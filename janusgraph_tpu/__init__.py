@@ -1,0 +1,24 @@
+"""janusgraph_tpu — a TPU-native property-graph framework.
+
+A brand-new framework with the capability envelope of JanusGraph (the
+reference distributed transactional property-graph database): schema-full
+property graphs, OLTP traversals, composite/mixed indexing, ACID-ish
+transactions with WAL, pluggable sorted-wide-row storage — and, first-class,
+an OLAP bulk-synchronous vertex-program engine executed on TPU via JAX:
+adjacency bulk-loaded into HBM as CSR blocks, supersteps compiled with
+jit/shard_map, cross-partition messages via ICI collectives, global
+aggregators via psum.
+
+Architecture is TPU-idiomatic, not a translation of the reference's Java
+design. See SURVEY.md for the structural analysis driving capability parity.
+"""
+
+__version__ = "0.1.0"
+
+
+def open_graph(config=None):
+    """Open a graph (JanusGraphFactory.open equivalent). Lazy import keeps
+    `import janusgraph_tpu` cheap for storage-only users."""
+    from janusgraph_tpu.core.graph import open_graph as _open
+
+    return _open(config)

@@ -1,0 +1,64 @@
+"""Exception hierarchy.
+
+Mirrors the capability split of the reference's exception model
+(reference: janusgraph-core .../core/JanusGraphException.java,
+diskstorage/BackendException.java): backend errors distinguish *temporary*
+(retriable with backoff) from *permanent* failures, which drives the retry
+policy in BackendOperation-equivalent wrappers.
+"""
+
+
+class JanusGraphTPUError(Exception):
+    """Base class for all framework errors."""
+
+
+class BackendError(JanusGraphTPUError):
+    """Storage backend failure."""
+
+
+class TemporaryBackendError(BackendError):
+    """Transient failure; the operation may be retried with backoff."""
+
+
+class PermanentBackendError(BackendError):
+    """Non-retriable failure."""
+
+
+class TemporaryLockingError(TemporaryBackendError):
+    """Lock contention; retry may succeed."""
+
+
+class PermanentLockingError(PermanentBackendError):
+    """Lock protocol failure (e.g. expectation check failed)."""
+
+
+class IDPoolExhaustedError(JanusGraphTPUError):
+    """No more IDs available in the allocation namespace."""
+
+
+class InvalidElementError(JanusGraphTPUError):
+    """Operation on a removed or invalid graph element."""
+
+    def __init__(self, msg, element=None):
+        super().__init__(msg)
+        self.element = element
+
+
+class InvalidIDError(JanusGraphTPUError):
+    """Malformed or out-of-range element ID."""
+
+
+class SchemaViolationError(JanusGraphTPUError):
+    """Schema constraint (multiplicity, cardinality, uniqueness, type) violated."""
+
+
+class ReadOnlyTransactionError(JanusGraphTPUError):
+    """Mutation attempted in a read-only transaction."""
+
+
+class QueryError(JanusGraphTPUError):
+    """Malformed or unsupported query."""
+
+
+class ConfigurationError(JanusGraphTPUError):
+    """Invalid configuration."""

@@ -1,0 +1,13 @@
+from janusgraph_tpu.storage.kcvs import (  # noqa: F401
+    Entry,
+    EntryList,
+    KCVMutation,
+    KeyColumnValueStore,
+    KeyColumnValueStoreManager,
+    KeyRangeQuery,
+    KeySliceQuery,
+    SliceQuery,
+    StoreFeatures,
+    StoreTransaction,
+)
+from janusgraph_tpu.storage.inmemory import InMemoryStoreManager  # noqa: F401

@@ -1,0 +1,203 @@
+"""In-memory KCVS backend — the default host store and test fake.
+
+Capability parity with the reference's inmemory backend
+(reference: janusgraph-inmemory .../inmemory/InMemoryStoreManager.java:200,
+InMemoryKeyColumnValueStore.java:444, copy-on-write page buffers
+MultiPageEntryBuffer.java:406): ordered key scans, snapshot reads, no
+native locking/transactions.
+
+Design differences from the reference (TPU-first, not a port): rows are
+copy-on-write *immutable tuples* of parallel (columns, values) lists —
+a mutation builds a fresh row and swaps one reference, so readers get
+consistent snapshots without locks (single-swap atomicity under the GIL,
+mirroring the reference's volatile page-list swap). The OLAP bulk loader
+reads whole rows at once and vectorizes decoding with numpy, so there is
+no per-page structure to maintain.
+"""
+
+from __future__ import annotations
+
+import bisect
+import threading
+from typing import Dict, Iterator, List, Optional, Sequence, Tuple
+
+from janusgraph_tpu.storage.kcvs import (
+    Entry,
+    EntryList,
+    KCVMutation,
+    KeyColumnValueStore,
+    KeyColumnValueStoreManager,
+    KeyRangeQuery,
+    KeySliceQuery,
+    SliceQuery,
+    StoreFeatures,
+    StoreTransaction,
+)
+
+
+class _Row:
+    """Immutable snapshot of one row: parallel sorted columns/values."""
+
+    __slots__ = ("columns", "values")
+
+    def __init__(self, columns: List[bytes], values: List[bytes]):
+        self.columns = columns
+        self.values = values
+
+    def slice(self, q: SliceQuery) -> EntryList:
+        cols = self.columns
+        lo = bisect.bisect_left(cols, q.start)
+        hi = len(cols) if q.end is None else bisect.bisect_left(cols, q.end)
+        if q.limit is not None and hi - lo > q.limit:
+            hi = lo + q.limit
+        vals = self.values
+        return [(cols[i], vals[i]) for i in range(lo, hi)]
+
+    def mutated(self, additions: EntryList, deletions: Sequence[bytes]) -> "_Row":
+        """Return a new row with the mutation applied (additions override
+        deletions of the same column, matching reference semantics).
+        Single O(n+m) two-way merge — bulk loads write thousands of columns
+        per call."""
+        added = {c: v for c, v in additions}
+        deleted = set(deletions) - set(added)
+        cols: List[bytes] = []
+        vals: List[bytes] = []
+        old_cols, old_vals = self.columns, self.values
+        add_cols = sorted(added)
+        i = j = 0
+        n, m = len(old_cols), len(add_cols)
+        while i < n or j < m:
+            if j >= m or (i < n and old_cols[i] < add_cols[j]):
+                c = old_cols[i]
+                if c not in deleted and c not in added:
+                    cols.append(c)
+                    vals.append(old_vals[i])
+                i += 1
+            else:
+                c = add_cols[j]
+                cols.append(c)
+                vals.append(added[c])
+                j += 1
+                if i < n and old_cols[i] == c:
+                    i += 1
+        return _Row(cols, vals)
+
+    def is_empty(self) -> bool:
+        return not self.columns
+
+
+_EMPTY_ROW = _Row([], [])
+
+
+class InMemoryKeyColumnValueStore(KeyColumnValueStore):
+    def __init__(self, name: str):
+        self._name = name
+        self._rows: Dict[bytes, _Row] = {}
+        self._write_lock = threading.Lock()
+
+    @property
+    def name(self) -> str:
+        return self._name
+
+    def get_slice(self, query: KeySliceQuery, txh: StoreTransaction) -> EntryList:
+        row = self._rows.get(query.key)
+        if row is None:
+            return []
+        return row.slice(query.slice)
+
+    def mutate(
+        self,
+        key: bytes,
+        additions: EntryList,
+        deletions: Sequence[bytes],
+        txh: StoreTransaction,
+    ) -> None:
+        with self._write_lock:
+            row = self._rows.get(key, _EMPTY_ROW)
+            new_row = row.mutated(additions, deletions)
+            if new_row.is_empty():
+                self._rows.pop(key, None)
+            else:
+                self._rows[key] = new_row
+
+    def get_keys(
+        self, query, txh: StoreTransaction
+    ) -> Iterator[Tuple[bytes, EntryList]]:
+        if isinstance(query, KeyRangeQuery):
+            sq = query.slice
+            keys = sorted(
+                k for k in self._rows if query.key_start <= k < query.key_end
+            )
+        else:
+            sq = query
+            keys = sorted(self._rows)
+        for k in keys:
+            row = self._rows.get(k)
+            if row is None:
+                continue
+            entries = row.slice(sq)
+            if entries:
+                yield k, entries
+
+    # -- introspection used by the OLAP bulk loader ------------------------
+    def row_count(self) -> int:
+        return len(self._rows)
+
+    def clear(self) -> None:
+        with self._write_lock:
+            self._rows.clear()
+
+
+class InMemoryStoreManager(KeyColumnValueStoreManager):
+    """Heap-backed store manager; ordered scans, no locking, no tx."""
+
+    def __init__(self, config: Optional[dict] = None):
+        self.config = config or {}
+        self._stores: Dict[str, InMemoryKeyColumnValueStore] = {}
+        self._lock = threading.Lock()
+        self._features = StoreFeatures(
+            ordered_scan=True,
+            unordered_scan=True,
+            multi_query=True,
+            batch_mutation=True,
+            key_consistent=True,
+            persists=False,
+        )
+
+    @property
+    def features(self) -> StoreFeatures:
+        return self._features
+
+    def open_database(self, name: str) -> InMemoryKeyColumnValueStore:
+        with self._lock:
+            store = self._stores.get(name)
+            if store is None:
+                store = InMemoryKeyColumnValueStore(name)
+                self._stores[name] = store
+            return store
+
+    def begin_transaction(self, config: Optional[dict] = None) -> StoreTransaction:
+        return StoreTransaction(config)
+
+    def mutate_many(
+        self,
+        mutations: Dict[str, Dict[bytes, KCVMutation]],
+        txh: StoreTransaction,
+    ) -> None:
+        for store_name, rows in mutations.items():
+            store = self.open_database(store_name)
+            for key, m in rows.items():
+                if not m.is_empty():
+                    store.mutate(key, m.additions, m.deletions, txh)
+
+    def close(self) -> None:
+        pass
+
+    def clear_storage(self) -> None:
+        with self._lock:
+            for s in self._stores.values():
+                s.clear()
+            self._stores.clear()
+
+    def exists(self) -> bool:
+        return bool(self._stores)

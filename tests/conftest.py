@@ -1,0 +1,33 @@
+"""Test harness configuration.
+
+Tests run on CPU with 8 virtual XLA devices so multi-chip sharding paths
+(Mesh/shard_map over partitions) are exercised without TPU hardware — the
+"multi-node without a cluster" technique, mirroring the reference's pattern
+of opening several store managers against one backend in a single JVM
+(reference: janusgraph-backend-testutils .../IDAuthorityTest.java,
+LogTest.java).
+"""
+
+import os
+
+# Must be set before jax is imported anywhere in the test process.
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import pytest  # noqa: E402
+
+from janusgraph_tpu.storage.inmemory import InMemoryStoreManager  # noqa: E402
+
+
+@pytest.fixture
+def store_manager():
+    """Parameterization point for backend-contract suites: every backend
+    must pass the same abstract suites (the reference's
+    backend-testutils pattern)."""
+    mgr = InMemoryStoreManager()
+    yield mgr
+    mgr.close()

@@ -1,0 +1,172 @@
+"""KCVS contract suite — port of the reference's store-contract tests
+(reference: janusgraph-backend-testutils .../diskstorage/KeyColumnValueStoreTest.java:
+slice semantics, ordering, limits, deletions, getKeys, concurrency;
+MultiWriteKeyColumnValueStoreTest.java: batched mutateMany).
+
+Runs against the `store_manager` fixture so any backend can be substituted.
+"""
+
+import threading
+
+import pytest
+
+from janusgraph_tpu.storage.kcvs import (
+    KCVMutation,
+    KeyRangeQuery,
+    KeySliceQuery,
+    SliceQuery,
+    entries_in_slice,
+)
+
+
+def col(i: int) -> bytes:
+    return i.to_bytes(4, "big")
+
+
+def key(i: int) -> bytes:
+    return b"k" + i.to_bytes(4, "big")
+
+
+@pytest.fixture
+def store(store_manager):
+    return store_manager.open_database("teststore")
+
+
+@pytest.fixture
+def tx(store_manager):
+    return store_manager.begin_transaction()
+
+
+def load(store, tx, nkeys=10, ncols=20):
+    for k in range(nkeys):
+        adds = [(col(c), b"v%d-%d" % (k, c)) for c in range(ncols)]
+        store.mutate(key(k), adds, [], tx)
+
+
+def test_slice_ordering_and_bounds(store, tx):
+    load(store, tx)
+    res = store.get_slice(KeySliceQuery(key(3), SliceQuery(col(5), col(15))), tx)
+    assert [c for c, _ in res] == [col(i) for i in range(5, 15)]
+    assert res[0][1] == b"v3-5"
+    # ascending order guaranteed
+    assert res == sorted(res)
+
+
+def test_slice_limit(store, tx):
+    load(store, tx)
+    res = store.get_slice(
+        KeySliceQuery(key(1), SliceQuery(col(0), col(20), limit=7)), tx
+    )
+    assert len(res) == 7
+    assert res[-1][0] == col(6)
+
+
+def test_slice_empty_row(store, tx):
+    assert store.get_slice(KeySliceQuery(b"nope", SliceQuery()), tx) == []
+
+
+def test_mutate_overwrites_and_deletes(store, tx):
+    store.mutate(key(0), [(col(1), b"a"), (col(2), b"b")], [], tx)
+    store.mutate(key(0), [(col(1), b"a2")], [col(2)], tx)
+    res = store.get_slice(KeySliceQuery(key(0), SliceQuery()), tx)
+    assert res == [(col(1), b"a2")]
+
+
+def test_addition_wins_over_deletion_same_call(store, tx):
+    # Matches reference semantics: within one mutate(), additions shadow
+    # deletions of the same column.
+    store.mutate(key(0), [(col(1), b"new")], [col(1)], tx)
+    res = store.get_slice(KeySliceQuery(key(0), SliceQuery()), tx)
+    assert res == [(col(1), b"new")]
+
+
+def test_row_removed_when_empty(store, tx):
+    store.mutate(key(0), [(col(1), b"a")], [], tx)
+    store.mutate(key(0), [], [col(1)], tx)
+    assert list(store.get_keys(SliceQuery(), tx)) == []
+
+
+def test_get_slice_multi(store, tx):
+    load(store, tx, nkeys=5, ncols=5)
+    res = store.get_slice_multi([key(0), key(3), key(9)], SliceQuery(), tx)
+    assert len(res[key(0)]) == 5
+    assert len(res[key(3)]) == 5
+    assert res[key(9)] == []
+
+
+def test_get_keys_ordered(store, tx):
+    load(store, tx, nkeys=8, ncols=2)
+    rows = list(store.get_keys(SliceQuery(), tx))
+    assert [k for k, _ in rows] == [key(i) for i in range(8)]
+    # range scan
+    rows = list(store.get_keys(KeyRangeQuery(key(2), key(5), SliceQuery()), tx))
+    assert [k for k, _ in rows] == [key(2), key(3), key(4)]
+
+
+def test_get_keys_skips_rows_outside_slice(store, tx):
+    store.mutate(key(0), [(col(1), b"a")], [], tx)
+    store.mutate(key(1), [(col(99), b"b")], [], tx)
+    rows = list(store.get_keys(SliceQuery(col(0), col(50)), tx))
+    assert [k for k, _ in rows] == [key(0)]
+
+
+def test_mutate_many_across_stores(store_manager):
+    tx = store_manager.begin_transaction()
+    muts = {
+        "s1": {key(0): KCVMutation(additions=[(col(1), b"x")])},
+        "s2": {key(0): KCVMutation(additions=[(col(2), b"y")])},
+    }
+    store_manager.mutate_many(muts, tx)
+    s1 = store_manager.open_database("s1")
+    s2 = store_manager.open_database("s2")
+    assert s1.get_slice(KeySliceQuery(key(0), SliceQuery()), tx) == [(col(1), b"x")]
+    assert s2.get_slice(KeySliceQuery(key(0), SliceQuery()), tx) == [(col(2), b"y")]
+
+
+def test_snapshot_read_during_write(store, tx):
+    """Readers must see a consistent row while a writer mutates (the
+    copy-on-write swap guarantee)."""
+    load(store, tx, nkeys=1, ncols=100)
+    errors = []
+    stop = threading.Event()
+
+    def reader():
+        while not stop.is_set():
+            res = store.get_slice(KeySliceQuery(key(0), SliceQuery()), tx)
+            cols = [c for c, _ in res]
+            if cols != sorted(cols):
+                errors.append("unsorted snapshot")
+
+    t = threading.Thread(target=reader)
+    t.start()
+    for i in range(200):
+        store.mutate(key(0), [(col(i % 100), b"w%d" % i)], [col((i * 7) % 100)], tx)
+    stop.set()
+    t.join()
+    assert not errors
+
+
+def test_concurrent_writers_distinct_keys(store, tx):
+    def writer(base):
+        for i in range(50):
+            store.mutate(key(base * 100 + i), [(col(i), b"v")], [], tx)
+
+    ts = [threading.Thread(target=writer, args=(b,)) for b in range(4)]
+    [t.start() for t in ts]
+    [t.join() for t in ts]
+    assert sum(1 for _ in store.get_keys(SliceQuery(), tx)) == 200
+
+
+def test_entries_in_slice_helper():
+    entries = [(col(i), b"v") for i in range(10)]
+    q = SliceQuery(col(2), col(7), limit=3)
+    assert entries_in_slice(entries, q) == [(col(i), b"v") for i in (2, 3, 4)]
+
+
+def test_clear_storage(store_manager):
+    tx = store_manager.begin_transaction()
+    s = store_manager.open_database("x")
+    s.mutate(b"k", [(b"c", b"v")], [], tx)
+    store_manager.clear_storage()
+    s2 = store_manager.open_database("x")
+    assert list(s2.get_keys(SliceQuery(), tx)) == []
