@@ -1,0 +1,325 @@
+"""Attribute serializer registry.
+
+Capability parity with the reference's serializer stack
+(reference: graphdb/database/serialize/StandardSerializer.java:78-132
+fixed-id registrations; serialize/attribute/*): a registry of binary
+serializers keyed by a stable small integer id, with an *order-preserving*
+mode used for sort keys and composite-index keys (byte-wise lexicographic
+order of the encoding == natural order of the value).
+
+Own design notes (not a port): encodings are fixed-width big-endian where
+possible so the OLAP bulk loader can decode property columns with vectorized
+numpy views instead of per-value Python.
+"""
+
+from __future__ import annotations
+
+import struct
+import uuid as _uuid
+from datetime import datetime, timedelta, timezone
+from typing import Any, Dict, Optional, Tuple, Type
+
+from janusgraph_tpu.exceptions import JanusGraphTPUError
+
+
+class SerializerError(JanusGraphTPUError):
+    pass
+
+
+class AttributeSerializer:
+    """One datatype's binary codec. Subclasses set `type_id` and `py_type`."""
+
+    type_id: int = -1
+    py_type: type = object
+    #: fixed encoded byte width, or None if variable
+    fixed_width: Optional[int] = None
+
+    def write(self, value) -> bytes:
+        raise NotImplementedError
+
+    def read(self, data: bytes):
+        raise NotImplementedError
+
+    # order-preserving variants default to the plain encoding when the plain
+    # encoding already sorts correctly; override otherwise.
+    def write_ordered(self, value) -> bytes:
+        return self.write(value)
+
+    def read_ordered(self, data: bytes):
+        return self.read(data)
+
+
+class BooleanSerializer(AttributeSerializer):
+    type_id = 1
+    py_type = bool
+    fixed_width = 1
+
+    def write(self, value) -> bytes:
+        return b"\x01" if value else b"\x00"
+
+    def read(self, data: bytes):
+        return data[0] != 0
+
+
+class LongSerializer(AttributeSerializer):
+    """Signed 64-bit. Ordered form flips the sign bit so byte order == numeric
+    order (two's-complement big-endian sorts negatives after positives
+    otherwise)."""
+
+    type_id = 2
+    py_type = int
+    fixed_width = 8
+
+    def write(self, value) -> bytes:
+        return struct.pack(">q", value)
+
+    def read(self, data: bytes):
+        return struct.unpack(">q", data)[0]
+
+    def write_ordered(self, value) -> bytes:
+        # struct raises on out-of-range, matching the plain write() path
+        return struct.pack(">Q", value + (1 << 63))
+
+    def read_ordered(self, data: bytes):
+        return struct.unpack(">Q", data)[0] - (1 << 63)
+
+
+class DoubleSerializer(AttributeSerializer):
+    """IEEE-754 double. Ordered form uses the total-order trick: flip all bits
+    of negatives, flip only the sign bit of non-negatives."""
+
+    type_id = 3
+    py_type = float
+    fixed_width = 8
+
+    def write(self, value) -> bytes:
+        return struct.pack(">d", value)
+
+    def read(self, data: bytes):
+        return struct.unpack(">d", data)[0]
+
+    def write_ordered(self, value) -> bytes:
+        bits = struct.unpack(">Q", struct.pack(">d", value))[0]
+        if bits & (1 << 63):
+            bits ^= (1 << 64) - 1
+        else:
+            bits ^= 1 << 63
+        return struct.pack(">Q", bits)
+
+    def read_ordered(self, data: bytes):
+        bits = struct.unpack(">Q", data)[0]
+        if bits & (1 << 63):
+            bits ^= 1 << 63
+        else:
+            bits ^= (1 << 64) - 1
+        return struct.unpack(">d", struct.pack(">Q", bits))[0]
+
+
+class StringSerializer(AttributeSerializer):
+    """UTF-8. Ordered form appends a NUL terminator; embedded NULs are
+    rejected in ordered mode so prefix containment can't corrupt ordering
+    (reference counterpart compresses — we favor vectorizable simplicity)."""
+
+    type_id = 4
+    py_type = str
+
+    def write(self, value) -> bytes:
+        return value.encode("utf-8")
+
+    def read(self, data: bytes):
+        return data.decode("utf-8")
+
+    def write_ordered(self, value) -> bytes:
+        raw = value.encode("utf-8")
+        if b"\x00" in raw:
+            raise SerializerError("NUL not allowed in ordered (sort-key) strings")
+        return raw + b"\x00"
+
+    def read_ordered(self, data: bytes):
+        if not data.endswith(b"\x00"):
+            raise SerializerError("malformed ordered string")
+        return data[:-1].decode("utf-8")
+
+
+class BytesSerializer(AttributeSerializer):
+    type_id = 5
+    py_type = bytes
+
+    def write(self, value) -> bytes:
+        return bytes(value)
+
+    def read(self, data: bytes):
+        return bytes(data)
+
+
+class DateSerializer(AttributeSerializer):
+    """UTC datetime as epoch-micros int64 (ordered like LongSerializer)."""
+
+    type_id = 6
+    py_type = datetime
+    fixed_width = 8
+
+    _EPOCH = datetime(1970, 1, 1, tzinfo=timezone.utc)
+
+    def _to_micros(self, value: datetime) -> int:
+        if value.tzinfo is None:
+            value = value.replace(tzinfo=timezone.utc)
+        # integer arithmetic: float timestamps lose microseconds far from epoch
+        return (value - self._EPOCH) // timedelta(microseconds=1)
+
+    def _from_micros(self, micros: int) -> datetime:
+        return self._EPOCH + timedelta(microseconds=micros)
+
+    def write(self, value) -> bytes:
+        return struct.pack(">q", self._to_micros(value))
+
+    def read(self, data: bytes):
+        return self._from_micros(struct.unpack(">q", data)[0])
+
+    def write_ordered(self, value) -> bytes:
+        return LongSerializer().write_ordered(self._to_micros(value))
+
+    def read_ordered(self, data: bytes):
+        return self._from_micros(LongSerializer().read_ordered(data))
+
+
+class UUIDSerializer(AttributeSerializer):
+    type_id = 7
+    py_type = _uuid.UUID
+    fixed_width = 16
+
+    def write(self, value) -> bytes:
+        return value.bytes
+
+    def read(self, data: bytes):
+        return _uuid.UUID(bytes=bytes(data))
+
+
+class FloatListSerializer(AttributeSerializer):
+    """list[float] — the OLAP compute-property carrier (e.g. pagerank vectors)."""
+
+    type_id = 8
+    py_type = list
+
+    def write(self, value) -> bytes:
+        return struct.pack(f">{len(value)}d", *value)
+
+    def read(self, data: bytes):
+        n = len(data) // 8
+        return list(struct.unpack(f">{n}d", data))
+
+
+class GeoshapePoint:
+    """Minimal geoshape: a (lat, lon) point. Full shape vocabulary
+    (circle/box/polygon, WKT) is tracked for a later round
+    (reference: core/attribute/Geoshape.java:623)."""
+
+    __slots__ = ("lat", "lon")
+
+    def __init__(self, lat: float, lon: float):
+        self.lat = float(lat)
+        self.lon = float(lon)
+
+    def __eq__(self, other):
+        return (
+            isinstance(other, GeoshapePoint)
+            and self.lat == other.lat
+            and self.lon == other.lon
+        )
+
+    def __hash__(self):
+        return hash((self.lat, self.lon))
+
+    def __repr__(self):
+        return f"point({self.lat}, {self.lon})"
+
+
+class GeoshapeSerializer(AttributeSerializer):
+    type_id = 9
+    py_type = GeoshapePoint
+    fixed_width = 16
+
+    def write(self, value) -> bytes:
+        return struct.pack(">dd", value.lat, value.lon)
+
+    def read(self, data: bytes):
+        return GeoshapePoint(*struct.unpack(">dd", data))
+
+
+class Serializer:
+    """The registry: type-id <-> serializer <-> python type.
+
+    Values are framed as [type_id:2 BE][payload] so heterogeneous cells are
+    self-describing (reference: StandardSerializer writeObjectNotNull)."""
+
+    def __init__(self):
+        self._by_id: Dict[int, AttributeSerializer] = {}
+        self._by_type: Dict[type, AttributeSerializer] = {}
+        for cls in (
+            BooleanSerializer,
+            LongSerializer,
+            DoubleSerializer,
+            StringSerializer,
+            BytesSerializer,
+            DateSerializer,
+            UUIDSerializer,
+            FloatListSerializer,
+            GeoshapeSerializer,
+        ):
+            self.register(cls())
+
+    def register(self, ser: AttributeSerializer) -> None:
+        if ser.type_id in self._by_id:
+            raise SerializerError(f"duplicate serializer id {ser.type_id}")
+        self._by_id[ser.type_id] = ser
+        self._by_type[ser.py_type] = ser
+
+    def serializer_for(self, value) -> AttributeSerializer:
+        # bool is a subclass of int: check exact type first, then walk MRO
+        ser = self._by_type.get(type(value))
+        if ser is not None:
+            return ser
+        for t, s in self._by_type.items():
+            if isinstance(value, t) and not (
+                t is int and isinstance(value, bool)
+            ):
+                return s
+        raise SerializerError(f"no serializer for {type(value).__name__}")
+
+    def serializer_for_type(self, py_type: type) -> AttributeSerializer:
+        ser = self._by_type.get(py_type)
+        if ser is None:
+            raise SerializerError(f"no serializer for type {py_type.__name__}")
+        return ser
+
+    # -- framed object encoding --------------------------------------------
+    def write_object(self, value) -> bytes:
+        ser = self.serializer_for(value)
+        return struct.pack(">H", ser.type_id) + ser.write(value)
+
+    def read_object(self, data: bytes) -> Tuple[Any, int]:
+        """Decode a framed value; returns (value, bytes_consumed). Only
+        fixed-width payloads can be length-inferred mid-stream; variable-width
+        payloads must be the tail of `data`."""
+        (tid,) = struct.unpack(">H", data[:2])
+        ser = self._by_id.get(tid)
+        if ser is None:
+            raise SerializerError(f"unknown serializer id {tid}")
+        if ser.fixed_width is not None:
+            end = 2 + ser.fixed_width
+            return ser.read(data[2:end]), end
+        return ser.read(data[2:]), len(data)
+
+    # -- order-preserving encoding (sort keys / index keys) ----------------
+    def write_ordered(self, value) -> bytes:
+        ser = self.serializer_for(value)
+        return ser.write_ordered(value)
+
+    def data_type_id(self, py_type: type) -> int:
+        return self.serializer_for_type(py_type).type_id
+
+    def type_for_id(self, tid: int) -> type:
+        ser = self._by_id.get(tid)
+        if ser is None:
+            raise SerializerError(f"unknown serializer id {tid}")
+        return ser.py_type

@@ -1,0 +1,104 @@
+"""ID scheme tests (reference model: janusgraph-test
+.../graphdb/idmanagement/* — id encoding round trips, key ordering,
+partition extraction, canonical partitioned-vertex ids)."""
+
+import pytest
+
+from janusgraph_tpu.core.ids import IDManager, VertexIDType
+from janusgraph_tpu.exceptions import InvalidIDError
+
+
+@pytest.fixture
+def idm():
+    return IDManager(partition_bits=5)
+
+
+def test_roundtrip_all_types(idm):
+    for t in VertexIDType:
+        partition = 0 if t.is_schema else 17
+        vid = idm.make_vertex_id(42, partition, t)
+        assert idm.id_type(vid) is t
+        assert idm.get_count(vid) == 42
+        assert idm.get_partition_id(vid) == partition
+
+
+def test_key_roundtrip_and_partition_locality(idm):
+    vids = [
+        idm.make_vertex_id(c, p)
+        for p in range(idm.num_partitions)
+        for c in (1, 2, 1000)
+    ]
+    for vid in vids:
+        assert idm.get_vertex_id(idm.get_key(vid)) == vid
+    # keys sort by partition first: all partition-p keys contiguous
+    keyed = sorted((idm.get_key(v), idm.get_partition_id(v)) for v in vids)
+    partitions = [p for _, p in keyed]
+    assert partitions == sorted(partitions)
+
+
+def test_partition_key_range_covers_exactly(idm):
+    for p in (0, 3, idm.num_partitions - 1):
+        start, end = idm.partition_key_range(p)
+        inside = idm.get_key(idm.make_vertex_id(99, p))
+        assert start <= inside < end
+        if p + 1 < idm.num_partitions:
+            outside = idm.get_key(idm.make_vertex_id(1, p + 1))
+            assert not (start <= outside < end)
+
+
+def test_schema_ids(idm):
+    sid = idm.make_schema_id(VertexIDType.USER_PROPERTY_KEY, 7)
+    assert idm.is_schema_vertex_id(sid)
+    assert not idm.is_user_vertex_id(sid)
+    assert idm.get_partition_id(sid) == 0
+    with pytest.raises(InvalidIDError):
+        idm.make_vertex_id(7, 3, VertexIDType.VERTEX_LABEL)  # schema => partition 0
+    with pytest.raises(InvalidIDError):
+        idm.make_schema_id(VertexIDType.NORMAL, 7)
+
+
+def test_normal_vs_schema_classification(idm):
+    nid = idm.make_vertex_id(5, 2)
+    assert idm.is_user_vertex_id(nid)
+    assert not idm.is_schema_vertex_id(nid)
+    assert not idm.is_partitioned_vertex_id(nid)
+
+
+def test_partitioned_vertex_canonical(idm):
+    count = 11
+    copies = [
+        idm.make_vertex_id(count, p, VertexIDType.PARTITIONED)
+        for p in range(idm.num_partitions)
+    ]
+    canon = {idm.get_canonical_vertex_id(v) for v in copies}
+    assert len(canon) == 1
+    c = canon.pop()
+    assert idm.get_partition_id(c) == count % idm.num_partitions
+    # copies enumerable from any copy
+    assert set(idm.partitioned_vertex_copies(copies[3])) == set(copies)
+    # canonical of a normal vertex is itself
+    nid = idm.make_vertex_id(5, 2)
+    assert idm.get_canonical_vertex_id(nid) == nid
+
+
+def test_bounds_checks(idm):
+    with pytest.raises(InvalidIDError):
+        idm.make_vertex_id(0, 0)
+    with pytest.raises(InvalidIDError):
+        idm.make_vertex_id(1, idm.num_partitions)
+    with pytest.raises(InvalidIDError):
+        idm.make_vertex_id(idm.max_count(VertexIDType.NORMAL) + 1, 0)
+    big = idm.make_vertex_id(idm.max_count(VertexIDType.NORMAL), 0)
+    assert big < (1 << 63)
+
+
+def test_temporary_ids(idm):
+    assert idm.is_temporary(-5)
+    assert not idm.is_temporary(5)
+
+
+def test_zero_partition_bits():
+    idm = IDManager(partition_bits=0)
+    vid = idm.make_vertex_id(3, 0)
+    assert idm.get_partition_id(vid) == 0
+    assert idm.get_vertex_id(idm.get_key(vid)) == vid
