@@ -102,6 +102,7 @@ class RelationCache:
     other_vertex_id: Optional[int] = None  # edges only
     value: object = None                   # property value (properties only)
     properties: Optional[Dict[int, object]] = None  # edge inline props
+    sort_key: bytes = b""                  # edges: raw sort-key bytes
 
     @property
     def is_edge(self) -> bool:
@@ -251,6 +252,7 @@ class EdgeSerializer:
                 direction=Direction(direction),
                 other_vertex_id=other_vid,
                 properties=props,
+                sort_key=col[11:off],
             )
         info = schema(type_id)
         if info.cardinality == Cardinality.SINGLE:

@@ -1,0 +1,450 @@
+"""The graph: lifecycle, wiring, ID assignment, schema persistence, and the
+commit pipeline.
+
+Capability parity with the reference's graph database core
+(reference: graphdb/database/StandardJanusGraph.java:96 — open/close and
+commit orchestration :674-830; core/JanusGraphFactory.java:78-161 open by
+config; idassigner/VertexIDAssigner.java:49 partition placement).
+"""
+
+from __future__ import annotations
+
+import struct
+import threading
+from typing import Dict, List, Optional
+
+from janusgraph_tpu.core.attributes import Serializer
+from janusgraph_tpu.core.codecs import (
+    Cardinality,
+    Direction,
+    EdgeSerializer,
+)
+from janusgraph_tpu.core.elements import Edge, VertexProperty
+from janusgraph_tpu.core.ids import IDManager, VertexIDType
+from janusgraph_tpu.core.index import IndexSerializer
+from janusgraph_tpu.core.management import (
+    INDEX_REGISTRY_KEY,
+    SCHEMA_NAME_INDEX_PREFIX,
+    ManagementSystem,
+)
+from janusgraph_tpu.core.schema import (
+    EdgeLabel,
+    IndexDefinition,
+    PropertyKey,
+    SchemaCache,
+    SystemTypes,
+    VertexLabel,
+    decode_definition,
+    encode_definition,
+    schema_element_from_definition,
+)
+from janusgraph_tpu.core.tx import Transaction
+from janusgraph_tpu.exceptions import ConfigurationError, SchemaViolationError
+from janusgraph_tpu.storage.backend import Backend
+from janusgraph_tpu.storage.idauthority import ConsistentKeyIDAuthority, StandardIDPool
+from janusgraph_tpu.storage.inmemory import InMemoryStoreManager
+from janusgraph_tpu.storage.kcvs import KeySliceQuery, SliceQuery
+
+DEFAULT_CONFIG = {
+    "storage.backend": "inmemory",
+    "ids.partition-bits": 5,
+    "ids.block-size": 10_000,
+    "ids.authority-wait-ms": 0.5,
+    "cache.db-cache": True,
+    "schema.default": "auto",  # auto-create schema on first use ("none" = strict)
+}
+
+_STORE_MANAGERS = {
+    "inmemory": InMemoryStoreManager,
+}
+
+
+def open_graph(config: Optional[dict] = None) -> "JanusGraphTPU":
+    """JanusGraphFactory.open equivalent."""
+    return JanusGraphTPU(config)
+
+
+class VertexIDAssigner:
+    """Maps new elements to IDs: round-robin partition placement for normal
+    vertices, canonical-partition ids for partitioned (vertex-cut) labels
+    (reference: idassigner/VertexIDAssigner.java + placement strategies)."""
+
+    def __init__(self, authority: ConsistentKeyIDAuthority, idm: IDManager):
+        self.authority = authority
+        self.idm = idm
+        self._vertex_pools: Dict[int, StandardIDPool] = {}
+        self._relation_pool = StandardIDPool(
+            authority, ConsistentKeyIDAuthority.NS_RELATION, 0
+        )
+        self._schema_pool = StandardIDPool(
+            authority, ConsistentKeyIDAuthority.NS_SCHEMA, 0
+        )
+        self._rr = 0
+        self._lock = threading.Lock()
+
+    def _pool(self, partition: int) -> StandardIDPool:
+        with self._lock:
+            pool = self._vertex_pools.get(partition)
+            if pool is None:
+                pool = StandardIDPool(
+                    self.authority, ConsistentKeyIDAuthority.NS_VERTEX, partition
+                )
+                self._vertex_pools[partition] = pool
+            return pool
+
+    def assign_vertex_id(self, partitioned: bool = False) -> int:
+        with self._lock:
+            partition = self._rr % self.idm.num_partitions
+            self._rr += 1
+        count = self._pool(partition).next_id()
+        if partitioned:
+            canonical = count % self.idm.num_partitions
+            return self.idm.make_vertex_id(
+                count, canonical, VertexIDType.PARTITIONED
+            )
+        return self.idm.make_vertex_id(count, partition)
+
+    def assign_relation_id(self) -> int:
+        return self.idm.make_relation_id(self._relation_pool.next_id())
+
+    def assign_schema_id(self, id_type: VertexIDType) -> int:
+        return self.idm.make_schema_id(id_type, self._schema_pool.next_id())
+
+
+class JanusGraphTPU:
+    def __init__(self, config: Optional[dict] = None):
+        cfg = dict(DEFAULT_CONFIG)
+        if config:
+            cfg.update(config)
+        self.config = cfg
+        backend_name = cfg["storage.backend"]
+        factory = _STORE_MANAGERS.get(backend_name)
+        if factory is None:
+            raise ConfigurationError(f"unknown storage backend {backend_name!r}")
+        self.idm = IDManager(partition_bits=cfg["ids.partition-bits"])
+        self.serializer = Serializer()
+        self.edge_serializer = EdgeSerializer(self.serializer, self.idm)
+        self.system_types = SystemTypes(self.idm)
+        self.backend = Backend(
+            factory(),
+            cache_enabled=cfg["cache.db-cache"],
+            id_block_size=cfg["ids.block-size"],
+        )
+        self.backend.id_authority.wait_ms = cfg["ids.authority-wait-ms"]
+        self.id_assigner = VertexIDAssigner(self.backend.id_authority, self.idm)
+        self.index_serializer = IndexSerializer(self.serializer)
+        self.schema_cache = SchemaCache(
+            self._load_schema_by_name, self._load_schema_by_id
+        )
+        self.auto_schema = cfg["schema.default"] == "auto"
+        self.indexes: Dict[str, IndexDefinition] = {}
+        self._commit_lock = threading.Lock()
+        self._open = True
+        self._load_index_registry()
+
+    # ------------------------------------------------------------- lifecycle
+    def new_transaction(self, read_only: bool = False) -> Transaction:
+        return Transaction(self, read_only=read_only)
+
+    def traversal(self):
+        from janusgraph_tpu.core.traversal import GraphTraversalSource
+
+        return GraphTraversalSource(self)
+
+    def management(self) -> ManagementSystem:
+        return ManagementSystem(self)
+
+    def close(self) -> None:
+        if self._open:
+            self.backend.close()
+            self._open = False
+
+    # ------------------------------------------------------ schema persistence
+    def persist_schema_element(self, el) -> None:
+        es = self.edge_serializer
+        st = self.system_types
+        btx = self.backend.begin_transaction()
+        key = self.idm.get_key(el.id)
+        rid = self.id_assigner.assign_relation_id
+        adds = [
+            es.write_property(st.EXISTS, rid(), True),
+            es.write_property(st.SCHEMA_NAME, rid(), el.name),
+            es.write_property(
+                st.SCHEMA_DEF, rid(), encode_definition(el.definition())
+            ),
+        ]
+        btx.mutate_edges(key, adds, [])
+        # name -> id lookup row (index names live in their own namespace)
+        from janusgraph_tpu.core.management import INDEX_NAME_PREFIX
+
+        prefix = (
+            INDEX_NAME_PREFIX
+            if isinstance(el, IndexDefinition)
+            else SCHEMA_NAME_INDEX_PREFIX
+        )
+        btx.mutate_index(
+            prefix + el.name.encode(),
+            [(struct.pack(">Q", el.id), b"")],
+            [],
+        )
+        btx.commit()
+        self.schema_cache.invalidate(el.name)
+
+    def _load_schema_by_name(self, name: str):
+        btx = self.backend.begin_transaction()
+        entries = btx.index_query(
+            KeySliceQuery(SCHEMA_NAME_INDEX_PREFIX + name.encode(), SliceQuery())
+        )
+        if not entries:
+            return None
+        (sid,) = struct.unpack(">Q", entries[0][0])
+        return self._load_schema_by_id(sid)
+
+    def _load_schema_by_id(self, sid: int):
+        es = self.edge_serializer
+        st = self.system_types
+        btx = self.backend.begin_transaction()
+        key = self.idm.get_key(sid)
+        name = None
+        definition = None
+        for q, want in (
+            (es.get_type_slice(st.SCHEMA_NAME, False), "name"),
+            (es.get_type_slice(st.SCHEMA_DEF, False), "def"),
+        ):
+            entries = btx.edge_store_query(KeySliceQuery(key, q))
+            if not entries:
+                return None
+            rc = es.parse_relation(entries[0], st.type_info)
+            if want == "name":
+                name = rc.value
+            else:
+                definition = decode_definition(rc.value)
+        return schema_element_from_definition(sid, name, definition)
+
+    def load_all_schema_elements(self) -> List:
+        """Scan the schema-name index prefix (management enumeration)."""
+        out = []
+        btx = self.backend.begin_transaction()
+        store = self.backend.indexstore
+        from janusgraph_tpu.storage.kcvs import KeyRangeQuery
+
+        it = store.get_keys(
+            KeyRangeQuery(
+                SCHEMA_NAME_INDEX_PREFIX,
+                SCHEMA_NAME_INDEX_PREFIX + b"\xff",
+                SliceQuery(),
+            ),
+            btx.store_tx,
+        )
+        for _key, entries in it:
+            for col, _ in entries:
+                (sid,) = struct.unpack(">Q", col)
+                el = self.schema_cache.get_by_id(sid)
+                if el is not None:
+                    out.append(el)
+        return out
+
+    def get_or_create_vertex_label(self, name: str) -> VertexLabel:
+        el = self.schema_cache.get_by_name(name)
+        if isinstance(el, VertexLabel):
+            return el
+        if el is not None:
+            raise SchemaViolationError(f"{name} exists and is not a vertex label")
+        if not self.auto_schema and name != "vertex":
+            raise SchemaViolationError(f"undefined vertex label: {name}")
+        return self.management().make_vertex_label(name)
+
+    def register_index(self, idx: IndexDefinition) -> None:
+        self.indexes[idx.name] = idx
+
+    def _load_index_registry(self) -> None:
+        btx = self.backend.begin_transaction()
+        entries = btx.index_query(KeySliceQuery(INDEX_REGISTRY_KEY, SliceQuery()))
+        for col, _ in entries:
+            (sid,) = struct.unpack(">Q", col)
+            el = self.schema_cache.get_by_id(sid)
+            if isinstance(el, IndexDefinition):
+                self.indexes[el.name] = el
+
+    # ----------------------------------------------------------------- commit
+    def commit_tx(self, tx: Transaction) -> None:
+        """Serialize a transaction's mutations and flush them. Commits are
+        serialized under a graph-wide lock so unique-index checks are sound
+        in-process (distributed locking lands with the consistent-key locker
+        milestone)."""
+        es = self.edge_serializer
+        st = self.system_types
+        btx = tx.backend_tx
+        with self._commit_lock:
+            # -- 1. vertex existence + label cells for new vertices
+            for vid, label_id in tx._new_vertex_labels.items():
+                if vid in tx._removed_vertices:
+                    continue
+                adds = [
+                    es.write_property(
+                        st.EXISTS, self.id_assigner.assign_relation_id(), True
+                    ),
+                    es.write_edge(
+                        st.VERTEX_LABEL_EDGE,
+                        Direction.OUT,
+                        label_id,
+                        self.id_assigner.assign_relation_id(),
+                    ),
+                ]
+                btx.mutate_edges(self.idm.get_key(vid), adds, [])
+
+            # -- 2. deleted relations FIRST: a later buffered addition with
+            # the same column (e.g. SINGLE-cardinality property replacement)
+            # must win over the deletion under KCVMutation temporal merge
+            for rel in tx._deleted:
+                self._write_relation(tx, rel, delete=True)
+
+            # -- 3. added relations
+            seen = set()
+            for rels in tx._added.values():
+                for rel in rels:
+                    if rel.is_removed or rel.id in seen:
+                        continue
+                    seen.add(rel.id)
+                    self._write_relation(tx, rel, delete=False)
+
+            # -- 4. removed vertices: existence + label cells
+            for vid in tx._removed_vertices:
+                if vid in tx._new_vertex_labels:
+                    continue  # never persisted
+                dels = []
+                key = self.idm.get_key(vid)
+                for q in (
+                    es.get_type_slice(st.EXISTS, False),
+                    es.get_type_slice(st.VERTEX_LABEL_EDGE, True, Direction.OUT),
+                ):
+                    for col, _ in btx.edge_store_query(KeySliceQuery(key, q)):
+                        dels.append(col)
+                if dels:
+                    btx.mutate_edges(key, [], dels)
+
+            # -- 5. composite index updates + unique checks
+            self._apply_index_updates(tx, btx)
+
+            # -- 6. flush while still holding the lock (unique-index safety)
+            btx.commit()
+
+    def _write_relation(self, tx: Transaction, rel, delete: bool) -> None:
+        es = self.edge_serializer
+        if isinstance(rel, Edge):
+            label = tx.schema_by_id(rel.type_id)
+            out_cell = es.write_edge(
+                rel.type_id,
+                Direction.OUT,
+                rel.in_vertex.id,
+                rel.id,
+                rel._sort_key,
+                rel._props or None,
+            )
+            cells = [(rel.out_vertex.id, out_cell)]
+            if not (isinstance(label, EdgeLabel) and label.unidirected):
+                in_cell = es.write_edge(
+                    rel.type_id,
+                    Direction.IN,
+                    rel.out_vertex.id,
+                    rel.id,
+                    rel._sort_key,
+                    rel._props or None,
+                )
+                cells.append((rel.in_vertex.id, in_cell))
+            for vid, cell in cells:
+                key = self.idm.get_key(vid)
+                if delete:
+                    tx.backend_tx.mutate_edges(key, [], [cell[0]])
+                else:
+                    tx.backend_tx.mutate_edges(key, [cell], [])
+        else:  # VertexProperty
+            pk = tx.schema_by_id(rel.type_id)
+            card = pk.cardinality if isinstance(pk, PropertyKey) else Cardinality.SINGLE
+            cell = es.write_property(rel.type_id, rel.id, rel.value, card)
+            key = self.idm.get_key(rel.vertex.id)
+            if delete:
+                tx.backend_tx.mutate_edges(key, [], [cell[0]])
+            else:
+                tx.backend_tx.mutate_edges(key, [cell], [])
+
+    # ---------------------------------------------------------- index updates
+    def _apply_index_updates(self, tx: Transaction, btx) -> None:
+        if not self.indexes:
+            return
+        # vertices whose properties changed in this tx
+        changed: Dict[int, bool] = {}
+        for vid, rels in tx._added.items():
+            if any(isinstance(r, VertexProperty) and not r.is_removed for r in rels):
+                changed[vid] = True
+        for rel in tx._deleted:
+            if isinstance(rel, VertexProperty):
+                changed[rel.vertex.id] = True
+        for vid in tx._removed_vertices:
+            changed[vid] = True
+        if not changed:
+            return
+
+        for idx in self.indexes.values():
+            # within-tx duplicate detection for unique indexes: the committed
+            # index can't see sibling mutations buffered in this same tx
+            tx_unique_claims: Dict[tuple, int] = {}
+            for vid in changed:
+                before = self._index_values_committed(tx, idx, vid)
+                after = (
+                    None
+                    if vid in tx._removed_vertices
+                    else self._index_values_current(tx, idx, vid)
+                )
+                if idx.label_constraint is not None and (before or after):
+                    v = tx._vertex_handle(vid)
+                    if tx.get_vertex_label(v) != idx.label_constraint:
+                        continue
+                if before == after:
+                    continue
+                if idx.unique and after is not None:
+                    prior = tx_unique_claims.get(after)
+                    if prior is not None and prior != vid:
+                        raise SchemaViolationError(
+                            f"unique index {idx.name} violated within "
+                            f"transaction for values {after!r}"
+                        )
+                    tx_unique_claims[after] = vid
+                    self.index_serializer.check_unique(idx, after, vid, btx)
+                for row, adds, dels in self.index_serializer.index_updates(
+                    idx, vid, before, after
+                ):
+                    btx.mutate_index(row, adds, dels)
+
+    def _index_values_committed(self, tx, idx: IndexDefinition, vid: int):
+        """Value tuple from committed storage only (pre-tx state)."""
+        es = self.edge_serializer
+        values = []
+        for key_id in idx.key_ids:
+            q = es.get_type_slice(key_id, False)
+            entries = tx._read_slice(vid, q)
+            if not entries:
+                return None
+            rc = es.parse_relation(entries[0], tx._codec_schema)
+            values.append(rc.value)
+        return tuple(values)
+
+    def _index_values_current(self, tx, idx: IndexDefinition, vid: int):
+        """Value tuple as visible in the tx (committed minus deleted plus
+        added)."""
+        v = tx._vertex_handle(vid)
+        values = []
+        for key_id in idx.key_ids:
+            el = self.schema_cache.get_by_id(key_id)
+            props = tx.get_properties(v, el.name)
+            if not props:
+                return None
+            values.append(props[0].value)
+        return tuple(values)
+
+    # -------------------------------------------------------- index-based read
+    def index_lookup(self, tx: Transaction, index_name: str, values) -> List[int]:
+        idx = self.indexes.get(index_name)
+        if idx is None:
+            raise SchemaViolationError(f"unknown index {index_name}")
+        return self.index_serializer.query(idx, values, tx.backend_tx)

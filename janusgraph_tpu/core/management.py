@@ -1,0 +1,160 @@
+"""Schema management: create property keys / edge labels / vertex labels /
+composite indexes; enumerate and inspect them.
+
+Capability parity subset of the reference's ManagementSystem
+(reference: graphdb/database/management/ManagementSystem.java — schema CRUD
+and index building; makers graphdb/types/Standard{PropertyKey,EdgeLabel,
+VertexLabel}Maker.java). Divergence: schema operations auto-commit
+individually instead of batching under mgmt.commit() — simpler, and schema
+broadcast/eviction (reference ManagementLogger) arrives with the KCVS log in
+a later milestone. Index lifecycle REGISTER/REINDEX/DISABLE arrives with the
+OLAP reindex jobs.
+"""
+
+from __future__ import annotations
+
+import struct
+from typing import List, Optional, Sequence, Tuple
+
+from janusgraph_tpu.core.codecs import Cardinality, Direction, Multiplicity
+from janusgraph_tpu.core.ids import VertexIDType
+from janusgraph_tpu.core.schema import (
+    EdgeLabel,
+    IndexDefinition,
+    PropertyKey,
+    VertexLabel,
+    encode_definition,
+    _DATA_TYPE_NAMES,
+)
+from janusgraph_tpu.exceptions import SchemaViolationError
+
+SCHEMA_NAME_INDEX_PREFIX = b"\x00sn\x00"
+# graph-index names are a namespace separate from relation-type names
+# (reference: buildIndex("name", ...) coexists with PropertyKey "name")
+INDEX_NAME_PREFIX = b"\x00in\x00"
+INDEX_REGISTRY_KEY = b"\x00indexes"
+
+
+class ManagementSystem:
+    def __init__(self, graph):
+        self.graph = graph
+
+    # ------------------------------------------------------------------ makers
+    def make_property_key(
+        self,
+        name: str,
+        data_type: type = str,
+        cardinality: Cardinality = Cardinality.SINGLE,
+    ) -> PropertyKey:
+        if data_type not in _DATA_TYPE_NAMES:
+            raise SchemaViolationError(
+                f"unsupported property data type {data_type!r}"
+            )
+        self._check_fresh(name)
+        sid = self.graph.id_assigner.assign_schema_id(
+            VertexIDType.USER_PROPERTY_KEY
+        )
+        el = PropertyKey(sid, name, data_type, cardinality)
+        self._persist(el)
+        return el
+
+    def make_edge_label(
+        self,
+        name: str,
+        multiplicity: Multiplicity = Multiplicity.MULTI,
+        sort_key: Sequence[str] = (),
+        unidirected: bool = False,
+    ) -> EdgeLabel:
+        self._check_fresh(name)
+        key_ids = []
+        for key_name in sort_key:
+            pk = self.graph.schema_cache.get_by_name(key_name)
+            if not isinstance(pk, PropertyKey):
+                raise SchemaViolationError(
+                    f"sort key {key_name} is not a property key"
+                )
+            ser = self.graph.serializer.serializer_for_type(pk.data_type)
+            if ser.fixed_width is None:
+                raise SchemaViolationError(
+                    f"sort key {key_name}: only fixed-width types can be "
+                    f"sort keys (got {pk.data_type.__name__})"
+                )
+            key_ids.append(pk.id)
+        sid = self.graph.id_assigner.assign_schema_id(VertexIDType.USER_EDGE_LABEL)
+        el = EdgeLabel(sid, name, multiplicity, tuple(key_ids), unidirected)
+        self._persist(el)
+        return el
+
+    def make_vertex_label(
+        self, name: str, partitioned: bool = False, static: bool = False
+    ) -> VertexLabel:
+        self._check_fresh(name)
+        sid = self.graph.id_assigner.assign_schema_id(VertexIDType.VERTEX_LABEL)
+        el = VertexLabel(sid, name, partitioned, static)
+        self._persist(el)
+        return el
+
+    def build_composite_index(
+        self,
+        name: str,
+        keys: Sequence[str],
+        unique: bool = False,
+        label: Optional[str] = None,
+    ) -> IndexDefinition:
+        if not keys:
+            raise SchemaViolationError("composite index needs at least one key")
+        if not name or name.startswith("\x00"):
+            raise SchemaViolationError(f"invalid index name {name!r}")
+        if name in self.graph.indexes:
+            raise SchemaViolationError(f"index name already exists: {name}")
+        key_ids = []
+        for key_name in keys:
+            pk = self.graph.schema_cache.get_by_name(key_name)
+            if not isinstance(pk, PropertyKey):
+                raise SchemaViolationError(f"{key_name} is not a property key")
+            if pk.cardinality != Cardinality.SINGLE:
+                raise SchemaViolationError(
+                    "composite index keys must have SINGLE cardinality"
+                )
+            key_ids.append(pk.id)
+        sid = self.graph.id_assigner.assign_schema_id(VertexIDType.GENERIC_SCHEMA)
+        idx = IndexDefinition(sid, name, tuple(key_ids), unique, label)
+        self._persist(idx)
+        # register in the index registry row so commits can enumerate indexes
+        btx = self.graph.backend.begin_transaction()
+        btx.mutate_index(INDEX_REGISTRY_KEY, [(struct.pack(">Q", sid), b"")], [])
+        btx.commit()
+        self.graph.register_index(idx)
+        return idx
+
+    # ----------------------------------------------------------------- lookups
+    def get(self, name: str):
+        return self.graph.schema_cache.get_by_name(name)
+
+    def contains(self, name: str) -> bool:
+        return self.get(name) is not None
+
+    def property_keys(self) -> List[PropertyKey]:
+        return [e for e in self._all_schema() if isinstance(e, PropertyKey)]
+
+    def edge_labels(self) -> List[EdgeLabel]:
+        return [e for e in self._all_schema() if isinstance(e, EdgeLabel)]
+
+    def vertex_labels(self) -> List[VertexLabel]:
+        return [e for e in self._all_schema() if isinstance(e, VertexLabel)]
+
+    def indexes(self) -> List[IndexDefinition]:
+        return list(self.graph.indexes.values())
+
+    def _all_schema(self):
+        return self.graph.load_all_schema_elements()
+
+    # ----------------------------------------------------------------- helpers
+    def _check_fresh(self, name: str) -> None:
+        if not name or name.startswith("\x00"):
+            raise SchemaViolationError(f"invalid schema name {name!r}")
+        if self.graph.schema_cache.get_by_name(name) is not None:
+            raise SchemaViolationError(f"schema name already exists: {name}")
+
+    def _persist(self, el) -> None:
+        self.graph.persist_schema_element(el)

@@ -1,0 +1,251 @@
+"""Schema model: property keys, edge labels, vertex labels — stored as
+vertices in the graph itself.
+
+Capability parity with the reference's type system
+(reference: graphdb/types/ — schema elements are vertices with system
+properties holding a TypeDefinitionMap; types/system/BaseKey.java system
+types with fixed ids; database/cache/StandardSchemaCache.java:206 name->id
+and id->definition caching).
+
+A schema vertex's row holds:
+  EXISTS          system property  (True)
+  SCHEMA_NAME     system property  (the type name)
+  SCHEMA_DEF      system property  (JSON-encoded definition map)
+and the name->id mapping lives in the `graphindex` store under the system
+schema-name index so lookups are one slice read.
+"""
+
+from __future__ import annotations
+
+import json
+from dataclasses import dataclass, field
+from threading import RLock
+from typing import Dict, Optional, Tuple
+
+from janusgraph_tpu.core.attributes import GeoshapePoint, Serializer
+from janusgraph_tpu.core.codecs import Cardinality, Multiplicity, TypeInfo
+from janusgraph_tpu.core.ids import IDManager, VertexIDType
+from janusgraph_tpu.exceptions import SchemaViolationError
+
+
+class SystemTypes:
+    """Fixed-id system schema types (reference: types/system/BaseKey.java,
+    BaseLabel.java, SystemTypeManager.java). IDs are stable constants —
+    they appear in storage cells."""
+
+    def __init__(self, idm: IDManager):
+        mk = idm.make_schema_id
+        self.EXISTS = mk(VertexIDType.SYSTEM_PROPERTY_KEY, 1)
+        self.SCHEMA_NAME = mk(VertexIDType.SYSTEM_PROPERTY_KEY, 2)
+        self.SCHEMA_DEF = mk(VertexIDType.SYSTEM_PROPERTY_KEY, 3)
+        self.VERTEX_LABEL_EDGE = mk(VertexIDType.SYSTEM_EDGE_LABEL, 1)
+        self._infos = {
+            self.EXISTS: TypeInfo(self.EXISTS, False),
+            self.SCHEMA_NAME: TypeInfo(self.SCHEMA_NAME, False),
+            self.SCHEMA_DEF: TypeInfo(self.SCHEMA_DEF, False),
+            self.VERTEX_LABEL_EDGE: TypeInfo(self.VERTEX_LABEL_EDGE, True),
+        }
+
+    def type_info(self, type_id: int) -> Optional[TypeInfo]:
+        return self._infos.get(type_id)
+
+
+_DATA_TYPES: Dict[str, type] = {
+    "Boolean": bool,
+    "Long": int,
+    "Double": float,
+    "String": str,
+    "Bytes": bytes,
+    "Geoshape": GeoshapePoint,
+    "FloatList": list,
+}
+_DATA_TYPE_NAMES = {v: k for k, v in _DATA_TYPES.items()}
+
+
+@dataclass(frozen=True)
+class PropertyKey:
+    """A property key definition (reference: core/PropertyKey.java)."""
+
+    id: int
+    name: str
+    data_type: type
+    cardinality: Cardinality = Cardinality.SINGLE
+
+    @property
+    def is_property_key(self) -> bool:
+        return True
+
+    @property
+    def is_edge_label(self) -> bool:
+        return False
+
+    def definition(self) -> dict:
+        return {
+            "kind": "property",
+            "dataType": _DATA_TYPE_NAMES[self.data_type],
+            "cardinality": int(self.cardinality),
+        }
+
+    def type_info(self) -> TypeInfo:
+        return TypeInfo(self.id, False, self.cardinality)
+
+
+@dataclass(frozen=True)
+class EdgeLabel:
+    """An edge label definition (reference: core/EdgeLabel.java)."""
+
+    id: int
+    name: str
+    multiplicity: Multiplicity = Multiplicity.MULTI
+    # property-key ids whose ordered fixed-width encodings form the sort key
+    sort_key: Tuple[int, ...] = ()
+    unidirected: bool = False
+
+    @property
+    def is_property_key(self) -> bool:
+        return False
+
+    @property
+    def is_edge_label(self) -> bool:
+        return True
+
+    def definition(self) -> dict:
+        return {
+            "kind": "edge",
+            "multiplicity": int(self.multiplicity),
+            "sortKey": list(self.sort_key),
+            "unidirected": self.unidirected,
+        }
+
+    def type_info(self) -> TypeInfo:
+        return TypeInfo(self.id, True, Cardinality.SINGLE, self.sort_key)
+
+
+@dataclass(frozen=True)
+class VertexLabel:
+    """A vertex label (reference: core/VertexLabel.java). `partitioned`
+    marks vertex-cut labels whose adjacency is spread over all partitions."""
+
+    id: int
+    name: str
+    partitioned: bool = False
+    static: bool = False
+
+    def definition(self) -> dict:
+        return {
+            "kind": "vertexlabel",
+            "partitioned": self.partitioned,
+            "static": self.static,
+        }
+
+
+@dataclass(frozen=True)
+class IndexDefinition:
+    """A composite index over property keys, optionally label-constrained and
+    unique (reference: graph index subset of core/schema/JanusGraphIndex.java)."""
+
+    id: int
+    name: str
+    key_ids: Tuple[int, ...]
+    unique: bool = False
+    label_constraint: Optional[str] = None
+    # lifecycle: REGISTERED -> ENABLED (reference SchemaStatus subset)
+    status: str = "ENABLED"
+
+    def definition(self) -> dict:
+        return {
+            "kind": "index",
+            "keys": list(self.key_ids),
+            "unique": self.unique,
+            "label": self.label_constraint,
+            "status": self.status,
+        }
+
+
+def schema_element_from_definition(sid: int, name: str, d: dict):
+    kind = d["kind"]
+    if kind == "property":
+        return PropertyKey(
+            sid, name, _DATA_TYPES[d["dataType"]], Cardinality(d["cardinality"])
+        )
+    if kind == "edge":
+        return EdgeLabel(
+            sid,
+            name,
+            Multiplicity(d["multiplicity"]),
+            tuple(d.get("sortKey", ())),
+            d.get("unidirected", False),
+        )
+    if kind == "vertexlabel":
+        return VertexLabel(sid, name, d.get("partitioned", False), d.get("static", False))
+    if kind == "index":
+        return IndexDefinition(
+            sid,
+            name,
+            tuple(d["keys"]),
+            d.get("unique", False),
+            d.get("label"),
+            d.get("status", "ENABLED"),
+        )
+    raise SchemaViolationError(f"unknown schema kind {kind!r}")
+
+
+def encode_definition(d: dict) -> bytes:
+    return json.dumps(d, sort_keys=True).encode()
+
+
+def decode_definition(data: bytes) -> dict:
+    return json.loads(data.decode())
+
+
+class SchemaCache:
+    """Name->element and id->element cache with explicit invalidation
+    (reference: StandardSchemaCache.java:206). Loading is delegated to the
+    graph, which reads schema vertices from storage."""
+
+    def __init__(self, loader_by_name, loader_by_id):
+        self._by_name: Dict[str, object] = {}
+        self._by_id: Dict[int, object] = {}
+        self._load_name = loader_by_name
+        self._load_id = loader_by_id
+        self._lock = RLock()
+
+    def get_by_name(self, name: str):
+        with self._lock:
+            el = self._by_name.get(name)
+        if el is not None:
+            return el
+        el = self._load_name(name)
+        if el is not None:
+            with self._lock:
+                self._by_name[name] = el
+                self._by_id[el.id] = el
+        return el
+
+    def get_by_id(self, sid: int):
+        with self._lock:
+            el = self._by_id.get(sid)
+        if el is not None:
+            return el
+        el = self._load_id(sid)
+        if el is not None:
+            with self._lock:
+                self._by_id[sid] = el
+                # index names are a separate namespace: never let an index
+                # shadow a relation type of the same name
+                if not isinstance(el, IndexDefinition):
+                    self._by_name[el.name] = el
+        return el
+
+    def invalidate(self, name: Optional[str] = None) -> None:
+        with self._lock:
+            if name is None:
+                self._by_name.clear()
+                self._by_id.clear()
+            else:
+                el = self._by_name.pop(name, None)
+                if el is not None:
+                    self._by_id.pop(el.id, None)
+
+    def data_type_for(self, serializer: Serializer, key: "PropertyKey"):
+        return serializer.serializer_for_type(key.data_type)

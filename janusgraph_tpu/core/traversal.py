@@ -1,0 +1,521 @@
+"""Gremlin-style fluent traversal DSL.
+
+Capability parity with the reference's OLTP query path — not TinkerPop's JVM
+machinery, but the same step vocabulary and, crucially, the same two
+optimizations the reference registers as traversal strategies
+(reference: graphdb/tinkerpop/optimize/strategy/JanusGraphStepStrategy.java —
+fold leading has() chains into one index-backed start step;
+JanusGraphLocalQueryOptimizerStrategy.java — batch vertex expansion through
+multiQuery prefetch):
+
+- `g.V().has('name', 'x')` folds its has-chain, matches it against the
+  registered composite indexes, and starts from an index lookup instead of a
+  full scan when every index key is covered by equality conditions.
+- `out()/in_()/both()/outE()/...` prefetch the needed slices for ALL current
+  traversers with one batched multi-query before expanding.
+
+Execution model is batch-at-a-time (each step maps a list of traversers to
+the next list), which matches both the multi-query optimization and the
+batch thinking of the TPU OLAP path.
+"""
+
+from __future__ import annotations
+
+from collections import Counter
+from typing import Callable, List, Optional, Sequence
+
+from janusgraph_tpu.core.codecs import Direction
+from janusgraph_tpu.core.elements import Edge, Vertex, VertexProperty
+from janusgraph_tpu.core.schema import IndexDefinition
+from janusgraph_tpu.exceptions import QueryError
+
+
+class P:
+    """Predicate (reference vocabulary: core/attribute/Cmp.java)."""
+
+    def __init__(self, test: Callable[[object], bool], label: str, eq_value=None):
+        self.test = test
+        self.label = label
+        #: set when the predicate is a plain equality — index-foldable
+        self.eq_value = eq_value
+
+    def __repr__(self):
+        return f"P.{self.label}"
+
+    @staticmethod
+    def eq(v) -> "P":
+        return P(lambda x: x == v, f"eq({v!r})", eq_value=v)
+
+    @staticmethod
+    def neq(v) -> "P":
+        return P(lambda x: x != v, f"neq({v!r})")
+
+    @staticmethod
+    def gt(v) -> "P":
+        return P(lambda x: x is not None and x > v, f"gt({v!r})")
+
+    @staticmethod
+    def gte(v) -> "P":
+        return P(lambda x: x is not None and x >= v, f"gte({v!r})")
+
+    @staticmethod
+    def lt(v) -> "P":
+        return P(lambda x: x is not None and x < v, f"lt({v!r})")
+
+    @staticmethod
+    def lte(v) -> "P":
+        return P(lambda x: x is not None and x <= v, f"lte({v!r})")
+
+    @staticmethod
+    def within(*vs) -> "P":
+        s = set(vs)
+        return P(lambda x: x in s, f"within{tuple(vs)!r}")
+
+    @staticmethod
+    def without(*vs) -> "P":
+        s = set(vs)
+        return P(lambda x: x not in s, f"without{tuple(vs)!r}")
+
+    @staticmethod
+    def between(lo, hi) -> "P":
+        return P(lambda x: x is not None and lo <= x < hi, f"between({lo!r},{hi!r})")
+
+
+class Traverser:
+    """One unit of traversal state: the current object plus the vertex it was
+    reached from (needed by otherV) — a minimal path memory."""
+
+    __slots__ = ("obj", "prev")
+
+    def __init__(self, obj, prev=None):
+        self.obj = obj
+        self.prev = prev
+
+
+class GraphTraversalSource:
+    def __init__(self, graph, tx=None):
+        self.graph = graph
+        self.tx = tx or graph.new_transaction()
+
+    def V(self, *ids) -> "GraphTraversal":
+        return GraphTraversal(self, _start_vertices(self, ids))
+
+    def E(self) -> "GraphTraversal":
+        return GraphTraversal(self, _start_edges(self))
+
+    def add_v(self, label: Optional[str] = None, **props) -> Vertex:
+        return self.tx.add_vertex(label, **props)
+
+    def add_e(self, out_v: Vertex, label: str, in_v: Vertex, **props) -> Edge:
+        return self.tx.add_edge(out_v, label, in_v, **props)
+
+    def commit(self) -> None:
+        self.tx.commit()
+        self.tx = self.graph.new_transaction()
+
+    def rollback(self) -> None:
+        self.tx.rollback()
+        self.tx = self.graph.new_transaction()
+
+
+# ---------------------------------------------------------------- start steps
+class _start_vertices:
+    def __init__(self, source: GraphTraversalSource, ids):
+        self.source = source
+        self.ids = ids
+
+    def run(self, has_conditions) -> List[Traverser]:
+        tx = self.source.tx
+        if self.ids:
+            out = []
+            for i in self.ids:
+                v = tx.get_vertex(i.id if isinstance(i, Vertex) else i)
+                if v is not None:
+                    out.append(Traverser(v))
+            return _apply_has(out, has_conditions, tx)
+        # index folding: find a composite index fully covered by eq conditions
+        eqs = {
+            key: p.eq_value
+            for key, p in has_conditions
+            if p.eq_value is not None and key is not None
+        }
+        idx = _select_index(self.source.graph, eqs)
+        if idx is not None:
+            names = [
+                self.source.graph.schema_cache.get_by_id(k).name
+                for k in idx.key_ids
+            ]
+            vids = self.source.graph.index_lookup(
+                tx, idx.name, [eqs[n] for n in names]
+            )
+            out = [Traverser(v) for vid in vids if (v := tx.get_vertex(vid))]
+            # the committed index can't see this tx's writes: add tx-created
+            # vertices AND loaded vertices whose properties changed in-tx;
+            # _apply_has then re-checks every condition on current values
+            dirty = {
+                vid
+                for vid, rels in tx._added.items()
+                if any(isinstance(r, VertexProperty) for r in rels)
+            }
+            dirty.update(
+                r.vertex.id for r in tx._deleted if isinstance(r, VertexProperty)
+            )
+            out.extend(
+                Traverser(v)
+                for v in tx._vertex_cache.values()
+                if not v.is_removed and (v.is_new or v.id in dirty)
+            )
+            return _apply_has(_dedup(out), has_conditions, tx)
+        # full scan (the reference warns here too)
+        return _apply_has([Traverser(v) for v in tx.vertices()], has_conditions, tx)
+
+
+class _start_edges:
+    def __init__(self, source: GraphTraversalSource):
+        self.source = source
+
+    def run(self, has_conditions) -> List[Traverser]:
+        tx = self.source.tx
+        out, seen = [], set()
+        for v in tx.vertices():
+            for e in tx.get_edges(v, Direction.OUT, ()):
+                if e.id not in seen:
+                    seen.add(e.id)
+                    out.append(Traverser(e))
+        return _apply_has(out, has_conditions, tx)
+
+
+def _select_index(graph, eqs: dict) -> Optional[IndexDefinition]:
+    best = None
+    for idx in graph.indexes.values():
+        names = []
+        for k in idx.key_ids:
+            el = graph.schema_cache.get_by_id(k)
+            if el is None:
+                break
+            names.append(el.name)
+        if len(names) != len(idx.key_ids):
+            continue
+        if all(n in eqs for n in names):
+            if best is None or len(idx.key_ids) > len(best.key_ids):
+                best = idx
+    return best
+
+
+def _element_value(t: Traverser, key: str, tx):
+    obj = t.obj
+    if isinstance(obj, Vertex):
+        return obj.value(key)
+    if isinstance(obj, Edge):
+        return obj.value(key)
+    if isinstance(obj, VertexProperty):
+        return obj.value if obj.key == key else None
+    return None
+
+
+def _apply_has(ts: List[Traverser], conditions, tx) -> List[Traverser]:
+    out = ts
+    for key, p in conditions:
+        if key is None:  # label condition
+            out = [t for t in out if p.test(_label_of(t.obj))]
+        else:
+            out = [t for t in out if p.test(_element_value(t, key, tx))]
+    return out
+
+
+def _label_of(obj):
+    if isinstance(obj, (Vertex, Edge)):
+        return obj.label
+    if isinstance(obj, VertexProperty):
+        return obj.key
+    return None
+
+
+def _dedup(ts: List[Traverser]) -> List[Traverser]:
+    seen, out = set(), []
+    for t in ts:
+        k = t.obj if not isinstance(t.obj, (Vertex, Edge)) else t.obj.id
+        try:
+            if k in seen:
+                continue
+            seen.add(k)
+        except TypeError:
+            pass  # unhashable values are kept
+        out.append(t)
+    return out
+
+
+# ------------------------------------------------------------------ traversal
+class GraphTraversal:
+    def __init__(self, source: GraphTraversalSource, start):
+        self.source = source
+        self.tx = source.tx
+        self._start = start
+        self._pre_has: List = []  # foldable leading has-conditions
+        self._steps: List[Callable[[List[Traverser]], List[Traverser]]] = []
+        self._folding = True  # still collecting leading has() steps
+
+    # -- filters ------------------------------------------------------------
+    def has(self, key: str, value=None) -> "GraphTraversal":
+        if value is None:
+            p = P(lambda x: x is not None, f"exists({key})")
+        elif isinstance(value, P):
+            p = value
+        else:
+            p = P.eq(value)
+        if self._folding:
+            self._pre_has.append((key, p))
+        else:
+            tx = self.tx
+            self._steps.append(
+                lambda ts: [t for t in ts if p.test(_element_value(t, key, tx))]
+            )
+        return self
+
+    def has_label(self, *labels: str) -> "GraphTraversal":
+        p = P.within(*labels)
+        if self._folding:
+            self._pre_has.append((None, p))
+        else:
+            self._steps.append(lambda ts: [t for t in ts if p.test(_label_of(t.obj))])
+        return self
+
+    def has_id(self, *ids: int) -> "GraphTraversal":
+        idset = set(ids)
+        self._add(lambda ts: [t for t in ts if getattr(t.obj, "id", None) in idset])
+        return self
+
+    def filter_(self, fn: Callable[[object], bool]) -> "GraphTraversal":
+        self._add(lambda ts: [t for t in ts if fn(t.obj)])
+        return self
+
+    def _add(self, step) -> None:
+        self._folding = False
+        self._steps.append(step)
+
+    # -- vertex expansion (batched via prefetch) -----------------------------
+    def out(self, *labels: str) -> "GraphTraversal":
+        return self._expand(Direction.OUT, labels, to_vertex=True)
+
+    def in_(self, *labels: str) -> "GraphTraversal":
+        return self._expand(Direction.IN, labels, to_vertex=True)
+
+    def both(self, *labels: str) -> "GraphTraversal":
+        return self._expand(Direction.BOTH, labels, to_vertex=True)
+
+    def out_e(self, *labels: str) -> "GraphTraversal":
+        return self._expand(Direction.OUT, labels, to_vertex=False)
+
+    def in_e(self, *labels: str) -> "GraphTraversal":
+        return self._expand(Direction.IN, labels, to_vertex=False)
+
+    def both_e(self, *labels: str) -> "GraphTraversal":
+        return self._expand(Direction.BOTH, labels, to_vertex=False)
+
+    def _expand(self, direction, labels, to_vertex) -> "GraphTraversal":
+        tx = self.tx
+
+        def step(ts: List[Traverser]) -> List[Traverser]:
+            vs = [t.obj for t in ts if isinstance(t.obj, Vertex)]
+            tx.prefetch(vs, direction, labels)  # the multiQuery batch
+            out: List[Traverser] = []
+            for t in ts:
+                v = t.obj
+                if not isinstance(v, Vertex):
+                    continue
+                for e in tx.get_edges(v, direction, labels):
+                    if to_vertex:
+                        out.append(Traverser(e.other(v), prev=v))
+                    else:
+                        out.append(Traverser(e, prev=v))
+            return out
+
+        self._add(step)
+        return self
+
+    def out_v(self) -> "GraphTraversal":
+        self._add(
+            lambda ts: [
+                Traverser(t.obj.out_vertex) for t in ts if isinstance(t.obj, Edge)
+            ]
+        )
+        return self
+
+    def in_v(self) -> "GraphTraversal":
+        self._add(
+            lambda ts: [
+                Traverser(t.obj.in_vertex) for t in ts if isinstance(t.obj, Edge)
+            ]
+        )
+        return self
+
+    def other_v(self) -> "GraphTraversal":
+        def step(ts):
+            out = []
+            for t in ts:
+                if isinstance(t.obj, Edge) and t.prev is not None:
+                    out.append(Traverser(t.obj.other(t.prev), prev=t.prev))
+            return out
+
+        self._add(step)
+        return self
+
+    def both_v(self) -> "GraphTraversal":
+        def step(ts):
+            out = []
+            for t in ts:
+                if isinstance(t.obj, Edge):
+                    out.append(Traverser(t.obj.out_vertex))
+                    out.append(Traverser(t.obj.in_vertex))
+            return out
+
+        self._add(step)
+        return self
+
+    # -- projections ---------------------------------------------------------
+    def values(self, *keys: str) -> "GraphTraversal":
+        tx = self.tx
+
+        def step(ts):
+            out = []
+            for t in ts:
+                if isinstance(t.obj, Vertex):
+                    props = tx.get_properties(t.obj, *keys)
+                    out.extend(Traverser(p.value, prev=t.prev) for p in props)
+                elif isinstance(t.obj, Edge):
+                    pv = t.obj.property_values()
+                    for k, v in pv.items():
+                        if not keys or k in keys:
+                            out.append(Traverser(v, prev=t.prev))
+            return out
+
+        self._add(step)
+        return self
+
+    def properties(self, *keys: str) -> "GraphTraversal":
+        tx = self.tx
+        self._add(
+            lambda ts: [
+                Traverser(p, prev=t.prev)
+                for t in ts
+                if isinstance(t.obj, Vertex)
+                for p in tx.get_properties(t.obj, *keys)
+            ]
+        )
+        return self
+
+    def value_map(self, *keys: str) -> "GraphTraversal":
+        tx = self.tx
+
+        def step(ts):
+            out = []
+            for t in ts:
+                if isinstance(t.obj, Vertex):
+                    m = {}
+                    for p in tx.get_properties(t.obj, *keys):
+                        m.setdefault(p.key, []).append(p.value)
+                    out.append(Traverser(m, prev=t.prev))
+                elif isinstance(t.obj, Edge):
+                    out.append(Traverser(t.obj.property_values(), prev=t.prev))
+            return out
+
+        self._add(step)
+        return self
+
+    def id_(self) -> "GraphTraversal":
+        self._add(lambda ts: [Traverser(t.obj.id, prev=t.prev) for t in ts])
+        return self
+
+    def label_(self) -> "GraphTraversal":
+        self._add(lambda ts: [Traverser(_label_of(t.obj), prev=t.prev) for t in ts])
+        return self
+
+    # -- collection/order/slicing -------------------------------------------
+    def dedup(self) -> "GraphTraversal":
+        self._add(_dedup)
+        return self
+
+    def limit(self, n: int) -> "GraphTraversal":
+        self._add(lambda ts: ts[:n])
+        return self
+
+    def range_(self, lo: int, hi: int) -> "GraphTraversal":
+        self._add(lambda ts: ts[lo:hi])
+        return self
+
+    def order(self, key: Optional[str] = None, reverse: bool = False) -> "GraphTraversal":
+        tx = self.tx
+
+        def step(ts):
+            if key is None:
+                return sorted(ts, key=lambda t: t.obj, reverse=reverse)
+            return sorted(
+                ts,
+                key=lambda t: (_element_value(t, key, tx) is None,
+                               _element_value(t, key, tx)),
+                reverse=reverse,
+            )
+
+        self._add(step)
+        return self
+
+    def repeat(self, body: Callable[["GraphTraversal"], "GraphTraversal"], times: int) -> "GraphTraversal":
+        """t.repeat(lambda t: t.out('knows'), times=3)"""
+        for _ in range(times):
+            body(self)
+        return self
+
+    # -- aggregation ---------------------------------------------------------
+    def count(self) -> int:
+        return len(self._execute())
+
+    def sum_(self):
+        return sum(t.obj for t in self._execute())
+
+    def max_(self):
+        vals = [t.obj for t in self._execute()]
+        return max(vals) if vals else None
+
+    def min_(self):
+        vals = [t.obj for t in self._execute()]
+        return min(vals) if vals else None
+
+    def mean_(self):
+        vals = [t.obj for t in self._execute()]
+        return sum(vals) / len(vals) if vals else None
+
+    def group_count(self, key: Optional[str] = None) -> dict:
+        tx = self.tx
+        ts = self._execute()
+        if key is None:
+            return dict(Counter(t.obj for t in ts))
+        return dict(Counter(_element_value(t, key, tx) for t in ts))
+
+    # -- terminals -----------------------------------------------------------
+    def _execute(self) -> List[Traverser]:
+        ts = self._start.run(self._pre_has)
+        for step in self._steps:
+            ts = step(ts)
+        return ts
+
+    def to_list(self) -> List[object]:
+        return [t.obj for t in self._execute()]
+
+    def to_set(self) -> set:
+        return set(self.to_list())
+
+    def next(self):
+        res = self._execute()
+        if not res:
+            raise QueryError("traversal returned no results")
+        return res[0].obj
+
+    def try_next(self):
+        res = self._execute()
+        return res[0].obj if res else None
+
+    def iterate(self) -> None:
+        self._execute()
+
+    def __iter__(self):
+        return iter(self.to_list())
