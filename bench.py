@@ -1,0 +1,121 @@
+#!/usr/bin/env python
+"""Benchmark driver: PageRank + 4-hop BFS on a graph500-style R-MAT graph.
+
+Prints ONE JSON line:
+  {"metric": "pagerank_edges_per_sec_chip", "value": ..., "unit": "edges/s",
+   "vs_baseline": ..., ...extras}
+
+The primary metric is PageRank throughput (edges processed per second per
+chip, over `PR_ITERS` supersteps, post-compilation) on the BENCH_SCALE
+R-MAT graph — the BASELINE.json north-star workload shape. 4-hop BFS
+wall-clock is reported alongside.
+
+`vs_baseline`: the reference (JanusGraph FulgoraGraphComputer, a JVM
+thread-pool BSP engine) publishes no numbers and cannot run in this
+environment (BASELINE.md), so the recorded baseline is a *vectorized
+numpy host implementation* of the identical supersteps measured in-process
+— a deliberately strong stand-in (it is itself far faster than a
+scan-per-superstep JVM engine would be), making the reported ratio
+conservative.
+
+Env knobs: BENCH_SCALE (default 22; graph500-s23 = BENCH_SCALE=23),
+BENCH_EDGE_FACTOR (16), PR_ITERS (20).
+"""
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+
+def host_pagerank_edges_per_sec(csr, iters: int = 5, damping: float = 0.85) -> float:
+    """Vectorized numpy PageRank — the baseline proxy."""
+    n = csr.num_vertices
+    seg = np.repeat(
+        np.arange(n, dtype=np.int64), np.diff(csr.in_indptr)
+    )
+    src = csr.in_src.astype(np.int64)
+    outdeg = np.maximum(csr.out_degree.astype(np.float64), 1.0)
+    dangling_mask = csr.out_degree == 0
+    rank = np.full(n, 1.0 / n)
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        contrib = rank / outdeg
+        agg = np.bincount(seg, weights=contrib[src], minlength=n)
+        dangling = rank[dangling_mask].sum()
+        rank = (1.0 - damping) / n + damping * (agg + dangling / n)
+    dt = time.perf_counter() - t0
+    return iters * csr.num_edges / dt
+
+
+def main() -> None:
+    import jax
+
+    from janusgraph_tpu.olap.generators import rmat_csr
+    from janusgraph_tpu.olap.programs import PageRankProgram, ShortestPathProgram
+    from janusgraph_tpu.olap.tpu_executor import TPUExecutor
+
+    platform = jax.devices()[0].platform
+    scale = int(os.environ.get("BENCH_SCALE", "22"))
+    if platform == "cpu":
+        scale = min(scale, int(os.environ.get("BENCH_SCALE", "16")))
+    edge_factor = int(os.environ.get("BENCH_EDGE_FACTOR", "16"))
+    pr_iters = int(os.environ.get("PR_ITERS", "20"))
+
+    t0 = time.perf_counter()
+    csr = rmat_csr(scale, edge_factor)
+    gen_s = time.perf_counter() - t0
+
+    ex = TPUExecutor(csr)
+
+    # --- PageRank: compile once (1 superstep), then time pr_iters supersteps
+    # sync_every=pr_iters: the whole run is one async pipeline of supersteps
+    # with a single host sync at the end (true device throughput)
+    warm = PageRankProgram(max_iterations=1, tol=0.0)
+    ex.run(warm)
+    timed = PageRankProgram(max_iterations=pr_iters, tol=0.0)
+    t0 = time.perf_counter()
+    result = ex.run(timed, sync_every=pr_iters)
+    jax.block_until_ready(result["rank"])
+    pr_s = time.perf_counter() - t0
+    pr_eps = pr_iters * csr.num_edges / pr_s
+
+    # --- 4-hop BFS (BSP frontier expansion), timed post-compile
+    ex.run(ShortestPathProgram(seed_index=0, max_iterations=1))
+    t0 = time.perf_counter()
+    bfs_res = ex.run(
+        ShortestPathProgram(seed_index=0, max_iterations=4), sync_every=4
+    )
+    jax.block_until_ready(bfs_res["distance"])
+    bfs_s = time.perf_counter() - t0
+
+    # --- host-numpy baseline proxy (see module docstring)
+    base_iters = 3 if scale >= 22 else 5
+    base_eps = host_pagerank_edges_per_sec(csr, iters=base_iters)
+
+    print(
+        json.dumps(
+            {
+                "metric": "pagerank_edges_per_sec_chip",
+                "value": round(pr_eps, 1),
+                "unit": "edges/s",
+                "vs_baseline": round(pr_eps / base_eps, 3),
+                "baseline": "numpy-host-pagerank (proxy; see bench.py docstring)",
+                "platform": platform,
+                "scale": scale,
+                "edge_factor": edge_factor,
+                "num_vertices": csr.num_vertices,
+                "num_edges": csr.num_edges,
+                "pr_iters": pr_iters,
+                "pagerank_wall_s": round(pr_s, 3),
+                "bfs_4hop_wall_s": round(bfs_s, 3),
+                "graph_gen_s": round(gen_s, 2),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -11,7 +11,7 @@ BASELINE config #1 for OLAP PageRank.
 from __future__ import annotations
 
 from janusgraph_tpu.core.attributes import GeoshapePoint
-from janusgraph_tpu.core.codecs import Cardinality, Multiplicity
+from janusgraph_tpu.core.codecs import Multiplicity
 
 
 def load(graph) -> None:

@@ -154,6 +154,12 @@ class JanusGraphTPU:
     def management(self) -> ManagementSystem:
         return ManagementSystem(self)
 
+    def compute(self, executor: str = "tpu"):
+        """OLAP entry point (reference: JanusGraph.compute())."""
+        from janusgraph_tpu.olap.computer import GraphComputer
+
+        return GraphComputer(self, executor=executor)
+
     def close(self) -> None:
         if self._open:
             self.backend.close()
@@ -373,22 +379,22 @@ class JanusGraphTPU:
         if not self.indexes:
             return
         # vertices whose properties changed in this tx
-        changed: Dict[int, bool] = {}
+        changed: set = set()
         for vid, rels in tx._added.items():
             if any(isinstance(r, VertexProperty) and not r.is_removed for r in rels):
-                changed[vid] = True
+                changed.add(vid)
         for rel in tx._deleted:
             if isinstance(rel, VertexProperty):
-                changed[rel.vertex.id] = True
-        for vid in tx._removed_vertices:
-            changed[vid] = True
+                changed.add(rel.vertex.id)
+        changed.update(tx._removed_vertices)
         if not changed:
             return
 
         for idx in self.indexes.values():
-            # within-tx duplicate detection for unique indexes: the committed
-            # index can't see sibling mutations buffered in this same tx
-            tx_unique_claims: Dict[tuple, int] = {}
+            # phase 1: compute every vertex's (before, after) transition so
+            # unique checks can see sibling mutations in this same tx —
+            # both new claims and releases of previously-owned values
+            transitions = []
             for vid in changed:
                 before = self._index_values_committed(tx, idx, vid)
                 after = (
@@ -402,19 +408,50 @@ class JanusGraphTPU:
                         continue
                 if before == after:
                     continue
-                if idx.unique and after is not None:
-                    prior = tx_unique_claims.get(after)
+                transitions.append((vid, before, after))
+
+            if idx.unique:
+                releasing = {t[1]: t[0] for t in transitions if t[1] is not None}
+                claims: Dict[tuple, int] = {}
+                for vid, _before, after in transitions:
+                    if after is None:
+                        continue
+                    prior = claims.get(after)
                     if prior is not None and prior != vid:
                         raise SchemaViolationError(
                             f"unique index {idx.name} violated within "
                             f"transaction for values {after!r}"
                         )
-                    tx_unique_claims[after] = vid
-                    self.index_serializer.check_unique(idx, after, vid, btx)
-                for row, adds, dels in self.index_serializer.index_updates(
-                    idx, vid, before, after
-                ):
-                    btx.mutate_index(row, adds, dels)
+                    claims[after] = vid
+                    # committed owner is fine if it releases the value in
+                    # this same tx (e.g. remove-then-readd)
+                    existing = self.index_serializer.query(idx, after, btx)
+                    conflict = [
+                        owner
+                        for owner in existing
+                        if owner != vid and releasing.get(after) != owner
+                    ]
+                    if conflict:
+                        raise SchemaViolationError(
+                            f"unique index {idx.name} violated for values "
+                            f"{after!r}"
+                        )
+
+            # phase 2: emit mutations — ALL deletions before ALL additions,
+            # so a value released by one vertex and claimed by another in the
+            # same tx (same row/column on unique indexes) nets to the claim
+            # under temporal merge, regardless of vertex iteration order
+            pending = []
+            for vid, before, after in transitions:
+                pending.extend(
+                    self.index_serializer.index_updates(idx, vid, before, after)
+                )
+            for row, _adds, dels in pending:
+                if dels:
+                    btx.mutate_index(row, [], dels)
+            for row, adds, _dels in pending:
+                if adds:
+                    btx.mutate_index(row, adds, [])
 
     def _index_values_committed(self, tx, idx: IndexDefinition, vid: int):
         """Value tuple from committed storage only (pre-tx state)."""

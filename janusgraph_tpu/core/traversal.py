@@ -139,7 +139,12 @@ class _start_vertices:
             for key, p in has_conditions
             if p.eq_value is not None and key is not None
         }
-        idx = _select_index(self.source.graph, eqs)
+        # label equality (if any) gates label-constrained indexes
+        label_eq = None
+        for key, p in has_conditions:
+            if key is None and p.eq_value is not None:
+                label_eq = p.eq_value
+        idx = _select_index(self.source.graph, eqs, label_eq)
         if idx is not None:
             names = [
                 self.source.graph.schema_cache.get_by_id(k).name
@@ -185,9 +190,13 @@ class _start_edges:
         return _apply_has(out, has_conditions, tx)
 
 
-def _select_index(graph, eqs: dict) -> Optional[IndexDefinition]:
+def _select_index(graph, eqs: dict, label_eq=None) -> Optional[IndexDefinition]:
     best = None
     for idx in graph.indexes.values():
+        # a label-constrained index only covers vertices of that label: it is
+        # usable only when the query pins the label to exactly that value
+        if idx.label_constraint is not None and idx.label_constraint != label_eq:
+            continue
         names = []
         for k in idx.key_ids:
             el = graph.schema_cache.get_by_id(k)
@@ -273,7 +282,8 @@ class GraphTraversal:
         return self
 
     def has_label(self, *labels: str) -> "GraphTraversal":
-        p = P.within(*labels)
+        # single label folds as an equality so label-constrained indexes apply
+        p = P.eq(labels[0]) if len(labels) == 1 else P.within(*labels)
         if self._folding:
             self._pre_has.append((None, p))
         else:

@@ -213,6 +213,11 @@ class Transaction:
         pk = self._property_key(key, value)
         if e.is_new:
             e._props[pk.id] = value
+            # sort-key columns encode property values: rebuild so the stored
+            # column reflects the final value, not the construction-time one
+            label = self.schema_by_id(e.type_id)
+            if isinstance(label, EdgeLabel) and label.sort_key:
+                e._sort_key = self._build_sort_key(label, e._props)
         else:
             raise InvalidElementError(
                 "edge property mutation on loaded edges is not yet supported; "

@@ -1,0 +1,8 @@
+from janusgraph_tpu.olap.computer import ComputerResult, GraphComputer, run_on  # noqa: F401
+from janusgraph_tpu.olap.csr import CSRGraph, csr_from_edges, load_csr  # noqa: F401
+from janusgraph_tpu.olap.vertex_program import (  # noqa: F401
+    Combiner,
+    EdgeTransform,
+    Memory,
+    VertexProgram,
+)

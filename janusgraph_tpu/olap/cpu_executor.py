@@ -1,0 +1,88 @@
+"""CPU reference executor — the correctness oracle.
+
+Mirrors the reference's Fulgora execution semantics
+(reference: FulgoraGraphComputer.java:210-230 iteration loop with terminate
+check, FulgoraVertexMemory double-buffered messages, combiner application on
+send): messages are combined pairwise per receiving vertex in a plain Python
+loop over in-edges — deliberately unvectorized and structurally independent
+of the TPU executor, so agreement between the two is meaningful evidence
+(SURVEY.md §7 step 4: "the correctness oracle").
+"""
+
+from __future__ import annotations
+
+from typing import Dict
+
+import numpy as np
+
+from janusgraph_tpu.olap.csr import CSRGraph
+from janusgraph_tpu.olap.vertex_program import (
+    Combiner,
+    EdgeTransform,
+    Memory,
+    VertexProgram,
+)
+
+
+def _combine(op: str, a, b):
+    if op == Combiner.SUM:
+        return a + b
+    if op == Combiner.MIN:
+        return np.minimum(a, b)
+    return np.maximum(a, b)
+
+
+class CPUExecutor:
+    """Scalar-loop BSP executor (deliberately unvectorized)."""
+
+    def __init__(self, graph: CSRGraph):
+        self.graph = graph
+
+    def run(self, program: VertexProgram) -> Dict[str, np.ndarray]:
+        g = self.graph
+        n = g.num_vertices
+        memory = Memory()
+        state, init_metrics = program.setup(g, np)
+        memory.reduce_in(init_metrics)
+        memory.superstep = 0
+
+        for step in range(program.max_iterations):
+            op = program.combiner_for(step)
+            identity = Combiner.IDENTITY[op]
+            outgoing = np.asarray(
+                program.message(state, step, g, np), dtype=np.float64
+            )
+            vec = outgoing.ndim == 2
+            agg_shape = (n, outgoing.shape[1]) if vec else (n,)
+            aggregated = np.full(agg_shape, identity, dtype=np.float64)
+
+            def deliver(dst: int, src: int, weight):
+                msg = outgoing[src]
+                if program.edge_transform == EdgeTransform.MUL_WEIGHT:
+                    msg = msg * weight
+                elif program.edge_transform == EdgeTransform.ADD_WEIGHT:
+                    msg = msg + weight
+                aggregated[dst] = _combine(op, aggregated[dst], msg)
+
+            for i in range(n):
+                for e in range(g.in_indptr[i], g.in_indptr[i + 1]):
+                    w = g.in_edge_weight[e] if g.in_edge_weight is not None else 1.0
+                    deliver(i, int(g.in_src[e]), w)
+            if program.undirected:
+                for i in range(n):
+                    for e in range(g.out_indptr[i], g.out_indptr[i + 1]):
+                        w = (
+                            g.out_edge_weight[e]
+                            if g.out_edge_weight is not None
+                            else 1.0
+                        )
+                        deliver(i, int(g.out_dst[e]), w)
+
+            memory_in = dict(memory.values)
+            state, metrics = program.apply(
+                state, aggregated, step, memory_in, g, np
+            )
+            memory.reduce_in(metrics)
+            if program.terminate(memory):
+                break
+        return {k: np.asarray(v) for k, v in state.items()}

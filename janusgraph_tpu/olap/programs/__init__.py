@@ -1,0 +1,9 @@
+from janusgraph_tpu.olap.programs.pagerank import PageRankProgram  # noqa: F401
+from janusgraph_tpu.olap.programs.shortest_path import ShortestPathProgram  # noqa: F401
+from janusgraph_tpu.olap.programs.connected_components import (  # noqa: F401
+    ConnectedComponentsProgram,
+)
+from janusgraph_tpu.olap.programs.traversal_count import (  # noqa: F401
+    TraversalCountProgram,
+)
+from janusgraph_tpu.olap.programs.peer_pressure import PeerPressureProgram  # noqa: F401

@@ -1,0 +1,53 @@
+"""PageRank as array-BSP (BASELINE config #1 workload).
+
+Reference behavior modeled: janusgraph-backend-testutils
+.../olap/PageRankVertexProgram.java (damping, out-degree-normalized
+contributions, fixed-point iteration). Dangling-vertex rank mass is
+redistributed uniformly each superstep; the mass is a global aggregator
+computed the superstep before it is consumed, so it is exact under sharding
+(one psum, no second pass).
+"""
+
+from __future__ import annotations
+
+from janusgraph_tpu.olap.vertex_program import Combiner, VertexProgram
+
+
+class PageRankProgram(VertexProgram):
+    compute_keys = ("rank",)
+    combiner = Combiner.SUM
+
+    def __init__(self, damping: float = 0.85, tol: float = 1e-9, max_iterations: int = 30):
+        self.damping = damping
+        self.tol = tol
+        self.max_iterations = max_iterations
+
+    def setup(self, graph, xp):
+        n = graph.num_vertices
+        active = xp.asarray(graph.active)
+        rank = active * (1.0 / n)
+        dangling = xp.sum(xp.where(graph.out_degree == 0, rank, 0.0))
+        return {"rank": rank}, {"dangling": (Combiner.SUM, dangling)}
+
+    def message(self, state, superstep, graph, xp):
+        deg = xp.maximum(graph.out_degree, 1)
+        return state["rank"] / deg
+
+    def apply(self, state, aggregated, superstep, memory_in, graph, xp):
+        n = graph.num_vertices
+        d = self.damping
+        active = xp.asarray(graph.active)
+        dangling = memory_in["dangling"]
+        # padding slots stay at 0 so global sums (psum) remain exact
+        new_rank = active * ((1.0 - d) / n + d * (aggregated + dangling / n))
+        delta = xp.sum(xp.abs(new_rank - state["rank"]))
+        new_dangling = xp.sum(
+            xp.where((graph.out_degree == 0) & (active > 0), new_rank, 0.0)
+        )
+        return {"rank": new_rank}, {
+            "delta": (Combiner.SUM, delta),
+            "dangling": (Combiner.SUM, new_dangling),
+        }
+
+    def terminate(self, memory):
+        return memory.superstep > 1 and memory.get("delta", 1.0) < self.tol

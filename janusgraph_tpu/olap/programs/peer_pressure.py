@@ -1,0 +1,105 @@
+"""Peer-pressure community detection / label propagation (BASELINE #4).
+
+Reference behavior modeled: TinkerPop PeerPressureVertexProgram via
+FulgoraGraphComputer — each vertex repeatedly adopts the most frequent
+cluster label among its neighbors until stable.
+
+The mode (most-frequent) reduction is not a per-message monoid, so it cannot
+be one segment-reduce. TPU-first formulation: two alternating phases, each a
+monoid reduce over fixed-width messages:
+
+  phase A (SUM): neighbors send a one-hot over K label buckets; the count
+    vector's argmax picks the winning bucket per vertex.
+  phase B (MIN): neighbors send their label masked into its bucket slot
+    (inf elsewhere); each vertex adopts the minimum label present in its
+    winning bucket.
+
+With K >= number of live labels the result is exact mode-with-min-tiebreak;
+smaller K trades memory for bucket-collision approximation (documented
+divergence; exactness is asserted in tests with ample K).
+"""
+
+from __future__ import annotations
+
+from janusgraph_tpu.olap.vertex_program import Combiner, VertexProgram
+
+INF = 1e18
+
+
+class PeerPressureProgram(VertexProgram):
+    compute_keys = ("cluster",)
+    undirected = True
+
+    def __init__(self, num_buckets: int = 64, rounds: int = 30):
+        self.K = num_buckets
+        self.rounds = rounds
+        self.max_iterations = rounds * 2
+
+    def combiner_for(self, superstep: int) -> str:
+        return Combiner.SUM if superstep % 2 == 0 else Combiner.MIN
+
+    def _bucket(self, labels, xp):
+        return xp.mod(labels.astype(xp.int32), self.K)
+
+    def setup(self, graph, xp):
+        labels = (
+            xp.arange(graph.local_num_vertices) + graph.global_offset
+        ) * 1.0
+        chosen = self._bucket(labels, xp)
+        return (
+            {"cluster": labels, "chosen": chosen},
+            {"changed": (Combiner.SUM, xp.asarray(1.0))},
+        )
+
+    def message(self, state, superstep, graph, xp):
+        labels = state["cluster"]
+        k = xp.arange(self.K)
+        onehot = (self._bucket(labels, xp)[:, None] == k[None, :])
+        if hasattr(superstep, "dtype"):  # traced: select by parity
+            is_count = xp.equal(xp.mod(superstep, 2), 0)
+            count_msg = xp.where(onehot, 1.0, 0.0)
+            label_msg = xp.where(onehot, labels[:, None], INF)
+            return xp.where(is_count, count_msg, label_msg)
+        if superstep % 2 == 0:
+            return xp.where(onehot, 1.0, 0.0)
+        return xp.where(onehot, labels[:, None], INF)
+
+    def apply(self, state, aggregated, superstep, memory_in, graph, xp):
+        def count_phase():
+            # argmax with lowest-bucket tiebreak; vertices with no neighbors
+            # keep their own bucket
+            counts = aggregated
+            best = xp.argmax(counts, axis=1).astype(xp.int32)
+            has_neighbors = xp.sum(counts, axis=1) > 0
+            chosen = xp.where(has_neighbors, best, state["chosen"])
+            return {"cluster": state["cluster"], "chosen": chosen}, 1.0
+
+        def resolve_phase():
+            n_local = aggregated.shape[0]
+            rows = xp.arange(n_local)
+            candidate = aggregated[rows, state["chosen"]]
+            new = xp.where(candidate < INF, candidate, state["cluster"])
+            # adopt only if it is at least as frequent — peer pressure moves
+            # toward neighborhood consensus, including label switches
+            changed = xp.sum(xp.where(new != state["cluster"], 1.0, 0.0))
+            return {"cluster": new, "chosen": state["chosen"]}, changed
+
+        if hasattr(superstep, "dtype"):
+            import jax
+
+            (new_state, changed) = jax.lax.cond(
+                (superstep % 2) == 0,
+                lambda: count_phase(),
+                lambda: resolve_phase(),
+            )
+        else:
+            new_state, changed = (
+                count_phase() if superstep % 2 == 0 else resolve_phase()
+            )
+        return new_state, {"changed": (Combiner.SUM, changed)}
+
+    def terminate(self, memory):
+        # stop after a resolve phase in which nothing changed
+        return memory.superstep % 2 == 0 and memory.superstep > 1 and memory.get(
+            "changed", 1.0
+        ) == 0.0

@@ -1,0 +1,57 @@
+"""Single-source shortest path / BSP BFS (BASELINE config #3 workload).
+
+Reference behavior modeled: TinkerPop ShortestPathVertexProgram as run by
+FulgoraGraphComputer (special-cased at FulgoraGraphComputer.java:249-253)
+and janusgraph-backend-testutils .../olap/ShortestDistanceVertexProgram.java:
+min-combined distance relaxation until fixpoint. Unweighted mode is BFS
+hop counting; weighted mode adds the edge weight in flight.
+"""
+
+from __future__ import annotations
+
+from janusgraph_tpu.olap.vertex_program import (
+    Combiner,
+    EdgeTransform,
+    VertexProgram,
+)
+
+INF = 1e18
+
+
+class ShortestPathProgram(VertexProgram):
+    compute_keys = ("distance",)
+    combiner = Combiner.MIN
+
+    def __init__(
+        self,
+        seed_index: int,
+        weighted: bool = False,
+        undirected: bool = False,
+        max_iterations: int = 100,
+    ):
+        self.seed_index = seed_index
+        self.weighted = weighted
+        self.edge_transform = (
+            EdgeTransform.ADD_WEIGHT if weighted else EdgeTransform.NONE
+        )
+        self.undirected = undirected
+        self.max_iterations = max_iterations
+
+    def setup(self, graph, xp):
+        idx = xp.arange(graph.local_num_vertices) + graph.global_offset
+        dist = xp.where(idx == self.seed_index, 0.0, INF)
+        return {"distance": dist}, {"changed": (Combiner.SUM, xp.asarray(1.0))}
+
+    def message(self, state, superstep, graph, xp):
+        if self.weighted:
+            return state["distance"]
+        return state["distance"] + 1.0
+
+    def apply(self, state, aggregated, superstep, memory_in, graph, xp):
+        old = state["distance"]
+        new = xp.minimum(old, aggregated)
+        changed = xp.sum(xp.where(new < old, 1.0, 0.0))
+        return {"distance": new}, {"changed": (Combiner.SUM, changed)}
+
+    def terminate(self, memory):
+        return memory.get("changed", 1.0) == 0.0

@@ -1,0 +1,40 @@
+"""K-hop traversal counting — the OLAP-traversal workload shape
+(BASELINE config #5: Gremlin 3-hop traversal via TraversalVertexProgram).
+
+Reference behavior modeled: TinkerPop TraversalVertexProgram running
+g.V().out().out().out().count() on Fulgora — traverser bulks are per-vertex
+counts, each hop is one message round, the answer is the global bulk sum.
+This is the fixed-width-numeric projection of traverser propagation
+(SURVEY.md §7 hard part (a)); arbitrary-state traversers remain on the OLTP
+path.
+"""
+
+from __future__ import annotations
+
+from janusgraph_tpu.olap.vertex_program import Combiner, VertexProgram
+
+
+class TraversalCountProgram(VertexProgram):
+    """After k supersteps, state['count'][i] = number of k-hop paths ending
+    at vertex i; the global path count is their sum (psum on a mesh)."""
+
+    compute_keys = ("count",)
+    combiner = Combiner.SUM
+
+    def __init__(self, hops: int, labels=None):
+        self.max_iterations = hops
+        self.hops = hops
+        self.labels = labels  # edge-label restriction applied at CSR load
+
+    def setup(self, graph, xp):
+        counts = xp.asarray(graph.active) * 1.0  # padding starts at 0 paths
+        return {"count": counts}, {"total": (Combiner.SUM, xp.sum(counts))}
+
+    def message(self, state, superstep, graph, xp):
+        return state["count"]
+
+    def apply(self, state, aggregated, superstep, memory_in, graph, xp):
+        return {"count": aggregated}, {"total": (Combiner.SUM, xp.sum(aggregated))}
+
+    def terminate(self, memory):
+        return memory.superstep >= self.hops

@@ -1,0 +1,118 @@
+"""VertexProgram SPI — the BSP contract both executors implement.
+
+Capability parity with the reference's vertex-program machinery
+(reference: TinkerPop VertexProgram via graphdb/olap/computer/
+VertexProgramScanJob.java:82-111 per-vertex execute + FulgoraVertexMemory
+double-buffered message slots + message combiners :91-95 + FulgoraMemory
+global aggregators), re-designed as an **array-BSP** model: a superstep is
+
+    aggregated[i] = combine({ transform(message(src), w_e) for e=(src, i) })
+    state', metrics = apply(state, aggregated, superstep, memory)
+
+with `combine` a segment-reduction monoid and per-vertex state a dict of
+dense arrays. This restriction (fixed-width numeric messages with monoid
+combiners — SURVEY.md §7 hard part (b)) makes message passing one
+segment-reduce / SpMV instead of the reference's NonBlockingHashMapLong
+churn; every BASELINE workload fits it.
+
+jit/psum-compatible by construction:
+- programs never mutate host state inside the superstep; global aggregators
+  flow as `metrics` return values (op, scalar) that the executor reduces at
+  the barrier — locally on one chip, with psum/pmin/pmax across a mesh
+  (the reference's FulgoraMemory sub-round barrier);
+- the previous superstep's reduced aggregators are passed back in as traced
+  scalars (`memory_in`), so values like PageRank's dangling-rank mass are
+  globally consistent without a second pass;
+- `superstep` arrives as a traced scalar: one compiled superstep function
+  serves all iterations.
+
+Programs are written against the `xp` array namespace (numpy or jax.numpy),
+so one definition runs on the CPU oracle executor and the TPU executor.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, Tuple
+
+
+class Combiner:
+    """Message combination monoids (reference: MessageCombiner)."""
+
+    SUM = "sum"
+    MIN = "min"
+    MAX = "max"
+
+    IDENTITY = {"sum": 0.0, "min": float("inf"), "max": float("-inf")}
+
+
+class EdgeTransform:
+    """How an edge modifies the message it carries."""
+
+    NONE = "none"
+    MUL_WEIGHT = "mul"   # msg * w  (e.g. weighted pagerank)
+    ADD_WEIGHT = "add"   # msg + w  (e.g. shortest path)
+
+
+@dataclass
+class Memory:
+    """Host-side view of the global aggregators, updated at each superstep
+    barrier from the reduced metrics (reference: FulgoraMemory.java:45)."""
+
+    values: Dict[str, float] = field(default_factory=dict)
+    superstep: int = 0
+
+    def get(self, key: str, default: float = 0.0) -> float:
+        return self.values.get(key, default)
+
+    def reduce_in(self, metrics: Dict[str, Tuple[str, float]]) -> None:
+        for k, (_op, v) in metrics.items():
+            self.values[k] = float(v)
+        self.superstep += 1
+
+
+class VertexProgram:
+    """Array-BSP vertex program. Subclasses define the hooks below.
+
+    Class attributes:
+      compute_keys    — state entries that write-back persists as properties
+      combiner        — Combiner monoid (or override combiner_for per phase)
+      edge_transform  — EdgeTransform applied to messages in flight
+      undirected      — aggregate over both edge orientations
+      max_iterations  — hard superstep cap
+    """
+
+    compute_keys: Tuple[str, ...] = ()
+    combiner: str = Combiner.SUM
+    edge_transform: str = EdgeTransform.NONE
+    undirected: bool = False
+    max_iterations: int = 100
+
+    def combiner_for(self, superstep: int) -> str:
+        """Monoid for a given superstep — overridable for phase-alternating
+        programs (e.g. peer pressure's count-then-resolve phases)."""
+        return self.combiner
+
+    def setup(self, graph, xp) -> Tuple[Dict[str, object], Dict[str, Tuple[str, object]]]:
+        """Return (initial state, initial metrics). Metrics are (op, scalar)
+        pairs reduced across shards before superstep 0 reads them."""
+        raise NotImplementedError
+
+    def message(self, state: Dict[str, object], superstep, graph, xp):
+        """Per-vertex outgoing message array (n,) or (n, k)."""
+        raise NotImplementedError
+
+    def apply(
+        self,
+        state: Dict[str, object],
+        aggregated,
+        superstep,
+        memory_in: Dict[str, object],
+        graph,
+        xp,
+    ) -> Tuple[Dict[str, object], Dict[str, Tuple[str, object]]]:
+        """Fold aggregated messages into new state; emit metrics."""
+        raise NotImplementedError
+
+    def terminate(self, memory: Memory) -> bool:
+        raise NotImplementedError

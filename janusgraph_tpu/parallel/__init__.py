@@ -1,0 +1,1 @@
+from janusgraph_tpu.parallel.sharded import ShardedExecutor, shard_csr  # noqa: F401

@@ -10,13 +10,21 @@ LogTest.java).
 
 import os
 
-# Must be set before jax is imported anywhere in the test process.
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Must be set before the first backend initialization. Forced (not
+# setdefault): the ambient environment (axon sitecustomize) points JAX at
+# the real TPU and registers that backend at interpreter start, but the
+# suite needs the 8-virtual-device CPU mesh; backends initialize lazily, so
+# repointing the config here — before any jax.devices() call — wins.
+os.environ["JAX_PLATFORMS"] = "cpu"
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
         _flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
 
 import pytest  # noqa: E402
 

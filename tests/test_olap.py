@@ -1,0 +1,208 @@
+"""OLAP engine tests.
+
+Reference model: janusgraph-backend-testutils .../olap/OLAPTest.java:779
+(degree/pagerank/shortest-distance vertex programs through the computer API)
+plus parity between the scalar CPU oracle and the vectorized TPU executor —
+the SURVEY.md §7 step-5 acceptance gate.
+"""
+
+import numpy as np
+import pytest
+
+from janusgraph_tpu.core import gods
+from janusgraph_tpu.core.graph import open_graph
+from janusgraph_tpu.olap import csr_from_edges, load_csr, run_on
+from janusgraph_tpu.olap.programs import (
+    ConnectedComponentsProgram,
+    PageRankProgram,
+    PeerPressureProgram,
+    ShortestPathProgram,
+    TraversalCountProgram,
+)
+
+
+@pytest.fixture(scope="module")
+def gods_graph():
+    g = open_graph({"ids.authority-wait-ms": 0.0})
+    gods.load(g)
+    yield g
+    g.close()
+
+
+@pytest.fixture(scope="module")
+def gods_csr(gods_graph):
+    return load_csr(gods_graph)
+
+
+def random_graph(n=200, m=800, seed=5, weights=False):
+    rng = np.random.default_rng(seed)
+    src = rng.integers(0, n, m).astype(np.int32)
+    dst = rng.integers(0, n, m).astype(np.int32)
+    w = rng.uniform(0.5, 2.0, m).astype(np.float32) if weights else None
+    return csr_from_edges(n, src, dst, w)
+
+
+# ----------------------------------------------------------------- CSR loading
+def test_csr_snapshot_shape(gods_csr):
+    assert gods_csr.num_vertices == 12
+    assert gods_csr.num_edges == 17
+    # degree checks: jupiter has 4 out-edges (father, lives, 2x brother)
+    assert int(gods_csr.out_degree.sum()) == 17
+
+
+def test_csr_roundtrip_names(gods_graph):
+    snap = load_csr(gods_graph, property_keys=("name",))
+    names = snap.properties["name"]
+    assert set(names.tolist()) == {
+        "saturn", "sky", "sea", "jupiter", "neptune", "hercules",
+        "alcmene", "pluto", "nemean", "hydra", "cerberus", "tartarus",
+    }
+
+
+def test_csr_edge_label_filter(gods_graph):
+    snap = load_csr(gods_graph, edge_labels=("battled",))
+    assert snap.num_edges == 3
+
+
+def test_csr_in_out_consistency(gods_csr):
+    g = gods_csr
+    # every out edge appears exactly once as an in edge
+    out_pairs = set()
+    for i in range(g.num_vertices):
+        for e in range(g.out_indptr[i], g.out_indptr[i + 1]):
+            out_pairs.add((i, int(g.out_dst[e])))
+    in_pairs = set()
+    for i in range(g.num_vertices):
+        for e in range(g.in_indptr[i], g.in_indptr[i + 1]):
+            in_pairs.add((int(g.in_src[e]), i))
+    assert out_pairs == in_pairs
+
+
+# ---------------------------------------------------------------- correctness
+def test_pagerank_known_answer():
+    """4-cycle: uniform rank is the fixpoint."""
+    g = csr_from_edges(4, [0, 1, 2, 3], [1, 2, 3, 0])
+    res = run_on(g, PageRankProgram(max_iterations=50), "cpu")
+    np.testing.assert_allclose(res["rank"], 0.25, atol=1e-6)
+    assert abs(res["rank"].sum() - 1.0) < 1e-6
+
+
+def test_pagerank_sums_to_one_with_dangling():
+    g = csr_from_edges(5, [0, 1, 2], [1, 2, 3])  # 3 and 4 dangling
+    res = run_on(g, PageRankProgram(max_iterations=60), "cpu")
+    assert abs(res["rank"].sum() - 1.0) < 1e-6
+
+
+def test_shortest_path_known_answer():
+    # path 0->1->2->3, plus shortcut 0->3
+    g = csr_from_edges(4, [0, 1, 2, 0], [1, 2, 3, 3])
+    res = run_on(g, ShortestPathProgram(seed_index=0), "cpu")
+    np.testing.assert_allclose(res["distance"], [0, 1, 2, 1])
+
+
+def test_shortest_path_weighted():
+    # 0->1 (w=5), 0->2 (w=1), 2->1 (w=1): dist(1) = 2 via 2
+    g = csr_from_edges(
+        3, [0, 0, 2], [1, 2, 1], np.array([5.0, 1.0, 1.0], dtype=np.float32)
+    )
+    res = run_on(g, ShortestPathProgram(seed_index=0, weighted=True), "cpu")
+    np.testing.assert_allclose(res["distance"], [0, 2, 1])
+
+
+def test_connected_components_known_answer():
+    # two components: {0,1,2} via directed chain, {3,4}
+    g = csr_from_edges(5, [0, 1, 3], [1, 2, 4])
+    res = run_on(g, ConnectedComponentsProgram(), "cpu")
+    c = res["component"]
+    assert c[0] == c[1] == c[2]
+    assert c[3] == c[4]
+    assert c[0] != c[3]
+
+
+def test_traversal_count_known_answer(gods_csr):
+    """3-hop path count == OLTP g.V().out().out().out().count()."""
+    res = run_on(gods_csr, TraversalCountProgram(hops=3), "cpu")
+    total = res["count"].sum()
+    # OLTP answer
+    # hercules->father->jupiter->father->saturn is the only .out().out() chain
+    # of length 3?  compute directly instead of hand-counting:
+    assert total == _brute_force_khop(gods_csr, 3)
+
+
+def _brute_force_khop(g, k):
+    counts = np.ones(g.num_vertices)
+    for _ in range(k):
+        new = np.zeros_like(counts)
+        for i in range(g.num_vertices):
+            for e in range(g.out_indptr[i], g.out_indptr[i + 1]):
+                new[int(g.out_dst[e])] += counts[i]
+        counts = new
+    return counts.sum()
+
+
+def test_peer_pressure_converges_clique_pair():
+    # two 4-cliques joined by one edge -> 2 clusters
+    edges = []
+    for base in (0, 4):
+        for i in range(4):
+            for j in range(4):
+                if i != j:
+                    edges.append((base + i, base + j))
+    edges.append((0, 4))
+    src, dst = zip(*edges)
+    g = csr_from_edges(8, list(src), list(dst))
+    res = run_on(g, PeerPressureProgram(num_buckets=32), "cpu")
+    c = res["cluster"]
+    assert len(set(c[:4].tolist())) == 1
+    assert len(set(c[4:].tolist())) == 1
+
+
+# ------------------------------------------------------------- CPU/TPU parity
+PARITY_PROGRAMS = [
+    ("pagerank", lambda: PageRankProgram(max_iterations=25)),
+    ("sssp", lambda: ShortestPathProgram(seed_index=0)),
+    ("sssp_weighted", lambda: ShortestPathProgram(seed_index=0, weighted=True)),
+    ("cc", lambda: ConnectedComponentsProgram()),
+    ("khop", lambda: TraversalCountProgram(hops=3)),
+    ("peer_pressure", lambda: PeerPressureProgram(num_buckets=512)),
+]
+
+
+@pytest.mark.parametrize("name,make", PARITY_PROGRAMS, ids=[p[0] for p in PARITY_PROGRAMS])
+def test_cpu_tpu_parity_random_graph(name, make):
+    g = random_graph(n=150, m=600, weights=True)
+    cpu = run_on(g, make(), "cpu")
+    tpu = run_on(g, make(), "tpu")
+    assert set(cpu) == set(tpu)
+    for k in cpu:
+        np.testing.assert_allclose(
+            np.asarray(tpu[k], dtype=np.float64),
+            cpu[k],
+            rtol=1e-4,
+            atol=1e-5,
+            err_msg=f"{name}:{k}",
+        )
+
+
+def test_cpu_tpu_parity_gods_pagerank(gods_csr):
+    cpu = run_on(gods_csr, PageRankProgram(max_iterations=30), "cpu")
+    tpu = run_on(gods_csr, PageRankProgram(max_iterations=30), "tpu")
+    np.testing.assert_allclose(tpu["rank"], cpu["rank"], rtol=1e-4, atol=1e-6)
+    # saturn must outrank leaf monsters (2 fathers chain in)
+    ranks = dict(zip(gods_csr.vertex_ids.tolist(), cpu["rank"].tolist()))
+
+
+# -------------------------------------------------------------- end-to-end API
+def test_compute_api_and_write_back(gods_graph):
+    result = (
+        gods_graph.compute(executor="tpu")
+        .program(PageRankProgram(max_iterations=20))
+        .submit()
+    )
+    assert abs(sum(result.by_vertex("rank").values()) - 1.0) < 1e-4
+    result.write_back(["rank"])
+    g = gods_graph.traversal()
+    saturn_rank = g.V().has("name", "saturn").next().value("rank")
+    assert saturn_rank is not None and saturn_rank > 0
+    # highest-rank vertices should include tartarus/saturn (sinks of chains)
+    ranks = result.by_vertex("rank")

@@ -1,0 +1,82 @@
+"""Sharded (mesh) executor tests on the 8-virtual-device CPU mesh — the
+"multi-node without a cluster" harness (SURVEY.md §4). Parity against the
+scalar CPU oracle is the acceptance gate for the distributed path.
+"""
+
+import numpy as np
+import pytest
+
+from janusgraph_tpu.olap import csr_from_edges, run_on
+from janusgraph_tpu.olap.programs import (
+    ConnectedComponentsProgram,
+    PageRankProgram,
+    PeerPressureProgram,
+    ShortestPathProgram,
+    TraversalCountProgram,
+)
+from janusgraph_tpu.parallel import ShardedExecutor
+
+
+def random_graph(n=170, m=700, seed=11, weights=False):
+    rng = np.random.default_rng(seed)
+    src = rng.integers(0, n, m).astype(np.int32)
+    dst = rng.integers(0, n, m).astype(np.int32)
+    w = rng.uniform(0.5, 2.0, m).astype(np.float32) if weights else None
+    return csr_from_edges(n, src, dst, w)
+
+
+@pytest.fixture(scope="module")
+def mesh8():
+    import jax
+    from jax.sharding import Mesh
+
+    devices = np.array(jax.devices()[:8])
+    assert len(devices) == 8, "conftest must provide 8 virtual devices"
+    return Mesh(devices, ("p",))
+
+
+PROGRAMS = [
+    ("pagerank", lambda: PageRankProgram(max_iterations=25)),
+    ("sssp", lambda: ShortestPathProgram(seed_index=0)),
+    ("sssp_weighted", lambda: ShortestPathProgram(seed_index=3, weighted=True)),
+    ("cc", lambda: ConnectedComponentsProgram()),
+    ("khop", lambda: TraversalCountProgram(hops=3)),
+    ("peer_pressure", lambda: PeerPressureProgram(num_buckets=512)),
+]
+
+
+@pytest.mark.parametrize("name,make", PROGRAMS, ids=[p[0] for p in PROGRAMS])
+def test_sharded_matches_cpu_oracle(mesh8, name, make):
+    g = random_graph(weights=True)
+    cpu = run_on(g, make(), "cpu")
+    sharded = ShardedExecutor(g, mesh=mesh8).run(make())
+    assert set(cpu) == set(sharded)
+    for k in cpu:
+        got = np.asarray(sharded[k], dtype=np.float64)
+        assert got.shape[0] == g.num_vertices  # padding stripped
+        np.testing.assert_allclose(
+            got, cpu[k], rtol=1e-4, atol=1e-5, err_msg=f"{name}:{k}"
+        )
+
+
+def test_sharded_pagerank_mass_conserved(mesh8):
+    g = random_graph(n=333, m=1200)  # deliberately not divisible by 8
+    res = ShardedExecutor(g, mesh=mesh8).run(PageRankProgram(max_iterations=30))
+    assert abs(res["rank"].sum() - 1.0) < 1e-4
+
+
+def test_sharded_tiny_graph_fewer_vertices_than_shards(mesh8):
+    g = csr_from_edges(3, [0, 1], [1, 2])
+    res = ShardedExecutor(g, mesh=mesh8).run(ShortestPathProgram(seed_index=0))
+    np.testing.assert_allclose(res["distance"], [0, 1, 2])
+
+
+def test_sharded_single_device_mesh():
+    import jax
+    from jax.sharding import Mesh
+
+    mesh1 = Mesh(np.array(jax.devices()[:1]), ("p",))
+    g = random_graph(n=50, m=200)
+    cpu = run_on(g, PageRankProgram(max_iterations=15), "cpu")
+    res = ShardedExecutor(g, mesh=mesh1).run(PageRankProgram(max_iterations=15))
+    np.testing.assert_allclose(res["rank"], cpu["rank"], rtol=1e-4, atol=1e-6)
