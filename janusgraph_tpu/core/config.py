@@ -1,0 +1,392 @@
+"""Typed hierarchical configuration registry with mutability levels and a
+KCVS-backed global configuration store.
+
+Capability parity with the reference's config system
+(reference: diskstorage/configuration/ConfigNamespace.java:26,
+ConfigOption.java:36 — datatype/default/verifier + mutability levels
+LOCAL/MASKABLE/GLOBAL/GLOBAL_OFFLINE/FIXED;
+graphdb/configuration/GraphDatabaseConfiguration.java — the ~140-option
+registry; diskstorage/configuration/backend/KCVSConfiguration.java — GLOBAL
+options stored in the ``system_properties`` store so every instance of the
+cluster agrees, frozen-on-first-use semantics merged at open by
+GraphDatabaseConfigurationBuilder.java:41).
+
+Design notes (TPU build): options are plain typed Python descriptors in one
+flat registry keyed by dotted path; global state rides the same KCVS
+``system_properties`` store so any store manager (in-memory, native, sharded)
+carries cluster config identically.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+import uuid
+from enum import Enum
+from typing import Any, Callable, Dict, List, Optional
+
+from janusgraph_tpu.exceptions import ConfigurationError
+
+
+class Mutability(Enum):
+    """reference: ConfigOption.Type (ConfigOption.java:36)."""
+
+    LOCAL = "local"  # only settable in local config at open
+    MASKABLE = "maskable"  # local config may override the global value
+    GLOBAL = "global"  # cluster-wide, changeable online via management
+    GLOBAL_OFFLINE = "global_offline"  # cluster-wide, all instances closed
+    FIXED = "fixed"  # frozen once the cluster is initialised
+
+
+class ConfigOption:
+    def __init__(
+        self,
+        path: str,
+        datatype: type,
+        description: str,
+        default: Any = None,
+        mutability: Mutability = Mutability.LOCAL,
+        verifier: Optional[Callable[[Any], bool]] = None,
+    ):
+        self.path = path
+        self.datatype = datatype
+        self.description = description
+        self.default = default
+        self.mutability = mutability
+        self.verifier = verifier
+
+    def check(self, value: Any) -> Any:
+        if value is None:
+            raise ConfigurationError(f"{self.path}: value may not be None")
+        if self.datatype is float and isinstance(value, int):
+            value = float(value)
+        if not isinstance(value, self.datatype):
+            raise ConfigurationError(
+                f"{self.path}: expected {self.datatype.__name__}, "
+                f"got {type(value).__name__} ({value!r})"
+            )
+        if self.verifier is not None and not self.verifier(value):
+            raise ConfigurationError(f"{self.path}: invalid value {value!r}")
+        return value
+
+
+class ConfigNamespace:
+    """A node in the option tree; options register themselves under it
+    (reference: ConfigNamespace.java:26)."""
+
+    def __init__(self, name: str, description: str = "", parent: Optional["ConfigNamespace"] = None):
+        self.name = name
+        self.description = description
+        self.parent = parent
+        self.children: Dict[str, ConfigNamespace] = {}
+        self.options: Dict[str, ConfigOption] = {}
+        if parent is not None:
+            parent.children[name] = self
+
+    @property
+    def path(self) -> str:
+        parts: List[str] = []
+        ns: Optional[ConfigNamespace] = self
+        while ns is not None and ns.parent is not None:
+            parts.append(ns.name)
+            ns = ns.parent
+        return ".".join(reversed(parts))
+
+    def option(
+        self,
+        name: str,
+        datatype: type,
+        description: str,
+        default: Any = None,
+        mutability: Mutability = Mutability.LOCAL,
+        verifier: Optional[Callable[[Any], bool]] = None,
+    ) -> ConfigOption:
+        full = f"{self.path}.{name}" if self.path else name
+        opt = ConfigOption(full, datatype, description, default, mutability, verifier)
+        self.options[name] = opt
+        REGISTRY[full] = opt
+        return opt
+
+
+#: flat path -> option registry (reference: ROOT_NS tree)
+REGISTRY: Dict[str, ConfigOption] = {}
+
+ROOT = ConfigNamespace("root")
+STORAGE = ConfigNamespace("storage", "storage backend", ROOT)
+IDS = ConfigNamespace("ids", "id allocation", ROOT)
+CACHE = ConfigNamespace("cache", "database caches", ROOT)
+SCHEMA = ConfigNamespace("schema", "schema handling", ROOT)
+CLUSTER = ConfigNamespace("cluster", "cluster-wide topology", ROOT)
+GRAPH = ConfigNamespace("graph", "graph instance", ROOT)
+LOG_NS = ConfigNamespace("log", "durable logs", ROOT)
+TX_NS = ConfigNamespace("tx", "transactions", ROOT)
+INDEX_NS = ConfigNamespace("index", "mixed index providers", ROOT)
+METRICS_NS = ConfigNamespace("metrics", "metrics collection", ROOT)
+COMPUTER_NS = ConfigNamespace("computer", "OLAP graph computer", ROOT)
+LOCK_NS = ConfigNamespace("locks", "distributed locking", ROOT)
+SERVER_NS = ConfigNamespace("server", "server endpoint", ROOT)
+
+STORAGE.option("backend", str, "store manager shorthand", "inmemory")
+STORAGE.option("directory", str, "data directory for persistent backends", "")
+STORAGE.option(
+    "batch-loading", bool,
+    "disable consistency checks for bulk loads", False,
+)
+STORAGE.option(
+    "buffer-size", int, "mutation buffer flush batch size", 1024,
+    verifier=lambda v: v > 0,
+)
+STORAGE.option(
+    "parallel-backend-ops", bool,
+    "parallelize multi-key slice reads on a worker pool", True,
+)
+IDS.option(
+    "partition-bits", int, "bits of the vertex id reserved for the partition",
+    5, Mutability.FIXED, lambda v: 0 <= v <= 16,
+)
+IDS.option(
+    "block-size", int, "ids leased per authority block", 10_000,
+    Mutability.GLOBAL_OFFLINE, lambda v: v > 0,
+)
+IDS.option(
+    "authority-wait-ms", float,
+    "claim-verification wait for the consistent-key id authority", 0.5,
+    Mutability.GLOBAL_OFFLINE,
+)
+CACHE.option("db-cache", bool, "enable the store-level slice cache", True)
+CACHE.option(
+    "db-cache-size", int, "slice cache entry budget", 65536,
+    Mutability.MASKABLE, lambda v: v > 0,
+)
+CACHE.option(
+    "db-cache-time-ms", float,
+    "slice cache TTL bounding cross-instance staleness (0 = no expiry)",
+    10_000.0, Mutability.MASKABLE,
+)
+CACHE.option(
+    "tx-cache-size", int, "per-transaction vertex cache size", 20000,
+    Mutability.MASKABLE, lambda v: v > 0,
+)
+SCHEMA.option(
+    "default", str, "auto-create schema on first use ('auto'|'none')", "auto",
+    Mutability.MASKABLE, lambda v: v in ("auto", "none"),
+)
+CLUSTER.option(
+    "max-partitions", int,
+    "virtual partitions for graph sharding (OLAP shard granularity)",
+    32, Mutability.FIXED, lambda v: v > 0,
+)
+GRAPH.option(
+    "graphname", str, "name of this graph for multi-graph management", "graph",
+)
+GRAPH.option(
+    "unique-instance-id", str,
+    "cluster-unique id of this open instance (auto-generated when empty)", "",
+)
+LOG_NS.option(
+    "num-buckets", int, "write-parallelism buckets per log partition", 4,
+    Mutability.GLOBAL_OFFLINE, lambda v: v > 0,
+)
+LOG_NS.option(
+    "send-batch-size", int, "max messages per batched log append", 256,
+    Mutability.MASKABLE, lambda v: v > 0,
+)
+LOG_NS.option(
+    "read-interval-ms", float, "poll interval of log message pullers", 20.0,
+    Mutability.MASKABLE,
+)
+TX_NS.option("log-tx", bool, "write the WAL transaction log", False, Mutability.GLOBAL)
+TX_NS.option(
+    "max-commit-time-ms", float,
+    "recovery considers a tx abandoned after this long", 10_000.0,
+    Mutability.GLOBAL,
+)
+INDEX_NS.option("search.backend", str, "mixed index provider shorthand", "fulltext")
+INDEX_NS.option("search.directory", str, "index data directory", "")
+METRICS_NS.option("enabled", bool, "collect per-store operation metrics", False)
+COMPUTER_NS.option(
+    "result-mode", str, "olap result mode ('memory'|'persist')", "memory",
+    Mutability.MASKABLE, lambda v: v in ("memory", "persist"),
+)
+LOCK_NS.option(
+    "wait-ms", float, "claim re-read wait of the consistent-key locker", 1.0,
+    Mutability.GLOBAL_OFFLINE,
+)
+LOCK_NS.option(
+    "expiry-ms", float, "lock claims older than this are expired", 10_000.0,
+    Mutability.GLOBAL_OFFLINE,
+)
+LOCK_NS.option(
+    "retries", int, "lock acquisition attempts", 3, Mutability.MASKABLE,
+    lambda v: v > 0,
+)
+SERVER_NS.option("host", str, "bind address", "127.0.0.1")
+SERVER_NS.option("port", int, "bind port", 8182)
+SERVER_NS.option("auth.enabled", bool, "require HMAC token auth", False)
+SERVER_NS.option("auth.secret", str, "HMAC token signing secret", "")
+
+
+def describe_options() -> str:
+    """Render the registry as a config-reference table (reference:
+    auto-generated docs/basics/janusgraph-cfg.md)."""
+    lines = ["| option | type | mutability | default | description |", "|---|---|---|---|---|"]
+    for path in sorted(REGISTRY):
+        o = REGISTRY[path]
+        lines.append(
+            f"| {o.path} | {o.datatype.__name__} | {o.mutability.value} "
+            f"| {o.default!r} | {o.description} |"
+        )
+    return "\n".join(lines)
+
+
+# ---------------------------------------------------------------------------
+# Merged live configuration
+
+
+class GraphConfiguration:
+    """The merged view: local config + KCVS-stored global config.
+
+    Merge semantics at open (reference:
+    GraphDatabaseConfigurationBuilder.java:41):
+      * FIXED options: first opener writes its local value to the global
+        store; afterwards the stored value wins — a conflicting local value
+        is an error.
+      * GLOBAL / GLOBAL_OFFLINE: stored value wins; local value used only to
+        initialise an unset stored value.
+      * MASKABLE: local value if present, else stored value, else default.
+      * LOCAL: local value, else default.
+    """
+
+    def __init__(self, local: Dict[str, Any], backend=None):
+        self.local: Dict[str, Any] = {}
+        for k, v in local.items():
+            opt = REGISTRY.get(k)
+            if opt is None:
+                raise ConfigurationError(f"unknown configuration option: {k}")
+            self.local[k] = opt.check(v)
+        self.backend = backend
+        self._frozen_checked = False
+
+    # -- global store access ------------------------------------------------
+    @staticmethod
+    def _encode(value: Any) -> bytes:
+        return json.dumps(value).encode()
+
+    @staticmethod
+    def _decode(raw: bytes) -> Any:
+        return json.loads(raw.decode())
+
+    def _stored(self, path: str) -> Any:
+        if self.backend is None:
+            return None
+        raw = self.backend.get_global_config(path)
+        return None if raw is None else self._decode(raw)
+
+    def _store(self, path: str, value: Any) -> None:
+        if self.backend is not None:
+            self.backend.set_global_config(path, self._encode(value))
+
+    def attach_backend(self, backend) -> None:
+        """Bind the opened backend, then reconcile cluster-global options."""
+        self.backend = backend
+        for path, value in list(self.local.items()):
+            opt = REGISTRY[path]
+            if opt.mutability is Mutability.FIXED:
+                stored = self._stored(path)
+                if stored is None:
+                    self._store(path, value)
+                elif stored != value:
+                    raise ConfigurationError(
+                        f"{path} is FIXED: cluster value {stored!r} != "
+                        f"local value {value!r}"
+                    )
+            elif opt.mutability in (Mutability.GLOBAL, Mutability.GLOBAL_OFFLINE):
+                if self._stored(path) is None:
+                    self._store(path, value)
+
+    # -- reads --------------------------------------------------------------
+    def get(self, path: str) -> Any:
+        opt = REGISTRY.get(path)
+        if opt is None:
+            raise ConfigurationError(f"unknown configuration option: {path}")
+        if opt.mutability in (
+            Mutability.FIXED,
+            Mutability.GLOBAL,
+            Mutability.GLOBAL_OFFLINE,
+        ):
+            stored = self._stored(path)
+            if stored is not None:
+                # GLOBAL/FIXED: the stored cluster value wins over local
+                return opt.check(stored)
+        if opt.mutability is Mutability.MASKABLE:
+            if path in self.local:
+                return self.local[path]
+            stored = self._stored(path)
+            if stored is not None:
+                return opt.check(stored)
+            return opt.default
+        if path in self.local:
+            return self.local[path]
+        return opt.default
+
+    # -- management writes --------------------------------------------------
+    def set_global(self, path: str, value: Any, open_instances: int = 1) -> None:
+        """Management-path write of a cluster option (reference:
+        ManagementSystem.set)."""
+        opt = REGISTRY.get(path)
+        if opt is None:
+            raise ConfigurationError(f"unknown configuration option: {path}")
+        value = opt.check(value)
+        if opt.mutability is Mutability.FIXED:
+            raise ConfigurationError(f"{path} is FIXED and cannot be changed")
+        if opt.mutability in (Mutability.LOCAL,):
+            raise ConfigurationError(f"{path} is LOCAL; set it in the local config")
+        if opt.mutability is Mutability.GLOBAL_OFFLINE and open_instances > 1:
+            raise ConfigurationError(
+                f"{path} is GLOBAL_OFFLINE: requires all other instances closed "
+                f"({open_instances} open)"
+            )
+        self._store(path, value)
+
+
+# ---------------------------------------------------------------------------
+# Instance registry (reference: StandardJanusGraph.java:176-185 — instances
+# register a unique id in the global config; ManagementSystem lists and
+# force-closes them)
+
+_INSTANCE_PREFIX = "cluster.instance."
+
+
+def generate_instance_id() -> str:
+    return f"{os.getpid():x}-{uuid.uuid4().hex[:12]}"
+
+
+class InstanceRegistry:
+    def __init__(self, backend):
+        self.backend = backend
+        self._lock = threading.Lock()
+
+    def register(self, instance_id: str) -> None:
+        with self._lock:
+            if self.backend.get_global_config(_INSTANCE_PREFIX + instance_id):
+                raise ConfigurationError(
+                    f"instance id already registered: {instance_id} "
+                    "(another instance with this id is open; use "
+                    "management().force_close_instance to evict a stale one)"
+                )
+            self.backend.set_global_config(
+                _INSTANCE_PREFIX + instance_id,
+                json.dumps({"ts": time.time()}).encode(),
+            )
+
+    def deregister(self, instance_id: str) -> None:
+        with self._lock:
+            self.backend.del_global_config(_INSTANCE_PREFIX + instance_id)
+
+    def open_instances(self) -> List[str]:
+        return [
+            name[len(_INSTANCE_PREFIX):]
+            for name in self.backend.list_global_config(_INSTANCE_PREFIX)
+        ]

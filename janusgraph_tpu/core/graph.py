@@ -45,18 +45,15 @@ from janusgraph_tpu.storage.idauthority import ConsistentKeyIDAuthority, Standar
 from janusgraph_tpu.storage.inmemory import InMemoryStoreManager
 from janusgraph_tpu.storage.kcvs import KeySliceQuery, SliceQuery
 
-DEFAULT_CONFIG = {
-    "storage.backend": "inmemory",
-    "ids.partition-bits": 5,
-    "ids.block-size": 10_000,
-    "ids.authority-wait-ms": 0.5,
-    "cache.db-cache": True,
-    "schema.default": "auto",  # auto-create schema on first use ("none" = strict)
-}
-
 _STORE_MANAGERS = {
     "inmemory": InMemoryStoreManager,
 }
+
+
+def register_store_manager(name: str, factory) -> None:
+    """Register a storage adapter shorthand (reference:
+    StandardStoreManager.java:82 shorthand registry)."""
+    _STORE_MANAGERS[name] = factory
 
 
 def open_graph(config: Optional[dict] = None) -> "JanusGraphTPU":
@@ -112,39 +109,140 @@ class VertexIDAssigner:
 
 
 class JanusGraphTPU:
-    def __init__(self, config: Optional[dict] = None):
-        cfg = dict(DEFAULT_CONFIG)
-        if config:
-            cfg.update(config)
-        self.config = cfg
-        backend_name = cfg["storage.backend"]
-        factory = _STORE_MANAGERS.get(backend_name)
-        if factory is None:
-            raise ConfigurationError(f"unknown storage backend {backend_name!r}")
-        self.idm = IDManager(partition_bits=cfg["ids.partition-bits"])
+    def __init__(
+        self,
+        config: Optional[dict] = None,
+        store_manager=None,
+    ):
+        from janusgraph_tpu.core.config import (
+            GraphConfiguration,
+            InstanceRegistry,
+            generate_instance_id,
+        )
+
+        self.config = GraphConfiguration(dict(config or {}))
+        cfg = self.config
+        if store_manager is None:
+            backend_name = cfg.get("storage.backend")
+            factory = _STORE_MANAGERS.get(backend_name)
+            if factory is None:
+                raise ConfigurationError(
+                    f"unknown storage backend {backend_name!r}"
+                )
+            store_manager = factory()
         self.serializer = Serializer()
+        # reconcile cluster-global options BEFORE building the backend so
+        # stored GLOBAL/FIXED values govern its construction (reference:
+        # GraphDatabaseConfigurationBuilder.java:41 opens the backend
+        # temporarily to merge KCVS-stored config first)
+        from janusgraph_tpu.storage.backend import GlobalConfigStore
+
+        cfg.attach_backend(GlobalConfigStore(store_manager))
+        ttl_ms = cfg.get("cache.db-cache-time-ms")
+        self.backend = Backend(
+            store_manager,
+            cache_enabled=cfg.get("cache.db-cache"),
+            cache_size=cfg.get("cache.db-cache-size"),
+            id_block_size=cfg.get("ids.block-size"),
+            cache_ttl_seconds=(ttl_ms / 1000.0) if ttl_ms > 0 else None,
+        )
+        self.idm = IDManager(partition_bits=cfg.get("ids.partition-bits"))
         self.edge_serializer = EdgeSerializer(self.serializer, self.idm)
         self.system_types = SystemTypes(self.idm)
-        self.backend = Backend(
-            factory(),
-            cache_enabled=cfg["cache.db-cache"],
-            id_block_size=cfg["ids.block-size"],
+        self.backend.id_authority.wait_ms = cfg.get("ids.authority-wait-ms")
+        self.backend.configure_lockers(
+            wait_ms=cfg.get("locks.wait-ms"),
+            expiry_ms=cfg.get("locks.expiry-ms"),
+            retries=cfg.get("locks.retries"),
         )
-        self.backend.id_authority.wait_ms = cfg["ids.authority-wait-ms"]
+        self.instance_id = (
+            cfg.get("graph.unique-instance-id") or generate_instance_id()
+        )
+        self.instance_registry = InstanceRegistry(self.backend)
+        self.instance_registry.register(self.instance_id)
         self.id_assigner = VertexIDAssigner(self.backend.id_authority, self.idm)
+        # the durable log bus: WAL, schema broadcast, user CDC
+        # (reference: Backend.java:267,312,316 — txlog/systemlog/user logs)
+        from janusgraph_tpu.storage.log import LogManager
+
+        self.log_manager = LogManager(
+            store_manager,
+            sender=self.backend.rid,
+            num_buckets=cfg.get("log.num-buckets"),
+            send_batch_size=cfg.get("log.send-batch-size"),
+            read_interval_ms=cfg.get("log.read-interval-ms"),
+        )
+        self._tx_log = None
+        self._mgmt_logger = None
+        self._tx_log_lock = threading.Lock()
+        self._wal_enabled = bool(cfg.get("tx.log-tx"))
         self.index_serializer = IndexSerializer(self.serializer)
         self.schema_cache = SchemaCache(
             self._load_schema_by_name, self._load_schema_by_id
         )
-        self.auto_schema = cfg["schema.default"] == "auto"
+        self.auto_schema = cfg.get("schema.default") == "auto"
         self.indexes: Dict[str, IndexDefinition] = {}
         self._commit_lock = threading.Lock()
         self._open = True
         self._load_index_registry()
+        # register the schema-eviction broadcast reader at open
+        # (reference: StandardJanusGraph.java:187-189 ManagementLogger on
+        # systemlog)
+        _ = self.management_logger
 
     # ------------------------------------------------------------- lifecycle
-    def new_transaction(self, read_only: bool = False) -> Transaction:
-        return Transaction(self, read_only=read_only)
+    def new_transaction(
+        self, read_only: bool = False, log_identifier: Optional[str] = None
+    ) -> Transaction:
+        return Transaction(self, read_only=read_only, log_identifier=log_identifier)
+
+    @property
+    def tx_log(self):
+        from janusgraph_tpu.core.txlog import TransactionLog
+
+        with self._tx_log_lock:
+            if self._tx_log is None:
+                self._tx_log = TransactionLog(self.log_manager.open_log("txlog"))
+            return self._tx_log
+
+    @property
+    def management_logger(self):
+        from janusgraph_tpu.core.txlog import ManagementLogger
+
+        with self._tx_log_lock:
+            if self._mgmt_logger is None:
+                self._mgmt_logger = ManagementLogger(self)
+            return self._mgmt_logger
+
+    def open_log_processor(self, identifier: str):
+        """User CDC entry point (reference:
+        JanusGraphFactory.openTransactionLog → LogProcessorFramework)."""
+        from janusgraph_tpu.core.txlog import LogProcessorFramework
+
+        return LogProcessorFramework(self, identifier)
+
+    def start_transaction_recovery(self, start_ns: int = 0):
+        """Heal transactions with failed secondary persistence (reference:
+        JanusGraphFactory.startTransactionRecovery)."""
+        from janusgraph_tpu.core.txlog import TransactionRecovery
+
+        return TransactionRecovery(self, start_ns)
+
+    def _on_global_config_change(self, path: str, value) -> None:
+        """Refresh open-resolved GLOBAL options when this instance changes
+        them (other instances pick the stored value up at reopen)."""
+        if path == "tx.log-tx":
+            self._wal_enabled = bool(value)
+
+    def evict_schema_element(self, sid: int) -> None:
+        """Broadcast receiver: drop the element from every cache layer."""
+        self.schema_cache.invalidate_id(sid)
+        self.backend.clear_caches()
+        self._load_index_registry()
+
+    def restore_mixed_indexes(self, changes) -> None:
+        """Recovery hook: re-derive mixed-index documents from primary
+        storage (filled in by the mixed-index milestone)."""
 
     def traversal(self):
         from janusgraph_tpu.core.traversal import GraphTraversalSource
@@ -162,6 +260,8 @@ class JanusGraphTPU:
 
     def close(self) -> None:
         if self._open:
+            self.instance_registry.deregister(self.instance_id)
+            self.log_manager.close()
             self.backend.close()
             self._open = False
 
@@ -261,16 +361,21 @@ class JanusGraphTPU:
         return self.management().make_vertex_label(name)
 
     def register_index(self, idx: IndexDefinition) -> None:
-        self.indexes[idx.name] = idx
+        # copy-on-write: readers always see a consistent dict
+        self.indexes = {**self.indexes, idx.name: idx}
 
     def _load_index_registry(self) -> None:
         btx = self.backend.begin_transaction()
         entries = btx.index_query(KeySliceQuery(INDEX_REGISTRY_KEY, SliceQuery()))
+        fresh: Dict[str, IndexDefinition] = {}
         for col, _ in entries:
             (sid,) = struct.unpack(">Q", col)
             el = self.schema_cache.get_by_id(sid)
             if isinstance(el, IndexDefinition):
-                self.indexes[el.name] = el
+                fresh[el.name] = el
+        # atomic swap: commit threads iterate a snapshot, never a dict being
+        # mutated by the systemlog reader thread
+        self.indexes = fresh
 
     # ----------------------------------------------------------------- commit
     def commit_tx(self, tx: Transaction) -> None:
@@ -281,6 +386,17 @@ class JanusGraphTPU:
         es = self.edge_serializer
         st = self.system_types
         btx = tx.backend_tx
+        # -- 0. WAL PRECOMMIT (reference: StandardJanusGraph.commit :698-703
+        # writes the tx payload to the txlog before touching storage).
+        # `tx.log-tx` is resolved once at open (+ on local set_config), not
+        # per commit — GLOBAL reads hit the system_properties store.
+        wal_enabled = self._wal_enabled or bool(tx.log_identifier)
+        tx_id = 0
+        changes = []
+        if wal_enabled:
+            changes = self._change_records(tx)
+            tx_id = self.tx_log.next_tx_id()
+            self.tx_log.precommit(tx_id, changes, tx.log_identifier or "")
         with self._commit_lock:
             # -- 1. vertex existence + label cells for new vertices
             for vid, label_id in tx._new_vertex_labels.items():
@@ -334,6 +450,89 @@ class JanusGraphTPU:
 
             # -- 6. flush while still holding the lock (unique-index safety)
             btx.commit()
+
+        # -- 7. WAL PRIMARY_SUCCESS, then secondary persistence (user log)
+        # with its own status marker (reference: :752-813 — secondary
+        # failures are healed asynchronously by TransactionRecovery).
+        # Primary storage has committed: nothing past this point may raise,
+        # or the caller would roll back a durably-committed transaction.
+        if wal_enabled:
+            try:
+                self.tx_log.primary_success(tx_id)
+            except Exception:
+                # recovery sees PRECOMMIT without PRIMARY_SUCCESS and skips
+                # it; the committed data itself is safe
+                return
+            try:
+                if tx.log_identifier:
+                    from janusgraph_tpu.core.txlog import (
+                        LogTxStatus,
+                        TxLogEntry,
+                        encode_tx_entry,
+                    )
+
+                    if getattr(tx, "_fail_secondary_for_test", False):
+                        raise RuntimeError("injected secondary failure")
+                    ulog = self.log_manager.open_log("ulog_" + tx.log_identifier)
+                    ulog.add_now(
+                        encode_tx_entry(
+                            TxLogEntry(
+                                tx_id,
+                                LogTxStatus.PRECOMMIT,
+                                changes,
+                                tx.log_identifier,
+                            )
+                        )
+                    )
+                self.tx_log.secondary(tx_id, success=True)
+            except Exception:
+                try:
+                    self.tx_log.secondary(tx_id, success=False)
+                except Exception:
+                    pass  # recovery treats a missing marker as failure too
+
+    def _change_records(self, tx: Transaction):
+        """Serialize the tx's mutations as self-contained change records for
+        the WAL / CDC payload (reference: TransactionLogHeader payload)."""
+        from janusgraph_tpu.core.txlog import ChangeRecord
+
+        records = []
+
+        def record(rel, added: bool):
+            if isinstance(rel, Edge):
+                records.append(
+                    ChangeRecord(
+                        "edge",
+                        added,
+                        rel.out_vertex.id,
+                        rel.in_vertex.id,
+                        rel.type_id,
+                        rel.id,
+                    )
+                )
+            else:
+                records.append(
+                    ChangeRecord(
+                        "property",
+                        added,
+                        rel.vertex.id,
+                        0,
+                        rel.type_id,
+                        rel.id,
+                        self.serializer.write_object(rel.value),
+                    )
+                )
+
+        seen = set()
+        for rels in tx._added.values():
+            for rel in rels:
+                if rel.is_removed or rel.id in seen:
+                    continue
+                seen.add(rel.id)
+                record(rel, added=True)
+        for rel in tx._deleted:
+            record(rel, added=False)
+        return records
 
     def _write_relation(self, tx: Transaction, rel, delete: bool) -> None:
         es = self.edge_serializer
@@ -390,7 +589,7 @@ class JanusGraphTPU:
         if not changed:
             return
 
-        for idx in self.indexes.values():
+        for idx in list(self.indexes.values()):
             # phase 1: compute every vertex's (before, after) transition so
             # unique checks can see sibling mutations in this same tx —
             # both new claims and releases of previously-owned values
@@ -416,6 +615,16 @@ class JanusGraphTPU:
                 for vid, _before, after in transitions:
                     if after is None:
                         continue
+                    # distributed claim: lock the unique index row and pin
+                    # the slice observed now — commit re-verifies it
+                    # (reference: prepareCommit lock acquisition :561-605 →
+                    # BackendTransaction.acquireIndexLock → ConsistentKeyLocker)
+                    row = self.index_serializer.index_row_key(idx, after)
+                    col = b"\x00"
+                    expected = btx.index_query_uncached(
+                        KeySliceQuery(row, SliceQuery(col, col + b"\x00"))
+                    )
+                    btx.acquire_index_lock(row, col, expected)
                     prior = claims.get(after)
                     if prior is not None and prior != vid:
                         raise SchemaViolationError(
@@ -425,7 +634,9 @@ class JanusGraphTPU:
                     claims[after] = vid
                     # committed owner is fine if it releases the value in
                     # this same tx (e.g. remove-then-readd)
-                    existing = self.index_serializer.query(idx, after, btx)
+                    existing = self.index_serializer.query(
+                        idx, after, btx, uncached=True
+                    )
                     conflict = [
                         owner
                         for owner in existing

@@ -65,11 +65,20 @@ class IndexSerializer:
 
     # ------------------------------------------------------------------ query
     def query(
-        self, index: IndexDefinition, values: Sequence[object], backend_tx
+        self,
+        index: IndexDefinition,
+        values: Sequence[object],
+        backend_tx,
+        uncached: bool = False,
     ) -> List[int]:
         """Vertex ids matching the exact value tuple."""
         row = self.index_row_key(index, values)
-        entries = backend_tx.index_query(KeySliceQuery(row, SliceQuery()))
+        q = KeySliceQuery(row, SliceQuery())
+        entries = (
+            backend_tx.index_query_uncached(q)
+            if uncached
+            else backend_tx.index_query(q)
+        )
         if index.unique:
             return [struct.unpack(">Q", v)[0] for c, v in entries if c == _UNIQUE_COL]
         return [struct.unpack(">Q", c)[0] for c, _ in entries]

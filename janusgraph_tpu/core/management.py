@@ -149,6 +149,38 @@ class ManagementSystem:
     def _all_schema(self):
         return self.graph.load_all_schema_elements()
 
+    # -------------------------------------------------------- schema eviction
+    def broadcast_eviction(self, schema_id: int, timeout_s: float = 5.0) -> bool:
+        """Tell every open instance to drop `schema_id` from its caches and
+        wait for their acknowledgements (reference: ManagementLogger.java:287
+        eviction broadcast + ack tracking)."""
+        ml = self.graph.management_logger
+        evict_id = ml.broadcast_eviction(schema_id)
+        expected = len(self.open_instances())
+        return ml.wait_for_acks(evict_id, expected, timeout_s)
+
+    # --------------------------------------------- cluster config + instances
+    # (reference: ManagementSystem.set/get over GLOBAL options;
+    #  getOpenInstances/forceCloseInstance, StandardJanusGraph.java:176-185)
+    def get_config(self, path: str):
+        return self.graph.config.get(path)
+
+    def set_config(self, path: str, value) -> None:
+        self.graph.config.set_global(
+            path, value, open_instances=len(self.open_instances())
+        )
+        self.graph._on_global_config_change(path, value)
+
+    def open_instances(self) -> List[str]:
+        return self.graph.instance_registry.open_instances()
+
+    def force_close_instance(self, instance_id: str) -> None:
+        if instance_id == self.graph.instance_id:
+            raise SchemaViolationError(
+                "cannot force-close the current instance; use graph.close()"
+            )
+        self.graph.instance_registry.deregister(instance_id)
+
     # ----------------------------------------------------------------- helpers
     def _check_fresh(self, name: str) -> None:
         if not name or name.startswith("\x00"):

@@ -247,5 +247,11 @@ class SchemaCache:
                 if el is not None:
                     self._by_id.pop(el.id, None)
 
+    def invalidate_id(self, sid: int) -> None:
+        with self._lock:
+            el = self._by_id.pop(sid, None)
+            if el is not None:
+                self._by_name.pop(el.name, None)
+
     def data_type_for(self, serializer: Serializer, key: "PropertyKey"):
         return serializer.serializer_for_type(key.data_type)

@@ -43,9 +43,17 @@ from janusgraph_tpu.storage.kcvs import KeySliceQuery, SliceQuery
 
 
 class Transaction:
-    def __init__(self, graph, read_only: bool = False):
+    def __init__(
+        self,
+        graph,
+        read_only: bool = False,
+        log_identifier: Optional[str] = None,
+    ):
         self.graph = graph
         self.read_only = read_only
+        # route this tx's change-set to the user CDC log "ulog_<identifier>"
+        # (reference: StandardTransactionBuilder.logIdentifier)
+        self.log_identifier = log_identifier
         self.backend_tx = graph.backend.begin_transaction()
         self._vertex_cache: Dict[int, Vertex] = {}
         # vid -> list of added relations incident to it (edges appear under
@@ -487,6 +495,10 @@ class Transaction:
             if self.has_mutations():
                 self.graph.commit_tx(self)
             self.backend_tx.commit()
+        except BaseException:
+            # release buffered mutations AND any held lock claims
+            self.backend_tx.rollback()
+            raise
         finally:
             self._open = False
 

@@ -11,6 +11,7 @@ mutations and flushes in batches).
 from __future__ import annotations
 
 import threading
+import uuid
 from typing import Dict, List, Optional, Sequence
 
 from janusgraph_tpu.storage.cache import ExpirationCacheStore
@@ -32,6 +33,46 @@ INDEXSTORE_NAME = "graphindex"
 SYSTEM_PROPERTIES_NAME = "system_properties"
 TXLOG_NAME = "txlog"
 SYSTEMLOG_NAME = "systemlog"
+LOCK_STORE_SUFFIX = "_lock_"
+
+
+class GlobalConfigStore:
+    """Cluster-global config access over the ``system_properties`` store,
+    usable BEFORE the full Backend is built — the reference likewise opens
+    the backend temporarily to merge KCVS-stored global config at open
+    (reference: GraphDatabaseConfigurationBuilder.java:41,
+    KCVSConfiguration)."""
+
+    _CONFIG_KEY = b"\x00config"
+
+    def __init__(self, manager: KeyColumnValueStoreManager):
+        self._store = manager.open_database(SYSTEM_PROPERTIES_NAME)
+        self._tx = manager.begin_transaction()
+
+    def set_global_config(self, name: str, value: bytes) -> None:
+        self._store.mutate(
+            self._CONFIG_KEY, [(name.encode(), value)], [], self._tx
+        )
+
+    def get_global_config(self, name: str) -> Optional[bytes]:
+        col = name.encode()
+        entries = self._store.get_slice(
+            KeySliceQuery(self._CONFIG_KEY, SliceQuery(col, col + b"\x00")),
+            self._tx,
+        )
+        return entries[0][1] if entries else None
+
+    def del_global_config(self, name: str) -> None:
+        self._store.mutate(self._CONFIG_KEY, [], [name.encode()], self._tx)
+
+    def list_global_config(self, prefix: str = "") -> List[str]:
+        p = prefix.encode()
+        end = (p + b"\xff") if p else None
+        entries = self._store.get_slice(
+            KeySliceQuery(self._CONFIG_KEY, SliceQuery(p or None, end)),
+            self._tx,
+        )
+        return [col.decode() for col, _ in entries]
 
 
 class Backend:
@@ -43,43 +84,81 @@ class Backend:
         cache_enabled: bool = True,
         cache_size: int = 65536,
         id_block_size: int = 10_000,
+        cache_ttl_seconds: Optional[float] = 10.0,
     ):
         self.manager = manager
         self._base_tx = manager.begin_transaction()
         edgestore = manager.open_database(EDGESTORE_NAME)
         indexstore = manager.open_database(INDEXSTORE_NAME)
         if cache_enabled:
-            # 80/20 edge/index cache split like the reference (Backend.java:107)
-            edgestore = ExpirationCacheStore(edgestore, int(cache_size * 0.8))
-            indexstore = ExpirationCacheStore(indexstore, int(cache_size * 0.2))
+            # 80/20 edge/index cache split like the reference (Backend.java:107);
+            # the TTL bounds cross-instance staleness (reference:
+            # cache.db-cache-time default 10s)
+            edgestore = ExpirationCacheStore(
+                edgestore, int(cache_size * 0.8), ttl_seconds=cache_ttl_seconds
+            )
+            indexstore = ExpirationCacheStore(
+                indexstore, int(cache_size * 0.2), ttl_seconds=cache_ttl_seconds
+            )
         self.edgestore = edgestore
         self.indexstore = indexstore
         self.system_properties = manager.open_database(SYSTEM_PROPERTIES_NAME)
+        self.global_config = GlobalConfigStore(manager)
         self.id_store = manager.open_database(ID_STORE_NAME)
         self.id_authority = ConsistentKeyIDAuthority(
             self.id_store, self._base_tx, block_size=id_block_size
         )
+        # consistent-key lockers over dedicated lock stores (reference:
+        # Backend.java:184-213 wraps stores in ExpectedValueCheckingStore)
+        from janusgraph_tpu.storage.locking import (
+            ConsistentKeyLocker,
+            mediator_for,
+        )
+
+        self.rid = uuid.uuid4().bytes[:8]
+        mediator = mediator_for(manager)
+        self.edge_locker = ConsistentKeyLocker(
+            manager.open_database(EDGESTORE_NAME + LOCK_STORE_SUFFIX),
+            manager.begin_transaction,
+            self.rid,
+            mediator,
+        )
+        self.index_locker = ConsistentKeyLocker(
+            manager.open_database(INDEXSTORE_NAME + LOCK_STORE_SUFFIX),
+            manager.begin_transaction,
+            self.rid,
+            mediator,
+        )
+
+    def clear_caches(self) -> None:
+        """Drop all cached slices (schema-eviction broadcast handler)."""
+        for store in (self.edgestore, self.indexstore):
+            if isinstance(store, ExpirationCacheStore):
+                store.invalidate_all()
+
+    def configure_lockers(
+        self, wait_ms: float, expiry_ms: float, retries: int
+    ) -> None:
+        for locker in (self.edge_locker, self.index_locker):
+            locker.wait_ms = wait_ms
+            locker.expiry_ms = expiry_ms
+            locker.retries = retries
 
     def begin_transaction(self, config: Optional[dict] = None) -> "BackendTransaction":
         return BackendTransaction(self, self.manager.begin_transaction(config))
 
     # -- global config on system_properties (reference: KCVSConfiguration) --
-    _CONFIG_KEY = b"\x00config"
-
     def set_global_config(self, name: str, value: bytes) -> None:
-        self.system_properties.mutate(
-            self._CONFIG_KEY, [(name.encode(), value)], [], self._base_tx
-        )
+        self.global_config.set_global_config(name, value)
 
     def get_global_config(self, name: str) -> Optional[bytes]:
-        col = name.encode()
-        entries = self.system_properties.get_slice(
-            KeySliceQuery(
-                self._CONFIG_KEY, SliceQuery(col, col + b"\x00")
-            ),
-            self._base_tx,
-        )
-        return entries[0][1] if entries else None
+        return self.global_config.get_global_config(name)
+
+    def del_global_config(self, name: str) -> None:
+        self.global_config.del_global_config(name)
+
+    def list_global_config(self, prefix: str = "") -> List[str]:
+        return self.global_config.list_global_config(prefix)
 
     def close(self) -> None:
         self.edgestore.close()
@@ -114,6 +193,14 @@ class BackendTransaction:
     def index_query(self, query: KeySliceQuery) -> EntryList:
         return self.backend.indexstore.get_slice(query, self.store_tx)
 
+    def index_query_uncached(self, query: KeySliceQuery) -> EntryList:
+        """Bypass the per-instance slice cache — claim-time reads backing
+        lock expectations must not see TTL-stale data."""
+        store = self.backend.indexstore
+        if isinstance(store, ExpirationCacheStore):
+            store = store.wrapped
+        return store.get_slice(query, self.store_tx)
+
     # ---------------------------------------------------------------- writes
     def _buffer(self, store: str, key: bytes, additions: EntryList, deletions: Sequence[bytes]):
         with self._lock:
@@ -132,11 +219,64 @@ class BackendTransaction:
             not m.is_empty() for rows in self._mutations.values() for m in rows.values()
         )
 
+    # ----------------------------------------------------------------- locks
+    # (reference: BackendTransaction.acquireEdgeLock/acquireIndexLock →
+    #  ExpectedValueCheckingStore.acquireLock)
+    def acquire_edge_lock(
+        self, key: bytes, column: bytes, expected=None
+    ) -> None:
+        from janusgraph_tpu.storage.locking import KeyColumn
+
+        self.backend.edge_locker.write_lock(
+            KeyColumn(key, column), self, expected
+        )
+
+    def acquire_index_lock(
+        self, key: bytes, column: bytes, expected=None
+    ) -> None:
+        from janusgraph_tpu.storage.locking import KeyColumn
+
+        self.backend.index_locker.write_lock(
+            KeyColumn(key, column), self, expected
+        )
+
+    def _check_and_release_locks(self, commit: bool) -> None:
+        from janusgraph_tpu.storage.kcvs import KeySliceQuery, SliceQuery
+
+        be = self.backend
+        try:
+            if commit:
+                for locker, store in (
+                    (be.edge_locker, be.edgestore),
+                    (be.index_locker, be.indexstore),
+                ):
+                    if not locker.held_by(self):
+                        continue
+                    # expected-value reads must see the real store, not a
+                    # possibly-stale per-instance slice cache
+                    if isinstance(store, ExpirationCacheStore):
+                        store = store.wrapped
+                    locker.check_locks(self)
+                    locker.check_expected_values(
+                        self,
+                        lambda t, _s=store: _s.get_slice(
+                            KeySliceQuery(
+                                t.key, SliceQuery(t.column, t.column + b"\x00")
+                            ),
+                            self.store_tx,
+                        ),
+                    )
+        except Exception:
+            be.edge_locker.delete_locks(self)
+            be.index_locker.delete_locks(self)
+            raise
+
     # ---------------------------------------------------------------- commit
     def commit(self) -> None:
         if not self._open:
             return
         try:
+            self._check_and_release_locks(commit=True)
             if self._mutations:
                 self.backend.manager.mutate_many(self._mutations, self.store_tx)
                 # cache invalidation for mutated rows
@@ -154,9 +294,13 @@ class BackendTransaction:
                 self._mutations = {}
             self.store_tx.commit()
         finally:
+            self.backend.edge_locker.delete_locks(self)
+            self.backend.index_locker.delete_locks(self)
             self._open = False
 
     def rollback(self) -> None:
         self._mutations = {}
+        self.backend.edge_locker.delete_locks(self)
+        self.backend.index_locker.delete_locks(self)
         self.store_tx.rollback()
         self._open = False

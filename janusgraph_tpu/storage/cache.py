@@ -114,6 +114,14 @@ class ExpirationCacheStore(KeyColumnValueStore):
                 self._cache.pop(ck, None)
                 self.metrics.invalidations += 1
 
+    def invalidate_all(self) -> None:
+        """Drop every cached slice (cross-instance schema changes)."""
+        with self._lock:
+            self._generation += 1
+            self.metrics.invalidations += len(self._cache)
+            self._cache.clear()
+            self._by_row.clear()
+
     def _evict(self, ck) -> None:
         self._cache.pop(ck, None)
         rowset = self._by_row.get(ck[0])

@@ -1,0 +1,189 @@
+"""Locking tests: local mediation, consistent-key claim protocol, expected
+value checking, and unique-index safety across two graph instances sharing
+one store (reference test model: LockKeyColumnValueStoreTest.java:542 — two
+stores + two local mediators simulate two processes)."""
+
+import threading
+
+import pytest
+
+from janusgraph_tpu.core.graph import JanusGraphTPU
+from janusgraph_tpu.exceptions import SchemaViolationError
+from janusgraph_tpu.storage.inmemory import InMemoryStoreManager
+from janusgraph_tpu.storage.locking import (
+    ConsistentKeyLocker,
+    KeyColumn,
+    LocalLockMediator,
+    PermanentLockingError,
+    TemporaryLockingError,
+)
+
+
+def make_locker(mgr, rid, mediator=None, **kw):
+    store = mgr.open_database("test_lock_")
+    return ConsistentKeyLocker(
+        store,
+        mgr.begin_transaction,
+        rid,
+        mediator or LocalLockMediator(),
+        wait_ms=0.0,
+        **kw,
+    )
+
+
+class TestLocalLockMediator:
+    def test_claim_release(self):
+        med = LocalLockMediator()
+        t = KeyColumn(b"k", b"c")
+        assert med.claim(t, "tx1", expiry=1e12)
+        assert not med.claim(t, "tx2", expiry=1e12)
+        assert med.claim(t, "tx1", expiry=1e12)  # re-entrant
+        med.release(t, "tx2")  # not the holder: no-op
+        assert not med.claim(t, "tx2", expiry=1e12)
+        med.release(t, "tx1")
+        assert med.claim(t, "tx2", expiry=1e12)
+
+    def test_expired_claim_is_stealable(self):
+        med = LocalLockMediator()
+        t = KeyColumn(b"k", b"c")
+        assert med.claim(t, "tx1", expiry=0.0)  # already expired
+        assert med.claim(t, "tx2", expiry=1e12)
+
+
+class TestConsistentKeyLocker:
+    def test_single_holder_wins(self):
+        mgr = InMemoryStoreManager()
+        lk = make_locker(mgr, b"rid1")
+        t = KeyColumn(b"key", b"col")
+        lk.write_lock(t, "tx1")
+        lk.check_locks("tx1")  # no contest: we hold it
+        lk.delete_locks("tx1")
+        # afterwards another tx can take it
+        lk.write_lock(t, "tx2")
+        lk.check_locks("tx2")
+        lk.delete_locks("tx2")
+
+    def test_local_contention_fails_fast(self):
+        mgr = InMemoryStoreManager()
+        med = LocalLockMediator()
+        lk = make_locker(mgr, b"rid1", med)
+        t = KeyColumn(b"key", b"col")
+        lk.write_lock(t, "tx1")
+        with pytest.raises(TemporaryLockingError, match="local lock"):
+            lk.write_lock(t, "tx2")
+        lk.delete_locks("tx1")
+
+    def test_cross_process_race_first_claim_wins(self):
+        """Two lockers with DIFFERENT mediators (= two processes) share the
+        lock store; the earlier claim timestamp wins the re-read."""
+        mgr = InMemoryStoreManager()
+        a = make_locker(mgr, b"rid_a")
+        b = make_locker(mgr, b"rid_b")
+        t = KeyColumn(b"key", b"col")
+        a.write_lock(t, "txA")
+        b.write_lock(t, "txB")  # different mediator: local claim succeeds
+        a.check_locks("txA")  # a claimed first → wins
+        with pytest.raises(TemporaryLockingError, match="lost lock race"):
+            b.check_locks("txB")
+        a.delete_locks("txA")
+        b.delete_locks("txB")
+        # loser's claim got cleaned up: store row holds nothing live
+        c = make_locker(mgr, b"rid_c")
+        c.write_lock(t, "txC")
+        c.check_locks("txC")
+        c.delete_locks("txC")
+
+    def test_expired_remote_claim_ignored(self):
+        import time
+
+        mgr = InMemoryStoreManager()
+        # cluster-wide expiry of 50ms; a's claim ages past it, b's does not
+        a = make_locker(mgr, b"rid_a", expiry_ms=50.0)
+        b = make_locker(mgr, b"rid_b", expiry_ms=50.0)
+        t = KeyColumn(b"key", b"col")
+        a.write_lock(t, "txA")
+        time.sleep(0.1)
+        b.write_lock(t, "txB")
+        b.check_locks("txB")  # a's claim is expired → b wins
+        b.delete_locks("txB")
+        a.delete_locks("txA")
+
+    def test_expected_value_drift_fails_commit(self):
+        mgr = InMemoryStoreManager()
+        lk = make_locker(mgr, b"rid1")
+        t = KeyColumn(b"key", b"col")
+        lk.write_lock(t, "tx1", expected=[(b"col", b"v1")])
+        lk.check_locks("tx1")
+        with pytest.raises(PermanentLockingError, match="expected value"):
+            lk.check_expected_values("tx1", lambda _t: [(b"col", b"CHANGED")])
+        lk.delete_locks("tx1")
+
+    def test_expected_value_stable_passes(self):
+        mgr = InMemoryStoreManager()
+        lk = make_locker(mgr, b"rid1")
+        t = KeyColumn(b"key", b"col")
+        lk.write_lock(t, "tx1", expected=[])
+        lk.check_locks("tx1")
+        lk.check_expected_values("tx1", lambda _t: [])
+        lk.delete_locks("tx1")
+
+
+class TestUniqueIndexAcrossInstances:
+    """The end-to-end reason locking exists: two graph instances over one
+    storage manager cannot both claim a unique value."""
+
+    def _open_pair(self):
+        mgr = InMemoryStoreManager()
+        g1 = JanusGraphTPU({"ids.authority-wait-ms": 0.0, "locks.wait-ms": 0.0}, store_manager=mgr)
+        g2 = JanusGraphTPU({"ids.authority-wait-ms": 0.0, "locks.wait-ms": 0.0}, store_manager=mgr)
+        mgmt = g1.management()
+        mgmt.make_property_key("name", str)
+        mgmt.build_composite_index("byName", ["name"], unique=True)
+        # second instance must see the schema: drop its caches and re-read
+        # (the mgmt-log broadcast automates this in the log milestone)
+        g2.backend.clear_caches()
+        g2.schema_cache.invalidate("name")
+        g2._load_index_registry()
+        return g1, g2
+
+    def test_sequential_claims_conflict(self):
+        g1, g2 = self._open_pair()
+        tx1 = g1.new_transaction()
+        v1 = tx1.add_vertex()
+        tx1.add_property(v1, "name", "zeus")
+        tx1.commit()
+        tx2 = g2.new_transaction()
+        v2 = tx2.add_vertex()
+        tx2.add_property(v2, "name", "zeus")
+        with pytest.raises(SchemaViolationError, match="unique"):
+            tx2.commit()
+        g1.close()
+        g2.close()
+
+    def test_concurrent_claims_one_wins(self):
+        g1, g2 = self._open_pair()
+        results = []
+        barrier = threading.Barrier(2)
+
+        def writer(g):
+            tx = g.new_transaction()
+            v = tx.add_vertex()
+            tx.add_property(v, "name", "hera")
+            barrier.wait()
+            try:
+                tx.commit()
+                results.append("ok")
+            except Exception:
+                results.append("fail")
+
+        t1 = threading.Thread(target=writer, args=(g1,))
+        t2 = threading.Thread(target=writer, args=(g2,))
+        t1.start(); t2.start(); t1.join(); t2.join()
+        assert sorted(results) == ["fail", "ok"]
+        # exactly one owner persisted
+        tx = g1.new_transaction()
+        hits = g1.index_lookup(tx, "byName", ("hera",))
+        assert len(hits) == 1
+        tx.rollback()
+        g1.close()
+        g2.close()
