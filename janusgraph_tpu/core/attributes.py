@@ -209,41 +209,51 @@ class FloatListSerializer(AttributeSerializer):
         return list(struct.unpack(f">{n}d", data))
 
 
-class GeoshapePoint:
-    """Minimal geoshape: a (lat, lon) point. Full shape vocabulary
-    (circle/box/polygon, WKT) is tracked for a later round
-    (reference: core/attribute/Geoshape.java:623)."""
+from janusgraph_tpu.core.predicates import Geoshape
 
-    __slots__ = ("lat", "lon")
 
-    def __init__(self, lat: float, lon: float):
-        self.lat = float(lat)
-        self.lon = float(lon)
-
-    def __eq__(self, other):
-        return (
-            isinstance(other, GeoshapePoint)
-            and self.lat == other.lat
-            and self.lon == other.lon
-        )
-
-    def __hash__(self):
-        return hash((self.lat, self.lon))
-
-    def __repr__(self):
-        return f"point({self.lat}, {self.lon})"
+def GeoshapePoint(lat: float, lon: float) -> Geoshape:
+    """Compat shim: the original minimal point type, now the full Geoshape
+    vocabulary (reference: core/attribute/Geoshape.java:623)."""
+    return Geoshape.point(lat, lon)
 
 
 class GeoshapeSerializer(AttributeSerializer):
+    """Kind-tagged binary: 0x01 point[2d], 0x02 circle[3d], 0x03 box[4d],
+    0x04 polygon[count:2][2d each] (reference: Geoshape.GeoShapeSerializer
+    binary codec)."""
+
     type_id = 9
-    py_type = GeoshapePoint
-    fixed_width = 16
+    py_type = Geoshape
 
     def write(self, value) -> bytes:
-        return struct.pack(">dd", value.lat, value.lon)
+        if value.kind == "Point":
+            return b"\x01" + struct.pack(">dd", value.lat, value.lon)
+        if value.kind == "Circle":
+            return b"\x02" + struct.pack(
+                ">ddd", value.lat, value.lon, value.radius_km
+            )
+        if value.kind == "Box":
+            (slat, slon), (nlat, nlon) = value.coords
+            return b"\x03" + struct.pack(">dddd", slat, slon, nlat, nlon)
+        out = [b"\x04", struct.pack(">H", len(value.coords))]
+        for la, lo in value.coords:
+            out.append(struct.pack(">dd", la, lo))
+        return b"".join(out)
 
     def read(self, data: bytes):
-        return GeoshapePoint(*struct.unpack(">dd", data))
+        kind = data[0]
+        if kind == 1:
+            return Geoshape.point(*struct.unpack(">dd", data[1:17]))
+        if kind == 2:
+            return Geoshape.circle(*struct.unpack(">ddd", data[1:25]))
+        if kind == 3:
+            return Geoshape.box(*struct.unpack(">dddd", data[1:33]))
+        (n,) = struct.unpack(">H", data[1:3])
+        pts = [
+            struct.unpack(">dd", data[3 + 16 * i : 19 + 16 * i]) for i in range(n)
+        ]
+        return Geoshape.polygon(pts)
 
 
 class Serializer:

@@ -203,7 +203,7 @@ TX_NS.option(
     "recovery considers a tx abandoned after this long", 10_000.0,
     Mutability.GLOBAL,
 )
-INDEX_NS.option("search.backend", str, "mixed index provider shorthand", "fulltext")
+INDEX_NS.option("search.backend", str, "mixed index provider shorthand", "memindex")
 INDEX_NS.option("search.directory", str, "index data directory", "")
 METRICS_NS.option("enabled", bool, "collect per-store operation metrics", False)
 COMPUTER_NS.option(

@@ -10,6 +10,7 @@ BASELINE config #1 for OLAP PageRank.
 
 from __future__ import annotations
 
+from janusgraph_tpu.core.predicates import Geoshape
 from janusgraph_tpu.core.attributes import GeoshapePoint
 from janusgraph_tpu.core.codecs import Multiplicity
 
@@ -20,7 +21,7 @@ def load(graph) -> None:
     mgmt.make_property_key("age", int)
     mgmt.make_property_key("time", int)
     mgmt.make_property_key("reason", str)
-    mgmt.make_property_key("place", GeoshapePoint)
+    mgmt.make_property_key("place", Geoshape)
 
     for label in ("titan", "god", "demigod", "human", "monster", "location"):
         mgmt.make_vertex_label(label)

@@ -56,9 +56,27 @@ def register_store_manager(name: str, factory) -> None:
     _STORE_MANAGERS[name] = factory
 
 
-def open_graph(config: Optional[dict] = None) -> "JanusGraphTPU":
+def open_graph(config: Optional[dict] = None, store_manager=None) -> "JanusGraphTPU":
     """JanusGraphFactory.open equivalent."""
-    return JanusGraphTPU(config)
+    return JanusGraphTPU(config, store_manager=store_manager)
+
+
+class _MultiIndexTransaction:
+    """Fans commit/rollback out to one IndexTransaction per provider."""
+
+    def __init__(self, txs):
+        self._txs = txs
+
+    def has_mutations(self) -> bool:
+        return any(t.has_mutations() for t in self._txs)
+
+    def commit(self) -> None:
+        for t in self._txs:
+            t.commit()
+
+    def rollback(self) -> None:
+        for t in self._txs:
+            t.rollback()
 
 
 class VertexIDAssigner:
@@ -177,6 +195,23 @@ class JanusGraphTPU:
         self._tx_log_lock = threading.Lock()
         self._wal_enabled = bool(cfg.get("tx.log-tx"))
         self.index_serializer = IndexSerializer(self.serializer)
+        # mixed-index providers: shared per store-manager, standing in for
+        # the external index services' durability across graph reopen
+        # (reference: Backend.java:167 Map<String,IndexProvider>)
+        from janusgraph_tpu.indexing import open_index_provider
+
+        shared = getattr(store_manager, "_shared_index_providers", None)
+        if shared is None:
+            shared = {}
+            store_manager._shared_index_providers = shared
+        if "search" not in shared:
+            shared["search"] = open_index_provider(
+                cfg.get("index.search.backend"),
+                directory=cfg.get("index.search.directory"),
+            )
+        self.index_providers: Dict[str, object] = shared
+        # {index_name: {field: KeyInformation}} for provider.mutate calls
+        self._mixed_key_infos: Dict[str, Dict[str, object]] = {}
         self.schema_cache = SchemaCache(
             self._load_schema_by_name, self._load_schema_by_id
         )
@@ -241,8 +276,77 @@ class JanusGraphTPU:
         self._load_index_registry()
 
     def restore_mixed_indexes(self, changes) -> None:
-        """Recovery hook: re-derive mixed-index documents from primary
-        storage (filled in by the mixed-index milestone)."""
+        """Recovery hook: re-derive mixed-index documents of every vertex a
+        failed tx touched from authoritative primary storage and overwrite
+        the provider's copy (reference:
+        StandardTransactionLogProcessor.fixSecondaryFailure:151 →
+        IndexSerializer.reindexElement → IndexProvider.restore)."""
+        from janusgraph_tpu.indexing import IndexEntry
+
+        touched = set()
+        for c in changes:
+            if c.kind == "property":
+                touched.add(c.vertex_id)
+            else:
+                touched.add(c.vertex_id)
+                touched.add(c.other_id)
+        if not touched:
+            return
+        tx = self.new_transaction(read_only=True)
+        try:
+            per_provider: Dict[str, dict] = {}
+            for idx in self.indexes.values():
+                if not idx.mixed or idx.status == "DISABLED":
+                    continue
+                fields = self.mixed_index_fields(idx, register=True)
+                docs = per_provider.setdefault(idx.backing, {}).setdefault(
+                    idx.name, {}
+                )
+                for vid in touched:
+                    v = tx.get_vertex(vid)
+                    entries = []
+                    if v is not None and self._matches_label(tx, idx, vid):
+                        for fname, (kid, _info) in fields.items():
+                            for p in tx.get_properties(v, fname):
+                                entries.append(IndexEntry(fname, p.value))
+                    docs[str(vid)] = entries
+            for backing, documents in per_provider.items():
+                self.index_providers[backing].restore(
+                    documents, self._mixed_key_infos
+                )
+        finally:
+            tx.rollback()
+
+    def _matches_label(self, tx, idx: IndexDefinition, vid: int) -> bool:
+        if idx.label_constraint is None:
+            return True
+        v = tx._vertex_handle(vid)
+        return tx.get_vertex_label(v) == idx.label_constraint
+
+    def mixed_index_fields(self, idx: IndexDefinition, register: bool = False):
+        """{field_name: (key_id, KeyInformation)}; the provider store name is
+        the index name (reference: IndexSerializer.getStoreName)."""
+        from janusgraph_tpu.indexing import KeyInformation, Mapping
+
+        fields = {}
+        for kid in idx.key_ids:
+            pk = self.schema_cache.get_by_id(kid)
+            if not isinstance(pk, PropertyKey):
+                continue
+            info = KeyInformation(
+                pk.data_type,
+                Mapping(idx.mapping_for(kid)),
+                pk.cardinality.name,
+            )
+            fields[pk.name] = (kid, info)
+        if register:
+            provider = self.index_providers[idx.backing]
+            infos = self._mixed_key_infos.setdefault(idx.name, {})
+            for fname, (_kid, info) in fields.items():
+                if fname not in infos:
+                    infos[fname] = info
+                    provider.register(idx.name, fname, info)
+        return fields
 
     def traversal(self):
         from janusgraph_tpu.core.traversal import GraphTraversalSource
@@ -295,6 +399,30 @@ class JanusGraphTPU:
         )
         btx.commit()
         self.schema_cache.invalidate(el.name)
+
+    def update_schema_element(self, el) -> None:
+        """Replace an existing element's stored definition (reference:
+        ManagementSystem updateSchemaVertex — rewrite the definition
+        property), then evict caches and broadcast."""
+        es = self.edge_serializer
+        st = self.system_types
+        btx = self.backend.begin_transaction()
+        key = self.idm.get_key(el.id)
+        q = es.get_type_slice(st.SCHEMA_DEF, False)
+        old = btx.edge_store_query(KeySliceQuery(key, q))
+        dels = [col for col, _ in old]
+        add = es.write_property(
+            st.SCHEMA_DEF,
+            self.id_assigner.assign_relation_id(),
+            encode_definition(el.definition()),
+        )
+        btx.mutate_edges(key, [add], dels)
+        btx.commit()
+        self.schema_cache.invalidate(el.name)
+        self.schema_cache.invalidate_id(el.id)
+        if isinstance(el, IndexDefinition):
+            self.register_index(el)
+        self.management_logger.broadcast_eviction(el.id)
 
     def _load_schema_by_name(self, name: str):
         btx = self.backend.begin_transaction()
@@ -448,8 +576,26 @@ class JanusGraphTPU:
             # -- 5. composite index updates + unique checks
             self._apply_index_updates(tx, btx)
 
+            # -- 5.5 derive mixed-index document mutations while tx state is
+            # still consistent (flushed after primary commit — reference:
+            # prepareCommit builds IndexTransaction adds :645-663, commit
+            # order storage-then-indexes :759-766)
+            index_tx = self._prepare_mixed_index_updates(tx)
+
             # -- 6. flush while still holding the lock (unique-index safety)
             btx.commit()
+
+        # -- 6.5 mixed-index documents: secondary persistence; a failure
+        # never unwinds the durably-committed primary (healed by recovery
+        # when the WAL is on)
+        secondary_ok = True
+        if index_tx is not None and index_tx.has_mutations():
+            try:
+                if getattr(tx, "_fail_mixed_for_test", False):
+                    raise RuntimeError("injected mixed-index failure")
+                index_tx.commit()
+            except Exception:
+                secondary_ok = False
 
         # -- 7. WAL PRIMARY_SUCCESS, then secondary persistence (user log)
         # with its own status marker (reference: :752-813 — secondary
@@ -464,6 +610,8 @@ class JanusGraphTPU:
                 # it; the committed data itself is safe
                 return
             try:
+                if not secondary_ok:
+                    raise RuntimeError("mixed-index persistence failed")
                 if tx.log_identifier:
                     from janusgraph_tpu.core.txlog import (
                         LogTxStatus,
@@ -590,6 +738,8 @@ class JanusGraphTPU:
             return
 
         for idx in list(self.indexes.values()):
+            if idx.mixed:
+                continue  # document updates prepared separately (step 5.5)
             # phase 1: compute every vertex's (before, after) transition so
             # unique checks can see sibling mutations in this same tx —
             # both new claims and releases of previously-owned values
@@ -689,6 +839,138 @@ class JanusGraphTPU:
                 return None
             values.append(props[0].value)
         return tuple(values)
+
+    # ------------------------------------------------------- mixed index I/O
+    def _mixed_indexes(self):
+        return [
+            i
+            for i in self.indexes.values()
+            if i.mixed and i.status in ("REGISTERED", "ENABLED")
+        ]
+
+    def _committed_key_values(self, tx, key_id: int, vid: int) -> List[object]:
+        """All committed values of one property key on one vertex."""
+        es = self.edge_serializer
+        q = es.get_type_slice(key_id, False)
+        out = []
+        for e in tx._read_slice(vid, q):
+            rc = es.parse_relation(e, tx._codec_schema)
+            out.append(rc.value)
+        return out
+
+    def _prepare_mixed_index_updates(self, tx: Transaction):
+        """Build the IndexTransaction holding this tx's document mutations
+        (reference: IndexSerializer.getIndexUpdates mixed-index branch)."""
+        mixed = self._mixed_indexes()
+        if not mixed:
+            return None
+        changed: set = set()
+        for vid, rels in tx._added.items():
+            if any(isinstance(r, VertexProperty) and not r.is_removed for r in rels):
+                changed.add(vid)
+        for rel in tx._deleted:
+            if isinstance(rel, VertexProperty):
+                changed.add(rel.vertex.id)
+        changed.update(tx._removed_vertices)
+        if not changed:
+            return None
+        from janusgraph_tpu.indexing import IndexTransaction
+
+        # one IndexTransaction per backing provider would be more faithful;
+        # a single one keyed by store (= index name) is equivalent here
+        # because every store name is globally unique
+        txs: Dict[str, IndexTransaction] = {}
+        for idx in mixed:
+            provider = self.index_providers[idx.backing]
+            itx = txs.get(idx.backing)
+            if itx is None:
+                itx = txs[idx.backing] = IndexTransaction(
+                    provider, self._mixed_key_infos
+                )
+            fields = self.mixed_index_fields(idx, register=True)
+            for vid in changed:
+                docid = str(vid)
+                if vid in tx._removed_vertices:
+                    itx.delete(idx.name, docid, None, None, delete_all=True)
+                    continue
+                if not self._matches_label(tx, idx, vid):
+                    continue
+                v = tx._vertex_handle(vid)
+                for fname, (kid, _info) in fields.items():
+                    before = self._committed_key_values(tx, kid, vid)
+                    after = [p.value for p in tx.get_properties(v, fname)]
+                    for val in before:
+                        if val not in after:
+                            itx.delete(idx.name, docid, fname, val)
+                    for val in after:
+                        if val not in before:
+                            itx.add(
+                                idx.name, docid, fname, val, is_new=not before
+                            )
+        if len(txs) == 1:
+            return next(iter(txs.values()))
+        if not txs:
+            return None
+        return _MultiIndexTransaction(list(txs.values()))
+
+    def mixed_index_query(
+        self,
+        tx: Transaction,
+        idx: IndexDefinition,
+        conditions,
+        orders=(),
+        limit=None,
+        offset=0,
+    ) -> List[int]:
+        """Query a mixed index with [(key_name, Predicate, value)] conditions
+        (reference: IndexSerializer.query mixed branch → IndexProvider.query)."""
+        from janusgraph_tpu.indexing import (
+            And,
+            IndexQuery,
+            Order,
+            PredicateCondition,
+        )
+
+        if idx.status != "ENABLED":
+            raise SchemaViolationError(
+                f"index {idx.name} is {idx.status}, not ENABLED"
+            )
+        cond = And(
+            tuple(
+                PredicateCondition(k, p, val) for k, p, val in conditions
+            )
+        )
+        q = IndexQuery(
+            cond,
+            tuple(Order(k, desc) for k, desc in orders),
+            limit,
+            offset,
+        )
+        provider = self.index_providers[idx.backing]
+        return [int(d) for d in provider.query(idx.name, q)]
+
+    def index_query(self, index_name: str, query: str, limit=None, offset=0):
+        """Direct provider-syntax query returning [(vertex_id, score)]
+        (reference: core/schema/JanusGraphIndexQuery /
+        graphdb/query/graph/IndexQueryBuilder — `v.name:hercules` strings)."""
+        from janusgraph_tpu.indexing import RawQuery
+
+        idx = self.indexes.get(index_name)
+        if idx is None or not idx.mixed:
+            raise SchemaViolationError(f"{index_name} is not a mixed index")
+        provider = self.index_providers[idx.backing]
+        hits = provider.raw_query(idx.name, RawQuery(query, limit, offset))
+        return [(int(d), score) for d, score in hits]
+
+    def index_totals(self, index_name: str, query: str) -> int:
+        from janusgraph_tpu.indexing import RawQuery
+
+        idx = self.indexes.get(index_name)
+        if idx is None or not idx.mixed:
+            raise SchemaViolationError(f"{index_name} is not a mixed index")
+        return self.index_providers[idx.backing].totals(
+            idx.name, RawQuery(query)
+        )
 
     # -------------------------------------------------------- index-based read
     def index_lookup(self, tx: Transaction, index_name: str, values) -> List[int]:

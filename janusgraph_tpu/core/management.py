@@ -125,7 +125,149 @@ class ManagementSystem:
         btx.mutate_index(INDEX_REGISTRY_KEY, [(struct.pack(">Q", sid), b"")], [])
         btx.commit()
         self.graph.register_index(idx)
+        # cover data committed before the index existed
+        self.reindex(name)
         return idx
+
+    def build_mixed_index(
+        self,
+        name: str,
+        keys: Sequence[str],
+        backing: str = "search",
+        label: Optional[str] = None,
+        mappings: Optional[dict] = None,
+    ) -> IndexDefinition:
+        """Create a mixed index backed by an IndexProvider (reference:
+        ManagementSystem buildIndex(...).buildMixedIndex(backingIndex);
+        key mappings core/schema/Mapping.java)."""
+        if not keys:
+            raise SchemaViolationError("mixed index needs at least one key")
+        if not name or name.startswith("\x00"):
+            raise SchemaViolationError(f"invalid index name {name!r}")
+        if name in self.graph.indexes:
+            raise SchemaViolationError(f"index name already exists: {name}")
+        if backing not in self.graph.index_providers:
+            raise SchemaViolationError(
+                f"unknown index backend {backing!r}; configured: "
+                f"{sorted(self.graph.index_providers)}"
+            )
+        mappings = mappings or {}
+        key_ids, mapping_pairs = [], []
+        for key_name in keys:
+            pk = self.graph.schema_cache.get_by_name(key_name)
+            if not isinstance(pk, PropertyKey):
+                raise SchemaViolationError(f"{key_name} is not a property key")
+            key_ids.append(pk.id)
+            m = str(mappings.get(key_name, "DEFAULT")).upper()
+            if m not in ("DEFAULT", "TEXT", "STRING", "TEXTSTRING"):
+                raise SchemaViolationError(f"unknown mapping {m!r}")
+            mapping_pairs.append((pk.id, m))
+        sid = self.graph.id_assigner.assign_schema_id(VertexIDType.GENERIC_SCHEMA)
+        idx = IndexDefinition(
+            sid,
+            name,
+            tuple(key_ids),
+            False,
+            label,
+            "ENABLED",
+            mixed=True,
+            backing=backing,
+            mappings=tuple(mapping_pairs),
+        )
+        self._persist(idx)
+        btx = self.graph.backend.begin_transaction()
+        btx.mutate_index(INDEX_REGISTRY_KEY, [(struct.pack(">Q", sid), b"")], [])
+        btx.commit()
+        self.graph.register_index(idx)
+        # register fields with the provider up front (reference:
+        # IndexTransaction.register on index creation)
+        self.graph.mixed_index_fields(idx, register=True)
+        # cover data committed before the index existed
+        self.reindex(name)
+        return idx
+
+    def add_index_key(
+        self, index_name: str, key_name: str, mapping: str = "DEFAULT"
+    ) -> IndexDefinition:
+        """Extend a mixed index with another key (reference:
+        ManagementSystem.addIndexKey)."""
+        idx = self.graph.indexes.get(index_name)
+        if idx is None or not idx.mixed:
+            raise SchemaViolationError(f"{index_name} is not a mixed index")
+        pk = self.graph.schema_cache.get_by_name(key_name)
+        if not isinstance(pk, PropertyKey):
+            raise SchemaViolationError(f"{key_name} is not a property key")
+        if pk.id in idx.key_ids:
+            raise SchemaViolationError(f"{key_name} already indexed")
+        m = str(mapping).upper()
+        if m not in ("DEFAULT", "TEXT", "STRING", "TEXTSTRING"):
+            raise SchemaViolationError(f"unknown mapping {m!r}")
+        new = IndexDefinition(
+            idx.id,
+            idx.name,
+            idx.key_ids + (pk.id,),
+            idx.unique,
+            idx.label_constraint,
+            idx.status,
+            True,
+            idx.backing,
+            idx.mappings + ((pk.id, m),),
+        )
+        self.graph.update_schema_element(new)
+        self.graph.mixed_index_fields(new, register=True)
+        return new
+
+    def reindex(self, name: str) -> int:
+        """Rebuild an index from primary storage so data committed before the
+        index existed becomes visible (reference:
+        graphdb/olap/job/IndexRepairJob.java — REINDEX scans every vertex and
+        re-derives index entries; invoked automatically by build_*_index here
+        until the full REGISTER→REINDEX→ENABLE lifecycle, a divergence noted
+        in the class docstring). Returns the number of vertices indexed."""
+        g = self.graph
+        idx = g.indexes.get(name)
+        if idx is None:
+            raise SchemaViolationError(f"unknown index {name}")
+        tx = g.new_transaction(read_only=True)
+        try:
+            if idx.mixed:
+                from janusgraph_tpu.indexing import IndexEntry
+
+                fields = g.mixed_index_fields(idx, register=True)
+                docs = {}
+                for v in tx.vertices():
+                    if not g._matches_label(tx, idx, v.id):
+                        continue
+                    entries = [
+                        IndexEntry(fname, p.value)
+                        for fname in fields
+                        for p in tx.get_properties(v, fname)
+                    ]
+                    if entries:
+                        docs[str(v.id)] = entries
+                if docs:
+                    g.index_providers[idx.backing].restore(
+                        {idx.name: docs}, g._mixed_key_infos
+                    )
+                return len(docs)
+            btx = g.backend.begin_transaction()
+            count = 0
+            for v in tx.vertices():
+                if not g._matches_label(tx, idx, v.id):
+                    continue
+                values = g._index_values_current(tx, idx, v.id)
+                if values is None:
+                    continue
+                for row, adds, _dels in g.index_serializer.index_updates(
+                    idx, v.id, None, values
+                ):
+                    if adds:
+                        btx.mutate_index(row, adds, [])
+                count += 1
+            btx.commit()
+            return count
+        finally:
+            tx.rollback()
 
     # ----------------------------------------------------------------- lookups
     def get(self, name: str):

@@ -22,7 +22,8 @@ from dataclasses import dataclass, field
 from threading import RLock
 from typing import Dict, Optional, Tuple
 
-from janusgraph_tpu.core.attributes import GeoshapePoint, Serializer
+from janusgraph_tpu.core.attributes import Serializer
+from janusgraph_tpu.core.predicates import Geoshape
 from janusgraph_tpu.core.codecs import Cardinality, Multiplicity, TypeInfo
 from janusgraph_tpu.core.ids import IDManager, VertexIDType
 from janusgraph_tpu.exceptions import SchemaViolationError
@@ -56,7 +57,7 @@ _DATA_TYPES: Dict[str, type] = {
     "Double": float,
     "String": str,
     "Bytes": bytes,
-    "Geoshape": GeoshapePoint,
+    "Geoshape": Geoshape,
     "FloatList": list,
 }
 _DATA_TYPE_NAMES = {v: k for k, v in _DATA_TYPES.items()}
@@ -141,25 +142,44 @@ class VertexLabel:
 
 @dataclass(frozen=True)
 class IndexDefinition:
-    """A composite index over property keys, optionally label-constrained and
-    unique (reference: graph index subset of core/schema/JanusGraphIndex.java)."""
+    """A graph index over property keys, optionally label-constrained.
+    Composite (exact-match rows in `graphindex`) or mixed (documents in an
+    external IndexProvider) — reference: core/schema/JanusGraphIndex.java;
+    mixed/composite split graphdb/types/CompositeIndexType +
+    MixedIndexType."""
 
     id: int
     name: str
     key_ids: Tuple[int, ...]
     unique: bool = False
     label_constraint: Optional[str] = None
-    # lifecycle: REGISTERED -> ENABLED (reference SchemaStatus subset)
+    # lifecycle (reference core/schema/SchemaStatus.java):
+    # INSTALLED -> REGISTERED -> ENABLED -> DISABLED
     status: str = "ENABLED"
+    mixed: bool = False
+    backing: Optional[str] = None  # index backend shorthand for mixed
+    # key_id -> Mapping name (TEXT/STRING/TEXTSTRING), mixed only
+    mappings: Tuple[Tuple[int, str], ...] = ()
 
     def definition(self) -> dict:
-        return {
+        d = {
             "kind": "index",
             "keys": list(self.key_ids),
             "unique": self.unique,
             "label": self.label_constraint,
             "status": self.status,
         }
+        if self.mixed:
+            d["mixed"] = True
+            d["backing"] = self.backing
+            d["mappings"] = [list(m) for m in self.mappings]
+        return d
+
+    def mapping_for(self, key_id: int) -> str:
+        for kid, m in self.mappings:
+            if kid == key_id:
+                return m
+        return "DEFAULT"
 
 
 def schema_element_from_definition(sid: int, name: str, d: dict):
@@ -186,6 +206,9 @@ def schema_element_from_definition(sid: int, name: str, d: dict):
             d.get("unique", False),
             d.get("label"),
             d.get("status", "ENABLED"),
+            d.get("mixed", False),
+            d.get("backing"),
+            tuple((int(k), str(m)) for k, m in d.get("mappings", ())),
         )
     raise SchemaViolationError(f"unknown schema kind {kind!r}")
 

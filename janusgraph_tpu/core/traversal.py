@@ -26,45 +26,92 @@ from typing import Callable, List, Optional, Sequence
 
 from janusgraph_tpu.core.codecs import Direction
 from janusgraph_tpu.core.elements import Edge, Vertex, VertexProperty
+from janusgraph_tpu.core.predicates import Cmp, Geo, Text
 from janusgraph_tpu.core.schema import IndexDefinition
 from janusgraph_tpu.exceptions import QueryError
 
 
 class P:
-    """Predicate (reference vocabulary: core/attribute/Cmp.java)."""
+    """Predicate (reference vocabulary: core/attribute/Cmp.java, Text.java,
+    Geo.java). Carries the structured (predicate, condition) pair so index
+    selection can push it down to composite rows or a mixed IndexProvider."""
 
-    def __init__(self, test: Callable[[object], bool], label: str, eq_value=None):
+    def __init__(
+        self,
+        test: Callable[[object], bool],
+        label: str,
+        eq_value=None,
+        predicate=None,
+        condition=None,
+    ):
         self.test = test
         self.label = label
         #: set when the predicate is a plain equality — index-foldable
         self.eq_value = eq_value
+        #: structured predicate for mixed-index pushdown (None = opaque)
+        self.predicate = predicate
+        self.condition = condition
 
     def __repr__(self):
         return f"P.{self.label}"
 
     @staticmethod
+    def _of(pred, v, label) -> "P":
+        return P(
+            lambda x: pred.evaluate(x, v), label, predicate=pred, condition=v
+        )
+
+    @staticmethod
     def eq(v) -> "P":
-        return P(lambda x: x == v, f"eq({v!r})", eq_value=v)
+        return P(
+            lambda x: x == v,
+            f"eq({v!r})",
+            eq_value=v,
+            predicate=Cmp.EQUAL,
+            condition=v,
+        )
 
     @staticmethod
     def neq(v) -> "P":
-        return P(lambda x: x != v, f"neq({v!r})")
+        return P(
+            lambda x: x != v, f"neq({v!r})", predicate=Cmp.NOT_EQUAL, condition=v
+        )
 
     @staticmethod
     def gt(v) -> "P":
-        return P(lambda x: x is not None and x > v, f"gt({v!r})")
+        return P(
+            lambda x: x is not None and x > v,
+            f"gt({v!r})",
+            predicate=Cmp.GREATER_THAN,
+            condition=v,
+        )
 
     @staticmethod
     def gte(v) -> "P":
-        return P(lambda x: x is not None and x >= v, f"gte({v!r})")
+        return P(
+            lambda x: x is not None and x >= v,
+            f"gte({v!r})",
+            predicate=Cmp.GREATER_THAN_EQUAL,
+            condition=v,
+        )
 
     @staticmethod
     def lt(v) -> "P":
-        return P(lambda x: x is not None and x < v, f"lt({v!r})")
+        return P(
+            lambda x: x is not None and x < v,
+            f"lt({v!r})",
+            predicate=Cmp.LESS_THAN,
+            condition=v,
+        )
 
     @staticmethod
     def lte(v) -> "P":
-        return P(lambda x: x is not None and x <= v, f"lte({v!r})")
+        return P(
+            lambda x: x is not None and x <= v,
+            f"lte({v!r})",
+            predicate=Cmp.LESS_THAN_EQUAL,
+            condition=v,
+        )
 
     @staticmethod
     def within(*vs) -> "P":
@@ -79,6 +126,56 @@ class P:
     @staticmethod
     def between(lo, hi) -> "P":
         return P(lambda x: x is not None and lo <= x < hi, f"between({lo!r},{hi!r})")
+
+    # ---- full-text predicates (reference: attribute/Text.java) ----
+    @staticmethod
+    def text_contains(v) -> "P":
+        return P._of(Text.CONTAINS, v, f"textContains({v!r})")
+
+    @staticmethod
+    def text_contains_prefix(v) -> "P":
+        return P._of(Text.CONTAINS_PREFIX, v, f"textContainsPrefix({v!r})")
+
+    @staticmethod
+    def text_contains_regex(v) -> "P":
+        return P._of(Text.CONTAINS_REGEX, v, f"textContainsRegex({v!r})")
+
+    @staticmethod
+    def text_contains_fuzzy(v) -> "P":
+        return P._of(Text.CONTAINS_FUZZY, v, f"textContainsFuzzy({v!r})")
+
+    @staticmethod
+    def text_contains_phrase(v) -> "P":
+        return P._of(Text.CONTAINS_PHRASE, v, f"textContainsPhrase({v!r})")
+
+    @staticmethod
+    def text_prefix(v) -> "P":
+        return P._of(Text.PREFIX, v, f"textPrefix({v!r})")
+
+    @staticmethod
+    def text_regex(v) -> "P":
+        return P._of(Text.REGEX, v, f"textRegex({v!r})")
+
+    @staticmethod
+    def text_fuzzy(v) -> "P":
+        return P._of(Text.FUZZY, v, f"textFuzzy({v!r})")
+
+    # ---- geo predicates (reference: attribute/Geo.java) ----
+    @staticmethod
+    def geo_intersect(shape) -> "P":
+        return P._of(Geo.INTERSECT, shape, f"geoIntersect({shape!r})")
+
+    @staticmethod
+    def geo_within(shape) -> "P":
+        return P._of(Geo.WITHIN, shape, f"geoWithin({shape!r})")
+
+    @staticmethod
+    def geo_disjoint(shape) -> "P":
+        return P._of(Geo.DISJOINT, shape, f"geoDisjoint({shape!r})")
+
+    @staticmethod
+    def geo_contains(shape) -> "P":
+        return P._of(Geo.CONTAINS, shape, f"geoContains({shape!r})")
 
 
 class Traverser:
@@ -153,24 +250,15 @@ class _start_vertices:
             vids = self.source.graph.index_lookup(
                 tx, idx.name, [eqs[n] for n in names]
             )
-            out = [Traverser(v) for vid in vids if (v := tx.get_vertex(vid))]
-            # the committed index can't see this tx's writes: add tx-created
-            # vertices AND loaded vertices whose properties changed in-tx;
-            # _apply_has then re-checks every condition on current values
-            dirty = {
-                vid
-                for vid, rels in tx._added.items()
-                if any(isinstance(r, VertexProperty) for r in rels)
-            }
-            dirty.update(
-                r.vertex.id for r in tx._deleted if isinstance(r, VertexProperty)
-            )
-            out.extend(
-                Traverser(v)
-                for v in tx._vertex_cache.values()
-                if not v.is_removed and (v.is_new or v.id in dirty)
-            )
-            return _apply_has(_dedup(out), has_conditions, tx)
+            return _index_hits_with_tx_overlay(tx, vids, has_conditions)
+        # mixed-index folding: push supported predicate conditions down to an
+        # IndexProvider (reference: GraphCentricQueryBuilder index selection
+        # falling back from composite to mixed indexes)
+        hit = _select_mixed_index(self.source.graph, has_conditions, label_eq)
+        if hit is not None:
+            midx, covered = hit
+            vids = self.source.graph.mixed_index_query(tx, midx, covered)
+            return _index_hits_with_tx_overlay(tx, vids, has_conditions)
         # full scan (the reference warns here too)
         return _apply_has([Traverser(v) for v in tx.vertices()], has_conditions, tx)
 
@@ -190,9 +278,57 @@ class _start_edges:
         return _apply_has(out, has_conditions, tx)
 
 
+def _index_hits_with_tx_overlay(tx, vids, has_conditions) -> List[Traverser]:
+    """Committed index hits can't see this tx's writes: add tx-created
+    vertices AND loaded vertices whose properties changed in-tx; _apply_has
+    then re-checks every condition on current values."""
+    out = [Traverser(v) for vid in vids if (v := tx.get_vertex(vid))]
+    dirty = {
+        vid
+        for vid, rels in tx._added.items()
+        if any(isinstance(r, VertexProperty) for r in rels)
+    }
+    dirty.update(
+        r.vertex.id for r in tx._deleted if isinstance(r, VertexProperty)
+    )
+    out.extend(
+        Traverser(v)
+        for v in tx._vertex_cache.values()
+        if not v.is_removed and (v.is_new or v.id in dirty)
+    )
+    return _apply_has(_dedup(out), has_conditions, tx)
+
+
+def _select_mixed_index(graph, has_conditions, label_eq=None):
+    """Pick the mixed index covering the most pushable conditions; returns
+    (index, [(key, predicate, condition), ...]) or None."""
+    best = None
+    for idx in graph.indexes.values():
+        if not idx.mixed or idx.status != "ENABLED":
+            continue
+        if idx.label_constraint is not None and idx.label_constraint != label_eq:
+            continue
+        provider = graph.index_providers.get(idx.backing)
+        if provider is None:
+            continue
+        fields = graph.mixed_index_fields(idx)
+        covered = []
+        for key, p in has_conditions:
+            if key is None or p.predicate is None or key not in fields:
+                continue
+            _kid, info = fields[key]
+            if provider.supports(info, p.predicate):
+                covered.append((key, p.predicate, p.condition))
+        if covered and (best is None or len(covered) > len(best[1])):
+            best = (idx, covered)
+    return best
+
+
 def _select_index(graph, eqs: dict, label_eq=None) -> Optional[IndexDefinition]:
     best = None
     for idx in graph.indexes.values():
+        if idx.mixed:
+            continue  # exact-row lookups only; mixed handled separately
         # a label-constrained index only covers vertices of that label: it is
         # usable only when the query pins the label to exactly that value
         if idx.label_constraint is not None and idx.label_constraint != label_eq:

@@ -1,0 +1,46 @@
+"""Mixed (full-text / range / geo) index provider subsystem.
+
+reference: diskstorage/indexing/ — IndexProvider.java:36 SPI,
+IndexTransaction.java buffered mutations, IndexQuery.java condition trees;
+providers janusgraph-es/janusgraph-lucene/janusgraph-solr.
+"""
+
+from janusgraph_tpu.indexing.provider import (
+    And,
+    IndexEntry,
+    IndexFeatures,
+    IndexMutation,
+    IndexProvider,
+    IndexQuery,
+    IndexTransaction,
+    KeyInformation,
+    Mapping,
+    Not,
+    Or,
+    Order,
+    PredicateCondition,
+    RawQuery,
+    register_index_provider,
+    open_index_provider,
+)
+from janusgraph_tpu.indexing.memindex import InMemoryIndexProvider
+
+__all__ = [
+    "And",
+    "IndexEntry",
+    "IndexFeatures",
+    "IndexMutation",
+    "IndexProvider",
+    "IndexQuery",
+    "IndexTransaction",
+    "InMemoryIndexProvider",
+    "KeyInformation",
+    "Mapping",
+    "Not",
+    "Or",
+    "Order",
+    "PredicateCondition",
+    "RawQuery",
+    "register_index_provider",
+    "open_index_provider",
+]

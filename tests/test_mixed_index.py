@@ -1,0 +1,338 @@
+"""Mixed-index subsystem tests: provider SPI contract + graph integration
+(reference test model: IndexProviderTest.java:1290 SPI contract,
+JanusGraphIndexTest.java mixed-index graph behavior)."""
+
+import pytest
+
+from janusgraph_tpu.core.graph import open_graph
+from janusgraph_tpu.core.predicates import Cmp, Geo, Geoshape, Text
+from janusgraph_tpu.core.traversal import P
+from janusgraph_tpu.exceptions import SchemaViolationError
+from janusgraph_tpu.indexing import (
+    And,
+    IndexMutation,
+    IndexQuery,
+    InMemoryIndexProvider,
+    KeyInformation,
+    Mapping,
+    Not,
+    Or,
+    Order,
+    PredicateCondition,
+    RawQuery,
+)
+from janusgraph_tpu.storage.inmemory import InMemoryStoreManager
+
+
+# ---------------------------------------------------------------- SPI contract
+@pytest.fixture
+def provider():
+    p = InMemoryIndexProvider()
+    p.register("store", "name", KeyInformation(str, Mapping.TEXT))
+    p.register("store", "title", KeyInformation(str, Mapping.STRING))
+    p.register("store", "weight", KeyInformation(float))
+    p.register("store", "loc", KeyInformation(Geoshape))
+    docs = {
+        "d1": [("name", "Hercules son of Zeus"), ("weight", 0.5),
+               ("title", "hero"), ("loc", Geoshape.point(37.97, 23.72))],
+        "d2": [("name", "Zeus god of thunder"), ("weight", 2.5),
+               ("title", "god"), ("loc", Geoshape.point(52.5, 13.4))],
+        "d3": [("name", "Cerberus hound"), ("weight", 1.0),
+               ("title", "monster"), ("loc", Geoshape.point(38.0, 23.7))],
+    }
+    muts = {"store": {}}
+    for docid, fields in docs.items():
+        m = IndexMutation(is_new=True)
+        for f, v in fields:
+            m.add(f, v)
+        muts["store"][docid] = m
+    p.mutate(muts, {})
+    return p
+
+
+def q(cond, **kw):
+    return IndexQuery(cond, **kw)
+
+
+def test_text_contains_query(provider):
+    hits = provider.query("store", q(PredicateCondition("name", Text.CONTAINS, "zeus")))
+    assert set(hits) == {"d1", "d2"}
+
+
+def test_string_exact_query(provider):
+    hits = provider.query("store", q(PredicateCondition("title", Cmp.EQUAL, "god")))
+    assert hits == ["d2"]
+
+
+def test_numeric_range_query(provider):
+    hits = provider.query(
+        "store", q(PredicateCondition("weight", Cmp.GREATER_THAN, 0.6))
+    )
+    assert set(hits) == {"d2", "d3"}
+    hits = provider.query(
+        "store", q(PredicateCondition("weight", Cmp.LESS_THAN_EQUAL, 1.0))
+    )
+    assert set(hits) == {"d1", "d3"}
+
+
+def test_geo_query(provider):
+    athens_area = Geoshape.circle(38.0, 23.7, 100)
+    hits = provider.query(
+        "store", q(PredicateCondition("loc", Geo.WITHIN, athens_area))
+    )
+    assert set(hits) == {"d1", "d3"}
+
+
+def test_boolean_conditions(provider):
+    cond = And(
+        (
+            PredicateCondition("name", Text.CONTAINS, "zeus"),
+            PredicateCondition("weight", Cmp.GREATER_THAN, 1.0),
+        )
+    )
+    assert provider.query("store", q(cond)) == ["d2"]
+    cond = Or(
+        (
+            PredicateCondition("title", Cmp.EQUAL, "god"),
+            PredicateCondition("title", Cmp.EQUAL, "hero"),
+        )
+    )
+    assert set(provider.query("store", q(cond))) == {"d1", "d2"}
+    cond = Not(PredicateCondition("name", Text.CONTAINS, "zeus"))
+    assert provider.query("store", q(cond)) == ["d3"]
+
+
+def test_order_limit_offset(provider):
+    cond = PredicateCondition("weight", Cmp.GREATER_THAN, 0.0)
+    ordered = provider.query(
+        "store", q(cond, orders=(Order("weight"),))
+    )
+    assert ordered == ["d1", "d3", "d2"]
+    desc = provider.query("store", q(cond, orders=(Order("weight", desc=True),)))
+    assert desc == ["d2", "d3", "d1"]
+    assert provider.query("store", q(cond, limit=1, offset=1)) == ["d2"]
+
+
+def test_mutation_update_delete(provider):
+    m = IndexMutation()
+    m.delete("title", "hero")
+    m.add("title", "demigod")
+    provider.mutate({"store": {"d1": m}}, {})
+    assert provider.query(
+        "store", q(PredicateCondition("title", Cmp.EQUAL, "demigod"))
+    ) == ["d1"]
+    m = IndexMutation(is_deleted=True)
+    provider.mutate({"store": {"d1": m}}, {})
+    assert provider.query(
+        "store", q(PredicateCondition("name", Text.CONTAINS, "hercules"))
+    ) == []
+
+
+def test_restore_overwrites(provider):
+    from janusgraph_tpu.indexing import IndexEntry
+
+    provider.restore(
+        {"store": {"d2": [IndexEntry("title", "skyfather")]}}, {}
+    )
+    assert provider.query(
+        "store", q(PredicateCondition("title", Cmp.EQUAL, "skyfather"))
+    ) == ["d2"]
+    # old fields gone
+    assert provider.query(
+        "store", q(PredicateCondition("name", Text.CONTAINS, "zeus"))
+    ) == ["d1"]
+
+
+def test_raw_query_and_totals(provider):
+    hits = provider.raw_query("store", RawQuery("v.name:zeus"))
+    assert {d for d, _ in hits} == {"d1", "d2"}
+    assert provider.totals("store", RawQuery("name:zeus")) == 2
+
+
+def test_supports(provider):
+    text_info = KeyInformation(str, Mapping.TEXT)
+    string_info = KeyInformation(str, Mapping.STRING)
+    both_info = KeyInformation(str, Mapping.TEXTSTRING)
+    assert provider.supports(text_info, Text.CONTAINS)
+    assert not provider.supports(text_info, Text.PREFIX)
+    assert provider.supports(string_info, Text.PREFIX)
+    assert not provider.supports(string_info, Text.CONTAINS)
+    assert provider.supports(both_info, Text.CONTAINS)
+    assert provider.supports(both_info, Text.PREFIX)
+    assert provider.supports(KeyInformation(float), Cmp.LESS_THAN)
+    assert provider.supports(KeyInformation(Geoshape), Geo.INTERSECT)
+
+
+# ------------------------------------------------------------ graph integration
+@pytest.fixture
+def graph():
+    g = open_graph({"schema.default": "auto"})
+    yield g
+    g.close()
+
+
+def _load_people(g):
+    mgmt = g.management()
+    mgmt.make_property_key("bio", str)
+    mgmt.make_property_key("age", int)
+    mgmt.build_mixed_index("people", ["bio", "age"], backing="search")
+    tx = g.new_transaction()
+    a = tx.add_vertex(bio="fought the nemean lion", age=30)
+    b = tx.add_vertex(bio="god of thunder and sky", age=5000)
+    c = tx.add_vertex(bio="three headed hound", age=100)
+    tx.commit()
+    return a.id, b.id, c.id
+
+
+def test_mixed_index_traversal_query(graph):
+    a, b, c = _load_people(graph)
+    g = graph.traversal()
+    hits = g.V().has("bio", P.text_contains("thunder")).to_list()
+    assert [v.id for v in hits] == [b]
+    hits = g.V().has("age", P.lt(500)).to_list()
+    assert {v.id for v in hits} == {a, c}
+    # combined: both conditions pushed to the same index
+    hits = g.V().has("bio", P.text_contains("hound")).has("age", P.gt(50)).to_list()
+    assert [v.id for v in hits] == [c]
+
+
+def test_mixed_index_sees_updates_and_removals(graph):
+    a, b, c = _load_people(graph)
+    tx = graph.new_transaction()
+    v = tx.get_vertex(a)
+    tx.add_property(v, "bio", "slew the hydra")
+    tx.commit()
+    g = graph.traversal()
+    assert [v.id for v in g.V().has("bio", P.text_contains("hydra")).to_list()] == [a]
+    assert g.V().has("bio", P.text_contains("nemean")).to_list() == []
+    tx = graph.new_transaction()
+    tx.remove_vertex(tx.get_vertex(c))
+    tx.commit()
+    g = graph.traversal()
+    assert g.V().has("bio", P.text_contains("hound")).to_list() == []
+
+
+def test_mixed_index_tx_visibility(graph):
+    """Uncommitted writes are visible to the writing tx via overlay."""
+    _load_people(graph)
+    g = graph.traversal()
+    g.add_v(bio="swift messenger of the gods", age=900)
+    hits = g.V().has("bio", P.text_contains("messenger")).to_list()
+    assert len(hits) == 1
+
+
+def test_raw_index_query_on_graph(graph):
+    a, b, c = _load_people(graph)
+    hits = graph.index_query("people", "v.bio:hound")
+    assert [vid for vid, _ in hits] == [c]
+    assert graph.index_totals("people", "bio:god") == 1
+
+
+def test_mixed_index_label_constraint(graph):
+    mgmt = graph.management()
+    mgmt.make_property_key("motto", str)
+    mgmt.make_vertex_label("clan")
+    mgmt.build_mixed_index("clans", ["motto"], backing="search", label="clan")
+    tx = graph.new_transaction()
+    tx.add_vertex("clan", motto="strength and honor")
+    tx.add_vertex(motto="strength in numbers")  # not a clan
+    tx.commit()
+    g = graph.traversal()
+    hits = g.V().has_label("clan").has("motto", P.text_contains("strength")).to_list()
+    assert len(hits) == 1
+
+
+def test_string_mapping(graph):
+    mgmt = graph.management()
+    mgmt.make_property_key("code", str)
+    mgmt.build_mixed_index(
+        "codes", ["code"], backing="search", mappings={"code": "STRING"}
+    )
+    tx = graph.new_transaction()
+    v = tx.add_vertex(code="ABC-123")
+    tx.commit()
+    g = graph.traversal()
+    assert len(g.V().has("code", P.text_prefix("ABC")).to_list()) == 1
+    assert len(g.V().has("code", P.eq("ABC-123")).to_list()) == 1
+
+
+def test_geo_mixed_index(graph):
+    mgmt = graph.management()
+    mgmt.make_property_key("spot", Geoshape)
+    mgmt.build_mixed_index("places", ["spot"], backing="search")
+    tx = graph.new_transaction()
+    athens = tx.add_vertex(spot=Geoshape.point(37.97, 23.72))
+    berlin = tx.add_vertex(spot=Geoshape.point(52.5, 13.4))
+    tx.commit()
+    g = graph.traversal()
+    hits = g.V().has(
+        "spot", P.geo_within(Geoshape.circle(38.0, 23.7, 100))
+    ).to_list()
+    assert [v.id for v in hits] == [athens.id]
+
+
+def test_add_index_key(graph):
+    mgmt = graph.management()
+    mgmt.make_property_key("alpha", str)
+    mgmt.make_property_key("beta", str)
+    mgmt.build_mixed_index("ab", ["alpha"], backing="search")
+    mgmt.add_index_key("ab", "beta", mapping="TEXT")
+    tx = graph.new_transaction()
+    v = tx.add_vertex(alpha="one", beta="two three")
+    tx.commit()
+    g = graph.traversal()
+    assert len(g.V().has("beta", P.text_contains("three")).to_list()) == 1
+    idx = graph.indexes["ab"]
+    assert len(idx.key_ids) == 2
+
+
+def test_mixed_index_survives_reopen():
+    sm = InMemoryStoreManager()
+    g = open_graph({"schema.default": "auto"}, store_manager=sm)
+    mgmt = g.management()
+    mgmt.make_property_key("t", str)
+    mgmt.build_mixed_index("ti", ["t"], backing="search")
+    tx = g.new_transaction()
+    tx.add_vertex(t="persistent words")
+    tx.commit()
+    g.close()
+    g2 = open_graph({"schema.default": "auto"}, store_manager=sm)
+    tr = g2.traversal()
+    assert len(tr.V().has("t", P.text_contains("persistent")).to_list()) == 1
+    g2.close()
+
+
+def test_mixed_failure_heals_via_recovery():
+    """Injected mixed-index failure -> WAL secondary-failure -> recovery
+    restores the documents from primary storage (reference:
+    StandardTransactionLogProcessor.fixSecondaryFailure)."""
+    sm = InMemoryStoreManager()
+    g = open_graph(
+        {"schema.default": "auto", "tx.log-tx": True}, store_manager=sm
+    )
+    mgmt = g.management()
+    mgmt.make_property_key("note", str)
+    mgmt.build_mixed_index("notes", ["note"], backing="search")
+    tx = g.new_transaction()
+    tx._fail_mixed_for_test = True
+    tx.add_vertex(note="lost then found")
+    tx.commit()
+    tr = g.traversal()
+    assert tr.V().has("note", P.text_contains("lost")).to_list() == []
+    healed = g.start_transaction_recovery().run(max_commit_time_ms=0.0)
+    assert len(healed) >= 1
+    tr = g.traversal()
+    assert len(tr.V().has("note", P.text_contains("lost")).to_list()) == 1
+    g.close()
+
+
+def test_build_mixed_index_validation(graph):
+    mgmt = graph.management()
+    mgmt.make_property_key("x", str)
+    with pytest.raises(SchemaViolationError):
+        mgmt.build_mixed_index("bad", ["x"], backing="nope")
+    with pytest.raises(SchemaViolationError):
+        mgmt.build_mixed_index("bad2", [], backing="search")
+    mgmt.build_mixed_index("ok", ["x"], backing="search")
+    with pytest.raises(SchemaViolationError):
+        mgmt.build_mixed_index("ok", ["x"], backing="search")

@@ -1,0 +1,102 @@
+"""Predicate vocabulary + Geoshape tests (reference behavior:
+attribute/Cmp.java, Text.java, Geo.java, Geoshape.java)."""
+
+import pytest
+
+from janusgraph_tpu.core.predicates import (
+    Cmp,
+    Geo,
+    Geoshape,
+    Text,
+    fuzzy_distance,
+    levenshtein,
+    predicate_by_name,
+    tokenize,
+)
+
+
+def test_tokenize():
+    assert tokenize("Hello, World! foo_bar 42") == ["hello", "world", "foo_bar", "42"]
+
+
+def test_cmp():
+    assert Cmp.EQUAL.evaluate(3, 3)
+    assert not Cmp.EQUAL.evaluate(3, 4)
+    assert Cmp.NOT_EQUAL.evaluate(3, 4)
+    assert Cmp.LESS_THAN.evaluate(2, 3)
+    assert Cmp.GREATER_THAN_EQUAL.evaluate(3, 3)
+    assert not Cmp.GREATER_THAN.evaluate(None, 3)
+
+
+def test_text_contains_family():
+    s = "The quick brown fox jumps"
+    assert Text.CONTAINS.evaluate(s, "quick fox")
+    assert not Text.CONTAINS.evaluate(s, "quick wolf")
+    assert Text.CONTAINS_PREFIX.evaluate(s, "qui")
+    assert not Text.CONTAINS_PREFIX.evaluate(s, "uick")
+    assert Text.CONTAINS_REGEX.evaluate(s, "qu.ck")
+    assert Text.CONTAINS_FUZZY.evaluate(s, "quicc")
+    assert Text.CONTAINS_PHRASE.evaluate(s, "quick brown fox")
+    assert not Text.CONTAINS_PHRASE.evaluate(s, "quick fox brown")
+
+
+def test_text_fullstring_family():
+    assert Text.PREFIX.evaluate("hercules", "herc")
+    assert Text.REGEX.evaluate("hercules", "her.*")
+    assert not Text.REGEX.evaluate("hercules", "her")
+    assert Text.FUZZY.evaluate("hercules", "herculez")
+
+
+def test_fuzzy_distance_auto():
+    assert fuzzy_distance("ab") == 0
+    assert fuzzy_distance("abcd") == 1
+    assert fuzzy_distance("abcdef") == 2
+    assert levenshtein("kitten", "sitting", 3) == 3
+    assert levenshtein("abc", "abc", 2) == 0
+
+
+def test_geoshape_point_circle():
+    athens = Geoshape.point(37.97, 23.72)
+    near = Geoshape.circle(38.0, 23.7, 50)
+    far = Geoshape.circle(52.5, 13.4, 50)
+    assert Geo.WITHIN.evaluate(athens, near)
+    assert not Geo.WITHIN.evaluate(athens, far)
+    assert Geo.INTERSECT.evaluate(athens, near)
+    assert Geo.DISJOINT.evaluate(athens, far)
+    assert Geo.CONTAINS.evaluate(near, athens)
+
+
+def test_geoshape_box_polygon():
+    box = Geoshape.box(37.0, 23.0, 39.0, 25.0)
+    p = Geoshape.point(38.0, 24.0)
+    assert box.contains_point(38.0, 24.0)
+    assert Geo.WITHIN.evaluate(p, box)
+    poly = Geoshape.polygon([(0, 0), (0, 10), (10, 10), (10, 0)])
+    assert poly.contains_point(5, 5)
+    assert not poly.contains_point(11, 5)
+
+
+def test_geoshape_wkt_roundtrip():
+    for shape in (
+        Geoshape.point(37.97, 23.72),
+        Geoshape.circle(38.0, 23.7, 50),
+        Geoshape.polygon([(0, 0), (0, 10), (10, 10)]),
+    ):
+        assert Geoshape.from_wkt(shape.to_wkt()) == shape
+
+
+def test_geoshape_geojson_roundtrip():
+    for shape in (
+        Geoshape.point(37.97, 23.72),
+        Geoshape.circle(38.0, 23.7, 50),
+        Geoshape.box(37.0, 23.0, 39.0, 25.0),
+        Geoshape.polygon([(0, 0), (0, 10), (10, 10)]),
+    ):
+        assert Geoshape.from_geojson(shape.to_geojson()) == shape
+
+
+def test_predicate_registry():
+    assert predicate_by_name("textContains") is Text.CONTAINS
+    assert predicate_by_name("geoWithin") is Geo.WITHIN
+    assert predicate_by_name("eq") is Cmp.EQUAL
+    assert predicate_by_name("nope") is None
