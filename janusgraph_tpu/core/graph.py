@@ -9,9 +9,12 @@ config; idassigner/VertexIDAssigner.java:49 partition placement).
 
 from __future__ import annotations
 
+import logging
 import struct
 import threading
 from typing import Dict, List, Optional
+
+_logger = logging.getLogger(__name__)
 
 from janusgraph_tpu.core.attributes import Serializer
 from janusgraph_tpu.core.codecs import (
@@ -596,6 +599,13 @@ class JanusGraphTPU:
                 index_tx.commit()
             except Exception:
                 secondary_ok = False
+                _logger.error(
+                    "mixed-index persistence failed for a committed "
+                    "transaction%s; primary storage is authoritative — run "
+                    "transaction recovery (WAL on) or reindex to heal",
+                    "" if wal_enabled else " (WAL off: no automatic heal)",
+                    exc_info=True,
+                )
 
         # -- 7. WAL PRIMARY_SUCCESS, then secondary persistence (user log)
         # with its own status marker (reference: :752-813 — secondary
@@ -740,6 +750,8 @@ class JanusGraphTPU:
         for idx in list(self.indexes.values()):
             if idx.mixed:
                 continue  # document updates prepared separately (step 5.5)
+            if idx.status in ("DISABLED", "INSTALLED"):
+                continue  # writes flow only to REGISTERED/ENABLED indexes
             # phase 1: compute every vertex's (before, after) transition so
             # unique checks can see sibling mutations in this same tx —
             # both new claims and releases of previously-owned values
@@ -864,15 +876,19 @@ class JanusGraphTPU:
         mixed = self._mixed_indexes()
         if not mixed:
             return None
-        changed: set = set()
+        # {vid: {touched property key ids}} — the diff only needs to look at
+        # keys the tx actually wrote, not every indexed field
+        touched: Dict[int, set] = {}
         for vid, rels in tx._added.items():
-            if any(isinstance(r, VertexProperty) and not r.is_removed for r in rels):
-                changed.add(vid)
+            for r in rels:
+                if isinstance(r, VertexProperty) and not r.is_removed:
+                    touched.setdefault(vid, set()).add(r.type_id)
         for rel in tx._deleted:
             if isinstance(rel, VertexProperty):
-                changed.add(rel.vertex.id)
-        changed.update(tx._removed_vertices)
-        if not changed:
+                touched.setdefault(rel.vertex.id, set()).add(rel.type_id)
+        for vid in tx._removed_vertices:
+            touched.setdefault(vid, set())
+        if not touched:
             return None
         from janusgraph_tpu.indexing import IndexTransaction
 
@@ -888,7 +904,7 @@ class JanusGraphTPU:
                     provider, self._mixed_key_infos
                 )
             fields = self.mixed_index_fields(idx, register=True)
-            for vid in changed:
+            for vid, touched_kids in touched.items():
                 docid = str(vid)
                 if vid in tx._removed_vertices:
                     itx.delete(idx.name, docid, None, None, delete_all=True)
@@ -897,6 +913,8 @@ class JanusGraphTPU:
                     continue
                 v = tx._vertex_handle(vid)
                 for fname, (kid, _info) in fields.items():
+                    if kid not in touched_kids:
+                        continue
                     before = self._committed_key_values(tx, kid, vid)
                     after = [p.value for p in tx.get_properties(v, fname)]
                     for val in before:

@@ -14,6 +14,8 @@ OLAP reindex jobs.
 from __future__ import annotations
 
 import struct
+import time
+from enum import Enum
 from typing import List, Optional, Sequence, Tuple
 
 from janusgraph_tpu.core.codecs import Cardinality, Direction, Multiplicity
@@ -33,6 +35,18 @@ SCHEMA_NAME_INDEX_PREFIX = b"\x00sn\x00"
 # (reference: buildIndex("name", ...) coexists with PropertyKey "name")
 INDEX_NAME_PREFIX = b"\x00in\x00"
 INDEX_REGISTRY_KEY = b"\x00indexes"
+
+
+class SchemaAction(Enum):
+    """Index lifecycle actions (reference: core/schema/SchemaAction.java:30-51).
+    Transitions: INSTALLED -> REGISTER_INDEX -> REGISTERED -> REINDEX/
+    ENABLE_INDEX -> ENABLED -> DISABLE_INDEX -> DISABLED -> REMOVE_INDEX."""
+
+    REGISTER_INDEX = "REGISTER_INDEX"
+    REINDEX = "REINDEX"
+    ENABLE_INDEX = "ENABLE_INDEX"
+    DISABLE_INDEX = "DISABLE_INDEX"
+    REMOVE_INDEX = "REMOVE_INDEX"
 
 
 class ManagementSystem:
@@ -215,59 +229,124 @@ class ManagementSystem:
         )
         self.graph.update_schema_element(new)
         self.graph.mixed_index_fields(new, register=True)
+        # backfill the new key from existing data, like build_*_index does
+        self.reindex(index_name)
         return new
+
+    # -------------------------------------------------------- index lifecycle
+    _TRANSITIONS = {
+        SchemaAction.REGISTER_INDEX: (("INSTALLED",), "REGISTERED"),
+        SchemaAction.ENABLE_INDEX: (("REGISTERED",), "ENABLED"),
+        SchemaAction.DISABLE_INDEX: (("ENABLED", "REGISTERED"), "DISABLED"),
+    }
+
+    def update_index(self, name: str, action: SchemaAction):
+        """Drive an index through its lifecycle (reference:
+        ManagementSystem.updateIndex — SchemaAction REGISTER/REINDEX/ENABLE/
+        DISABLE/REMOVE; status changes are broadcast so every instance's
+        schema cache refreshes, ManagementLogger.java:287)."""
+        idx = self.graph.indexes.get(name)
+        if idx is None:
+            raise SchemaViolationError(f"unknown index {name}")
+        if action is SchemaAction.REINDEX:
+            # rebuild entries from primary storage, then enable
+            count = self.reindex(name)
+            if idx.status != "ENABLED":
+                self._set_index_status(idx, "ENABLED")
+            return count
+        if action is SchemaAction.REMOVE_INDEX:
+            if idx.status not in ("DISABLED", "INSTALLED"):
+                raise SchemaViolationError(
+                    f"index {name} must be DISABLED before removal "
+                    f"(is {idx.status})"
+                )
+            from janusgraph_tpu.olap.jobs import IndexRemoveJob
+
+            metrics = IndexRemoveJob(self.graph, idx).run()
+            # drop from registry + schema store
+            btx = self.graph.backend.begin_transaction()
+            btx.mutate_index(
+                INDEX_REGISTRY_KEY, [], [struct.pack(">Q", idx.id)]
+            )
+            btx.mutate_index(INDEX_NAME_PREFIX + idx.name.encode(), [],
+                             [struct.pack(">Q", idx.id)])
+            btx.commit()
+            self.graph.indexes = {
+                k: v for k, v in self.graph.indexes.items() if k != name
+            }
+            # forget provider field registrations so a same-name index built
+            # later re-registers with ITS mappings, not the removed one's
+            self.graph._mixed_key_infos.pop(idx.name, None)
+            self.graph.schema_cache.invalidate(idx.name)
+            self.graph.schema_cache.invalidate_id(idx.id)
+            self.graph.management_logger.broadcast_eviction(idx.id)
+            return metrics
+        allowed, target = self._TRANSITIONS[action]
+        if idx.status not in allowed:
+            raise SchemaViolationError(
+                f"cannot {action.value} index {name} in status {idx.status}"
+            )
+        self._set_index_status(idx, target)
+        return self.graph.indexes[name]
+
+    def _set_index_status(self, idx: IndexDefinition, status: str) -> None:
+        new = IndexDefinition(
+            idx.id,
+            idx.name,
+            idx.key_ids,
+            idx.unique,
+            idx.label_constraint,
+            status,
+            idx.mixed,
+            idx.backing,
+            idx.mappings,
+        )
+        self.graph.update_schema_element(new)
+
+    def await_graph_index_status(
+        self, name: str, status: str = "ENABLED", timeout_s: float = 10.0
+    ) -> bool:
+        """Poll until the index reaches `status` (reference:
+        GraphIndexStatusWatcher.java:102 — used after REGISTER/ENABLE to wait
+        for cluster-wide acknowledgement)."""
+        deadline = time.monotonic() + timeout_s
+        while time.monotonic() < deadline:
+            idx = self.graph.indexes.get(name)
+            if idx is not None and idx.status == status:
+                return True
+            if idx is None and status == "REMOVED":
+                return True
+            time.sleep(0.01)
+        idx = self.graph.indexes.get(name)
+        return (idx is not None and idx.status == status) or (
+            idx is None and status == "REMOVED"
+        )
+
+    def ghost_vertex_removal(self, num_workers: int = 1):
+        """Purge half-deleted vertices (reference:
+        GhostVertexRemover.java:44)."""
+        from janusgraph_tpu.olap.jobs import GhostVertexRemover, run_scan_job
+
+        return run_scan_job(
+            self.graph, GhostVertexRemover(self.graph), num_workers
+        )
 
     def reindex(self, name: str) -> int:
         """Rebuild an index from primary storage so data committed before the
-        index existed becomes visible (reference:
-        graphdb/olap/job/IndexRepairJob.java — REINDEX scans every vertex and
-        re-derives index entries; invoked automatically by build_*_index here
-        until the full REGISTER→REINDEX→ENABLE lifecycle, a divergence noted
-        in the class docstring). Returns the number of vertices indexed."""
+        index existed becomes visible. Runs the IndexRepairJob over the
+        partition-parallel scan framework (reference:
+        graphdb/olap/job/IndexRepairJob.java driven by StandardScanner;
+        invoked automatically by build_*_index here -- a convenience
+        divergence from the explicit REGISTER/REINDEX/ENABLE ceremony, which
+        update_index() also supports). Returns rows processed."""
         g = self.graph
         idx = g.indexes.get(name)
         if idx is None:
             raise SchemaViolationError(f"unknown index {name}")
-        tx = g.new_transaction(read_only=True)
-        try:
-            if idx.mixed:
-                from janusgraph_tpu.indexing import IndexEntry
+        from janusgraph_tpu.olap.jobs import IndexRepairJob, run_scan_job
 
-                fields = g.mixed_index_fields(idx, register=True)
-                docs = {}
-                for v in tx.vertices():
-                    if not g._matches_label(tx, idx, v.id):
-                        continue
-                    entries = [
-                        IndexEntry(fname, p.value)
-                        for fname in fields
-                        for p in tx.get_properties(v, fname)
-                    ]
-                    if entries:
-                        docs[str(v.id)] = entries
-                if docs:
-                    g.index_providers[idx.backing].restore(
-                        {idx.name: docs}, g._mixed_key_infos
-                    )
-                return len(docs)
-            btx = g.backend.begin_transaction()
-            count = 0
-            for v in tx.vertices():
-                if not g._matches_label(tx, idx, v.id):
-                    continue
-                values = g._index_values_current(tx, idx, v.id)
-                if values is None:
-                    continue
-                for row, adds, _dels in g.index_serializer.index_updates(
-                    idx, v.id, None, values
-                ):
-                    if adds:
-                        btx.mutate_index(row, adds, [])
-                count += 1
-            btx.commit()
-            return count
-        finally:
-            tx.rollback()
+        metrics = run_scan_job(g, IndexRepairJob(g, idx))
+        return metrics.rows_processed
 
     # ----------------------------------------------------------------- lookups
     def get(self, name: str):

@@ -326,18 +326,7 @@ class Geoshape:
             return Geoshape.circle(lat, lon, g["radius"])
         if t == "Polygon":
             ring = [(la, lo) for lo, la in g["coordinates"][0][:-1]]
-            if len(ring) == 4:
-                lats = sorted(p[0] for p in ring)
-                lons = sorted(p[1] for p in ring)
-                cand = Geoshape.box(lats[0], lons[0], lats[-1], lons[-1])
-                if set(ring) == {
-                    (lats[0], lons[0]),
-                    (lats[0], lons[-1]),
-                    (lats[-1], lons[0]),
-                    (lats[-1], lons[-1]),
-                }:
-                    return cand
-            return Geoshape.polygon(ring)
+            return _ring_to_shape(ring)
         raise ValueError(f"unsupported GeoJSON type {t}")
 
     def to_wkt(self) -> str:
@@ -382,8 +371,25 @@ class Geoshape:
                 pts.append((float(y), float(x)))
             if pts and pts[0] == pts[-1]:
                 pts = pts[:-1]
-            return Geoshape.polygon(pts)
+            return _ring_to_shape(pts)
         raise ValueError(f"unsupported WKT {text!r}")
+
+
+def _ring_to_shape(ring) -> "Geoshape":
+    """Axis-aligned rectangles normalize to Box in BOTH codecs, so shape
+    round-trips are stable (reference: Geoshape GeoJSON reader does the same
+    rectangle→box normalization)."""
+    if len(ring) == 4:
+        lats = sorted(p[0] for p in ring)
+        lons = sorted(p[1] for p in ring)
+        if set(ring) == {
+            (lats[0], lons[0]),
+            (lats[0], lons[-1]),
+            (lats[-1], lons[0]),
+            (lats[-1], lons[-1]),
+        }:
+            return Geoshape.box(lats[0], lons[0], lats[-1], lons[-1])
+    return Geoshape.polygon(ring)
 
 
 class _GeoPredicate(Predicate):

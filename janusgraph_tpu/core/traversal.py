@@ -327,8 +327,8 @@ def _select_mixed_index(graph, has_conditions, label_eq=None):
 def _select_index(graph, eqs: dict, label_eq=None) -> Optional[IndexDefinition]:
     best = None
     for idx in graph.indexes.values():
-        if idx.mixed:
-            continue  # exact-row lookups only; mixed handled separately
+        if idx.mixed or idx.status != "ENABLED":
+            continue  # exact-row lookups on ENABLED composite indexes only
         # a label-constrained index only covers vertices of that label: it is
         # usable only when the query pins the label to exactly that value
         if idx.label_constraint is not None and idx.label_constraint != label_eq:

@@ -112,20 +112,32 @@ class _FieldIndex:
             vals.remove(value)
         except ValueError:
             return
+        remaining = vals
         if not vals:
             del self.values[docid]
+        # postings stay while ANY remaining value of the doc still justifies
+        # them (LIST/SET cardinality, duplicate values)
         if isinstance(value, str):
+            live_tokens = {
+                t
+                for v in remaining
+                if isinstance(v, str)
+                for t in tokenize(v)
+            }
             for tok in tokenize(value):
+                if tok in live_tokens:
+                    continue
                 s = self.inverted.get(tok)
                 if s is not None:
                     s.discard(docid)
                     if not s:
                         del self.inverted[tok]
-        s = self.exact.get(value) if not isinstance(value, Geoshape) else None
-        if s is not None:
-            s.discard(docid)
-            if not s:
-                del self.exact[value]
+        if not isinstance(value, Geoshape) and value not in remaining:
+            s = self.exact.get(value)
+            if s is not None:
+                s.discard(docid)
+                if not s:
+                    del self.exact[value]
         self._sorted = None
 
     def remove_doc(self, docid: str) -> None:
