@@ -166,6 +166,7 @@ class JanusGraphTPU:
             cache_size=cfg.get("cache.db-cache-size"),
             id_block_size=cfg.get("ids.block-size"),
             cache_ttl_seconds=(ttl_ms / 1000.0) if ttl_ms > 0 else None,
+            metrics_enabled=cfg.get("metrics.enabled"),
         )
         self.idm = IDManager(partition_bits=cfg.get("ids.partition-bits"))
         self.edge_serializer = EdgeSerializer(self.serializer, self.idm)

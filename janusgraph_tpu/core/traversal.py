@@ -220,10 +220,13 @@ class _start_vertices:
     def __init__(self, source: GraphTraversalSource, ids):
         self.source = source
         self.ids = ids
+        #: filled at run(): how the start step resolved (for .profile())
+        self.plan: dict = {}
 
     def run(self, has_conditions) -> List[Traverser]:
         tx = self.source.tx
         if self.ids:
+            self.plan = {"access": "ids"}
             out = []
             for i in self.ids:
                 v = tx.get_vertex(i.id if isinstance(i, Vertex) else i)
@@ -243,6 +246,7 @@ class _start_vertices:
                 label_eq = p.eq_value
         idx = _select_index(self.source.graph, eqs, label_eq)
         if idx is not None:
+            self.plan = {"access": "composite-index", "index": idx.name}
             names = [
                 self.source.graph.schema_cache.get_by_id(k).name
                 for k in idx.key_ids
@@ -257,9 +261,15 @@ class _start_vertices:
         hit = _select_mixed_index(self.source.graph, has_conditions, label_eq)
         if hit is not None:
             midx, covered = hit
+            self.plan = {
+                "access": "mixed-index",
+                "index": midx.name,
+                "conditions_pushed": len(covered),
+            }
             vids = self.source.graph.mixed_index_query(tx, midx, covered)
             return _index_hits_with_tx_overlay(tx, vids, has_conditions)
         # full scan (the reference warns here too)
+        self.plan = {"access": "full-scan"}
         return _apply_has([Traverser(v) for v in tx.vertices()], has_conditions, tx)
 
 
@@ -412,8 +422,9 @@ class GraphTraversal:
             self._pre_has.append((key, p))
         else:
             tx = self.tx
-            self._steps.append(
-                lambda ts: [t for t in ts if p.test(_element_value(t, key, tx))]
+            self._add(
+                lambda ts: [t for t in ts if p.test(_element_value(t, key, tx))],
+                name=f"has({key})",
             )
         return self
 
@@ -423,7 +434,10 @@ class GraphTraversal:
         if self._folding:
             self._pre_has.append((None, p))
         else:
-            self._steps.append(lambda ts: [t for t in ts if p.test(_label_of(t.obj))])
+            self._add(
+                lambda ts: [t for t in ts if p.test(_label_of(t.obj))],
+                name="hasLabel",
+            )
         return self
 
     def has_id(self, *ids: int) -> "GraphTraversal":
@@ -435,8 +449,12 @@ class GraphTraversal:
         self._add(lambda ts: [t for t in ts if fn(t.obj)])
         return self
 
-    def _add(self, step) -> None:
+    def _add(self, step, name: Optional[str] = None) -> None:
         self._folding = False
+        # label for .profile(): the public step method that registered it
+        import sys
+
+        step._label = name or sys._getframe(1).f_code.co_name
         self._steps.append(step)
 
     # -- vertex expansion (batched via prefetch) -----------------------------
@@ -476,7 +494,13 @@ class GraphTraversal:
                         out.append(Traverser(e, prev=v))
             return out
 
-        self._add(step)
+        kind = {Direction.OUT: "out", Direction.IN: "in", Direction.BOTH: "both"}[
+            direction
+        ]
+        suffix = ("" if to_vertex else "E") + (
+            f"({','.join(labels)})" if labels else "()"
+        )
+        self._add(step, name=kind + suffix)
         return self
 
     def out_v(self) -> "GraphTraversal":
@@ -638,11 +662,41 @@ class GraphTraversal:
         return dict(Counter(_element_value(t, key, tx) for t in ts))
 
     # -- terminals -----------------------------------------------------------
-    def _execute(self) -> List[Traverser]:
-        ts = self._start.run(self._pre_has)
+    def _execute(self, observe=None) -> List[Traverser]:
+        """One execution path for plain runs and .profile(): `observe` wraps
+        every stage invocation (label, fn, input) -> output."""
+        run = observe if observe is not None else (lambda _label, fn, ts: fn(ts))
+        ts = run("start", lambda _: self._start.run(self._pre_has), None)
         for step in self._steps:
-            ts = step(ts)
+            ts = run(getattr(step, "_label", "step"), step, ts)
         return ts
+
+    def profile(self):
+        """Execute with per-step timing and plan annotations (reference:
+        Gremlin .profile() → QueryProfiler via TP3ProfileWrapper.java;
+        annotations mirror SimpleQueryProfiler's condition/index notes)."""
+        from janusgraph_tpu.core.profile import QueryProfiler, TraversalMetrics
+
+        root = QueryProfiler("traversal")
+
+        def observe(label, fn, ts):
+            p = root.add_nested(label)
+            with p:
+                out = fn(ts)
+            p.annotate("traversers", len(out))
+            if label == "start":
+                if self._pre_has:
+                    p.annotate(
+                        "conditions",
+                        [f"{k or 'label'}:{pr.label}" for k, pr in self._pre_has],
+                    )
+                for k, v in getattr(self._start, "plan", {}).items():
+                    p.annotate(k, v)
+            return out
+
+        with root:
+            ts = self._execute(observe)
+        return TraversalMetrics(root, [t.obj for t in ts])
 
     def to_list(self) -> List[object]:
         return [t.obj for t in self._execute()]

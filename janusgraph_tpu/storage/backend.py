@@ -85,11 +85,21 @@ class Backend:
         cache_size: int = 65536,
         id_block_size: int = 10_000,
         cache_ttl_seconds: Optional[float] = 10.0,
+        metrics_enabled: bool = False,
     ):
         self.manager = manager
+        self.metrics_enabled = metrics_enabled
         self._base_tx = manager.begin_transaction()
         edgestore = manager.open_database(EDGESTORE_NAME)
         indexstore = manager.open_database(INDEXSTORE_NAME)
+        if metrics_enabled:
+            # instrument BEFORE the cache layer so cache hits show up as the
+            # gap between tx-level and store-level counts (reference:
+            # Backend.java:184-188 MetricInstrumentedStore wrapping)
+            from janusgraph_tpu.util.metrics import MetricInstrumentedStore
+
+            edgestore = MetricInstrumentedStore(edgestore)
+            indexstore = MetricInstrumentedStore(indexstore)
         if cache_enabled:
             # 80/20 edge/index cache split like the reference (Backend.java:107);
             # the TTL bounds cross-instance staleness (reference:
@@ -278,7 +288,26 @@ class BackendTransaction:
         try:
             self._check_and_release_locks(commit=True)
             if self._mutations:
-                self.backend.manager.mutate_many(self._mutations, self.store_tx)
+                if self.backend.metrics_enabled:
+                    # batched writes bypass the per-store wrapper, so they
+                    # are counted here (reference: MetricInstrumentedStoreManager
+                    # times mutateMany at the manager level)
+                    from janusgraph_tpu.util.metrics import metrics as _m
+
+                    with _m.time("storage.mutateMany"):
+                        self.backend.manager.mutate_many(
+                            self._mutations, self.store_tx
+                        )
+                    for store_name, rows in self._mutations.items():
+                        # '.rows' suffix: distinct from the per-call 'mutate'
+                        # timer namespace of MetricInstrumentedStore
+                        _m.counter(f"storage.{store_name}.mutate.rows").inc(
+                            len(rows)
+                        )
+                else:
+                    self.backend.manager.mutate_many(
+                        self._mutations, self.store_tx
+                    )
                 # cache invalidation for mutated rows
                 for store_name, rows in self._mutations.items():
                     store = (

@@ -1,0 +1,7 @@
+from janusgraph_tpu.util.metrics import (
+    MetricInstrumentedStore,
+    MetricManager,
+    metrics,
+)
+
+__all__ = ["MetricInstrumentedStore", "MetricManager", "metrics"]
