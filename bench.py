@@ -19,7 +19,8 @@ scan-per-superstep JVM engine would be), making the reported ratio
 conservative.
 
 Env knobs: BENCH_SCALE (default 22; graph500-s23 = BENCH_SCALE=23),
-BENCH_EDGE_FACTOR (16), PR_ITERS (20).
+BENCH_EDGE_FACTOR (16), PR_ITERS (20), BENCH_STRATEGY
+(auto|ell|segment|pallas — aggregation kernel, see olap/kernels.py).
 """
 
 import json
@@ -68,14 +69,14 @@ def main() -> None:
     csr = rmat_csr(scale, edge_factor)
     gen_s = time.perf_counter() - t0
 
-    ex = TPUExecutor(csr)
+    strategy = os.environ.get("BENCH_STRATEGY", "auto")
+    ex = TPUExecutor(csr, strategy=strategy)
 
-    # --- PageRank: compile once (1 superstep), then time pr_iters supersteps
-    # sync_every=pr_iters: the whole run is one async pipeline of supersteps
-    # with a single host sync at the end (true device throughput)
-    warm = PageRankProgram(max_iterations=1, tol=0.0)
-    ex.run(warm)
+    # --- PageRank: the whole pr_iters-superstep run is ONE fused dispatch
+    # (lax.while_loop on device). Warm run compiles; timed run re-executes
+    # the cached executable (identical program params = identical cache key).
     timed = PageRankProgram(max_iterations=pr_iters, tol=0.0)
+    ex.run(timed)
     t0 = time.perf_counter()
     result = ex.run(timed, sync_every=pr_iters)
     jax.block_until_ready(result["rank"])
@@ -83,11 +84,10 @@ def main() -> None:
     pr_eps = pr_iters * csr.num_edges / pr_s
 
     # --- 4-hop BFS (BSP frontier expansion), timed post-compile
-    ex.run(ShortestPathProgram(seed_index=0, max_iterations=1))
+    bfs_prog = ShortestPathProgram(seed_index=0, max_iterations=4)
+    ex.run(bfs_prog)
     t0 = time.perf_counter()
-    bfs_res = ex.run(
-        ShortestPathProgram(seed_index=0, max_iterations=4), sync_every=4
-    )
+    bfs_res = ex.run(bfs_prog, sync_every=4)
     jax.block_until_ready(bfs_res["distance"])
     bfs_s = time.perf_counter() - t0
 
@@ -104,6 +104,7 @@ def main() -> None:
                 "vs_baseline": round(pr_eps / base_eps, 3),
                 "baseline": "numpy-host-pagerank (proxy; see bench.py docstring)",
                 "platform": platform,
+                "strategy": ex.strategy,
                 "scale": scale,
                 "edge_factor": edge_factor,
                 "num_vertices": csr.num_vertices,

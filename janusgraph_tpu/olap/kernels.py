@@ -1,0 +1,305 @@
+"""TPU aggregation kernels for the BSP superstep.
+
+The superstep's hot op is `combine({msg(src) for (src,dst) edges}) by dst` —
+the reference runs it as NonBlockingHashMapLong insert-with-combiner per
+message (reference: FulgoraVertexMemory.java:91-99); the straightforward XLA
+translation is gather + `segment_sum`, whose scatter-add lowering serializes
+poorly on TPU. Two TPU-native alternatives here:
+
+1. **Degree-bucketed ELL** (`ELLPack` / `ell_aggregate`): in-edges are packed
+   per destination into power-of-two-capacity row buckets (ELLPACK layout).
+   Aggregation becomes gather + dense axis-1 reduction — no scatter at all,
+   every monoid (sum/min/max) supported, padding overhead < 2× by the
+   power-of-two bucketing. This is the default device strategy.
+
+2. **Pallas sorted-segment-sum** (`pallas_sorted_segment_sum`): edges are
+   already destination-sorted (CSR); host-side alignment pads each output
+   tile's edge range to whole blocks, so each edge block accumulates into
+   exactly one output tile. The kernel one-hot-expands local segment ids and
+   reduces on the MXU/VPU, revisiting the same output block across grid
+   steps (zeroed on first touch). SUM monoid; used for PageRank-shaped
+   programs.
+
+Both are built once per (graph, orientation) and reused across supersteps.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+from janusgraph_tpu.olap.vertex_program import Combiner, EdgeTransform
+
+
+# --------------------------------------------------------------------------
+# Degree-bucketed ELL packing
+# --------------------------------------------------------------------------
+
+class ELLPack:
+    """Host-side ELLPACK layout of an edge list grouped by destination.
+
+    For each power-of-two capacity bucket c: the destinations whose in-degree
+    d satisfies prev_c < d <= c, with a (n_c, c) matrix of source indices
+    (padded with a sentinel slot) and a (n_c, c) weight/validity matrix.
+
+    `sentinel` is index `n` — callers extend the per-vertex message vector by
+    one identity element so padded slots read the monoid identity.
+    """
+
+    def __init__(
+        self,
+        src: np.ndarray,
+        dst: np.ndarray,
+        weight: Optional[np.ndarray],
+        num_vertices: int,
+        max_capacity: int = 1 << 14,
+    ):
+        n = num_vertices
+        self.num_vertices = n
+        self.sentinel = n
+        self.has_weight = weight is not None
+        order = np.argsort(dst, kind="stable")
+        src = np.asarray(src, dtype=np.int64)[order]
+        dst = np.asarray(dst, dtype=np.int64)[order]
+        w = (
+            np.asarray(weight, dtype=np.float32)[order]
+            if weight is not None
+            else None
+        )
+        deg = np.bincount(dst, minlength=n).astype(np.int64)
+        indptr = np.zeros(n + 1, dtype=np.int64)
+        np.cumsum(deg, out=indptr[1:])
+
+        # bucket capacity per vertex: next power of two >= degree (min 1);
+        # degrees beyond max_capacity clamp into one jumbo bucket padded to
+        # the true max degree (supernodes: SURVEY.md §5.7)
+        caps = np.maximum(1, 1 << np.ceil(np.log2(np.maximum(deg, 1))).astype(np.int64))
+        caps = np.minimum(caps, max_capacity)
+        max_deg = int(deg.max()) if n else 0
+        if max_deg > max_capacity:
+            caps[deg > max_capacity] = max_deg
+
+        self.buckets: List[Tuple[np.ndarray, np.ndarray, np.ndarray]] = []
+        self.vertex_order_parts: List[np.ndarray] = []
+        for c in sorted(set(int(c) for c in np.unique(caps))):
+            members = np.nonzero(caps == c)[0]
+            if len(members) == 0:
+                continue
+            idx = np.full((len(members), c), self.sentinel, dtype=np.int64)
+            wmat = np.zeros((len(members), c), dtype=np.float32)
+            valid = np.zeros((len(members), c), dtype=np.float32)
+            # vectorized fill: flatten each member's edge range
+            deg_m = deg[members]
+            total = int(deg_m.sum())
+            if total:
+                row_ids = np.repeat(np.arange(len(members)), deg_m)
+                col_ids = np.arange(total) - np.repeat(
+                    np.cumsum(deg_m) - deg_m, deg_m
+                )
+                edge_pos = np.repeat(indptr[members], deg_m) + col_ids
+                idx[row_ids, col_ids] = src[edge_pos]
+                valid[row_ids, col_ids] = 1.0
+                wmat[row_ids, col_ids] = w[edge_pos] if w is not None else 1.0
+            self.buckets.append((idx.astype(np.int32), wmat, valid))
+            self.vertex_order_parts.append(members)
+
+        vertex_order = (
+            np.concatenate(self.vertex_order_parts)
+            if self.vertex_order_parts
+            else np.zeros(0, dtype=np.int64)
+        )
+        # inverse permutation: position of vertex i in the bucketed output
+        pos = np.zeros(n, dtype=np.int64)
+        pos[vertex_order] = np.arange(len(vertex_order), dtype=np.int64)
+        self.unpermute = pos.astype(np.int32)
+
+    def device_put(self, jnp, sharding=None):
+        """Move index/weight matrices to device once (optionally sharded)."""
+        put = (lambda a: a) if sharding is None else (
+            lambda a: __import__("jax").device_put(a, sharding)
+        )
+        self.buckets = [
+            (put(jnp.asarray(i)), put(jnp.asarray(w)), put(jnp.asarray(v)))
+            for (i, w, v) in self.buckets
+        ]
+        self.unpermute = put(jnp.asarray(self.unpermute))
+        return self
+
+
+def ell_aggregate(
+    jnp,
+    pack: ELLPack,
+    msgs,
+    op: str,
+    edge_transform: str = EdgeTransform.NONE,
+):
+    """Aggregate per-vertex messages over an ELLPack.
+
+    msgs: (n,) or (n, k) per-source message array. Returns (n,) / (n, k)
+    aggregated-by-destination, monoid identity where a vertex has no edges.
+    """
+    identity = Combiner.IDENTITY[op]
+    if not pack.has_weight:
+        # mirror the segment path: transforms only apply when weights exist
+        edge_transform = EdgeTransform.NONE
+    # sentinel slot so padded indices read the identity
+    pad_shape = (1,) + tuple(msgs.shape[1:])
+    msgs_ext = jnp.concatenate(
+        [msgs, jnp.full(pad_shape, identity, dtype=msgs.dtype)], axis=0
+    )
+    parts = []
+    for idx, w, valid in pack.buckets:
+        m = msgs_ext[idx]  # (n_c, c) or (n_c, c, k)
+        if m.ndim == 3:
+            w_ = w[:, :, None]
+            valid_ = valid[:, :, None]
+        else:
+            w_, valid_ = w, valid
+        if edge_transform == EdgeTransform.MUL_WEIGHT:
+            m = m * w_
+        elif edge_transform == EdgeTransform.ADD_WEIGHT:
+            m = m + w_
+        m = jnp.where(valid_ > 0, m, identity)
+        if op == Combiner.SUM:
+            parts.append(m.sum(axis=1))
+        elif op == Combiner.MIN:
+            parts.append(m.min(axis=1))
+        else:
+            parts.append(m.max(axis=1))
+    if not parts:
+        out_shape = msgs.shape
+        return jnp.full(out_shape, identity, dtype=msgs.dtype)
+    stacked = jnp.concatenate(parts, axis=0)
+    return stacked[pack.unpermute]
+
+
+# --------------------------------------------------------------------------
+# Pallas sorted-segment-sum
+# --------------------------------------------------------------------------
+
+class _SegSumPlan:
+    """Static host-side plan: tile-aligned edge blocks for the kernel.
+
+    Edges (sorted by destination segment) are re-laid-out so each output
+    tile's edge range occupies whole blocks; a block therefore writes into
+    exactly one output tile, enabling the revisit-accumulate output pattern.
+    """
+
+    def __init__(
+        self,
+        seg: np.ndarray,
+        num_segments: int,
+        block: int = 1024,
+        tile: int = 1024,
+    ):
+        self.block = block
+        self.tile = tile
+        self.num_segments = num_segments
+        self.padded_segments = -(-max(num_segments, 1) // tile) * tile
+        num_tiles = self.padded_segments // tile
+
+        seg = np.asarray(seg, dtype=np.int64)
+        m = len(seg)
+        # edges per output tile (seg already sorted ascending)
+        tile_of = seg // tile
+        counts = np.bincount(tile_of, minlength=num_tiles)
+        blocks_per_tile = np.maximum(1, -(-counts // block))
+        total_blocks = int(blocks_per_tile.sum())
+        padded_m = total_blocks * block
+
+        gather_idx = np.zeros(padded_m, dtype=np.int32)
+        pad_mask = np.zeros(padded_m, dtype=np.float32)
+        seg_local = np.zeros(padded_m, dtype=np.int32)
+        out_tile = np.zeros(total_blocks, dtype=np.int32)
+        is_first = np.zeros(total_blocks, dtype=np.int32)
+
+        edge_starts = np.zeros(num_tiles + 1, dtype=np.int64)
+        np.cumsum(counts, out=edge_starts[1:])
+        b = 0
+        w = 0
+        for t in range(num_tiles):
+            lo, hi = edge_starts[t], edge_starts[t + 1]
+            k = hi - lo
+            gather_idx[w : w + k] = np.arange(lo, hi, dtype=np.int32)
+            pad_mask[w : w + k] = 1.0
+            seg_local[w : w + k] = (seg[lo:hi] - t * tile).astype(np.int32)
+            nb = int(blocks_per_tile[t])
+            out_tile[b : b + nb] = t
+            is_first[b] = 1
+            b += nb
+            w += nb * block
+        self.gather_idx = gather_idx
+        self.pad_mask = pad_mask
+        self.seg_local = seg_local
+        self.out_tile = out_tile
+        self.is_first = is_first
+        self.num_blocks = total_blocks
+
+
+def make_segsum_plan(
+    seg: np.ndarray, num_segments: int, block: int = 1024, tile: int = 1024
+) -> _SegSumPlan:
+    return _SegSumPlan(seg, num_segments, block=block, tile=tile)
+
+
+def pallas_sorted_segment_sum(
+    data,
+    plan: _SegSumPlan,
+    interpret: bool = False,
+):
+    """Segment-sum of `data` (per-edge values, original edge order) using a
+    Pallas TPU kernel over the precomputed tile-aligned plan.
+
+    Returns (num_segments,) float32 sums.
+    """
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    B, T = plan.block, plan.tile
+
+    # align + pad on device (monotone gather, cheap)
+    gidx = jnp.asarray(plan.gather_idx)
+    mask = jnp.asarray(plan.pad_mask)
+    segl = jnp.asarray(plan.seg_local)
+    data_p = data[gidx] * mask
+
+    def kernel(out_tile_ref, is_first_ref, data_ref, seg_ref, out_ref):
+        b = pl.program_id(0)
+
+        @pl.when(is_first_ref[b] == 1)
+        def _():
+            out_ref[:] = jnp.zeros_like(out_ref)
+
+        seg_block = seg_ref[:]                      # (B,)
+        d = data_ref[:]                             # (B,)
+        cols = jax.lax.broadcasted_iota(jnp.int32, (B, T), 1)
+        onehot = (seg_block[:, None] == cols).astype(jnp.float32)
+        partial = jnp.dot(
+            d.reshape(1, B), onehot, preferred_element_type=jnp.float32
+        ).reshape(T)
+        out_ref[:] = out_ref[:] + partial
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(plan.num_blocks,),
+        in_specs=[
+            pl.BlockSpec((B,), lambda b, ot, fi: (b,)),
+            pl.BlockSpec((B,), lambda b, ot, fi: (b,)),
+        ],
+        out_specs=pl.BlockSpec((T,), lambda b, ot, fi: (ot[b],)),
+    )
+    out = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((plan.padded_segments,), jnp.float32),
+        interpret=interpret,
+    )(
+        jnp.asarray(plan.out_tile),
+        jnp.asarray(plan.is_first),
+        data_p.astype(jnp.float32),
+        segl,
+    )
+    return out[: plan.num_segments]

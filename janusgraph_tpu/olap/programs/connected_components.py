@@ -39,3 +39,6 @@ class ConnectedComponentsProgram(VertexProgram):
 
     def terminate(self, memory):
         return memory.get("changed", 1.0) == 0.0
+
+    def terminate_device(self, values, steps_done, xp):
+        return values["changed"] == 0.0

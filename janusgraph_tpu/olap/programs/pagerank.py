@@ -51,3 +51,6 @@ class PageRankProgram(VertexProgram):
 
     def terminate(self, memory):
         return memory.superstep > 1 and memory.get("delta", 1.0) < self.tol
+
+    def terminate_device(self, values, steps_done, xp):
+        return xp.logical_and(steps_done > 1, values["delta"] < self.tol)

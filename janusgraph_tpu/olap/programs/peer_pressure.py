@@ -103,3 +103,9 @@ class PeerPressureProgram(VertexProgram):
         return memory.superstep % 2 == 0 and memory.superstep > 1 and memory.get(
             "changed", 1.0
         ) == 0.0
+
+    def terminate_device(self, values, steps_done, xp):
+        return xp.logical_and(
+            xp.logical_and(steps_done % 2 == 0, steps_done > 1),
+            values["changed"] == 0.0,
+        )

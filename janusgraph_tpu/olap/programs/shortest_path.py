@@ -21,6 +21,7 @@ INF = 1e18
 class ShortestPathProgram(VertexProgram):
     compute_keys = ("distance",)
     combiner = Combiner.MIN
+    setup_only_params = ("seed_index",)
 
     def __init__(
         self,
@@ -55,3 +56,6 @@ class ShortestPathProgram(VertexProgram):
 
     def terminate(self, memory):
         return memory.get("changed", 1.0) == 0.0
+
+    def terminate_device(self, values, steps_done, xp):
+        return values["changed"] == 0.0

@@ -38,3 +38,6 @@ class TraversalCountProgram(VertexProgram):
 
     def terminate(self, memory):
         return memory.superstep >= self.hops
+
+    def terminate_device(self, values, steps_done, xp):
+        return steps_done >= self.hops

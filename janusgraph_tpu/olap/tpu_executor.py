@@ -74,9 +74,17 @@ def _segment_reduce(jnp, op: str, data, segment_ids, num_segments: int):
 
 class TPUExecutor:
     """Single-device executor. The sharded (mesh) executor lives in
-    janusgraph_tpu/parallel/."""
+    janusgraph_tpu/parallel/.
 
-    def __init__(self, csr: CSRGraph, use_pallas: bool = False):
+    `strategy` selects the aggregation kernel (janusgraph_tpu/olap/kernels.py):
+      - "ell"     degree-bucketed ELLPACK gather + dense reduce (default;
+                  scatter-free, all monoids)
+      - "segment" XLA gather + segment-reduce
+      - "pallas"  Pallas sorted-segment-sum kernel (SUM monoid; other
+                  monoids fall back to "ell")
+    """
+
+    def __init__(self, csr: CSRGraph, use_pallas: bool = False, strategy: str = "auto"):
         import jax
         import jax.numpy as jnp
 
@@ -84,20 +92,74 @@ class TPUExecutor:
         self.jnp = jnp
         self.csr = csr
         self.g = _DeviceGraph(csr, jnp)
-        self.use_pallas = use_pallas
+        if strategy == "auto":
+            strategy = "pallas" if use_pallas else "ell"
+        if strategy not in ("ell", "segment", "pallas"):
+            raise ValueError(f"unknown aggregation strategy: {strategy!r}")
+        self.strategy = strategy
+        # Pallas kernels interpret on CPU/virtual devices, compile on real
+        # TPU (platform may be a tunneled plugin name like "axon" whose
+        # device_kind still identifies the TPU generation)
+        dev = jax.devices()[0]
+        self._interpret = not (
+            dev.platform == "tpu" or "tpu" in dev.device_kind.lower()
+        )
         self._compiled: Dict[str, object] = {}
+        self._ell_packs: Dict[bool, object] = {}
+        self._segsum_plans: Dict[str, object] = {}
+
+    def _ell_pack(self, undirected: bool):
+        from janusgraph_tpu.olap.kernels import ELLPack
+
+        pack = self._ell_packs.get(undirected)
+        if pack is None:
+            csr = self.csr
+            src = csr.in_src.astype(np.int64)
+            dst = _segment_ids(csr.in_indptr, csr.num_edges).astype(np.int64)
+            w = csr.in_edge_weight
+            if undirected:
+                rsrc = csr.out_dst.astype(np.int64)
+                rdst = _segment_ids(csr.out_indptr, csr.num_edges).astype(np.int64)
+                rw = csr.out_edge_weight
+                src = np.concatenate([src, rsrc])
+                dst = np.concatenate([dst, rdst])
+                w = np.concatenate([w, rw]) if w is not None else None
+            pack = ELLPack(src, dst, w, csr.num_vertices)
+            pack.device_put(self.jnp)
+            self._ell_packs[undirected] = pack
+        return pack
+
+    def _segsum_plan(self, orientation: str):
+        from janusgraph_tpu.olap.kernels import make_segsum_plan
+
+        plan = self._segsum_plans.get(orientation)
+        if plan is None:
+            csr = self.csr
+            if orientation == "in":
+                seg = _segment_ids(csr.in_indptr, csr.num_edges)
+            else:
+                seg = _segment_ids(csr.out_indptr, csr.num_edges)
+            plan = make_segsum_plan(seg, csr.num_vertices)
+            self._segsum_plans[orientation] = plan
+        return plan
 
     # ------------------------------------------------------------ superstep
-    def _superstep_fn(self, program: VertexProgram, op: str):
-        """Build (and cache) the jitted superstep for one combiner monoid."""
-        key = op
-        if key in self._compiled:
-            return self._compiled[key]
+    def _superstep_body(self, program: VertexProgram, op: str):
+        """Build the (un-jitted) superstep function for one combiner monoid."""
 
         jnp = self.jnp
         g = self.g
         n = g.local_num_vertices
         identity = Combiner.IDENTITY[op]
+        strategy = self.strategy
+        if strategy == "pallas" and op != Combiner.SUM:
+            strategy = "ell"  # kernel is SUM-monoid; ELL covers the rest
+        if strategy == "ell":
+            pack = self._ell_pack(program.undirected)
+        elif strategy == "pallas":
+            plans = [( "in", self._segsum_plan("in"))]
+            if program.undirected:
+                plans.append(("out", self._segsum_plan("out")))
 
         def aggregate(outgoing, src_idx, dst_seg, weight):
             msgs = outgoing[src_idx]
@@ -107,19 +169,50 @@ class TPUExecutor:
                 msgs = msgs + (weight[:, None] if msgs.ndim == 2 else weight)
             return _segment_reduce(jnp, op, msgs, dst_seg, n)
 
+        def pallas_aggregate(outgoing):
+            from janusgraph_tpu.olap.kernels import pallas_sorted_segment_sum
+
+            def one(orientation, plan):
+                if orientation == "in":
+                    src_idx, weight = g.in_src, g.in_edge_weight
+                else:
+                    src_idx, weight = g.out_dst, g.out_edge_weight
+                msgs = outgoing[src_idx]
+                if program.edge_transform == EdgeTransform.MUL_WEIGHT and weight is not None:
+                    msgs = msgs * weight
+                elif program.edge_transform == EdgeTransform.ADD_WEIGHT and weight is not None:
+                    msgs = msgs + weight
+                return pallas_sorted_segment_sum(
+                    msgs, plan, interpret=self._interpret
+                )
+
+            total = one(*plans[0])
+            for orientation, plan in plans[1:]:
+                total = total + one(orientation, plan)
+            return total
+
         def superstep(state, superstep_idx, memory_in):
             outgoing = program.message(state, superstep_idx, g, jnp)
-            agg = aggregate(outgoing, g.in_src, g.in_dst_seg, g.in_edge_weight)
-            if program.undirected:
-                rev = aggregate(
-                    outgoing, g.out_dst, g.out_src_seg, g.out_edge_weight
+            from janusgraph_tpu.olap.kernels import ell_aggregate
+
+            if strategy == "ell":
+                agg = ell_aggregate(
+                    jnp, pack, outgoing, op, program.edge_transform
                 )
-                if op == Combiner.SUM:
-                    agg = agg + rev
-                elif op == Combiner.MIN:
-                    agg = jnp.minimum(agg, rev)
-                else:
-                    agg = jnp.maximum(agg, rev)
+            elif strategy == "pallas" and outgoing.ndim == 1:
+                agg = pallas_aggregate(outgoing)
+            else:
+                agg = aggregate(outgoing, g.in_src, g.in_dst_seg, g.in_edge_weight)
+                if program.undirected:
+                    rev = aggregate(
+                        outgoing, g.out_dst, g.out_src_seg, g.out_edge_weight
+                    )
+                    if op == Combiner.SUM:
+                        agg = agg + rev
+                    elif op == Combiner.MIN:
+                        agg = jnp.minimum(agg, rev)
+                    else:
+                        agg = jnp.maximum(agg, rev)
             # vertices with no in-edges hold the identity, matching the CPU
             # oracle's "no message received" semantics
             new_state, metrics = program.apply(
@@ -127,24 +220,87 @@ class TPUExecutor:
             )
             return new_state, {k: v for k, (_o, v) in metrics.items()}
 
-        fn = self.jax.jit(superstep)
+        return superstep
+
+    def _superstep_fn(self, program: VertexProgram, op: str):
+        """Jitted single superstep (host-loop path)."""
+        key = ("step", program.cache_key(), op, self.strategy)
+        if key not in self._compiled:
+            self._compiled[key] = self.jax.jit(
+                self._superstep_body(program, op)
+            )
+        return self._compiled[key]
+
+    def _fused_fn(self, program: VertexProgram, op: str):
+        """The ENTIRE BSP run as one compiled dispatch: superstep 0 unrolled
+        (to establish the aggregator pytree), then a lax.while_loop over
+        supersteps with `terminate_device` as the on-device stop condition.
+        No per-superstep host round trips at all — essential when the chip
+        sits behind a high-latency PJRT link, and idiomatic XLA regardless
+        (compiler-visible control flow instead of a host loop)."""
+        key = ("fused", program.cache_key(), op, self.strategy)
+        if key in self._compiled:
+            return self._compiled[key]
+
+        jax, jnp = self.jax, self.jnp
+        body = self._superstep_body(program, op)
+        max_iter = program.max_iterations
+
+        def whole_run(state, mem0):
+            state, mem = body(state, jnp.asarray(0, jnp.int32), mem0)
+
+            def cond(carry):
+                _s, m, steps_done = carry
+                return jnp.logical_and(
+                    steps_done < max_iter,
+                    jnp.logical_not(
+                        program.terminate_device(m, steps_done, jnp)
+                    ),
+                )
+
+            def loop(carry):
+                s, m, steps_done = carry
+                s2, m2 = body(s, steps_done, m)
+                return (s2, m2, steps_done + 1)
+
+            return jax.lax.while_loop(
+                cond, loop, (state, mem, jnp.asarray(1, jnp.int32))
+            )
+
+        fn = jax.jit(whole_run)
         self._compiled[key] = fn
         return fn
 
     # ------------------------------------------------------------------ run
-    def run(self, program: VertexProgram, sync_every: int = 1) -> Dict[str, np.ndarray]:
+    def run(
+        self,
+        program: VertexProgram,
+        sync_every: int = 1,
+        fused: bool = None,
+    ) -> Dict[str, np.ndarray]:
         """Run to termination.
 
-        `sync_every`: how often (in supersteps) the host fetches the global
-        aggregators to evaluate `terminate`. Between syncs everything —
-        state, aggregators, the superstep counter — stays on device and the
-        host just enqueues work, so per-step host<->device latency (which
-        can be tens of ms through a tunneled PJRT link) is amortized.
-        Programs may run up to sync_every-1 supersteps past their stop
-        condition; supersteps are idempotent at fixpoint for all monoid
-        programs, so results are unchanged.
+        `fused` (default: auto) — compile the whole iteration into one
+        dispatch (single-monoid programs). Phase-alternating programs fall
+        back to the host loop, where `sync_every` controls how often the
+        host fetches the global aggregators to evaluate `terminate`;
+        between syncs everything stays on device and the host just enqueues
+        work, amortizing per-step link latency.
         """
         jnp = self.jnp
+        if fused is None:
+            fused = program.fused_eligible()
+        if fused and type(program).combiner_for is VertexProgram.combiner_for:
+            op = program.combiner
+            state, init_metrics = program.setup(self.g, jnp)
+            state = {k: jnp.asarray(v) for k, v in state.items()}
+            mem0 = {
+                k: jnp.asarray(v, dtype=jnp.float32)
+                for k, (_o, v) in init_metrics.items()
+            }
+            fn = self._fused_fn(program, op)
+            state, _mem, _steps = fn(state, mem0)
+            return {k: np.asarray(v) for k, v in state.items()}
         memory = Memory()
         state, init_metrics = program.setup(self.g, jnp)
         memory.reduce_in(init_metrics)

@@ -116,3 +116,39 @@ class VertexProgram:
 
     def terminate(self, memory: Memory) -> bool:
         raise NotImplementedError
+
+    def terminate_device(self, values: Dict[str, object], steps_done, xp):
+        """Traceable termination predicate for the fused on-device run loop
+        (the whole BSP iteration compiles into ONE lax.while_loop dispatch;
+        host-loop executors use `terminate` instead). `values` are the
+        barrier-reduced aggregators, `steps_done` a traced step count.
+        Default: rely on the loop's max_iterations bound only."""
+        return xp.asarray(False)
+
+    #: parameters consumed only by setup() (host-side initial state), not
+    #: baked into the traced superstep — excluded from cache_key so varying
+    #: them (e.g. BFS seeds) reuses the compiled executable
+    setup_only_params: Tuple[str, ...] = ()
+
+    def cache_key(self) -> Tuple:
+        """Identity of this program's compiled computation (parameters that
+        are baked into the traced superstep)."""
+        return (
+            type(self).__module__,
+            type(self).__qualname__,
+            tuple(sorted(
+                (k, v) for k, v in self.__dict__.items()
+                if isinstance(v, (int, float, bool, str, tuple))
+                and k not in self.setup_only_params
+            )),
+        )
+
+    def fused_eligible(self) -> bool:
+        """Whether run() may compile the whole iteration into one on-device
+        while_loop: requires a constant combiner monoid AND an overridden
+        terminate_device (the default never stops early, which would change
+        semantics for programs relying on host terminate())."""
+        return (
+            type(self).combiner_for is VertexProgram.combiner_for
+            and type(self).terminate_device is not VertexProgram.terminate_device
+        )

@@ -175,15 +175,10 @@ class ShardedExecutor:
             self._sharded_cache[undirected] = sc
         return sc
 
-    def _superstep_fn(self, program: VertexProgram, op: str, sc: ShardedCSR):
-        key = (op, program.undirected)
-        if key in self._compiled:
-            return self._compiled[key]
-
+    def _shard_body(self, program: VertexProgram, op: str, sc: ShardedCSR):
+        """The per-shard superstep body (traced inside shard_map)."""
         import jax
         import jax.numpy as jnp
-        from jax import shard_map
-        from jax.sharding import PartitionSpec as P
 
         axis = self.axis
         Np = sc.shard_size
@@ -236,8 +231,23 @@ class ShardedExecutor:
                     reduced[k] = jax.lax.pmax(v, axis)
             return new_state, reduced
 
-        sharded_spec = P(axis)
-        rep = P()
+        return body
+
+    def _specs(self):
+        from jax.sharding import PartitionSpec as P
+
+        return P(self.axis), P()
+
+    def _superstep_fn(self, program: VertexProgram, op: str, sc: ShardedCSR):
+        key = ("step", program.cache_key(), op)
+        if key in self._compiled:
+            return self._compiled[key]
+
+        import jax
+        from jax import shard_map
+
+        body = self._shard_body(program, op, sc)
+        sharded_spec, rep = self._specs()
         fn = shard_map(
             body,
             mesh=self.mesh,
@@ -259,9 +269,70 @@ class ShardedExecutor:
         self._compiled[key] = fn
         return fn
 
-    def run(self, program: VertexProgram, sync_every: int = 1) -> Dict[str, np.ndarray]:
-        """Run to termination. See TPUExecutor.run for `sync_every` — between
-        host syncs the state, aggregators and step counter stay on device."""
+    def _fused_fn(self, program: VertexProgram, op: str, sc: ShardedCSR):
+        """Whole BSP run as ONE dispatch: lax.while_loop inside shard_map,
+        collectives (all_gather exchange + psum barrier) in the loop body,
+        `terminate_device` on the replicated aggregators as the on-device
+        stop condition. See TPUExecutor._fused_fn."""
+        key = ("fused", program.cache_key(), op)
+        if key in self._compiled:
+            return self._compiled[key]
+
+        import jax
+        import jax.numpy as jnp
+        from jax import shard_map
+
+        body = self._shard_body(program, op, sc)
+        max_iter = program.max_iterations
+
+        def whole_run(state, mem0, out_degree, active, src_glob, dst_loc, valid, weight):
+            args = (out_degree, active, src_glob, dst_loc, valid, weight)
+            state, mem = body(state, jnp.asarray(0, jnp.int32), mem0, *args)
+
+            def cond(carry):
+                _s, m, steps_done = carry
+                return jnp.logical_and(
+                    steps_done < max_iter,
+                    jnp.logical_not(
+                        program.terminate_device(m, steps_done, jnp)
+                    ),
+                )
+
+            def loop(carry):
+                s, m, steps_done = carry
+                s2, m2 = body(s, steps_done, m, *args)
+                return (s2, m2, steps_done + 1)
+
+            return jax.lax.while_loop(
+                cond, loop, (state, mem, jnp.asarray(1, jnp.int32))
+            )
+
+        sharded_spec, rep = self._specs()
+        fn = shard_map(
+            whole_run,
+            mesh=self.mesh,
+            in_specs=(
+                sharded_spec, rep,
+                sharded_spec, sharded_spec, sharded_spec,
+                sharded_spec, sharded_spec, sharded_spec,
+            ),
+            out_specs=(sharded_spec, rep, rep),
+            check_vma=False,
+        )
+        fn = jax.jit(fn)
+        self._compiled[key] = fn
+        return fn
+
+    def run(
+        self,
+        program: VertexProgram,
+        sync_every: int = 1,
+        fused: bool = None,
+    ) -> Dict[str, np.ndarray]:
+        """Run to termination. `fused` (default auto): single-monoid programs
+        compile the whole run into one dispatch (while_loop inside
+        shard_map); otherwise a host loop with `sync_every`-amortized
+        aggregator fetches (see TPUExecutor.run)."""
         import jax.numpy as jnp
 
         sc = self._sharded(program.undirected)
@@ -273,6 +344,22 @@ class ShardedExecutor:
         device_memory = {
             k: jnp.asarray(v, dtype=jnp.float32) for k, v in memory.values.items()
         }
+
+        if fused is None:
+            fused = program.fused_eligible()
+        if fused and type(program).combiner_for is VertexProgram.combiner_for:
+            fn = self._fused_fn(program, program.combiner, sc)
+            state, _mem, _steps = fn(
+                state,
+                device_memory,
+                sc.out_degree,
+                sc.active,
+                sc.in_src_glob,
+                sc.in_dst_loc,
+                sc.in_valid,
+                sc.in_weight,
+            )
+            return {k: np.asarray(v)[: sc.real_n] for k, v in state.items()}
 
         steps_done = 0
         for step in range(program.max_iterations):
