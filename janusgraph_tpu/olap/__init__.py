@@ -6,3 +6,14 @@ from janusgraph_tpu.olap.vertex_program import (  # noqa: F401
     Memory,
     VertexProgram,
 )
+from janusgraph_tpu.olap.mapreduce import (  # noqa: F401
+    ClusterCountMapReduce,
+    MapReduce,
+    StatsMapReduce,
+    TopKMapReduce,
+    run_map_reduce,
+)
+from janusgraph_tpu.olap.checkpoint import (  # noqa: F401
+    load_checkpoint,
+    save_checkpoint,
+)

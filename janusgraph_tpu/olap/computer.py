@@ -23,6 +23,9 @@ class ComputerResult:
     states: Dict[str, np.ndarray]
     csr: CSRGraph
     graph: object = None
+    #: map-reduce results keyed by each job's memory_key (reference:
+    #: FulgoraMemory holding MapReduce side-effect keys)
+    memory: Dict[str, object] = field(default_factory=dict)
 
     def value(self, key: str, vertex_id: int) -> float:
         return float(self.states[key][self.csr.index_of(vertex_id)])
@@ -44,12 +47,26 @@ class GraphComputer:
         self.graph = graph
         self.executor_kind = executor
         self._edge_labels: Optional[Sequence[str]] = None
+        self._vertex_labels: Optional[Sequence[str]] = None
         self._property_keys: Sequence[str] = ()
         self._weight_key: Optional[str] = None
         self._program: Optional[VertexProgram] = None
+        self._map_reduces: list = []
 
     def edges(self, *labels: str) -> "GraphComputer":
+        """GraphFilter on edge labels (reference: GraphComputer.edges)."""
         self._edge_labels = labels
+        return self
+
+    def vertices(self, *labels: str) -> "GraphComputer":
+        """GraphFilter on vertex labels (reference: GraphComputer.vertices)."""
+        self._vertex_labels = labels
+        return self
+
+    def map_reduce(self, mr) -> "GraphComputer":
+        """Add a MapReduce job over the final vertex state (reference:
+        FulgoraGraphComputer.mapReduce)."""
+        self._map_reduces.append(mr)
         return self
 
     def properties(self, *keys: str) -> "GraphComputer":
@@ -69,11 +86,20 @@ class GraphComputer:
         csr = load_csr(
             self.graph,
             edge_labels=self._edge_labels,
+            vertex_labels=self._vertex_labels,
             property_keys=self._property_keys,
             weight_key=self._weight_key,
         )
         states = run_on(csr, self._program, self.executor_kind)
-        return ComputerResult(states=states, csr=csr, graph=self.graph)
+        memory = {}
+        if self._map_reduces:
+            from janusgraph_tpu.olap.mapreduce import run_map_reduce
+
+            for mr in self._map_reduces:
+                memory[mr.memory_key] = run_map_reduce(mr, states, csr)
+        return ComputerResult(
+            states=states, csr=csr, graph=self.graph, memory=memory
+        )
 
 
 def run_on(csr: CSRGraph, program: VertexProgram, executor: str = "tpu"):

@@ -88,11 +88,15 @@ def load_csr(
     property_keys: Sequence[str] = (),
     weight_key: Optional[str] = None,
     partitions: Optional[Sequence[int]] = None,
+    vertex_labels: Optional[Sequence[str]] = None,
 ) -> CSRGraph:
     """Scan the edgestore and build a CSRGraph.
 
     edge_labels: restrict to these labels (None = all user edges) — the
     reference's GraphFilter.edges equivalent.
+    vertex_labels: restrict to vertices with these labels — the reference's
+    GraphFilter.vertices equivalent (edges incident to excluded vertices are
+    dropped with them).
     property_keys: vertex property columns to materialize as arrays.
     weight_key: edge property to materialize as edge weight (float).
     partitions: restrict the scan to these storage partitions (the unit that
@@ -112,6 +116,14 @@ def load_csr(
             el = graph.schema_cache.get_by_name(name)
             if el is not None:
                 label_ids.add(el.id)
+
+    vlabel_ids: Optional[set] = None
+    if vertex_labels is not None:
+        vlabel_ids = set()
+        for name in vertex_labels:
+            vl = graph.schema_cache.get_by_name(name)
+            if vl is not None:
+                vlabel_ids.add(vl.id)
 
     prop_key_ids: Dict[int, str] = {}
     for name in property_keys:
@@ -153,15 +165,18 @@ def load_csr(
             if not idm.is_user_vertex_id(vid):
                 continue
             vid = canonicalize(vid)
-            vertex_id_list.append(vid)
 
-            # vertex label
+            # vertex label (+ GraphFilter.vertices: excluded vertices are
+            # skipped entirely; their edges drop via endpoint validation)
             lbl_entries = store.get_slice(KeySliceQuery(key, label_q), store_tx)
+            label_id = 0
             if lbl_entries:
                 rc = es.parse_relation(lbl_entries[0], st.type_info)
-                vertex_labels.append(rc.other_vertex_id)
-            else:
-                vertex_labels.append(0)
+                label_id = rc.other_vertex_id
+            if vlabel_ids is not None and label_id not in vlabel_ids:
+                continue
+            vertex_id_list.append(vid)
+            vertex_labels.append(label_id)
 
             # out-edges (OUT cells only: each edge counted once)
             edge_entries = store.get_slice(KeySliceQuery(key, edge_q), store_tx)

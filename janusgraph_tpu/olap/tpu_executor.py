@@ -232,27 +232,26 @@ class TPUExecutor:
         return self._compiled[key]
 
     def _fused_fn(self, program: VertexProgram, op: str):
-        """The ENTIRE BSP run as one compiled dispatch: superstep 0 unrolled
-        (to establish the aggregator pytree), then a lax.while_loop over
-        supersteps with `terminate_device` as the on-device stop condition.
-        No per-superstep host round trips at all — essential when the chip
-        sits behind a high-latency PJRT link, and idiomatic XLA regardless
-        (compiler-visible control flow instead of a host loop)."""
+        """A span of the BSP iteration as one compiled dispatch: a
+        lax.while_loop over supersteps with `terminate_device` as the
+        on-device stop condition. `steps_done0`/`limit` flow in as traced
+        scalars, so the same executable serves the full run and any
+        checkpoint-bounded chunk of it. No per-superstep host round trips —
+        essential when the chip sits behind a high-latency PJRT link, and
+        idiomatic XLA regardless (compiler-visible control flow instead of
+        a host loop)."""
         key = ("fused", program.cache_key(), op, self.strategy)
         if key in self._compiled:
             return self._compiled[key]
 
         jax, jnp = self.jax, self.jnp
         body = self._superstep_body(program, op)
-        max_iter = program.max_iterations
 
-        def whole_run(state, mem0):
-            state, mem = body(state, jnp.asarray(0, jnp.int32), mem0)
-
+        def run_span(state, mem, steps_done0, limit):
             def cond(carry):
                 _s, m, steps_done = carry
                 return jnp.logical_and(
-                    steps_done < max_iter,
+                    steps_done < limit,
                     jnp.logical_not(
                         program.terminate_device(m, steps_done, jnp)
                     ),
@@ -263,11 +262,9 @@ class TPUExecutor:
                 s2, m2 = body(s, steps_done, m)
                 return (s2, m2, steps_done + 1)
 
-            return jax.lax.while_loop(
-                cond, loop, (state, mem, jnp.asarray(1, jnp.int32))
-            )
+            return jax.lax.while_loop(cond, loop, (state, mem, steps_done0))
 
-        fn = jax.jit(whole_run)
+        fn = jax.jit(run_span)
         self._compiled[key] = fn
         return fn
 
@@ -277,30 +274,103 @@ class TPUExecutor:
         program: VertexProgram,
         sync_every: int = 1,
         fused: bool = None,
+        checkpoint_path: str = None,
+        checkpoint_every: int = 0,
+        resume: bool = False,
     ) -> Dict[str, np.ndarray]:
         """Run to termination.
 
         `fused` (default: auto) — compile the whole iteration into one
-        dispatch (single-monoid programs). Phase-alternating programs fall
-        back to the host loop, where `sync_every` controls how often the
-        host fetches the global aggregators to evaluate `terminate`;
-        between syncs everything stays on device and the host just enqueues
-        work, amortizing per-step link latency.
+        dispatch (programs with a constant combiner + a terminate_device
+        override). Phase-alternating programs fall back to the host loop,
+        where `sync_every` controls how often the host fetches the global
+        aggregators to evaluate `terminate`; between syncs everything stays
+        on device and the host just enqueues work, amortizing per-step link
+        latency.
+
+        `checkpoint_path` + `checkpoint_every=N` — save (state, aggregators,
+        step) every N supersteps (fused path: the while_loop is bounded into
+        N-step chunks reusing ONE executable); `resume=True` continues from
+        the checkpoint if present. Exceeds reference parity (SURVEY.md §5.4:
+        a failed Fulgora iteration aborts outright).
         """
         jnp = self.jnp
         if fused is None:
             fused = program.fused_eligible()
         if fused and type(program).combiner_for is VertexProgram.combiner_for:
-            op = program.combiner
+            return self._run_fused(
+                program, checkpoint_path, checkpoint_every, resume
+            )
+        return self._run_host_loop(program, sync_every)
+
+    def _run_fused(
+        self,
+        program: VertexProgram,
+        checkpoint_path: str,
+        checkpoint_every: int,
+        resume: bool,
+    ) -> Dict[str, np.ndarray]:
+        jnp = self.jnp
+        op = program.combiner
+        max_iter = program.max_iterations
+        steps_done = 0
+        state = mem = None
+
+        if resume and checkpoint_path:
+            from janusgraph_tpu.olap.checkpoint import load_checkpoint
+
+            ck = load_checkpoint(checkpoint_path)
+            if ck is not None:
+                state, mem, steps_done = ck
+                state = {k: jnp.asarray(v) for k, v in state.items()}
+                mem = {k: jnp.asarray(v, jnp.float32) for k, v in mem.items()}
+
+        if state is None:
             state, init_metrics = program.setup(self.g, jnp)
             state = {k: jnp.asarray(v) for k, v in state.items()}
             mem0 = {
                 k: jnp.asarray(v, dtype=jnp.float32)
                 for k, (_o, v) in init_metrics.items()
             }
-            fn = self._fused_fn(program, op)
-            state, _mem, _steps = fn(state, mem0)
-            return {k: np.asarray(v) for k, v in state.items()}
+            if max_iter == 0:
+                return {k: np.asarray(v) for k, v in state.items()}
+            # superstep 0 runs outside the loop: it establishes the
+            # aggregator pytree (apply metrics can add keys over setup's)
+            step_fn = self._superstep_fn(program, op)
+            state, mem = step_fn(state, jnp.asarray(0, jnp.int32), mem0)
+            steps_done = 1
+
+        fn = self._fused_fn(program, op)
+        while steps_done < max_iter:
+            limit = max_iter
+            if checkpoint_every:
+                limit = min(steps_done + checkpoint_every, max_iter)
+            state, mem, steps_dev = fn(
+                state,
+                mem,
+                jnp.asarray(steps_done, jnp.int32),
+                jnp.asarray(limit, jnp.int32),
+            )
+            new_steps = int(steps_dev)
+            terminated = new_steps < limit or new_steps == steps_done
+            steps_done = max(new_steps, steps_done)
+            if checkpoint_path and checkpoint_every:
+                from janusgraph_tpu.olap.checkpoint import save_checkpoint
+
+                save_checkpoint(
+                    checkpoint_path,
+                    {k: np.asarray(v) for k, v in state.items()},
+                    {k: np.asarray(v) for k, v in mem.items()},
+                    steps_done,
+                )
+            if terminated:
+                break
+        return {k: np.asarray(v) for k, v in state.items()}
+
+    def _run_host_loop(
+        self, program: VertexProgram, sync_every: int = 1
+    ) -> Dict[str, np.ndarray]:
+        jnp = self.jnp
         memory = Memory()
         state, init_metrics = program.setup(self.g, jnp)
         memory.reduce_in(init_metrics)

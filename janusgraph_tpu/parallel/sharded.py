@@ -270,10 +270,12 @@ class ShardedExecutor:
         return fn
 
     def _fused_fn(self, program: VertexProgram, op: str, sc: ShardedCSR):
-        """Whole BSP run as ONE dispatch: lax.while_loop inside shard_map,
-        collectives (all_gather exchange + psum barrier) in the loop body,
-        `terminate_device` on the replicated aggregators as the on-device
-        stop condition. See TPUExecutor._fused_fn."""
+        """A span of the BSP run as ONE dispatch: lax.while_loop inside
+        shard_map, collectives (all_gather exchange + psum barrier) in the
+        loop body, `terminate_device` on the replicated aggregators as the
+        on-device stop condition. steps/limit flow as traced scalars so one
+        executable serves the full run and checkpoint-bounded chunks. See
+        TPUExecutor._fused_fn."""
         key = ("fused", program.cache_key(), op)
         if key in self._compiled:
             return self._compiled[key]
@@ -283,16 +285,15 @@ class ShardedExecutor:
         from jax import shard_map
 
         body = self._shard_body(program, op, sc)
-        max_iter = program.max_iterations
 
-        def whole_run(state, mem0, out_degree, active, src_glob, dst_loc, valid, weight):
+        def run_span(state, mem, steps_done0, limit,
+                     out_degree, active, src_glob, dst_loc, valid, weight):
             args = (out_degree, active, src_glob, dst_loc, valid, weight)
-            state, mem = body(state, jnp.asarray(0, jnp.int32), mem0, *args)
 
             def cond(carry):
                 _s, m, steps_done = carry
                 return jnp.logical_and(
-                    steps_done < max_iter,
+                    steps_done < limit,
                     jnp.logical_not(
                         program.terminate_device(m, steps_done, jnp)
                     ),
@@ -303,16 +304,14 @@ class ShardedExecutor:
                 s2, m2 = body(s, steps_done, m, *args)
                 return (s2, m2, steps_done + 1)
 
-            return jax.lax.while_loop(
-                cond, loop, (state, mem, jnp.asarray(1, jnp.int32))
-            )
+            return jax.lax.while_loop(cond, loop, (state, mem, steps_done0))
 
         sharded_spec, rep = self._specs()
         fn = shard_map(
-            whole_run,
+            run_span,
             mesh=self.mesh,
             in_specs=(
-                sharded_spec, rep,
+                sharded_spec, rep, rep, rep,
                 sharded_spec, sharded_spec, sharded_spec,
                 sharded_spec, sharded_spec, sharded_spec,
             ),
@@ -328,14 +327,25 @@ class ShardedExecutor:
         program: VertexProgram,
         sync_every: int = 1,
         fused: bool = None,
+        checkpoint_path: str = None,
+        checkpoint_every: int = 0,
+        resume: bool = False,
     ) -> Dict[str, np.ndarray]:
-        """Run to termination. `fused` (default auto): single-monoid programs
-        compile the whole run into one dispatch (while_loop inside
-        shard_map); otherwise a host loop with `sync_every`-amortized
+        """Run to termination. `fused` (default auto): constant-combiner
+        programs with terminate_device compile spans of the run into one
+        dispatch (while_loop inside shard_map), optionally chunked for
+        checkpointing; otherwise a host loop with `sync_every`-amortized
         aggregator fetches (see TPUExecutor.run)."""
         import jax.numpy as jnp
 
         sc = self._sharded(program.undirected)
+        if fused is None:
+            fused = program.fused_eligible()
+        if fused and type(program).combiner_for is VertexProgram.combiner_for:
+            return self._run_fused(
+                program, sc, checkpoint_path, checkpoint_every, resume
+            )
+
         memory = Memory()
         state, init_metrics = program.setup(_GlobalView(sc), np)
         state = {k: jnp.asarray(v) for k, v in state.items()}
@@ -344,22 +354,6 @@ class ShardedExecutor:
         device_memory = {
             k: jnp.asarray(v, dtype=jnp.float32) for k, v in memory.values.items()
         }
-
-        if fused is None:
-            fused = program.fused_eligible()
-        if fused and type(program).combiner_for is VertexProgram.combiner_for:
-            fn = self._fused_fn(program, program.combiner, sc)
-            state, _mem, _steps = fn(
-                state,
-                device_memory,
-                sc.out_degree,
-                sc.active,
-                sc.in_src_glob,
-                sc.in_dst_loc,
-                sc.in_valid,
-                sc.in_weight,
-            )
-            return {k: np.asarray(v)[: sc.real_n] for k, v in state.items()}
 
         steps_done = 0
         for step in range(program.max_iterations):
@@ -393,6 +387,86 @@ class ShardedExecutor:
         return {
             k: np.asarray(v)[: sc.real_n] for k, v in state.items()
         }
+
+    def _run_fused(
+        self,
+        program: VertexProgram,
+        sc: ShardedCSR,
+        checkpoint_path: str,
+        checkpoint_every: int,
+        resume: bool,
+    ) -> Dict[str, np.ndarray]:
+        import jax.numpy as jnp
+
+        op = program.combiner
+        max_iter = program.max_iterations
+        csr_args = (
+            sc.out_degree, sc.active, sc.in_src_glob,
+            sc.in_dst_loc, sc.in_valid, sc.in_weight,
+        )
+        steps_done = 0
+        state = mem = None
+
+        if resume and checkpoint_path:
+            from janusgraph_tpu.olap.checkpoint import load_checkpoint
+
+            ck = load_checkpoint(checkpoint_path)
+            if ck is not None:
+                ck_state, ck_mem, steps_done = ck
+                # checkpoints store the real_n rows (portable across shard
+                # counts); padding rows are re-derived from a fresh setup()
+                fresh, _m = program.setup(_GlobalView(sc), np)
+                state = {}
+                for k, pad in fresh.items():
+                    arr = np.asarray(pad).copy()
+                    arr[: sc.real_n] = np.asarray(ck_state[k])
+                    state[k] = jnp.asarray(arr)
+                mem = {k: jnp.asarray(v, jnp.float32) for k, v in ck_mem.items()}
+
+        if state is None:
+            state, init_metrics = program.setup(_GlobalView(sc), np)
+            state = {k: jnp.asarray(v) for k, v in state.items()}
+            mem0 = {
+                k: jnp.asarray(v, dtype=jnp.float32)
+                for k, (_o, v) in init_metrics.items()
+            }
+            if max_iter == 0:
+                return {
+                    k: np.asarray(v)[: sc.real_n] for k, v in state.items()
+                }
+            step_fn = self._superstep_fn(program, op, sc)
+            state, mem = step_fn(
+                state, jnp.asarray(0, jnp.int32), mem0, *csr_args
+            )
+            steps_done = 1
+
+        fn = self._fused_fn(program, op, sc)
+        while steps_done < max_iter:
+            limit = max_iter
+            if checkpoint_every:
+                limit = min(steps_done + checkpoint_every, max_iter)
+            state, mem, steps_dev = fn(
+                state,
+                mem,
+                jnp.asarray(steps_done, jnp.int32),
+                jnp.asarray(limit, jnp.int32),
+                *csr_args,
+            )
+            new_steps = int(steps_dev)
+            terminated = new_steps < limit or new_steps == steps_done
+            steps_done = max(new_steps, steps_done)
+            if checkpoint_path and checkpoint_every:
+                from janusgraph_tpu.olap.checkpoint import save_checkpoint
+
+                save_checkpoint(
+                    checkpoint_path,
+                    {k: np.asarray(v)[: sc.real_n] for k, v in state.items()},
+                    {k: np.asarray(v) for k, v in mem.items()},
+                    steps_done,
+                )
+            if terminated:
+                break
+        return {k: np.asarray(v)[: sc.real_n] for k, v in state.items()}
 
 
 def shard_csr(csr: CSRGraph, num_shards: int, undirected: bool = False) -> ShardedCSR:
