@@ -131,6 +131,10 @@ SERVER_NS = ConfigNamespace("server", "server endpoint", ROOT)
 STORAGE.option("backend", str, "store manager shorthand", "inmemory")
 STORAGE.option("directory", str, "data directory for persistent backends", "")
 STORAGE.option(
+    "sharded-nodes", int, "node count for the sharded backend", 3,
+    verifier=lambda v: v > 0,
+)
+STORAGE.option(
     "batch-loading", bool,
     "disable consistency checks for bulk loads", False,
 )

@@ -48,8 +48,29 @@ from janusgraph_tpu.storage.idauthority import ConsistentKeyIDAuthority, Standar
 from janusgraph_tpu.storage.inmemory import InMemoryStoreManager
 from janusgraph_tpu.storage.kcvs import KeySliceQuery, SliceQuery
 
+def _open_local(cfg):
+    from janusgraph_tpu.storage.localstore import open_local_kcvs
+
+    directory = cfg.get("storage.directory")
+    if not directory:
+        raise ConfigurationError(
+            "storage.backend=local requires storage.directory"
+        )
+    return open_local_kcvs(directory)
+
+
+def _open_sharded(cfg):
+    from janusgraph_tpu.storage.sharded_store import ShardedStoreManager
+
+    return ShardedStoreManager(num_nodes=cfg.get("storage.sharded-nodes"))
+
+
+# reference: StandardStoreManager.java:82 shorthand registry. Factories take
+# the GraphConfiguration (or nothing, for config-free backends).
 _STORE_MANAGERS = {
-    "inmemory": InMemoryStoreManager,
+    "inmemory": lambda cfg: InMemoryStoreManager(),
+    "local": _open_local,
+    "sharded": _open_sharded,
 }
 
 
@@ -150,7 +171,14 @@ class JanusGraphTPU:
                 raise ConfigurationError(
                     f"unknown storage backend {backend_name!r}"
                 )
-            store_manager = factory()
+            import inspect
+
+            takes_cfg = True
+            try:
+                takes_cfg = len(inspect.signature(factory).parameters) >= 1
+            except (TypeError, ValueError):
+                pass
+            store_manager = factory(cfg) if takes_cfg else factory()
         self.serializer = Serializer()
         # reconcile cluster-global options BEFORE building the backend so
         # stored GLOBAL/FIXED values govern its construction (reference:

@@ -156,10 +156,23 @@ def load_csr(
 
     canonicalize = idm.get_canonical_vertex_id
 
-    for start, end in ranges:
-        for key, exist_entries in store.get_keys(
-            KeyRangeQuery(start, end, exists_q), store_tx
-        ):
+    ordered = graph.backend.manager.features.ordered_scan
+
+    def _scan_rows():
+        if ordered:
+            for start, end in ranges:
+                yield from store.get_keys(
+                    KeyRangeQuery(start, end, exists_q), store_tx
+                )
+        else:
+            # unordered backends (sharded/CQL-analogue): one full scan,
+            # key-range filtering client-side (reference: token-range
+            # getKeys path used by VertexJobConverter on CQL)
+            for key, entries in store.get_keys(exists_q, store_tx):
+                if any(s <= key < e for s, e in ranges):
+                    yield key, entries
+
+    for key, exist_entries in _scan_rows():
             # ghost check: only rows with the existence cell are real vertices
             vid = idm.get_vertex_id(key)
             if not idm.is_user_vertex_id(vid):

@@ -168,10 +168,17 @@ class IndexRemoveJob:
         btx = self.graph.backend.begin_transaction()
         prefix = struct.pack(">Q", idx.id)
         store = self.graph.backend.indexstore
-        it = store.get_keys(
-            KeyRangeQuery(prefix, prefix + b"\xff" * 17, SliceQuery()),
-            btx.store_tx,
-        )
+        if self.graph.backend.manager.features.ordered_scan:
+            it = store.get_keys(
+                KeyRangeQuery(prefix, prefix + b"\xff" * 17, SliceQuery()),
+                btx.store_tx,
+            )
+        else:
+            it = (
+                (k, es)
+                for k, es in store.get_keys(SliceQuery(), btx.store_tx)
+                if k.startswith(prefix)
+            )
         for key, entries in it:
             cols = [col for col, _ in entries]
             if cols:
@@ -225,7 +232,11 @@ def run_scan_job(graph, job: ScanJob, num_workers: int = 1) -> ScanMetrics:
     Backend.buildEdgeScanJob → StandardScanner; partition ranges =
     IDManager key ranges, the same structure the TPU mesh shards by)."""
     btx = graph.backend.begin_transaction()
-    scanner = StandardScanner(graph.backend.edgestore, btx.store_tx)
+    scanner = StandardScanner(
+        graph.backend.edgestore,
+        btx.store_tx,
+        ordered_scan=graph.backend.manager.features.ordered_scan,
+    )
     ranges = [
         graph.idm.partition_key_range(p)
         for p in range(graph.idm.num_partitions)

@@ -301,7 +301,9 @@ class TPUExecutor:
             return self._run_fused(
                 program, checkpoint_path, checkpoint_every, resume
             )
-        return self._run_host_loop(program, sync_every)
+        return self._run_host_loop(
+            program, sync_every, checkpoint_path, checkpoint_every, resume
+        )
 
     def _run_fused(
         self,
@@ -368,20 +370,37 @@ class TPUExecutor:
         return {k: np.asarray(v) for k, v in state.items()}
 
     def _run_host_loop(
-        self, program: VertexProgram, sync_every: int = 1
+        self,
+        program: VertexProgram,
+        sync_every: int = 1,
+        checkpoint_path: str = None,
+        checkpoint_every: int = 0,
+        resume: bool = False,
     ) -> Dict[str, np.ndarray]:
         jnp = self.jnp
         memory = Memory()
-        state, init_metrics = program.setup(self.g, jnp)
-        memory.reduce_in(init_metrics)
-        memory.superstep = 0
+        state = None
+        start_step = 0
+        if resume and checkpoint_path:
+            from janusgraph_tpu.olap.checkpoint import load_checkpoint
+
+            ck = load_checkpoint(checkpoint_path)
+            if ck is not None:
+                ck_state, ck_mem, start_step = ck
+                state = {k: jnp.asarray(v) for k, v in ck_state.items()}
+                memory.values = {k: float(v) for k, v in ck_mem.items()}
+                memory.superstep = start_step
+        if state is None:
+            state, init_metrics = program.setup(self.g, jnp)
+            memory.reduce_in(init_metrics)
+            memory.superstep = 0
 
         # device-resident aggregators: no H2D after this point
         device_memory = {
             k: jnp.asarray(v, dtype=jnp.float32) for k, v in memory.values.items()
         }
-        steps_done = 0
-        for step in range(program.max_iterations):
+        steps_done = start_step
+        for step in range(start_step, program.max_iterations):
             op = program.combiner_for(step)
             fn = self._superstep_fn(program, op)
             state, metrics = fn(
@@ -397,6 +416,17 @@ class TPUExecutor:
                 host_vals = self.jax.device_get(metrics)  # one round trip
                 memory.values = {k: float(v) for k, v in host_vals.items()}
                 memory.superstep = steps_done
+                if checkpoint_path and checkpoint_every and (
+                    steps_done % checkpoint_every == 0 or last
+                ):
+                    from janusgraph_tpu.olap.checkpoint import save_checkpoint
+
+                    save_checkpoint(
+                        checkpoint_path,
+                        {k: np.asarray(v) for k, v in state.items()},
+                        memory.values,
+                        steps_done,
+                    )
                 if program.terminate(memory):
                     break
         return {k: np.asarray(v) for k, v in state.items()}

@@ -347,16 +347,33 @@ class ShardedExecutor:
             )
 
         memory = Memory()
-        state, init_metrics = program.setup(_GlobalView(sc), np)
-        state = {k: jnp.asarray(v) for k, v in state.items()}
-        memory.reduce_in(init_metrics)
-        memory.superstep = 0
+        state = None
+        start_step = 0
+        if resume and checkpoint_path:
+            from janusgraph_tpu.olap.checkpoint import load_checkpoint
+
+            ck = load_checkpoint(checkpoint_path)
+            if ck is not None:
+                ck_state, ck_mem, start_step = ck
+                fresh, _m = program.setup(_GlobalView(sc), np)
+                state = {}
+                for k, pad in fresh.items():
+                    arr = np.asarray(pad).copy()
+                    arr[: sc.real_n] = np.asarray(ck_state[k])
+                    state[k] = jnp.asarray(arr)
+                memory.values = {k: float(v) for k, v in ck_mem.items()}
+                memory.superstep = start_step
+        if state is None:
+            state, init_metrics = program.setup(_GlobalView(sc), np)
+            state = {k: jnp.asarray(v) for k, v in state.items()}
+            memory.reduce_in(init_metrics)
+            memory.superstep = 0
         device_memory = {
             k: jnp.asarray(v, dtype=jnp.float32) for k, v in memory.values.items()
         }
 
-        steps_done = 0
-        for step in range(program.max_iterations):
+        steps_done = start_step
+        for step in range(start_step, program.max_iterations):
             op = program.combiner_for(step)
             fn = self._superstep_fn(program, op, sc)
             state, metrics = fn(
@@ -380,6 +397,17 @@ class ShardedExecutor:
                 host_vals = self.jax.device_get(metrics)
                 memory.values = {k: float(v) for k, v in host_vals.items()}
                 memory.superstep = steps_done
+                if checkpoint_path and checkpoint_every and (
+                    steps_done % checkpoint_every == 0 or last
+                ):
+                    from janusgraph_tpu.olap.checkpoint import save_checkpoint
+
+                    save_checkpoint(
+                        checkpoint_path,
+                        {k: np.asarray(v)[: sc.real_n] for k, v in state.items()},
+                        memory.values,
+                        steps_done,
+                    )
                 if program.terminate(memory):
                     break
 

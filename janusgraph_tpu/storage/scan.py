@@ -77,9 +77,15 @@ class ScanJob:
 class StandardScanner:
     """Runs ScanJobs over a store with partition-parallel workers."""
 
-    def __init__(self, store: KeyColumnValueStore, txh: StoreTransaction):
+    def __init__(
+        self,
+        store: KeyColumnValueStore,
+        txh: StoreTransaction,
+        ordered_scan: bool = True,
+    ):
         self.store = store
         self.txh = txh
+        self.ordered_scan = ordered_scan
 
     def execute(
         self,
@@ -104,6 +110,11 @@ class StandardScanner:
         try:
             if key_ranges is None:
                 self._scan_range(job, queries, None, metrics, batch_size)
+            elif not self.ordered_scan:
+                # unordered backend: ONE full scan routed against the union
+                # of ranges (a per-range scan would re-read the whole store
+                # P times)
+                self._scan_unordered(job, queries, key_ranges, metrics, batch_size)
             elif num_workers <= 1 or len(key_ranges) <= 1:
                 for rng in key_ranges:
                     self._scan_range(job, queries, rng, metrics, batch_size)
@@ -120,6 +131,33 @@ class StandardScanner:
         finally:
             job.teardown(metrics)
         return metrics
+
+    def _scan_unordered(
+        self,
+        job: ScanJob,
+        queries: List[SliceQuery],
+        key_ranges: Sequence[Tuple[bytes, bytes]],
+        metrics: ScanMetrics,
+        batch_size: int,
+    ) -> None:
+        """One full unordered scan with client-side range filtering
+        (reference: the CQL token-range getKeys path)."""
+        primary, rest = queries[0], queries[1:]
+        batch: List[Tuple[bytes, Dict[SliceQuery, EntryList]]] = []
+        for key, primary_entries in self.store.get_keys(primary, self.txh):
+            if not any(lo <= key < hi for lo, hi in key_ranges):
+                continue
+            slices: Dict[SliceQuery, EntryList] = {primary: primary_entries}
+            for q in rest:
+                slices[q] = self.store.get_slice(KeySliceQuery(key, q), self.txh)
+            batch.append((key, slices))
+            if len(batch) >= batch_size:
+                job.process(batch, metrics)
+                metrics.add_rows(len(batch))
+                batch = []
+        if batch:
+            job.process(batch, metrics)
+            metrics.add_rows(len(batch))
 
     def _scan_range(
         self,

@@ -31,11 +31,30 @@ import pytest  # noqa: E402
 from janusgraph_tpu.storage.inmemory import InMemoryStoreManager  # noqa: E402
 
 
-@pytest.fixture
-def store_manager():
+def _make_backend(kind: str, tmp_path):
+    if kind == "inmemory":
+        return InMemoryStoreManager()
+    if kind == "local":
+        from janusgraph_tpu.storage.localstore import open_local_kcvs
+
+        return open_local_kcvs(str(tmp_path / "localstore"), fsync=False)
+    if kind == "sharded":
+        from janusgraph_tpu.storage.sharded_store import ShardedStoreManager
+
+        return ShardedStoreManager(num_nodes=3)
+    if kind == "ttl":
+        from janusgraph_tpu.storage.ttl import TTLStoreManager
+
+        # ttl=0 (never expires): exercises the value framing transparently
+        return TTLStoreManager(InMemoryStoreManager(), default_ttl_seconds=0.0)
+    raise ValueError(kind)
+
+
+@pytest.fixture(params=["inmemory", "local", "sharded", "ttl"])
+def store_manager(request, tmp_path):
     """Parameterization point for backend-contract suites: every backend
     must pass the same abstract suites (the reference's
-    backend-testutils pattern)."""
-    mgr = InMemoryStoreManager()
+    backend-testutils pattern: abstract suites subclassed per backend)."""
+    mgr = _make_backend(request.param, tmp_path)
     yield mgr
     mgr.close()

@@ -167,9 +167,9 @@ def test_scan_partitioned_ranges_parallel(store_manager):
         store.mutate(bytes([i]) + b"x", [(b"c", b"v")], [], tx)
     ranges = [(bytes([lo]), bytes([lo + 16])) for lo in range(0, 64, 16)]
     job = CountingJob(SliceQuery())
-    metrics = StandardScanner(store, tx).execute(
-        job, key_ranges=ranges, num_workers=4, batch_size=5
-    )
+    metrics = StandardScanner(
+        store, tx, ordered_scan=store_manager.features.ordered_scan
+    ).execute(job, key_ranges=ranges, num_workers=4, batch_size=5)
     assert metrics.rows_processed == 64
     assert sorted(k for k, _ in job.rows) == sorted(bytes([i]) + b"x" for i in range(64))
 

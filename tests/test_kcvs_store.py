@@ -94,9 +94,12 @@ def test_get_slice_multi(store, tx):
     assert res[key(9)] == []
 
 
-def test_get_keys_ordered(store, tx):
+def test_get_keys_ordered(store_manager, store, tx):
     load(store, tx, nkeys=8, ncols=2)
     rows = list(store.get_keys(SliceQuery(), tx))
+    if not store_manager.features.ordered_scan:
+        assert sorted(k for k, _ in rows) == [key(i) for i in range(8)]
+        pytest.skip("backend has unordered scans only (CQL-analogue)")
     assert [k for k, _ in rows] == [key(i) for i in range(8)]
     # range scan
     rows = list(store.get_keys(KeyRangeQuery(key(2), key(5), SliceQuery()), tx))

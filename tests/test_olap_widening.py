@@ -165,3 +165,26 @@ def test_checkpoint_early_termination_preserved(tmp_path):
     assert steps < 50
     comp = np.asarray(res["component"])
     assert (comp[:4] == comp[0]).all()
+
+
+def test_checkpoint_resume_host_loop_path(tmp_path):
+    """Phase-alternating programs (host loop) also checkpoint + resume."""
+    from janusgraph_tpu.olap.programs import PeerPressureProgram
+
+    csr = random_graph(seed=41)
+    path = str(tmp_path / "pp.npz")
+    direct = TPUExecutor(csr, strategy="ell").run(
+        PeerPressureProgram(num_buckets=128, rounds=6)
+    )
+    ex = TPUExecutor(csr, strategy="ell")
+    ex.run(
+        PeerPressureProgram(num_buckets=128, rounds=3),
+        checkpoint_path=path, checkpoint_every=2,
+    )
+    _st, _mem, steps = load_checkpoint(path)
+    assert steps > 0
+    resumed = TPUExecutor(csr, strategy="ell").run(
+        PeerPressureProgram(num_buckets=128, rounds=6),
+        checkpoint_path=path, checkpoint_every=2, resume=True,
+    )
+    np.testing.assert_allclose(resumed["cluster"], direct["cluster"])

@@ -1,0 +1,266 @@
+"""Adapter-specific suites: persistent local store (WAL recovery, compaction
+— reference: janusgraph-berkeleyje durability), TTL wrapper (reference:
+TTLKCVSManager.java:119), sharded distributed manager (reference: CQL
+token-partitioned store), and the order-preserving composite codec
+(reference: OrderedKeyValueStoreAdapter.java:389)."""
+
+import time
+
+import numpy as np
+import pytest
+
+from janusgraph_tpu.exceptions import PermanentBackendError, TemporaryBackendError
+from janusgraph_tpu.storage.inmemory import InMemoryStoreManager
+from janusgraph_tpu.storage.kcvs import (
+    KCVMutation,
+    KeyRangeQuery,
+    KeySliceQuery,
+    SliceQuery,
+)
+from janusgraph_tpu.storage.kvstore import (
+    decode_composite,
+    encode_composite,
+    encode_key,
+)
+from janusgraph_tpu.storage.localstore import LocalKVStoreManager, open_local_kcvs
+from janusgraph_tpu.storage.sharded_store import ShardedStoreManager
+from janusgraph_tpu.storage.ttl import TTLStoreManager
+
+
+# ------------------------------------------------------------ composite codec
+def test_composite_roundtrip_and_order():
+    cases = [
+        (b"a", b""),
+        (b"a", b"\x00col"),
+        (b"a\x00b", b"c"),
+        (b"a\x00", b""),
+        (b"", b"col"),
+        (b"a\xff", b"z"),
+    ]
+    for k, c in cases:
+        assert decode_composite(encode_composite(k, c)) == (k, c)
+    # order preservation: composites of key a sort strictly between
+    # composites of any smaller and larger key
+    keys = sorted([b"a", b"a\x00", b"a\x00b", b"ab", b"a\xff", b"b"])
+    encs = [encode_key(k) for k in keys]
+    assert encs == sorted(encs)
+
+
+# ------------------------------------------------------------ local store WAL
+def test_local_store_survives_reopen(tmp_path):
+    d = str(tmp_path / "db")
+    mgr = open_local_kcvs(d, fsync=False)
+    store = mgr.open_database("s")
+    tx = mgr.begin_transaction()
+    store.mutate(b"k1", [(b"c1", b"v1"), (b"c2", b"v2")], [], tx)
+    store.mutate(b"k2", [(b"c1", b"x")], [], tx)
+    store.mutate(b"k1", [], [b"c2"], tx)
+    tx.commit()
+    mgr.close()
+
+    mgr2 = open_local_kcvs(d, fsync=False)
+    s2 = mgr2.open_database("s")
+    tx2 = mgr2.begin_transaction()
+    assert s2.get_slice(KeySliceQuery(b"k1", SliceQuery()), tx2) == [(b"c1", b"v1")]
+    assert s2.get_slice(KeySliceQuery(b"k2", SliceQuery()), tx2) == [(b"c1", b"x")]
+    mgr2.close()
+
+
+def test_local_store_compaction_preserves_data(tmp_path):
+    d = str(tmp_path / "db")
+    kv = LocalKVStoreManager(d, fsync=False)
+    tx = kv.begin_transaction()
+    s = kv.open_database("s")
+    for i in range(50):
+        s.insert(b"key%03d" % i, b"val%d" % i, tx)
+    for i in range(0, 50, 2):
+        s.delete(b"key%03d" % i, tx)
+    tx.commit()
+    kv.compact()
+    # more writes after compaction land in the fresh WAL
+    s.insert(b"zz", b"tail", tx)
+    tx.commit()
+    kv.close()
+
+    kv2 = LocalKVStoreManager(d, fsync=False)
+    s2 = kv2.open_database("s")
+    rows = list(s2.scan(b"", None, kv2.begin_transaction()))
+    assert len(rows) == 26
+    assert (b"zz", b"tail") in rows
+    assert all(int(k[3:]) % 2 == 1 for k, _ in rows if k != b"zz")
+    kv2.close()
+
+
+def test_local_store_torn_tail_record_ignored(tmp_path):
+    d = str(tmp_path / "db")
+    mgr = open_local_kcvs(d, fsync=False)
+    store = mgr.open_database("s")
+    tx = mgr.begin_transaction()
+    store.mutate(b"k", [(b"c", b"v")], [], tx)
+    tx.commit()
+    mgr.close()
+    # corrupt: append garbage (simulates a crash mid-append)
+    import os
+
+    with open(os.path.join(d, "store.wal"), "ab") as f:
+        f.write(b"\x01\x02\x03garbage")
+    mgr2 = open_local_kcvs(d, fsync=False)
+    s2 = mgr2.open_database("s")
+    assert s2.get_slice(
+        KeySliceQuery(b"k", SliceQuery()), mgr2.begin_transaction()
+    ) == [(b"c", b"v")]
+    mgr2.close()
+
+
+# ------------------------------------------------------------------- TTL
+def test_ttl_expiry_and_purge():
+    mgr = TTLStoreManager(InMemoryStoreManager(), default_ttl_seconds=0.05)
+    s = mgr.open_database("s")
+    tx = mgr.begin_transaction()
+    s.mutate(b"k", [(b"c", b"v")], [], tx)
+    assert s.get_slice(KeySliceQuery(b"k", SliceQuery()), tx) == [(b"c", b"v")]
+    time.sleep(0.08)
+    assert s.get_slice(KeySliceQuery(b"k", SliceQuery()), tx) == []
+    assert list(s.get_keys(SliceQuery(), tx)) == []
+    # the dead cell still occupies the wrapped store until purged
+    assert s.purge_expired(tx) == 1
+    assert s.purge_expired(tx) == 0
+    mgr.close()
+
+
+def test_ttl_zero_never_expires():
+    mgr = TTLStoreManager(InMemoryStoreManager(), default_ttl_seconds=0.0)
+    s = mgr.open_database("s")
+    tx = mgr.begin_transaction()
+    mgr.mutate_many({"s": {b"k": KCVMutation(additions=[(b"c", b"v")])}}, tx)
+    assert s.get_slice(KeySliceQuery(b"k", SliceQuery()), tx) == [(b"c", b"v")]
+    mgr.close()
+
+
+def test_ttl_per_store_override():
+    mgr = TTLStoreManager(
+        InMemoryStoreManager(), default_ttl_seconds=0.0,
+        per_store_ttl={"volatile": 0.01},
+    )
+    sv = mgr.open_database("volatile")
+    sp = mgr.open_database("permanent")
+    tx = mgr.begin_transaction()
+    sv.mutate(b"k", [(b"c", b"v")], [], tx)
+    sp.mutate(b"k", [(b"c", b"v")], [], tx)
+    time.sleep(0.03)
+    assert sv.get_slice(KeySliceQuery(b"k", SliceQuery()), tx) == []
+    assert sp.get_slice(KeySliceQuery(b"k", SliceQuery()), tx) == [(b"c", b"v")]
+    mgr.close()
+
+
+# ---------------------------------------------------------------- sharded
+def test_sharded_distributes_keys():
+    mgr = ShardedStoreManager(num_nodes=4)
+    s = mgr.open_database("s")
+    tx = mgr.begin_transaction()
+    for i in range(64):
+        s.mutate(b"key%d" % i, [(b"c", b"v%d" % i)], [], tx)
+    counts = [
+        m.open_database("s").row_count() for m in mgr.nodes
+    ]
+    assert sum(counts) == 64
+    assert all(c > 0 for c in counts)  # blake2b spreads 64 keys over 4 nodes
+    # full scan sees all rows
+    assert len(list(s.get_keys(SliceQuery(), tx))) == 64
+    mgr.close()
+
+
+def test_sharded_rejects_ordered_range_scan():
+    mgr = ShardedStoreManager(num_nodes=2)
+    s = mgr.open_database("s")
+    tx = mgr.begin_transaction()
+    with pytest.raises(PermanentBackendError):
+        list(s.get_keys(KeyRangeQuery(b"a", b"z", SliceQuery()), tx))
+    mgr.close()
+
+
+def test_sharded_node_failure_and_heal():
+    mgr = ShardedStoreManager(num_nodes=2)
+    s = mgr.open_database("s")
+    tx = mgr.begin_transaction()
+    s.mutate(b"k1", [(b"c", b"v")], [], tx)
+    down = next(
+        i for i in range(2)
+        if __import__("janusgraph_tpu.storage.sharded_store", fromlist=["_shard_of"])._shard_of(b"k1", 2) == i
+    )
+    mgr.fail_node(down)
+    with pytest.raises(TemporaryBackendError):
+        s.get_slice(KeySliceQuery(b"k1", SliceQuery()), tx)
+    mgr.heal_node(down)
+    assert s.get_slice(KeySliceQuery(b"k1", SliceQuery()), tx) == [(b"c", b"v")]
+    mgr.close()
+
+
+def test_sharded_mutate_many_routes_per_node():
+    mgr = ShardedStoreManager(num_nodes=3)
+    tx = mgr.begin_transaction()
+    muts = {
+        "a": {b"k%d" % i: KCVMutation(additions=[(b"c", b"v")]) for i in range(20)},
+        "b": {b"q%d" % i: KCVMutation(additions=[(b"c", b"v")]) for i in range(20)},
+    }
+    mgr.mutate_many(muts, tx)
+    sa, sb = mgr.open_database("a"), mgr.open_database("b")
+    assert len(list(sa.get_keys(SliceQuery(), tx))) == 20
+    assert len(list(sb.get_keys(SliceQuery(), tx))) == 20
+    mgr.close()
+
+
+# ------------------------------------------------- graph-level integration
+def test_graph_persists_across_reopen_on_local_backend(tmp_path):
+    from janusgraph_tpu.core import gods
+    from janusgraph_tpu.core.graph import open_graph
+
+    d = str(tmp_path / "graphdb")
+    g = open_graph({
+        "storage.backend": "local",
+        "storage.directory": d,
+        "ids.authority-wait-ms": 0.0,
+    })
+    gods.load(g)
+    saturn_id = None
+    tx = g.new_transaction()
+    for v in tx.vertices():
+        if v.value("name") == "saturn":
+            saturn_id = v.id
+    tx.rollback()
+    g.close()
+
+    g2 = open_graph({
+        "storage.backend": "local",
+        "storage.directory": d,
+        "ids.authority-wait-ms": 0.0,
+    })
+    tx2 = g2.new_transaction()
+    saturn = tx2.get_vertex(saturn_id)
+    assert saturn is not None and saturn.value("name") == "saturn"
+    # traversal over persisted edges
+    grandchild = (
+        g2.traversal().V().has("name", "saturn")
+        .in_("father").in_("father").values("name").to_list()
+    )
+    assert grandchild == ["hercules"]
+    tx2.rollback()
+    g2.close()
+
+
+def test_graph_olap_on_sharded_backend():
+    from janusgraph_tpu.core import gods
+    from janusgraph_tpu.core.graph import open_graph
+    from janusgraph_tpu.olap import load_csr
+    from janusgraph_tpu.olap.programs import PageRankProgram
+
+    g = open_graph({
+        "storage.backend": "sharded",
+        "ids.authority-wait-ms": 0.0,
+    })
+    gods.load(g)
+    csr = load_csr(g)  # exercises the unordered-scan fallback
+    assert csr.num_vertices == 12 and csr.num_edges == 17
+    res = g.compute().program(PageRankProgram(max_iterations=20)).submit()
+    assert abs(sum(res.states["rank"]) - 1.0) < 1e-3
+    g.close()
