@@ -262,18 +262,11 @@ def load_csr(
         w = None
 
     # build out-CSR (sorted by src) and in-CSR (sorted by dst)
-    out_order = np.argsort(src_idx, kind="stable")
-    out_dst = dst_idx[out_order]
-    out_indptr = np.zeros(n + 1, dtype=np.int64)
-    np.add.at(out_indptr, src_idx + 1, 1)
-    np.cumsum(out_indptr, out=out_indptr)
+    from janusgraph_tpu import native
 
-    in_order = np.argsort(dst_idx, kind="stable")
-    in_src = src_idx[in_order]
-    in_indptr = np.zeros(n + 1, dtype=np.int64)
-    np.add.at(in_indptr, dst_idx + 1, 1)
-    np.cumsum(in_indptr, out=in_indptr)
-
+    out_indptr, out_dst, out_order, in_indptr, in_src, in_order = (
+        native.build_csr(n, src_idx, dst_idx)
+    )
     out_degree = np.diff(out_indptr).astype(np.int32)
 
     props: Dict[str, np.ndarray] = {}
@@ -329,22 +322,19 @@ def csr_from_edges(
 ) -> CSRGraph:
     """Build a CSRGraph directly from an edge list with dense [0,n) ids —
     the synthetic-graph path for benchmarks (graph500 RMAT etc.)."""
+    from janusgraph_tpu import native
+
     src = np.asarray(src, dtype=np.int32)
     dst = np.asarray(dst, dtype=np.int32)
-    out_order = np.argsort(src, kind="stable")
-    in_order = np.argsort(dst, kind="stable")
-    out_indptr = np.zeros(n + 1, dtype=np.int64)
-    np.add.at(out_indptr, src.astype(np.int64) + 1, 1)
-    np.cumsum(out_indptr, out=out_indptr)
-    in_indptr = np.zeros(n + 1, dtype=np.int64)
-    np.add.at(in_indptr, dst.astype(np.int64) + 1, 1)
-    np.cumsum(in_indptr, out=in_indptr)
+    out_indptr, out_dst, out_order, in_indptr, in_src, in_order = (
+        native.build_csr(n, src, dst)
+    )
     return CSRGraph(
         vertex_ids=np.arange(n, dtype=np.int64),
         out_indptr=out_indptr,
-        out_dst=dst[out_order],
+        out_dst=out_dst,
         in_indptr=in_indptr,
-        in_src=src[in_order],
+        in_src=in_src,
         out_degree=np.diff(out_indptr).astype(np.int32),
         in_edge_weight=weights[in_order].astype(np.float32) if weights is not None else None,
         out_edge_weight=weights[out_order].astype(np.float32) if weights is not None else None,

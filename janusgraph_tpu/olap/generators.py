@@ -26,6 +26,17 @@ def rmat_edges(
     n = 1 << scale
     m = n * edge_factor
     rng = np.random.default_rng(seed)
+
+    from janusgraph_tpu import native
+
+    nat = native.rmat_edges(scale, m, seed, a, b, c)
+    if nat is not None:
+        src32, dst32 = nat
+        if permute:
+            perm = rng.permutation(n).astype(np.int32)
+            src32 = perm[src32]
+            dst32 = perm[dst32]
+        return n, src32, dst32
     src = np.zeros(m, dtype=np.int64)
     dst = np.zeros(m, dtype=np.int64)
     ab = a + b

@@ -82,26 +82,35 @@ class ELLPack:
 
         self.buckets: List[Tuple[np.ndarray, np.ndarray, np.ndarray]] = []
         self.vertex_order_parts: List[np.ndarray] = []
+        src32 = np.ascontiguousarray(src, dtype=np.int32)
+        w32 = (
+            np.ascontiguousarray(w, dtype=np.float32) if w is not None else None
+        )
         for c in sorted(set(int(c) for c in np.unique(caps))):
             members = np.nonzero(caps == c)[0]
             if len(members) == 0:
                 continue
-            idx = np.full((len(members), c), self.sentinel, dtype=np.int64)
+            idx = np.full((len(members), c), self.sentinel, dtype=np.int32)
             wmat = np.zeros((len(members), c), dtype=np.float32)
             valid = np.zeros((len(members), c), dtype=np.float32)
-            # vectorized fill: flatten each member's edge range
             deg_m = deg[members]
-            total = int(deg_m.sum())
-            if total:
-                row_ids = np.repeat(np.arange(len(members)), deg_m)
-                col_ids = np.arange(total) - np.repeat(
-                    np.cumsum(deg_m) - deg_m, deg_m
-                )
-                edge_pos = np.repeat(indptr[members], deg_m) + col_ids
-                idx[row_ids, col_ids] = src[edge_pos]
-                valid[row_ids, col_ids] = 1.0
-                wmat[row_ids, col_ids] = w[edge_pos] if w is not None else 1.0
-            self.buckets.append((idx.astype(np.int32), wmat, valid))
+            from janusgraph_tpu import native
+
+            if not native.ell_fill(
+                c, indptr[members], deg_m, src32, w32, idx, wmat, valid
+            ):
+                # numpy fallback: flatten each member's edge range
+                total = int(deg_m.sum())
+                if total:
+                    row_ids = np.repeat(np.arange(len(members)), deg_m)
+                    col_ids = np.arange(total) - np.repeat(
+                        np.cumsum(deg_m) - deg_m, deg_m
+                    )
+                    edge_pos = np.repeat(indptr[members], deg_m) + col_ids
+                    idx[row_ids, col_ids] = src[edge_pos]
+                    valid[row_ids, col_ids] = 1.0
+                    wmat[row_ids, col_ids] = w[edge_pos] if w is not None else 1.0
+            self.buckets.append((idx, wmat, valid))
             self.vertex_order_parts.append(members)
 
         vertex_order = (

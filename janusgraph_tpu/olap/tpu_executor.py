@@ -57,9 +57,9 @@ class _DeviceGraph:
 
 def _segment_ids(indptr: np.ndarray, m: int) -> np.ndarray:
     """indptr -> per-edge destination segment ids (repeat encoding)."""
-    return np.repeat(
-        np.arange(len(indptr) - 1, dtype=np.int32), np.diff(indptr)
-    )[:m]
+    from janusgraph_tpu import native
+
+    return native.segment_ids(indptr, m)
 
 
 def _segment_reduce(jnp, op: str, data, segment_ids, num_segments: int):
