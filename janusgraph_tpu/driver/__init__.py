@@ -1,0 +1,12 @@
+"""Client-side driver: serialization modules + remote clients.
+
+Capability parity with the reference's driver module (janusgraph-driver:
+GraphSON/GraphBinary serializer registration — JanusGraphSONModule.java:195,
+GraphBinary JanusGraphTypeSerializer.java:94, RelationIdentifier.java:131 —
+a storage-dependency-free client library).
+"""
+
+from janusgraph_tpu.driver.relation_identifier import RelationIdentifier  # noqa: F401
+from janusgraph_tpu.driver.graphson import graphson_dumps, graphson_loads  # noqa: F401
+from janusgraph_tpu.driver.graphbinary import binary_dumps, binary_loads  # noqa: F401
+from janusgraph_tpu.driver.client import JanusGraphClient  # noqa: F401

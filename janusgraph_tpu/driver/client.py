@@ -1,0 +1,177 @@
+"""Remote client: submit queries over HTTP or WebSocket.
+
+Capability parity with the reference's remote driver usage (gremlin-driver
+Cluster/Client against JanusGraphServer — here a dependency-free client
+speaking the server's JSON protocol with GraphSON-typed results).
+"""
+
+from __future__ import annotations
+
+import base64
+import hashlib
+import json
+import os
+import socket
+import struct
+from typing import Any, Optional
+from urllib import request as _urlreq
+
+from janusgraph_tpu.driver.graphson import _decode  # typed-JSON reader
+
+
+class RemoteError(Exception):
+    def __init__(self, code, message):
+        super().__init__(f"[{code}] {message}")
+        self.code = code
+
+
+class JanusGraphClient:
+    """HTTP client; `ws()` upgrades to a persistent WebSocket session."""
+
+    def __init__(
+        self,
+        host: str = "127.0.0.1",
+        port: int = 8182,
+        username: Optional[str] = None,
+        password: Optional[str] = None,
+        token: Optional[str] = None,
+    ):
+        self.base = f"http://{host}:{port}"
+        self.host, self.port = host, port
+        self.username, self.password = username, password
+        self.token = token
+
+    # ----------------------------------------------------------------- auth
+    def _auth_header(self) -> dict:
+        if self.token:
+            return {"Authorization": f"Token {self.token}"}
+        if self.username is not None:
+            raw = base64.b64encode(
+                f"{self.username}:{self.password}".encode()
+            ).decode()
+            return {"Authorization": f"Basic {raw}"}
+        return {}
+
+    def fetch_token(self) -> str:
+        body = json.dumps(
+            {"username": self.username, "password": self.password}
+        ).encode()
+        req = _urlreq.Request(
+            self.base + "/token", data=body, method="POST",
+            headers={"Content-Type": "application/json"},
+        )
+        with _urlreq.urlopen(req) as resp:
+            self.token = json.loads(resp.read())["token"]
+        return self.token
+
+    # ---------------------------------------------------------------- HTTP
+    def submit(self, gremlin: str, graph: Optional[str] = None) -> Any:
+        body = json.dumps({"gremlin": gremlin, "graph": graph}).encode()
+        req = _urlreq.Request(
+            self.base + "/gremlin", data=body, method="POST",
+            headers={"Content-Type": "application/json", **self._auth_header()},
+        )
+        with _urlreq.urlopen(req) as resp:
+            payload = json.loads(resp.read())
+        status = payload.get("status", {})
+        if status.get("code") != 200:
+            raise RemoteError(status.get("code"), status.get("message"))
+        return _decode(payload["result"]["data"])
+
+    def graphs(self) -> list:
+        req = _urlreq.Request(
+            self.base + "/graphs", headers=self._auth_header()
+        )
+        with _urlreq.urlopen(req) as resp:
+            return json.loads(resp.read())["graphs"]
+
+    def health(self) -> bool:
+        with _urlreq.urlopen(self.base + "/health") as resp:
+            return json.loads(resp.read()).get("status") == "ok"
+
+    # ------------------------------------------------------------ WebSocket
+    def ws(self) -> "WebSocketSession":
+        return WebSocketSession(self)
+
+
+class WebSocketSession:
+    """Persistent WS connection; submit() round-trips one JSON request."""
+
+    def __init__(self, client: JanusGraphClient):
+        self.client = client
+        self.sock = socket.create_connection((client.host, client.port))
+        key = base64.b64encode(os.urandom(16)).decode()
+        auth = client._auth_header()
+        auth_line = "".join(f"{k}: {v}\r\n" for k, v in auth.items())
+        handshake = (
+            f"GET /gremlin HTTP/1.1\r\n"
+            f"Host: {client.host}:{client.port}\r\n"
+            f"Upgrade: websocket\r\nConnection: Upgrade\r\n"
+            f"Sec-WebSocket-Key: {key}\r\n"
+            f"Sec-WebSocket-Version: 13\r\n{auth_line}\r\n"
+        )
+        self.sock.sendall(handshake.encode())
+        # read response headers
+        buf = b""
+        while b"\r\n\r\n" not in buf:
+            chunk = self.sock.recv(4096)
+            if not chunk:
+                raise ConnectionError("handshake failed")
+            buf += chunk
+        status_line = buf.split(b"\r\n", 1)[0].decode()
+        if " 101 " not in status_line:
+            raise ConnectionError(f"ws upgrade rejected: {status_line}")
+
+    def submit(self, gremlin: str, graph: Optional[str] = None) -> Any:
+        self._send(json.dumps({"gremlin": gremlin, "graph": graph}))
+        payload = json.loads(self._recv())
+        status = payload.get("status", {})
+        if status.get("code") != 200:
+            raise RemoteError(status.get("code"), status.get("message"))
+        return _decode(payload["result"]["data"])
+
+    def close(self) -> None:
+        try:
+            self.sock.sendall(b"\x88\x80" + os.urandom(4))  # masked close
+        except OSError:
+            pass
+        self.sock.close()
+
+    # client frames MUST be masked per RFC6455
+    def _send(self, text: str) -> None:
+        payload = text.encode()
+        mask = os.urandom(4)
+        masked = bytes(c ^ mask[i % 4] for i, c in enumerate(payload))
+        n = len(payload)
+        hdr = bytearray([0x81])
+        if n < 126:
+            hdr.append(0x80 | n)
+        elif n < (1 << 16):
+            hdr.append(0x80 | 126)
+            hdr += struct.pack(">H", n)
+        else:
+            hdr.append(0x80 | 127)
+            hdr += struct.pack(">Q", n)
+        self.sock.sendall(bytes(hdr) + mask + masked)
+
+    def _recv(self) -> str:
+        hdr = self._read_exact(2)
+        b1, b2 = hdr
+        length = b2 & 0x7F
+        if length == 126:
+            (length,) = struct.unpack(">H", self._read_exact(2))
+        elif length == 127:
+            (length,) = struct.unpack(">Q", self._read_exact(8))
+        payload = self._read_exact(length)
+        if (b1 & 0x0F) == 0x8:
+            raise ConnectionError("server closed")
+        return payload.decode()
+
+    def _read_exact(self, n: int) -> bytes:
+        buf = b""
+        while len(buf) < n:
+            chunk = self.sock.recv(n - len(buf))
+            if not chunk:
+                raise ConnectionError("connection closed")
+            buf += chunk
+        return buf

@@ -46,18 +46,26 @@ def _load() -> Optional[ctypes.CDLL]:
             return None
         so = _so_path()
         if not os.path.exists(so):
+            # unique tmp name: concurrent processes may compile at once;
+            # os.replace makes whoever finishes last win atomically
+            tmp = f"{so}.{os.getpid()}.tmp"
             try:
                 subprocess.run(
                     [
                         "g++", "-O3", "-std=c++17", "-shared", "-fPIC",
-                        "-pthread", "-o", so + ".tmp", _SRC,
+                        "-pthread", "-o", tmp, _SRC,
                     ],
                     check=True,
                     capture_output=True,
                     timeout=120,
                 )
-                os.replace(so + ".tmp", so)
+                os.replace(tmp, so)
             except (OSError, subprocess.SubprocessError):
+                if os.path.exists(tmp):
+                    try:
+                        os.unlink(tmp)
+                    except OSError:
+                        pass
                 return None
         try:
             lib = ctypes.CDLL(so)

@@ -51,10 +51,13 @@ void build_csr(int64_t n, int64_t m,
   }
 }
 
-// Expand an indptr into per-slot segment ids: seg[indptr[v]..indptr[v+1]) = v
+// Expand an indptr into per-slot segment ids: seg[indptr[v]..indptr[v+1]) = v,
+// clamped to the output buffer length m (matching numpy repeat(...)[:m]).
 void segment_ids(int64_t n, int64_t m, const int64_t* indptr, int32_t* seg) {
   for (int64_t v = 0; v < n; ++v) {
-    for (int64_t e = indptr[v]; e < indptr[v + 1]; ++e) seg[e] = (int32_t)v;
+    int64_t lo = std::min(indptr[v], m);
+    int64_t hi = std::min(indptr[v + 1], m);
+    for (int64_t e = lo; e < hi; ++e) seg[e] = (int32_t)v;
   }
 }
 
@@ -90,16 +93,23 @@ static inline uint64_t splitmix64(uint64_t& s) {
 void rmat_edges(int64_t scale, int64_t m, uint64_t seed,
                 double a, double b, double c,
                 int32_t* src, int32_t* dst) {
+  // fixed chunk grid (NOT thread-count-dependent): the same seed yields the
+  // same edge list on any machine; threads just pick up chunks
+  const int64_t NCHUNKS = 64;
   unsigned nthreads = std::thread::hardware_concurrency();
   if (nthreads == 0) nthreads = 1;
   if (nthreads > 16) nthreads = 16;
-  int64_t chunk = (m + nthreads - 1) / nthreads;
+  int64_t chunk = (m + NCHUNKS - 1) / NCHUNKS;
+  std::atomic<int64_t> next_chunk(0);
   std::vector<std::thread> ts;
   for (unsigned t = 0; t < nthreads; ++t) {
-    ts.emplace_back([=]() {
-      int64_t lo = (int64_t)t * chunk, hi = std::min(m, lo + chunk);
-      uint64_t s = seed + 0x1234567ULL * (t + 1);
-      for (int64_t i = lo; i < hi; ++i) {
+    ts.emplace_back([&, seed, scale, m, a, b, c, chunk]() {
+      for (;;) {
+        int64_t ci = next_chunk.fetch_add(1);
+        if (ci >= NCHUNKS) break;
+        int64_t lo = ci * chunk, hi = std::min(m, lo + chunk);
+        uint64_t s = seed + 0x9e3779b97f4a7c15ULL * (uint64_t)(ci + 1);
+        for (int64_t i = lo; i < hi; ++i) {
         uint32_t u = 0, v = 0;
         for (int64_t bit = 0; bit < scale; ++bit) {
           double r = (double)(splitmix64(s) >> 11) * (1.0 / 9007199254740992.0);
@@ -113,6 +123,7 @@ void rmat_edges(int64_t scale, int64_t m, uint64_t seed,
         }
         src[i] = (int32_t)u;
         dst[i] = (int32_t)v;
+        }
       }
     });
   }

@@ -1,0 +1,17 @@
+"""Server layer: query endpoint, auth, multi-graph management.
+
+Capability parity with the reference's server stack (janusgraph-server:
+JanusGraphServer.java:44-49 over Gremlin Server; channelizers for WS/HTTP;
+HMAC/SASL/simple authenticators; graphdb/management/JanusGraphManager.java:49
+graph registry; core/ConfiguredGraphFactory.java:57 dynamic graphs).
+"""
+
+from janusgraph_tpu.server.manager import (  # noqa: F401
+    ConfiguredGraphFactory,
+    JanusGraphManager,
+)
+from janusgraph_tpu.server.auth import (  # noqa: F401
+    CredentialsAuthenticator,
+    HMACAuthenticator,
+)
+from janusgraph_tpu.server.server import JanusGraphServer  # noqa: F401

@@ -1,0 +1,354 @@
+"""Query server: HTTP + WebSocket endpoint over the traversal DSL.
+
+Capability parity with the reference's server
+(reference: janusgraph-server .../JanusGraphServer.java:44-49 — a Gremlin
+Server hosting named graphs/traversal sources with WS+HTTP channelizers,
+JanusGraphWsAndHttpChannelizer.java; auth per auth.py). Protocol shape
+mirrors the Gremlin Server HTTP API: POST a JSON request containing a query
+string, get back {"result": {"data": ...}, "status": {...}} with
+GraphSON-typed data. The same JSON request/response flows over the
+WebSocket endpoint (RFC6455 implemented inline — no external ws library in
+the image).
+
+Queries are evaluated against a sandboxed namespace holding ONLY the
+registered traversal sources (g_<name>, or `g` for the default graph) and
+the predicate vocabulary P — the analogue of the reference's
+gremlin-groovy sandbox. A bare traversal result is auto-iterated
+(`.to_list()`), like Gremlin Server does.
+"""
+
+from __future__ import annotations
+
+import ast
+import base64
+import hashlib
+import json
+import re
+import struct
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+
+from janusgraph_tpu.driver.graphson import graphson_dumps
+from janusgraph_tpu.server.auth import AuthenticationError
+from janusgraph_tpu.server.manager import JanusGraphManager
+
+_WS_MAGIC = "258EAFA5-E914-47DA-95CA-C5AB0DC85B11"
+
+#: AST node whitelist for the query DSL: expressions built from names,
+#: attribute/method chains, calls, literals and containers — no statements,
+#: comprehensions, lambdas, subscript tricks or operators beyond
+#: comparison/arith on literals. Combined with the dunder ban this closes
+#: the classic `().__class__.__bases__` escape hatches of raw eval.
+_ALLOWED_NODES = (
+    ast.Expression, ast.Call, ast.Attribute, ast.Name, ast.Load,
+    ast.Constant, ast.List, ast.Tuple, ast.Dict, ast.Set, ast.keyword,
+    ast.UnaryOp, ast.USub, ast.BinOp, ast.Add, ast.Sub, ast.Mult, ast.Div,
+    ast.Starred,
+)
+
+
+class QueryRejected(Exception):
+    pass
+
+
+def _validate_query(query: str) -> ast.Expression:
+    try:
+        tree = ast.parse(query, mode="eval")
+    except SyntaxError as e:
+        raise QueryRejected(f"syntax error: {e}") from None
+    for node in ast.walk(tree):
+        if not isinstance(node, _ALLOWED_NODES):
+            raise QueryRejected(
+                f"disallowed construct: {type(node).__name__}"
+            )
+        if isinstance(node, ast.Attribute) and node.attr.startswith("_"):
+            raise QueryRejected(f"disallowed attribute: {node.attr}")
+        if isinstance(node, ast.Name) and node.id.startswith("__"):
+            raise QueryRejected(f"disallowed name: {node.id}")
+    return tree
+
+
+def _evaluate(query: str, namespace: dict):
+    from janusgraph_tpu.core.traversal import GraphTraversal
+
+    tree = _validate_query(query)
+    result = eval(  # noqa: S307 - AST-whitelisted DSL, empty builtins
+        compile(tree, "<query>", "eval"), {"__builtins__": {}}, namespace
+    )
+    if isinstance(result, GraphTraversal):
+        result = result.to_list()
+    return result
+
+
+class JanusGraphServer:
+    """HTTP + WS query server over a JanusGraphManager registry."""
+
+    def __init__(
+        self,
+        manager: Optional[JanusGraphManager] = None,
+        default_graph: str = "graph",
+        authenticator=None,
+        host: str = "127.0.0.1",
+        port: int = 0,
+    ):
+        self.manager = manager or JanusGraphManager.get_instance()
+        self.default_graph = default_graph
+        self.authenticator = authenticator
+        self.host = host
+        self._port = port
+        self._httpd: Optional[ThreadingHTTPServer] = None
+        self._thread: Optional[threading.Thread] = None
+
+    # ------------------------------------------------------------ lifecycle
+    @property
+    def port(self) -> int:
+        return self._httpd.server_address[1] if self._httpd else self._port
+
+    def start(self) -> "JanusGraphServer":
+        server = self
+
+        class Handler(_Handler):
+            jg_server = server
+
+        self._httpd = ThreadingHTTPServer((self.host, self._port), Handler)
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, daemon=True
+        )
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        if self._httpd is not None:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+            self._httpd = None
+
+    # ------------------------------------------------------------ execution
+    def _namespace(self, query: str, graph_name: Optional[str]) -> dict:
+        from janusgraph_tpu.core.traversal import P
+
+        ns = {"P": P}
+        name = graph_name or self.default_graph
+        g = self.manager.get_graph(name)
+        if g is None:
+            raise KeyError(f"graph {name!r} not registered")
+        ns["g"] = g.traversal()
+        # only open sources the query actually references (each source holds
+        # an open transaction)
+        for other in set(re.findall(r"\bg_([A-Za-z0-9]\w*)", query)):
+            og = self.manager.get_graph(other)
+            if og is not None:
+                ns[f"g_{other}"] = og.traversal()
+        return ns
+
+    def execute(self, query: str, graph_name: Optional[str] = None):
+        from janusgraph_tpu.core.traversal import GraphTraversalSource
+
+        ns = self._namespace(query, graph_name)
+        try:
+            return _evaluate(query, ns)
+        finally:
+            for v in ns.values():
+                if isinstance(v, GraphTraversalSource):
+                    # release the source's transaction without reopening
+                    # (source.rollback() would start a fresh one)
+                    v.tx.rollback()
+
+    def authenticate_request(self, headers) -> Optional[str]:
+        """Returns username, or raises. None when auth is disabled."""
+        if self.authenticator is None:
+            return None
+        header = headers.get("Authorization", "")
+        if header.startswith("Basic "):
+            try:
+                raw = base64.b64decode(header[6:]).decode()
+                user, pw = raw.split(":", 1)
+            except Exception:
+                raise AuthenticationError("malformed basic auth")
+            return self.authenticator.credentials.authenticate(user, pw)
+        if header.startswith("Token "):
+            return self.authenticator.verify_token(header[6:])
+        raise AuthenticationError("missing Authorization header")
+
+
+class _Handler(BaseHTTPRequestHandler):
+    jg_server: JanusGraphServer = None
+    protocol_version = "HTTP/1.1"
+
+    def log_message(self, *args):  # silence default stderr chatter
+        pass
+
+    # --------------------------------------------------------------- helpers
+    def _send_json(self, code: int, payload: dict) -> None:
+        body = payload if isinstance(payload, bytes) else json.dumps(payload).encode()
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _auth(self) -> bool:
+        try:
+            self.jg_server.authenticate_request(self.headers)
+            return True
+        except AuthenticationError as e:
+            self._send_json(401, {"status": {"code": 401, "message": str(e)}})
+            return False
+
+    def _run_request(self, req: dict) -> dict:
+        query = req.get("gremlin", "")
+        graph = req.get("graph")
+        try:
+            result = self.jg_server.execute(query, graph)
+            data = json.loads(graphson_dumps(result))
+            return {"result": {"data": data}, "status": {"code": 200}}
+        except Exception as e:  # noqa: BLE001 - surface to client
+            return {
+                "result": {"data": None},
+                "status": {"code": 500, "message": f"{type(e).__name__}: {e}"},
+            }
+
+    # ----------------------------------------------------------------- HTTP
+    def do_GET(self):
+        if self.path == "/health":
+            self._send_json(200, {"status": "ok"})
+            return
+        if self.path == "/graphs":
+            if not self._auth():
+                return
+            self._send_json(
+                200, {"graphs": self.jg_server.manager.graph_names()}
+            )
+            return
+        if self.path.startswith("/gremlin") and (
+            self.headers.get("Upgrade", "").lower() == "websocket"
+        ):
+            self._websocket()
+            return
+        self._send_json(404, {"status": {"code": 404}})
+
+    def do_POST(self):
+        length = int(self.headers.get("Content-Length", 0))
+        raw = self.rfile.read(length)
+        if self.path == "/session" or self.path == "/token":
+            try:
+                req = json.loads(raw)
+                token = self.jg_server.authenticator.issue_token(
+                    req["username"], req["password"]
+                )
+                self._send_json(200, {"token": token})
+            except (AuthenticationError, KeyError, AttributeError) as e:
+                self._send_json(401, {"status": {"code": 401, "message": str(e)}})
+            return
+        if self.path == "/gremlin" or self.path == "/":
+            if not self._auth():
+                return
+            try:
+                req = json.loads(raw)
+            except json.JSONDecodeError:
+                self._send_json(400, {"status": {"code": 400, "message": "bad json"}})
+                return
+            self._send_json(200, self._run_request(req))
+            return
+        self._send_json(404, {"status": {"code": 404}})
+
+    # ------------------------------------------------------------ WebSocket
+    def _websocket(self) -> None:
+        if not self._auth():
+            return
+        key = self.headers.get("Sec-WebSocket-Key", "")
+        accept = base64.b64encode(
+            hashlib.sha1((key + _WS_MAGIC).encode()).digest()
+        ).decode()
+        self.send_response(101, "Switching Protocols")
+        self.send_header("Upgrade", "websocket")
+        self.send_header("Connection", "Upgrade")
+        self.send_header("Sec-WebSocket-Accept", accept)
+        self.end_headers()
+        sock = self.connection
+        try:
+            while True:
+                msg = _ws_recv(sock)
+                if msg is None:
+                    break
+                try:
+                    req = json.loads(msg)
+                except json.JSONDecodeError:
+                    _ws_send(sock, json.dumps(
+                        {"status": {"code": 400, "message": "bad json"}}
+                    ))
+                    continue
+                _ws_send(sock, json.dumps(self._run_request(req)))
+        except (ConnectionError, OSError):
+            pass
+        self.close_connection = True
+
+
+# ------------------------------------------------------- RFC6455 frame codec
+
+def _ws_recv(sock) -> Optional[str]:
+    """Read one text message (handles close/ping; no fragmentation)."""
+    while True:
+        hdr = _read_exact(sock, 2)
+        if hdr is None:
+            return None
+        b1, b2 = hdr
+        opcode = b1 & 0x0F
+        masked = b2 & 0x80
+        length = b2 & 0x7F
+        if length == 126:
+            ext = _read_exact(sock, 2)
+            if ext is None:
+                return None
+            (length,) = struct.unpack(">H", ext)
+        elif length == 127:
+            ext = _read_exact(sock, 8)
+            if ext is None:
+                return None
+            (length,) = struct.unpack(">Q", ext)
+        mask = _read_exact(sock, 4) if masked else b"\x00" * 4
+        if mask is None:
+            return None
+        payload = _read_exact(sock, length) if length else b""
+        if payload is None:
+            return None
+        if masked:
+            payload = bytes(
+                c ^ mask[i % 4] for i, c in enumerate(payload)
+            )
+        if opcode == 0x8:  # close
+            return None
+        if opcode == 0x9:  # ping -> pong
+            _ws_send_raw(sock, 0xA, payload)
+            continue
+        if opcode in (0x1, 0x2):
+            return payload.decode("utf-8")
+
+
+def _ws_send(sock, text: str) -> None:
+    _ws_send_raw(sock, 0x1, text.encode("utf-8"))
+
+
+def _ws_send_raw(sock, opcode: int, payload: bytes) -> None:
+    n = len(payload)
+    hdr = bytearray([0x80 | opcode])
+    if n < 126:
+        hdr.append(n)
+    elif n < (1 << 16):
+        hdr.append(126)
+        hdr += struct.pack(">H", n)
+    else:
+        hdr.append(127)
+        hdr += struct.pack(">Q", n)
+    sock.sendall(bytes(hdr) + payload)
+
+
+def _read_exact(sock, n: int) -> Optional[bytes]:
+    buf = b""
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            return None
+        buf += chunk
+    return buf
