@@ -1,0 +1,3 @@
+#!/usr/bin/env bash
+# Interactive console (reference analogue: janusgraph-dist bin/gremlin.sh)
+exec python -m janusgraph_tpu console "$@"

@@ -1,0 +1,4 @@
+#!/usr/bin/env bash
+# Launch the JanusGraph-TPU query server
+# (reference analogue: janusgraph-dist bin/janusgraph-server.sh)
+exec python -m janusgraph_tpu server "$@"

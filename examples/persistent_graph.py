@@ -1,0 +1,28 @@
+"""Persistent local-backend example (reference analogue: the berkeleyje
+example app): data survives process restarts via the WAL-backed store."""
+
+import sys
+import tempfile
+
+from janusgraph_tpu.core.graph import open_graph
+
+
+def main(directory: str) -> None:
+    cfg = {"storage.backend": "local", "storage.directory": directory}
+    g1 = open_graph(cfg)
+    mgmt = g1.management()
+    if g1.schema_cache.get_by_name("name") is None:
+        mgmt.make_property_key("name", str)
+    src = g1.traversal()
+    v = src.add_v()
+    v.property("name", "persisted!")
+    src.commit()
+    g1.close()
+
+    g2 = open_graph(cfg)
+    print("after reopen:", g2.traversal().V().values("name").to_list())
+    g2.close()
+
+
+if __name__ == "__main__":
+    main(sys.argv[1] if len(sys.argv) > 1 else tempfile.mkdtemp())

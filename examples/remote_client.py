@@ -1,0 +1,29 @@
+"""Remote access example: start a server, query it over HTTP and WebSocket
+(reference analogue: janusgraph-examples remote graph app)."""
+
+from janusgraph_tpu.core import gods
+from janusgraph_tpu.core.graph import open_graph
+from janusgraph_tpu.driver import JanusGraphClient
+from janusgraph_tpu.server import JanusGraphManager, JanusGraphServer
+
+
+def main() -> None:
+    graph = open_graph({"storage.backend": "inmemory"})
+    gods.load(graph)
+    manager = JanusGraphManager()
+    manager.put_graph("graph", graph)
+    server = JanusGraphServer(manager=manager).start()
+    try:
+        client = JanusGraphClient(port=server.port)
+        print("count over HTTP:", client.submit("g.V().count()"))
+        ws = client.ws()
+        print("names over WS:",
+              ws.submit("g.V().has('name','jupiter').out('brother').values('name')"))
+        ws.close()
+    finally:
+        server.stop()
+        graph.close()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,3 @@
+from janusgraph_tpu.cli import main
+
+raise SystemExit(main())

@@ -1,0 +1,143 @@
+"""Command-line entry points.
+
+Capability parity with the reference's dist scripts
+(reference: janusgraph-dist/src/assembly/static/bin/janusgraph-server.sh —
+start the server from a config file; gremlin.sh — interactive console;
+janusgraph.sh — combined lifecycle):
+
+  python -m janusgraph_tpu server  --config graph.json [--port 8182] [--auth]
+  python -m janusgraph_tpu console [--config graph.json | --remote host:port]
+  python -m janusgraph_tpu bench   [--scale N]
+"""
+
+from __future__ import annotations
+
+import argparse
+import code
+import json
+import sys
+from typing import Optional
+
+
+def _load_config(path: Optional[str]) -> dict:
+    if not path:
+        return {"storage.backend": "inmemory", "ids.authority-wait-ms": 0.0}
+    with open(path) as f:
+        return json.load(f)
+
+
+def cmd_server(args) -> int:
+    from janusgraph_tpu.core.graph import open_graph
+    from janusgraph_tpu.server import JanusGraphManager, JanusGraphServer
+
+    cfg = _load_config(args.config)
+    graph = open_graph(cfg)
+    if args.load_gods:
+        from janusgraph_tpu.core import gods
+
+        gods.load(graph)
+    manager = JanusGraphManager.get_instance()
+    manager.put_graph(args.graph_name, graph)
+
+    authenticator = None
+    if args.auth_credentials:
+        from janusgraph_tpu.core.graph import open_graph as _og
+        from janusgraph_tpu.server import (
+            CredentialsAuthenticator,
+            HMACAuthenticator,
+        )
+
+        creds_graph = _og(_load_config(args.auth_credentials))
+        authenticator = HMACAuthenticator(CredentialsAuthenticator(creds_graph))
+
+    server = JanusGraphServer(
+        manager=manager,
+        default_graph=args.graph_name,
+        authenticator=authenticator,
+        host=args.host,
+        port=args.port,
+    ).start()
+    print(f"JanusGraph-TPU server listening on {args.host}:{server.port}")
+    try:
+        import threading
+
+        threading.Event().wait()
+    except KeyboardInterrupt:
+        pass
+    finally:
+        server.stop()
+        graph.close()
+    return 0
+
+
+def cmd_console(args) -> int:
+    banner = "JanusGraph-TPU console — `g` is the traversal source, `P` the predicates"
+    ns = {}
+    if args.remote:
+        from janusgraph_tpu.driver import JanusGraphClient
+
+        host, _, port = args.remote.partition(":")
+        client = JanusGraphClient(host=host, port=int(port or 8182))
+        ns["client"] = client
+        ns["submit"] = client.submit
+        banner = (
+            "JanusGraph-TPU remote console — submit('g.V()...') runs on "
+            f"{args.remote}"
+        )
+    else:
+        from janusgraph_tpu.core.graph import open_graph
+        from janusgraph_tpu.core.traversal import P
+
+        graph = open_graph(_load_config(args.config))
+        if args.load_gods:
+            from janusgraph_tpu.core import gods
+
+            gods.load(graph)
+        ns.update({"graph": graph, "g": graph.traversal(), "P": P})
+    code.interact(banner=banner, local=ns)
+    return 0
+
+
+def cmd_bench(args) -> int:
+    import os
+
+    if args.scale:
+        os.environ["BENCH_SCALE"] = str(args.scale)
+    root = os.path.join(os.path.dirname(__file__), "..")
+    sys.path.insert(0, root)
+    import bench
+
+    bench.main()
+    return 0
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(prog="janusgraph_tpu")
+    sub = parser.add_subparsers(dest="command", required=True)
+
+    ps = sub.add_parser("server", help="start the query server")
+    ps.add_argument("--config", help="graph config JSON file")
+    ps.add_argument("--graph-name", default="graph")
+    ps.add_argument("--host", default="127.0.0.1")
+    ps.add_argument("--port", type=int, default=8182)
+    ps.add_argument("--auth-credentials", help="credentials-graph config JSON")
+    ps.add_argument("--load-gods", action="store_true",
+                    help="preload the Graph of the Gods example")
+    ps.set_defaults(fn=cmd_server)
+
+    pc = sub.add_parser("console", help="interactive console")
+    pc.add_argument("--config", help="graph config JSON file")
+    pc.add_argument("--remote", help="host:port of a running server")
+    pc.add_argument("--load-gods", action="store_true")
+    pc.set_defaults(fn=cmd_console)
+
+    pb = sub.add_parser("bench", help="run the benchmark")
+    pb.add_argument("--scale", type=int)
+    pb.set_defaults(fn=cmd_bench)
+
+    args = parser.parse_args(argv)
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,210 @@
+"""Distributed bulk input format + distributed index management.
+
+Capability parity with the reference's Hadoop integration
+(reference: janusgraph-hadoop .../formats/util/HadoopInputFormat.java:34 +
+HadoopRecordReader.java:111 — partition the edgestore into input splits and
+deserialize raw rows into star vertices via
+JanusGraphVertexDeserializer.java; MapReduceIndexManagement.java:276 — run
+index repair/remove jobs across splits at cluster scale).
+
+TPU-first re-design: splits are ID-partition ranges (the same structure the
+device mesh shards by — IDManager.partition_key_range), records are
+`StarVertex` (adjacency + properties of one vertex), and the cluster-scale
+consumers are (a) per-shard CSR loading for the sharded executor and
+(b) a worker-parallel distributed reindex driver. An external engine (or a
+multi-host launcher) can consume splits independently: each split reads
+only its own key range.
+"""
+
+from __future__ import annotations
+
+from concurrent.futures import ThreadPoolExecutor
+from dataclasses import dataclass, field
+from typing import Dict, Iterator, List, Optional, Sequence, Tuple
+
+from janusgraph_tpu.core.codecs import Direction
+from janusgraph_tpu.storage.kcvs import KeyRangeQuery, KeySliceQuery, SliceQuery
+
+
+@dataclass
+class StarVertex:
+    """One vertex with its full adjacency star (reference: TinkerPop
+    StarVertex as produced by JanusGraphVertexDeserializer)."""
+
+    vertex_id: int
+    label: str = "vertex"
+    properties: Dict[str, List[object]] = field(default_factory=dict)
+    #: out-edges as (edge_label, other_vertex_id, edge_properties)
+    edges: List[Tuple[str, int, Dict[str, object]]] = field(default_factory=list)
+
+
+@dataclass(frozen=True)
+class InputSplit:
+    """A unit of distributed read work: one contiguous ID-partition range
+    (reference: HadoopInputFormat.getSplits — one split per token range)."""
+
+    split_id: int
+    partitions: Tuple[int, ...]
+
+
+class GraphInputFormat:
+    """Splits + record reading over a graph's edgestore."""
+
+    def __init__(self, graph):
+        self.graph = graph
+        self.idm = graph.idm
+        self.es = graph.edge_serializer
+        self.st = graph.system_types
+
+    def splits(self, num_splits: Optional[int] = None) -> List[InputSplit]:
+        """Group the ID partitions into `num_splits` splits (defaults to one
+        split per partition)."""
+        nparts = self.idm.num_partitions
+        if num_splits is None or num_splits >= nparts:
+            return [InputSplit(p, (p,)) for p in range(nparts)]
+        num_splits = max(1, num_splits)
+        out: List[InputSplit] = []
+        for s in range(num_splits):
+            parts = tuple(range(nparts))[s::num_splits]
+            if parts:
+                out.append(InputSplit(s, parts))
+        return out
+
+    # ------------------------------------------------------------- reading
+    def read_split(self, split: InputSplit) -> Iterator[StarVertex]:
+        """Deserialize every live vertex row in the split into a StarVertex
+        (reference: HadoopRecordReader -> JanusGraphVertexDeserializer)."""
+        g = self.graph
+        btx = g.backend.begin_transaction()
+        store_tx = btx.store_tx
+        store = g.backend.edgestore
+        schema = _codec_schema(g)
+        exists_q = self.es.get_type_slice(self.st.EXISTS, False)
+        label_q = self.es.get_type_slice(
+            self.st.VERTEX_LABEL_EDGE, True, Direction.OUT
+        )
+        prop_q, edge_q = self.es.user_relations_bounds()
+        ordered = g.backend.manager.features.ordered_scan
+        ranges = [self.idm.partition_key_range(p) for p in split.partitions]
+
+        def rows():
+            if ordered:
+                for start, end in ranges:
+                    yield from store.get_keys(
+                        KeyRangeQuery(start, end, exists_q), store_tx
+                    )
+            else:
+                for key, entries in store.get_keys(exists_q, store_tx):
+                    if any(s <= key < e for s, e in ranges):
+                        yield key, entries
+
+        for key, _exist in rows():
+            vid = self.idm.get_vertex_id(key)
+            if not self.idm.is_user_vertex_id(vid):
+                continue
+            sv = StarVertex(vertex_id=self.idm.get_canonical_vertex_id(vid))
+            # label
+            for e in store.get_slice(KeySliceQuery(key, label_q), store_tx):
+                rc = self.es.parse_relation(e, self.st.type_info)
+                el = g.schema_cache.get_by_id(rc.other_vertex_id)
+                if el is not None:
+                    sv.label = el.name
+            # properties
+            for e in store.get_slice(KeySliceQuery(key, prop_q), store_tx):
+                try:
+                    rc = self.es.parse_relation(e, schema)
+                except KeyError:
+                    continue
+                pk = g.schema_cache.get_by_id(rc.type_id)
+                if pk is not None:
+                    sv.properties.setdefault(pk.name, []).append(rc.value)
+            # out-edges
+            for e in store.get_slice(KeySliceQuery(key, edge_q), store_tx):
+                try:
+                    rc = self.es.parse_relation(e, schema)
+                except KeyError:
+                    continue
+                if not rc.is_edge or rc.direction != Direction.OUT:
+                    continue
+                el = g.schema_cache.get_by_id(rc.type_id)
+                props = {}
+                if rc.properties:
+                    for tid, val in rc.properties.items():
+                        pk = g.schema_cache.get_by_id(tid)
+                        if pk is not None:
+                            props[pk.name] = val
+                sv.edges.append(
+                    (el.name if el else str(rc.type_id), rc.other_vertex_id, props)
+                )
+            yield sv
+
+    def read_all(self, num_splits: Optional[int] = None) -> Iterator[StarVertex]:
+        for split in self.splits(num_splits):
+            yield from self.read_split(split)
+
+
+def load_shard_csrs(graph, num_shards: int):
+    """One CSRGraph per shard of ID partitions — the bulk path feeding each
+    mesh device/host its own slice (reference: backend-specific binary input
+    formats feeding SparkGraphComputer executors)."""
+    from janusgraph_tpu.olap.csr import load_csr
+
+    fmt = GraphInputFormat(graph)
+    return [
+        load_csr(graph, partitions=list(split.partitions))
+        for split in fmt.splits(num_shards)
+    ]
+
+
+def _codec_schema(graph):
+    def lookup(type_id: int):
+        info = graph.system_types.type_info(type_id)
+        if info is not None:
+            return info
+        el = graph.schema_cache.get_by_id(type_id)
+        if el is None:
+            raise KeyError(type_id)
+        return el.type_info()
+
+    return lookup
+
+
+class DistributedIndexManagement:
+    """Worker-parallel index maintenance across input splits
+    (reference: MapReduceIndexManagement.java:276 running IndexRepairJob /
+    IndexRemoveJob as Hadoop MR jobs)."""
+
+    def __init__(self, graph, num_workers: int = 4):
+        self.graph = graph
+        self.num_workers = num_workers
+
+    def reindex(self, index_name: str):
+        """REINDEX across splits in parallel; returns merged ScanMetrics."""
+        from janusgraph_tpu.olap.jobs import IndexRepairJob
+        from janusgraph_tpu.storage.scan import ScanMetrics, StandardScanner
+
+        g = self.graph
+        idx = g.indexes.get(index_name)
+        if idx is None:
+            raise KeyError(f"no index named {index_name!r}")
+        fmt = GraphInputFormat(g)
+        splits = fmt.splits(self.num_workers)
+        merged = ScanMetrics()
+
+        def run_split(split: InputSplit) -> ScanMetrics:
+            job = IndexRepairJob(g, idx)
+            btx = g.backend.begin_transaction()
+            scanner = StandardScanner(
+                g.backend.edgestore,
+                btx.store_tx,
+                ordered_scan=g.backend.manager.features.ordered_scan,
+            )
+            ranges = [
+                g.idm.partition_key_range(p) for p in split.partitions
+            ]
+            return scanner.execute(job, key_ranges=ranges, num_workers=1)
+
+        with ThreadPoolExecutor(max_workers=self.num_workers) as pool:
+            for metrics in pool.map(run_split, splits):
+                merged.merge(metrics)
+        return merged

@@ -49,6 +49,13 @@ class ScanMetrics:
             self.rows_processed += processed
             self.rows_skipped += skipped
 
+    def merge(self, other: "ScanMetrics") -> None:
+        with self._lock:
+            self.rows_processed += other.rows_processed
+            self.rows_skipped += other.rows_skipped
+            for k, v in other.custom.items():
+                self.custom[k] = self.custom.get(k, 0) + v
+
 
 class ScanJob:
     """SPI for whole-store scans (reference: ScanJob.java:32)."""
