@@ -36,12 +36,70 @@ from janusgraph_tpu.olap.vertex_program import Combiner, EdgeTransform
 # Degree-bucketed ELL packing
 # --------------------------------------------------------------------------
 
+def fill_ell_rows(cap, starts_r, degs_r, src32, w32, idx, wmat, valid):
+    """Fill one ELL bucket's (rows, cap) matrices in place — native fast
+    path with a numpy fallback. Callers pre-fill idx with the sentinel and
+    wmat/valid with zeros."""
+    from janusgraph_tpu import native
+
+    if native.ell_fill(cap, starts_r, degs_r, src32, w32, idx, wmat, valid):
+        return
+    total = int(np.asarray(degs_r).sum())
+    if not total:
+        return
+    degs_r = np.asarray(degs_r, dtype=np.int64)
+    starts_r = np.asarray(starts_r, dtype=np.int64)
+    rows = len(starts_r)
+    row_ids = np.repeat(np.arange(rows), degs_r)
+    col_ids = np.arange(total) - np.repeat(
+        np.cumsum(degs_r) - degs_r, degs_r
+    )
+    edge_pos = np.repeat(starts_r, degs_r) + col_ids
+    idx[row_ids, col_ids] = src32[edge_pos]
+    valid[row_ids, col_ids] = 1.0
+    wmat[row_ids, col_ids] = w32[edge_pos] if w32 is not None else 1.0
+
+
+def split_rows(
+    members: np.ndarray,
+    deg_m: np.ndarray,
+    starts_m: np.ndarray,
+    cap: int,
+):
+    """Row-split supernode edge ranges into chunks of at most `cap` edges.
+
+    Returns (starts, degs, rowseg): one entry per row; rowseg maps each row
+    to its owner's slot index (position within `members`). Vertices with
+    degree <= cap keep one row. This bounds ELL padding at < 2× regardless
+    of max degree — a supernode costs ceil(d/cap) dense rows, not a bucket
+    padded to the global max degree (supernodes: SURVEY.md §5.7).
+    """
+    n_rows = np.maximum(1, -(-deg_m // cap)).astype(np.int64)
+    total = int(n_rows.sum())
+    rowseg = np.repeat(np.arange(len(members), dtype=np.int64), n_rows)
+    chunk = (
+        np.arange(total, dtype=np.int64)
+        - np.repeat(np.cumsum(n_rows) - n_rows, n_rows)
+    )
+    starts = np.repeat(starts_m, n_rows) + chunk * cap
+    degs = np.minimum(cap, np.repeat(deg_m, n_rows) - chunk * cap)
+    degs = np.maximum(degs, 0)
+    return starts, degs, rowseg
+
+
 class ELLPack:
     """Host-side ELLPACK layout of an edge list grouped by destination.
 
     For each power-of-two capacity bucket c: the destinations whose in-degree
-    d satisfies prev_c < d <= c, with a (n_c, c) matrix of source indices
-    (padded with a sentinel slot) and a (n_c, c) weight/validity matrix.
+    d satisfies prev_c < d <= c, with a (rows, c) matrix of source indices
+    (padded with a sentinel slot) and a (rows, c) weight/validity matrix.
+    Destinations with degree > max_capacity are ROW-SPLIT into ceil(d/cap)
+    rows of the top bucket; `rowseg` then folds row partials into one slot
+    per destination with a small (rows-sized, not edges-sized) segment
+    reduction.
+
+    Bucket tuple: (idx, wmat, valid, rowseg, num_slots); rowseg is None when
+    rows == slots (no split rows in that bucket).
 
     `sentinel` is index `n` — callers extend the per-vertex message vector by
     one identity element so padded slots read the monoid identity.
@@ -71,16 +129,12 @@ class ELLPack:
         indptr = np.zeros(n + 1, dtype=np.int64)
         np.cumsum(deg, out=indptr[1:])
 
-        # bucket capacity per vertex: next power of two >= degree (min 1);
-        # degrees beyond max_capacity clamp into one jumbo bucket padded to
-        # the true max degree (supernodes: SURVEY.md §5.7)
+        # bucket capacity per vertex: next power of two >= degree (min 1),
+        # clamped to max_capacity (larger degrees row-split, see split_rows)
         caps = np.maximum(1, 1 << np.ceil(np.log2(np.maximum(deg, 1))).astype(np.int64))
         caps = np.minimum(caps, max_capacity)
-        max_deg = int(deg.max()) if n else 0
-        if max_deg > max_capacity:
-            caps[deg > max_capacity] = max_deg
 
-        self.buckets: List[Tuple[np.ndarray, np.ndarray, np.ndarray]] = []
+        self.buckets: List[Tuple] = []
         self.vertex_order_parts: List[np.ndarray] = []
         src32 = np.ascontiguousarray(src, dtype=np.int32)
         w32 = (
@@ -90,27 +144,26 @@ class ELLPack:
             members = np.nonzero(caps == c)[0]
             if len(members) == 0:
                 continue
-            idx = np.full((len(members), c), self.sentinel, dtype=np.int32)
-            wmat = np.zeros((len(members), c), dtype=np.float32)
-            valid = np.zeros((len(members), c), dtype=np.float32)
             deg_m = deg[members]
-            from janusgraph_tpu import native
-
-            if not native.ell_fill(
-                c, indptr[members], deg_m, src32, w32, idx, wmat, valid
-            ):
-                # numpy fallback: flatten each member's edge range
-                total = int(deg_m.sum())
-                if total:
-                    row_ids = np.repeat(np.arange(len(members)), deg_m)
-                    col_ids = np.arange(total) - np.repeat(
-                        np.cumsum(deg_m) - deg_m, deg_m
-                    )
-                    edge_pos = np.repeat(indptr[members], deg_m) + col_ids
-                    idx[row_ids, col_ids] = src[edge_pos]
-                    valid[row_ids, col_ids] = 1.0
-                    wmat[row_ids, col_ids] = w[edge_pos] if w is not None else 1.0
-            self.buckets.append((idx, wmat, valid))
+            starts_m = indptr[members]
+            if c == max_capacity and int(deg_m.max()) > c:
+                starts_r, degs_r, rowseg = split_rows(members, deg_m, starts_m, c)
+            else:
+                starts_r, degs_r, rowseg = starts_m, deg_m, None
+            rows = len(starts_r)
+            idx = np.full((rows, c), self.sentinel, dtype=np.int32)
+            wmat = np.zeros((rows, c), dtype=np.float32)
+            valid = np.zeros((rows, c), dtype=np.float32)
+            fill_ell_rows(c, starts_r, degs_r, src32, w32, idx, wmat, valid)
+            self.buckets.append(
+                (
+                    idx,
+                    wmat,
+                    valid,
+                    rowseg.astype(np.int32) if rowseg is not None else None,
+                    len(members),
+                )
+            )
             self.vertex_order_parts.append(members)
 
         vertex_order = (
@@ -129,8 +182,14 @@ class ELLPack:
             lambda a: __import__("jax").device_put(a, sharding)
         )
         self.buckets = [
-            (put(jnp.asarray(i)), put(jnp.asarray(w)), put(jnp.asarray(v)))
-            for (i, w, v) in self.buckets
+            (
+                put(jnp.asarray(i)),
+                put(jnp.asarray(w)),
+                put(jnp.asarray(v)),
+                put(jnp.asarray(rs)) if rs is not None else None,
+                ns,
+            )
+            for (i, w, v, rs, ns) in self.buckets
         ]
         self.unpermute = put(jnp.asarray(self.unpermute))
         return self
@@ -158,8 +217,8 @@ def ell_aggregate(
         [msgs, jnp.full(pad_shape, identity, dtype=msgs.dtype)], axis=0
     )
     parts = []
-    for idx, w, valid in pack.buckets:
-        m = msgs_ext[idx]  # (n_c, c) or (n_c, c, k)
+    for idx, w, valid, rowseg, num_slots in pack.buckets:
+        m = msgs_ext[idx]  # (rows, c) or (rows, c, k)
         if m.ndim == 3:
             w_ = w[:, :, None]
             valid_ = valid[:, :, None]
@@ -171,11 +230,23 @@ def ell_aggregate(
             m = m + w_
         m = jnp.where(valid_ > 0, m, identity)
         if op == Combiner.SUM:
-            parts.append(m.sum(axis=1))
+            r = m.sum(axis=1)
         elif op == Combiner.MIN:
-            parts.append(m.min(axis=1))
+            r = m.min(axis=1)
         else:
-            parts.append(m.max(axis=1))
+            r = m.max(axis=1)
+        if rowseg is not None:
+            # fold supernode row partials into one slot per destination —
+            # a rows-sized reduction, negligible next to the edge gather
+            import jax
+
+            seg_fn = {
+                Combiner.SUM: jax.ops.segment_sum,
+                Combiner.MIN: jax.ops.segment_min,
+                Combiner.MAX: jax.ops.segment_max,
+            }[op]
+            r = seg_fn(r, rowseg, num_segments=num_slots)
+        parts.append(r)
     if not parts:
         out_shape = msgs.shape
         return jnp.full(out_shape, identity, dtype=msgs.dtype)

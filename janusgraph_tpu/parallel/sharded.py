@@ -8,10 +8,18 @@ the data plane is XLA collectives over ICI:
   - vertex state and in-edge CSR blocks are sharded over the mesh axis by
     contiguous vertex-index blocks (the analogue of the reference's
     partition-prefixed key ranges, IDManager.getKey:480);
-  - each superstep all_gathers the per-vertex message vector (O(n) on ICI),
-    gathers per-edge messages locally, and segment-reduces into the local
-    shard — replacing Fulgora's pull-based reversed slice rescans
-    (VertexProgramScanJob.java:114-135);
+  - each superstep exchanges ONLY boundary messages: at build time every
+    (src-shard q → dst-shard s) pair gets a bucket of the distinct source
+    vertices in q whose messages s actually needs (q's boundary set toward
+    s); the superstep gathers those values and swaps buckets with ONE
+    `lax.all_to_all` over ICI — per-shard comm volume is S·B elements
+    (B = max boundary-bucket size) instead of the full O(n) vertex vector an
+    all_gather would move. This replaces Fulgora's pull-based reversed slice
+    rescans (VertexProgramScanJob.java:114-135) the way FulgoraVertexMemory
+    holds only the messages each worker consumes (FulgoraVertexMemory.java:91-99);
+  - local aggregation uses a degree-bucketed ELL layout (gather + dense
+    axis-1 reduction, no scatter — see olap/kernels.py) whose bucket shapes
+    are made uniform across shards so one SPMD program serves the mesh;
   - global aggregators reduce with psum/pmin/pmax at the superstep barrier —
     replacing FulgoraMemory's in-process sub-round barrier;
   - vertex-cut merging is subsumed at CSR-load canonicalization.
@@ -27,7 +35,7 @@ test technique.
 
 from __future__ import annotations
 
-from typing import Dict, Optional, Tuple
+from typing import Dict, List, Optional, Tuple
 
 import numpy as np
 
@@ -38,6 +46,8 @@ from janusgraph_tpu.olap.vertex_program import (
     Memory,
     VertexProgram,
 )
+
+_ELL_MAX_CAPACITY = 1 << 14
 
 
 class ShardedCSR:
@@ -50,6 +60,21 @@ class ShardedCSR:
       in_dst_loc   (S*Em,) int32  — destination index local to its shard
       in_valid     (S*Em,) float32
       in_weight    (S*Em,) float32 (all ones if unweighted)
+
+    Boundary-exchange plan (the all-to-all schedule):
+      boundary_width B — max distinct cross-shard sources any (q→s) pair needs
+      send_idx     (S*S, B) int32 — row q*S+s: indices LOCAL TO q of the
+                   sources q must send to s (padded with 0; padded slots are
+                   transmitted but never referenced by any receiver)
+      in_src_tab   (S*Em,) int32 — per-edge index into the superstep message
+                   table [own outgoing (Np) ++ received buckets (S*B)]
+
+    Uniform ELL pack (SPMD-identical bucket shapes across shards):
+      ell_buckets  list of (idx (S*N_c, c) int32, w (S*N_c, c) f32,
+                   valid (S*N_c, c) f32); idx indexes the message table,
+                   sentinel = Np + S*B
+      ell_unpermute (S*Np,) int32 — position of each local vertex in the
+                   concatenated bucket output (local length sum_c N_c)
     """
 
     def __init__(self, csr: CSRGraph, num_shards: int, undirected: bool):
@@ -76,27 +101,31 @@ class ShardedCSR:
             src, dst = np.concatenate([src, dst]), np.concatenate([dst, src])
             w = np.concatenate([w, w])
 
+        # sorting by dst groups edges by owning shard (shard = dst // Np is
+        # monotone in dst) AND keeps each shard's edges dst-sorted, which the
+        # ELL fill below requires
+        order = np.argsort(dst, kind="stable")
+        src, dst, w = src[order], dst[order], w[order]
         shard_of = dst // Np
         counts = np.bincount(shard_of, minlength=S)
         Em = int(counts.max()) if len(counts) else 0
         Em = max(Em, 1)
         self.edges_per_shard = Em
+        offsets = np.zeros(S + 1, dtype=np.int64)
+        np.cumsum(counts, out=offsets[1:])
 
         in_src_glob = np.zeros(S * Em, dtype=np.int32)
         in_dst_loc = np.zeros(S * Em, dtype=np.int32)
         in_valid = np.zeros(S * Em, dtype=np.float32)
         in_weight = np.ones(S * Em, dtype=np.float32)
-        order = np.argsort(shard_of, kind="stable")
-        offsets = np.zeros(S + 1, dtype=np.int64)
-        np.cumsum(counts, out=offsets[1:])
         for s in range(S):
-            sl = order[offsets[s] : offsets[s + 1]]
-            k = len(sl)
+            lo, hi = offsets[s], offsets[s + 1]
+            k = hi - lo
             base = s * Em
-            in_src_glob[base : base + k] = src[sl]
-            in_dst_loc[base : base + k] = dst[sl] - s * Np
+            in_src_glob[base : base + k] = src[lo:hi]
+            in_dst_loc[base : base + k] = dst[lo:hi] - s * Np
             in_valid[base : base + k] = 1.0
-            in_weight[base : base + k] = w[sl]
+            in_weight[base : base + k] = w[lo:hi]
 
         out_degree = np.zeros(S * Np, dtype=np.float32)
         out_degree[:n] = csr.out_degree
@@ -109,6 +138,181 @@ class ShardedCSR:
         self.in_dst_loc = in_dst_loc
         self.in_valid = in_valid
         self.in_weight = in_weight
+
+        # retained for the lazily-built exchange plan / ELL pack — each
+        # executor configuration pays only for the structures it ships
+        self._src_sorted = src
+        self._offsets = offsets
+        self._exchange_built = False
+        self._ell_built = False
+
+    def ensure_exchange_plan(self) -> None:
+        """Build the boundary all-to-all plan (send_idx / in_src_tab) once,
+        on first use — the gather/segment debug path never pays for it."""
+        if self._exchange_built:
+            return
+        self._exchange_built = True
+        S, Np, Em = self.num_shards, self.shard_size, self.edges_per_shard
+        src, offsets = self._src_sorted, self._offsets
+
+        # distinct sources per (q → s) pair
+        uniq: Dict[Tuple[int, int], np.ndarray] = {}
+        inv_parts: List[Tuple[int, np.ndarray, int, np.ndarray]] = []
+        B = 1
+        for s in range(S):
+            lo, hi = offsets[s], offsets[s + 1]
+            ssrc = src[lo:hi]
+            qof = ssrc // Np
+            for q in range(S):
+                if q == s:
+                    continue
+                m = np.nonzero(qof == q)[0]
+                if len(m) == 0:
+                    continue
+                u, inv = np.unique(ssrc[m], return_inverse=True)
+                uniq[(q, s)] = u
+                inv_parts.append((s, m, q, inv))
+                B = max(B, len(u))
+        self.boundary_width = B
+
+        send_idx = np.zeros((S * S, B), dtype=np.int32)
+        for (q, s), u in uniq.items():
+            send_idx[q * S + s, : len(u)] = u - q * Np
+        self.send_idx = send_idx
+
+        in_src_tab = np.zeros(S * Em, dtype=np.int32)
+        for s in range(S):
+            lo, hi = offsets[s], offsets[s + 1]
+            k = hi - lo
+            ssrc = src[lo:hi]
+            local = (ssrc // Np) == s
+            seg = in_src_tab[s * Em : s * Em + k]
+            seg[local] = (ssrc[local] - s * Np).astype(np.int32)
+        for s, m, q, inv in inv_parts:
+            in_src_tab[s * Em + m] = (Np + q * B + inv).astype(np.int32)
+        self.in_src_tab = in_src_tab
+        self.msg_table_len = Np + S * B
+        # per-superstep comm volume (elements/shard): a2a vs all_gather
+        self.comm_a2a_elems = S * B
+        self.comm_gather_elems = self.padded_n
+
+    def ensure_ell(self) -> None:
+        """Build the uniform ELL pack once, on first use (requires the
+        exchange plan: ELL indices point into the a2a message table)."""
+        if self._ell_built:
+            return
+        self.ensure_exchange_plan()
+        self._ell_built = True
+        self._build_uniform_ell(self._offsets, self.edges_per_shard)
+
+    def _build_uniform_ell(self, offsets: np.ndarray, Em: int) -> None:
+        """Per-shard degree-bucketed ELL with bucket shapes made UNIFORM
+        across shards (pad each capacity's row count to the max over shards)
+        so the pack can be passed through shard_map as plain sharded arrays
+        (SPMD requires identical per-shard shapes)."""
+        from janusgraph_tpu import native
+
+        S, Np = self.num_shards, self.shard_size
+        sentinel = self.msg_table_len
+
+        deg = np.zeros((S, Np), dtype=np.int64)
+        indptr = np.zeros((S, Np + 1), dtype=np.int64)
+        for s in range(S):
+            k = int(offsets[s + 1] - offsets[s])
+            d = np.bincount(
+                self.in_dst_loc[s * Em : s * Em + k].astype(np.int64),
+                minlength=Np,
+            )
+            deg[s] = d
+            np.cumsum(d, out=indptr[s, 1:])
+
+        # capacity per vertex: next pow2 >= degree (min 1), clamped to the
+        # max capacity — larger degrees row-split into ceil(d/cap) rows of
+        # the top bucket, folded by a rows-sized segment reduce (supernodes:
+        # SURVEY.md §5.7; avoids padding a jumbo bucket to the max degree)
+        caps = np.maximum(
+            1, 1 << np.ceil(np.log2(np.maximum(deg, 1))).astype(np.int64)
+        )
+        caps = np.minimum(caps, _ELL_MAX_CAPACITY)
+
+        from janusgraph_tpu.olap.kernels import split_rows
+
+        cap_set = sorted(set(int(c) for c in np.unique(caps)))
+        self.ell_buckets: List[Tuple] = []
+        # static per-bucket metadata: None (rows == slots) or the slot count
+        # (+1 dead slot for padded rows) of a row-split bucket
+        self.ell_meta: List[Optional[int]] = []
+        unpermute = np.zeros(S * Np, dtype=np.int32)
+        out_off = 0
+        for c in cap_set:
+            members_per_shard = [
+                np.nonzero(caps[s] == c)[0] for s in range(S)
+            ]
+            split = c == _ELL_MAX_CAPACITY and any(
+                len(m) and int(deg[s][m].max()) > c
+                for s, m in enumerate(members_per_shard)
+            )
+            shard_rows = []
+            for s in range(S):
+                m = members_per_shard[s]
+                if split:
+                    shard_rows.append(
+                        split_rows(m, deg[s][m], indptr[s][m], c)
+                    )
+                else:
+                    shard_rows.append(
+                        (indptr[s][m], deg[s][m],
+                         np.arange(len(m), dtype=np.int64))
+                    )
+            N_rows = max(len(r[0]) for r in shard_rows)
+            N_slots = max(len(m) for m in members_per_shard)
+            if N_rows == 0:
+                continue
+            idx = np.full((S * N_rows, c), sentinel, dtype=np.int32)
+            wmat = np.zeros((S * N_rows, c), dtype=np.float32)
+            valid = np.zeros((S * N_rows, c), dtype=np.float32)
+            # padded rows point at the dead slot (N_slots) and are dropped
+            rowseg = np.full(S * N_rows, N_slots, dtype=np.int32)
+            for s in range(S):
+                members = members_per_shard[s]
+                starts_r, degs_r, rseg = shard_rows[s]
+                rows = len(starts_r)
+                if rows == 0:
+                    continue
+                src32 = np.ascontiguousarray(
+                    self.in_src_tab[s * Em : (s + 1) * Em], dtype=np.int32
+                )
+                w32 = np.ascontiguousarray(
+                    self.in_weight[s * Em : (s + 1) * Em], dtype=np.float32
+                )
+                bidx = idx[s * N_rows : s * N_rows + rows]
+                bw = wmat[s * N_rows : s * N_rows + rows]
+                bv = valid[s * N_rows : s * N_rows + rows]
+                if not native.ell_fill(c, starts_r, degs_r, src32, w32, bidx, bw, bv):
+                    total = int(degs_r.sum())
+                    if total:
+                        row_ids = np.repeat(np.arange(rows), degs_r)
+                        col_ids = np.arange(total) - np.repeat(
+                            np.cumsum(degs_r) - degs_r, degs_r
+                        )
+                        edge_pos = np.repeat(starts_r, degs_r) + col_ids
+                        bidx[row_ids, col_ids] = src32[edge_pos]
+                        bv[row_ids, col_ids] = 1.0
+                        bw[row_ids, col_ids] = w32[edge_pos]
+                rowseg[s * N_rows : s * N_rows + rows] = rseg.astype(np.int32)
+                unpermute[s * Np + members] = (
+                    out_off + np.arange(len(members))
+                ).astype(np.int32)
+            if split:
+                self.ell_buckets.append((idx, wmat, valid, rowseg))
+                self.ell_meta.append(N_slots)
+                out_off += N_slots
+            else:
+                self.ell_buckets.append((idx, wmat, valid))
+                self.ell_meta.append(None)
+                out_off += N_rows
+        self.ell_unpermute = unpermute
+        self.ell_out_len = out_off
 
 
 class _GlobalView:
@@ -133,17 +337,23 @@ class _ShardView:
         self.active = active
 
 
-_PREDUCE = {
-    Combiner.SUM: "psum",
-    Combiner.MIN: "pmin",
-    Combiner.MAX: "pmax",
-}
-
-
 class ShardedExecutor:
-    """BSP executor over a jax.sharding.Mesh (1-D axis 'p')."""
+    """BSP executor over a jax.sharding.Mesh (1-D axis 'p').
 
-    def __init__(self, csr: CSRGraph, mesh=None, axis: str = "p"):
+    exchange: "a2a" (default) — boundary-bucket lax.all_to_all;
+              "gather" — full-vector all_gather (debug/reference path).
+    agg:      "ell" (default) — uniform degree-bucketed ELL (no scatter);
+              "segment" — flat segment reduction.
+    """
+
+    def __init__(
+        self,
+        csr: CSRGraph,
+        mesh=None,
+        axis: str = "p",
+        exchange: str = "a2a",
+        agg: str = "ell",
+    ):
         import jax
         from jax.sharding import Mesh
 
@@ -155,25 +365,74 @@ class ShardedExecutor:
         self.mesh = mesh
         self.num_shards = mesh.devices.size
         self.csr = csr
-        self._compiled: Dict[Tuple[str, bool], object] = {}
+        if exchange == "gather" and agg == "ell":
+            agg = "segment"  # ELL indexes the a2a message table only
+        self.exchange = exchange
+        self.agg = agg
+        self._compiled: Dict[Tuple, object] = {}
         self._sharded_cache: Dict[bool, ShardedCSR] = {}
+        self._device_cache: Dict[Tuple[bool, str], object] = {}
+
+    def comm_stats(self, undirected: bool = False) -> Dict[str, int]:
+        """Per-superstep exchange volume in elements per shard."""
+        sc = self._sharded(undirected)
+        sc.ensure_exchange_plan()
+        return {
+            "a2a_elems": sc.comm_a2a_elems,
+            "gather_elems": sc.comm_gather_elems,
+            "boundary_width": sc.boundary_width,
+        }
 
     def _sharded(self, undirected: bool) -> ShardedCSR:
         sc = self._sharded_cache.get(undirected)
         if sc is None:
             sc = ShardedCSR(self.csr, self.num_shards, undirected)
-            # place the static CSR blocks on the mesh ONCE, sharded over the
-            # axis — re-uploading them each superstep would dominate runtime
+            self._sharded_cache[undirected] = sc
+        return sc
+
+    def _dev(self, sc: ShardedCSR, undirected: bool, name: str):
+        """Device-put a ShardedCSR array once, sharded over the mesh axis —
+        re-uploading the static CSR blocks each superstep would dominate."""
+        key = (undirected, name)
+        arr = self._device_cache.get(key)
+        if arr is None:
             from jax.sharding import NamedSharding, PartitionSpec as P
 
             sharding = NamedSharding(self.mesh, P(self.axis))
-            for name in (
-                "out_degree", "active", "in_src_glob", "in_dst_loc",
-                "in_valid", "in_weight",
-            ):
-                setattr(sc, name, self.jax.device_put(getattr(sc, name), sharding))
-            self._sharded_cache[undirected] = sc
-        return sc
+            host = getattr(sc, name)
+            if name == "ell_buckets":
+                arr = tuple(
+                    tuple(self.jax.device_put(a, sharding) for a in bucket)
+                    for bucket in host
+                )
+            else:
+                arr = self.jax.device_put(host, sharding)
+            self._device_cache[key] = arr
+        return arr
+
+    def _graph_args(self, sc: ShardedCSR, undirected: bool) -> Dict[str, object]:
+        """The static per-shard graph arrays the configured body needs."""
+        g = {
+            "out_degree": self._dev(sc, undirected, "out_degree"),
+            "active": self._dev(sc, undirected, "active"),
+        }
+        if self.exchange == "a2a":
+            sc.ensure_exchange_plan()
+            g["send_idx"] = self._dev(sc, undirected, "send_idx")
+        if self.agg == "ell":
+            sc.ensure_ell()
+            g["ell_buckets"] = self._dev(sc, undirected, "ell_buckets")
+            g["ell_unpermute"] = self._dev(sc, undirected, "ell_unpermute")
+        else:
+            g["dst_loc"] = self._dev(sc, undirected, "in_dst_loc")
+            g["valid"] = self._dev(sc, undirected, "in_valid")
+            g["weight"] = self._dev(sc, undirected, "in_weight")
+            g["src_idx"] = (
+                self._dev(sc, undirected, "in_src_tab")
+                if self.exchange == "a2a"
+                else self._dev(sc, undirected, "in_src_glob")
+            )
+        return g
 
     def _shard_body(self, program: VertexProgram, op: str, sc: ShardedCSR):
         """The per-shard superstep body (traced inside shard_map)."""
@@ -181,44 +440,89 @@ class ShardedExecutor:
         import jax.numpy as jnp
 
         axis = self.axis
+        S = self.num_shards
         Np = sc.shard_size
         identity = Combiner.IDENTITY[op]
+        exchange, agg = self.exchange, self.agg
+        B = sc.boundary_width if exchange == "a2a" else 0
+
+        def seg_reduce_n(data, seg, n):
+            if op == Combiner.SUM:
+                return jax.ops.segment_sum(data, seg, num_segments=n)
+            if op == Combiner.MIN:
+                return jax.ops.segment_min(data, seg, num_segments=n)
+            return jax.ops.segment_max(data, seg, num_segments=n)
 
         def seg_reduce(data, seg):
-            if op == Combiner.SUM:
-                return jax.ops.segment_sum(data, seg, num_segments=Np)
-            if op == Combiner.MIN:
-                return jax.ops.segment_min(data, seg, num_segments=Np)
-            return jax.ops.segment_max(data, seg, num_segments=Np)
+            return seg_reduce_n(data, seg, Np)
 
-        def body(
-            state,          # pytree of (Np, ...) local arrays
-            step,           # scalar
-            memory_in,      # dict of replicated scalars
-            out_degree,     # (Np,)
-            active,         # (Np,)
-            src_glob,       # (Em,)
-            dst_loc,        # (Em,)
-            valid,          # (Em,)
-            weight,         # (Em,)
-        ):
+        def reduce_cols(m, axis_):
+            if op == Combiner.SUM:
+                return m.sum(axis=axis_)
+            if op == Combiner.MIN:
+                return m.min(axis=axis_)
+            return m.max(axis=axis_)
+
+        def body(state, step, memory_in, g):
             offset = jax.lax.axis_index(axis) * Np
-            view = _ShardView(sc.real_n, Np, offset, out_degree, active)
+            view = _ShardView(
+                sc.real_n, Np, offset, g["out_degree"], g["active"]
+            )
             outgoing = program.message(state, step, view, jnp)
-            # exchange: every shard needs message values for its in-edge
-            # sources — all_gather over ICI, then local gather
-            all_msgs = jax.lax.all_gather(outgoing, axis, axis=0, tiled=True)
-            msgs = all_msgs[src_glob]
-            if program.edge_transform == EdgeTransform.MUL_WEIGHT:
-                msgs = msgs * (weight[:, None] if msgs.ndim == 2 else weight)
-            elif program.edge_transform == EdgeTransform.ADD_WEIGHT:
-                msgs = msgs + (weight[:, None] if msgs.ndim == 2 else weight)
-            # mask padded edge slots to the monoid identity
-            vmask = valid[:, None] if msgs.ndim == 2 else valid
-            msgs = jnp.where(vmask > 0, msgs, identity)
-            agg = seg_reduce(msgs, dst_loc)
+            tail = tuple(outgoing.shape[1:])
+
+            # ---- exchange: build the message table this shard reads from
+            if exchange == "a2a":
+                # boundary buckets only: gather the values each peer needs,
+                # swap buckets with one all_to_all over ICI
+                sends = outgoing[g["send_idx"]]            # (S, B, ...)
+                recv = jax.lax.all_to_all(
+                    sends, axis, split_axis=0, concat_axis=0
+                )
+                tab = jnp.concatenate(
+                    [outgoing, recv.reshape((S * B,) + tail)], axis=0
+                )
+            else:
+                tab = jax.lax.all_gather(outgoing, axis, axis=0, tiled=True)
+
+            # ---- local aggregation by destination
+            if agg == "ell":
+                pad = jnp.full((1,) + tail, identity, dtype=outgoing.dtype)
+                tab_ext = jnp.concatenate([tab, pad], axis=0)
+                parts = []
+                for bucket, n_slots in zip(g["ell_buckets"], sc.ell_meta):
+                    idx, wm, va = bucket[0], bucket[1], bucket[2]
+                    m = tab_ext[idx]                       # (rows, c[, k])
+                    if m.ndim == 3:
+                        wm_, va_ = wm[:, :, None], va[:, :, None]
+                    else:
+                        wm_, va_ = wm, va
+                    if program.edge_transform == EdgeTransform.MUL_WEIGHT:
+                        m = m * wm_
+                    elif program.edge_transform == EdgeTransform.ADD_WEIGHT:
+                        m = m + wm_
+                    m = jnp.where(va_ > 0, m, identity)
+                    r = reduce_cols(m, 1)
+                    if n_slots is not None:
+                        # fold supernode row partials (rows-sized reduce);
+                        # padded rows land in the dead slot and are dropped
+                        r = seg_reduce_n(r, bucket[3], n_slots + 1)[:n_slots]
+                    parts.append(r)
+                stacked = jnp.concatenate(parts, axis=0)
+                agg_v = stacked[g["ell_unpermute"]]
+            else:
+                msgs = tab[g["src_idx"]]
+                weight, valid = g["weight"], g["valid"]
+                if program.edge_transform == EdgeTransform.MUL_WEIGHT:
+                    msgs = msgs * (weight[:, None] if msgs.ndim == 2 else weight)
+                elif program.edge_transform == EdgeTransform.ADD_WEIGHT:
+                    msgs = msgs + (weight[:, None] if msgs.ndim == 2 else weight)
+                vmask = valid[:, None] if msgs.ndim == 2 else valid
+                msgs = jnp.where(vmask > 0, msgs, identity)
+                agg_v = seg_reduce(msgs, g["dst_loc"])
+
             new_state, metrics = program.apply(
-                state, agg, step, memory_in, view, jnp
+                state, agg_v, step, memory_in, view, jnp
             )
             # barrier: global aggregator reduction over the mesh
             reduced = {}
@@ -239,7 +543,7 @@ class ShardedExecutor:
         return P(self.axis), P()
 
     def _superstep_fn(self, program: VertexProgram, op: str, sc: ShardedCSR):
-        key = ("step", program.cache_key(), op)
+        key = ("step", program.cache_key(), op, self.exchange, self.agg)
         if key in self._compiled:
             return self._compiled[key]
 
@@ -255,12 +559,7 @@ class ShardedExecutor:
                 sharded_spec,  # state (leading dim sharded)
                 rep,           # step
                 rep,           # memory_in
-                sharded_spec,  # out_degree
-                sharded_spec,  # active
-                sharded_spec,  # src_glob
-                sharded_spec,  # dst_loc
-                sharded_spec,  # valid
-                sharded_spec,  # weight
+                sharded_spec,  # graph arrays pytree (prefix: shard dim 0)
             ),
             out_specs=(sharded_spec, rep),
             check_vma=False,
@@ -271,12 +570,12 @@ class ShardedExecutor:
 
     def _fused_fn(self, program: VertexProgram, op: str, sc: ShardedCSR):
         """A span of the BSP run as ONE dispatch: lax.while_loop inside
-        shard_map, collectives (all_gather exchange + psum barrier) in the
-        loop body, `terminate_device` on the replicated aggregators as the
-        on-device stop condition. steps/limit flow as traced scalars so one
-        executable serves the full run and checkpoint-bounded chunks. See
+        shard_map, collectives (boundary all_to_all exchange + psum barrier)
+        in the loop body, `terminate_device` on the replicated aggregators as
+        the on-device stop condition. steps/limit flow as traced scalars so
+        one executable serves the full run and checkpoint-bounded chunks. See
         TPUExecutor._fused_fn."""
-        key = ("fused", program.cache_key(), op)
+        key = ("fused", program.cache_key(), op, self.exchange, self.agg)
         if key in self._compiled:
             return self._compiled[key]
 
@@ -286,10 +585,7 @@ class ShardedExecutor:
 
         body = self._shard_body(program, op, sc)
 
-        def run_span(state, mem, steps_done0, limit,
-                     out_degree, active, src_glob, dst_loc, valid, weight):
-            args = (out_degree, active, src_glob, dst_loc, valid, weight)
-
+        def run_span(state, mem, steps_done0, limit, g):
             def cond(carry):
                 _s, m, steps_done = carry
                 return jnp.logical_and(
@@ -301,7 +597,7 @@ class ShardedExecutor:
 
             def loop(carry):
                 s, m, steps_done = carry
-                s2, m2 = body(s, steps_done, m, *args)
+                s2, m2 = body(s, steps_done, m, g)
                 return (s2, m2, steps_done + 1)
 
             return jax.lax.while_loop(cond, loop, (state, mem, steps_done0))
@@ -310,11 +606,7 @@ class ShardedExecutor:
         fn = shard_map(
             run_span,
             mesh=self.mesh,
-            in_specs=(
-                sharded_spec, rep, rep, rep,
-                sharded_spec, sharded_spec, sharded_spec,
-                sharded_spec, sharded_spec, sharded_spec,
-            ),
+            in_specs=(sharded_spec, rep, rep, rep, sharded_spec),
             out_specs=(sharded_spec, rep, rep),
             check_vma=False,
         )
@@ -372,6 +664,7 @@ class ShardedExecutor:
             k: jnp.asarray(v, dtype=jnp.float32) for k, v in memory.values.items()
         }
 
+        gargs = self._graph_args(sc, program.undirected)
         steps_done = start_step
         for step in range(start_step, program.max_iterations):
             op = program.combiner_for(step)
@@ -380,12 +673,7 @@ class ShardedExecutor:
                 state,
                 jnp.asarray(step, dtype=jnp.int32),
                 device_memory,
-                sc.out_degree,
-                sc.active,
-                sc.in_src_glob,
-                sc.in_dst_loc,
-                sc.in_valid,
-                sc.in_weight,
+                gargs,
             )
             device_memory = {
                 k: metrics.get(k, device_memory.get(k))
@@ -428,10 +716,7 @@ class ShardedExecutor:
 
         op = program.combiner
         max_iter = program.max_iterations
-        csr_args = (
-            sc.out_degree, sc.active, sc.in_src_glob,
-            sc.in_dst_loc, sc.in_valid, sc.in_weight,
-        )
+        gargs = self._graph_args(sc, program.undirected)
         steps_done = 0
         state = mem = None
 
@@ -464,7 +749,7 @@ class ShardedExecutor:
                 }
             step_fn = self._superstep_fn(program, op, sc)
             state, mem = step_fn(
-                state, jnp.asarray(0, jnp.int32), mem0, *csr_args
+                state, jnp.asarray(0, jnp.int32), mem0, gargs
             )
             steps_done = 1
 
@@ -478,7 +763,7 @@ class ShardedExecutor:
                 mem,
                 jnp.asarray(steps_done, jnp.int32),
                 jnp.asarray(limit, jnp.int32),
-                *csr_args,
+                gargs,
             )
             new_steps = int(steps_dev)
             terminated = new_steps < limit or new_steps == steps_done

@@ -78,7 +78,8 @@ def test_ell_aggregate_2d_messages():
 
 
 def test_ell_supernode_jumbo_bucket():
-    """A hub vertex with degree above max_capacity lands in the jumbo bucket."""
+    """A hub vertex with degree above max_capacity row-splits into multiple
+    capacity-sized rows folded by the rows-sized segment reduce."""
     import jax.numpy as jnp
 
     n = 40

@@ -75,10 +75,17 @@ def test_ellpack_native_or_fallback_parity():
     finally:
         native.ell_fill = orig
     assert len(pack.buckets) == len(pack_np.buckets)
-    for (i1, w1, v1), (i2, w2, v2) in zip(pack.buckets, pack_np.buckets):
+    for (i1, w1, v1, rs1, ns1), (i2, w2, v2, rs2, ns2) in zip(
+        pack.buckets, pack_np.buckets
+    ):
         np.testing.assert_array_equal(i1, i2)
         np.testing.assert_allclose(w1, w2)
         np.testing.assert_array_equal(v1, v2)
+        assert ns1 == ns2
+        if rs1 is None:
+            assert rs2 is None
+        else:
+            np.testing.assert_array_equal(rs1, rs2)
     np.testing.assert_array_equal(pack.unpermute, pack_np.unpermute)
 
 
