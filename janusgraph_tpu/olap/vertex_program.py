@@ -33,7 +33,7 @@ so one definition runs on the CPU oracle executor and the TPU executor.
 from __future__ import annotations
 
 from dataclasses import dataclass, field
-from typing import Dict, Tuple
+from typing import Dict, Optional, Tuple
 
 
 class Combiner:
@@ -52,6 +52,24 @@ class EdgeTransform:
     NONE = "none"
     MUL_WEIGHT = "mul"   # msg * w  (e.g. weighted pagerank)
     ADD_WEIGHT = "add"   # msg + w  (e.g. shortest path)
+
+
+@dataclass(frozen=True)
+class EdgeChannel:
+    """A typed edge view for one message round (reference: TinkerPop
+    MessageScope.Local carrying a per-step traversal like __.out('knows'),
+    compiled to reversed slice queries at VertexProgramScanJob.java:114-135).
+
+    direction: traverser movement along the edge —
+        "out"  src -> dst  (aggregate at dst over in-edges; the default)
+        "in"   dst -> src  (aggregate at src over out-edges)
+        "both" both orientations
+    labels: edge type ids to include (None = all). Requires the CSR to carry
+        per-edge type arrays (in_edge_type/out_edge_type).
+    """
+
+    direction: str = "out"
+    labels: Optional[Tuple[int, ...]] = None
 
 
 @dataclass
@@ -88,10 +106,20 @@ class VertexProgram:
     undirected: bool = False
     max_iterations: int = 100
 
+    #: named typed edge views; programs with per-superstep edge scopes
+    #: (the TraversalVertexProgram analogue) declare them here and pick one
+    #: per superstep via channel_for
+    edge_channels: Dict[str, EdgeChannel] = {}
+
     def combiner_for(self, superstep: int) -> str:
         """Monoid for a given superstep — overridable for phase-alternating
         programs (e.g. peer pressure's count-then-resolve phases)."""
         return self.combiner
+
+    def channel_for(self, superstep: int) -> Optional[str]:
+        """Edge channel for a given superstep. None = the program's default
+        edge view (in-CSR, or the symmetric closure when `undirected`)."""
+        return None
 
     def setup(self, graph, xp) -> Tuple[Dict[str, object], Dict[str, Tuple[str, object]]]:
         """Return (initial state, initial metrics). Metrics are (op, scalar)
@@ -145,10 +173,12 @@ class VertexProgram:
 
     def fused_eligible(self) -> bool:
         """Whether run() may compile the whole iteration into one on-device
-        while_loop: requires a constant combiner monoid AND an overridden
-        terminate_device (the default never stops early, which would change
-        semantics for programs relying on host terminate())."""
+        while_loop: requires a constant combiner monoid, a constant edge
+        channel, AND an overridden terminate_device (the default never stops
+        early, which would change semantics for programs relying on host
+        terminate())."""
         return (
             type(self).combiner_for is VertexProgram.combiner_for
+            and type(self).channel_for is VertexProgram.channel_for
             and type(self).terminate_device is not VertexProgram.terminate_device
         )
