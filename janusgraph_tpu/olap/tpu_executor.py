@@ -143,6 +143,24 @@ class TPUExecutor:
             self._segsum_plans[orientation] = plan
         return plan
 
+    def _resolve_strategy(self, op: str) -> str:
+        """The strategy actually used for a combiner monoid: the pallas
+        kernel is SUM-only, everything else falls back to ELL."""
+        if self.strategy == "pallas" and op != Combiner.SUM:
+            return "ell"
+        return self.strategy
+
+    def prewarm(self, program: VertexProgram) -> None:
+        """Build + device-put the aggregation structures a program will use,
+        so transfer cost is paid (and measurable) before the first run."""
+        strategy = self._resolve_strategy(program.combiner)
+        if strategy == "ell":
+            self._ell_pack(program.undirected)
+        elif strategy == "pallas":
+            self._segsum_plan("in")
+            if program.undirected:
+                self._segsum_plan("out")
+
     # ------------------------------------------------------------ superstep
     def _superstep_body(self, program: VertexProgram, op: str):
         """Build the (un-jitted) superstep function for one combiner monoid."""
@@ -151,9 +169,7 @@ class TPUExecutor:
         g = self.g
         n = g.local_num_vertices
         identity = Combiner.IDENTITY[op]
-        strategy = self.strategy
-        if strategy == "pallas" and op != Combiner.SUM:
-            strategy = "ell"  # kernel is SUM-monoid; ELL covers the rest
+        strategy = self._resolve_strategy(op)
         if strategy == "ell":
             pack = self._ell_pack(program.undirected)
         elif strategy == "pallas":
