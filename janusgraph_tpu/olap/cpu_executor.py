@@ -64,19 +64,33 @@ class CPUExecutor:
                     msg = msg + weight
                 aggregated[dst] = _combine(op, aggregated[dst], msg)
 
-            for i in range(n):
-                for e in range(g.in_indptr[i], g.in_indptr[i + 1]):
-                    w = g.in_edge_weight[e] if g.in_edge_weight is not None else 1.0
-                    deliver(i, int(g.in_src[e]), w)
-            if program.undirected:
+            ch_name = program.channel_for(step)
+            if ch_name is not None:
+                # typed edge view: deliver only along the channel's edges
+                # (reference: per-scope slice queries,
+                # VertexProgramScanJob.java:114-135)
+                from janusgraph_tpu.olap.csr import channel_edges
+
+                ch_src, ch_dst, ch_w = channel_edges(
+                    g, program.edge_channels[ch_name]
+                )
+                for e in range(len(ch_src)):
+                    w = float(ch_w[e]) if ch_w is not None else 1.0
+                    deliver(int(ch_dst[e]), int(ch_src[e]), w)
+            else:
                 for i in range(n):
-                    for e in range(g.out_indptr[i], g.out_indptr[i + 1]):
-                        w = (
-                            g.out_edge_weight[e]
-                            if g.out_edge_weight is not None
-                            else 1.0
-                        )
-                        deliver(i, int(g.out_dst[e]), w)
+                    for e in range(g.in_indptr[i], g.in_indptr[i + 1]):
+                        w = g.in_edge_weight[e] if g.in_edge_weight is not None else 1.0
+                        deliver(i, int(g.in_src[e]), w)
+                if program.undirected:
+                    for i in range(n):
+                        for e in range(g.out_indptr[i], g.out_indptr[i + 1]):
+                            w = (
+                                g.out_edge_weight[e]
+                                if g.out_edge_weight is not None
+                                else 1.0
+                            )
+                            deliver(i, int(g.out_dst[e]), w)
 
             memory_in = dict(memory.values)
             state, metrics = program.apply(

@@ -47,6 +47,12 @@ class CSRGraph:
     out_edge_weight: Optional[np.ndarray] = None  # (m,) float32, aligned to out_dst
     properties: Dict[str, np.ndarray] = field(default_factory=dict)
     labels: Optional[np.ndarray] = None  # (n,) int64 vertex-label schema ids
+    # per-edge type (edge-label schema id) arrays — the substrate for typed
+    # EdgeChannel views (reference: per-scope slice queries compiled at
+    # VertexProgramScanJob.java:114-135 restrict each message round to one
+    # edge label; here the restriction is an array mask over these)
+    in_edge_type: Optional[np.ndarray] = None     # (m,) int32, aligned to in_src
+    out_edge_type: Optional[np.ndarray] = None    # (m,) int32, aligned to out_dst
 
     @property
     def num_vertices(self) -> int:
@@ -143,6 +149,7 @@ def load_csr(
     src_ids: List[np.ndarray] = []
     dst_ids: List[np.ndarray] = []
     weights: List[np.ndarray] = []
+    etypes: List[np.ndarray] = []
     vertex_id_list: List[int] = []
     vertex_labels: List[int] = []
     raw_props: Dict[str, Dict[int, object]] = {name: {} for name in prop_key_ids.values()}
@@ -209,6 +216,7 @@ def load_csr(
                 if len(outs):
                     src_ids.append(np.full(len(outs), vid, dtype=np.int64))
                     dst_ids.append(outs)
+                    etypes.append(tids[mask].astype(np.int32))
                     if weight_key_id is not None:
                         weights.append(np.ones(len(outs), dtype=np.float32))
             for col, val in slow_entries:
@@ -219,6 +227,7 @@ def load_csr(
                     continue
                 src_ids.append(np.array([vid], dtype=np.int64))
                 dst_ids.append(np.array([rc.other_vertex_id], dtype=np.int64))
+                etypes.append(np.array([rc.type_id], dtype=np.int32))
                 if weight_key_id is not None:
                     w = 1.0
                     if rc.properties and weight_key_id in rc.properties:
@@ -239,10 +248,10 @@ def load_csr(
         src = np.concatenate(src_ids)
         dst = np.concatenate(dst_ids)
         w = np.concatenate(weights) if weights else None
+        et = np.concatenate(etypes) if etypes else None
         # canonicalize partitioned-vertex endpoints on the dst side too
-        if idm.partition_bits > 0:
-            dst = np.array([canonicalize(int(d)) for d in dst], dtype=np.int64) \
-                if _any_partitioned(idm, dst) else dst
+        if idm.partition_bits > 0 and _any_partitioned(idm, dst):
+            dst = canonicalize_ids(idm, dst)
         # drop edges to vertices outside the snapshot (ghost endpoints)
         src_idx = np.searchsorted(vertex_ids, src)
         dst_idx = np.searchsorted(vertex_ids, dst)
@@ -256,10 +265,13 @@ def load_csr(
         dst_idx = dst_idx[valid].astype(np.int32)
         if w is not None:
             w = w[valid]
+        if et is not None:
+            et = et[valid]
     else:
         src_idx = np.empty(0, dtype=np.int32)
         dst_idx = np.empty(0, dtype=np.int32)
         w = None
+        et = None
 
     # build out-CSR (sorted by src) and in-CSR (sorted by dst)
     from janusgraph_tpu import native
@@ -296,12 +308,30 @@ def load_csr(
         out_edge_weight=w[out_order] if w is not None else None,
         properties=props,
         labels=label_arr,
+        in_edge_type=et[in_order] if et is not None else None,
+        out_edge_type=et[out_order] if et is not None else None,
     )
 
 
 def _any_partitioned(idm, ids: np.ndarray) -> bool:
     # partitioned suffix is 0b010 in the low 3 bits
     return bool(np.any((ids & 0b111) == 0b010))
+
+
+def canonicalize_ids(idm, ids: np.ndarray) -> np.ndarray:
+    """Vectorized IDManager.get_canonical_vertex_id over an int64 array:
+    partition-copies of vertex-cut vertices map to the canonical
+    representative (partition = count % num_partitions); everything else
+    passes through unchanged."""
+    ids = np.asarray(ids, dtype=np.int64)
+    # 0b010 suffix identifies partitioned user vertices (schema ids end 0b111)
+    part_mask = (ids & 0b111) == 0b010
+    if not np.any(part_mask):
+        return ids
+    pb = idm.partition_bits
+    count = ids >> (3 + pb)
+    canonical = (((count << pb) | (count % (1 << pb))) << 3) | 0b010
+    return np.where(part_mask, canonical, ids)
 
 
 def graph_codec_schema(graph):
@@ -318,16 +348,28 @@ def graph_codec_schema(graph):
 
 
 def csr_from_edges(
-    n: int, src: np.ndarray, dst: np.ndarray, weights: Optional[np.ndarray] = None
+    n: int,
+    src: np.ndarray,
+    dst: np.ndarray,
+    weights: Optional[np.ndarray] = None,
+    edge_types: Optional[np.ndarray] = None,
 ) -> CSRGraph:
     """Build a CSRGraph directly from an edge list with dense [0,n) ids —
-    the synthetic-graph path for benchmarks (graph500 RMAT etc.)."""
+    the synthetic-graph path for benchmarks (graph500 RMAT etc.).
+
+    edge_types: optional (m,) per-edge label ids, carried into the CSR's
+    in_edge_type/out_edge_type arrays for EdgeChannel views."""
     from janusgraph_tpu import native
 
     src = np.asarray(src, dtype=np.int32)
     dst = np.asarray(dst, dtype=np.int32)
     out_indptr, out_dst, out_order, in_indptr, in_src, in_order = (
         native.build_csr(n, src, dst)
+    )
+    et = (
+        np.asarray(edge_types, dtype=np.int32)
+        if edge_types is not None
+        else None
     )
     return CSRGraph(
         vertex_ids=np.arange(n, dtype=np.int64),
@@ -338,4 +380,62 @@ def csr_from_edges(
         out_degree=np.diff(out_indptr).astype(np.int32),
         in_edge_weight=weights[in_order].astype(np.float32) if weights is not None else None,
         out_edge_weight=weights[out_order].astype(np.float32) if weights is not None else None,
+        in_edge_type=et[in_order] if et is not None else None,
+        out_edge_type=et[out_order] if et is not None else None,
     )
+
+
+def channel_edges(
+    csr: CSRGraph, channel
+) -> Tuple[np.ndarray, np.ndarray, Optional[np.ndarray]]:
+    """Flatten an EdgeChannel view into (src_idx, dst_idx, weight) arrays
+    where messages flow src -> dst (aggregation happens at dst).
+
+    direction "out": traversers move src->dst, so aggregation reads the
+    in-CSR; "in" reverses the edges (aggregate at the source over its
+    out-edges); "both" is the union. Label filtering requires the CSR to
+    carry per-edge type arrays (load_csr / csr_from_edges edge_types).
+    """
+    parts_src: List[np.ndarray] = []
+    parts_dst: List[np.ndarray] = []
+    parts_w: List[np.ndarray] = []
+    have_w = csr.in_edge_weight is not None or csr.out_edge_weight is not None
+
+    def _select(src, dst, w, types):
+        if channel.labels is not None:
+            if types is None:
+                raise ValueError(
+                    "EdgeChannel with labels requires per-edge type arrays "
+                    "(load the CSR with edge types)"
+                )
+            mask = np.isin(types, np.asarray(channel.labels, dtype=types.dtype))
+            src, dst = src[mask], dst[mask]
+            w = w[mask] if w is not None else None
+        parts_src.append(src)
+        parts_dst.append(dst)
+        if have_w:
+            parts_w.append(
+                w if w is not None else np.ones(len(src), dtype=np.float32)
+            )
+
+    m = csr.num_edges
+    if channel.direction in ("out", "both"):
+        seg = np.repeat(
+            np.arange(csr.num_vertices, dtype=np.int64), np.diff(csr.in_indptr)
+        )
+        _select(
+            csr.in_src.astype(np.int64), seg, csr.in_edge_weight, csr.in_edge_type
+        )
+    if channel.direction in ("in", "both"):
+        seg = np.repeat(
+            np.arange(csr.num_vertices, dtype=np.int64), np.diff(csr.out_indptr)
+        )
+        _select(
+            csr.out_dst.astype(np.int64), seg, csr.out_edge_weight, csr.out_edge_type
+        )
+    if channel.direction not in ("out", "in", "both"):
+        raise ValueError(f"unknown channel direction {channel.direction!r}")
+    src = np.concatenate(parts_src) if parts_src else np.empty(0, np.int64)
+    dst = np.concatenate(parts_dst) if parts_dst else np.empty(0, np.int64)
+    w = np.concatenate(parts_w) if have_w and parts_w else None
+    return src, dst, w

@@ -129,6 +129,22 @@ class TPUExecutor:
             self._ell_packs[undirected] = pack
         return pack
 
+    def _channel_pack(self, program: VertexProgram, name: str):
+        """ELL pack for one named EdgeChannel (typed edge view). Built from
+        the channel's filtered edge list; cached per channel name."""
+        from janusgraph_tpu.olap.csr import channel_edges
+        from janusgraph_tpu.olap.kernels import ELLPack
+
+        key = ("channel", name)
+        pack = self._ell_packs.get(key)
+        if pack is None:
+            channel = program.edge_channels[name]
+            src, dst, w = channel_edges(self.csr, channel)
+            pack = ELLPack(src, dst, w, self.csr.num_vertices)
+            pack.device_put(self.jnp)
+            self._ell_packs[key] = pack
+        return pack
+
     def _segsum_plan(self, orientation: str):
         from janusgraph_tpu.olap.kernels import make_segsum_plan
 
@@ -162,15 +178,20 @@ class TPUExecutor:
                 self._segsum_plan("out")
 
     # ------------------------------------------------------------ superstep
-    def _superstep_body(self, program: VertexProgram, op: str):
-        """Build the (un-jitted) superstep function for one combiner monoid."""
+    def _superstep_body(self, program: VertexProgram, op: str, channel: str = None):
+        """Build the (un-jitted) superstep function for one combiner monoid
+        (and, for channel-switching programs, one named edge channel —
+        channel steps always aggregate over the channel's ELL pack)."""
 
         jnp = self.jnp
         g = self.g
         n = g.local_num_vertices
         identity = Combiner.IDENTITY[op]
         strategy = self._resolve_strategy(op)
-        if strategy == "ell":
+        if channel is not None:
+            strategy = "ell"
+            pack = self._channel_pack(program, channel)
+        elif strategy == "ell":
             pack = self._ell_pack(program.undirected)
         elif strategy == "pallas":
             plans = [( "in", self._segsum_plan("in"))]
@@ -238,12 +259,12 @@ class TPUExecutor:
 
         return superstep
 
-    def _superstep_fn(self, program: VertexProgram, op: str):
+    def _superstep_fn(self, program: VertexProgram, op: str, channel: str = None):
         """Jitted single superstep (host-loop path)."""
-        key = ("step", program.cache_key(), op, self.strategy)
+        key = ("step", program.cache_key(), op, self.strategy, channel)
         if key not in self._compiled:
             self._compiled[key] = self.jax.jit(
-                self._superstep_body(program, op)
+                self._superstep_body(program, op, channel)
             )
         return self._compiled[key]
 
@@ -418,7 +439,7 @@ class TPUExecutor:
         steps_done = start_step
         for step in range(start_step, program.max_iterations):
             op = program.combiner_for(step)
-            fn = self._superstep_fn(program, op)
+            fn = self._superstep_fn(program, op, program.channel_for(step))
             state, metrics = fn(
                 state, jnp.asarray(step, dtype=jnp.int32), device_memory
             )

@@ -33,7 +33,8 @@ so one definition runs on the CPU oracle executor and the TPU executor.
 from __future__ import annotations
 
 from dataclasses import dataclass, field
-from typing import Dict, Optional, Tuple
+from types import MappingProxyType
+from typing import Dict, Mapping, Optional, Tuple
 
 
 class Combiner:
@@ -107,9 +108,11 @@ class VertexProgram:
     max_iterations: int = 100
 
     #: named typed edge views; programs with per-superstep edge scopes
-    #: (the TraversalVertexProgram analogue) declare them here and pick one
-    #: per superstep via channel_for
-    edge_channels: Dict[str, EdgeChannel] = {}
+    #: (the TraversalVertexProgram analogue) SHADOW this with their own dict
+    #: and pick one per superstep via channel_for (the immutable default
+    #: cannot be mutated in place, so per-class declarations can't leak
+    #: across programs)
+    edge_channels: Mapping[str, EdgeChannel] = MappingProxyType({})
 
     def combiner_for(self, superstep: int) -> str:
         """Monoid for a given superstep — overridable for phase-alternating

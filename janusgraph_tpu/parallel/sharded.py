@@ -77,7 +77,13 @@ class ShardedCSR:
                    concatenated bucket output (local length sum_c N_c)
     """
 
-    def __init__(self, csr: CSRGraph, num_shards: int, undirected: bool):
+    def __init__(
+        self,
+        csr: CSRGraph,
+        num_shards: int,
+        undirected: bool,
+        edges: Optional[Tuple] = None,
+    ):
         n = csr.num_vertices
         S = num_shards
         Np = -(-max(n, 1) // S)  # ceil
@@ -87,19 +93,30 @@ class ShardedCSR:
         self.padded_n = S * Np
         self.real_n = n
 
-        src = csr.in_src.astype(np.int64)
-        dst = np.repeat(
-            np.arange(n, dtype=np.int64), np.diff(csr.in_indptr)
-        )
-        w = (
-            csr.in_edge_weight.astype(np.float32)
-            if csr.in_edge_weight is not None
-            else np.ones(len(src), dtype=np.float32)
-        )
-        if undirected:
-            # symmetric closure: aggregate over both orientations in one pass
-            src, dst = np.concatenate([src, dst]), np.concatenate([dst, src])
-            w = np.concatenate([w, w])
+        if edges is not None:
+            # pre-filtered edge view (EdgeChannel): messages flow src -> dst
+            src, dst, w = edges
+            src = np.asarray(src, dtype=np.int64)
+            dst = np.asarray(dst, dtype=np.int64)
+            w = (
+                np.asarray(w, dtype=np.float32)
+                if w is not None
+                else np.ones(len(src), dtype=np.float32)
+            )
+        else:
+            src = csr.in_src.astype(np.int64)
+            dst = np.repeat(
+                np.arange(n, dtype=np.int64), np.diff(csr.in_indptr)
+            )
+            w = (
+                csr.in_edge_weight.astype(np.float32)
+                if csr.in_edge_weight is not None
+                else np.ones(len(src), dtype=np.float32)
+            )
+            if undirected:
+                # symmetric closure: aggregate both orientations in one pass
+                src, dst = np.concatenate([src, dst]), np.concatenate([dst, src])
+                w = np.concatenate([w, w])
 
         # sorting by dst groups edges by owning shard (shard = dst // Np is
         # monotone in dst) AND keeps each shard's edges dst-sorted, which the
@@ -366,12 +383,18 @@ class ShardedExecutor:
         self.num_shards = mesh.devices.size
         self.csr = csr
         if exchange == "gather" and agg == "ell":
-            agg = "segment"  # ELL indexes the a2a message table only
+            # the ELL pack indexes the a2a message table, which the gather
+            # exchange never builds — refuse rather than silently rewiring
+            raise ValueError(
+                "agg='ell' requires exchange='a2a' (the ELL indices point "
+                "into the all-to-all message table); use agg='segment' with "
+                "exchange='gather'"
+            )
         self.exchange = exchange
         self.agg = agg
         self._compiled: Dict[Tuple, object] = {}
-        self._sharded_cache: Dict[bool, ShardedCSR] = {}
-        self._device_cache: Dict[Tuple[bool, str], object] = {}
+        self._sharded_cache: Dict[object, ShardedCSR] = {}
+        self._device_cache: Dict[Tuple[object, str], object] = {}
 
     def comm_stats(self, undirected: bool = False) -> Dict[str, int]:
         """Per-superstep exchange volume in elements per shard."""
@@ -390,10 +413,24 @@ class ShardedExecutor:
             self._sharded_cache[undirected] = sc
         return sc
 
-    def _dev(self, sc: ShardedCSR, undirected: bool, name: str):
+    def _sharded_channel(self, program: VertexProgram, name: str) -> ShardedCSR:
+        """ShardedCSR for one named EdgeChannel (typed edge view), built from
+        the channel's filtered edge list and cached per channel name."""
+        from janusgraph_tpu.olap.csr import channel_edges
+
+        key = ("ch", name)
+        sc = self._sharded_cache.get(key)
+        if sc is None:
+            edges = channel_edges(self.csr, program.edge_channels[name])
+            sc = ShardedCSR(self.csr, self.num_shards, False, edges=edges)
+            self._sharded_cache[key] = sc
+        return sc
+
+    def _dev(self, sc: ShardedCSR, view_key, name: str):
         """Device-put a ShardedCSR array once, sharded over the mesh axis —
-        re-uploading the static CSR blocks each superstep would dominate."""
-        key = (undirected, name)
+        re-uploading the static CSR blocks each superstep would dominate.
+        view_key identifies the edge view (undirected flag or channel)."""
+        key = (view_key, name)
         arr = self._device_cache.get(key)
         if arr is None:
             from jax.sharding import NamedSharding, PartitionSpec as P
@@ -410,27 +447,27 @@ class ShardedExecutor:
             self._device_cache[key] = arr
         return arr
 
-    def _graph_args(self, sc: ShardedCSR, undirected: bool) -> Dict[str, object]:
+    def _graph_args(self, sc: ShardedCSR, view_key) -> Dict[str, object]:
         """The static per-shard graph arrays the configured body needs."""
         g = {
-            "out_degree": self._dev(sc, undirected, "out_degree"),
-            "active": self._dev(sc, undirected, "active"),
+            "out_degree": self._dev(sc, view_key, "out_degree"),
+            "active": self._dev(sc, view_key, "active"),
         }
         if self.exchange == "a2a":
             sc.ensure_exchange_plan()
-            g["send_idx"] = self._dev(sc, undirected, "send_idx")
+            g["send_idx"] = self._dev(sc, view_key, "send_idx")
         if self.agg == "ell":
             sc.ensure_ell()
-            g["ell_buckets"] = self._dev(sc, undirected, "ell_buckets")
-            g["ell_unpermute"] = self._dev(sc, undirected, "ell_unpermute")
+            g["ell_buckets"] = self._dev(sc, view_key, "ell_buckets")
+            g["ell_unpermute"] = self._dev(sc, view_key, "ell_unpermute")
         else:
-            g["dst_loc"] = self._dev(sc, undirected, "in_dst_loc")
-            g["valid"] = self._dev(sc, undirected, "in_valid")
-            g["weight"] = self._dev(sc, undirected, "in_weight")
+            g["dst_loc"] = self._dev(sc, view_key, "in_dst_loc")
+            g["valid"] = self._dev(sc, view_key, "in_valid")
+            g["weight"] = self._dev(sc, view_key, "in_weight")
             g["src_idx"] = (
-                self._dev(sc, undirected, "in_src_tab")
+                self._dev(sc, view_key, "in_src_tab")
                 if self.exchange == "a2a"
-                else self._dev(sc, undirected, "in_src_glob")
+                else self._dev(sc, view_key, "in_src_glob")
             )
         return g
 
@@ -542,8 +579,10 @@ class ShardedExecutor:
 
         return P(self.axis), P()
 
-    def _superstep_fn(self, program: VertexProgram, op: str, sc: ShardedCSR):
-        key = ("step", program.cache_key(), op, self.exchange, self.agg)
+    def _superstep_fn(
+        self, program: VertexProgram, op: str, sc: ShardedCSR, channel: str = None
+    ):
+        key = ("step", program.cache_key(), op, self.exchange, self.agg, channel)
         if key in self._compiled:
             return self._compiled[key]
 
@@ -668,12 +707,18 @@ class ShardedExecutor:
         steps_done = start_step
         for step in range(start_step, program.max_iterations):
             op = program.combiner_for(step)
-            fn = self._superstep_fn(program, op, sc)
+            ch = program.channel_for(step)
+            if ch is not None:
+                sc_step = self._sharded_channel(program, ch)
+                gargs_step = self._graph_args(sc_step, ("ch", ch))
+            else:
+                sc_step, gargs_step = sc, gargs
+            fn = self._superstep_fn(program, op, sc_step, ch)
             state, metrics = fn(
                 state,
                 jnp.asarray(step, dtype=jnp.int32),
                 device_memory,
-                gargs,
+                gargs_step,
             )
             device_memory = {
                 k: metrics.get(k, device_memory.get(k))
