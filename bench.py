@@ -440,8 +440,27 @@ def worker() -> None:
             break
 
     # pallas kernel evidence (VERDICT r2 #5): compiled run at s16 with
-    # parity vs the ell result; failure is recorded, not fatal
-    if platform == "tpu":
+    # parity vs the ell result; failure is recorded, not fatal. The stage
+    # runs LAST and under a watchdog: a hung Mosaic compile through the
+    # tunnel (observed: the r3 s16 run wedged here and burned the remaining
+    # budget) can only cost PALLAS_TIMEOUT_S now, and since everything else
+    # already emitted, the watchdog may simply exit the process.
+    if platform == "tpu" and os.environ.get("BENCH_PALLAS", "1") != "0":
+        cap = float(os.environ.get("BENCH_PALLAS_TIMEOUT_S", "240"))
+        done = threading.Event()
+
+        def _pallas_watchdog():
+            if not done.wait(cap):
+                _hb(f"pallas stage exceeded {cap:.0f}s — exiting", t0)
+                _emit({
+                    "stage": "pallas",
+                    "ok": False,
+                    "error": f"watchdog: pallas stage exceeded {cap:.0f}s "
+                             "(hung compile/run)",
+                })
+                os._exit(0)
+
+        threading.Thread(target=_pallas_watchdog, daemon=True).start()
         try:
             _pallas_stage(jax, pr_iters, t0)
         except Exception as e:
@@ -451,6 +470,7 @@ def worker() -> None:
                 "ok": False,
                 "error": f"{type(e).__name__}: {e}"[:500],
             })
+        done.set()
 
 
 def _pallas_stage(jax, pr_iters, t0):

@@ -16,8 +16,13 @@ from __future__ import annotations
 
 import struct
 import uuid as _uuid
-from datetime import datetime, timedelta, timezone
+import zlib
+from dataclasses import dataclass
+from datetime import date as _date, datetime, time as _time, timedelta, timezone
+from enum import Enum
 from typing import Any, Dict, Optional, Tuple, Type
+
+import numpy as np
 
 from janusgraph_tpu.exceptions import JanusGraphTPUError
 
@@ -116,18 +121,29 @@ class DoubleSerializer(AttributeSerializer):
 
 
 class StringSerializer(AttributeSerializer):
-    """UTF-8. Ordered form appends a NUL terminator; embedded NULs are
-    rejected in ordered mode so prefix containment can't corrupt ordering
-    (reference counterpart compresses — we favor vectorizable simplicity)."""
+    """UTF-8 with transparent compression for long values: payload is
+    [flag:1][body], flag 0 = raw utf-8, 1 = zlib(utf-8) (reference:
+    serialize/attribute/StringSerializer.java:279 compresses long strings
+    the same way). Ordered form stays raw + NUL terminator — compression
+    would destroy byte ordering; embedded NULs are rejected there so prefix
+    containment can't corrupt ordering."""
 
     type_id = 4
     py_type = str
+    COMPRESS_THRESHOLD = 48
 
     def write(self, value) -> bytes:
-        return value.encode("utf-8")
+        raw = value.encode("utf-8")
+        if len(raw) > self.COMPRESS_THRESHOLD:
+            z = zlib.compress(raw, 6)
+            if len(z) < len(raw):
+                return b"\x01" + z
+        return b"\x00" + raw
 
     def read(self, data: bytes):
-        return data.decode("utf-8")
+        if data[:1] == b"\x01":
+            return zlib.decompress(data[1:]).decode("utf-8")
+        return data[1:].decode("utf-8")
 
     def write_ordered(self, value) -> bytes:
         raw = value.encode("utf-8")
@@ -256,6 +272,340 @@ class GeoshapeSerializer(AttributeSerializer):
         return Geoshape.polygon(pts)
 
 
+# --------------------------------------------------------------------------
+# Sized integer / float scalars (reference registers Java's Byte/Short/
+# Integer/Float as distinct datatypes, StandardSerializer.java:78-132; the
+# TPU-idiomatic Python carriers are the numpy sized scalar types, which is
+# also what OLAP property arrays decode to)
+# --------------------------------------------------------------------------
+
+class _SizedIntSerializer(AttributeSerializer):
+    fmt = ">q"
+    bias = 1 << 63
+
+    def write(self, value) -> bytes:
+        return struct.pack(self.fmt, int(value))
+
+    def read(self, data: bytes):
+        return self.py_type(struct.unpack(self.fmt, data)[0])
+
+    def write_ordered(self, value) -> bytes:
+        # sign-bias so byte-lexicographic order == numeric order
+        return struct.pack(self.fmt.upper(), int(value) + self.bias)
+
+    def read_ordered(self, data: bytes):
+        return self.py_type(struct.unpack(self.fmt.upper(), data)[0] - self.bias)
+
+
+class ByteSerializer(_SizedIntSerializer):
+    type_id = 10
+    py_type = np.int8
+    fixed_width = 1
+    fmt = ">b"
+    bias = 1 << 7
+
+
+class ShortSerializer(_SizedIntSerializer):
+    type_id = 11
+    py_type = np.int16
+    fixed_width = 2
+    fmt = ">h"
+    bias = 1 << 15
+
+
+class IntSerializer(_SizedIntSerializer):
+    type_id = 12
+    py_type = np.int32
+    fixed_width = 4
+    fmt = ">i"
+    bias = 1 << 31
+
+
+class NumpyLongSerializer(_SizedIntSerializer):
+    type_id = 13
+    py_type = np.int64
+    fixed_width = 8
+    fmt = ">q"
+    bias = 1 << 63
+
+
+class FloatSerializer(AttributeSerializer):
+    """IEEE-754 single; same total-order trick as DoubleSerializer."""
+
+    type_id = 14
+    py_type = np.float32
+    fixed_width = 4
+
+    def write(self, value) -> bytes:
+        return struct.pack(">f", float(value))
+
+    def read(self, data: bytes):
+        return np.float32(struct.unpack(">f", data)[0])
+
+    def write_ordered(self, value) -> bytes:
+        bits = struct.unpack(">I", struct.pack(">f", float(value)))[0]
+        bits = bits ^ ((1 << 32) - 1) if bits & (1 << 31) else bits ^ (1 << 31)
+        return struct.pack(">I", bits)
+
+    def read_ordered(self, data: bytes):
+        bits = struct.unpack(">I", data)[0]
+        bits = bits ^ (1 << 31) if bits & (1 << 31) else bits ^ ((1 << 32) - 1)
+        return np.float32(struct.unpack(">f", struct.pack(">I", bits))[0])
+
+
+class Char(str):
+    """Single-character datatype (reference registers Character)."""
+
+    def __new__(cls, value):
+        if len(value) != 1:
+            raise SerializerError("Char must be exactly one character")
+        return super().__new__(cls, value)
+
+
+class CharSerializer(AttributeSerializer):
+    type_id = 15
+    py_type = Char
+    fixed_width = 4
+
+    def write(self, value) -> bytes:
+        return struct.pack(">I", ord(value))
+
+    def read(self, data: bytes):
+        return Char(chr(struct.unpack(">I", data)[0]))
+
+
+# --------------------------------------------------------------------------
+# Temporal types
+# --------------------------------------------------------------------------
+
+@dataclass(frozen=True, order=True)
+class Instant:
+    """Nanosecond-precision timestamp (reference: java.time.Instant
+    registration; Python datetime caps at microseconds, so ns needs its own
+    type). seconds = epoch seconds, nanos in [0, 1e9)."""
+
+    seconds: int
+    nanos: int = 0
+
+    def __post_init__(self):
+        if not (0 <= self.nanos < 1_000_000_000):
+            raise SerializerError("nanos must be in [0, 1e9)")
+
+    @staticmethod
+    def of(dt: datetime) -> "Instant":
+        if dt.tzinfo is None:
+            dt = dt.replace(tzinfo=timezone.utc)
+        micros = (dt - datetime(1970, 1, 1, tzinfo=timezone.utc)) // timedelta(
+            microseconds=1
+        )
+        sec, rem = divmod(micros, 1_000_000)
+        return Instant(sec, rem * 1000)
+
+    def to_datetime(self) -> datetime:
+        return datetime(1970, 1, 1, tzinfo=timezone.utc) + timedelta(
+            seconds=self.seconds, microseconds=self.nanos // 1000
+        )
+
+
+class InstantSerializer(AttributeSerializer):
+    """[seconds:8][nanos:4]; ordered form sign-biases seconds so the whole
+    12-byte encoding sorts chronologically."""
+
+    type_id = 16
+    py_type = Instant
+    fixed_width = 12
+
+    def write(self, value) -> bytes:
+        return struct.pack(">qI", value.seconds, value.nanos)
+
+    def read(self, data: bytes):
+        s, n = struct.unpack(">qI", data)
+        return Instant(s, n)
+
+    def write_ordered(self, value) -> bytes:
+        return struct.pack(">QI", value.seconds + (1 << 63), value.nanos)
+
+    def read_ordered(self, data: bytes):
+        s, n = struct.unpack(">QI", data)
+        return Instant(s - (1 << 63), n)
+
+
+class DurationSerializer(AttributeSerializer):
+    type_id = 17
+    py_type = timedelta
+    fixed_width = 12
+
+    def write(self, value: timedelta) -> bytes:
+        micros = value // timedelta(microseconds=1)
+        sec, rem = divmod(micros, 1_000_000)
+        return struct.pack(">qI", sec, rem * 1000)
+
+    def read(self, data: bytes):
+        s, n = struct.unpack(">qI", data)
+        return timedelta(seconds=s, microseconds=n // 1000)
+
+
+class LocalDateSerializer(AttributeSerializer):
+    """date as proleptic-Gregorian ordinal int32 (ordered = biased int)."""
+
+    type_id = 18
+    py_type = _date
+    fixed_width = 4
+
+    def write(self, value: _date) -> bytes:
+        return struct.pack(">i", value.toordinal())
+
+    def read(self, data: bytes):
+        return _date.fromordinal(struct.unpack(">i", data)[0])
+
+    def write_ordered(self, value) -> bytes:
+        return struct.pack(">I", value.toordinal() + (1 << 31))
+
+    def read_ordered(self, data: bytes):
+        return _date.fromordinal(struct.unpack(">I", data)[0] - (1 << 31))
+
+
+class LocalTimeSerializer(AttributeSerializer):
+    """time-of-day as nanos-since-midnight int64 (naturally ordered)."""
+
+    type_id = 19
+    py_type = _time
+    fixed_width = 8
+
+    def write(self, value: _time) -> bytes:
+        nanos = (
+            (value.hour * 3600 + value.minute * 60 + value.second) * 1_000_000
+            + value.microsecond
+        ) * 1000
+        return struct.pack(">q", nanos)
+
+    def read(self, data: bytes):
+        nanos = struct.unpack(">q", data)[0]
+        micros, _ = divmod(nanos, 1000)
+        sec, micro = divmod(micros, 1_000_000)
+        h, rem = divmod(sec, 3600)
+        m, s = divmod(rem, 60)
+        return _time(h, m, s, micro)
+
+
+# --------------------------------------------------------------------------
+# Primitive arrays — numpy-typed (reference registers boolean[]/byte[]/
+# short[]/int[]/long[]/float[]/double[]/char[]/String[] each with its own id,
+# StandardSerializer.java:105-115; here each dtype gets an id and values are
+# np.ndarray, which is what the OLAP path wants anyway)
+# --------------------------------------------------------------------------
+
+class NdArraySerializer(AttributeSerializer):
+    """[ndim:1][dim:4 x ndim][big-endian raw data] for one fixed dtype."""
+
+    dtype: np.dtype = None
+
+    def write(self, value) -> bytes:
+        a = np.ascontiguousarray(value, dtype=self.dtype)
+        if a.ndim > 255:
+            raise SerializerError("too many dimensions")
+        head = struct.pack(">B", a.ndim) + b"".join(
+            struct.pack(">I", d) for d in a.shape
+        )
+        return head + a.astype(self.dtype.newbyteorder(">")).tobytes()
+
+    def read(self, data: bytes):
+        ndim = data[0]
+        shape = tuple(
+            struct.unpack(">I", data[1 + 4 * i : 5 + 4 * i])[0]
+            for i in range(ndim)
+        )
+        off = 1 + 4 * ndim
+        a = np.frombuffer(data[off:], dtype=self.dtype.newbyteorder(">"))
+        return a.reshape(shape).astype(self.dtype)
+
+
+def _array_serializer(tid: int, np_dtype) -> NdArraySerializer:
+    class _S(NdArraySerializer):
+        type_id = tid
+        py_type = np.ndarray
+        dtype = np.dtype(np_dtype)
+
+    _S.__name__ = f"NdArraySerializer_{np.dtype(np_dtype).name}"
+    return _S()
+
+
+_ARRAY_IDS = [
+    (20, np.bool_), (21, np.int8), (22, np.int16), (23, np.int32),
+    (24, np.int64), (25, np.float32), (26, np.float64), (27, np.uint8),
+]
+
+
+class StringListSerializer(AttributeSerializer):
+    """list[str] with per-item length framing (reference: String[])."""
+
+    type_id = 28
+    py_type = list  # dispatched via serializer_for's list special-case
+
+    def write(self, value) -> bytes:
+        out = [struct.pack(">I", len(value))]
+        for s in value:
+            raw = s.encode("utf-8")
+            out.append(struct.pack(">I", len(raw)) + raw)
+        return b"".join(out)
+
+    def read(self, data: bytes):
+        (n,) = struct.unpack(">I", data[:4])
+        off = 4
+        out = []
+        for _ in range(n):
+            (ln,) = struct.unpack(">I", data[off : off + 4])
+            off += 4
+            out.append(data[off : off + ln].decode("utf-8"))
+            off += ln
+        return out
+
+
+# --------------------------------------------------------------------------
+# Enums (reference registers each schema enum with a fixed id,
+# StandardSerializer.java:90-104; user enums attach via register_enum)
+# --------------------------------------------------------------------------
+
+class EnumSerializer(AttributeSerializer):
+    """One Enum class; encodes the member's ordinal position (stable as long
+    as members are only appended, same contract as the reference)."""
+
+    def __init__(self, enum_cls: Type[Enum], type_id: int):
+        self.type_id = type_id
+        self.py_type = enum_cls
+        self._members = list(enum_cls)
+        self.fixed_width = 2
+
+    def write(self, value) -> bytes:
+        return struct.pack(">H", self._members.index(value))
+
+    def read(self, data: bytes):
+        return self._members[struct.unpack(">H", data)[0]]
+
+
+def _framework_enums():
+    from janusgraph_tpu.core.codecs import (
+        Cardinality,
+        Direction,
+        Multiplicity,
+        RelationCategory,
+    )
+    from janusgraph_tpu.core.config import Mutability
+    from janusgraph_tpu.core.management import SchemaAction
+    from janusgraph_tpu.core.txlog import LogTxStatus
+    from janusgraph_tpu.indexing.provider import Mapping as IndexMapping
+
+    return [
+        (30, Direction), (31, RelationCategory), (32, Cardinality),
+        (33, Multiplicity), (34, SchemaAction), (35, Mutability),
+        (36, LogTxStatus), (37, IndexMapping),
+    ]
+
+
+#: first id available to register_enum / register for user-defined types
+USER_TYPE_ID_START = 100
+
+
 class Serializer:
     """The registry: type-id <-> serializer <-> python type.
 
@@ -265,6 +615,7 @@ class Serializer:
     def __init__(self):
         self._by_id: Dict[int, AttributeSerializer] = {}
         self._by_type: Dict[type, AttributeSerializer] = {}
+        self._array_by_dtype: Dict[np.dtype, AttributeSerializer] = {}
         for cls in (
             BooleanSerializer,
             LongSerializer,
@@ -275,16 +626,53 @@ class Serializer:
             UUIDSerializer,
             FloatListSerializer,
             GeoshapeSerializer,
+            ByteSerializer,
+            ShortSerializer,
+            IntSerializer,
+            NumpyLongSerializer,
+            FloatSerializer,
+            CharSerializer,
+            InstantSerializer,
+            DurationSerializer,
+            LocalDateSerializer,
+            LocalTimeSerializer,
+            StringListSerializer,
         ):
             self.register(cls())
+        for tid, dt in _ARRAY_IDS:
+            ser = _array_serializer(tid, dt)
+            self._by_id[tid] = ser
+            self._array_by_dtype[np.dtype(dt)] = ser
+        self._by_type[np.ndarray] = self._array_by_dtype[np.dtype(np.float64)]
+        for tid, enum_cls in _framework_enums():
+            self.register_enum(enum_cls, tid)
+
+    def register_enum(self, enum_cls: Type[Enum], type_id: int) -> None:
+        """Attach an Enum datatype (user enums: type_id >= USER_TYPE_ID_START)."""
+        self.register(EnumSerializer(enum_cls, type_id))
 
     def register(self, ser: AttributeSerializer) -> None:
         if ser.type_id in self._by_id:
             raise SerializerError(f"duplicate serializer id {ser.type_id}")
         self._by_id[ser.type_id] = ser
-        self._by_type[ser.py_type] = ser
+        # first registration wins the python-type slot (list maps to
+        # FloatListSerializer; StringListSerializer dispatches by content)
+        self._by_type.setdefault(ser.py_type, ser)
 
     def serializer_for(self, value) -> AttributeSerializer:
+        # numpy arrays dispatch on dtype (one id per element type, mirroring
+        # the reference's per-primitive array registrations)
+        if isinstance(value, np.ndarray):
+            ser = self._array_by_dtype.get(value.dtype)
+            if ser is None:
+                raise SerializerError(f"no array serializer for dtype {value.dtype}")
+            return ser
+        # lists: numeric lists keep the legacy FloatList encoding; string
+        # lists use the String[] analogue
+        if isinstance(value, list):
+            if value and all(isinstance(x, str) for x in value):
+                return self._by_id[StringListSerializer.type_id]
+            return self._by_id[FloatListSerializer.type_id]
         # bool is a subclass of int: check exact type first, then walk MRO
         ser = self._by_type.get(type(value))
         if ser is not None:

@@ -214,6 +214,52 @@ COMPUTER_NS.option(
     "result-mode", str, "olap result mode ('memory'|'persist')", "memory",
     Mutability.MASKABLE, lambda v: v in ("memory", "persist"),
 )
+COMPUTER_NS.option(
+    "strategy", str,
+    "device aggregation kernel ('auto'|'ell'|'segment'|'pallas')", "auto",
+    Mutability.MASKABLE, lambda v: v in ("auto", "ell", "segment", "pallas"),
+)
+COMPUTER_NS.option(
+    "ell-max-capacity", int,
+    "ELL bucket capacity cap; larger degrees row-split (supernode bound)",
+    1 << 14, Mutability.MASKABLE, lambda v: v >= 8,
+)
+COMPUTER_NS.option(
+    "executor", str, "default executor for graph.compute() ('tpu'|'cpu')",
+    "tpu", Mutability.MASKABLE, lambda v: v in ("tpu", "cpu"),
+)
+COMPUTER_NS.option(
+    "write-back-batch", int,
+    "vertices per transaction when persisting compute keys", 10_000,
+    Mutability.MASKABLE, lambda v: v > 0,
+)
+COMPUTER_NS.option(
+    "sync-every", int,
+    "supersteps between host aggregator fetches (host-loop programs)", 1,
+    Mutability.MASKABLE, lambda v: v > 0,
+)
+COMPUTER_NS.option(
+    "checkpoint-every", int,
+    "supersteps between OLAP state checkpoints (0 = no checkpointing)", 0,
+    Mutability.MASKABLE, lambda v: v >= 0,
+)
+COMPUTER_NS.option(
+    "checkpoint-path", str, "directory/file for OLAP superstep checkpoints", "",
+)
+STORAGE.option(
+    "scan-batch-size", int, "rows per scan-framework batch", 4096,
+    Mutability.MASKABLE, lambda v: v > 0,
+)
+STORAGE.option(
+    "scan-parallelism", int,
+    "worker threads assembling scan batches (0 = one per partition)", 0,
+    Mutability.MASKABLE, lambda v: v >= 0,
+)
+IDS.option(
+    "renew-percentage", float,
+    "fraction of an id block remaining that triggers background renewal",
+    0.3, Mutability.MASKABLE, lambda v: 0.0 < v < 1.0,
+)
 LOCK_NS.option(
     "wait-ms", float, "claim re-read wait of the consistent-key locker", 1.0,
     Mutability.GLOBAL_OFFLINE,

@@ -108,15 +108,23 @@ class VertexIDAssigner:
     vertices, canonical-partition ids for partitioned (vertex-cut) labels
     (reference: idassigner/VertexIDAssigner.java + placement strategies)."""
 
-    def __init__(self, authority: ConsistentKeyIDAuthority, idm: IDManager):
+    def __init__(
+        self,
+        authority: ConsistentKeyIDAuthority,
+        idm: IDManager,
+        renew_fraction: Optional[float] = None,
+    ):
         self.authority = authority
         self.idm = idm
+        self.renew_fraction = renew_fraction  # ids.renew-percentage
         self._vertex_pools: Dict[int, StandardIDPool] = {}
         self._relation_pool = StandardIDPool(
-            authority, ConsistentKeyIDAuthority.NS_RELATION, 0
+            authority, ConsistentKeyIDAuthority.NS_RELATION, 0,
+            renew_fraction=renew_fraction,
         )
         self._schema_pool = StandardIDPool(
-            authority, ConsistentKeyIDAuthority.NS_SCHEMA, 0
+            authority, ConsistentKeyIDAuthority.NS_SCHEMA, 0,
+            renew_fraction=renew_fraction,
         )
         self._rr = 0
         self._lock = threading.Lock()
@@ -126,7 +134,8 @@ class VertexIDAssigner:
             pool = self._vertex_pools.get(partition)
             if pool is None:
                 pool = StandardIDPool(
-                    self.authority, ConsistentKeyIDAuthority.NS_VERTEX, partition
+                    self.authority, ConsistentKeyIDAuthority.NS_VERTEX, partition,
+                    renew_fraction=self.renew_fraction,
                 )
                 self._vertex_pools[partition] = pool
             return pool
@@ -210,7 +219,10 @@ class JanusGraphTPU:
         )
         self.instance_registry = InstanceRegistry(self.backend)
         self.instance_registry.register(self.instance_id)
-        self.id_assigner = VertexIDAssigner(self.backend.id_authority, self.idm)
+        self.id_assigner = VertexIDAssigner(
+            self.backend.id_authority, self.idm,
+            renew_fraction=cfg.get("ids.renew-percentage"),
+        )
         # the durable log bus: WAL, schema broadcast, user CDC
         # (reference: Backend.java:267,312,316 — txlog/systemlog/user logs)
         from janusgraph_tpu.storage.log import LogManager
@@ -388,8 +400,9 @@ class JanusGraphTPU:
     def management(self) -> ManagementSystem:
         return ManagementSystem(self)
 
-    def compute(self, executor: str = "tpu"):
-        """OLAP entry point (reference: JanusGraph.compute())."""
+    def compute(self, executor: str = None):
+        """OLAP entry point (reference: JanusGraph.compute()). Defaults the
+        executor to the computer.executor config option."""
         from janusgraph_tpu.olap.computer import GraphComputer
 
         return GraphComputer(self, executor=executor)

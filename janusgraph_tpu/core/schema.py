@@ -51,15 +51,42 @@ class SystemTypes:
         return self._infos.get(type_id)
 
 
-_DATA_TYPES: Dict[str, type] = {
-    "Boolean": bool,
-    "Long": int,
-    "Double": float,
-    "String": str,
-    "Bytes": bytes,
-    "Geoshape": Geoshape,
-    "FloatList": list,
-}
+def _attribute_types() -> Dict[str, type]:
+    """Schema-declarable property datatypes (reference: the ~60 datatype
+    registrations at StandardSerializer.java:78-132; names are the stable
+    schema-definition vocabulary persisted in schema cells)."""
+    import uuid as _uuid
+    from datetime import date as _d, datetime as _dt, time as _t, timedelta
+
+    import numpy as np
+
+    from janusgraph_tpu.core.attributes import Char, Instant
+
+    return {
+        "Boolean": bool,
+        "Long": int,
+        "Double": float,
+        "String": str,
+        "Bytes": bytes,
+        "Geoshape": Geoshape,
+        "FloatList": list,
+        "Date": _dt,
+        "UUID": _uuid.UUID,
+        "Byte": np.int8,
+        "Short": np.int16,
+        "Int": np.int32,
+        "Long64": np.int64,
+        "Float": np.float32,
+        "Char": Char,
+        "Instant": Instant,
+        "Duration": timedelta,
+        "LocalDate": _d,
+        "LocalTime": _t,
+        "Array": np.ndarray,
+    }
+
+
+_DATA_TYPES: Dict[str, type] = _attribute_types()
 _DATA_TYPE_NAMES = {v: k for k, v in _DATA_TYPES.items()}
 
 

@@ -22,7 +22,7 @@ batch thinking of the TPU OLAP path.
 from __future__ import annotations
 
 from collections import Counter
-from typing import Callable, List, Optional, Sequence
+from typing import Callable, List, Optional, Sequence, Tuple
 
 from janusgraph_tpu.core.codecs import Direction
 from janusgraph_tpu.core.elements import Edge, Vertex, VertexProperty
@@ -179,14 +179,28 @@ class P:
 
 
 class Traverser:
-    """One unit of traversal state: the current object plus the vertex it was
-    reached from (needed by otherV) — a minimal path memory."""
+    """One unit of traversal state: the current object, the vertex it was
+    reached from (needed by otherV), the full path history (for path() /
+    simple_path()), and the as_()-tag bindings (for select() / where())
+    (reference: TinkerPop traversers carry the same path/labels state; the
+    reference reuses them via graphdb/tinkerpop/ glue)."""
 
-    __slots__ = ("obj", "prev")
+    __slots__ = ("obj", "prev", "path", "tags")
 
-    def __init__(self, obj, prev=None):
+    def __init__(self, obj, prev=None, path=None, tags=None):
         self.obj = obj
         self.prev = prev
+        self.path = (obj,) if path is None else path
+        self.tags = tags
+
+    def child(self, obj, prev=None) -> "Traverser":
+        """A traverser one step further along: path extended, tags kept."""
+        return Traverser(obj, prev=prev, path=self.path + (obj,), tags=self.tags)
+
+    def tagged(self, name: str) -> "Traverser":
+        tags = dict(self.tags) if self.tags else {}
+        tags[name] = self.obj
+        return Traverser(self.obj, prev=self.prev, path=self.path, tags=tags)
 
 
 class GraphTraversalSource:
@@ -405,10 +419,11 @@ class GraphTraversal:
     def __init__(self, source: GraphTraversalSource, start):
         self.source = source
         self.tx = source.tx
-        self._start = start
+        self._start = start  # None for anonymous (sub-traversal) bodies
         self._pre_has: List = []  # foldable leading has-conditions
         self._steps: List[Callable[[List[Traverser]], List[Traverser]]] = []
         self._folding = True  # still collecting leading has() steps
+        self._last_by: Optional[List] = None  # open by() modulator window
 
     # -- filters ------------------------------------------------------------
     def has(self, key: str, value=None) -> "GraphTraversal":
@@ -451,6 +466,7 @@ class GraphTraversal:
 
     def _add(self, step, name: Optional[str] = None) -> None:
         self._folding = False
+        self._last_by = None  # a new step closes the previous by() window
         # label for .profile(): the public step method that registered it
         import sys
 
@@ -489,9 +505,9 @@ class GraphTraversal:
                     continue
                 for e in tx.get_edges(v, direction, labels):
                     if to_vertex:
-                        out.append(Traverser(e.other(v), prev=v))
+                        out.append(t.child(e.other(v), prev=v))
                     else:
-                        out.append(Traverser(e, prev=v))
+                        out.append(t.child(e, prev=v))
             return out
 
         kind = {Direction.OUT: "out", Direction.IN: "in", Direction.BOTH: "both"}[
@@ -506,7 +522,7 @@ class GraphTraversal:
     def out_v(self) -> "GraphTraversal":
         self._add(
             lambda ts: [
-                Traverser(t.obj.out_vertex) for t in ts if isinstance(t.obj, Edge)
+                t.child(t.obj.out_vertex) for t in ts if isinstance(t.obj, Edge)
             ]
         )
         return self
@@ -514,7 +530,7 @@ class GraphTraversal:
     def in_v(self) -> "GraphTraversal":
         self._add(
             lambda ts: [
-                Traverser(t.obj.in_vertex) for t in ts if isinstance(t.obj, Edge)
+                t.child(t.obj.in_vertex) for t in ts if isinstance(t.obj, Edge)
             ]
         )
         return self
@@ -524,7 +540,7 @@ class GraphTraversal:
             out = []
             for t in ts:
                 if isinstance(t.obj, Edge) and t.prev is not None:
-                    out.append(Traverser(t.obj.other(t.prev), prev=t.prev))
+                    out.append(t.child(t.obj.other(t.prev), prev=t.prev))
             return out
 
         self._add(step)
@@ -535,8 +551,8 @@ class GraphTraversal:
             out = []
             for t in ts:
                 if isinstance(t.obj, Edge):
-                    out.append(Traverser(t.obj.out_vertex))
-                    out.append(Traverser(t.obj.in_vertex))
+                    out.append(t.child(t.obj.out_vertex))
+                    out.append(t.child(t.obj.in_vertex))
             return out
 
         self._add(step)
@@ -551,12 +567,12 @@ class GraphTraversal:
             for t in ts:
                 if isinstance(t.obj, Vertex):
                     props = tx.get_properties(t.obj, *keys)
-                    out.extend(Traverser(p.value, prev=t.prev) for p in props)
+                    out.extend(t.child(p.value, prev=t.prev) for p in props)
                 elif isinstance(t.obj, Edge):
                     pv = t.obj.property_values()
                     for k, v in pv.items():
                         if not keys or k in keys:
-                            out.append(Traverser(v, prev=t.prev))
+                            out.append(t.child(v, prev=t.prev))
             return out
 
         self._add(step)
@@ -566,7 +582,7 @@ class GraphTraversal:
         tx = self.tx
         self._add(
             lambda ts: [
-                Traverser(p, prev=t.prev)
+                t.child(p, prev=t.prev)
                 for t in ts
                 if isinstance(t.obj, Vertex)
                 for p in tx.get_properties(t.obj, *keys)
@@ -584,20 +600,20 @@ class GraphTraversal:
                     m = {}
                     for p in tx.get_properties(t.obj, *keys):
                         m.setdefault(p.key, []).append(p.value)
-                    out.append(Traverser(m, prev=t.prev))
+                    out.append(t.child(m, prev=t.prev))
                 elif isinstance(t.obj, Edge):
-                    out.append(Traverser(t.obj.property_values(), prev=t.prev))
+                    out.append(t.child(t.obj.property_values(), prev=t.prev))
             return out
 
         self._add(step)
         return self
 
     def id_(self) -> "GraphTraversal":
-        self._add(lambda ts: [Traverser(t.obj.id, prev=t.prev) for t in ts])
+        self._add(lambda ts: [t.child(t.obj.id, prev=t.prev) for t in ts])
         return self
 
     def label_(self) -> "GraphTraversal":
-        self._add(lambda ts: [Traverser(_label_of(t.obj), prev=t.prev) for t in ts])
+        self._add(lambda ts: [t.child(_label_of(t.obj), prev=t.prev) for t in ts])
         return self
 
     # -- collection/order/slicing -------------------------------------------
@@ -615,8 +631,18 @@ class GraphTraversal:
 
     def order(self, key: Optional[str] = None, reverse: bool = False) -> "GraphTraversal":
         tx = self.tx
+        by_list: List[Tuple] = []
 
         def step(ts):
+            if by_list:  # .order().by('name') / .by(body, reverse=True)
+                spec = by_list[0]
+                return sorted(
+                    ts,
+                    key=lambda t: (
+                        (v := self._by_value(spec, t.obj)) is None, v
+                    ),
+                    reverse=spec[2],
+                )
             if key is None:
                 return sorted(ts, key=lambda t: t.obj, reverse=reverse)
             return sorted(
@@ -627,12 +653,359 @@ class GraphTraversal:
             )
 
         self._add(step)
+        self._last_by = by_list
         return self
 
-    def repeat(self, body: Callable[["GraphTraversal"], "GraphTraversal"], times: int) -> "GraphTraversal":
-        """t.repeat(lambda t: t.out('knows'), times=3)"""
-        for _ in range(times):
-            body(self)
+    # -- sub-traversal machinery ---------------------------------------------
+    # Bodies are Python callables receiving an anonymous traversal (the
+    # TinkerPop `__` analogue): t.union(lambda t: t.out('knows'), ...).
+    def _sub_steps(self, body) -> List[Callable]:
+        sub = GraphTraversal(self.source, None)
+        sub._folding = False  # has() inside a body is a plain filter
+        r = body(sub)
+        return (r if isinstance(r, GraphTraversal) else sub)._steps
+
+    @staticmethod
+    def _apply_steps(steps: List[Callable], ts: List[Traverser]) -> List[Traverser]:
+        for st in steps:
+            ts = st(ts)
+        return ts
+
+    # -- by() modulator -------------------------------------------------------
+    def _resolve_by_spec(self, spec):
+        """A by() argument: None (identity), a property key, or a body."""
+        if spec is None:
+            return ("id", None)
+        if isinstance(spec, str):
+            return ("key", spec)
+        if callable(spec):
+            return ("sub", self._sub_steps(spec))
+        raise QueryError(f"unsupported by() modulator: {spec!r}")
+
+    def _by_value(self, resolved, obj):
+        kind, arg = resolved[0], resolved[1]
+        if kind == "id":
+            return obj
+        if kind == "key":
+            return _element_value(Traverser(obj), arg, self.tx)
+        hits = self._apply_steps(arg, [Traverser(obj)])
+        return hits[0].obj if hits else None
+
+    def by(self, spec=None, reverse: bool = False) -> "GraphTraversal":
+        """Modulate the previous step (order/select/path/project/group) —
+        TinkerPop's by(): a property key, a sub-traversal body, or nothing
+        (identity). Multiple by() calls round-robin (project/select/group)."""
+        if getattr(self, "_last_by", None) is None:
+            raise QueryError("by() must follow a modulatable step")
+        self._last_by.append(self._resolve_by_spec(spec) + (reverse,))
+        return self
+
+    # -- path / tags ----------------------------------------------------------
+    def as_(self, name: str) -> "GraphTraversal":
+        """Tag the current object (reference: TinkerPop step labels consumed
+        by select()/where())."""
+        self._add(lambda ts: [t.tagged(name) for t in ts], name=f"as({name})")
+        return self
+
+    def select(self, *names: str) -> "GraphTraversal":
+        by_list: List[Tuple] = []
+
+        def step(ts):
+            out = []
+            for t in ts:
+                tags = t.tags or {}
+                if any(n not in tags for n in names):
+                    continue
+                if len(names) == 1:
+                    spec = by_list[0] if by_list else ("id", None, False)
+                    out.append(t.child(self._by_value(spec, tags[names[0]]),
+                                       prev=t.prev))
+                else:
+                    d = {}
+                    for i, nm in enumerate(names):
+                        spec = (
+                            by_list[i % len(by_list)]
+                            if by_list
+                            else ("id", None, False)
+                        )
+                        d[nm] = self._by_value(spec, tags[nm])
+                    out.append(t.child(d, prev=t.prev))
+            return out
+
+        self._add(step, name=f"select{names!r}")
+        self._last_by = by_list
+        return self
+
+    def path(self) -> "GraphTraversal":
+        by_list: List[Tuple] = []
+
+        def step(ts):
+            out = []
+            for t in ts:
+                if by_list:
+                    objs = tuple(
+                        self._by_value(by_list[i % len(by_list)], o)
+                        for i, o in enumerate(t.path)
+                    )
+                else:
+                    objs = t.path
+                out.append(t.child(objs, prev=t.prev))
+            return out
+
+        self._add(step, name="path")
+        self._last_by = by_list
+        return self
+
+    def simple_path(self) -> "GraphTraversal":
+        """Keep traversers whose path never revisits an element."""
+
+        def step(ts):
+            out = []
+            for t in ts:
+                seen = set()
+                ok = True
+                for o in t.path:
+                    k = o.id if isinstance(o, (Vertex, Edge)) else o
+                    try:
+                        if k in seen:
+                            ok = False
+                            break
+                        seen.add(k)
+                    except TypeError:
+                        pass
+                if ok:
+                    out.append(t)
+            return out
+
+        self._add(step, name="simplePath")
+        return self
+
+    # -- branching ------------------------------------------------------------
+    def union(self, *bodies) -> "GraphTraversal":
+        branches = [self._sub_steps(b) for b in bodies]
+
+        def step(ts):
+            out = []
+            for t in ts:
+                for br in branches:
+                    out.extend(self._apply_steps(br, [t]))
+            return out
+
+        self._add(step, name=f"union[{len(branches)}]")
+        return self
+
+    def coalesce(self, *bodies) -> "GraphTraversal":
+        branches = [self._sub_steps(b) for b in bodies]
+
+        def step(ts):
+            out = []
+            for t in ts:
+                for br in branches:
+                    hits = self._apply_steps(br, [t])
+                    if hits:
+                        out.extend(hits)
+                        break
+            return out
+
+        self._add(step, name=f"coalesce[{len(branches)}]")
+        return self
+
+    def optional_(self, body) -> "GraphTraversal":
+        return self.coalesce(body, lambda t: t)
+
+    def choose(self, predicate, true_body, false_body=None) -> "GraphTraversal":
+        """Binary branch. `predicate` is a P (tested on the current object)
+        or a body (non-empty result = true)."""
+        t_steps = self._sub_steps(true_body)
+        f_steps = self._sub_steps(false_body) if false_body is not None else None
+        p_steps = (
+            self._sub_steps(predicate) if callable(predicate) and not isinstance(predicate, P)
+            else None
+        )
+
+        def step(ts):
+            out = []
+            for t in ts:
+                if p_steps is not None:
+                    cond = bool(self._apply_steps(p_steps, [t]))
+                else:
+                    cond = predicate.test(t.obj)
+                if cond:
+                    out.extend(self._apply_steps(t_steps, [t]))
+                elif f_steps is not None:
+                    out.extend(self._apply_steps(f_steps, [t]))
+                else:
+                    out.append(t)
+            return out
+
+        self._add(step, name="choose")
+        return self
+
+    # -- filters over sub-traversals / tags -----------------------------------
+    def where(self, arg) -> "GraphTraversal":
+        """where(body): keep traversers whose sub-traversal is non-empty.
+        where(P): the P's condition names an as_() tag — compare the current
+        object against the tagged one (TinkerPop: strings inside where() are
+        step labels, e.g. .as_('x')...where(P.neq('x')))."""
+        if isinstance(arg, P):
+            p = arg
+
+            def step(ts):
+                out = []
+                for t in ts:
+                    tags = t.tags or {}
+                    if p.condition not in tags:
+                        continue
+                    ref = tags[p.condition]
+                    if p.predicate is not None:
+                        keep = p.predicate.evaluate(t.obj, ref)
+                    else:
+                        keep = p.test(t.obj)
+                    if keep:
+                        out.append(t)
+                return out
+
+            self._add(step, name=f"where({p.label})")
+            return self
+        steps = self._sub_steps(arg)
+        self._add(
+            lambda ts: [t for t in ts if self._apply_steps(steps, [t])],
+            name="where(traversal)",
+        )
+        return self
+
+    def not_(self, body) -> "GraphTraversal":
+        steps = self._sub_steps(body)
+        self._add(
+            lambda ts: [t for t in ts if not self._apply_steps(steps, [t])],
+            name="not",
+        )
+        return self
+
+    def is_(self, arg) -> "GraphTraversal":
+        p = arg if isinstance(arg, P) else P.eq(arg)
+        self._add(lambda ts: [t for t in ts if p.test(t.obj)], name=f"is({p.label})")
+        return self
+
+    # -- projections over sub-traversals --------------------------------------
+    def project(self, *names: str) -> "GraphTraversal":
+        """project('a','b').by(...).by(...) — one dict per traverser."""
+        by_list: List[Tuple] = []
+
+        def step(ts):
+            out = []
+            for t in ts:
+                d = {}
+                for i, nm in enumerate(names):
+                    spec = (
+                        by_list[i % len(by_list)] if by_list else ("id", None, False)
+                    )
+                    d[nm] = self._by_value(spec, t.obj)
+                out.append(t.child(d, prev=t.prev))
+            return out
+
+        self._add(step, name=f"project{names!r}")
+        self._last_by = by_list
+        return self
+
+    def group(self) -> "GraphTraversal":
+        """group().by(key_spec).by(value_spec) — ONE dict traverser:
+        {key: [values]} (TinkerPop group semantics with list fold)."""
+        by_list: List[Tuple] = []
+
+        def step(ts):
+            key_spec = by_list[0] if by_list else ("id", None, False)
+            val_spec = by_list[1] if len(by_list) > 1 else ("id", None, False)
+            m: dict = {}
+            for t in ts:
+                k = self._by_value(key_spec, t.obj)
+                if isinstance(k, (Vertex, Edge)):
+                    k = k.id
+                m.setdefault(k, []).append(self._by_value(val_spec, t.obj))
+            return [Traverser(m)]
+
+        self._add(step, name="group")
+        self._last_by = by_list
+        return self
+
+    def fold(self) -> "GraphTraversal":
+        self._add(lambda ts: [Traverser([t.obj for t in ts])], name="fold")
+        return self
+
+    def count_(self) -> "GraphTraversal":
+        """count as a STEP (for use inside bodies / by() modulators, like
+        TinkerPop's mid-traversal count()); the terminal form is count()."""
+        self._add(lambda ts: [Traverser(len(ts))], name="count")
+        return self
+
+    def unfold(self) -> "GraphTraversal":
+        def step(ts):
+            out = []
+            for t in ts:
+                if isinstance(t.obj, dict):
+                    out.extend(t.child(kv) for kv in t.obj.items())
+                elif isinstance(t.obj, (list, tuple, set)):
+                    out.extend(t.child(o) for o in t.obj)
+                else:
+                    out.append(t)
+            return out
+
+        self._add(step, name="unfold")
+        return self
+
+    # -- repeat ---------------------------------------------------------------
+    def repeat(
+        self,
+        body: Callable[["GraphTraversal"], "GraphTraversal"],
+        times: Optional[int] = None,
+        until=None,
+        emit: bool = False,
+        max_loops: int = 64,
+    ) -> "GraphTraversal":
+        """t.repeat(lambda t: t.out('knows'), times=3)
+        t.repeat(body, until=lambda t: t.has('name','x'))  # do-while
+        t.repeat(body, times=5, emit=True)  # emit intermediate traversers
+
+        TinkerPop repeat().until()/emit() semantics: the body runs, then the
+        until filter splits satisfied traversers out of the loop; emit copies
+        every surviving traverser into the output each round. `max_loops`
+        bounds until-only loops (cycles would otherwise never drain)."""
+        if until is None and not emit:
+            if times is None:
+                raise QueryError("repeat() needs times= and/or until=/emit=")
+            for _ in range(times):
+                body(self)
+            return self
+
+        body_steps = self._sub_steps(body)
+        until_steps = self._sub_steps(until) if until is not None else None
+
+        def step(ts):
+            results: List[Traverser] = []
+            frontier = ts
+            loops = 0
+            bound = times if times is not None else max_loops
+            while frontier and loops < bound:
+                frontier = self._apply_steps(body_steps, frontier)
+                loops += 1
+                if until_steps is not None:
+                    cont = []
+                    for t in frontier:
+                        if self._apply_steps(until_steps, [t]):
+                            results.append(t)
+                        else:
+                            cont.append(t)
+                    frontier = cont
+                if emit:
+                    results.extend(frontier)
+            if until_steps is None and not emit:
+                return frontier
+            if until_steps is not None and not emit:
+                # loop bound exhausted: remaining traversers exit as output
+                results.extend(frontier)
+            return results
+
+        self._add(step, name="repeat")
         return self
 
     # -- aggregation ---------------------------------------------------------
@@ -665,6 +1038,10 @@ class GraphTraversal:
     def _execute(self, observe=None) -> List[Traverser]:
         """One execution path for plain runs and .profile(): `observe` wraps
         every stage invocation (label, fn, input) -> output."""
+        if self._start is None:
+            raise QueryError(
+                "anonymous (sub-traversal) bodies cannot be executed directly"
+            )
         run = observe if observe is not None else (lambda _label, fn, ts: fn(ts))
         ts = run("start", lambda _: self._start.run(self._pre_has), None)
         for step in self._steps:

@@ -37,14 +37,21 @@ class ComputerResult:
     def write_back(self, keys: Optional[Sequence[str]] = None) -> None:
         from janusgraph_tpu.olap.tpu_executor import write_back
 
-        write_back(self.graph, self.csr, self.states, keys)
+        cfg = getattr(self.graph, "config", None)
+        batch = cfg.get("computer.write-back-batch") if cfg else 10_000
+        write_back(self.graph, self.csr, self.states, keys, batch=batch)
 
 
 class GraphComputer:
-    """graph.compute() builder (reference: JanusGraphComputer)."""
+    """graph.compute() builder (reference: JanusGraphComputer). Executor
+    kind, aggregation strategy, sync cadence and checkpointing default to
+    the graph's registered config (computer.* options)."""
 
-    def __init__(self, graph, executor: str = "tpu"):
+    def __init__(self, graph, executor: str = None):
         self.graph = graph
+        cfg = getattr(graph, "config", None)
+        if executor is None:
+            executor = cfg.get("computer.executor") if cfg else "tpu"
         self.executor_kind = executor
         self._edge_labels: Optional[Sequence[str]] = None
         self._vertex_labels: Optional[Sequence[str]] = None
@@ -90,7 +97,17 @@ class GraphComputer:
             property_keys=self._property_keys,
             weight_key=self._weight_key,
         )
-        states = run_on(csr, self._program, self.executor_kind)
+        cfg = getattr(self.graph, "config", None)
+        run_kwargs = {}
+        if cfg is not None and self.executor_kind == "tpu":
+            run_kwargs = {
+                "strategy": cfg.get("computer.strategy"),
+                "ell_max_capacity": cfg.get("computer.ell-max-capacity"),
+                "sync_every": cfg.get("computer.sync-every"),
+                "checkpoint_every": cfg.get("computer.checkpoint-every"),
+                "checkpoint_path": cfg.get("computer.checkpoint-path") or None,
+            }
+        states = run_on(csr, self._program, self.executor_kind, **run_kwargs)
         memory = {}
         if self._map_reduces:
             from janusgraph_tpu.olap.mapreduce import run_map_reduce
@@ -102,7 +119,16 @@ class GraphComputer:
         )
 
 
-def run_on(csr: CSRGraph, program: VertexProgram, executor: str = "tpu"):
+def run_on(
+    csr: CSRGraph,
+    program: VertexProgram,
+    executor: str = "tpu",
+    strategy: str = "auto",
+    ell_max_capacity: int = None,
+    sync_every: int = 1,
+    checkpoint_every: int = 0,
+    checkpoint_path: str = None,
+):
     if executor == "cpu":
         from janusgraph_tpu.olap.cpu_executor import CPUExecutor
 
@@ -110,5 +136,12 @@ def run_on(csr: CSRGraph, program: VertexProgram, executor: str = "tpu"):
     if executor == "tpu":
         from janusgraph_tpu.olap.tpu_executor import TPUExecutor
 
-        return TPUExecutor(csr).run(program)
+        return TPUExecutor(
+            csr, strategy=strategy, ell_max_capacity=ell_max_capacity
+        ).run(
+            program,
+            sync_every=sync_every,
+            checkpoint_every=checkpoint_every,
+            checkpoint_path=checkpoint_path,
+        )
     raise ValueError(f"unknown executor {executor!r}")

@@ -227,10 +227,24 @@ class GhostVertexRemover(_VertexRowJob):
             self._btx.commit()
 
 
-def run_scan_job(graph, job: ScanJob, num_workers: int = 1) -> ScanMetrics:
+def run_scan_job(
+    graph,
+    job: ScanJob,
+    num_workers: int = None,
+    batch_size: int = None,
+) -> ScanMetrics:
     """Run a ScanJob over the edgestore, partition-parallel (reference:
     Backend.buildEdgeScanJob → StandardScanner; partition ranges =
-    IDManager key ranges, the same structure the TPU mesh shards by)."""
+    IDManager key ranges, the same structure the TPU mesh shards by).
+    Worker count and batch size default to the graph's registered config
+    (storage.scan-parallelism / storage.scan-batch-size)."""
+    cfg = getattr(graph, "config", None)
+    if num_workers is None:
+        num_workers = cfg.get("storage.scan-parallelism") if cfg else 1
+        if not num_workers:  # 0 = one worker per partition
+            num_workers = graph.idm.num_partitions
+    if batch_size is None:
+        batch_size = cfg.get("storage.scan-batch-size") if cfg else 4096
     btx = graph.backend.begin_transaction()
     scanner = StandardScanner(
         graph.backend.edgestore,
@@ -241,4 +255,6 @@ def run_scan_job(graph, job: ScanJob, num_workers: int = 1) -> ScanMetrics:
         graph.idm.partition_key_range(p)
         for p in range(graph.idm.num_partitions)
     ]
-    return scanner.execute(job, key_ranges=ranges, num_workers=num_workers)
+    return scanner.execute(
+        job, key_ranges=ranges, num_workers=num_workers, batch_size=batch_size
+    )

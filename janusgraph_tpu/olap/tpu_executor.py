@@ -84,13 +84,20 @@ class TPUExecutor:
                   monoids fall back to "ell")
     """
 
-    def __init__(self, csr: CSRGraph, use_pallas: bool = False, strategy: str = "auto"):
+    def __init__(
+        self,
+        csr: CSRGraph,
+        use_pallas: bool = False,
+        strategy: str = "auto",
+        ell_max_capacity: int = None,
+    ):
         import jax
         import jax.numpy as jnp
 
         self.jax = jax
         self.jnp = jnp
         self.csr = csr
+        self.ell_max_capacity = ell_max_capacity  # computer.ell-max-capacity
         self.g = _DeviceGraph(csr, jnp)
         if strategy == "auto":
             strategy = "pallas" if use_pallas else "ell"
@@ -124,10 +131,17 @@ class TPUExecutor:
                 src = np.concatenate([src, rsrc])
                 dst = np.concatenate([dst, rdst])
                 w = np.concatenate([w, rw]) if w is not None else None
-            pack = ELLPack(src, dst, w, csr.num_vertices)
+            pack = ELLPack(src, dst, w, csr.num_vertices, **self._ell_kwargs())
             pack.device_put(self.jnp)
             self._ell_packs[undirected] = pack
         return pack
+
+    def _ell_kwargs(self):
+        return (
+            {"max_capacity": self.ell_max_capacity}
+            if self.ell_max_capacity
+            else {}
+        )
 
     def _channel_pack(self, program: VertexProgram, name: str):
         """ELL pack for one named EdgeChannel (typed edge view). Built from
@@ -140,7 +154,9 @@ class TPUExecutor:
         if pack is None:
             channel = program.edge_channels[name]
             src, dst, w = channel_edges(self.csr, channel)
-            pack = ELLPack(src, dst, w, self.csr.num_vertices)
+            pack = ELLPack(
+                src, dst, w, self.csr.num_vertices, **self._ell_kwargs()
+            )
             pack.device_put(self.jnp)
             self._ell_packs[key] = pack
         return pack

@@ -188,7 +188,7 @@ class StandardIDPool:
     IDs from the current block and prefetches the next block in a background
     thread before exhaustion (reference: StandardIDPool.java:301)."""
 
-    RENEW_FRACTION = 0.1  # prefetch when <10% remaining
+    RENEW_FRACTION = 0.3  # prefetch when <30% remaining (ids.renew-percentage)
 
     def __init__(
         self,
@@ -196,11 +196,15 @@ class StandardIDPool:
         namespace: int,
         partition: int,
         max_id: Optional[int] = None,
+        renew_fraction: Optional[float] = None,
     ):
         self.authority = authority
         self.namespace = namespace
         self.partition = partition
         self.max_id = max_id
+        self.RENEW_FRACTION = (
+            renew_fraction if renew_fraction is not None else type(self).RENEW_FRACTION
+        )
         self._lock = threading.Lock()
         self._current: Optional[IDBlock] = None
         self._next_block: Optional[IDBlock] = None

@@ -99,3 +99,121 @@ def test_mid_stream_fixed_width(ser):
     value, consumed = ser.read_object(data)
     assert value == 42
     assert data[consumed:] == b"trailing"
+
+
+# ---- widened registry (reference: StandardSerializer.java:78-132 breadth) ----
+
+import numpy as np
+from datetime import date, time, timedelta
+
+from janusgraph_tpu.core.attributes import (
+    Char,
+    Instant,
+    USER_TYPE_ID_START,
+)
+
+
+WIDE_SAMPLES = [
+    np.int8(-7), np.int16(-30000), np.int32(2**30), np.int64(-(2**60)),
+    np.float32(1.5), Char("x"),
+    Instant(1_722_000_000, 123_456_789),
+    timedelta(days=2, seconds=3, microseconds=7),
+    date(2026, 7, 29), time(23, 59, 58, 999_999),
+    ["alpha", "beta", ""],
+    "x" * 500,  # long string -> compressed path
+]
+
+
+@pytest.mark.parametrize(
+    "value", WIDE_SAMPLES, ids=[repr(v)[:30] for v in WIDE_SAMPLES]
+)
+def test_wide_framed_roundtrip(ser, value):
+    data = ser.write_object(value)
+    out, consumed = ser.read_object(data)
+    assert out == value
+    assert consumed == len(data)
+
+
+ARRAYS = [
+    np.array([True, False, True]),
+    np.arange(-4, 4, dtype=np.int8),
+    np.arange(10, dtype=np.int16).reshape(2, 5),
+    np.arange(6, dtype=np.int32),
+    np.array([2**40, -(2**40)], dtype=np.int64),
+    np.linspace(0, 1, 7, dtype=np.float32),
+    np.linspace(-1, 1, 5, dtype=np.float64).reshape(5, 1),
+    np.frombuffer(b"\x01\x02\xff", dtype=np.uint8),
+]
+
+
+@pytest.mark.parametrize("arr", ARRAYS, ids=[str(a.dtype) for a in ARRAYS])
+def test_ndarray_roundtrip_per_dtype(ser, arr):
+    out, _ = ser.read_object(ser.write_object(arr))
+    assert out.dtype == arr.dtype
+    assert out.shape == arr.shape
+    np.testing.assert_array_equal(out, arr)
+
+
+def test_compressed_string_shorter_and_lossless(ser):
+    s = "janusgraph " * 100
+    data = ser.write_object(s)
+    assert len(data) < len(s.encode())  # actually compressed
+    assert ser.read_object(data)[0] == s
+
+
+def test_enum_roundtrip_framework_enums(ser):
+    from janusgraph_tpu.core.codecs import Cardinality, Direction, Multiplicity
+    from janusgraph_tpu.core.management import SchemaAction
+
+    for member in (
+        Direction.OUT, Cardinality.LIST, Multiplicity.MANY2ONE,
+        SchemaAction.REINDEX,
+    ):
+        out, _ = ser.read_object(ser.write_object(member))
+        assert out is member
+
+
+def test_user_enum_registration(ser):
+    from enum import Enum
+
+    class Color(Enum):
+        RED = 1
+        GREEN = 2
+
+    ser.register_enum(Color, USER_TYPE_ID_START)
+    out, _ = ser.read_object(ser.write_object(Color.GREEN))
+    assert out is Color.GREEN
+
+
+@pytest.mark.parametrize("vals,caster", [
+    ([-5, -1, 0, 1, 100], lambda v: np.int8(v)),
+    ([-30000, -7, 0, 12345], lambda v: np.int16(v)),
+    ([-(2**30), -1, 0, 2**30], lambda v: np.int32(v)),
+    ([-2.5, -0.0, 0.0, 1.5, 1e30], lambda v: np.float32(v)),
+    ([Instant(-5, 0), Instant(0, 1), Instant(0, 999), Instant(7, 0)],
+     lambda v: v),
+    ([date(1990, 1, 1), date(2026, 7, 29), date(3000, 12, 31)], lambda v: v),
+], ids=["int8", "int16", "int32", "float32", "instant", "date"])
+def test_wide_ordered_encoding_sorts(ser, vals, caster):
+    """Byte-lexicographic order of write_ordered == natural order."""
+    vals = [caster(v) for v in vals]
+    encs = [ser.write_ordered(v) for v in vals]
+    assert encs == sorted(encs)
+
+
+def test_char_rejects_multichar():
+    with pytest.raises(SerializerError):
+        Char("ab")
+
+
+def test_instant_nanosecond_precision_roundtrip(ser):
+    a = Instant(100, 1)
+    b = Instant(100, 2)
+    assert ser.read_object(ser.write_object(a))[0] == a
+    assert ser.write_ordered(a) < ser.write_ordered(b)  # ns ordering visible
+
+
+def test_instant_datetime_conversion():
+    dt = datetime(2026, 7, 29, 12, 0, 0, 500, tzinfo=timezone.utc)
+    i = Instant.of(dt)
+    assert i.to_datetime() == dt

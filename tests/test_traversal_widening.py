@@ -1,0 +1,272 @@
+"""Widened OLTP traversal vocabulary — the reference docs' Graph-of-the-Gods
+queries (reference: docs/getting-started/basic-usage.md traversal examples,
+step semantics from TinkerPop as rewritten by
+graphdb/tinkerpop/optimize/strategy/JanusGraphLocalQueryOptimizerStrategy.java)
+run verbatim modulo snake_case: as_/select/path, union/coalesce/choose,
+where(P-on-tag)/where(traversal)/not_/is_, project/group with by()
+modulators, repeat(...).until/emit, simple_path, fold/unfold.
+"""
+
+import pytest
+
+from janusgraph_tpu.core import gods
+from janusgraph_tpu.core.graph import open_graph
+from janusgraph_tpu.core.traversal import P
+from janusgraph_tpu.exceptions import QueryError
+
+
+@pytest.fixture()
+def g():
+    graph = open_graph()
+    gods.load(graph)
+    yield graph.traversal()
+    graph.close()
+
+
+def names(xs):
+    return sorted(xs)
+
+
+# ---- docs' basic queries -----------------------------------------------------
+
+def test_grandfather_via_double_in(g):
+    # g.V().has('name','saturn').in('father').in('father').values('name')
+    out = g.V().has("name", "saturn").in_("father").in_("father").values("name").to_list()
+    assert out == ["hercules"]
+
+
+def test_out_two_labels(g):
+    out = g.V().has("name", "hercules").out("father", "mother").values("name").to_list()
+    assert names(out) == ["alcmene", "jupiter"]
+
+
+def test_edge_property_filter_mid_traversal(g):
+    # g.V(hercules).outE('battled').has('time', gt(1)).inV().values('name')
+    out = (
+        g.V().has("name", "hercules")
+        .out_e("battled").has("time", P.gt(1)).in_v().values("name").to_list()
+    )
+    assert names(out) == ["cerberus", "hydra"]
+
+
+# ---- as_/select/path/where --------------------------------------------------
+
+def test_where_neq_tag_excludes_self(g):
+    # g.V(pluto).as('x').out('lives').in('lives').where(neq('x')).values('name')
+    cohab = (
+        g.V().has("name", "pluto").as_("x")
+        .out("lives").in_("lives").where(P.neq("x")).values("name").to_list()
+    )
+    assert names(cohab) == ["cerberus"]
+
+
+def test_select_two_tags_by_name(g):
+    # g.V(pluto).out('brother').as('god').out('lives').as('place')
+    #  .select('god','place').by('name')
+    rows = (
+        g.V().has("name", "pluto").out("brother").as_("god")
+        .out("lives").as_("place")
+        .select("god", "place").by("name").to_list()
+    )
+    assert sorted((r["god"], r["place"]) for r in rows) == [
+        ("jupiter", "sky"), ("neptune", "sea"),
+    ]
+
+
+def test_select_single_tag(g):
+    rows = (
+        g.V().has("name", "hercules").as_("h").out("battled")
+        .select("h").by("name").to_list()
+    )
+    assert rows == ["hercules"] * 3
+
+
+def test_path_by_name(g):
+    out = (
+        g.V().has("name", "hercules").out("father").out("father")
+        .path().by("name").to_list()
+    )
+    assert out == [("hercules", "jupiter", "saturn")]
+
+
+def test_path_raw_objects(g):
+    p = g.V().has("name", "saturn").in_("father").path().next()
+    assert [v.value("name") for v in p] == ["saturn", "jupiter"]
+
+
+def test_simple_path_removes_cycles(g):
+    # jupiter -brother-> pluto -brother-> jupiter revisits; simple_path drops
+    out = (
+        g.V().has("name", "jupiter").out("brother").out("brother")
+        .simple_path().values("name").to_list()
+    )
+    assert "jupiter" not in out
+
+
+# ---- union / coalesce / choose ----------------------------------------------
+
+def test_union_parents(g):
+    out = (
+        g.V().has("name", "hercules")
+        .union(lambda t: t.out("father"), lambda t: t.out("mother"))
+        .values("name").to_list()
+    )
+    assert names(out) == ["alcmene", "jupiter"]
+
+
+def test_coalesce_first_nonempty_wins(g):
+    # hercules has no pet -> falls through to father
+    out = (
+        g.V().has("name", "hercules")
+        .coalesce(lambda t: t.out("pet"), lambda t: t.out("father"))
+        .values("name").to_list()
+    )
+    assert out == ["jupiter"]
+    # pluto HAS a pet -> first branch wins
+    out = (
+        g.V().has("name", "pluto")
+        .coalesce(lambda t: t.out("pet"), lambda t: t.out("father"))
+        .values("name").to_list()
+    )
+    assert out == ["cerberus"]
+
+
+def test_optional_keeps_original_when_empty(g):
+    out = (
+        g.V().has("name", "hercules").optional_(lambda t: t.out("pet"))
+        .values("name").to_list()
+    )
+    assert out == ["hercules"]
+
+
+def test_choose_predicate_branches(g):
+    # gods get their name; everything else its label
+    out = (
+        g.V().has("age", P.gt(100))
+        .choose(
+            lambda t: t.has_label("god"),
+            lambda t: t.values("name"),
+            lambda t: t.label_(),
+        ).to_list()
+    )
+    assert names(out) == ["jupiter", "neptune", "pluto", "titan"]
+
+
+def test_choose_value_predicate(g):
+    out = (
+        g.V().has_label("god").values("age")
+        .choose(P.gte(4500), lambda t: t.is_(P.gte(4500)), lambda t: t)
+        .to_list()
+    )
+    assert sorted(out) == [4000, 4500, 5000]
+
+
+# ---- where(traversal) / not_ / is_ ------------------------------------------
+
+def test_where_subtraversal_filter(g):
+    out = g.V().where(lambda t: t.out("battled")).values("name").to_list()
+    assert out == ["hercules"]
+
+
+def test_not_subtraversal(g):
+    monsters = (
+        g.V().has_label("monster").not_(lambda t: t.in_("pet"))
+        .values("name").to_list()
+    )
+    assert names(monsters) == ["hydra", "nemean"]  # cerberus is a pet
+
+
+# ---- project / group / fold -------------------------------------------------
+
+def test_project_with_by_modulators(g):
+    row = (
+        g.V().has("name", "hercules")
+        .project("name", "battles")
+        .by("name")
+        .by(lambda t: t.out("battled").count_())
+        .next()
+    )
+    assert row == {"name": "hercules", "battles": 3}
+
+
+def test_group_by_label_collects_names(g):
+    m = (
+        g.V().has("age", P.gt(0))
+        .group().by(lambda t: t.label_()).by("name").next()
+    )
+    assert names(m["god"]) == ["jupiter", "neptune", "pluto"]
+    assert m["titan"] == ["saturn"]
+    assert m["human"] == ["alcmene"]
+
+
+def test_fold_unfold_roundtrip(g):
+    folded = g.V().has_label("god").values("name").fold().next()
+    assert names(folded) == ["jupiter", "neptune", "pluto"]
+    out = (
+        g.V().has_label("god").values("name").fold().unfold().to_list()
+    )
+    assert names(out) == ["jupiter", "neptune", "pluto"]
+
+
+# ---- repeat/until/emit ------------------------------------------------------
+
+def test_repeat_until_ancestor_root(g):
+    # climb father edges until there is no further father -> saturn
+    out = (
+        g.V().has("name", "hercules")
+        .repeat(
+            lambda t: t.out("father"),
+            until=lambda t: t.not_(lambda s: s.out("father")),
+        ).values("name").to_list()
+    )
+    assert out == ["saturn"]
+
+
+def test_repeat_emit_collects_intermediates(g):
+    out = (
+        g.V().has("name", "hercules")
+        .repeat(lambda t: t.out("father"), times=2, emit=True)
+        .values("name").to_list()
+    )
+    assert names(out) == ["jupiter", "saturn"]
+
+
+def test_repeat_times_only_backcompat(g):
+    out = (
+        g.V().has("name", "hercules")
+        .repeat(lambda t: t.out("father"), times=2).values("name").to_list()
+    )
+    assert out == ["saturn"]
+
+
+def test_repeat_until_max_loops_guard(g):
+    # brother edges cycle forever; max_loops bounds the walk
+    out = (
+        g.V().has("name", "jupiter")
+        .repeat(
+            lambda t: t.out("brother"),
+            until=lambda t: t.has("name", "nobody"),
+            max_loops=3,
+        ).count()
+    )
+    assert out > 0  # exhausted loop bound, traversers exit
+
+
+# ---- misc ---------------------------------------------------------------
+
+def test_order_with_by_modulator(g):
+    out = g.V().has_label("god").order().by("age", reverse=True).values("name").to_list()
+    assert out == ["jupiter", "neptune", "pluto"]
+
+
+def test_by_without_modulatable_step_raises(g):
+    with pytest.raises(QueryError, match="by"):
+        g.V().out("father").by("name")
+
+
+def test_anonymous_traversal_cannot_execute(g):
+    from janusgraph_tpu.core.traversal import GraphTraversal
+
+    anon = GraphTraversal(g, None)
+    with pytest.raises(QueryError):
+        anon.to_list()
