@@ -340,6 +340,31 @@ class EdgeSerializer:
             return SliceQuery(start, _increment(start))
         return SliceQuery(prefix + bytes([d]), prefix + bytes([d + 1]))
 
+    def get_sort_range_slice(
+        self,
+        type_id: int,
+        direction: Direction,
+        lo: bytes,
+        hi: bytes,
+        sort_key_len: int,
+    ) -> SliceQuery:
+        """Column range covering sort keys in [lo, hi) for one edge type and
+        direction — the vertex-centric index RANGE scan (reference:
+        BasicVertexCentricQueryBuilder.java:780 interval constraints compiled
+        into key ranges by EdgeSerializer.java:235-319's order-preserving
+        sort-key encoding). lo/hi are order-preserving encodings of sort-key
+        value prefixes; hi is exclusive at its prefix."""
+        if direction == Direction.BOTH:
+            raise CodecError("sort-range scans need a concrete direction")
+        if len(lo) > sort_key_len or len(hi) > sort_key_len:
+            raise CodecError("sort-range bound longer than label sort key")
+        cat = _category_byte(type_id, True, self.idm)
+        base = struct.pack(">BQ", cat, type_id) + bytes(
+            [int(direction), sort_key_len]
+        )
+        end = base + hi if hi else _increment(base)
+        return SliceQuery(base + lo, end)
+
     # ------------------------------------------------------------- bulk decode
     def bulk_decode_edges(
         self, columns: List[bytes]

@@ -155,6 +155,10 @@ class VertexIDAssigner:
     def assign_relation_id(self) -> int:
         return self.idm.make_relation_id(self._relation_pool.next_id())
 
+    def assign_relation_ids(self, count: int):
+        """Bulk relation-id spans for columnar writers: [(start, len), ...]."""
+        return self._relation_pool.next_ids(count)
+
     def assign_schema_id(self, id_type: VertexIDType) -> int:
         return self.idm.make_schema_id(id_type, self._schema_pool.next_id())
 

@@ -198,6 +198,23 @@ class IDManager:
         key_int = (partition << rest_bits) | rest
         return key_int.to_bytes(8, "big")
 
+    def get_keys_array(self, vids) -> "list":
+        """Vectorized get_key for USER vertex ids (3-bit suffix): one numpy
+        pass renders all 8-byte BE row keys (the columnar bulk-load and
+        write-back paths call this with millions of ids)."""
+        import numpy as np
+
+        vids = np.asarray(vids, dtype=np.int64)
+        if len(vids) and np.any((vids & 0b111) == SCHEMA_MARK):
+            raise InvalidIDError("get_keys_array handles user vertex ids only")
+        pb = self.partition_bits
+        partition = (vids >> 3) & ((1 << pb) - 1)
+        count = vids >> (3 + pb)
+        rest = (count << 3) | (vids & 0b111)
+        key_int = (partition << (TOTAL_BITS - pb)) | rest
+        raw = key_int.astype(">u8").tobytes()
+        return [raw[i : i + 8] for i in range(0, len(raw), 8)]
+
     def get_vertex_id(self, key: bytes) -> int:
         key_int = int.from_bytes(key, "big")
         rest_bits = TOTAL_BITS - self.partition_bits

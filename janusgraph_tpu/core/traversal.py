@@ -483,27 +483,34 @@ class GraphTraversal:
     def both(self, *labels: str) -> "GraphTraversal":
         return self._expand(Direction.BOTH, labels, to_vertex=True)
 
-    def out_e(self, *labels: str) -> "GraphTraversal":
-        return self._expand(Direction.OUT, labels, to_vertex=False)
+    def out_e(self, *labels: str, sort_range=None) -> "GraphTraversal":
+        return self._expand(
+            Direction.OUT, labels, to_vertex=False, sort_range=sort_range
+        )
 
-    def in_e(self, *labels: str) -> "GraphTraversal":
-        return self._expand(Direction.IN, labels, to_vertex=False)
+    def in_e(self, *labels: str, sort_range=None) -> "GraphTraversal":
+        return self._expand(
+            Direction.IN, labels, to_vertex=False, sort_range=sort_range
+        )
 
     def both_e(self, *labels: str) -> "GraphTraversal":
         return self._expand(Direction.BOTH, labels, to_vertex=False)
 
-    def _expand(self, direction, labels, to_vertex) -> "GraphTraversal":
+    def _expand(
+        self, direction, labels, to_vertex, sort_range=None
+    ) -> "GraphTraversal":
         tx = self.tx
 
         def step(ts: List[Traverser]) -> List[Traverser]:
             vs = [t.obj for t in ts if isinstance(t.obj, Vertex)]
-            tx.prefetch(vs, direction, labels)  # the multiQuery batch
+            if sort_range is None:
+                tx.prefetch(vs, direction, labels)  # the multiQuery batch
             out: List[Traverser] = []
             for t in ts:
                 v = t.obj
                 if not isinstance(v, Vertex):
                     continue
-                for e in tx.get_edges(v, direction, labels):
+                for e in tx.get_edges(v, direction, labels, sort_range=sort_range):
                     if to_vertex:
                         out.append(t.child(e.other(v), prev=v))
                     else:

@@ -347,12 +347,35 @@ class Transaction:
         return results
 
     def get_edges(
-        self, v: Vertex, direction: Direction, labels: Sequence[str]
+        self,
+        v: Vertex,
+        direction: Direction,
+        labels: Sequence[str],
+        sort_range: Optional[tuple] = None,
     ) -> List[Edge]:
+        """Edges incident to v. `sort_range=(lo, hi)` restricts a
+        sort-keyed label to sort-key values in [lo, hi) — compiled into a
+        column-range slice, i.e. the vertex-centric index (reference:
+        BasicVertexCentricQueryBuilder interval constraints). lo/hi are a
+        value (first sort-key property) or a tuple of values (a prefix of
+        the label's sort-key properties); None leaves that bound open.
+        Requires exactly one sort-keyed label and a concrete direction."""
         es = self.graph.edge_serializer
         results: List[Edge] = []
+        sr_bytes = None
+        if sort_range is not None:
+            sr_bytes = self._encode_sort_range(labels, direction, sort_range)
         if not v.is_new:
-            for q in self._edge_slices(direction, labels):
+            if sr_bytes is not None:
+                el, lo_b, hi_b, sk_len = sr_bytes
+                slices = [
+                    es.get_sort_range_slice(
+                        el.id, direction, lo_b, hi_b, sk_len
+                    )
+                ]
+            else:
+                slices = self._edge_slices(direction, labels)
+            for q in slices:
                 for entry in self._read_slice(v.id, q):
                     rc = es.parse_relation(entry, self._codec_schema)
                     if rc.relation_id in self._deleted_ids:
@@ -371,6 +394,12 @@ class Transaction:
                     continue
                 if direction == Direction.IN and rel.in_vertex.id != v.id:
                     continue
+                if sr_bytes is not None:
+                    # same [lo, hi) semantics as the committed column range
+                    _el, lo_b, hi_b, _len = sr_bytes
+                    sk = rel._sort_key or b""
+                    if (lo_b and sk < lo_b) or (hi_b and sk >= hi_b):
+                        continue
                 results.append(rel)
                 # a self-loop has two incidences: BOTH sees it twice, matching
                 # the committed representation (one OUT + one IN cell)
@@ -381,6 +410,42 @@ class Transaction:
                 ):
                     results.append(rel)
         return results
+
+    def _encode_sort_range(self, labels, direction, sort_range):
+        """Resolve (lo, hi) sort-range values into order-preserving byte
+        bounds for one sort-keyed label: (label, lo_bytes, hi_bytes, width)."""
+        from janusgraph_tpu.exceptions import QueryError
+
+        if len(labels) != 1:
+            raise QueryError("sort_range requires exactly one edge label")
+        if direction == Direction.BOTH:
+            raise QueryError("sort_range requires a concrete direction")
+        el = self.schema_by_name(labels[0])
+        if not isinstance(el, EdgeLabel) or not el.sort_key:
+            raise QueryError(f"label {labels[0]!r} has no sort key")
+        ser = self.graph.serializer
+        sk_len = 0
+        for key_id in el.sort_key:
+            pk = self.schema_by_id(key_id)
+            width = ser.serializer_for_type(pk.data_type).fixed_width
+            sk_len += width
+
+        def enc(bound):
+            if bound is None:
+                return b""
+            vals = bound if isinstance(bound, tuple) else (bound,)
+            if len(vals) > len(el.sort_key):
+                raise QueryError("sort_range bound has too many values")
+            out = []
+            for key_id, v in zip(el.sort_key, vals):
+                pk = self.schema_by_id(key_id)
+                if type(v) is int and pk.data_type is not int:
+                    v = pk.data_type(v)
+                out.append(ser.write_ordered(v))
+            return b"".join(out)
+
+        lo, hi = sort_range
+        return el, enc(lo), enc(hi), sk_len
 
     def _label_ids(self, labels: Sequence[str]) -> Optional[Set[int]]:
         if not labels:

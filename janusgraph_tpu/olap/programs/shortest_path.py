@@ -19,6 +19,20 @@ INF = 1e18
 
 
 class ShortestPathProgram(VertexProgram):
+    """Min-relaxation SSSP / BFS.
+
+    track_paths=True additionally materializes a predecessor array so actual
+    paths can be reconstructed on host (reference: TinkerPop
+    ShortestPathVertexProgram materializes paths, special-cased at
+    FulgoraGraphComputer.java:249-253; the TPU-native form is a predecessor
+    index per vertex + host chain-walk, not per-traverser path objects).
+    Unweighted only: at superstep t the frontier is exactly {dist == t}, so
+    the message is the sender's own (global) index where it is on the
+    frontier and +inf elsewhere; MIN-combining yields, at each newly reached
+    vertex, the smallest-index frontier neighbor as its predecessor —
+    float32-exact (indices < 2^24), no wide encodings needed.
+    """
+
     compute_keys = ("distance",)
     combiner = Combiner.MIN
     setup_only_params = ("seed_index",)
@@ -29,27 +43,55 @@ class ShortestPathProgram(VertexProgram):
         weighted: bool = False,
         undirected: bool = False,
         max_iterations: int = 100,
+        track_paths: bool = False,
     ):
+        if track_paths and weighted:
+            raise ValueError(
+                "track_paths requires unweighted BFS (frontier-index "
+                "predecessor encoding); run weighted distances without paths"
+            )
         self.seed_index = seed_index
         self.weighted = weighted
+        self.track_paths = track_paths
         self.edge_transform = (
             EdgeTransform.ADD_WEIGHT if weighted else EdgeTransform.NONE
         )
         self.undirected = undirected
         self.max_iterations = max_iterations
+        if track_paths:
+            self.compute_keys = ("distance", "predecessor")
 
     def setup(self, graph, xp):
         idx = xp.arange(graph.local_num_vertices) + graph.global_offset
         dist = xp.where(idx == self.seed_index, 0.0, INF)
-        return {"distance": dist}, {"changed": (Combiner.SUM, xp.asarray(1.0))}
+        state = {"distance": dist}
+        if self.track_paths:
+            # seed points at itself; unreached at -1
+            state["predecessor"] = xp.where(
+                idx == self.seed_index, float(self.seed_index), -1.0
+            )
+        return state, {"changed": (Combiner.SUM, xp.asarray(1.0))}
 
     def message(self, state, superstep, graph, xp):
+        if self.track_paths:
+            idx = xp.arange(graph.local_num_vertices) + graph.global_offset
+            on_frontier = state["distance"] == superstep
+            return xp.where(on_frontier, idx.astype(state["distance"].dtype), INF)
         if self.weighted:
             return state["distance"]
         return state["distance"] + 1.0
 
     def apply(self, state, aggregated, superstep, memory_in, graph, xp):
         old = state["distance"]
+        if self.track_paths:
+            newly = (old >= INF) & (aggregated < INF)
+            dist = xp.where(newly, superstep + 1.0, old)
+            pred = xp.where(newly, aggregated, state["predecessor"])
+            changed = xp.sum(xp.where(newly, 1.0, 0.0))
+            return (
+                {"distance": dist, "predecessor": pred},
+                {"changed": (Combiner.SUM, changed)},
+            )
         new = xp.minimum(old, aggregated)
         changed = xp.sum(xp.where(new < old, 1.0, 0.0))
         return {"distance": new}, {"changed": (Combiner.SUM, changed)}
@@ -59,3 +101,26 @@ class ShortestPathProgram(VertexProgram):
 
     def terminate_device(self, values, steps_done, xp):
         return values["changed"] == 0.0
+
+
+def reconstruct_path(result, target_index: int):
+    """Walk the predecessor chain host-side: [seed, ..., target], or None if
+    the target was never reached. `result` is a run() output of a
+    track_paths=True program."""
+    import numpy as np
+
+    pred = np.asarray(result["predecessor"]).astype(np.int64)
+    dist = np.asarray(result["distance"])
+    if target_index >= len(pred) or dist[target_index] >= INF:
+        return None
+    path = [int(target_index)]
+    v = int(target_index)
+    for _ in range(len(pred)):
+        p = int(pred[v])
+        if p < 0:
+            return None
+        if p == v:  # seed reached
+            return list(reversed(path))
+        path.append(p)
+        v = p
+    return None  # cycle guard — malformed predecessor array

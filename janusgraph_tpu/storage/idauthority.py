@@ -72,6 +72,13 @@ class IDBlock:
         self._next += 1
         return v
 
+    def next_span(self, count: int):
+        """Consume up to `count` contiguous ids; returns (start, taken)."""
+        taken = min(count, self.size - self._next)
+        start = self.start + self._next
+        self._next += taken
+        return start, taken
+
     @property
     def remaining(self) -> int:
         return self.size - self._next
@@ -247,6 +254,30 @@ class StandardIDPool:
                         raise err
                     continue
                 self._current = self._fetch()
+
+    def next_ids(self, count: int):
+        """Bulk allocation: spans of contiguous ids drawn from successive
+        blocks (the columnar write-back path needs millions of relation ids;
+        one next_id() round trip per id would dominate). Returns a list of
+        (start, length) spans covering exactly `count` ids."""
+        spans = []
+        remaining = count
+        with self._lock:
+            while remaining > 0:
+                if self._current is None or self._current.remaining == 0:
+                    if self._next_block is not None:
+                        self._current, self._next_block = self._next_block, None
+                    else:
+                        self._current = self._fetch()
+                start, taken = self._current.next_span(remaining)
+                if taken:
+                    if self.max_id is not None and start + taken - 1 > self.max_id:
+                        raise IDPoolExhaustedError(
+                            f"id namespace {self.namespace} exhausted"
+                        )
+                    spans.append((start, taken))
+                    remaining -= taken
+        return spans
 
     def _fetch(self) -> IDBlock:
         return self.authority.get_id_block(self.namespace, self.partition)

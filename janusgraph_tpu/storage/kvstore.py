@@ -169,19 +169,32 @@ class OrderedKVAdapter(KeyColumnValueStore):
             sq = query.slice
         else:
             start, end, sq = b"", None, query
+        # Row grouping via prefix match, not per-cell decode: within the
+        # encoded-key part 0x00 is always followed by 0xFF, so the FIRST
+        # b"\x00\x00" in a composite is exactly the terminator, and only
+        # composites of the same row start with (encoded key + terminator) —
+        # one C-level startswith per cell replaces the byte-walk decode
+        # (this adapter is the OLAP full-scan hot path).
+        limit = sq.limit
+        contains = sq.contains
+        prefix: Optional[bytes] = None
+        plen = 0
         cur_key: Optional[bytes] = None
         cur_entries: EntryList = []
         for ck, v in self.kv.scan(start, end, txh):
-            k, col = decode_composite(ck)
-            if k != cur_key:
-                if cur_key is not None and cur_entries:
+            if prefix is None or not ck.startswith(prefix):
+                if cur_entries:
                     yield cur_key, cur_entries
-                cur_key, cur_entries = k, []
-            if sq.contains(col) and (
-                sq.limit is None or len(cur_entries) < sq.limit
-            ):
+                t = ck.find(_TERM)
+                kenc = ck[:t]
+                cur_key = kenc.replace(b"\x00\xff", b"\x00")
+                prefix = kenc + _TERM
+                plen = len(prefix)
+                cur_entries = []
+            col = ck[plen:]
+            if contains(col) and (limit is None or len(cur_entries) < limit):
                 cur_entries.append((col, v))
-        if cur_key is not None and cur_entries:
+        if cur_entries:
             yield cur_key, cur_entries
 
     def close(self) -> None:
