@@ -439,8 +439,17 @@ class Transaction:
             out = []
             for key_id, v in zip(el.sort_key, vals):
                 pk = self.schema_by_id(key_id)
-                if type(v) is int and pk.data_type is not int:
-                    v = pk.data_type(v)
+                if not isinstance(v, pk.data_type):
+                    coerced = pk.data_type(v)
+                    if coerced != v:
+                        # e.g. a float bound on an int sort key would be
+                        # encoded in a non-comparable byte space and match
+                        # nothing — reject instead of silently returning []
+                        raise QueryError(
+                            f"sort_range bound {v!r} is not exactly "
+                            f"representable as {pk.data_type.__name__}"
+                        )
+                    v = coerced
                 out.append(ser.write_ordered(v))
             return b"".join(out)
 

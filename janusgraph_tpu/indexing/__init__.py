@@ -24,6 +24,7 @@ from janusgraph_tpu.indexing.provider import (
     open_index_provider,
 )
 from janusgraph_tpu.indexing.memindex import InMemoryIndexProvider
+from janusgraph_tpu.indexing.localindex import LocalIndexProvider
 
 __all__ = [
     "And",
@@ -34,6 +35,7 @@ __all__ = [
     "IndexQuery",
     "IndexTransaction",
     "InMemoryIndexProvider",
+    "LocalIndexProvider",
     "KeyInformation",
     "Mapping",
     "Not",

@@ -66,6 +66,12 @@ class ShortestPathProgram(VertexProgram):
         dist = xp.where(idx == self.seed_index, 0.0, INF)
         state = {"distance": dist}
         if self.track_paths:
+            if graph.num_vertices >= (1 << 24):
+                raise ValueError(
+                    "track_paths stores vertex indices in float32 state, "
+                    "exact only below 2^24 vertices; run distances without "
+                    "paths at this scale"
+                )
             # seed points at itself; unreached at -1
             state["predecessor"] = xp.where(
                 idx == self.seed_index, float(self.seed_index), -1.0

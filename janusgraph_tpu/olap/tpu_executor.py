@@ -536,6 +536,8 @@ def _write_back_tx(graph, vids, name, values, batch: int) -> None:
 def _write_back_columnar(graph, vids, pk, values, batch: int) -> None:
     import struct
 
+    if len(vids) == 0:
+        return
     values = np.asarray(values, dtype=np.float64)
     es = graph.edge_serializer
     idm = graph.idm

@@ -25,9 +25,17 @@ from janusgraph_tpu.storage.inmemory import InMemoryStoreManager
 
 
 # ---------------------------------------------------------------- SPI contract
-@pytest.fixture
-def provider():
-    p = InMemoryIndexProvider()
+# Abstract-suite pattern (reference: IndexProviderTest.java parameterized per
+# backend): every SPI-contract test below runs against BOTH the in-memory
+# provider and the persistent localindex provider.
+@pytest.fixture(params=["memindex", "localindex"])
+def provider(request, tmp_path):
+    if request.param == "memindex":
+        p = InMemoryIndexProvider()
+    else:
+        from janusgraph_tpu.indexing import LocalIndexProvider
+
+        p = LocalIndexProvider(directory=str(tmp_path / "idx"))
     p.register("store", "name", KeyInformation(str, Mapping.TEXT))
     p.register("store", "title", KeyInformation(str, Mapping.STRING))
     p.register("store", "weight", KeyInformation(float))
@@ -336,3 +344,140 @@ def test_build_mixed_index_validation(graph):
     mgmt.build_mixed_index("ok", ["x"], backing="search")
     with pytest.raises(SchemaViolationError):
         mgmt.build_mixed_index("ok", ["x"], backing="search")
+
+
+# ---------------------------------------------------- localindex persistence
+def _mk_local(tmp_path, name="idx"):
+    from janusgraph_tpu.indexing import LocalIndexProvider
+
+    return LocalIndexProvider(directory=str(tmp_path / name))
+
+
+def test_localindex_survives_reopen(tmp_path):
+    p = _mk_local(tmp_path)
+    p.register("s", "name", KeyInformation(str, Mapping.TEXT))
+    p.register("s", "score", KeyInformation(float))
+    m = IndexMutation(is_new=True)
+    m.add("name", "cerberus the hound")
+    m.add("score", 4.5)
+    p.mutate({"s": {"doc9": m}}, {})
+    p.close()
+
+    p2 = _mk_local(tmp_path)
+    assert p2.query(
+        "s", IndexQuery(PredicateCondition("name", Text.CONTAINS, "hound"))
+    ) == ["doc9"]
+    assert p2.query(
+        "s", IndexQuery(PredicateCondition("score", Cmp.GREATER_THAN, 4.0))
+    ) == ["doc9"]
+    # field metadata (mapping) also persisted
+    assert p2.supports(
+        KeyInformation(str, Mapping.TEXT), Text.CONTAINS
+    )
+    p2.close()
+
+
+def test_localindex_survives_compaction(tmp_path):
+    p = _mk_local(tmp_path)
+    p.register("s", "w", KeyInformation(float))
+    for i in range(20):
+        m = IndexMutation(is_new=True)
+        m.add("w", float(i))
+        p.mutate({"s": {f"d{i}": m}}, {})
+    p.compact()
+    p.close()
+    p2 = _mk_local(tmp_path)
+    hits = p2.query(
+        "s",
+        IndexQuery(PredicateCondition("w", Cmp.GREATER_THAN_EQUAL, 17.0)),
+    )
+    assert sorted(hits) == ["d17", "d18", "d19"]
+    p2.close()
+
+
+def test_localindex_range_is_contiguous_scan(tmp_path):
+    """Numeric ranges resolve via ONE ordered-KV range scan, not a doc scan."""
+    p = _mk_local(tmp_path)
+    p.register("s", "w", KeyInformation(float))
+    for i in range(50):
+        m = IndexMutation(is_new=True)
+        m.add("w", float(i))
+        p.mutate({"s": {f"d{i:02d}": m}}, {})
+    calls = []
+    orig = p._kv.scan
+
+    def spy(start, end, txh):
+        calls.append((start, end))
+        return orig(start, end, txh)
+
+    p._kv.scan = spy
+    hits = p.query(
+        "s", IndexQuery(PredicateCondition("w", Cmp.LESS_THAN, 3.0))
+    )
+    assert sorted(hits) == ["d00", "d01", "d02"]
+    assert len(calls) == 1  # one contiguous posting-range scan
+    p.close()
+
+
+def test_graph_with_localindex_backing(tmp_path):
+    g = open_graph({
+        "schema.default": "auto",
+        "index.search.backend": "localindex",
+        "index.search.directory": str(tmp_path / "gidx"),
+    })
+    mgmt = g.management()
+    mgmt.make_property_key("bio", str)
+    mgmt.make_property_key("age", int)
+    mgmt.build_mixed_index("people", ["bio", "age"], backing="search")
+    tx = g.new_transaction()
+    a = tx.add_vertex(bio="fought the nemean lion", age=30)
+    b = tx.add_vertex(bio="god of thunder and sky", age=5000)
+    tx.commit()
+    t = g.traversal()
+    hits = t.V().has("bio", P.text_contains("thunder")).to_list()
+    assert [v.id for v in hits] == [b.id]
+    hits = t.V().has("age", P.lt(500)).to_list()
+    assert [v.id for v in hits] == [a.id]
+    g.close()
+
+
+def test_localindex_reindex_existing_data(tmp_path):
+    """REINDEX repopulates the persistent provider from primary storage
+    (restore path) for data written before the index existed."""
+    from janusgraph_tpu.core.management import SchemaAction
+
+    g = open_graph({
+        "schema.default": "auto",
+        "index.search.backend": "localindex",
+        "index.search.directory": str(tmp_path / "ridx"),
+    })
+    tx = g.new_transaction()
+    a = tx.add_vertex(story="the hydra grew two heads")
+    tx.commit()
+    mgmt = g.management()
+    idx = mgmt.build_mixed_index("stories", ["story"], backing="search")
+    mgmt.update_index("stories", SchemaAction.REINDEX)
+    hits = g.traversal().V().has("story", P.text_contains("hydra")).to_list()
+    assert [v.id for v in hits] == [a.id]
+    g.close()
+
+
+def test_localindex_cross_type_numeric_conditions(tmp_path):
+    """Int conditions on float fields (and vice versa) must behave like the
+    in-memory provider: conditions encode in the FIELD's value space."""
+    p = _mk_local(tmp_path)
+    p.register("s", "w", KeyInformation(float))
+    p.register("s", "n", KeyInformation(int))
+    m = IndexMutation(is_new=True)
+    m.add("w", 0.5)
+    m.add("n", 2)
+    p.mutate({"s": {"d1": m}}, {})
+    # int condition on float field
+    assert p.query("s", IndexQuery(PredicateCondition("w", Cmp.LESS_THAN, 3))) == ["d1"]
+    assert p.query("s", IndexQuery(PredicateCondition("w", Cmp.GREATER_THAN, 3))) == []
+    # non-integral float condition on int field: exact range rewrite
+    assert p.query("s", IndexQuery(PredicateCondition("n", Cmp.GREATER_THAN, 1.5))) == ["d1"]
+    assert p.query("s", IndexQuery(PredicateCondition("n", Cmp.LESS_THAN, 1.5))) == []
+    assert p.query("s", IndexQuery(PredicateCondition("n", Cmp.EQUAL, 1.5))) == []
+    assert p.query("s", IndexQuery(PredicateCondition("n", Cmp.EQUAL, 2.0))) == ["d1"]
+    p.close()

@@ -129,3 +129,10 @@ def test_multi_property_sort_key():
     ]
     assert got == [(1, 2), (2, 1)]
     graph.close()
+
+
+def test_sort_range_rejects_lossy_bound(g):
+    tx = g.new_transaction()
+    h = hercules(tx, g)
+    with pytest.raises(QueryError, match="representable"):
+        tx.get_edges(h, Direction.OUT, ("battled",), sort_range=(1.5, None))
