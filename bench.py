@@ -302,6 +302,9 @@ def _bench_scale(jax, platform, scale, edge_factor, pr_iters, strategy, t0):
         f"({gen_s:.1f}s)", t0)
 
     timed = PageRankProgram(max_iterations=pr_iters, tol=0.0)
+    ell_fp = TPUExecutor.ell_footprint(csr)
+    _hb(f"s{scale}: ell footprint {ell_fp['bytes']/2**30:.2f}GB "
+        f"(pad {ell_fp['pad_ratio']:.2f}x)", t0)
     x0 = time.perf_counter()
     ex = TPUExecutor(csr, strategy=strategy)
     # force device transfer of the aggregation structures now so transfer
@@ -351,6 +354,8 @@ def _bench_scale(jax, platform, scale, edge_factor, pr_iters, strategy, t0):
         "graph_gen_s": round(gen_s, 2),
         "transfer_pack_s": round(transfer_s, 2),
         "compile_s": round(compile_s, 2),
+        "ell_bytes": ell_fp["bytes"],
+        "ell_pad_ratio": round(ell_fp["pad_ratio"], 3),
     })
     del ex, csr
 

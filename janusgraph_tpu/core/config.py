@@ -256,6 +256,16 @@ STORAGE.option(
     Mutability.MASKABLE, lambda v: v >= 0,
 )
 IDS.option(
+    "placement", str,
+    "vertex partition placement strategy ('simple'|'property')", "simple",
+    Mutability.MASKABLE, lambda v: v in ("simple", "property"),
+)
+IDS.option(
+    "placement-key", str,
+    "property whose hashed value picks the partition ('property' strategy)",
+    "",
+)
+IDS.option(
     "renew-percentage", float,
     "fraction of an id block remaining that triggers background renewal",
     0.3, Mutability.MASKABLE, lambda v: 0.0 < v < 1.0,

@@ -113,10 +113,14 @@ class VertexIDAssigner:
         authority: ConsistentKeyIDAuthority,
         idm: IDManager,
         renew_fraction: Optional[float] = None,
+        placement=None,
     ):
+        from janusgraph_tpu.core.placement import SimpleBulkPlacementStrategy
+
         self.authority = authority
         self.idm = idm
         self.renew_fraction = renew_fraction  # ids.renew-percentage
+        self.placement = placement or SimpleBulkPlacementStrategy()
         self._vertex_pools: Dict[int, StandardIDPool] = {}
         self._relation_pool = StandardIDPool(
             authority, ConsistentKeyIDAuthority.NS_RELATION, 0,
@@ -140,10 +144,19 @@ class VertexIDAssigner:
                 self._vertex_pools[partition] = pool
             return pool
 
-    def assign_vertex_id(self, partitioned: bool = False) -> int:
+    def assign_vertex_id(
+        self,
+        partitioned: bool = False,
+        label=None,
+        props: Optional[dict] = None,
+    ) -> int:
         with self._lock:
-            partition = self._rr % self.idm.num_partitions
-            self._rr += 1
+            partition = self.placement.partition_for(
+                label, props, self.idm.num_partitions
+            )
+            if partition is None:
+                partition = self._rr % self.idm.num_partitions
+                self._rr += 1
         count = self._pool(partition).next_id()
         if partitioned:
             canonical = count % self.idm.num_partitions
@@ -223,9 +236,14 @@ class JanusGraphTPU:
         )
         self.instance_registry = InstanceRegistry(self.backend)
         self.instance_registry.register(self.instance_id)
+        from janusgraph_tpu.core.placement import make_placement_strategy
+
         self.id_assigner = VertexIDAssigner(
             self.backend.id_authority, self.idm,
             renew_fraction=cfg.get("ids.renew-percentage"),
+            placement=make_placement_strategy(
+                cfg.get("ids.placement"), cfg.get("ids.placement-key")
+            ),
         )
         # the durable log bus: WAL, schema broadcast, user CDC
         # (reference: Backend.java:267,312,316 — txlog/systemlog/user logs)

@@ -116,7 +116,7 @@ class Transaction:
         self._check_writable()
         label_el = self.graph.get_or_create_vertex_label(label or "vertex")
         vid = self.graph.id_assigner.assign_vertex_id(
-            partitioned=label_el.partitioned
+            partitioned=label_el.partitioned, label=label_el, props=props
         )
         v = Vertex(vid, self, LifeCycle.NEW)
         v._label_cache = label_el.name
@@ -440,7 +440,12 @@ class Transaction:
             for key_id, v in zip(el.sort_key, vals):
                 pk = self.schema_by_id(key_id)
                 if not isinstance(v, pk.data_type):
-                    coerced = pk.data_type(v)
+                    try:
+                        coerced = pk.data_type(v)
+                    except (TypeError, ValueError) as e:
+                        raise QueryError(
+                            f"sort_range bound {v!r}: {e}"
+                        ) from e
                     if coerced != v:
                         # e.g. a float bound on an int sort key would be
                         # encoded in a non-comparable byte space and match

@@ -147,7 +147,10 @@ class LocalIndexProvider(IndexProvider):
         if isinstance(value, Geoshape):
             return []  # exact-tested over the doc store
         try:
-            return [b"o" + self._ser.write_ordered(value)]
+            # encode in the FIELD's registered value space (int values on a
+            # float field must land in float-ordered bytes, matching the
+            # query-side _coerce — parity with the in-memory provider)
+            return [b"o" + self._ser.write_ordered(self._coerce(info, value))]
         except Exception:
             return []
 
@@ -196,17 +199,17 @@ class LocalIndexProvider(IndexProvider):
                     self._mkey(store, key), json.dumps(meta).encode(), self._tx
                 )
 
-    # doc value (en/de)coding: [count u16] then framed values
+    # doc value (en/de)coding: [count u32] then framed values
     def _encode_values(self, values: List[object]) -> bytes:
-        parts = [struct.pack(">H", len(values))]
+        parts = [struct.pack(">I", len(values))]
         for v in values:
             framed = self._ser.write_object(v)
             parts.append(struct.pack(">I", len(framed)) + framed)
         return b"".join(parts)
 
     def _decode_values(self, data: bytes) -> List[object]:
-        (n,) = struct.unpack(">H", data[:2])
-        off = 2
+        (n,) = struct.unpack(">I", data[:4])
+        off = 4
         out = []
         for _ in range(n):
             (ln,) = struct.unpack(">I", data[off : off + 4])
@@ -250,15 +253,21 @@ class LocalIndexProvider(IndexProvider):
         for term in self._terms_for(info, value):
             self._posting_adjust(store, field, term, docid, -1)
 
-    def _add_value(self, store: str, docid: str, field: str, value, key_infos):
+    def _add_values(
+        self, store: str, docid: str, field: str, values: List[object], key_infos
+    ):
+        """Append a BATCH of values to one doc field: one read-modify-write
+        of the doc entry regardless of how many values the mutation carries
+        (per-value re-encoding would be O(n^2) for LIST-cardinality docs)."""
         info = self._info(store, field, key_infos)
         vals = self._doc_values(store, docid).get(field, [])
-        vals.append(value)
+        vals.extend(values)
         self._kv.insert(
             self._dkey(store, docid, field), self._encode_values(vals), self._tx
         )
-        for term in self._terms_for(info, value):
-            self._posting_adjust(store, field, term, docid, +1)
+        for value in values:
+            for term in self._terms_for(info, value):
+                self._posting_adjust(store, field, term, docid, +1)
 
     def _delete_doc(self, store: str, docid: str, key_infos) -> None:
         for field, vals in self._doc_values(store, docid).items():
@@ -267,6 +276,13 @@ class LocalIndexProvider(IndexProvider):
                 for term in self._terms_for(info, v):
                     self._posting_adjust(store, field, term, docid, -1)
             self._kv.delete(self._dkey(store, docid, field), self._tx)
+
+    @staticmethod
+    def _group_by_field(entries) -> Dict[str, List[object]]:
+        grouped: Dict[str, List[object]] = {}
+        for e in entries:
+            grouped.setdefault(e.field, []).append(e.value)
+        return grouped
 
     def mutate(self, mutations, key_infos) -> None:
         with self._lock:
@@ -278,8 +294,8 @@ class LocalIndexProvider(IndexProvider):
                             continue
                     for e in m.deletions:
                         self._remove_value(store, docid, e.field, e.value, key_infos)
-                    for e in m.additions:
-                        self._add_value(store, docid, e.field, e.value, key_infos)
+                    for field, values in self._group_by_field(m.additions).items():
+                        self._add_values(store, docid, field, values, key_infos)
             self._tx.commit()
 
     def restore(self, documents, key_infos) -> None:
@@ -287,8 +303,8 @@ class LocalIndexProvider(IndexProvider):
             for store, per_doc in documents.items():
                 for docid, entries in per_doc.items():
                     self._delete_doc(store, docid, key_infos)
-                    for e in entries:
-                        self._add_value(store, docid, e.field, e.value, key_infos)
+                    for field, values in self._group_by_field(entries).items():
+                        self._add_values(store, docid, field, values, key_infos)
             self._tx.commit()
 
     # ---------------------------------------------------------------- query
@@ -321,12 +337,16 @@ class LocalIndexProvider(IndexProvider):
         return out
 
     def _docs_with_field(self, store: str, field: str):
-        """(docid, values) pairs for docs carrying the field — doc-store scan
-        (the exact-test fallback path)."""
-        for docid in self._all_docids(store):
-            vals = self._doc_values(store, docid).get(field)
-            if vals:
-                yield docid, vals
+        """(docid, values) pairs for docs carrying the field — ONE contiguous
+        scan of the store's doc region (the exact-test fallback path), not a
+        per-doc range scan."""
+        prefix = b"D" + encode_key(store.encode())
+        want = field.encode()
+        for k, v in self._kv.scan(prefix, _next_prefix(prefix), self._tx):
+            docid_b, rest = _decode_segment(k[len(prefix) :])
+            field_b, _ = _decode_segment(rest)
+            if field_b == want:
+                yield docid_b.decode(), self._decode_values(v)
 
     def _coerce(self, info: KeyInformation, cond):
         """Encode query conditions in the FIELD's value space: postings were

@@ -481,3 +481,37 @@ def test_localindex_cross_type_numeric_conditions(tmp_path):
     assert p.query("s", IndexQuery(PredicateCondition("n", Cmp.EQUAL, 1.5))) == []
     assert p.query("s", IndexQuery(PredicateCondition("n", Cmp.EQUAL, 2.0))) == ["d1"]
     p.close()
+
+
+def test_localindex_write_side_value_coercion(tmp_path):
+    """Values stored with a looser Python type than the field's registered
+    type must still be reachable by typed conditions (parity with the
+    in-memory provider's behavior)."""
+    p = _mk_local(tmp_path)
+    p.register("s", "w", KeyInformation(float))
+    m = IndexMutation(is_new=True)
+    m.add("w", 2)  # int value on a float field
+    p.mutate({"s": {"d1": m}}, {})
+    assert p.query("s", IndexQuery(PredicateCondition("w", Cmp.EQUAL, 2.0))) == ["d1"]
+    assert p.query("s", IndexQuery(PredicateCondition("w", Cmp.EQUAL, 2))) == ["d1"]
+    p.close()
+
+
+def test_localindex_bulk_list_values(tmp_path):
+    """A large LIST-cardinality mutation completes quickly (batched doc
+    encoding) and survives the u32 value count."""
+    import time as _time
+
+    p = _mk_local(tmp_path)
+    p.register("s", "tags", KeyInformation(float, cardinality="LIST"))
+    m = IndexMutation(is_new=True)
+    for i in range(70_000):
+        m.add("tags", float(i))
+    t0 = _time.perf_counter()
+    p.mutate({"s": {"d1": m}}, {})
+    assert _time.perf_counter() - t0 < 20.0
+    hits = p.query(
+        "s", IndexQuery(PredicateCondition("tags", Cmp.GREATER_THAN, 69_998.0))
+    )
+    assert hits == ["d1"]
+    p.close()

@@ -206,3 +206,23 @@ def test_compute_api_and_write_back(gods_graph):
     assert saturn_rank is not None and saturn_rank > 0
     # highest-rank vertices should include tartarus/saturn (sinks of chains)
     ranks = result.by_vertex("rank")
+
+
+def test_ell_auto_strategy_budget():
+    """auto picks ELL within budget, segment when ELL padding blows up
+    (e.g. huge vertex sets with almost no edges: every empty row still
+    costs one ELL slot)."""
+    from janusgraph_tpu.olap import csr_from_edges
+    from janusgraph_tpu.olap.tpu_executor import TPUExecutor
+
+    dense = csr_from_edges(100, np.arange(99), np.arange(1, 100))
+    fp = TPUExecutor.ell_footprint(dense)
+    assert fp["pad_ratio"] <= 2.0
+    assert TPUExecutor(dense).strategy == "ell"
+
+    sparse = csr_from_edges(50_000, [0, 1], [1, 2])
+    fp = TPUExecutor.ell_footprint(sparse)
+    assert fp["pad_ratio"] > 3.0
+    assert TPUExecutor(sparse).strategy == "segment"
+    # explicit strategy always wins over the heuristic
+    assert TPUExecutor(sparse, strategy="ell").strategy == "ell"

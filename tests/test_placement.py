@@ -1,0 +1,73 @@
+"""ID placement strategies (reference: placement/PropertyPlacementStrategy
+.java:110, SimpleBulkPlacementStrategy.java:130): property-hash co-location
+vs round-robin spread, wired through ids.placement config.
+"""
+
+import pytest
+
+from janusgraph_tpu.core.graph import open_graph
+from janusgraph_tpu.core.placement import (
+    PropertyPlacementStrategy,
+    SimpleBulkPlacementStrategy,
+    make_placement_strategy,
+    stable_hash,
+)
+from janusgraph_tpu.exceptions import ConfigurationError
+
+
+def test_simple_spreads_round_robin():
+    s = SimpleBulkPlacementStrategy()
+    got = [s.partition_for(None, None, 4) for _ in range(8)]
+    assert got == [0, 1, 2, 3, 0, 1, 2, 3]
+
+
+def test_property_colocates_same_value():
+    s = PropertyPlacementStrategy("region")
+    parts = {
+        s.partition_for(None, {"region": "emea"}, 32) for _ in range(10)
+    }
+    assert len(parts) == 1
+    # missing key falls back to spread (round robin over calls)
+    a = s.partition_for(None, {}, 4)
+    b = s.partition_for(None, {}, 4)
+    assert (a, b) == (0, 1)
+
+
+def test_stable_hash_is_process_independent():
+    assert stable_hash("emea") == stable_hash("emea")
+    assert stable_hash(b"x") == stable_hash(b"x")
+    assert stable_hash(42) == stable_hash(42)
+
+
+def test_graph_level_property_placement():
+    g = open_graph({
+        "ids.placement": "property",
+        "ids.placement-key": "region",
+        "schema.default": "auto",
+    })
+    tx = g.new_transaction()
+    emea = [tx.add_vertex(region="emea", name=f"e{i}") for i in range(6)]
+    apac = [tx.add_vertex(region="apac", name=f"a{i}") for i in range(6)]
+    tx.commit()
+    p_emea = {g.idm.get_partition_id(v.id) for v in emea}
+    p_apac = {g.idm.get_partition_id(v.id) for v in apac}
+    assert len(p_emea) == 1, "same region value must co-locate"
+    assert len(p_apac) == 1
+    g.close()
+
+
+def test_property_strategy_requires_key():
+    with pytest.raises(ConfigurationError):
+        make_placement_strategy("property", "")
+    with pytest.raises(ConfigurationError):
+        make_placement_strategy("nope")
+
+
+def test_default_graph_keeps_round_robin_spread():
+    g = open_graph()
+    tx = g.new_transaction()
+    vs = [tx.add_vertex() for _ in range(8)]
+    tx.commit()
+    parts = [g.idm.get_partition_id(v.id) for v in vs]
+    assert len(set(parts)) > 1  # spread, not all in one partition
+    g.close()
