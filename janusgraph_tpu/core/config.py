@@ -130,6 +130,17 @@ SERVER_NS = ConfigNamespace("server", "server endpoint", ROOT)
 
 STORAGE.option("backend", str, "store manager shorthand", "inmemory")
 STORAGE.option("directory", str, "data directory for persistent backends", "")
+STORAGE.option("hostname", str, "remote storage server host", "")
+STORAGE.option("port", int, "remote storage server port", 0)
+STORAGE.option(
+    "connection-pool-size", int, "client connections to a remote backend", 4,
+    Mutability.MASKABLE, lambda v: v > 0,
+)
+STORAGE.option(
+    "retry-time-ms", float,
+    "time budget for retrying temporary backend failures with backoff",
+    10_000.0, Mutability.MASKABLE,
+)
 STORAGE.option(
     "sharded-nodes", int, "node count for the sharded backend", 3,
     verifier=lambda v: v > 0,

@@ -67,10 +67,28 @@ def _open_sharded(cfg):
 
 # reference: StandardStoreManager.java:82 shorthand registry. Factories take
 # the GraphConfiguration (or nothing, for config-free backends).
+def _open_remote(cfg):
+    from janusgraph_tpu.storage.remote import RemoteStoreManager
+
+    host = cfg.get("storage.hostname")
+    port = cfg.get("storage.port")
+    if not host or not port:
+        raise ConfigurationError(
+            "storage.backend=remote requires storage.hostname + storage.port"
+        )
+    return RemoteStoreManager(
+        host,
+        port,
+        pool_size=cfg.get("storage.connection-pool-size"),
+        retry_time_s=cfg.get("storage.retry-time-ms") / 1000.0,
+    )
+
+
 _STORE_MANAGERS = {
     "inmemory": lambda cfg: InMemoryStoreManager(),
     "local": _open_local,
     "sharded": _open_sharded,
+    "remote": _open_remote,
 }
 
 

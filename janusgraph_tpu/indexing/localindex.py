@@ -108,7 +108,35 @@ class LocalIndexProvider(IndexProvider):
 
         self._ser = Serializer()
         self._infos: Dict[Tuple[str, str], KeyInformation] = {}
+        self._check_format()
         self._load_meta()
+
+    #: bump on any change to the posting/doc byte layouts; directories
+    #: written by another version are refused LOUDLY instead of being
+    #: decoded as garbage (no silent migration)
+    FORMAT_VERSION = 2
+    _VKEY = b"V"
+
+    def _check_format(self) -> None:
+        stored = self._kv.get(self._VKEY, self._tx)
+        if stored is None:
+            has_data = any(True for _ in self._kv.scan(b"D", b"N", self._tx))
+            if has_data:
+                raise BackendError(
+                    "localindex directory predates format versioning — "
+                    "rebuild the index (REINDEX) into a fresh directory"
+                )
+            self._kv.insert(
+                self._VKEY, struct.pack(">I", self.FORMAT_VERSION), self._tx
+            )
+            self._tx.commit()
+            return
+        (ver,) = struct.unpack(">I", stored)
+        if ver != self.FORMAT_VERSION:
+            raise BackendError(
+                f"localindex format v{ver} != supported v{self.FORMAT_VERSION}"
+                " — rebuild the index (REINDEX) into a fresh directory"
+            )
 
     # -------------------------------------------------------------- layout
     @staticmethod
@@ -238,20 +266,46 @@ class LocalIndexProvider(IndexProvider):
         elif cur is not None:
             self._kv.delete(key, self._tx)
 
-    def _remove_value(self, store: str, docid: str, field: str, value, key_infos):
+    def _remove_values(
+        self, store: str, docid: str, field: str, values: List[object], key_infos
+    ):
+        """Remove a BATCH of values from one doc field: one read-modify-write
+        of the doc entry, mirroring _add_values (per-value re-encoding is
+        O(n^2) for LIST-cardinality docs)."""
         info = self._info(store, field, key_infos)
         vals = self._doc_values(store, docid).get(field, [])
         try:
-            vals.remove(value)
-        except ValueError:
+            # hashable fast path: multiset subtraction in one pass
+            from collections import Counter
+
+            want = Counter(values)
+            kept: List[object] = []
+            removed: List[object] = []
+            for v in vals:
+                if want.get(v, 0) > 0:
+                    want[v] -= 1
+                    removed.append(v)
+                else:
+                    kept.append(v)
+            vals = kept
+        except TypeError:  # unhashable values: linear removal
+            removed = []
+            for value in values:
+                try:
+                    vals.remove(value)
+                except ValueError:
+                    continue
+                removed.append(value)
+        if not removed:
             return
         dkey = self._dkey(store, docid, field)
         if vals:
             self._kv.insert(dkey, self._encode_values(vals), self._tx)
         else:
             self._kv.delete(dkey, self._tx)
-        for term in self._terms_for(info, value):
-            self._posting_adjust(store, field, term, docid, -1)
+        for value in removed:
+            for term in self._terms_for(info, value):
+                self._posting_adjust(store, field, term, docid, -1)
 
     def _add_values(
         self, store: str, docid: str, field: str, values: List[object], key_infos
@@ -292,8 +346,8 @@ class LocalIndexProvider(IndexProvider):
                         self._delete_doc(store, docid, key_infos)
                         if not m.additions:
                             continue
-                    for e in m.deletions:
-                        self._remove_value(store, docid, e.field, e.value, key_infos)
+                    for field, values in self._group_by_field(m.deletions).items():
+                        self._remove_values(store, docid, field, values, key_infos)
                     for field, values in self._group_by_field(m.additions).items():
                         self._add_values(store, docid, field, values, key_infos)
             self._tx.commit()

@@ -168,9 +168,7 @@ class TPUExecutor:
     def strategy(self) -> str:
         """The configured strategy; 'auto' reports the directed-view
         resolution (display/back-compat)."""
-        if self._strategy_cfg == "auto":
-            return self._auto_cache.get(False) or self._auto_strategy(False)
-        return self._strategy_cfg
+        return self._base_strategy(False)
 
     def _base_strategy(self, undirected: bool) -> str:
         base = self._strategy_cfg

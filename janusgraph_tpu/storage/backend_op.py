@@ -1,0 +1,48 @@
+"""Retrying backend-operation wrapper.
+
+Capability parity with the reference's universal backend-call guard
+(reference: diskstorage/util/BackendOperation.java — every storage call is
+wrapped in execute(), which retries TemporaryBackendExceptions with
+exponential backoff up to a time budget and lets PermanentBackendExceptions
+fail fast). Used by the remote store client; available to any caller
+touching a backend that can flake (network partitions, failing shards).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Callable, TypeVar
+
+from janusgraph_tpu.exceptions import (
+    PermanentBackendError,
+    TemporaryBackendError,
+)
+
+T = TypeVar("T")
+
+
+def execute(
+    op: Callable[[], T],
+    max_time_s: float = 10.0,
+    base_delay_s: float = 0.05,
+    max_delay_s: float = 2.0,
+) -> T:
+    """Run `op`, replaying temporary failures with exponential backoff until
+    the time budget is spent; the last temporary error is then re-raised.
+    Permanent failures propagate immediately (reference:
+    BackendOperation.executeDirect semantics)."""
+    deadline = time.monotonic() + max_time_s
+    delay = base_delay_s
+    attempt = 0
+    while True:
+        try:
+            return op()
+        except PermanentBackendError:
+            raise
+        except TemporaryBackendError:
+            attempt += 1
+            now = time.monotonic()
+            if now >= deadline:
+                raise
+            time.sleep(min(delay, max_delay_s, max(0.0, deadline - now)))
+            delay *= 2

@@ -1,0 +1,509 @@
+"""Remote KCVS over TCP: a real networked storage backend.
+
+This is the framework's cql/hbase-analogue (reference: the CQL adapter
+speaks the Cassandra wire protocol to remote storage nodes —
+CQLStoreManager.java:533, CQLKeyColumnValueStore.java:476; all inter-node
+"communication" in the reference flows through such storage RPC, SURVEY.md
+§2.4). Design is NOT a Cassandra clone: one compact length-prefixed binary
+protocol carrying exactly the KCVS SPI (slice / multi-slice / mutate /
+mutate-many / row scan), autocommit per request (the CQL adapter's
+consistency-level model: no cross-request transaction state), row scans
+STREAMED row-by-row so OLAP bulk loads don't materialize the store in
+memory on either side.
+
+Server: `RemoteStoreServer` exposes ANY KeyColumnValueStoreManager (in
+memory, persistent local, sharded composite) over a socket — one thread per
+connection. Client: `RemoteStoreManager` implements the full manager SPI;
+every request is wrapped in the retrying backend-operation guard
+(backend_op.execute), so transient connection failures replay with backoff
+(reference: BackendOperation.java). Combine with ShardedStoreManager for a
+multi-node remote cluster in tests (the "multi-node without a cluster"
+technique over real sockets).
+
+Wire format (big-endian):
+  request:  [u32 body_len][u8 op][body]
+  response: [u32 body_len][u8 status][body]   status: 0 ok / 1 temp / 2 perm
+  scan responses stream after the status frame: ([u8 1][row])* [u8 0]
+Strings/bytes are u32-length-prefixed; entry lists are u32-count prefixed.
+"""
+
+from __future__ import annotations
+
+import socket
+import socketserver
+import struct
+import threading
+from typing import Dict, Iterator, List, Optional, Sequence, Tuple
+
+from janusgraph_tpu.exceptions import (
+    PermanentBackendError,
+    TemporaryBackendError,
+)
+from janusgraph_tpu.storage import backend_op
+from janusgraph_tpu.storage.kcvs import (
+    EntryList,
+    KCVMutation,
+    KeyColumnValueStore,
+    KeyColumnValueStoreManager,
+    KeyRangeQuery,
+    KeySliceQuery,
+    SliceQuery,
+    StoreFeatures,
+    StoreTransaction,
+)
+
+# ops
+_OP_FEATURES = 1
+_OP_GET_SLICE = 2
+_OP_GET_SLICE_MULTI = 3
+_OP_MUTATE = 4
+_OP_MUTATE_MANY = 5
+_OP_SCAN_ALL = 6
+_OP_SCAN_RANGE = 7
+_OP_CLEAR = 8
+_OP_EXISTS = 9
+
+_STATUS_OK = 0
+_STATUS_TEMP = 1
+_STATUS_PERM = 2
+
+
+# ------------------------------------------------------------------ encoding
+def _pb(out: List[bytes], b: bytes) -> None:
+    out.append(struct.pack(">I", len(b)))
+    out.append(b)
+
+
+def _ps(out: List[bytes], s: str) -> None:
+    _pb(out, s.encode())
+
+
+class _Reader:
+    def __init__(self, data: bytes):
+        self.data = data
+        self.off = 0
+
+    def u32(self) -> int:
+        (v,) = struct.unpack_from(">I", self.data, self.off)
+        self.off += 4
+        return v
+
+    def u8(self) -> int:
+        v = self.data[self.off]
+        self.off += 1
+        return v
+
+    def bytes_(self) -> bytes:
+        n = self.u32()
+        v = self.data[self.off : self.off + n]
+        self.off += n
+        return v
+
+    def str_(self) -> str:
+        return self.bytes_().decode()
+
+
+def _encode_entries(out: List[bytes], entries: EntryList) -> None:
+    out.append(struct.pack(">I", len(entries)))
+    for col, val in entries:
+        _pb(out, col)
+        _pb(out, val)
+
+
+def _decode_entries(r: _Reader) -> EntryList:
+    n = r.u32()
+    return [(r.bytes_(), r.bytes_()) for _ in range(n)]
+
+
+def _encode_slice(out: List[bytes], sq: SliceQuery) -> None:
+    _pb(out, sq.start)
+    _pb(out, sq.end if sq.end is not None else b"")
+    out.append(struct.pack(">i", -1 if sq.limit is None else sq.limit))
+
+
+def _decode_slice(r: _Reader) -> SliceQuery:
+    start = r.bytes_()
+    end = r.bytes_()
+    (limit,) = struct.unpack_from(">i", r.data, r.off)
+    r.off += 4
+    return SliceQuery(start, end or None, None if limit < 0 else limit)
+
+
+def _recv_exact(sock: socket.socket, n: int) -> bytes:
+    buf = bytearray()
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            raise ConnectionError("connection closed mid-frame")
+        buf += chunk
+    return bytes(buf)
+
+
+# -------------------------------------------------------------------- server
+class _Handler(socketserver.BaseRequestHandler):
+    def handle(self):
+        mgr = self.server.manager  # type: ignore[attr-defined]
+        sock = self.request
+        try:
+            while True:
+                try:
+                    head = _recv_exact(sock, 5)
+                except ConnectionError:
+                    return
+                (body_len,) = struct.unpack(">I", head[:4])
+                op = head[4]
+                body = _recv_exact(sock, body_len) if body_len else b""
+                try:
+                    self._dispatch(mgr, sock, op, body)
+                except (TemporaryBackendError, ConnectionError) as e:
+                    self._reply(sock, _STATUS_TEMP, str(e).encode())
+                except Exception as e:  # noqa: BLE001 - protocol boundary
+                    self._reply(sock, _STATUS_PERM, f"{type(e).__name__}: {e}".encode())
+        except (ConnectionResetError, BrokenPipeError):
+            return
+
+    @staticmethod
+    def _reply(sock, status: int, body: bytes) -> None:
+        sock.sendall(struct.pack(">IB", len(body), status) + body)
+
+    def _dispatch(self, mgr, sock, op: int, body: bytes) -> None:
+        r = _Reader(body)
+        txh = mgr.begin_transaction()
+        if op == _OP_FEATURES:
+            f = mgr.features
+            import json
+
+            payload = json.dumps({
+                k: getattr(f, k)
+                for k in (
+                    "ordered_scan", "unordered_scan", "multi_query",
+                    "batch_mutation", "key_consistent", "persists",
+                    "cell_ttl", "timestamps",
+                )
+            }).encode()
+            self._reply(sock, _STATUS_OK, payload)
+            return
+        if op == _OP_GET_SLICE:
+            store = mgr.open_database(r.str_())
+            key = r.bytes_()
+            sq = _decode_slice(r)
+            entries = store.get_slice(KeySliceQuery(key, sq), txh)
+            out: List[bytes] = []
+            _encode_entries(out, entries)
+            self._reply(sock, _STATUS_OK, b"".join(out))
+            return
+        if op == _OP_GET_SLICE_MULTI:
+            store = mgr.open_database(r.str_())
+            nkeys = r.u32()
+            keys = [r.bytes_() for _ in range(nkeys)]
+            sq = _decode_slice(r)
+            res = store.get_slice_multi(keys, sq, txh)
+            out = [struct.pack(">I", len(keys))]
+            for k in keys:
+                _pb(out, k)
+                _encode_entries(out, res.get(k, []))
+            self._reply(sock, _STATUS_OK, b"".join(out))
+            return
+        if op == _OP_MUTATE:
+            store = mgr.open_database(r.str_())
+            key = r.bytes_()
+            adds = _decode_entries(r)
+            ndels = r.u32()
+            dels = [r.bytes_() for _ in range(ndels)]
+            store.mutate(key, adds, dels, txh)
+            txh.commit()
+            self._reply(sock, _STATUS_OK, b"")
+            return
+        if op == _OP_MUTATE_MANY:
+            nstores = r.u32()
+            muts: Dict[str, Dict[bytes, KCVMutation]] = {}
+            for _ in range(nstores):
+                sname = r.str_()
+                nrows = r.u32()
+                rows: Dict[bytes, KCVMutation] = {}
+                for _ in range(nrows):
+                    key = r.bytes_()
+                    adds = _decode_entries(r)
+                    ndels = r.u32()
+                    dels = [r.bytes_() for _ in range(ndels)]
+                    m = KCVMutation()
+                    m.additions.extend(adds)
+                    m.deletions.extend(dels)
+                    rows[key] = m
+                muts[sname] = rows
+            mgr.mutate_many(muts, txh)
+            txh.commit()
+            self._reply(sock, _STATUS_OK, b"")
+            return
+        if op in (_OP_SCAN_ALL, _OP_SCAN_RANGE):
+            store = mgr.open_database(r.str_())
+            if op == _OP_SCAN_RANGE:
+                key_start = r.bytes_()
+                key_end = r.bytes_()
+                sq = _decode_slice(r)
+                query = KeyRangeQuery(key_start, key_end, sq)
+            else:
+                query = _decode_slice(r)
+            # stream rows after an OK frame; [1][row]* then [0]
+            self._reply(sock, _STATUS_OK, b"")
+            for key, entries in store.get_keys(query, txh):
+                out = [b"\x01"]
+                _pb(out, key)
+                _encode_entries(out, entries)
+                sock.sendall(b"".join(out))
+            sock.sendall(b"\x00")
+            return
+        if op == _OP_CLEAR:
+            mgr.clear_storage()
+            self._reply(sock, _STATUS_OK, b"")
+            return
+        if op == _OP_EXISTS:
+            self._reply(sock, _STATUS_OK, b"\x01" if mgr.exists() else b"\x00")
+            return
+        raise PermanentBackendError(f"unknown op {op}")
+
+
+class RemoteStoreServer:
+    """Serve a KCVS manager over TCP (threaded; port 0 = ephemeral)."""
+
+    def __init__(self, manager, host: str = "127.0.0.1", port: int = 0):
+        class _Srv(socketserver.ThreadingTCPServer):
+            allow_reuse_address = True
+            daemon_threads = True
+
+        self._srv = _Srv((host, port), _Handler)
+        self._srv.manager = manager  # type: ignore[attr-defined]
+        self.manager = manager
+        self._thread: Optional[threading.Thread] = None
+
+    @property
+    def address(self) -> Tuple[str, int]:
+        return self._srv.server_address  # type: ignore[return-value]
+
+    def start(self) -> "RemoteStoreServer":
+        self._thread = threading.Thread(
+            target=self._srv.serve_forever, daemon=True, name="kcvs-server"
+        )
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._srv.shutdown()
+        self._srv.server_close()
+
+
+# -------------------------------------------------------------------- client
+class _Conn:
+    """One pooled connection; serialized per-request by its own lock."""
+
+    def __init__(self, host: str, port: int):
+        self.host, self.port = host, port
+        self.lock = threading.Lock()
+        self.sock: Optional[socket.socket] = None
+
+    def _connect(self):
+        s = socket.create_connection((self.host, self.port), timeout=30)
+        s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        self.sock = s
+
+    def request(self, op: int, body: bytes) -> Tuple[int, bytes, socket.socket]:
+        """Send one request; return (status, body, sock) — sock is needed by
+        streaming (scan) callers who continue reading row frames."""
+        if self.sock is None:
+            try:
+                self._connect()
+            except OSError as e:
+                raise TemporaryBackendError(f"connect failed: {e}") from e
+        try:
+            self.sock.sendall(struct.pack(">IB", len(body), op) + body)
+            head = _recv_exact(self.sock, 5)
+            (blen,) = struct.unpack(">I", head[:4])
+            status = head[4]
+            payload = _recv_exact(self.sock, blen) if blen else b""
+            return status, payload, self.sock
+        except (OSError, ConnectionError) as e:
+            # drop the broken socket so the next attempt redials
+            try:
+                self.sock.close()
+            except OSError:
+                pass
+            self.sock = None
+            raise TemporaryBackendError(f"request failed: {e}") from e
+
+
+class RemoteKCVStore(KeyColumnValueStore):
+    def __init__(self, manager: "RemoteStoreManager", name: str):
+        self._manager = manager
+        self._name = name
+
+    @property
+    def name(self) -> str:
+        return self._name
+
+    def get_slice(self, query: KeySliceQuery, txh) -> EntryList:
+        out: List[bytes] = []
+        _ps(out, self._name)
+        _pb(out, query.key)
+        _encode_slice(out, query.slice)
+        payload = self._manager._call(_OP_GET_SLICE, b"".join(out))
+        return _decode_entries(_Reader(payload))
+
+    def get_slice_multi(self, keys, slice_query, txh):
+        out: List[bytes] = []
+        _ps(out, self._name)
+        out.append(struct.pack(">I", len(keys)))
+        for k in keys:
+            _pb(out, k)
+        _encode_slice(out, slice_query)
+        payload = self._manager._call(_OP_GET_SLICE_MULTI, b"".join(out))
+        r = _Reader(payload)
+        n = r.u32()
+        return {r.bytes_(): _decode_entries(r) for _ in range(n)}
+
+    def mutate(self, key, additions, deletions, txh) -> None:
+        out: List[bytes] = []
+        _ps(out, self._name)
+        _pb(out, key)
+        _encode_entries(out, additions)
+        out.append(struct.pack(">I", len(deletions)))
+        for col in deletions:
+            _pb(out, col)
+        self._manager._call(_OP_MUTATE, b"".join(out))
+
+    def get_keys(self, query, txh) -> Iterator[Tuple[bytes, EntryList]]:
+        out: List[bytes] = []
+        _ps(out, self._name)
+        if isinstance(query, KeyRangeQuery):
+            op = _OP_SCAN_RANGE
+            _pb(out, query.key_start)
+            _pb(out, query.key_end)
+            _encode_slice(out, query.slice)
+        else:
+            op = _OP_SCAN_ALL
+            _encode_slice(out, query)
+        # each scan gets a DEDICATED connection: the row stream occupies the
+        # socket until exhausted, and a consumer abandoning the generator
+        # mid-stream must not leave unread row bytes to desync a pooled
+        # connection's next request — the private socket just closes
+        conn = _Conn(self._manager.host, self._manager.port)
+        try:
+            status, payload, sock = conn.request(op, b"".join(out))
+            if status != _STATUS_OK:
+                _raise_status(status, payload)
+            while True:
+                marker = _recv_exact(sock, 1)
+                if marker == b"\x00":
+                    break
+                key = _recv_exact(sock, struct.unpack(
+                    ">I", _recv_exact(sock, 4))[0])
+                (n,) = struct.unpack(">I", _recv_exact(sock, 4))
+                entries = []
+                for _ in range(n):
+                    (cl,) = struct.unpack(">I", _recv_exact(sock, 4))
+                    col = _recv_exact(sock, cl)
+                    (vl,) = struct.unpack(">I", _recv_exact(sock, 4))
+                    val = _recv_exact(sock, vl)
+                    entries.append((col, val))
+                yield key, entries
+        finally:
+            if conn.sock is not None:
+                try:
+                    conn.sock.close()
+                except OSError:
+                    pass
+
+
+def _raise_status(status: int, payload: bytes):
+    msg = payload.decode("utf-8", "replace")
+    if status == _STATUS_TEMP:
+        raise TemporaryBackendError(msg)
+    raise PermanentBackendError(msg)
+
+
+class RemoteStoreManager(KeyColumnValueStoreManager):
+    """Client-side manager speaking the remote KCVS protocol."""
+
+    def __init__(self, host: str, port: int, pool_size: int = 4,
+                 retry_time_s: float = 10.0):
+        self.host, self.port = host, port
+        self.retry_time_s = retry_time_s
+        self._pool = [_Conn(host, port) for _ in range(pool_size)]
+        self._pool_lock = threading.Lock()
+        self._pool_idx = 0
+        self._stores: Dict[str, RemoteKCVStore] = {}
+        self._features: Optional[StoreFeatures] = None
+
+    def _acquire(self) -> _Conn:
+        with self._pool_lock:
+            conn = self._pool[self._pool_idx % len(self._pool)]
+            self._pool_idx += 1
+            return conn
+
+    def _call(self, op: int, body: bytes) -> bytes:
+        def attempt() -> bytes:
+            conn = self._acquire()
+            with conn.lock:
+                status, payload, _sock = conn.request(op, body)
+            if status != _STATUS_OK:
+                _raise_status(status, payload)
+            return payload
+
+        return backend_op.execute(attempt, max_time_s=self.retry_time_s)
+
+    @property
+    def features(self) -> StoreFeatures:
+        if self._features is None:
+            import json
+
+            remote = json.loads(self._call(_OP_FEATURES, b"").decode())
+            self._features = StoreFeatures(
+                distributed=True,
+                locking=False,       # consistent-key locker wraps this store
+                transactional=False,  # autocommit per request (CQL model)
+                multi_query=True,
+                batch_mutation=True,
+                **{k: v for k, v in remote.items()
+                   if k not in ("multi_query", "batch_mutation")},
+            )
+        return self._features
+
+    @property
+    def name(self) -> str:
+        return f"remote({self.host}:{self.port})"
+
+    def open_database(self, name: str) -> RemoteKCVStore:
+        if name not in self._stores:
+            self._stores[name] = RemoteKCVStore(self, name)
+        return self._stores[name]
+
+    def begin_transaction(self, config: Optional[dict] = None) -> StoreTransaction:
+        return StoreTransaction(config)
+
+    def mutate_many(self, mutations, txh) -> None:
+        out: List[bytes] = [struct.pack(">I", len(mutations))]
+        for sname, rows in mutations.items():
+            _ps(out, sname)
+            out.append(struct.pack(">I", len(rows)))
+            for key, m in rows.items():
+                _pb(out, key)
+                _encode_entries(out, m.additions)
+                out.append(struct.pack(">I", len(m.deletions)))
+                for col in m.deletions:
+                    _pb(out, col)
+        self._call(_OP_MUTATE_MANY, b"".join(out))
+
+    def close(self) -> None:
+        for conn in self._pool:
+            with conn.lock:
+                if conn.sock is not None:
+                    try:
+                        conn.sock.close()
+                    except OSError:
+                        pass
+                    conn.sock = None
+
+    def clear_storage(self) -> None:
+        self._call(_OP_CLEAR, b"")
+
+    def exists(self) -> bool:
+        return self._call(_OP_EXISTS, b"") == b"\x01"

@@ -47,10 +47,29 @@ def _make_backend(kind: str, tmp_path):
 
         # ttl=0 (never expires): exercises the value framing transparently
         return TTLStoreManager(InMemoryStoreManager(), default_ttl_seconds=0.0)
+    if kind == "remote":
+        # a REAL networked backend: every store op crosses a TCP socket to
+        # an in-process server (the cql/hbase-analogue adapter)
+        from janusgraph_tpu.storage.remote import (
+            RemoteStoreManager,
+            RemoteStoreServer,
+        )
+
+        server = RemoteStoreServer(InMemoryStoreManager()).start()
+        host, port = server.address
+        mgr = RemoteStoreManager(host, port)
+        orig_close = mgr.close
+
+        def close_with_server():
+            orig_close()
+            server.stop()
+
+        mgr.close = close_with_server
+        return mgr
     raise ValueError(kind)
 
 
-@pytest.fixture(params=["inmemory", "local", "sharded", "ttl"])
+@pytest.fixture(params=["inmemory", "local", "sharded", "ttl", "remote"])
 def store_manager(request, tmp_path):
     """Parameterization point for backend-contract suites: every backend
     must pass the same abstract suites (the reference's

@@ -515,3 +515,42 @@ def test_localindex_bulk_list_values(tmp_path):
     )
     assert hits == ["d1"]
     p.close()
+
+
+def test_localindex_bulk_list_deletion_fast(tmp_path):
+    """Batched deletions mirror batched adds (no O(n^2) re-encoding)."""
+    import time as _time
+
+    p = _mk_local(tmp_path)
+    p.register("s", "tags", KeyInformation(float, cardinality="LIST"))
+    m = IndexMutation(is_new=True)
+    for i in range(40_000):
+        m.add("tags", float(i))
+    p.mutate({"s": {"d1": m}}, {})
+    d = IndexMutation()
+    for i in range(40_000):
+        d.delete("tags", float(i))
+    t0 = _time.perf_counter()
+    p.mutate({"s": {"d1": d}}, {})
+    assert _time.perf_counter() - t0 < 15.0
+    assert p.query(
+        "s", IndexQuery(PredicateCondition("tags", Cmp.GREATER_THAN_EQUAL, 0.0))
+    ) == []
+    p.close()
+
+
+def test_localindex_rejects_foreign_format(tmp_path):
+    from janusgraph_tpu.exceptions import BackendError
+    import struct as _struct
+
+    p = _mk_local(tmp_path)
+    p.register("s", "w", KeyInformation(float))
+    m = IndexMutation(is_new=True)
+    m.add("w", 1.0)
+    p.mutate({"s": {"d1": m}}, {})
+    # simulate a directory written by a different format version
+    p._kv.insert(p._VKEY, _struct.pack(">I", 1), p._tx)
+    p._tx.commit()
+    p.close()
+    with pytest.raises(BackendError, match="format"):
+        _mk_local(tmp_path)
