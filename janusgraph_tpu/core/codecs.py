@@ -340,6 +340,22 @@ class EdgeSerializer:
             return SliceQuery(start, _increment(start))
         return SliceQuery(prefix + bytes([d]), prefix + bytes([d + 1]))
 
+    def get_adjacency_slice(
+        self, type_id: int, direction: Direction, other_vid: int
+    ) -> SliceQuery:
+        """Point-lookup slice for edges of one type+direction to ONE specific
+        neighbor (reference: the AdjacentVertex*OptimizerStrategy rewrites —
+        graphdb/tinkerpop/optimize/strategy/AdjacentVertexFilter/HasId/Is —
+        turn neighborhood iteration into adjacency checks; here the check is
+        a single column-range read because other_vid sits at a fixed offset
+        in sort-key-free edge columns)."""
+        if direction == Direction.BOTH:
+            raise CodecError("adjacency lookups need a concrete direction")
+        cat = _category_byte(type_id, True, self.idm)
+        base = struct.pack(">BQBB", cat, type_id, int(direction), 0)
+        start = base + struct.pack(">Q", other_vid)
+        return SliceQuery(start, _increment(start))
+
     def get_sort_range_slice(
         self,
         type_id: int,

@@ -455,8 +455,32 @@ class GraphTraversal:
             )
         return self
 
-    def has_id(self, *ids: int) -> "GraphTraversal":
-        idset = set(ids)
+    def has_id(self, *ids) -> "GraphTraversal":
+        idset = {i.id if isinstance(i, Vertex) else i for i in ids}
+        # AdjacentVertex rewrite (reference: optimize/strategy/
+        # AdjacentVertexHasIdOptimizerStrategy): `.out(lbl).has_id(v)`
+        # collapses the expansion + filter into per-traverser adjacency
+        # POINT LOOKUPS — a bounded column slice per (label, target) instead
+        # of materializing the whole neighborhood
+        prev = self._steps[-1] if self._steps else None
+        meta = getattr(prev, "_expand_meta", None)
+        if meta is not None and meta["to_vertex"] and meta["sort_range"] is None:
+            tx = self.tx
+            direction, labels = meta["direction"], meta["labels"]
+
+            def adjacency(ts):
+                out = []
+                for t in ts:
+                    v = t.obj
+                    if not isinstance(v, Vertex):
+                        continue
+                    for e in tx.adjacency_edges(v, direction, labels, idset):
+                        out.append(t.child(e.other(v), prev=v))
+                return out
+
+            adjacency._label = f"adjacentVertexHasId{tuple(sorted(idset))!r}"
+            self._steps[-1] = adjacency
+            return self
         self._add(lambda ts: [t for t in ts if getattr(t.obj, "id", None) in idset])
         return self
 
@@ -524,6 +548,13 @@ class GraphTraversal:
             f"({','.join(labels)})" if labels else "()"
         )
         self._add(step, name=kind + suffix)
+        # metadata for peephole rewrites (AdjacentVertex* strategies)
+        step._expand_meta = {
+            "direction": direction,
+            "labels": labels,
+            "to_vertex": to_vertex,
+            "sort_range": sort_range,
+        }
         return self
 
     def out_v(self) -> "GraphTraversal":
@@ -890,6 +921,9 @@ class GraphTraversal:
         return self
 
     def is_(self, arg) -> "GraphTraversal":
+        # AdjacentVertexIs rewrite: `.out(lbl).is_(v)` -> adjacency lookup
+        if isinstance(arg, Vertex):
+            return self.has_id(arg.id)
         p = arg if isinstance(arg, P) else P.eq(arg)
         self._add(lambda ts: [t for t in ts if p.test(t.obj)], name=f"is({p.label})")
         return self

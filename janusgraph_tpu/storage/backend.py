@@ -118,6 +118,19 @@ class Backend:
         self.id_authority = ConsistentKeyIDAuthority(
             self.id_store, self._base_tx, block_size=id_block_size
         )
+        # mutation-epoch tracker: edgestore row key -> epoch of its last
+        # committed mutation (this instance). Powers incremental CSR refresh
+        # (olap/csr.py refresh_csr): re-read only rows touched since a
+        # snapshot instead of rescanning the store (SURVEY.md §7 hard part
+        # (e) — OLTP mutations -> CSR deltas without full rebuilds).
+        self._mutation_epochs: Dict[bytes, int] = {}
+        self._epoch = 0
+        self._epoch_lock = threading.Lock()
+        #: tracker size bound — beyond it the tracker resets and records the
+        #: overflow epoch; snapshots older than that must full-reload
+        #: (bounds memory on write-heavy workloads that never refresh)
+        self._epoch_track_limit = 1_000_000
+        self._overflow_epoch = 0
         # consistent-key lockers over dedicated lock stores (reference:
         # Backend.java:184-213 wraps stores in ExpectedValueCheckingStore)
         from janusgraph_tpu.storage.locking import (
@@ -156,6 +169,34 @@ class Backend:
 
     def begin_transaction(self, config: Optional[dict] = None) -> "BackendTransaction":
         return BackendTransaction(self, self.manager.begin_transaction(config))
+
+    # -- mutation-epoch tracking (incremental CSR refresh) ------------------
+    def note_edge_mutations(self, keys) -> None:
+        with self._epoch_lock:
+            self._epoch += 1
+            e = self._epoch
+            for key in keys:
+                self._mutation_epochs[key] = e
+            if len(self._mutation_epochs) > self._epoch_track_limit:
+                # reset rather than grow unboundedly; refreshes across the
+                # reset fall back to a full reload
+                self._mutation_epochs.clear()
+                self._overflow_epoch = e
+
+    def mutation_epoch(self) -> int:
+        """Monotonic counter bumped per committed edgestore batch; snapshot
+        it alongside a CSR load, pass it to touched_since at refresh."""
+        with self._epoch_lock:
+            return self._epoch
+
+    def touched_since(self, epoch: int) -> Optional[List[bytes]]:
+        """Edgestore row keys mutated (by this instance) after `epoch`, or
+        None when the tracker overflowed past that epoch (caller must
+        full-reload)."""
+        with self._epoch_lock:
+            if epoch < self._overflow_epoch:
+                return None
+            return [k for k, e in self._mutation_epochs.items() if e > epoch]
 
     # -- global config on system_properties (reference: KCVSConfiguration) --
     def set_global_config(self, name: str, value: bytes) -> None:
@@ -308,6 +349,10 @@ class BackendTransaction:
                     self.backend.manager.mutate_many(
                         self._mutations, self.store_tx
                     )
+                # mutation-epoch bump for touched edgestore rows
+                edge_rows = self._mutations.get(EDGESTORE_NAME)
+                if edge_rows:
+                    self.backend.note_edge_mutations(edge_rows.keys())
                 # cache invalidation for mutated rows
                 for store_name, rows in self._mutations.items():
                     store = (

@@ -1,0 +1,193 @@
+"""Incremental CSR refresh (SURVEY.md §7 hard part (e)): OLTP mutations fold
+into an existing CSR snapshot via the backend's mutation-epoch tracker —
+only touched rows are re-read, no full store scan. Oracle: a fresh full
+load_csr after the same mutations.
+"""
+
+import numpy as np
+import pytest
+
+from janusgraph_tpu.core.graph import open_graph
+from janusgraph_tpu.olap.csr import load_csr, load_csr_snapshot, refresh_csr
+
+
+def assert_csr_equal(a, b):
+    """Structural equality up to within-row edge order (the order of edges
+    inside one adjacency row depends on scan order and is not part of the
+    CSR contract — aggregation monoids are order-independent)."""
+    np.testing.assert_array_equal(a.vertex_ids, b.vertex_ids)
+    np.testing.assert_array_equal(a.out_indptr, b.out_indptr)
+    np.testing.assert_array_equal(a.in_indptr, b.in_indptr)
+
+    def rows(indptr, arr):
+        return [
+            np.sort(arr[indptr[i]:indptr[i + 1]]).tolist()
+            for i in range(len(indptr) - 1)
+        ]
+
+    assert rows(a.out_indptr, a.out_dst) == rows(b.out_indptr, b.out_dst)
+    assert rows(a.in_indptr, a.in_src) == rows(b.in_indptr, b.in_src)
+    if a.labels is not None and b.labels is not None:
+        np.testing.assert_array_equal(a.labels, b.labels)
+
+
+@pytest.fixture
+def g():
+    graph = open_graph({"schema.default": "auto"})
+    yield graph
+    graph.close()
+
+
+def seed(g, n=30):
+    tx = g.new_transaction()
+    vs = [tx.add_vertex(name=f"v{i}") for i in range(n)]
+    for i in range(n - 1):
+        tx.add_edge(vs[i], "link", vs[i + 1])
+    tx.commit()
+    return vs
+
+
+def test_refresh_noop_without_mutations(g):
+    seed(g)
+    csr, epoch = load_csr_snapshot(g)
+    refreshed, e2 = refresh_csr(g, csr, epoch)
+    assert refreshed is csr  # zero touched rows: same snapshot handed back
+    assert e2 >= epoch
+
+
+def test_refresh_after_edge_addition(g):
+    vs = seed(g)
+    csr, epoch = load_csr_snapshot(g)
+    tx = g.new_transaction()
+    tx.add_edge(tx.get_vertex(vs[0].id), "link", tx.get_vertex(vs[29].id))
+    tx.commit()
+    refreshed, _ = refresh_csr(g, csr, epoch)
+    assert_csr_equal(refreshed, load_csr(g))
+    assert refreshed.num_edges == csr.num_edges + 1
+
+
+def test_refresh_after_vertex_addition(g):
+    seed(g)
+    csr, epoch = load_csr_snapshot(g)
+    tx = g.new_transaction()
+    nv = tx.add_vertex(name="new")
+    tx.add_edge(nv, "link", tx.get_vertex(int(csr.vertex_ids[0])))
+    tx.commit()
+    refreshed, _ = refresh_csr(g, csr, epoch)
+    assert_csr_equal(refreshed, load_csr(g))
+    assert refreshed.num_vertices == csr.num_vertices + 1
+
+
+def test_refresh_after_edge_removal(g):
+    vs = seed(g)
+    csr, epoch = load_csr_snapshot(g)
+    tx = g.new_transaction()
+    v0 = tx.get_vertex(vs[4].id)
+    from janusgraph_tpu.core.codecs import Direction
+
+    e = tx.get_edges(v0, Direction.OUT, ("link",))[0]
+    tx.remove_edge(e)
+    tx.commit()
+    refreshed, _ = refresh_csr(g, csr, epoch)
+    assert_csr_equal(refreshed, load_csr(g))
+    assert refreshed.num_edges == csr.num_edges - 1
+
+
+def test_refresh_after_vertex_removal(g):
+    vs = seed(g)
+    csr, epoch = load_csr_snapshot(g)
+    tx = g.new_transaction()
+    tx.remove_vertex(tx.get_vertex(vs[10].id))
+    tx.commit()
+    refreshed, _ = refresh_csr(g, csr, epoch)
+    assert_csr_equal(refreshed, load_csr(g))
+    assert refreshed.num_vertices == csr.num_vertices - 1
+
+
+def test_refresh_chain_of_epochs(g):
+    vs = seed(g)
+    csr, epoch = load_csr_snapshot(g)
+    for round_ in range(3):
+        tx = g.new_transaction()
+        nv = tx.add_vertex(name=f"r{round_}")
+        tx.add_edge(nv, "link", tx.get_vertex(vs[round_].id))
+        tx.commit()
+        csr, epoch = refresh_csr(g, csr, epoch)
+    assert_csr_equal(csr, load_csr(g))
+
+
+def test_refresh_reads_only_touched_rows(g):
+    vs = seed(g, n=50)
+    csr, epoch = load_csr_snapshot(g)
+    tx = g.new_transaction()
+    tx.add_edge(tx.get_vertex(vs[7].id), "link", tx.get_vertex(vs[9].id))
+    tx.commit()
+
+    calls = []
+    store = g.backend.edgestore
+    orig = store.get_slice
+
+    def spy(q, txh):
+        calls.append(q.key)
+        return orig(q, txh)
+
+    store.get_slice = spy
+    refresh_csr(g, csr, epoch)
+    store.get_slice = orig
+    # both endpoint rows were touched (OUT cell + IN cell), nothing else
+    assert len(calls) == 2
+
+
+def test_refresh_runs_olap(g):
+    vs = seed(g)
+    csr, epoch = load_csr_snapshot(g)
+    tx = g.new_transaction()
+    tx.add_edge(tx.get_vertex(vs[29].id), "link", tx.get_vertex(vs[0].id))
+    tx.commit()
+    csr2, _ = refresh_csr(g, csr, epoch)
+    from janusgraph_tpu.olap.cpu_executor import CPUExecutor
+    from janusgraph_tpu.olap.programs import PageRankProgram
+
+    res = CPUExecutor(csr2).run(PageRankProgram(max_iterations=10))
+    assert abs(res["rank"].sum() - 1.0) < 1e-6
+
+
+def test_refresh_rejects_filtered_snapshot(g):
+    seed(g)
+    from janusgraph_tpu.olap.csr import load_csr_snapshot as snap
+
+    csr, epoch = snap(g, edge_labels=["link"])
+    tx = g.new_transaction()
+    tx.add_vertex()
+    tx.commit()
+    with pytest.raises(ValueError, match="unfiltered"):
+        refresh_csr(g, csr, epoch)
+
+
+def test_refresh_tracker_overflow_falls_back_to_full_reload(g):
+    vs = seed(g)
+    csr, epoch = load_csr_snapshot(g)
+    g.backend._epoch_track_limit = 4  # force overflow
+    tx = g.new_transaction()
+    for i in range(8):
+        tx.add_edge(tx.get_vertex(vs[i].id), "link", tx.get_vertex(vs[i + 10].id))
+        tx.commit()
+        tx = g.new_transaction()
+    refreshed, _ = refresh_csr(g, csr, epoch)
+    assert_csr_equal(refreshed, load_csr(g))
+
+
+def test_adjacency_self_loop_both_parity(g):
+    from janusgraph_tpu.core.codecs import Direction
+
+    tx = g.new_transaction()
+    v = tx.add_vertex(name="loop")
+    tx.add_edge(v, "link", v)
+    pre = tx.adjacency_edges(tx.get_vertex(v.id) or v, Direction.BOTH,
+                             ("link",), {v.id})
+    assert len(pre) == 2  # uncommitted: two incidences, like get_edges
+    tx.commit()
+    tx2 = g.new_transaction()
+    post = tx2.adjacency_edges(tx2.get_vertex(v.id), Direction.BOTH,
+                               ("link",), {v.id})
+    assert len(post) == 2  # committed: OUT + IN cells
