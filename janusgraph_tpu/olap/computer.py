@@ -88,6 +88,19 @@ class GraphComputer:
         self._program = p
         return self
 
+    def traverse(self, *spec) -> "GraphComputer":
+        """OLAP traversal shortcut (the TraversalVertexProgram analogue):
+        compute().traverse(("out", ["knows"]), ("in", None)).submit() counts
+        traversers per vertex; result.states["count"].sum() is the terminal
+        count (reference: BASELINE config #5)."""
+        from janusgraph_tpu.olap.programs import (
+            OLAPTraversalProgram,
+            steps_from_spec,
+        )
+
+        self._program = OLAPTraversalProgram(steps_from_spec(self.graph, spec))
+        return self
+
     def submit(self) -> ComputerResult:
         assert self._program is not None, "program() not set"
         csr = load_csr(

@@ -7,3 +7,8 @@ from janusgraph_tpu.olap.programs.traversal_count import (  # noqa: F401
     TraversalCountProgram,
 )
 from janusgraph_tpu.olap.programs.peer_pressure import PeerPressureProgram  # noqa: F401
+from janusgraph_tpu.olap.programs.olap_traversal import (  # noqa: F401
+    OLAPTraversalProgram,
+    TraversalStep,
+    steps_from_spec,
+)

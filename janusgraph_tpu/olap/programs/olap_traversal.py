@@ -1,0 +1,118 @@
+"""OLAP traversal execution: the TraversalVertexProgram analogue.
+
+The reference runs Gremlin traversals OLAP-side by shipping TinkerPop's
+TraversalVertexProgram through Fulgora (reference: BASELINE config #5 "3-hop
+via TraversalVertexProgram"; FulgoraGraphComputer.submit on a traversal;
+SURVEY.md §7 hard part (a) "arbitrary traversers as device state"). The
+TPU-native form: a RESTRICTED traversal — a chain of expansion steps, each
+with its own direction + edge labels — compiles into one BSP run where
+superstep k applies step k's typed EdgeChannel, and per-vertex state is the
+dense TRAVERSER COUNT vector (the device representation of "how many
+traversers sit here"), exactly what count()/group-count terminals need.
+Arbitrary per-traverser state (paths, arbitrary objects) stays an OLTP
+concern — the restriction that makes the hot path one gather/segment-reduce
+per step.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional, Sequence, Tuple
+
+from janusgraph_tpu.olap.vertex_program import (
+    Combiner,
+    EdgeChannel,
+    VertexProgram,
+)
+
+
+from dataclasses import dataclass
+
+
+@dataclass(frozen=True)
+class TraversalStep:
+    """One expansion: direction out/in/both, optional edge-label ids.
+    Frozen/value-comparable so program cache keys (and the executors'
+    channel caches) hit across instances built from the same spec."""
+
+    direction: str = "out"
+    labels: Optional[Tuple[int, ...]] = None
+
+    def __post_init__(self):
+        if self.direction not in ("out", "in", "both"):
+            raise ValueError(f"unknown step direction {self.direction!r}")
+        if self.labels is not None:
+            object.__setattr__(self, "labels", tuple(self.labels))
+
+
+def steps_from_spec(graph, spec: Sequence) -> Tuple[TraversalStep, ...]:
+    """Build steps from ('out', ['knows']) pairs, resolving label NAMES to
+    schema ids via the graph (None/empty labels = all)."""
+    out = []
+    for item in spec:
+        direction, labels = (item, None) if isinstance(item, str) else item
+        ids = None
+        if labels:
+            ids = tuple(
+                el.id
+                for name in labels
+                if (el := graph.schema_cache.get_by_name(name)) is not None
+            )
+        out.append(TraversalStep(direction, ids))
+    return tuple(out)
+
+
+class OLAPTraversalProgram(VertexProgram):
+    """Traverser-count BSP over a step chain.
+
+    state["count"][v] = number of traversers at v after the steps so far
+    (float64-safe in f32 up to 2^24 per vertex; overflow means the query
+    wants group-counting, not exact enumeration). Starts from all vertices
+    (g.V() semantics) or a seed set.
+
+    Terminals on the result:
+      total = result["count"].sum()            — g.V().out()...count()
+      per-vertex counts                         — group-count by destination
+    """
+
+    compute_keys = ("count",)
+    combiner = Combiner.SUM
+    setup_only_params = ("seed_indices",)
+
+    def __init__(self, steps: Sequence[TraversalStep], seed_indices=None):
+        self.steps = tuple(steps)
+        if not self.steps:
+            raise ValueError("at least one traversal step required")
+        self.seed_indices = (
+            tuple(int(i) for i in seed_indices)
+            if seed_indices is not None
+            else None
+        )
+        self.max_iterations = len(self.steps)
+        # one named channel per step; labels=None channels still express
+        # per-step direction through the same machinery
+        self.edge_channels = {
+            f"s{i}": EdgeChannel(st.direction, st.labels)
+            for i, st in enumerate(self.steps)
+        }
+
+    def channel_for(self, superstep: int) -> str:
+        return f"s{min(superstep, len(self.steps) - 1)}"
+
+    def setup(self, graph, xp):
+        n = graph.local_num_vertices
+        if self.seed_indices is None:
+            count = xp.ones(n) * graph.active if hasattr(graph, "active") else xp.ones(n)
+        else:
+            idx = xp.arange(n) + graph.global_offset
+            count = xp.isin(idx, xp.asarray(self.seed_indices)).astype(float)
+        return {"count": count}, {}
+
+    def message(self, state, superstep, graph, xp):
+        return state["count"]
+
+    def apply(self, state, aggregated, superstep, memory_in, graph, xp):
+        # traversers MOVE: the new count is exactly what arrived
+        return {"count": aggregated}, {}
+
+    def terminate(self, memory):
+        return False  # fixed-length chain; max_iterations bounds the run

@@ -209,14 +209,16 @@ class TPUExecutor:
 
     def _channel_pack(self, program: VertexProgram, name: str):
         """ELL pack for one named EdgeChannel (typed edge view). Built from
-        the channel's filtered edge list; cached per channel name."""
+        the channel's filtered edge list; cached per channel VALUE (frozen
+        dataclass) — names like 's0' recur across different programs on a
+        reused executor and must not alias each other's packs."""
         from janusgraph_tpu.olap.csr import channel_edges
         from janusgraph_tpu.olap.kernels import ELLPack
 
-        key = ("channel", name)
+        channel = program.edge_channels[name]
+        key = ("channel", channel)
         pack = self._ell_packs.get(key)
         if pack is None:
-            channel = program.edge_channels[name]
             src, dst, w = channel_edges(self.csr, channel)
             pack = ELLPack(
                 src, dst, w, self.csr.num_vertices, **self._ell_kwargs()
@@ -345,7 +347,8 @@ class TPUExecutor:
 
     def _superstep_fn(self, program: VertexProgram, op: str, channel: str = None):
         """Jitted single superstep (host-loop path)."""
-        key = ("step", program.cache_key(), op, self._strategy_cfg, channel)
+        ch_val = program.edge_channels[channel] if channel is not None else None
+        key = ("step", program.cache_key(), op, self._strategy_cfg, ch_val)
         if key not in self._compiled:
             self._compiled[key] = self.jax.jit(
                 self._superstep_body(program, op, channel)

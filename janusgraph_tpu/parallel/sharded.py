@@ -415,13 +415,16 @@ class ShardedExecutor:
 
     def _sharded_channel(self, program: VertexProgram, name: str) -> ShardedCSR:
         """ShardedCSR for one named EdgeChannel (typed edge view), built from
-        the channel's filtered edge list and cached per channel name."""
+        the channel's filtered edge list and cached per channel VALUE —
+        generic names (s0, s1, ...) recur across programs on a reused
+        executor and must not alias each other's edge views."""
         from janusgraph_tpu.olap.csr import channel_edges
 
-        key = ("ch", name)
+        channel = program.edge_channels[name]
+        key = ("ch", channel)
         sc = self._sharded_cache.get(key)
         if sc is None:
-            edges = channel_edges(self.csr, program.edge_channels[name])
+            edges = channel_edges(self.csr, channel)
             sc = ShardedCSR(self.csr, self.num_shards, False, edges=edges)
             self._sharded_cache[key] = sc
         return sc
@@ -582,7 +585,8 @@ class ShardedExecutor:
     def _superstep_fn(
         self, program: VertexProgram, op: str, sc: ShardedCSR, channel: str = None
     ):
-        key = ("step", program.cache_key(), op, self.exchange, self.agg, channel)
+        ch_val = program.edge_channels[channel] if channel is not None else None
+        key = ("step", program.cache_key(), op, self.exchange, self.agg, ch_val)
         if key in self._compiled:
             return self._compiled[key]
 
@@ -710,7 +714,9 @@ class ShardedExecutor:
             ch = program.channel_for(step)
             if ch is not None:
                 sc_step = self._sharded_channel(program, ch)
-                gargs_step = self._graph_args(sc_step, ("ch", ch))
+                gargs_step = self._graph_args(
+                    sc_step, ("ch", program.edge_channels[ch])
+                )
             else:
                 sc_step, gargs_step = sc, gargs
             fn = self._superstep_fn(program, op, sc_step, ch)
