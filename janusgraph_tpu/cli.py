@@ -111,6 +111,65 @@ def cmd_bench(args) -> int:
     return 0
 
 
+def cmd_storage_server(args) -> int:
+    """Serve a storage backend over TCP (the remote KCVS endpoint other
+    instances open with storage.backend=remote)."""
+    from janusgraph_tpu.storage.remote import RemoteStoreServer
+
+    if args.directory:
+        from janusgraph_tpu.storage.localstore import open_local_kcvs
+
+        manager = open_local_kcvs(args.directory)
+        kind = f"local({args.directory})"
+    elif args.sharded_nodes is not None:
+        if args.sharded_nodes < 1:
+            print("--sharded-nodes must be >= 1", file=sys.stderr)
+            return 2
+        from janusgraph_tpu.storage.sharded_store import ShardedStoreManager
+
+        manager = ShardedStoreManager(num_nodes=args.sharded_nodes)
+        kind = f"sharded({args.sharded_nodes})"
+    else:
+        from janusgraph_tpu.storage.inmemory import InMemoryStoreManager
+
+        manager = InMemoryStoreManager()
+        kind = "inmemory"
+    server = RemoteStoreServer(manager, host=args.host, port=args.port).start()
+    host, port = server.address
+    print(f"storage server ({kind}) listening on {host}:{port}", flush=True)
+    print(
+        "connect with open_graph({'storage.backend': 'remote', "
+        f"'storage.hostname': '{host}', 'storage.port': {port}}})",
+        flush=True,
+    )
+    try:
+        import time as _t
+
+        while True:
+            _t.sleep(3600)
+    except KeyboardInterrupt:
+        server.stop()
+    return 0
+
+
+def cmd_config_docs(args) -> int:
+    from janusgraph_tpu.core.config import describe_options
+
+    text = (
+        "# Configuration reference\n\n"
+        "Generated from the registered option tree "
+        "(`janusgraph_tpu/core/config.py`; reference model: the reference's "
+        "auto-generated janusgraph-cfg.md).\n\n" + describe_options() + "\n"
+    )
+    if args.out:
+        with open(args.out, "w") as f:
+            f.write(text)
+        print(f"wrote {args.out}")
+    else:
+        print(text)
+    return 0
+
+
 def main(argv=None) -> int:
     parser = argparse.ArgumentParser(prog="janusgraph_tpu")
     sub = parser.add_subparsers(dest="command", required=True)
@@ -134,6 +193,23 @@ def main(argv=None) -> int:
     pb = sub.add_parser("bench", help="run the benchmark")
     pb.add_argument("--scale", type=int)
     pb.set_defaults(fn=cmd_bench)
+
+    pss = sub.add_parser(
+        "storage-server", help="serve a storage backend over TCP"
+    )
+    pss.add_argument("--host", default="127.0.0.1")
+    pss.add_argument("--port", type=int, default=0)
+    backing = pss.add_mutually_exclusive_group()
+    backing.add_argument("--directory", help="persistent local store directory")
+    backing.add_argument(
+        "--sharded-nodes", type=int,
+        help="serve an N-node sharded composite (N >= 1)",
+    )
+    pss.set_defaults(fn=cmd_storage_server)
+
+    pd = sub.add_parser("config-docs", help="render the config reference")
+    pd.add_argument("--out", help="write to this file instead of stdout")
+    pd.set_defaults(fn=cmd_config_docs)
 
     args = parser.parse_args(argv)
     return args.fn(args)

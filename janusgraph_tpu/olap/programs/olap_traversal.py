@@ -52,11 +52,15 @@ def steps_from_spec(graph, spec: Sequence) -> Tuple[TraversalStep, ...]:
         direction, labels = (item, None) if isinstance(item, str) else item
         ids = None
         if labels:
-            ids = tuple(
-                el.id
-                for name in labels
-                if (el := graph.schema_cache.get_by_name(name)) is not None
-            )
+            ids = []
+            for name in labels:
+                el = graph.schema_cache.get_by_name(name)
+                if el is None:
+                    # a typo'd label silently matching nothing would return
+                    # a wrong-but-plausible count — fail loudly instead
+                    raise ValueError(f"unknown edge label {name!r}")
+                ids.append(el.id)
+            ids = tuple(ids)
         out.append(TraversalStep(direction, ids))
     return tuple(out)
 
@@ -101,7 +105,9 @@ class OLAPTraversalProgram(VertexProgram):
     def setup(self, graph, xp):
         n = graph.local_num_vertices
         if self.seed_indices is None:
-            count = xp.ones(n) * graph.active if hasattr(graph, "active") else xp.ones(n)
+            # `active` masks SPMD padding slots on sharded views (all graph
+            # views define it)
+            count = xp.ones(n) * graph.active
         else:
             idx = xp.arange(n) + graph.global_offset
             count = xp.isin(idx, xp.asarray(self.seed_indices)).astype(float)

@@ -115,8 +115,11 @@ class TPUExecutor:
         self._interpret = not (
             dev.platform == "tpu" or "tpu" in dev.device_kind.lower()
         )
+        from collections import OrderedDict
+
         self._compiled: Dict[str, object] = {}
         self._ell_packs: Dict[bool, object] = {}
+        self._channel_packs: "OrderedDict" = OrderedDict()
         self._segsum_plans: Dict[str, object] = {}
 
     @staticmethod
@@ -207,24 +210,37 @@ class TPUExecutor:
             else {}
         )
 
+    #: distinct EdgeChannel views kept device-resident at once; a long-lived
+    #: executor answering ad-hoc traverse() queries would otherwise
+    #: accumulate one O(E) pack per label-set forever
+    CHANNEL_CACHE_SIZE = 8
+
     def _channel_pack(self, program: VertexProgram, name: str):
         """ELL pack for one named EdgeChannel (typed edge view). Built from
         the channel's filtered edge list; cached per channel VALUE (frozen
         dataclass) — names like 's0' recur across different programs on a
-        reused executor and must not alias each other's packs."""
+        reused executor and must not alias each other's packs. LRU-bounded;
+        eviction also drops compiled supersteps that close over the pack."""
         from janusgraph_tpu.olap.csr import channel_edges
         from janusgraph_tpu.olap.kernels import ELLPack
 
         channel = program.edge_channels[name]
-        key = ("channel", channel)
-        pack = self._ell_packs.get(key)
-        if pack is None:
-            src, dst, w = channel_edges(self.csr, channel)
-            pack = ELLPack(
-                src, dst, w, self.csr.num_vertices, **self._ell_kwargs()
-            )
-            pack.device_put(self.jnp)
-            self._ell_packs[key] = pack
+        pack = self._channel_packs.get(channel)
+        if pack is not None:
+            self._channel_packs.move_to_end(channel)
+            return pack
+        src, dst, w = channel_edges(self.csr, channel)
+        pack = ELLPack(
+            src, dst, w, self.csr.num_vertices, **self._ell_kwargs()
+        )
+        pack.device_put(self.jnp)
+        self._channel_packs[channel] = pack
+        while len(self._channel_packs) > self.CHANNEL_CACHE_SIZE:
+            evicted, _ = self._channel_packs.popitem(last=False)
+            self._compiled = {
+                k: v for k, v in self._compiled.items()
+                if not (len(k) >= 5 and k[4] == evicted)
+            }
         return pack
 
     def _segsum_plan(self, orientation: str):

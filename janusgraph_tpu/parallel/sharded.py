@@ -392,8 +392,11 @@ class ShardedExecutor:
             )
         self.exchange = exchange
         self.agg = agg
+        from collections import OrderedDict
+
         self._compiled: Dict[Tuple, object] = {}
         self._sharded_cache: Dict[object, ShardedCSR] = {}
+        self._channel_views: "OrderedDict" = OrderedDict()
         self._device_cache: Dict[Tuple[object, str], object] = {}
 
     def comm_stats(self, undirected: bool = False) -> Dict[str, int]:
@@ -413,28 +416,39 @@ class ShardedExecutor:
             self._sharded_cache[undirected] = sc
         return sc
 
-    def _sharded_channel(self, program: VertexProgram, name: str) -> ShardedCSR:
-        """ShardedCSR for one named EdgeChannel (typed edge view), built from
-        the channel's filtered edge list and cached per channel VALUE —
-        generic names (s0, s1, ...) recur across programs on a reused
-        executor and must not alias each other's edge views."""
+    #: distinct EdgeChannel views kept device-resident at once (LRU)
+    CHANNEL_CACHE_SIZE = 8
+
+    def _channel_view(self, program: VertexProgram, name: str):
+        """(ShardedCSR, graph-args) for one named EdgeChannel, cached per
+        channel VALUE — generic names (s0, s1, ...) recur across programs on
+        a reused executor and must not alias each other's edge views.
+        LRU-bounded: compiled sharded supersteps take the arrays as
+        ARGUMENTS (not closures), so eviction actually frees them."""
         from janusgraph_tpu.olap.csr import channel_edges
 
         channel = program.edge_channels[name]
-        key = ("ch", channel)
-        sc = self._sharded_cache.get(key)
-        if sc is None:
-            edges = channel_edges(self.csr, channel)
-            sc = ShardedCSR(self.csr, self.num_shards, False, edges=edges)
-            self._sharded_cache[key] = sc
-        return sc
+        hit = self._channel_views.get(channel)
+        if hit is not None:
+            self._channel_views.move_to_end(channel)
+            return hit
+        edges = channel_edges(self.csr, channel)
+        sc = ShardedCSR(self.csr, self.num_shards, False, edges=edges)
+        gargs = self._graph_args(sc, ("ch", channel), cache={})
+        self._channel_views[channel] = (sc, gargs)
+        while len(self._channel_views) > self.CHANNEL_CACHE_SIZE:
+            self._channel_views.popitem(last=False)
+        return sc, gargs
 
-    def _dev(self, sc: ShardedCSR, view_key, name: str):
+    def _dev(self, sc: ShardedCSR, view_key, name: str, cache=None):
         """Device-put a ShardedCSR array once, sharded over the mesh axis —
         re-uploading the static CSR blocks each superstep would dominate.
-        view_key identifies the edge view (undirected flag or channel)."""
+        view_key identifies the edge view (undirected flag or channel);
+        `cache` overrides the executor-lifetime device cache (channel views
+        use a private dict so LRU eviction frees their arrays)."""
+        store = self._device_cache if cache is None else cache
         key = (view_key, name)
-        arr = self._device_cache.get(key)
+        arr = store.get(key)
         if arr is None:
             from jax.sharding import NamedSharding, PartitionSpec as P
 
@@ -447,30 +461,30 @@ class ShardedExecutor:
                 )
             else:
                 arr = self.jax.device_put(host, sharding)
-            self._device_cache[key] = arr
+            store[key] = arr
         return arr
 
-    def _graph_args(self, sc: ShardedCSR, view_key) -> Dict[str, object]:
+    def _graph_args(self, sc: ShardedCSR, view_key, cache=None) -> Dict[str, object]:
         """The static per-shard graph arrays the configured body needs."""
         g = {
-            "out_degree": self._dev(sc, view_key, "out_degree"),
-            "active": self._dev(sc, view_key, "active"),
+            "out_degree": self._dev(sc, view_key, "out_degree", cache),
+            "active": self._dev(sc, view_key, "active", cache),
         }
         if self.exchange == "a2a":
             sc.ensure_exchange_plan()
-            g["send_idx"] = self._dev(sc, view_key, "send_idx")
+            g["send_idx"] = self._dev(sc, view_key, "send_idx", cache)
         if self.agg == "ell":
             sc.ensure_ell()
-            g["ell_buckets"] = self._dev(sc, view_key, "ell_buckets")
-            g["ell_unpermute"] = self._dev(sc, view_key, "ell_unpermute")
+            g["ell_buckets"] = self._dev(sc, view_key, "ell_buckets", cache)
+            g["ell_unpermute"] = self._dev(sc, view_key, "ell_unpermute", cache)
         else:
-            g["dst_loc"] = self._dev(sc, view_key, "in_dst_loc")
-            g["valid"] = self._dev(sc, view_key, "in_valid")
-            g["weight"] = self._dev(sc, view_key, "in_weight")
+            g["dst_loc"] = self._dev(sc, view_key, "in_dst_loc", cache)
+            g["valid"] = self._dev(sc, view_key, "in_valid", cache)
+            g["weight"] = self._dev(sc, view_key, "in_weight", cache)
             g["src_idx"] = (
-                self._dev(sc, view_key, "in_src_tab")
+                self._dev(sc, view_key, "in_src_tab", cache)
                 if self.exchange == "a2a"
-                else self._dev(sc, view_key, "in_src_glob")
+                else self._dev(sc, view_key, "in_src_glob", cache)
             )
         return g
 
@@ -713,10 +727,7 @@ class ShardedExecutor:
             op = program.combiner_for(step)
             ch = program.channel_for(step)
             if ch is not None:
-                sc_step = self._sharded_channel(program, ch)
-                gargs_step = self._graph_args(
-                    sc_step, ("ch", program.edge_channels[ch])
-                )
+                sc_step, gargs_step = self._channel_view(program, ch)
             else:
                 sc_step, gargs_step = sc, gargs
             fn = self._superstep_fn(program, op, sc_step, ch)

@@ -160,3 +160,22 @@ def test_program_cache_key_value_equal(g):
     c = OLAPTraversalProgram(steps_from_spec(g, [("in", ["father"])]))
     assert a.cache_key() == b.cache_key()
     assert a.cache_key() != c.cache_key()
+
+
+def test_unknown_label_raises(g):
+    with pytest.raises(ValueError, match="unknown edge label"):
+        steps_from_spec(g, [("out", ["knowz"])])
+
+
+def test_channel_cache_bounded(g):
+    csr = load_csr(g)
+    ex = TPUExecutor(csr)
+    labels = ["father", "mother", "brother", "battled", "lives", "pet"]
+    for i in range(len(labels)):
+        for lab in (labels[: i + 1],):
+            spec = [("out", lab)]
+            ex.run(OLAPTraversalProgram(steps_from_spec(g, spec)))
+    assert len(ex._channel_packs) <= ex.CHANNEL_CACHE_SIZE
+    # correctness survives any evictions
+    res = ex.run(OLAPTraversalProgram(steps_from_spec(g, [("in", ["battled"])])))
+    assert int(np.asarray(res["count"]).sum()) == 3

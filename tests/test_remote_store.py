@@ -141,3 +141,41 @@ def test_graph_end_to_end_over_remote():
     assert csr.num_vertices == 12 and csr.num_edges == 17
     g.close()
     server.stop()
+
+
+def test_cli_storage_server_cross_process(tmp_path):
+    """Two real processes: `janusgraph_tpu storage-server` serving a
+    persistent store, a graph client over the wire (the reference's
+    deployment shape: storage nodes + graph instances)."""
+    import re
+    import subprocess
+    import sys
+
+    from pathlib import Path
+
+    repo_root = Path(__file__).resolve().parents[1]
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "janusgraph_tpu", "storage-server",
+         "--port", "0", "--directory", str(tmp_path / "srv")],
+        stdout=subprocess.PIPE, text=True, cwd=str(repo_root),
+    )
+    try:
+        line = proc.stdout.readline()
+        m = re.search(r"listening on ([\d.]+):(\d+)", line)
+        assert m, line
+        host, port = m.group(1), int(m.group(2))
+        from janusgraph_tpu.core.graph import open_graph
+
+        g = open_graph({
+            "storage.backend": "remote",
+            "storage.hostname": host,
+            "storage.port": port,
+        })
+        tx = g.new_transaction()
+        v = tx.add_vertex(name="networked")
+        tx.commit()
+        assert g.traversal().V().has("name", "networked").count() == 1
+        g.close()
+    finally:
+        proc.terminate()
+        proc.wait(timeout=10)
