@@ -213,6 +213,61 @@ class ShardedCSR:
         self.comm_a2a_elems = S * B
         self.comm_gather_elems = self.padded_n
 
+    def ensure_ring(self) -> None:
+        """Build the ring-exchange plan once: per shard, edge slots grouped
+        by SOURCE OWNER into uniform blocks of Eo = max edges any (shard,
+        owner) pair holds, so ring step t reduces exactly one owner's block
+        (dynamic-slice by traced owner index) instead of masking the whole
+        edge list every step. Arrays (leading dim S, per-shard layout
+        owner-major):
+          ring_src_loc (S*S*Eo,) int32 — source index LOCAL to the owner
+          ring_dst_loc (S*S*Eo,) int32 — destination local to this shard
+          ring_valid   (S*S*Eo,) f32
+          ring_weight  (S*S*Eo,) f32
+        """
+        if getattr(self, "_ring_built", False):
+            return
+        self._ring_built = True
+        S, Np, Em = self.num_shards, self.shard_size, self.edges_per_shard
+        src, offsets = self._src_sorted, self._offsets
+
+        counts = np.zeros((S, S), dtype=np.int64)
+        per_shard = []
+        for s in range(S):
+            lo, hi = offsets[s], offsets[s + 1]
+            ssrc = src[lo:hi]
+            owner = (ssrc // Np).astype(np.int64)
+            order = np.argsort(owner, kind="stable")
+            per_shard.append((lo, order, owner[order]))
+            counts[s] = np.bincount(owner, minlength=S)
+        Eo = max(1, int(counts.max()))
+        self.ring_block = Eo
+
+        ring_src = np.zeros((S, S * Eo), dtype=np.int32)
+        ring_dst = np.zeros((S, S * Eo), dtype=np.int32)
+        ring_valid = np.zeros((S, S * Eo), dtype=np.float32)
+        ring_weight = np.ones((S, S * Eo), dtype=np.float32)
+        for s in range(S):
+            lo, order, owner_sorted = per_shard[s]
+            k = len(order)
+            if not k:
+                continue
+            gsrc = src[lo + order]
+            # position within each owner block
+            block_start = np.concatenate(
+                ([0], np.cumsum(np.bincount(owner_sorted, minlength=S)))
+            )
+            pos = np.arange(k) - block_start[owner_sorted]
+            col = owner_sorted * Eo + pos
+            ring_src[s, col] = (gsrc - owner_sorted * Np).astype(np.int32)
+            ring_dst[s, col] = self.in_dst_loc[s * Em + order]
+            ring_valid[s, col] = 1.0
+            ring_weight[s, col] = self.in_weight[s * Em + order]
+        self.ring_src_loc = ring_src.reshape(-1)
+        self.ring_dst_loc = ring_dst.reshape(-1)
+        self.ring_valid = ring_valid.reshape(-1)
+        self.ring_weight = ring_weight.reshape(-1)
+
     def ensure_ell(self) -> None:
         """Build the uniform ELL pack once, on first use (requires the
         exchange plan: ELL indices point into the a2a message table)."""
@@ -358,9 +413,14 @@ class ShardedExecutor:
     """BSP executor over a jax.sharding.Mesh (1-D axis 'p').
 
     exchange: "a2a" (default) — boundary-bucket lax.all_to_all;
+              "ring" — S-step lax.ppermute rotation: each step one shard's
+              outgoing block streams past and its contribution is folded in
+              (the ring-attention pattern applied to message aggregation —
+              peak comm memory O(Np) per step instead of the S*B bucket
+              table; the right shape when boundary sets approach O(n));
               "gather" — full-vector all_gather (debug/reference path).
-    agg:      "ell" (default) — uniform degree-bucketed ELL (no scatter);
-              "segment" — flat segment reduction.
+    agg:      "ell" (default; a2a only) — uniform degree-bucketed ELL;
+              "segment" — flat segment reduction (ring/gather use this).
     """
 
     def __init__(
@@ -382,13 +442,15 @@ class ShardedExecutor:
         self.mesh = mesh
         self.num_shards = mesh.devices.size
         self.csr = csr
-        if exchange == "gather" and agg == "ell":
-            # the ELL pack indexes the a2a message table, which the gather
-            # exchange never builds — refuse rather than silently rewiring
+        if exchange not in ("a2a", "ring", "gather"):
+            raise ValueError(f"unknown exchange {exchange!r}")
+        if exchange in ("gather", "ring") and agg == "ell":
+            # the ELL pack indexes the a2a message table, which the other
+            # exchanges never build — refuse rather than silently rewiring
             raise ValueError(
                 "agg='ell' requires exchange='a2a' (the ELL indices point "
                 "into the all-to-all message table); use agg='segment' with "
-                "exchange='gather'"
+                f"exchange={exchange!r}"
             )
         self.exchange = exchange
         self.agg = agg
@@ -406,6 +468,10 @@ class ShardedExecutor:
         return {
             "a2a_elems": sc.comm_a2a_elems,
             "gather_elems": sc.comm_gather_elems,
+            # ring: S steps x one Np block = padded_n streamed per superstep,
+            # but peak resident comm buffer is a single Np block
+            "ring_elems": sc.padded_n,
+            "ring_peak_elems": sc.shard_size,
             "boundary_width": sc.boundary_width,
         }
 
@@ -437,7 +503,15 @@ class ShardedExecutor:
         gargs = self._graph_args(sc, ("ch", channel), cache={})
         self._channel_views[channel] = (sc, gargs)
         while len(self._channel_views) > self.CHANNEL_CACHE_SIZE:
-            self._channel_views.popitem(last=False)
+            evicted, _ = self._channel_views.popitem(last=False)
+            # compiled supersteps close over the evicted ShardedCSR (static
+            # shapes/metadata), pinning its O(E) host arrays — prune them
+            # (their key layout is ("step", cache_key, op, exchange, agg,
+            # ch_val))
+            self._compiled = {
+                k: v for k, v in self._compiled.items()
+                if not (len(k) >= 6 and k[5] == evicted)
+            }
         return sc, gargs
 
     def _dev(self, sc: ShardedCSR, view_key, name: str, cache=None):
@@ -473,6 +547,15 @@ class ShardedExecutor:
         if self.exchange == "a2a":
             sc.ensure_exchange_plan()
             g["send_idx"] = self._dev(sc, view_key, "send_idx", cache)
+        if self.exchange == "ring":
+            sc.ensure_ring()
+            g["ring_src"] = self._dev(sc, view_key, "ring_src_loc", cache)
+            g["ring_dst"] = self._dev(sc, view_key, "ring_dst_loc", cache)
+            g["ring_valid"] = self._dev(sc, view_key, "ring_valid", cache)
+            g["ring_weight"] = self._dev(sc, view_key, "ring_weight", cache)
+            g["out_degree"] = self._dev(sc, view_key, "out_degree", cache)
+            g["active"] = self._dev(sc, view_key, "active", cache)
+            return g
         if self.agg == "ell":
             sc.ensure_ell()
             g["ell_buckets"] = self._dev(sc, view_key, "ell_buckets", cache)
@@ -517,6 +600,55 @@ class ShardedExecutor:
                 return m.min(axis=axis_)
             return m.max(axis=axis_)
 
+        if exchange == "ring":
+            sc.ensure_ring()
+            Eo = sc.ring_block
+        else:
+            Eo = 0
+
+        def ring_aggregate(g, outgoing):
+            """S-step ring: rotate outgoing blocks with ppermute; step t
+            reduces exactly the pre-partitioned edge block of the owner now
+            passing by (dynamic-slice into the owner-major ring plan), so
+            total edge work per superstep is ~Em + padding, not S*Em. The
+            ring-attention streaming pattern: peak comm buffer is ONE Np
+            block, not the S*B bucket table."""
+            my = jax.lax.axis_index(axis)
+            tail_shape = tuple(outgoing.shape[1:])
+            acc0 = jnp.full((Np,) + tail_shape, identity, outgoing.dtype)
+            perm = [(i, (i + 1) % S) for i in range(S)]
+
+            def fold(carry, step_i):
+                acc, block = carry
+                owner = (my - step_i) % S
+                start = owner * Eo
+                src = jax.lax.dynamic_slice(g["ring_src"], (start,), (Eo,))
+                dst = jax.lax.dynamic_slice(g["ring_dst"], (start,), (Eo,))
+                valid = jax.lax.dynamic_slice(g["ring_valid"], (start,), (Eo,))
+                weight = jax.lax.dynamic_slice(g["ring_weight"], (start,), (Eo,))
+                msgs = block[src]
+                w = weight[:, None] if msgs.ndim == 2 else weight
+                if program.edge_transform == EdgeTransform.MUL_WEIGHT:
+                    msgs = msgs * w
+                elif program.edge_transform == EdgeTransform.ADD_WEIGHT:
+                    msgs = msgs + w
+                mask = valid[:, None] if msgs.ndim == 2 else valid
+                msgs = jnp.where(mask > 0, msgs, identity)
+                part = seg_reduce(msgs, dst)
+                if op == Combiner.SUM:
+                    acc = acc + part
+                elif op == Combiner.MIN:
+                    acc = jnp.minimum(acc, part)
+                else:
+                    acc = jnp.maximum(acc, part)
+                block = jax.lax.ppermute(block, axis, perm)
+                return (acc, block), None
+
+            (acc, _), _ = jax.lax.scan(
+                fold, (acc0, outgoing), jnp.arange(S, dtype=jnp.int32)
+            )
+            return acc
+
         def body(state, step, memory_in, g):
             offset = jax.lax.axis_index(axis) * Np
             view = _ShardView(
@@ -524,6 +656,10 @@ class ShardedExecutor:
             )
             outgoing = program.message(state, step, view, jnp)
             tail = tuple(outgoing.shape[1:])
+
+            if exchange == "ring":
+                agg_v = ring_aggregate(g, outgoing)
+                return _apply_and_reduce(state, agg_v, step, memory_in, view)
 
             # ---- exchange: build the message table this shard reads from
             if exchange == "a2a":
@@ -575,6 +711,9 @@ class ShardedExecutor:
                 msgs = jnp.where(vmask > 0, msgs, identity)
                 agg_v = seg_reduce(msgs, g["dst_loc"])
 
+            return _apply_and_reduce(state, agg_v, step, memory_in, view)
+
+        def _apply_and_reduce(state, agg_v, step, memory_in, view):
             new_state, metrics = program.apply(
                 state, agg_v, step, memory_in, view, jnp
             )

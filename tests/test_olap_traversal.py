@@ -167,15 +167,27 @@ def test_unknown_label_raises(g):
         steps_from_spec(g, [("out", ["knowz"])])
 
 
-def test_channel_cache_bounded(g):
+def test_channel_cache_bounded_and_eviction_safe(g, mesh8):
+    """Eviction must actually FIRE (more distinct views than the cap) and
+    both the LRU and the compiled-fn pruning must leave behavior exact."""
     csr = load_csr(g)
-    ex = TPUExecutor(csr)
     labels = ["father", "mother", "brother", "battled", "lives", "pet"]
-    for i in range(len(labels)):
-        for lab in (labels[: i + 1],):
-            spec = [("out", lab)]
-            ex.run(OLAPTraversalProgram(steps_from_spec(g, spec)))
-    assert len(ex._channel_packs) <= ex.CHANNEL_CACHE_SIZE
-    # correctness survives any evictions
+    # 12 distinct channel values (6 labels x 2 directions) > cap
+    specs = [[(d, [lab])] for lab in labels for d in ("out", "in")]
+
+    ex = TPUExecutor(csr)
+    ex.CHANNEL_CACHE_SIZE = 4
+    for spec in specs:
+        ex.run(OLAPTraversalProgram(steps_from_spec(g, spec)))
+    assert len(ex._channel_packs) <= 4
+    # the FIRST spec was evicted long ago: rebuild must be exact
     res = ex.run(OLAPTraversalProgram(steps_from_spec(g, [("in", ["battled"])])))
     assert int(np.asarray(res["count"]).sum()) == 3
+
+    sx = ShardedExecutor(csr, mesh=mesh8)
+    sx.CHANNEL_CACHE_SIZE = 4
+    for spec in specs[:6]:
+        sx.run(OLAPTraversalProgram(steps_from_spec(g, spec)))
+    assert len(sx._channel_views) <= 4
+    res = sx.run(OLAPTraversalProgram(steps_from_spec(g, [("out", ["father"])])))
+    assert int(np.asarray(res["count"]).sum()) == 2

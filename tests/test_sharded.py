@@ -73,6 +73,7 @@ def test_sharded_tiny_graph_fewer_vertices_than_shards(mesh8):
 
 @pytest.mark.parametrize("exchange,agg", [
     ("a2a", "ell"), ("a2a", "segment"), ("gather", "segment"),
+    ("ring", "segment"),
 ])
 def test_exchange_agg_matrix_parity(mesh8, exchange, agg):
     """Every exchange × aggregation configuration gives oracle results."""
@@ -155,3 +156,26 @@ def test_sharded_single_device_mesh():
     cpu = run_on(g, PageRankProgram(max_iterations=15), "cpu")
     res = ShardedExecutor(g, mesh=mesh1).run(PageRankProgram(max_iterations=15))
     np.testing.assert_allclose(res["rank"], cpu["rank"], rtol=1e-4, atol=1e-6)
+
+
+def test_ring_exchange_parity_all_programs(mesh8):
+    """The ring (ppermute-streamed blocks, the ring-attention pattern)
+    matches the oracle for every monoid/program shape, including fused
+    spans (while_loop + ppermute in the loop body)."""
+    g = random_graph(n=190, m=800, seed=13, weights=True)
+    for name, make in PROGRAMS:
+        cpu = run_on(g, make(), "cpu")
+        ex = ShardedExecutor(g, mesh=mesh8, exchange="ring", agg="segment")
+        res = ex.run(make())
+        for k in cpu:
+            np.testing.assert_allclose(
+                np.asarray(res[k], np.float64), cpu[k], rtol=1e-4, atol=1e-5,
+                err_msg=f"ring:{name}:{k}",
+            )
+
+
+def test_ring_comm_stats(mesh8):
+    g = random_graph(n=512, m=2000)
+    ex = ShardedExecutor(g, mesh=mesh8, exchange="ring", agg="segment")
+    stats = ex.comm_stats()
+    assert stats["ring_peak_elems"] == stats["ring_elems"] // 8
