@@ -108,12 +108,7 @@ def load_csr(
     partitions: restrict the scan to these storage partitions (the unit that
     maps onto mesh shards).
     """
-    es = graph.edge_serializer
     idm = graph.idm
-    st = graph.system_types
-    btx = graph.backend.begin_transaction()
-    store_tx = btx.store_tx
-    store = graph.backend.edgestore
 
     label_ids: Optional[set] = None
     if edge_labels is not None:
@@ -141,6 +136,26 @@ def load_csr(
         pk = graph.schema_cache.get_by_name(weight_key)
         if pk is not None:
             weight_key_id = pk.id
+
+    raw = _scan_raw(
+        graph, label_ids, vlabel_ids, prop_key_ids, weight_key_id, partitions
+    )
+    return build_csr_from_raw(idm, [raw])
+
+
+def _scan_raw(
+    graph, label_ids, vlabel_ids, prop_key_ids, weight_key_id, partitions
+):
+    """Partition scan -> RAW vid-space arrays with NO endpoint validation:
+    the unit of DISTRIBUTED loading. Each worker scans disjoint partitions;
+    an edge's destination may live in another worker's partition set, so
+    validation waits for the merge (build_csr_from_raw)."""
+    es = graph.edge_serializer
+    idm = graph.idm
+    st = graph.system_types
+    btx = graph.backend.begin_transaction()
+    store_tx = btx.store_tx
+    store = graph.backend.edgestore
 
     # ONE wide slice covering every cell category (sys-prop .. user-edge):
     # the whole row arrives with the scan, so there are no per-row get_slice
@@ -282,13 +297,60 @@ def load_csr(
 
     _flush_edges()
 
-    vertex_ids = np.unique(np.array(vertex_id_list, dtype=np.int64))
+    return {
+        "vertex_id_list": vertex_id_list,
+        "vertex_labels": vertex_labels,
+        "src": np.concatenate(src_ids) if src_ids else np.empty(0, np.int64),
+        "dst": np.concatenate(dst_ids) if dst_ids else np.empty(0, np.int64),
+        "etype": np.concatenate(etypes) if etypes else None,
+        "weights": np.concatenate(weights) if weights else None,
+        "raw_props": raw_props,
+    }
+
+
+def build_csr_from_raw(idm, raws) -> CSRGraph:
+    """Merge one or more _scan_raw outputs (e.g. from N loader processes
+    over disjoint partition sets) into a validated CSRGraph."""
+    vid_parts, vlabel_parts = [], []
+    src_parts, dst_parts, et_parts, w_parts = [], [], [], []
+    raw_props: Dict[str, Dict[int, object]] = {}
+    any_et = any(r["etype"] is not None for r in raws)
+    any_w = any(r["weights"] is not None for r in raws)
+    for r in raws:
+        vid_parts.append(np.asarray(r["vertex_id_list"], dtype=np.int64))
+        vlabel_parts.append(np.asarray(r["vertex_labels"], dtype=np.int64))
+        src_parts.append(r["src"])
+        dst_parts.append(r["dst"])
+        if any_et:
+            et_parts.append(
+                r["etype"] if r["etype"] is not None
+                else np.zeros(len(r["src"]), dtype=np.int32)
+            )
+        if any_w:
+            w_parts.append(
+                r["weights"] if r["weights"] is not None
+                else np.ones(len(r["src"]), dtype=np.float32)
+            )
+        for name, mapping in r["raw_props"].items():
+            raw_props.setdefault(name, {}).update(mapping)
+    src = np.concatenate(src_parts) if src_parts else np.empty(0, np.int64)
+    dst = np.concatenate(dst_parts) if dst_parts else np.empty(0, np.int64)
+    et = np.concatenate(et_parts) if any_et else None
+    w = np.concatenate(w_parts) if any_w else None
+
+    # vectorized vertex/label merge: one unique pass; return_index picks a
+    # representative occurrence for each id's label (the loader targets
+    # multi-million-vertex merges — no per-element Python)
+    vids_all = (
+        np.concatenate(vid_parts) if vid_parts else np.empty(0, np.int64)
+    )
+    vlabels_all = (
+        np.concatenate(vlabel_parts) if vlabel_parts else np.empty(0, np.int64)
+    )
+    vertex_ids, first_idx = np.unique(vids_all, return_index=True)
+    label_arr = vlabels_all[first_idx] if len(vlabels_all) else None
     n = len(vertex_ids)
-    if src_ids:
-        src = np.concatenate(src_ids)
-        dst = np.concatenate(dst_ids)
-        w = np.concatenate(weights) if weights else None
-        et = np.concatenate(etypes) if etypes else None
+    if len(src):
         # canonicalize partitioned-vertex endpoints on the dst side too
         if idm.partition_bits > 0 and _any_partitioned(idm, dst):
             dst = canonicalize_ids(idm, dst)
@@ -331,11 +393,6 @@ def load_csr(
             )
         else:
             props[name] = np.array(vals, dtype=object)
-
-    label_arr = None
-    if vertex_labels:
-        m = dict(zip(vertex_id_list, vertex_labels))
-        label_arr = np.array([m.get(int(v), 0) for v in vertex_ids], dtype=np.int64)
 
     return CSRGraph(
         vertex_ids=vertex_ids,

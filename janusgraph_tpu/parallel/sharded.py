@@ -461,19 +461,26 @@ class ShardedExecutor:
         self._channel_views: "OrderedDict" = OrderedDict()
         self._device_cache: Dict[Tuple[object, str], object] = {}
 
-    def comm_stats(self, undirected: bool = False) -> Dict[str, int]:
-        """Per-superstep exchange volume in elements per shard."""
+    def comm_stats(self, undirected: bool = False) -> Dict[str, object]:
+        """Per-superstep exchange volume in elements per shard. The a2a
+        boundary plan is only materialized for a2a-configured executors —
+        ring exists precisely for the regime where that O(S*S*B) table is
+        most expensive to build."""
         sc = self._sharded(undirected)
-        sc.ensure_exchange_plan()
-        return {
-            "a2a_elems": sc.comm_a2a_elems,
-            "gather_elems": sc.comm_gather_elems,
-            # ring: S steps x one Np block = padded_n streamed per superstep,
-            # but peak resident comm buffer is a single Np block
+        stats: Dict[str, object] = {
+            "gather_elems": sc.padded_n,
+            # ring: S-1 hops x one Np block streamed per superstep, peak
+            # resident comm buffer is a single Np block
             "ring_elems": sc.padded_n,
             "ring_peak_elems": sc.shard_size,
-            "boundary_width": sc.boundary_width,
+            "a2a_elems": None,
+            "boundary_width": None,
         }
+        if self.exchange == "a2a":
+            sc.ensure_exchange_plan()
+            stats["a2a_elems"] = sc.comm_a2a_elems
+            stats["boundary_width"] = sc.boundary_width
+        return stats
 
     def _sharded(self, undirected: bool) -> ShardedCSR:
         sc = self._sharded_cache.get(undirected)
@@ -553,8 +560,6 @@ class ShardedExecutor:
             g["ring_dst"] = self._dev(sc, view_key, "ring_dst_loc", cache)
             g["ring_valid"] = self._dev(sc, view_key, "ring_valid", cache)
             g["ring_weight"] = self._dev(sc, view_key, "ring_weight", cache)
-            g["out_degree"] = self._dev(sc, view_key, "out_degree", cache)
-            g["active"] = self._dev(sc, view_key, "active", cache)
             return g
         if self.agg == "ell":
             sc.ensure_ell()
@@ -618,9 +623,7 @@ class ShardedExecutor:
             acc0 = jnp.full((Np,) + tail_shape, identity, outgoing.dtype)
             perm = [(i, (i + 1) % S) for i in range(S)]
 
-            def fold(carry, step_i):
-                acc, block = carry
-                owner = (my - step_i) % S
+            def fold_owner(acc, block, owner):
                 start = owner * Eo
                 src = jax.lax.dynamic_slice(g["ring_src"], (start,), (Eo,))
                 dst = jax.lax.dynamic_slice(g["ring_dst"], (start,), (Eo,))
@@ -636,16 +639,23 @@ class ShardedExecutor:
                 msgs = jnp.where(mask > 0, msgs, identity)
                 part = seg_reduce(msgs, dst)
                 if op == Combiner.SUM:
-                    acc = acc + part
-                elif op == Combiner.MIN:
-                    acc = jnp.minimum(acc, part)
-                else:
-                    acc = jnp.maximum(acc, part)
+                    return acc + part
+                if op == Combiner.MIN:
+                    return jnp.minimum(acc, part)
+                return jnp.maximum(acc, part)
+
+            # own block folds before any hop, so only S-1 ppermutes fire —
+            # the final rotation (returning blocks home) would be dead comm
+            acc0 = fold_owner(acc0, outgoing, my)
+
+            def fold(carry, step_i):
+                acc, block = carry
                 block = jax.lax.ppermute(block, axis, perm)
+                acc = fold_owner(acc, block, (my - step_i) % S)
                 return (acc, block), None
 
             (acc, _), _ = jax.lax.scan(
-                fold, (acc0, outgoing), jnp.arange(S, dtype=jnp.int32)
+                fold, (acc0, outgoing), jnp.arange(1, S, dtype=jnp.int32)
             )
             return acc
 

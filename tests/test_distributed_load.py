@@ -1,0 +1,111 @@
+"""Multi-process distributed CSR loading (the Hadoop input-format analogue —
+reference: HadoopInputFormat.java splits read by separate workers): N real
+worker processes scan disjoint partition sets from a SHARED backend and the
+parent merges; oracle = single-process load_csr.
+"""
+
+import numpy as np
+import pytest
+
+from janusgraph_tpu.core.bulk import bulk_add_edges, bulk_add_vertices
+from janusgraph_tpu.core.graph import open_graph
+from janusgraph_tpu.olap.csr import load_csr
+from janusgraph_tpu.olap.distributed_load import distributed_load_csr
+from janusgraph_tpu.storage.inmemory import InMemoryStoreManager
+from janusgraph_tpu.storage.remote import RemoteStoreServer
+
+
+def _seed(g, n=400, m=2500, seed=3):
+    rng = np.random.default_rng(seed)
+    vids = bulk_add_vertices(g, n, label="node")
+    bulk_add_edges(
+        g, "link", vids[rng.integers(0, n, m)], vids[rng.integers(0, n, m)]
+    )
+    return vids
+
+
+def _csr_sets(csr):
+    src = np.repeat(csr.vertex_ids, np.diff(csr.out_indptr))
+    dst = csr.vertex_ids[csr.out_dst]
+    return set(csr.vertex_ids.tolist()), set(zip(src.tolist(), dst.tolist()))
+
+
+def test_distributed_matches_single_process_over_remote():
+    server = RemoteStoreServer(InMemoryStoreManager()).start()
+    host, port = server.address
+    cfg = {
+        "storage.backend": "remote",
+        "storage.hostname": host,
+        "storage.port": port,
+    }
+    g = open_graph(cfg)
+    _seed(g)
+    oracle = load_csr(g)
+    g.close()
+
+    csr = distributed_load_csr(cfg, num_workers=4)
+    assert _csr_sets(csr) == _csr_sets(oracle)
+    assert csr.num_edges == oracle.num_edges
+    np.testing.assert_array_equal(csr.vertex_ids, oracle.vertex_ids)
+    np.testing.assert_array_equal(csr.labels, oracle.labels)
+    server.stop()
+
+
+def test_distributed_over_local_directory(tmp_path):
+    cfg = {
+        "storage.backend": "local",
+        "storage.directory": str(tmp_path / "store"),
+    }
+    g = open_graph(cfg)
+    _seed(g, n=120, m=700, seed=9)
+    oracle = load_csr(g)
+    g.close()
+
+    csr = distributed_load_csr(cfg, num_workers=3)
+    assert _csr_sets(csr) == _csr_sets(oracle)
+
+
+def test_cross_partition_edges_survive_the_split():
+    """The property the merge exists for: edges whose src and dst live in
+    DIFFERENT workers' partition sets must not be dropped."""
+    server = RemoteStoreServer(InMemoryStoreManager()).start()
+    host, port = server.address
+    cfg = {
+        "storage.backend": "remote",
+        "storage.hostname": host,
+        "storage.port": port,
+    }
+    g = open_graph(cfg)
+    vids = _seed(g, n=300, m=1500)
+    parts = {g.idm.get_partition_id(int(v)) for v in vids}
+    assert len(parts) > 8  # spread over many partitions
+    oracle = load_csr(g)
+    g.close()
+    csr = distributed_load_csr(cfg, num_workers=8)
+    assert csr.num_edges == oracle.num_edges
+    server.stop()
+
+
+def test_rejects_private_backend():
+    with pytest.raises(ValueError, match="SHARED backend"):
+        distributed_load_csr({"storage.backend": "inmemory"})
+
+
+def test_distributed_csr_runs_olap():
+    server = RemoteStoreServer(InMemoryStoreManager()).start()
+    host, port = server.address
+    cfg = {
+        "storage.backend": "remote",
+        "storage.hostname": host,
+        "storage.port": port,
+    }
+    g = open_graph(cfg)
+    _seed(g, n=200, m=1000)
+    g.close()
+    csr = distributed_load_csr(cfg, num_workers=2)
+    from janusgraph_tpu.olap.cpu_executor import CPUExecutor
+    from janusgraph_tpu.olap.programs import PageRankProgram
+
+    res = CPUExecutor(csr).run(PageRankProgram(max_iterations=10))
+    assert abs(res["rank"].sum() - 1.0) < 1e-6
+    server.stop()
