@@ -1,0 +1,70 @@
+"""Distributed deployment example (reference analogue: a Cassandra cluster +
+several JanusGraph instances + Spark workers for OLAP input):
+
+  1. one storage-server process hosting an N-node sharded composite,
+  2. a graph instance connected over the remote KCVS protocol (OLTP),
+  3. N loader processes doing partition-parallel CSR extraction,
+  4. OLAP PageRank on the merged snapshot, written back over the wire.
+
+Run: python examples/distributed_cluster.py
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")  # demo stays on host devices
+
+import numpy as np
+
+from janusgraph_tpu.core.bulk import bulk_add_edges, bulk_add_vertices
+from janusgraph_tpu.core.graph import open_graph
+from janusgraph_tpu.olap.distributed_load import distributed_load_csr
+from janusgraph_tpu.olap.programs import PageRankProgram
+from janusgraph_tpu.olap.tpu_executor import TPUExecutor, write_back
+from janusgraph_tpu.storage.sharded_store import ShardedStoreManager
+from janusgraph_tpu.storage.remote import RemoteStoreServer
+
+
+def main() -> None:
+    # 1. storage tier: 3 hash-partitioned nodes behind one TCP endpoint
+    server = RemoteStoreServer(ShardedStoreManager(num_nodes=3)).start()
+    host, port = server.address
+    cfg = {
+        "storage.backend": "remote",
+        "storage.hostname": host,
+        "storage.port": port,
+    }
+    print(f"storage cluster at {host}:{port} (3 sharded nodes)")
+
+    # 2. a graph instance over the wire: bulk-ingest a small power-law graph
+    g = open_graph(cfg)
+    rng = np.random.default_rng(7)
+    n, m = 5000, 40000
+    vids = bulk_add_vertices(g, n, label="page")
+    bulk_add_edges(
+        g, "links", vids[rng.integers(0, n, m)], vids[rng.integers(0, n, m)]
+    )
+    print(f"ingested {n} vertices / {m} edges over the remote protocol")
+
+    # 3. partition-parallel extraction with 4 REAL worker processes
+    csr = distributed_load_csr(cfg, num_workers=4)
+    print(f"distributed load: {csr.num_vertices}v {csr.num_edges}e")
+
+    # 4. OLAP + write-back through the same wire
+    res = TPUExecutor(csr).run(PageRankProgram(max_iterations=20))
+    write_back(g, csr, {"rank": res["rank"]})
+    top = max(
+        g.traversal().V().to_list(), key=lambda v: v.value("rank") or 0.0
+    )
+    print(f"highest-rank vertex {top.id}: {top.value('rank'):.2e}")
+
+    g.close()
+    server.stop()
+
+
+if __name__ == "__main__":
+    main()

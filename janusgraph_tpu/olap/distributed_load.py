@@ -79,12 +79,18 @@ def distributed_load_csr(
             "(storage.backend='remote' or 'local'); "
             f"got {backend!r} whose state is private to each process"
         )
-    from janusgraph_tpu.core.config import REGISTRY  # noqa: F401 (validated by open)
     from janusgraph_tpu.core.graph import open_graph
     from janusgraph_tpu.core.ids import IDManager
 
-    # partition count comes from the FIXED ids.partition-bits option
-    pb = config.get("ids.partition-bits", 5)
+    # partition count MUST be the cluster's reconciled FIXED value, which can
+    # differ from (or be absent in) the caller's dict — the stored global
+    # config wins; resolve it the same way the workers will, by opening the
+    # graph once (a config.get default here silently loses partitions)
+    probe = open_graph(config)
+    try:
+        pb = probe.idm.partition_bits
+    finally:
+        probe.close()
     num_partitions = 1 << pb
     num_workers = max(1, min(num_workers, num_partitions))
     assignments: List[List[int]] = [[] for _ in range(num_workers)]

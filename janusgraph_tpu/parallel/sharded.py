@@ -469,9 +469,9 @@ class ShardedExecutor:
         sc = self._sharded(undirected)
         stats: Dict[str, object] = {
             "gather_elems": sc.padded_n,
-            # ring: S-1 hops x one Np block streamed per superstep, peak
-            # resident comm buffer is a single Np block
-            "ring_elems": sc.padded_n,
+            # ring: S-1 hops x one Np block streamed per superstep (the own
+            # block folds locally), peak resident comm buffer one Np block
+            "ring_elems": (self.num_shards - 1) * sc.shard_size,
             "ring_peak_elems": sc.shard_size,
             "a2a_elems": None,
             "boundary_width": None,

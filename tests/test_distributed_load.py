@@ -109,3 +109,25 @@ def test_distributed_csr_runs_olap():
     res = CPUExecutor(csr).run(PageRankProgram(max_iterations=10))
     assert abs(res["rank"].sum() - 1.0) < 1e-6
     server.stop()
+
+
+def test_partition_bits_resolved_from_stored_config(tmp_path):
+    """The FIXED partition count lives in the backend's global config; a
+    caller dict omitting it must not silently lose partitions."""
+    cfg_create = {
+        "storage.backend": "local",
+        "storage.directory": str(tmp_path / "pb7"),
+        "ids.partition-bits": 7,
+    }
+    g = open_graph(cfg_create)
+    _seed(g, n=300, m=1000, seed=5)
+    oracle = load_csr(g)
+    g.close()
+    # caller omits partition-bits entirely: stored value (7) must win
+    cfg_load = {
+        "storage.backend": "local",
+        "storage.directory": str(tmp_path / "pb7"),
+    }
+    csr = distributed_load_csr(cfg_load, num_workers=4)
+    assert csr.num_vertices == oracle.num_vertices == 300
+    assert csr.num_edges == oracle.num_edges

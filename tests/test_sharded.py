@@ -178,4 +178,6 @@ def test_ring_comm_stats(mesh8):
     g = random_graph(n=512, m=2000)
     ex = ShardedExecutor(g, mesh=mesh8, exchange="ring", agg="segment")
     stats = ex.comm_stats()
-    assert stats["ring_peak_elems"] == stats["ring_elems"] // 8
+    # S-1 = 7 hops of one shard-block each; own block never leaves the chip
+    assert stats["ring_elems"] == 7 * stats["ring_peak_elems"]
+    assert stats["a2a_elems"] is None  # the a2a plan is not materialized
