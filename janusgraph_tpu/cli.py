@@ -86,14 +86,16 @@ def cmd_console(args) -> int:
         )
     else:
         from janusgraph_tpu.core.graph import open_graph
-        from janusgraph_tpu.core.traversal import P
+        from janusgraph_tpu.core.traversal import P, __ as _anon
 
         graph = open_graph(_load_config(args.config))
         if args.load_gods:
             from janusgraph_tpu.core import gods
 
             gods.load(graph)
-        ns.update({"graph": graph, "g": graph.traversal(), "P": P})
+        ns.update({
+            "graph": graph, "g": graph.traversal(), "P": P, "__": _anon,
+        })
     code.interact(banner=banner, local=ns)
     return 0
 

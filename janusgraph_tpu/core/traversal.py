@@ -203,6 +203,42 @@ class Traverser:
         return Traverser(self.obj, prev=self.prev, path=self.path, tags=tags)
 
 
+class AnonymousTraversal:
+    """TinkerPop's `__` analogue: records a step chain and replays it when
+    called with a traversal — usable anywhere a lambda body is accepted
+    (`t.repeat(__.out('father'), times=2)`), and the ONLY body form the
+    server's AST sandbox can express (lambdas are rejected there). Chains
+    are immutable; each step returns a new recorder, so shared prefixes are
+    safe to reuse."""
+
+    __slots__ = ("_chain",)
+
+    def __init__(self, chain: tuple = ()):
+        object.__setattr__(self, "_chain", chain)
+
+    def __getattr__(self, name):
+        if name.startswith("_"):
+            raise AttributeError(name)
+        chain = self._chain
+
+        def add(*args, **kwargs):
+            return AnonymousTraversal(chain + ((name, args, kwargs),))
+
+        return add
+
+    def __call__(self, t):
+        for name, args, kwargs in self._chain:
+            t = getattr(t, name)(*args, **kwargs)
+        return t
+
+    def __repr__(self):
+        return "__" + "".join(f".{n}(...)" for n, _a, _k in self._chain)
+
+
+#: the anonymous start: __.out('knows').has('name', ...)
+__ = AnonymousTraversal()
+
+
 class GraphTraversalSource:
     def __init__(self, graph, tx=None):
         self.graph = graph

@@ -65,7 +65,10 @@ def _validate_query(query: str) -> ast.Expression:
         if isinstance(node, ast.Attribute) and node.attr.startswith("_"):
             raise QueryRejected(f"disallowed attribute: {node.attr}")
         if isinstance(node, ast.Name) and node.id.startswith("__"):
-            raise QueryRejected(f"disallowed name: {node.id}")
+            # the bare anonymous-traversal builder is the ONE sanctioned
+            # dunder name (TinkerPop's __; it carries no object internals)
+            if node.id != "__":
+                raise QueryRejected(f"disallowed name: {node.id}")
     return tree
 
 
@@ -126,9 +129,9 @@ class JanusGraphServer:
 
     # ------------------------------------------------------------ execution
     def _namespace(self, query: str, graph_name: Optional[str]) -> dict:
-        from janusgraph_tpu.core.traversal import P
+        from janusgraph_tpu.core.traversal import P, __ as _anon
 
-        ns = {"P": P}
+        ns = {"P": P, "__": _anon}
         name = graph_name or self.default_graph
         g = self.manager.get_graph(name)
         if g is None:

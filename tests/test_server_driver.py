@@ -287,3 +287,52 @@ def test_hmac_token_format_robust():
         t = auth.issue_token("u|ser", "pw")
         assert auth.verify_token(t) == "u|ser"
     creds_graph.close()
+
+
+def test_anonymous_traversal_bodies_over_the_wire(server):
+    """Lambdas are (rightly) rejected by the sandbox; the `__` builder is
+    the sanctioned body form (TinkerPop's anonymous traversal), covering
+    repeat/until, union, coalesce, where(traversal), and project by()."""
+    c = JanusGraphClient("127.0.0.1", server.port)
+    assert c.submit(
+        "g.V().has('name','hercules')"
+        ".repeat(__.out('father'), times=2).values('name').to_list()"
+    ) == ["saturn"]
+    assert sorted(c.submit(
+        "g.V().has('name','hercules')"
+        ".union(__.out('father'), __.out('mother')).values('name').to_list()"
+    )) == ["alcmene", "jupiter"]
+    assert c.submit(
+        "g.V().has('name','hercules')"
+        ".coalesce(__.out('pet'), __.out('father')).values('name').to_list()"
+    ) == ["jupiter"]
+    assert c.submit(
+        "g.V().where(__.out('battled')).values('name').to_list()"
+    ) == ["hercules"]
+    assert c.submit(
+        "g.V().has('name','hercules')"
+        ".repeat(__.out('father'), until=__.not_(__.out('father')))"
+        ".values('name').to_list()"
+    ) == ["saturn"]
+    # other dunder names stay rejected
+    from janusgraph_tpu.driver.client import RemoteError
+
+    with pytest.raises(RemoteError, match="disallowed"):
+        c.submit("__import__('os')")
+
+
+def test_anonymous_builder_in_python_api():
+    from janusgraph_tpu.core import gods
+    from janusgraph_tpu.core.graph import open_graph
+    from janusgraph_tpu.core.traversal import __
+
+    g = open_graph()
+    gods.load(g)
+    t = g.traversal()
+    out = (
+        t.V().has("name", "hercules")
+        .project("name", "battles").by("name").by(__.out("battled").count_())
+        .next()
+    )
+    assert out == {"name": "hercules", "battles": 3}
+    g.close()
