@@ -28,7 +28,13 @@ def _encode(obj: Any):
     # unless elements actually flow through
     from janusgraph_tpu.core.elements import Edge, Vertex, VertexProperty
 
-    if obj is None or isinstance(obj, (str, bool)):
+    if obj is None or isinstance(obj, bool):
+        return obj
+    if isinstance(obj, str):
+        from janusgraph_tpu.core.attributes import Char
+
+        if isinstance(obj, Char):  # str subclass — must stay typed
+            return {"@type": "janusgraph:Char", "@value": str(obj)}
         return obj
     if isinstance(obj, int):
         return {"@type": "g:Int64", "@value": obj}
@@ -78,7 +84,35 @@ def _encode(obj: Any):
         return {"@type": "g:List", "@value": [_encode(v) for v in obj]}
     if isinstance(obj, set):
         return {"@type": "g:Set", "@value": [_encode(v) for v in obj]}
-    # numpy scalars and anything float-like
+    # temporal + framework datatypes (reference: JanusGraphSONModule
+    # registers typed serializers for its attribute vocabulary)
+    import datetime as _dt
+
+    from janusgraph_tpu.core.attributes import Instant
+
+    if isinstance(obj, Instant):
+        return {
+            "@type": "janusgraph:Instant",
+            "@value": {"seconds": obj.seconds, "nanos": obj.nanos},
+        }
+    if isinstance(obj, _dt.datetime):
+        return {"@type": "g:Date", "@value": obj.isoformat()}
+    if isinstance(obj, _dt.timedelta):
+        # integer fields: float total_seconds() drops microseconds once the
+        # magnitude exceeds ~2^53 us
+        return {
+            "@type": "g:Duration",
+            "@value": {
+                "days": obj.days,
+                "seconds": obj.seconds,
+                "micros": obj.microseconds,
+            },
+        }
+    if isinstance(obj, _dt.date):
+        return {"@type": "g:LocalDate", "@value": obj.isoformat()}
+    if isinstance(obj, _dt.time):
+        return {"@type": "g:LocalTime", "@value": obj.isoformat()}
+    # numpy scalars/arrays and anything float-like
     try:
         import numpy as np
 
@@ -86,6 +120,18 @@ def _encode(obj: Any):
             return {"@type": "g:Int64", "@value": int(obj)}
         if isinstance(obj, np.floating):
             return {"@type": "g:Double", "@value": float(obj)}
+        if isinstance(obj, np.ndarray) and obj.dtype.kind in "biuf":
+            # numeric/bool dtypes only: tolist() of datetime64/complex/bytes
+            # arrays is not JSON-representable — those fall to the string
+            # fallback rather than 500ing the whole response
+            return {
+                "@type": "janusgraph:NdArray",
+                "@value": {
+                    "dtype": str(obj.dtype),
+                    "shape": list(obj.shape),
+                    "data": obj.ravel().tolist(),
+                },
+            }
     except ImportError:  # pragma: no cover
         pass
     return str(obj)
@@ -124,6 +170,37 @@ def _decode(obj: Any):
         return {_decode(k): _decode(val) for k, val in zip(it, it)}
     if t == "janusgraph:RelationIdentifier":
         return RelationIdentifier.parse(v["relationId"])
+    if t == "janusgraph:Instant":
+        from janusgraph_tpu.core.attributes import Instant
+
+        return Instant(int(v["seconds"]), int(v["nanos"]))
+    if t == "janusgraph:Char":
+        from janusgraph_tpu.core.attributes import Char
+
+        return Char(v)
+    if t == "g:Date":
+        import datetime as _dt
+
+        return _dt.datetime.fromisoformat(v)
+    if t == "g:Duration":
+        import datetime as _dt
+
+        return _dt.timedelta(
+            days=int(v["days"]), seconds=int(v["seconds"]),
+            microseconds=int(v["micros"]),
+        )
+    if t == "g:LocalDate":
+        import datetime as _dt
+
+        return _dt.date.fromisoformat(v)
+    if t == "g:LocalTime":
+        import datetime as _dt
+
+        return _dt.time.fromisoformat(v)
+    if t == "janusgraph:NdArray":
+        import numpy as np
+
+        return np.asarray(v["data"], dtype=v["dtype"]).reshape(v["shape"])
     if t == "g:Vertex":
         data = {
             "id": _decode(v["id"]),

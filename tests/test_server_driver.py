@@ -336,3 +336,79 @@ def test_anonymous_builder_in_python_api():
     )
     assert out == {"name": "hercules", "battles": 3}
     g.close()
+
+
+def test_typed_graphson_roundtrip_new_datatypes(manager, server):
+    """Framework datatypes survive the wire TYPED, not stringified
+    (reference: JanusGraphSONModule registered serializers)."""
+    import numpy as np
+    from datetime import timedelta
+
+    from janusgraph_tpu.core.attributes import Char, Instant
+
+    g = manager.get_graph("graph")
+    mgmt = g.management()
+    mgmt.make_property_key("born", Instant)
+    mgmt.make_property_key("grade", Char)
+    mgmt.make_property_key("scores", np.ndarray)
+    mgmt.make_property_key("dur", timedelta)
+    tx = g.new_transaction()
+    v = tx.add_vertex(name="typed")
+    v.property("born", Instant(1000, 5))
+    v.property("grade", Char("B"))
+    v.property("scores", np.array([1.5, 2.5]))
+    v.property("dur", timedelta(seconds=90))
+    tx.commit()
+
+    c = JanusGraphClient("127.0.0.1", server.port)
+    vm = c.submit("g.V().has('name','typed').value_map().to_list()")[0]
+    assert vm["born"] == [Instant(1000, 5)]
+    assert vm["grade"] == ["B"] and isinstance(vm["grade"][0], Char)
+    np.testing.assert_array_equal(vm["scores"][0], [1.5, 2.5])
+    assert vm["dur"] == [timedelta(seconds=90)]
+
+
+def test_graphbinary_typed_roundtrip_new_datatypes():
+    """The binary codec keeps the same typed vocabulary as GraphSON."""
+    import numpy as np
+    from datetime import date, datetime, time as dtime, timedelta
+
+    from janusgraph_tpu.core.attributes import Char, Instant
+    from janusgraph_tpu.driver.graphbinary import binary_dumps, binary_loads
+
+    samples = [
+        Instant(1000, 5),
+        Char("Q"),
+        timedelta(days=200000, microseconds=1),  # lossy under float seconds
+        datetime(2026, 7, 30, 1, 2, 3, 4),
+        date(2026, 7, 30),
+        dtime(23, 59, 58, 999999),
+    ]
+    for v in samples:
+        got = binary_loads(binary_dumps(v))
+        assert got == v and type(got) is type(v), v
+    arr = np.arange(6, dtype=np.float32).reshape(2, 3)
+    got = binary_loads(binary_dumps(arr))
+    assert got.dtype == arr.dtype and got.shape == arr.shape
+    np.testing.assert_array_equal(got, arr)
+    # non-numeric dtypes degrade to strings, never crash
+    weird = np.array([b"x"], dtype="|S1")
+    assert isinstance(binary_loads(binary_dumps(weird)), str)
+
+
+def test_graphson_duration_lossless_and_weird_arrays():
+    import json
+
+    import numpy as np
+    from datetime import timedelta
+
+    from janusgraph_tpu.driver.graphson import graphson_dumps, graphson_loads
+
+    big = timedelta(days=200000, microseconds=1)
+    assert graphson_loads(graphson_dumps(big)) == big
+    # datetime64/complex arrays must not 500 the response
+    for weird in (
+        np.array(["2026-01-01"], dtype="datetime64[s]"),
+        np.array([1 + 2j]),
+    ):
+        json.loads(graphson_dumps(weird))  # serializes without raising
