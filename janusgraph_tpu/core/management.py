@@ -367,6 +367,48 @@ class ManagementSystem:
     def indexes(self) -> List[IndexDefinition]:
         return list(self.graph.indexes.values())
 
+    def print_schema(self) -> str:
+        """Formatted schema overview (reference:
+        ManagementSystem.printSchema — property keys, labels, indexes)."""
+        lines = ["--- property keys ---"]
+        for pk in sorted(self.property_keys(), key=lambda e: e.name):
+            lines.append(
+                f"{pk.name:<24} {pk.data_type.__name__:<12} "
+                f"{pk.cardinality.name}"
+            )
+        lines.append("--- edge labels ---")
+        for el in sorted(self.edge_labels(), key=lambda e: e.name):
+            sk = ""
+            if el.sort_key:
+                names = [
+                    self.graph.schema_cache.get_by_id(k).name
+                    for k in el.sort_key
+                ]
+                sk = f" sortKey={','.join(names)}"
+            lines.append(
+                f"{el.name:<24} {el.multiplicity.name}"
+                f"{' unidirected' if el.unidirected else ''}{sk}"
+            )
+        lines.append("--- vertex labels ---")
+        for vl in sorted(self.vertex_labels(), key=lambda e: e.name):
+            flags = []
+            if vl.partitioned:
+                flags.append("partitioned")
+            if vl.static:
+                flags.append("static")
+            lines.append(f"{vl.name:<24} {' '.join(flags)}")
+        lines.append("--- indexes ---")
+        for idx in sorted(self.indexes(), key=lambda i: i.name):
+            kind = "mixed" if idx.mixed else "composite"
+            keys = ",".join(
+                self.graph.schema_cache.get_by_id(k).name for k in idx.key_ids
+            )
+            extra = " unique" if getattr(idx, "unique", False) else ""
+            lines.append(
+                f"{idx.name:<24} {kind:<10} [{keys}] {idx.status}{extra}"
+            )
+        return "\n".join(lines)
+
     def _all_schema(self):
         return self.graph.load_all_schema_elements()
 

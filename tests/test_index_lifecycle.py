@@ -165,3 +165,19 @@ def test_status_survives_reopen():
     g2 = open_graph({"schema.default": "auto"}, store_manager=sm)
     assert g2.indexes["ki"].status == "DISABLED"
     g2.close()
+
+
+def test_print_schema_overview():
+    """reference: ManagementSystem.printSchema formatted output."""
+    from janusgraph_tpu.core import gods
+    from janusgraph_tpu.core.graph import open_graph
+
+    g = open_graph()
+    gods.load(g)
+    out = g.management().print_schema()
+    assert "--- property keys ---" in out
+    assert "name" in out and "battled" in out
+    assert "sortKey=time" in out           # battled's vertex-centric index
+    assert "composite" in out and "ENABLED" in out
+    assert "titan" in out
+    g.close()
