@@ -218,7 +218,17 @@ def ell_aggregate(
     )
     parts = []
     for idx, w, valid, rowseg, num_slots in pack.buckets:
-        m = msgs_ext[idx]  # (rows, c) or (rows, c, k)
+        # gather via a FLAT 1-D index then reshape: identical HLO semantics
+        # to msgs_ext[idx], but the (rows, 1) 2-D gather shape compiles
+        # pathologically on TPU (measured 197s for a 667k-row cap-1 bucket
+        # vs 0.5s flat; run throughput is the same ~140M gathers/s)
+        flat = idx.reshape(-1)
+        if msgs_ext.ndim == 1:
+            m = jnp.take(msgs_ext, flat).reshape(idx.shape)
+        else:
+            m = jnp.take(msgs_ext, flat, axis=0).reshape(
+                idx.shape + msgs_ext.shape[1:]
+            )
         if m.ndim == 3:
             w_ = w[:, :, None]
             valid_ = valid[:, :, None]

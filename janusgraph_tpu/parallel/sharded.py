@@ -460,6 +460,9 @@ class ShardedExecutor:
         self._sharded_cache: Dict[object, ShardedCSR] = {}
         self._channel_views: "OrderedDict" = OrderedDict()
         self._device_cache: Dict[Tuple[object, str], object] = {}
+        # (cache_key, op) -> {metric_key: combiner_op}; recorded when the
+        # shard body is traced (see TPUExecutor._metric_ops)
+        self._metric_ops: Dict[Tuple, Dict[str, str]] = {}
 
     def comm_stats(self, undirected: bool = False) -> Dict[str, object]:
         """Per-superstep exchange volume in elements per shard. The a2a
@@ -727,6 +730,9 @@ class ShardedExecutor:
             new_state, metrics = program.apply(
                 state, agg_v, step, memory_in, view, jnp
             )
+            self._metric_ops[(program.cache_key(), op)] = {
+                k: mop for k, (mop, _v) in metrics.items()
+            }
             # barrier: global aggregator reduction over the mesh
             reduced = {}
             for k, (mop, v) in metrics.items():
@@ -794,10 +800,16 @@ class ShardedExecutor:
         def run_span(state, mem, steps_done0, limit, g):
             def cond(carry):
                 _s, m, steps_done = carry
+                # terminate() is consulted AFTER each superstep, never
+                # before the first (at steps_done == 0 the aggregators are
+                # identity-seeded placeholders) — mirrors TPUExecutor
                 return jnp.logical_and(
                     steps_done < limit,
-                    jnp.logical_not(
-                        program.terminate_device(m, steps_done, jnp)
+                    jnp.logical_or(
+                        steps_done == 0,
+                        jnp.logical_not(
+                            program.terminate_device(m, steps_done, jnp)
+                        ),
                     ),
                 )
 
@@ -958,11 +970,27 @@ class ShardedExecutor:
                 return {
                     k: np.asarray(v)[: sc.real_n] for k, v in state.items()
                 }
-            step_fn = self._superstep_fn(program, op, sc)
-            state, mem = step_fn(
-                state, jnp.asarray(0, jnp.int32), mem0, gargs
-            )
-            steps_done = 1
+            # learn apply's aggregator pytree by abstract trace (records
+            # each metric's monoid op, no XLA compile), seed missing keys
+            # with the monoid identity, and run superstep 0 INSIDE the
+            # fused executable — one compile per program instead of two
+            # (mirrors TPUExecutor._run_fused)
+            mkey = (program.cache_key(), op)
+            if mkey not in self._metric_ops:
+                step_fn = self._superstep_fn(program, op, sc)
+                self.jax.eval_shape(
+                    step_fn, state, jnp.asarray(0, jnp.int32), mem0, gargs
+                )
+            mops = self._metric_ops[mkey]
+            mem = {
+                k: (
+                    mem0[k]
+                    if k in mem0
+                    else jnp.asarray(Combiner.IDENTITY[mops[k]], jnp.float32)
+                )
+                for k in mops
+            }
+            steps_done = 0
 
         fn = self._fused_fn(program, op, sc)
         while steps_done < max_iter:
