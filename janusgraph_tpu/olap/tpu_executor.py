@@ -55,6 +55,52 @@ class _DeviceGraph:
         )
 
 
+class _TracedView:
+    """The graph view handed to program.message/apply inside a compiled
+    superstep: static ints from the host-side view template, array fields
+    rebound to the traced `_graph_args` pytree leaves."""
+
+    __slots__ = (
+        "num_vertices", "local_num_vertices", "global_offset", "num_edges",
+        "active", "out_degree", "in_src", "in_dst_seg", "out_dst",
+        "out_src_seg", "in_edge_weight", "out_edge_weight",
+    )
+
+    def __init__(self, tmpl, arrs):
+        self.num_vertices = tmpl.num_vertices
+        self.local_num_vertices = tmpl.local_num_vertices
+        self.global_offset = tmpl.global_offset
+        self.num_edges = tmpl.num_edges
+        self.active = arrs["active"]
+        self.out_degree = arrs["out_degree"]
+        self.in_src = arrs["in_src"]
+        self.in_dst_seg = arrs["in_dst_seg"]
+        self.out_dst = arrs["out_dst"]
+        self.out_src_seg = arrs["out_src_seg"]
+        self.in_edge_weight = arrs.get("in_w")
+        self.out_edge_weight = arrs.get("out_w")
+
+
+class _PackView:
+    """ELLPack-shaped facade over traced bucket arrays (duck-typed for
+    ell_aggregate: .buckets / .unpermute / .has_weight)."""
+
+    __slots__ = ("buckets", "unpermute", "has_weight")
+
+    def __init__(self, bucket_args, bucket_slots, unpermute, has_weight):
+        if len(bucket_args) != len(bucket_slots):
+            raise ValueError(
+                f"graph-args bucket count {len(bucket_args)} != compiled "
+                f"bucket metadata {len(bucket_slots)} (pack drift)"
+            )
+        self.buckets = [
+            (b["idx"], b["w"], b["valid"], b.get("rowseg"), ns)
+            for b, ns in zip(bucket_args, bucket_slots)
+        ]
+        self.unpermute = unpermute
+        self.has_weight = has_weight
+
+
 def _segment_ids(indptr: np.ndarray, m: int) -> np.ndarray:
     """indptr -> per-edge destination segment ids (repeat encoding)."""
     from janusgraph_tpu import native
@@ -285,25 +331,71 @@ class TPUExecutor:
                 self._segsum_plan("out")
 
     # ------------------------------------------------------------ superstep
-    def _superstep_body(self, program: VertexProgram, op: str, channel: str = None):
-        """Build the (un-jitted) superstep function for one combiner monoid
-        (and, for channel-switching programs, one named edge channel —
-        channel steps always aggregate over the channel's ELL pack)."""
-
-        jnp = self.jnp
+    def _graph_args(self, program: VertexProgram, op: str, channel: str = None):
+        """The device-array pytree a compiled superstep consumes as an
+        ARGUMENT. Closing over device arrays would embed them as constants
+        in the lowered module — at s22 that is a >1GB HLO payload the
+        tunneled remote-compile endpoint rejects outright (HTTP 413), and
+        constant-folding it is where the pathological compile time went."""
         g = self.g
-        n = g.local_num_vertices
-        identity = Combiner.IDENTITY[op]
+        view = {
+            "active": g.active,
+            "out_degree": g.out_degree,
+            "in_src": g.in_src,
+            "in_dst_seg": g.in_dst_seg,
+            "out_dst": g.out_dst,
+            "out_src_seg": g.out_src_seg,
+        }
+        if g.in_edge_weight is not None:
+            view["in_w"] = g.in_edge_weight
+        if g.out_edge_weight is not None:
+            view["out_w"] = g.out_edge_weight
+        args = {"view": view}
+        strategy, pack = self._resolve_pack(program, op, channel)
+        if strategy == "ell":
+            buckets = []
+            for idx, w, valid, rowseg, _ns in pack.buckets:
+                b = {"idx": idx, "w": w, "valid": valid}
+                if rowseg is not None:
+                    b["rowseg"] = rowseg
+                buckets.append(b)
+            args["ell"] = buckets
+            args["unpermute"] = pack.unpermute
+        return args
+
+    def _resolve_pack(self, program: VertexProgram, op: str, channel: str = None):
+        """(strategy, ELLPack-or-None) for one combiner monoid + edge view —
+        the single source of truth shared by `_graph_args` (which ships the
+        pack's arrays) and `_superstep_body` (which captures its static
+        bucket metadata), so the two can never disagree on bucket count."""
         strategy = self._resolve_strategy(op, program.undirected)
+        pack = None
         if channel is not None:
             strategy = "ell"
             pack = self._channel_pack(program, channel)
         elif strategy == "ell":
             pack = self._ell_pack(program.undirected)
-        elif strategy == "pallas":
-            plans = [( "in", self._segsum_plan("in"))]
+        return strategy, pack
+
+    def _superstep_body(self, program: VertexProgram, op: str, channel: str = None):
+        """Build the (un-jitted) superstep function for one combiner monoid
+        (and, for channel-switching programs, one named edge channel —
+        channel steps always aggregate over the channel's ELL pack). The
+        returned function takes the graph-array pytree (`_graph_args`) as
+        its final argument; only static metadata is captured by closure."""
+
+        jnp = self.jnp
+        n = self.g.local_num_vertices
+        tmpl = self.g
+        identity = Combiner.IDENTITY[op]
+        strategy, pack_meta = self._resolve_pack(program, op, channel)
+        if strategy == "pallas":
+            plans = [("in", self._segsum_plan("in"))]
             if program.undirected:
                 plans.append(("out", self._segsum_plan("out")))
+        elif strategy == "ell":
+            bucket_slots = [b[4] for b in pack_meta.buckets]
+            has_weight = pack_meta.has_weight
 
         def aggregate(outgoing, src_idx, dst_seg, weight):
             msgs = outgoing[src_idx]
@@ -313,14 +405,14 @@ class TPUExecutor:
                 msgs = msgs + (weight[:, None] if msgs.ndim == 2 else weight)
             return _segment_reduce(jnp, op, msgs, dst_seg, n)
 
-        def pallas_aggregate(outgoing):
+        def pallas_aggregate(outgoing, gv):
             from janusgraph_tpu.olap.kernels import pallas_sorted_segment_sum
 
             def one(orientation, plan):
                 if orientation == "in":
-                    src_idx, weight = g.in_src, g.in_edge_weight
+                    src_idx, weight = gv.in_src, gv.in_edge_weight
                 else:
-                    src_idx, weight = g.out_dst, g.out_edge_weight
+                    src_idx, weight = gv.out_dst, gv.out_edge_weight
                 msgs = outgoing[src_idx]
                 if program.edge_transform == EdgeTransform.MUL_WEIGHT and weight is not None:
                     msgs = msgs * weight
@@ -335,21 +427,27 @@ class TPUExecutor:
                 total = total + one(orientation, plan)
             return total
 
-        def superstep(state, superstep_idx, memory_in):
-            outgoing = program.message(state, superstep_idx, g, jnp)
+        def superstep(state, superstep_idx, memory_in, gargs):
+            gv = _TracedView(tmpl, gargs["view"])
             from janusgraph_tpu.olap.kernels import ell_aggregate
 
+            outgoing = program.message(state, superstep_idx, gv, jnp)
             if strategy == "ell":
+                pv = _PackView(
+                    gargs["ell"], bucket_slots, gargs["unpermute"], has_weight
+                )
                 agg = ell_aggregate(
-                    jnp, pack, outgoing, op, program.edge_transform
+                    jnp, pv, outgoing, op, program.edge_transform
                 )
             elif strategy == "pallas" and outgoing.ndim == 1:
-                agg = pallas_aggregate(outgoing)
+                agg = pallas_aggregate(outgoing, gv)
             else:
-                agg = aggregate(outgoing, g.in_src, g.in_dst_seg, g.in_edge_weight)
+                agg = aggregate(
+                    outgoing, gv.in_src, gv.in_dst_seg, gv.in_edge_weight
+                )
                 if program.undirected:
                     rev = aggregate(
-                        outgoing, g.out_dst, g.out_src_seg, g.out_edge_weight
+                        outgoing, gv.out_dst, gv.out_src_seg, gv.out_edge_weight
                     )
                     if op == Combiner.SUM:
                         agg = agg + rev
@@ -360,7 +458,7 @@ class TPUExecutor:
             # vertices with no in-edges hold the identity, matching the CPU
             # oracle's "no message received" semantics
             new_state, metrics = program.apply(
-                state, agg, superstep_idx, memory_in, g, jnp
+                state, agg, superstep_idx, memory_in, gv, jnp
             )
             self._metric_ops[(program.cache_key(), op)] = {
                 k: o for k, (o, _v) in metrics.items()
@@ -395,7 +493,7 @@ class TPUExecutor:
         jax, jnp = self.jax, self.jnp
         body = self._superstep_body(program, op)
 
-        def run_span(state, mem, steps_done0, limit):
+        def run_span(state, mem, steps_done0, limit, gargs):
             def cond(carry):
                 _s, m, steps_done = carry
                 # Fulgora semantics: terminate() is consulted AFTER each
@@ -414,7 +512,7 @@ class TPUExecutor:
 
             def loop(carry):
                 s, m, steps_done = carry
-                s2, m2 = body(s, steps_done, m)
+                s2, m2 = body(s, steps_done, m, gargs)
                 return (s2, m2, steps_done + 1)
 
             return jax.lax.while_loop(cond, loop, (state, mem, steps_done0))
@@ -503,7 +601,11 @@ class TPUExecutor:
             if mkey not in self._metric_ops:
                 body = self._superstep_body(program, op)
                 self.jax.eval_shape(
-                    body, state, jnp.asarray(0, jnp.int32), mem0
+                    body,
+                    state,
+                    jnp.asarray(0, jnp.int32),
+                    mem0,
+                    self._graph_args(program, op),
                 )
             mops = self._metric_ops[mkey]
             mem = {
@@ -517,6 +619,7 @@ class TPUExecutor:
             steps_done = 0
 
         fn = self._fused_fn(program, op)
+        gargs = self._graph_args(program, op)
         while steps_done < max_iter:
             limit = max_iter
             if checkpoint_every:
@@ -526,6 +629,7 @@ class TPUExecutor:
                 mem,
                 jnp.asarray(steps_done, jnp.int32),
                 jnp.asarray(limit, jnp.int32),
+                gargs,
             )
             new_steps = int(steps_dev)
             terminated = new_steps < limit or new_steps == steps_done
@@ -576,9 +680,13 @@ class TPUExecutor:
         steps_done = start_step
         for step in range(start_step, program.max_iterations):
             op = program.combiner_for(step)
-            fn = self._superstep_fn(program, op, program.channel_for(step))
+            ch = program.channel_for(step)
+            fn = self._superstep_fn(program, op, ch)
             state, metrics = fn(
-                state, jnp.asarray(step, dtype=jnp.int32), device_memory
+                state,
+                jnp.asarray(step, dtype=jnp.int32),
+                device_memory,
+                self._graph_args(program, op, ch),
             )
             device_memory = {
                 k: metrics.get(k, device_memory.get(k)) for k in
