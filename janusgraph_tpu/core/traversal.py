@@ -956,6 +956,89 @@ class GraphTraversal:
         )
         return self
 
+    def match(self, *patterns) -> "GraphTraversal":
+        """match(__.as_('a').out('father').as_('b'), ...) — declarative
+        constraint-join pattern matching (TinkerPop MatchStep subset:
+        connected patterns, solved in bound-tag-first order). Each pattern
+        must start at an as_() tag; a trailing as_() binds (or checks) the
+        end tag; a pattern without a trailing as_() is an existence filter
+        on its start binding. Solutions are emitted as tag bindings on the
+        outgoing traversers, read back with select(). The reference gets
+        MatchStep from TinkerPop and optimizes around it
+        (JanusGraphLocalQueryOptimizerStrategy.java); here the step itself
+        is part of the DSL."""
+        if not patterns:
+            raise ValueError("match() needs at least one pattern")
+        compiled = []
+        for pat in patterns:
+            chain = getattr(pat, "_chain", None)
+            if not chain or chain[0][0] != "as_":
+                raise ValueError(
+                    "match() patterns must start with __.as_(tag)"
+                )
+            start = chain[0][1][0]
+            mid = list(chain[1:])
+            end = None
+            if mid and mid[-1][0] == "as_":
+                end = mid[-1][1][0]
+                mid = mid[:-1]
+            compiled.append(
+                (start, end, self._sub_steps(AnonymousTraversal(tuple(mid))))
+            )
+
+        def _key(o):
+            return ("el", o.id) if isinstance(o, (Vertex, Edge)) else ("v", o)
+
+        def step(ts):
+            out = []
+            for t in ts:
+                base = dict(t.tags) if t.tags else {}
+                # seed the current object as the first pattern's start ONLY
+                # when no pattern start is already tag-bound — a pre-tagged
+                # traverser supplies its own anchor (TinkerPop computed-start)
+                if not any(s in base for s, _e, _st in compiled):
+                    base[compiled[0][0]] = t.obj
+                frontier = [base]
+                pending = list(compiled)
+                while pending and frontier:
+                    pick = next(
+                        (
+                            i
+                            for i, (s, _e, _m) in enumerate(pending)
+                            if all(s in b for b in frontier)
+                        ),
+                        None,
+                    )
+                    if pick is None:
+                        raise ValueError(
+                            "match() patterns are disconnected: no "
+                            "remaining pattern starts at a bound tag "
+                            f"(pending: {[s for s, _e, _m in pending]})"
+                        )
+                    start, end, steps = pending.pop(pick)
+                    nxt = []
+                    for b in frontier:
+                        seed = Traverser(b[start], tags=b)
+                        for r in self._apply_steps(steps, [seed]):
+                            rb = dict(r.tags) if r.tags else dict(b)
+                            if end is None:
+                                nxt.append(rb)
+                                break  # existence filter: one hit suffices
+                            if end in rb and _key(rb[end]) != _key(r.obj):
+                                continue  # contradicts an earlier binding
+                            rb = dict(rb)
+                            rb[end] = r.obj
+                            nxt.append(rb)
+                    frontier = nxt
+                for b in frontier:
+                    out.append(
+                        Traverser(t.obj, prev=t.prev, path=t.path, tags=b)
+                    )
+            return out
+
+        self._add(step, name=f"match[{len(patterns)}]")
+        return self
+
     def is_(self, arg) -> "GraphTraversal":
         # AdjacentVertexIs rewrite: `.out(lbl).is_(v)` -> adjacency lookup
         if isinstance(arg, Vertex):

@@ -270,3 +270,108 @@ def test_anonymous_traversal_cannot_execute(g):
     anon = GraphTraversal(g, None)
     with pytest.raises(QueryError):
         anon.to_list()
+
+
+# ---- match() ----------------------------------------------------------------
+
+def test_match_grandfather(g):
+    from janusgraph_tpu.core.traversal import __
+
+    rows = (
+        g.V().has("name", "hercules")
+        .match(
+            __.as_("me").out("father").as_("dad"),
+            __.as_("dad").out("father").as_("granddad"),
+        )
+        .select("granddad").by("name")
+        .to_list()
+    )
+    assert rows == ["saturn"]
+
+
+def test_match_existence_filter_pattern(g):
+    from janusgraph_tpu.core.traversal import __
+
+    # gods who both live somewhere and have a brother
+    rows = (
+        g.V().has_label("god")
+        .match(
+            __.as_("g").out("lives").as_("home"),
+            __.as_("g").out("brother"),
+        )
+        .select("g").by("name")
+        .dedup()
+        .to_list()
+    )
+    assert sorted(rows) == ["jupiter", "neptune", "pluto"]
+
+
+def test_match_binding_consistency(g):
+    from janusgraph_tpu.core.traversal import __
+
+    # 'brother of my brother' constrained back to an existing binding:
+    # jupiter's brothers' brothers include jupiter himself
+    rows = (
+        g.V().has("name", "jupiter")
+        .match(
+            __.as_("a").out("brother").as_("b"),
+            __.as_("b").out("brother").as_("a"),
+        )
+        .select("b").by("name")
+        .dedup()
+        .to_list()
+    )
+    assert sorted(rows) == ["neptune", "pluto"]
+
+
+def test_match_out_of_order_patterns_solved_by_boundness(g):
+    from janusgraph_tpu.core.traversal import __
+
+    # first pattern's start is the incoming object; second listed pattern
+    # references 'dad' before the pattern that binds it — the solver must
+    # pick the bound-start pattern first
+    rows = (
+        g.V().has("name", "hercules")
+        .match(
+            __.as_("me").out("father").as_("dad"),
+            __.as_("granddad").has("name", "saturn"),
+            __.as_("dad").out("father").as_("granddad"),
+        )
+        .select("dad").by("name")
+        .to_list()
+    )
+    assert rows == ["jupiter"]
+
+
+def test_match_disconnected_raises(g):
+    from janusgraph_tpu.core.traversal import __
+
+    with pytest.raises(ValueError):
+        g.V().has("name", "hercules").match(
+            __.as_("me").out("father").as_("dad"),
+            __.as_("stranger").out("lives").as_("where"),
+        ).to_list()
+
+
+def test_match_requires_as_start(g):
+    from janusgraph_tpu.core.traversal import __
+
+    with pytest.raises(ValueError):
+        g.V().match(__.out("father")).to_list()
+
+
+def test_match_pretagged_anchor(g):
+    from janusgraph_tpu.core.traversal import __
+
+    # the traverser arrives pre-tagged; the first listed pattern's start is
+    # bound by a LATER pattern — the current object must NOT be force-bound
+    rows = (
+        g.V().has("name", "hercules").as_("me")
+        .match(
+            __.as_("dad").out("father").as_("granddad"),
+            __.as_("me").out("father").as_("dad"),
+        )
+        .select("granddad").by("name")
+        .to_list()
+    )
+    assert rows == ["saturn"]
