@@ -19,6 +19,7 @@ import uuid as _uuid
 import zlib
 from dataclasses import dataclass
 from datetime import date as _date, datetime, time as _time, timedelta, timezone
+from decimal import Decimal
 from enum import Enum
 from typing import Any, Dict, Optional, Tuple, Type
 
@@ -602,6 +603,109 @@ def _framework_enums():
     ]
 
 
+class BigInt(int):
+    """Schema marker for arbitrary-precision integer property keys (the
+    reference's BigInteger data type, distinct from Long). Plain ints
+    outside the int64 range auto-promote to this codec on write."""
+
+
+class BigIntegerSerializer(AttributeSerializer):
+    """Arbitrary-precision signed integer (reference: StandardSerializer
+    registers BigInteger, StandardSerializer.java:78-132). Plain form:
+    minimal two's-complement big-endian. Ordered form: a length-class
+    prefix byte, then sign-adjusted magnitude — longer positive magnitudes
+    sort after shorter ones, longer negative magnitudes before, so byte
+    order == numeric order for |v| < 2**1016."""
+
+    type_id = 38
+    py_type = BigInt  # plain int dispatches here explicitly beyond int64
+
+    def write(self, value) -> bytes:
+        length = max(1, (value.bit_length() + 8) // 8)
+        return value.to_bytes(length, "big", signed=True)
+
+    def read(self, data: bytes):
+        return int.from_bytes(data, "big", signed=True)
+
+    def write_ordered(self, value) -> bytes:
+        if value == 0:
+            return b"\x80"
+        mag = abs(value)
+        m = mag.to_bytes((mag.bit_length() + 7) // 8, "big")
+        if len(m) > 0x7F:
+            raise SerializerError("ordered BigInteger limited to 127 bytes")
+        if value > 0:
+            return bytes([0x80 + len(m)]) + m
+        return bytes([0x7F - len(m)]) + bytes(255 - b for b in m)
+
+    def read_ordered(self, data: bytes):
+        b0 = data[0]
+        if b0 == 0x80:
+            return 0
+        if b0 > 0x80:
+            n = b0 - 0x80
+            return int.from_bytes(data[1 : 1 + n], "big")
+        n = 0x7F - b0
+        mag = int.from_bytes(bytes(255 - b for b in data[1 : 1 + n]), "big")
+        return -mag
+
+
+class DecimalSerializer(AttributeSerializer):
+    """decimal.Decimal (reference BigDecimal). Plain form: the exact string
+    representation (scale-preserving round trip). Ordered form: sign class
+    byte, then ordered-int64 decimal exponent and 0x01+digit bytes with a
+    terminator (all complemented for negatives) — byte order == numeric
+    order; decoding the ordered form yields a numerically-equal Decimal in
+    minimal form (trailing zeros are not preserved there)."""
+
+    type_id = 39
+    py_type = Decimal
+
+    def write(self, value) -> bytes:
+        return str(value).encode("ascii")
+
+    def read(self, data: bytes):
+        return Decimal(data.decode("ascii"))
+
+    def write_ordered(self, value) -> bytes:
+        if value.is_nan() or value.is_infinite():
+            raise SerializerError("ordered Decimal must be finite")
+        if value == 0:
+            return b"\x80"
+        # strip trailing zeros by hand: Decimal.normalize() rounds to the
+        # context precision (28 digits), conflating longer values
+        sign, digits, exp = value.as_tuple()
+        while len(digits) > 1 and digits[-1] == 0:
+            digits = digits[:-1]
+            exp += 1
+        # value = 0.D1D2.. * 10**E  with D1 != 0
+        e = exp + len(digits)
+        ekey = struct.pack(">Q", e + (1 << 63))
+        dkey = bytes(1 + d for d in digits) + b"\x00"
+        if sign == 0:
+            return b"\xc0" + ekey + dkey
+        return b"\x40" + bytes(255 - b for b in ekey + dkey)
+
+    def read_ordered(self, data: bytes):
+        from decimal import Decimal
+
+        b0 = data[0]
+        if b0 == 0x80:
+            return Decimal(0)
+        body = data[1:]
+        neg = b0 == 0x40
+        if neg:
+            body = bytes(255 - b for b in body)
+        e = struct.unpack(">Q", body[:8])[0] - (1 << 63)
+        digits = []
+        for b in body[8:]:
+            if b == 0:
+                break
+            digits.append(b - 1)
+        d = Decimal((1 if neg else 0, tuple(digits), e - len(digits)))
+        return d
+
+
 #: first id available to register_enum / register for user-defined types
 USER_TYPE_ID_START = 100
 
@@ -637,6 +741,8 @@ class Serializer:
             LocalDateSerializer,
             LocalTimeSerializer,
             StringListSerializer,
+            BigIntegerSerializer,
+            DecimalSerializer,
         ):
             self.register(cls())
         for tid, dt in _ARRAY_IDS:
@@ -673,6 +779,14 @@ class Serializer:
             if value and all(isinstance(x, str) for x in value):
                 return self._by_id[StringListSerializer.type_id]
             return self._by_id[FloatListSerializer.type_id]
+        # ints beyond 64 bits promote to the BigInteger codec (the plain
+        # int slot belongs to LongSerializer, whose struct.pack would raise)
+        if (
+            isinstance(value, int)
+            and not isinstance(value, bool)
+            and not (-(1 << 63) <= value < (1 << 63))
+        ):
+            return self._by_id[BigIntegerSerializer.type_id]
         # bool is a subclass of int: check exact type first, then walk MRO
         ser = self._by_type.get(type(value))
         if ser is not None:

@@ -58,9 +58,11 @@ def _attribute_types() -> Dict[str, type]:
     import uuid as _uuid
     from datetime import date as _d, datetime as _dt, time as _t, timedelta
 
+    from decimal import Decimal as _Decimal
+
     import numpy as np
 
-    from janusgraph_tpu.core.attributes import Char, Instant
+    from janusgraph_tpu.core.attributes import BigInt as _BigInt, Char, Instant
 
     return {
         "Boolean": bool,
@@ -83,6 +85,8 @@ def _attribute_types() -> Dict[str, type]:
         "LocalDate": _d,
         "LocalTime": _t,
         "Array": np.ndarray,
+        "BigInteger": _BigInt,
+        "Decimal": _Decimal,
     }
 
 

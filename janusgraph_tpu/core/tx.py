@@ -196,8 +196,14 @@ class Transaction:
             pk.data_type is not bool and isinstance(value, bool)
         ):
             # ints are acceptable doubles (common literal convenience)
+            from janusgraph_tpu.core.attributes import BigInt
+
             if pk.data_type is float and isinstance(value, int) and not isinstance(value, bool):
                 value = float(value)
+            elif pk.data_type is BigInt and isinstance(value, int) and not isinstance(value, bool):
+                # plain ints promote to declared BigInteger keys (and the
+                # codec reads back plain int, so round-trip writes stay legal)
+                value = BigInt(value)
             else:
                 raise SchemaViolationError(
                     f"property {key} expects {pk.data_type.__name__}, "

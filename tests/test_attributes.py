@@ -217,3 +217,61 @@ def test_instant_datetime_conversion():
     dt = datetime(2026, 7, 29, 12, 0, 0, 500, tzinfo=timezone.utc)
     i = Instant.of(dt)
     assert i.to_datetime() == dt
+
+
+# ---- BigInteger / Decimal (reference: StandardSerializer BigInteger &
+# BigDecimal registrations, StandardSerializer.java:78-132) -------------------
+
+def test_bigint_roundtrip(ser):
+    for v in (2**64, -(2**100), 2**500, -(2**63) - 1, 1 << 63):
+        got, _ = ser.read_object(ser.write_object(v))
+        assert got == v
+
+
+def test_small_int_still_long(ser):
+    data = ser.write_object(42)
+    import struct
+    (tid,) = struct.unpack(">H", data[:2])
+    assert tid == 2  # LongSerializer keeps the int64 range
+
+
+def test_bigint_ordered_sorts(ser):
+    from janusgraph_tpu.core.attributes import BigIntegerSerializer
+    big = BigIntegerSerializer()
+    vals = [-(2**200), -(2**70), -(1 << 63) - 5, -1, 0, 1,
+            (1 << 63) + 5, 2**70, 2**200]
+    encs = [big.write_ordered(v) for v in vals]
+    assert encs == sorted(encs)
+    for v, e in zip(vals, encs):
+        assert big.read_ordered(e) == v
+
+
+def test_decimal_roundtrip_preserves_scale(ser):
+    from decimal import Decimal
+    for s in ("1.50", "-0.003", "12345678901234567890.123456789", "0", "1E+10"):
+        v = Decimal(s)
+        got, _ = ser.read_object(ser.write_object(v))
+        assert got == v and str(got) == s
+
+
+def test_decimal_ordered_sorts(ser):
+    from decimal import Decimal
+    from janusgraph_tpu.core.attributes import DecimalSerializer
+    d = DecimalSerializer()
+    vals = [Decimal(s) for s in
+            ("-1000.5", "-2.5", "-2.4999", "-0.001", "0", "0.0005",
+             "1", "1.0001", "2.5", "99", "100", "1E+20")]
+    encs = [d.write_ordered(v) for v in vals]
+    assert encs == sorted(encs)
+    for v, e in zip(vals, encs):
+        assert d.read_ordered(e) == v  # numerically equal
+
+
+def test_decimal_ordered_beyond_context_precision(ser):
+    from decimal import Decimal
+    from janusgraph_tpu.core.attributes import DecimalSerializer
+    d = DecimalSerializer()
+    a = Decimal("1." + "0" * 29 + "1")
+    b = Decimal("1." + "0" * 29 + "2")
+    ea, eb = d.write_ordered(a), d.write_ordered(b)
+    assert ea < eb and d.read_ordered(ea) == a and d.read_ordered(eb) == b

@@ -368,3 +368,23 @@ def test_sort_key_edges_ordered(gods_graph):
     edges = gods_graph.traversal().V().has("name", "hercules").out_e("battled").to_list()
     times = [e.value("time") for e in edges]
     assert times == sorted(times)
+
+
+def test_bigint_schema_key_accepts_plain_int():
+    from janusgraph_tpu.core.attributes import BigInt
+    from janusgraph_tpu.core.graph import open_graph
+
+    graph = open_graph()
+    graph.management().make_property_key("bignum", data_type=BigInt)
+    tx = graph.new_transaction()
+    v = tx.add_vertex()
+    v.property("bignum", 2**100)  # plain int promotes
+    tx.commit()
+    tx2 = graph.new_transaction()
+    got = tx2.get_vertex(v.id).value("bignum")
+    assert got == 2**100
+    # read-back value (plain int) is legal to write again
+    w = tx2.add_vertex()
+    w.property("bignum", got)
+    tx2.commit()
+    graph.close()
