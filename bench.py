@@ -305,14 +305,55 @@ def host_pagerank_edges_per_sec(csr, iters: int = 5, damping: float = 0.85) -> f
     return iters * csr.num_edges / dt
 
 
+def _cached_rmat_csr(scale, edge_factor, t0):
+    """rmat_csr with an on-disk cache of the final CSR arrays: s23
+    generation costs ~170s and the graph is seed-deterministic, so ladder
+    re-runs (supervisor retries, end-of-round driver) should pay it once."""
+    import numpy as np
+
+    from janusgraph_tpu.olap.csr import CSRGraph
+    from janusgraph_tpu.olap.generators import rmat_csr
+
+    cache_dir = os.path.join(_REPO_DIR, ".bench_cache")
+    path = os.path.join(cache_dir, f"rmat_s{scale}_ef{edge_factor}.npz")
+    if os.path.exists(path):
+        try:
+            z = np.load(path)
+            return CSRGraph(
+                vertex_ids=z["vertex_ids"],
+                out_indptr=z["out_indptr"],
+                out_dst=z["out_dst"],
+                in_indptr=z["in_indptr"],
+                in_src=z["in_src"],
+                out_degree=z["out_degree"],
+            )
+        except Exception as e:
+            _hb(f"graph cache read failed ({e}) — regenerating", t0)
+    csr = rmat_csr(scale, edge_factor)
+    try:
+        os.makedirs(cache_dir, exist_ok=True)
+        np.savez(
+            path + ".tmp.npz",
+            vertex_ids=csr.vertex_ids,
+            out_indptr=csr.out_indptr,
+            out_dst=csr.out_dst,
+            in_indptr=csr.in_indptr,
+            in_src=csr.in_src,
+            out_degree=csr.out_degree,
+        )
+        os.replace(path + ".tmp.npz", path)
+    except Exception as e:
+        _hb(f"graph cache write failed ({e})", t0)
+    return csr
+
+
 def _bench_scale(jax, platform, scale, edge_factor, pr_iters, strategy, t0):
     """One ladder rung: generate, transfer, compile, run, report."""
-    from janusgraph_tpu.olap.generators import rmat_csr
     from janusgraph_tpu.olap.programs import PageRankProgram, ShortestPathProgram
     from janusgraph_tpu.olap.tpu_executor import TPUExecutor
 
     g0 = time.perf_counter()
-    csr = rmat_csr(scale, edge_factor)
+    csr = _cached_rmat_csr(scale, edge_factor, t0)
     gen_s = time.perf_counter() - g0
     _hb(f"s{scale}: graph ready |V|={csr.num_vertices} |E|={csr.num_edges} "
         f"({gen_s:.1f}s)", t0)
