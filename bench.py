@@ -332,8 +332,11 @@ def _cached_rmat_csr(scale, edge_factor, t0):
     csr = rmat_csr(scale, edge_factor)
     try:
         os.makedirs(cache_dir, exist_ok=True)
+        # pid-unique tmp: concurrent ladder runs (supervisor retry + driver)
+        # must not interleave writes into one tmp file before the rename
+        tmp = f"{path}.{os.getpid()}.tmp.npz"
         np.savez(
-            path + ".tmp.npz",
+            tmp,
             vertex_ids=csr.vertex_ids,
             out_indptr=csr.out_indptr,
             out_dst=csr.out_dst,
@@ -341,7 +344,7 @@ def _cached_rmat_csr(scale, edge_factor, t0):
             in_src=csr.in_src,
             out_degree=csr.out_degree,
         )
-        os.replace(path + ".tmp.npz", path)
+        os.replace(tmp, path)
     except Exception as e:
         _hb(f"graph cache write failed ({e})", t0)
     return csr

@@ -83,7 +83,7 @@ def _load() -> Optional[ctypes.CDLL]:
         lib.segment_ids.argtypes = [ctypes.c_int64, ctypes.c_int64, I64, I32]
         lib.ell_fill.argtypes = [
             ctypes.c_int64, ctypes.c_int64, I64, I64, I32,
-            ctypes.c_void_p, I32, F32, F32,
+            ctypes.c_void_p, I32, ctypes.c_void_p, ctypes.c_void_p,
         ]
         lib.rmat_edges.argtypes = [
             ctypes.c_int64, ctypes.c_int64, ctypes.c_uint64,
@@ -148,23 +148,25 @@ def segment_ids(indptr: np.ndarray, m: int) -> np.ndarray:
 
 
 def ell_fill(cap, starts, degs, sorted_src, sorted_w, idx, wmat, valid) -> bool:
-    """Fill one ELL bucket in place. Returns False if native is unavailable
-    (caller keeps its numpy path)."""
+    """Fill one ELL bucket in place (wmat/valid may be None for unweighted
+    packs — the device kernel then relies on the sentinel slot alone).
+    Returns False if native is unavailable (caller keeps its numpy path)."""
     lib = _load()
     if lib is None:
         return False
     rows = len(starts)
-    wptr = (
-        sorted_w.ctypes.data_as(ctypes.c_void_p)
-        if sorted_w is not None
-        else None
-    )
+
+    def _fptr(a):
+        return (
+            a.ctypes.data_as(ctypes.c_void_p) if a is not None else None
+        )
+
     lib.ell_fill(
         rows, cap,
         np.ascontiguousarray(starts, dtype=np.int64),
         np.ascontiguousarray(degs, dtype=np.int64),
         np.ascontiguousarray(sorted_src, dtype=np.int32),
-        wptr, idx, wmat, valid,
+        _fptr(sorted_w), idx, _fptr(wmat), _fptr(valid),
     )
     return True
 

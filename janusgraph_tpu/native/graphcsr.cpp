@@ -75,8 +75,8 @@ void ell_fill(int64_t rows, int64_t cap,
     int64_t d = degs[r];
     for (int64_t j = 0; j < d; ++j) {
       idx[base + j] = sorted_src[s + j];
-      wmat[base + j] = sorted_w ? sorted_w[s + j] : 1.0f;
-      valid[base + j] = 1.0f;
+      if (wmat) wmat[base + j] = sorted_w ? sorted_w[s + j] : 1.0f;
+      if (valid) valid[base + j] = 1.0f;
     }
   }
 }

@@ -39,7 +39,8 @@ from janusgraph_tpu.olap.vertex_program import Combiner, EdgeTransform
 def fill_ell_rows(cap, starts_r, degs_r, src32, w32, idx, wmat, valid):
     """Fill one ELL bucket's (rows, cap) matrices in place — native fast
     path with a numpy fallback. Callers pre-fill idx with the sentinel and
-    wmat/valid with zeros."""
+    wmat/valid with zeros; wmat/valid are None for unweighted packs (the
+    sentinel slot alone provides the monoid identity on device)."""
     from janusgraph_tpu import native
 
     if native.ell_fill(cap, starts_r, degs_r, src32, w32, idx, wmat, valid):
@@ -56,8 +57,10 @@ def fill_ell_rows(cap, starts_r, degs_r, src32, w32, idx, wmat, valid):
     )
     edge_pos = np.repeat(starts_r, degs_r) + col_ids
     idx[row_ids, col_ids] = src32[edge_pos]
-    valid[row_ids, col_ids] = 1.0
-    wmat[row_ids, col_ids] = w32[edge_pos] if w32 is not None else 1.0
+    if valid is not None:
+        valid[row_ids, col_ids] = 1.0
+    if wmat is not None:
+        wmat[row_ids, col_ids] = w32[edge_pos] if w32 is not None else 1.0
 
 
 def split_rows(
@@ -152,8 +155,15 @@ class ELLPack:
                 starts_r, degs_r, rowseg = starts_m, deg_m, None
             rows = len(starts_r)
             idx = np.full((rows, c), self.sentinel, dtype=np.int32)
-            wmat = np.zeros((rows, c), dtype=np.float32)
-            valid = np.zeros((rows, c), dtype=np.float32)
+            # unweighted packs carry idx ONLY: padded slots point at the
+            # sentinel, which reads the monoid identity — wmat/valid would
+            # triple HBM footprint and transfer for nothing (s23: 2.3GB
+            # -> 0.76GB measured)
+            if self.has_weight:
+                wmat = np.zeros((rows, c), dtype=np.float32)
+                valid = np.zeros((rows, c), dtype=np.float32)
+            else:
+                wmat = valid = None
             fill_ell_rows(c, starts_r, degs_r, src32, w32, idx, wmat, valid)
             self.buckets.append(
                 (
@@ -184,8 +194,8 @@ class ELLPack:
         self.buckets = [
             (
                 put(jnp.asarray(i)),
-                put(jnp.asarray(w)),
-                put(jnp.asarray(v)),
+                put(jnp.asarray(w)) if w is not None else None,
+                put(jnp.asarray(v)) if v is not None else None,
                 put(jnp.asarray(rs)) if rs is not None else None,
                 ns,
             )
@@ -193,6 +203,18 @@ class ELLPack:
         ]
         self.unpermute = put(jnp.asarray(self.unpermute))
         return self
+
+
+def flat_take(jnp, tab, idx):
+    """Gather rows/values of `tab` by a 2-D index matrix via a FLAT 1-D
+    take + reshape. Identical semantics to tab[idx], but the (rows, 1) 2-D
+    gather shape compiles pathologically on TPU (measured 197s for a
+    667k-row cap-1 bucket vs 0.5s flat; run throughput is the same ~140M
+    gathers/s). Shared by the single-chip and sharded ELL paths."""
+    flat = idx.reshape(-1)
+    if tab.ndim == 1:
+        return jnp.take(tab, flat).reshape(idx.shape)
+    return jnp.take(tab, flat, axis=0).reshape(idx.shape + tab.shape[1:])
 
 
 def ell_aggregate(
@@ -218,27 +240,23 @@ def ell_aggregate(
     )
     parts = []
     for idx, w, valid, rowseg, num_slots in pack.buckets:
-        # gather via a FLAT 1-D index then reshape: identical HLO semantics
-        # to msgs_ext[idx], but the (rows, 1) 2-D gather shape compiles
-        # pathologically on TPU (measured 197s for a 667k-row cap-1 bucket
-        # vs 0.5s flat; run throughput is the same ~140M gathers/s)
-        flat = idx.reshape(-1)
-        if msgs_ext.ndim == 1:
-            m = jnp.take(msgs_ext, flat).reshape(idx.shape)
-        else:
-            m = jnp.take(msgs_ext, flat, axis=0).reshape(
-                idx.shape + msgs_ext.shape[1:]
-            )
-        if m.ndim == 3:
-            w_ = w[:, :, None]
-            valid_ = valid[:, :, None]
-        else:
-            w_, valid_ = w, valid
-        if edge_transform == EdgeTransform.MUL_WEIGHT:
-            m = m * w_
-        elif edge_transform == EdgeTransform.ADD_WEIGHT:
-            m = m + w_
-        m = jnp.where(valid_ > 0, m, identity)
+        m = flat_take(jnp, msgs_ext, idx)
+        if w is not None:
+            # weighted pack: apply the transform, then force padded slots
+            # back to the identity (a transform can disturb it, e.g.
+            # identity*0 = nan for MIN's +inf)
+            if m.ndim == 3:
+                w_ = w[:, :, None]
+                valid_ = valid[:, :, None]
+            else:
+                w_, valid_ = w, valid
+            if edge_transform == EdgeTransform.MUL_WEIGHT:
+                m = m * w_
+            elif edge_transform == EdgeTransform.ADD_WEIGHT:
+                m = m + w_
+            m = jnp.where(valid_ > 0, m, identity)
+        # unweighted pack: padded slots index the sentinel, which already
+        # reads the identity — no mask needed
         if op == Combiner.SUM:
             r = m.sum(axis=1)
         elif op == Combiner.MIN:

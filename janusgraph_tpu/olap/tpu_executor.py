@@ -94,7 +94,7 @@ class _PackView:
                 f"bucket metadata {len(bucket_slots)} (pack drift)"
             )
         self.buckets = [
-            (b["idx"], b["w"], b["valid"], b.get("rowseg"), ns)
+            (b["idx"], b.get("w"), b.get("valid"), b.get("rowseg"), ns)
             for b, ns in zip(bucket_args, bucket_slots)
         ]
         self.unpermute = unpermute
@@ -179,9 +179,11 @@ class TPUExecutor:
     ):
         """Estimate the ELL pack's device footprint WITHOUT building it:
         per-vertex slot count = next-pow2(degree) (capped, supernodes
-        row-split at ~1x), x 3 arrays (idx i32 + weight f32 + valid f32).
-        Undirected programs pack BOTH orientations, so their estimate uses
-        in+out degree. Computed from the degree histogram in one numpy pass."""
+        row-split at ~1x). Unweighted graphs ship idx (i32) only — padded
+        slots read the identity through the sentinel; weighted graphs add
+        weight + valid f32 matrices. Undirected programs pack BOTH
+        orientations, so their estimate uses in+out degree. Computed from
+        the degree histogram in one numpy pass."""
         deg = np.diff(csr.in_indptr).astype(np.int64)
         edges = csr.num_edges
         if undirected:
@@ -195,9 +197,10 @@ class TPUExecutor:
         over = deg > max_capacity
         if over.any():
             slots += int((deg[over] - max_capacity).sum())
+        per_slot = 12 if csr.in_edge_weight is not None else 4
         return {
             "slots": slots,
-            "bytes": slots * 12,
+            "bytes": slots * per_slot,
             "pad_ratio": slots / max(1, edges),
         }
 
@@ -355,7 +358,11 @@ class TPUExecutor:
         if strategy == "ell":
             buckets = []
             for idx, w, valid, rowseg, _ns in pack.buckets:
-                b = {"idx": idx, "w": w, "valid": valid}
+                b = {"idx": idx}
+                if w is not None:
+                    b["w"] = w
+                if valid is not None:
+                    b["valid"] = valid
                 if rowseg is not None:
                     b["rowseg"] = rowseg
                 buckets.append(b)

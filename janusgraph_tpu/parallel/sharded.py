@@ -693,9 +693,11 @@ class ShardedExecutor:
                 pad = jnp.full((1,) + tail, identity, dtype=outgoing.dtype)
                 tab_ext = jnp.concatenate([tab, pad], axis=0)
                 parts = []
+                from janusgraph_tpu.olap.kernels import flat_take
+
                 for bucket, n_slots in zip(g["ell_buckets"], sc.ell_meta):
                     idx, wm, va = bucket[0], bucket[1], bucket[2]
-                    m = tab_ext[idx]                       # (rows, c[, k])
+                    m = flat_take(jnp, tab_ext, idx)       # (rows, c[, k])
                     if m.ndim == 3:
                         wm_, va_ = wm[:, :, None], va[:, :, None]
                     else:
