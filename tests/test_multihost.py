@@ -1,0 +1,47 @@
+"""Multi-host glue: single-process no-op init, global mesh construction,
+partition-range assignment, and a ShardedExecutor run over the multihost
+mesh (virtual 8-device CPU mesh stands in for the real DCN topology —
+SURVEY.md §2.4.3)."""
+
+import numpy as np
+
+from janusgraph_tpu.olap.cpu_executor import CPUExecutor
+from janusgraph_tpu.olap.generators import rmat_csr
+from janusgraph_tpu.olap.programs import PageRankProgram
+from janusgraph_tpu.parallel.multihost import (
+    global_mesh,
+    host_partition_range,
+    init_multihost,
+)
+from janusgraph_tpu.parallel.sharded import ShardedExecutor
+
+
+def test_single_process_init_is_noop():
+    assert init_multihost() == 0
+    assert init_multihost(num_processes=1, process_id=0) == 0
+
+
+def test_multiprocess_without_coordinator_raises():
+    import pytest
+
+    with pytest.raises(ValueError):
+        init_multihost(num_processes=4, process_id=1)
+
+
+def test_partition_ranges_cover_exactly():
+    for nproc in (1, 3, 8):
+        covered = []
+        for pid in range(nproc):
+            lo, hi = host_partition_range(32, pid, nproc)
+            covered.extend(range(lo, hi))
+        assert covered == list(range(32))
+
+
+def test_sharded_executor_on_global_mesh():
+    mesh = global_mesh()
+    assert mesh.devices.size == 8  # conftest forces 8 virtual devices
+    csr = rmat_csr(10, 8)
+    ex = ShardedExecutor(csr, mesh=mesh)
+    got = ex.run(PageRankProgram(max_iterations=6, tol=0.0))
+    want = CPUExecutor(csr).run(PageRankProgram(max_iterations=6, tol=0.0))
+    np.testing.assert_allclose(got["rank"], want["rank"], rtol=1e-5, atol=1e-8)
