@@ -76,6 +76,18 @@ def _load() -> Optional[ctypes.CDLL]:
             np.ctypeslib.ndpointer(np.int32, flags="C_CONTIGUOUS"),
             np.ctypeslib.ndpointer(np.float32, flags="C_CONTIGUOUS"),
         )
+
+        class F32OrNull:
+            """float32 C-contiguous ndpointer that also accepts None —
+            keeps ctypes' dtype/contiguity validation for real arrays
+            instead of a raw c_void_p passthrough."""
+
+            @classmethod
+            def from_param(cls, obj):
+                if obj is None:
+                    return None
+                return F32.from_param(obj)
+
         lib.build_csr.argtypes = [
             ctypes.c_int64, ctypes.c_int64, I32, I32,
             I64, I32, I64, I64, I32, I64,
@@ -83,7 +95,7 @@ def _load() -> Optional[ctypes.CDLL]:
         lib.segment_ids.argtypes = [ctypes.c_int64, ctypes.c_int64, I64, I32]
         lib.ell_fill.argtypes = [
             ctypes.c_int64, ctypes.c_int64, I64, I64, I32,
-            ctypes.c_void_p, I32, ctypes.c_void_p, ctypes.c_void_p,
+            F32OrNull, I32, F32OrNull, F32OrNull,
         ]
         lib.rmat_edges.argtypes = [
             ctypes.c_int64, ctypes.c_int64, ctypes.c_uint64,
@@ -155,18 +167,12 @@ def ell_fill(cap, starts, degs, sorted_src, sorted_w, idx, wmat, valid) -> bool:
     if lib is None:
         return False
     rows = len(starts)
-
-    def _fptr(a):
-        return (
-            a.ctypes.data_as(ctypes.c_void_p) if a is not None else None
-        )
-
     lib.ell_fill(
         rows, cap,
         np.ascontiguousarray(starts, dtype=np.int64),
         np.ascontiguousarray(degs, dtype=np.int64),
         np.ascontiguousarray(sorted_src, dtype=np.int32),
-        _fptr(sorted_w), idx, _fptr(wmat), _fptr(valid),
+        sorted_w, idx, wmat, valid,
     )
     return True
 

@@ -98,6 +98,7 @@ class ShardedCSR:
             src, dst, w = edges
             src = np.asarray(src, dtype=np.int64)
             dst = np.asarray(dst, dtype=np.int64)
+            self.has_weight = w is not None
             w = (
                 np.asarray(w, dtype=np.float32)
                 if w is not None
@@ -108,6 +109,7 @@ class ShardedCSR:
             dst = np.repeat(
                 np.arange(n, dtype=np.int64), np.diff(csr.in_indptr)
             )
+            self.has_weight = csr.in_edge_weight is not None
             w = (
                 csr.in_edge_weight.astype(np.float32)
                 if csr.in_edge_weight is not None
@@ -341,8 +343,13 @@ class ShardedCSR:
             if N_rows == 0:
                 continue
             idx = np.full((S * N_rows, c), sentinel, dtype=np.int32)
-            wmat = np.zeros((S * N_rows, c), dtype=np.float32)
-            valid = np.zeros((S * N_rows, c), dtype=np.float32)
+            # unweighted: idx only — padded slots point at the message
+            # table's identity pad slot (mirrors olap/kernels.py ELLPack)
+            if self.has_weight:
+                wmat = np.zeros((S * N_rows, c), dtype=np.float32)
+                valid = np.zeros((S * N_rows, c), dtype=np.float32)
+            else:
+                wmat = valid = None
             # padded rows point at the dead slot (N_slots) and are dropped
             rowseg = np.full(S * N_rows, N_slots, dtype=np.int32)
             for s in range(S):
@@ -358,8 +365,14 @@ class ShardedCSR:
                     self.in_weight[s * Em : (s + 1) * Em], dtype=np.float32
                 )
                 bidx = idx[s * N_rows : s * N_rows + rows]
-                bw = wmat[s * N_rows : s * N_rows + rows]
-                bv = valid[s * N_rows : s * N_rows + rows]
+                bw = (
+                    wmat[s * N_rows : s * N_rows + rows]
+                    if wmat is not None else None
+                )
+                bv = (
+                    valid[s * N_rows : s * N_rows + rows]
+                    if valid is not None else None
+                )
                 if not native.ell_fill(c, starts_r, degs_r, src32, w32, bidx, bw, bv):
                     total = int(degs_r.sum())
                     if total:
@@ -369,8 +382,10 @@ class ShardedCSR:
                         )
                         edge_pos = np.repeat(starts_r, degs_r) + col_ids
                         bidx[row_ids, col_ids] = src32[edge_pos]
-                        bv[row_ids, col_ids] = 1.0
-                        bw[row_ids, col_ids] = w32[edge_pos]
+                        if bv is not None:
+                            bv[row_ids, col_ids] = 1.0
+                        if bw is not None:
+                            bw[row_ids, col_ids] = w32[edge_pos]
                 rowseg[s * N_rows : s * N_rows + rows] = rseg.astype(np.int32)
                 unpermute[s * Np + members] = (
                     out_off + np.arange(len(members))
@@ -540,7 +555,11 @@ class ShardedExecutor:
             host = getattr(sc, name)
             if name == "ell_buckets":
                 arr = tuple(
-                    tuple(self.jax.device_put(a, sharding) for a in bucket)
+                    tuple(
+                        self.jax.device_put(a, sharding)
+                        if a is not None else None
+                        for a in bucket
+                    )
                     for bucket in host
                 )
             else:
@@ -698,15 +717,18 @@ class ShardedExecutor:
                 for bucket, n_slots in zip(g["ell_buckets"], sc.ell_meta):
                     idx, wm, va = bucket[0], bucket[1], bucket[2]
                     m = flat_take(jnp, tab_ext, idx)       # (rows, c[, k])
-                    if m.ndim == 3:
-                        wm_, va_ = wm[:, :, None], va[:, :, None]
-                    else:
-                        wm_, va_ = wm, va
-                    if program.edge_transform == EdgeTransform.MUL_WEIGHT:
-                        m = m * wm_
-                    elif program.edge_transform == EdgeTransform.ADD_WEIGHT:
-                        m = m + wm_
-                    m = jnp.where(va_ > 0, m, identity)
+                    if wm is not None:
+                        # weighted pack: transform, then re-assert the
+                        # identity on padded slots (see kernels.py)
+                        if m.ndim == 3:
+                            wm_, va_ = wm[:, :, None], va[:, :, None]
+                        else:
+                            wm_, va_ = wm, va
+                        if program.edge_transform == EdgeTransform.MUL_WEIGHT:
+                            m = m * wm_
+                        elif program.edge_transform == EdgeTransform.ADD_WEIGHT:
+                            m = m + wm_
+                        m = jnp.where(va_ > 0, m, identity)
                     r = reduce_cols(m, 1)
                     if n_slots is not None:
                         # fold supernode row partials (rows-sized reduce);
