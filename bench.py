@@ -33,7 +33,11 @@ Env knobs: BENCH_SCALES (default "16,20,22,23" — graph500-s23 north
 star last), BENCH_EDGE_FACTOR (16), PR_ITERS (20), BENCH_STRATEGY
 (auto|ell|segment|pallas), BENCH_BUDGET_S (supervisor budget, default
 2700), BENCH_INIT_TIMEOUT_S (cap on backend init before declaring the
-tunnel dead, default 1500), BENCH_CPU_SCALE (fallback scale, 16).
+tunnel dead, default 1500), BENCH_CPU_SCALE (fallback scale, 16),
+BENCH_EXTRAS_SCALE (default 20 — the ladder rung that additionally runs
+the CC / peer-pressure / 3-hop-count headline workloads; must appear in
+BENCH_SCALES to fire, and its compile time comes out of BENCH_BUDGET_S
+before the s23 rung).
 """
 
 import json
@@ -366,6 +370,8 @@ def _cached_rmat_csr(scale, edge_factor, t0):
 
 def _bench_scale(jax, platform, scale, edge_factor, pr_iters, strategy, t0):
     """One ladder rung: generate, transfer, compile, run, report."""
+    import numpy as np
+
     from janusgraph_tpu.olap.programs import PageRankProgram, ShortestPathProgram
     from janusgraph_tpu.olap.tpu_executor import TPUExecutor
 
@@ -431,6 +437,60 @@ def _bench_scale(jax, platform, scale, edge_factor, pr_iters, strategy, t0):
         "ell_bytes": ell_fp["bytes"],
         "ell_pad_ratio": round(ell_fp["pad_ratio"], 3),
     })
+
+    # Remaining BASELINE.md headline workloads (configs #2/#4/#5) at ONE
+    # ladder scale: ConnectedComponent, PeerPressure label propagation
+    # (phase-alternating -> host-loop path), and the 3-hop
+    # TraversalVertexProgram-analogue count. Gated so the budget cost is
+    # bounded; compile cache amortizes re-runs.
+    if scale == int(os.environ.get("BENCH_EXTRAS_SCALE", "20")):
+        from janusgraph_tpu.olap.programs import (
+            ConnectedComponentsProgram,
+            PeerPressureProgram,
+            TraversalCountProgram,
+        )
+
+        def _workload(name, prog, result_key=None, post=None, **runkw):
+            ex.run(prog)  # compile + warm
+            r0 = time.perf_counter()
+            res = ex.run(prog, **runkw)
+            if result_key is not None:
+                np.asarray(res[result_key])  # ensure fetched before stopping
+            wall = round(time.perf_counter() - r0, 3)
+            line = {
+                "stage": "workload", "workload": name,
+                "platform": platform, "scale": scale, "wall_s": wall,
+            }
+            if post is not None:
+                line.update(post(res))
+            _hb(f"s{scale}: {name} {wall}s", t0)
+            _emit(line)  # one line per workload: a later hang loses nothing
+
+        # min-label propagation converges within the component diameter;
+        # 64 covers R-MAT's O(log n) diameter with a wide margin at any
+        # ladder scale, and terminate_device stops the loop at fixpoint
+        _workload(
+            "connected_components",
+            ConnectedComponentsProgram(max_iterations=64),
+            result_key="component",
+            post=lambda res: {
+                "components": int(len(np.unique(np.asarray(res["component"])))),
+                "iter_cap": 64,
+            },
+        )
+        # phase-alternating combiner -> host-loop path; sync_every matters
+        _workload(
+            "peer_pressure",
+            PeerPressureProgram(rounds=5),
+            result_key="cluster",
+            sync_every=5,
+        )
+        _workload(
+            "traversal_3hop_count",
+            TraversalCountProgram(hops=3),
+            result_key="count",
+            post=lambda res: {"paths": float(np.asarray(res["count"]).sum())},
+        )
     del ex, csr
 
 
