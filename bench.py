@@ -451,7 +451,7 @@ def _bench_scale(jax, platform, scale, edge_factor, pr_iters, strategy, t0):
         )
 
         def _workload(name, prog, result_key=None, post=None, **runkw):
-            ex.run(prog)  # compile + warm
+            ex.run(prog, **runkw)  # compile + warm the SAME configuration
             r0 = time.perf_counter()
             res = ex.run(prog, **runkw)
             if result_key is not None:

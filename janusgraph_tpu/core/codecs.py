@@ -83,6 +83,21 @@ class Multiplicity(IntEnum):
     ONE2ONE = 4
 
 
+class Consistency(IntEnum):
+    """Per-type consistency modifier (reference:
+    core/schema/ConsistencyModifier.java; applied via mgmt.setConsistency).
+    LOCK: commits touching relations of this type acquire consistent-key
+    locks with expected-value checks, serializing concurrent writers across
+    instances. FORK (edge labels only): modifying an existing edge deletes
+    it and writes a NEW relation id instead of updating in place, so
+    concurrent eventual-consistency modifications fork rather than
+    clobber."""
+
+    DEFAULT = 0
+    LOCK = 1
+    FORK = 2
+
+
 # category bytes
 _CAT_SYS_PROP = 0
 _CAT_USER_PROP = 1

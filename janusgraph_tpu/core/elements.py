@@ -126,7 +126,9 @@ class Relation(Element):
 
 
 class Edge(Relation):
-    __slots__ = ("out_vertex", "in_vertex", "_props", "_sort_key")
+    __slots__ = (
+        "out_vertex", "in_vertex", "_props", "_sort_key", "_replacement"
+    )
 
     def __init__(
         self,
@@ -144,6 +146,9 @@ class Edge(Relation):
         self.in_vertex = in_vertex
         self._props: Dict[int, object] = props or {}
         self._sort_key = sort_key
+        # set when a LOADED edge is rewritten by set_property: the live
+        # replacement relation this handle forwards further updates to
+        self._replacement: Optional["Edge"] = None
 
     @property
     def label(self) -> str:
@@ -165,8 +170,17 @@ class Edge(Relation):
     def property_values(self) -> Dict[str, object]:
         return {self.tx.schema_name(k): v for k, v in self._props.items()}
 
-    def set_property(self, key: str, value) -> None:
-        self.tx.set_edge_property(self, key, value)
+    def set_property(self, key: str, value) -> "Edge":
+        """Set an inline property. Loaded edges are rewritten (see
+        tx.set_edge_property); this handle then forwards further updates to
+        the live replacement, and the replacement is returned either way —
+        so chained e.set_property(...) calls compose."""
+        if self._replacement is not None:
+            return self._replacement.set_property(key, value)
+        live = self.tx.set_edge_property(self, key, value)
+        if live is not self:
+            self._replacement = live
+        return live
 
     @property
     def identifier(self) -> RelationIdentifier:

@@ -611,6 +611,10 @@ class JanusGraphTPU:
             tx_id = self.tx_log.next_tx_id()
             self.tx_log.precommit(tx_id, changes, tx.log_identifier or "")
         with self._commit_lock:
+            # -- 0.5 LOCK-consistency claims for mutated cells of
+            # LOCK-modified types; verified + released by btx.commit()
+            # (failure path: tx.commit's backend_tx.rollback releases)
+            self._register_consistency_locks(tx)
             # -- 1. vertex existence + label cells for new vertices
             for vid, label_id in tx._new_vertex_labels.items():
                 if vid in tx._removed_vertices:
@@ -774,40 +778,79 @@ class JanusGraphTPU:
             record(rel, added=False)
         return records
 
-    def _write_relation(self, tx: Transaction, rel, delete: bool) -> None:
+    def _relation_cells(self, tx: Transaction, rel):
+        """[(vertex-key, (column, value))] a relation serializes to — the
+        single encoding shared by the write path and the LOCK-consistency
+        expected-value computation, so they cannot drift."""
         es = self.edge_serializer
         if isinstance(rel, Edge):
             label = tx.schema_by_id(rel.type_id)
-            out_cell = es.write_edge(
-                rel.type_id,
-                Direction.OUT,
-                rel.in_vertex.id,
-                rel.id,
-                rel._sort_key,
-                rel._props or None,
-            )
-            cells = [(rel.out_vertex.id, out_cell)]
+            cells = [(
+                self.idm.get_key(rel.out_vertex.id),
+                es.write_edge(
+                    rel.type_id, Direction.OUT, rel.in_vertex.id,
+                    rel.id, rel._sort_key, rel._props or None,
+                ),
+            )]
             if not (isinstance(label, EdgeLabel) and label.unidirected):
-                in_cell = es.write_edge(
-                    rel.type_id,
-                    Direction.IN,
-                    rel.out_vertex.id,
-                    rel.id,
-                    rel._sort_key,
-                    rel._props or None,
-                )
-                cells.append((rel.in_vertex.id, in_cell))
-            for vid, cell in cells:
-                key = self.idm.get_key(vid)
-                if delete:
-                    tx.backend_tx.mutate_edges(key, [], [cell[0]])
+                cells.append((
+                    self.idm.get_key(rel.in_vertex.id),
+                    es.write_edge(
+                        rel.type_id, Direction.IN, rel.out_vertex.id,
+                        rel.id, rel._sort_key, rel._props or None,
+                    ),
+                ))
+            return cells
+        pk = tx.schema_by_id(rel.type_id)
+        card = (
+            pk.cardinality if isinstance(pk, PropertyKey) else Cardinality.SINGLE
+        )
+        return [(
+            self.idm.get_key(rel.vertex.id),
+            es.write_property(rel.type_id, rel.id, rel.value, card),
+        )]
+
+    def _register_consistency_locks(self, tx: Transaction) -> None:
+        """Register consistent-key lock claims for every mutated cell whose
+        type carries LOCK consistency (reference:
+        StandardJanusGraph.prepareCommit :561-605 acquiring edge locks via
+        BackendTransaction.acquireEdgeLock + ExpectedValueCheckingStore).
+        One claim per touched cell; the expected value comes from the tx's
+        own mutations — a deleted relation's cell must still hold its
+        observed encoding, a freshly added cell's column must be absent —
+        so a concurrent commit that changed any touched cell after this tx
+        read it fails the expected-value pass. Claim verification, the
+        cache-unwrapped expected-value re-read, and release all run inside
+        btx.commit()/rollback() (`_check_and_release_locks`)."""
+        from janusgraph_tpu.core.codecs import Consistency
+
+        # (key, cell column) -> expected value bytes | None (absent)
+        cells: dict = {}
+
+        def touch(rel, deleted: bool):
+            el = tx.schema_by_id(rel.type_id)
+            if getattr(el, "consistency", None) != Consistency.LOCK:
+                return
+            for key, (col, val) in self._relation_cells(tx, rel):
+                if deleted:
+                    cells[(key, col)] = val
                 else:
-                    tx.backend_tx.mutate_edges(key, [cell], [])
-        else:  # VertexProperty
-            pk = tx.schema_by_id(rel.type_id)
-            card = pk.cardinality if isinstance(pk, PropertyKey) else Cardinality.SINGLE
-            cell = es.write_property(rel.type_id, rel.id, rel.value, card)
-            key = self.idm.get_key(rel.vertex.id)
+                    cells.setdefault((key, col), None)
+
+        for rel in tx._deleted:
+            touch(rel, True)
+        for rels in tx._added.values():
+            for rel in rels:
+                if not rel.is_removed:
+                    touch(rel, False)
+        for (key, col) in sorted(cells):
+            val = cells[(key, col)]
+            tx.backend_tx.acquire_edge_lock(
+                key, col, expected=[(col, val)] if val is not None else []
+            )
+
+    def _write_relation(self, tx: Transaction, rel, delete: bool) -> None:
+        for key, cell in self._relation_cells(tx, rel):
             if delete:
                 tx.backend_tx.mutate_edges(key, [], [cell[0]])
             else:

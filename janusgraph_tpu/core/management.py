@@ -18,7 +18,12 @@ import time
 from enum import Enum
 from typing import List, Optional, Sequence, Tuple
 
-from janusgraph_tpu.core.codecs import Cardinality, Direction, Multiplicity
+from janusgraph_tpu.core.codecs import (
+    Cardinality,
+    Consistency,
+    Direction,
+    Multiplicity,
+)
 from janusgraph_tpu.core.ids import VertexIDType
 from janusgraph_tpu.core.schema import (
     EdgeLabel,
@@ -98,6 +103,42 @@ class ManagementSystem:
         el = EdgeLabel(sid, name, multiplicity, tuple(key_ids), unidirected)
         self._persist(el)
         return el
+
+    def set_consistency(self, name: str, consistency: Consistency):
+        """Attach a consistency modifier to a property key or edge label
+        (reference: ManagementSystem.setConsistency +
+        core/schema/ConsistencyModifier.java). LOCK makes commits touching
+        the type acquire consistent-key locks with expected-value checks;
+        FORK (edge labels only) turns in-place edge updates into
+        delete + re-add under a fresh relation id. The updated definition
+        is re-persisted and evicted cluster-wide."""
+        el = self.graph.schema_cache.get_by_name(name)
+        if el is None or not (el.is_property_key or el.is_edge_label):
+            raise SchemaViolationError(
+                f"{name} is not a property key or edge label"
+            )
+        consistency = Consistency(consistency)
+        if consistency is Consistency.FORK and not el.is_edge_label:
+            raise SchemaViolationError(
+                "FORK consistency applies only to edge labels "
+                "(reference: ConsistencyModifier.FORK)"
+            )
+        import dataclasses
+
+        updated = dataclasses.replace(el, consistency=consistency)
+        self._persist(updated)
+        self.graph.schema_cache.invalidate(name)
+        self.graph.schema_cache.invalidate_id(el.id)
+        self.graph.management_logger.broadcast_eviction(el.id)
+        return updated
+
+    def get_consistency(self, name: str) -> Consistency:
+        el = self.graph.schema_cache.get_by_name(name)
+        if el is None or not hasattr(el, "consistency"):
+            raise SchemaViolationError(
+                f"{name} is not a property key or edge label"
+            )
+        return el.consistency
 
     def make_vertex_label(
         self, name: str, partitioned: bool = False, static: bool = False

@@ -24,7 +24,12 @@ from typing import Dict, Optional, Tuple
 
 from janusgraph_tpu.core.attributes import Serializer
 from janusgraph_tpu.core.predicates import Geoshape
-from janusgraph_tpu.core.codecs import Cardinality, Multiplicity, TypeInfo
+from janusgraph_tpu.core.codecs import (
+    Cardinality,
+    Consistency,
+    Multiplicity,
+    TypeInfo,
+)
 from janusgraph_tpu.core.ids import IDManager, VertexIDType
 from janusgraph_tpu.exceptions import SchemaViolationError
 
@@ -102,6 +107,7 @@ class PropertyKey:
     name: str
     data_type: type
     cardinality: Cardinality = Cardinality.SINGLE
+    consistency: Consistency = Consistency.DEFAULT
 
     @property
     def is_property_key(self) -> bool:
@@ -116,6 +122,7 @@ class PropertyKey:
             "kind": "property",
             "dataType": _DATA_TYPE_NAMES[self.data_type],
             "cardinality": int(self.cardinality),
+            "consistency": int(self.consistency),
         }
 
     def type_info(self) -> TypeInfo:
@@ -132,6 +139,7 @@ class EdgeLabel:
     # property-key ids whose ordered fixed-width encodings form the sort key
     sort_key: Tuple[int, ...] = ()
     unidirected: bool = False
+    consistency: Consistency = Consistency.DEFAULT
 
     @property
     def is_property_key(self) -> bool:
@@ -147,6 +155,7 @@ class EdgeLabel:
             "multiplicity": int(self.multiplicity),
             "sortKey": list(self.sort_key),
             "unidirected": self.unidirected,
+            "consistency": int(self.consistency),
         }
 
     def type_info(self) -> TypeInfo:
@@ -217,7 +226,11 @@ def schema_element_from_definition(sid: int, name: str, d: dict):
     kind = d["kind"]
     if kind == "property":
         return PropertyKey(
-            sid, name, _DATA_TYPES[d["dataType"]], Cardinality(d["cardinality"])
+            sid,
+            name,
+            _DATA_TYPES[d["dataType"]],
+            Cardinality(d["cardinality"]),
+            Consistency(d.get("consistency", 0)),
         )
     if kind == "edge":
         return EdgeLabel(
@@ -226,6 +239,7 @@ def schema_element_from_definition(sid: int, name: str, d: dict):
             Multiplicity(d["multiplicity"]),
             tuple(d.get("sortKey", ())),
             d.get("unidirected", False),
+            Consistency(d.get("consistency", 0)),
         )
     if kind == "vertexlabel":
         return VertexLabel(sid, name, d.get("partitioned", False), d.get("static", False))

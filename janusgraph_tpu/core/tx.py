@@ -222,8 +222,18 @@ class Transaction:
             self._added[v.id].append(p)
         return p
 
-    def set_edge_property(self, e: Edge, key: str, value) -> None:
+    def set_edge_property(self, e: Edge, key: str, value) -> "Edge":
+        """Set an inline edge property. New edges mutate in place; LOADED
+        edges are rewritten as delete + re-add (edge properties live inside
+        the relation cell). A FORK-consistency label (reference:
+        ConsistencyModifier.FORK, mgmt.set_consistency) takes a FRESH
+        relation id so concurrent modifications fork into distinct edges
+        instead of clobbering one cell; other labels keep the relation id —
+        an in-place update of the same relation. Returns the live edge
+        (the replacement, for loaded edges)."""
         self._check_writable()
+        if getattr(e, "_replacement", None) is not None:
+            return self.set_edge_property(e._replacement, key, value)
         pk = self._property_key(key, value)
         if e.is_new:
             e._props[pk.id] = value
@@ -232,11 +242,33 @@ class Transaction:
             label = self.schema_by_id(e.type_id)
             if isinstance(label, EdgeLabel) and label.sort_key:
                 e._sort_key = self._build_sort_key(label, e._props)
-        else:
-            raise InvalidElementError(
-                "edge property mutation on loaded edges is not yet supported; "
-                "remove and re-add the edge", e
-            )
+            return e
+        from janusgraph_tpu.core.codecs import Consistency
+
+        label = self.schema_by_id(e.type_id)
+        new_props = dict(e._props or {})
+        new_props[pk.id] = value
+        self.remove_edge(e)
+        fork = (
+            isinstance(label, EdgeLabel)
+            and label.consistency == Consistency.FORK
+        )
+        rid = self.graph.id_assigner.assign_relation_id() if fork else e.id
+        sort_key = (
+            self._build_sort_key(label, new_props)
+            if isinstance(label, EdgeLabel) and label.sort_key
+            else b""
+        )
+        ne = Edge(
+            rid, e.type_id, e.out_vertex, e.in_vertex, self,
+            LifeCycle.NEW, new_props, sort_key,
+        )
+        with self._lock:
+            self._added[ne.out_vertex.id].append(ne)
+            if ne.in_vertex.id != ne.out_vertex.id:
+                self._added[ne.in_vertex.id].append(ne)
+        e._replacement = ne
+        return ne
 
     def remove_property(self, p: VertexProperty) -> None:
         self._check_writable()

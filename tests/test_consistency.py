@@ -1,0 +1,163 @@
+"""ConsistencyModifier (reference: core/schema/ConsistencyModifier.java,
+ManagementSystem.setConsistency): LOCK serializes concurrent writers of a
+type via the consistent-key locker with expected-value checks; FORK turns
+in-place edge updates into delete + re-add under a fresh relation id.
+Two JanusGraphTPU instances over ONE store manager stand in for two
+cluster nodes (SURVEY.md §4's multi-node-without-a-cluster technique)."""
+
+import pytest
+
+from janusgraph_tpu.core.codecs import Consistency
+from janusgraph_tpu.core.graph import open_graph
+from janusgraph_tpu.exceptions import SchemaViolationError
+from janusgraph_tpu.storage.inmemory import InMemoryStoreManager
+
+
+def test_consistency_roundtrip_and_validation():
+    g = open_graph()
+    g.management().make_property_key("serial", int)
+    g.management().make_edge_label("follows")
+    g.management().set_consistency("serial", Consistency.LOCK)
+    g.management().set_consistency("follows", Consistency.FORK)
+    assert g.management().get_consistency("serial") is Consistency.LOCK
+    assert g.management().get_consistency("follows") is Consistency.FORK
+    with pytest.raises(SchemaViolationError):
+        g.management().set_consistency("serial", Consistency.FORK)
+    g.close()
+
+
+def test_consistency_persists_across_reopen():
+    mgr = InMemoryStoreManager()
+    g = open_graph(store_manager=mgr)
+    g.management().make_property_key("serial", int)
+    g.management().set_consistency("serial", Consistency.LOCK)
+    g.close()
+    g2 = open_graph(store_manager=mgr)
+    assert g2.management().get_consistency("serial") is Consistency.LOCK
+    g2.close()
+
+
+def test_lock_consistency_detects_concurrent_write():
+    mgr = InMemoryStoreManager()
+    g1 = open_graph(store_manager=mgr)
+    g1.management().make_property_key("serial", int)
+    g1.management().set_consistency("serial", Consistency.LOCK)
+    tx = g1.new_transaction()
+    v = tx.add_vertex()
+    v.property("serial", 1)
+    tx.commit()
+
+    g2 = open_graph(store_manager=mgr)
+    # both instances read then write the same LOCK-consistency property
+    tx1 = g1.new_transaction()
+    tx2 = g2.new_transaction()
+    v1 = tx1.get_vertex(v.id)
+    v2 = tx2.get_vertex(v.id)
+    v1.property("serial", 2)
+    v2.property("serial", 3)
+    tx1.commit()  # first writer wins
+    with pytest.raises(Exception):
+        tx2.commit()  # claim/expected-value must reject the stale writer
+    g3 = open_graph(store_manager=mgr)
+    tx3 = g3.new_transaction()
+    assert tx3.get_vertex(v.id).value("serial") == 2
+    for g in (g1, g2, g3):
+        g.close()
+
+
+def test_lock_consistency_sequential_commits_ok():
+    mgr = InMemoryStoreManager()
+    g = open_graph(store_manager=mgr)
+    g.management().make_property_key("serial", int)
+    g.management().set_consistency("serial", Consistency.LOCK)
+    tx = g.new_transaction()
+    v = tx.add_vertex()
+    v.property("serial", 1)
+    tx.commit()
+    for i in (2, 3, 4):
+        txi = g.new_transaction()
+        txi.get_vertex(v.id).property("serial", i)
+        txi.commit()
+    assert g.new_transaction().get_vertex(v.id).value("serial") == 4
+    g.close()
+
+
+def _edge_between(tx, out_id, label):
+    from janusgraph_tpu.core.codecs import Direction
+
+    [e] = tx.get_vertex(out_id).edges(Direction.OUT, label)
+    return e
+
+
+def test_fork_edge_update_takes_new_relation_id():
+    g = open_graph()
+    mgmt = g.management()
+    mgmt.make_property_key("since", int)
+    mgmt.make_edge_label("follows")
+    mgmt.set_consistency("follows", Consistency.FORK)
+    tx = g.new_transaction()
+    a, b = tx.add_vertex(), tx.add_vertex()
+    e = tx.add_edge(a, "follows", b, since=1)
+    tx.commit()
+    old_id = e.id
+
+    tx2 = g.new_transaction()
+    e2 = _edge_between(tx2, a.id, "follows")
+    ne = tx2.set_edge_property(e2, "since", 2)
+    assert ne.id != old_id  # forked: fresh relation id
+    tx2.commit()
+
+    tx3 = g.new_transaction()
+    e3 = _edge_between(tx3, a.id, "follows")
+    assert e3.value("since") == 2 and e3.id == ne.id
+    g.close()
+
+
+def test_default_edge_update_keeps_relation_id():
+    g = open_graph()
+    mgmt = g.management()
+    mgmt.make_property_key("since", int)
+    mgmt.make_edge_label("knows")
+    tx = g.new_transaction()
+    a, b = tx.add_vertex(), tx.add_vertex()
+    e = tx.add_edge(a, "knows", b, since=1)
+    tx.commit()
+
+    tx2 = g.new_transaction()
+    e2 = _edge_between(tx2, a.id, "knows")
+    ne = tx2.set_edge_property(e2, "since", 2)
+    assert ne.id == e.id  # in-place semantics
+    tx2.commit()
+
+    tx3 = g.new_transaction()
+    e3 = _edge_between(tx3, a.id, "knows")
+    assert e3.value("since") == 2 and e3.id == e.id
+    g.close()
+
+
+def test_chained_updates_through_stale_handle():
+    """Repeated set_property through the ORIGINAL edge handle must compose:
+    the handle forwards to its live replacement (found by review: the
+    second update previously rebuilt from the stale property map)."""
+    g = open_graph()
+    mgmt = g.management()
+    mgmt.make_property_key("a", int)
+    mgmt.make_property_key("b", int)
+    mgmt.make_edge_label("knows")
+    tx = g.new_transaction()
+    u, w = tx.add_vertex(), tx.add_vertex()
+    tx.add_edge(u, "knows", w)
+    tx.commit()
+
+    tx2 = g.new_transaction()
+    from janusgraph_tpu.core.codecs import Direction
+
+    [e2] = tx2.get_vertex(u.id).edges(Direction.OUT, "knows")
+    e2.set_property("a", 1)
+    e2.set_property("b", 2)  # via the now-stale original handle
+    tx2.commit()
+
+    tx3 = g.new_transaction()
+    [e3] = tx3.get_vertex(u.id).edges(Direction.OUT, "knows")
+    assert e3.value("a") == 1 and e3.value("b") == 2
+    g.close()
