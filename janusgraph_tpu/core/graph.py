@@ -630,6 +630,16 @@ class JanusGraphTPU:
                         self.id_assigner.assign_relation_id(),
                     ),
                 ]
+                # vertex-label TTL: the existence + label cells expire, so
+                # the whole vertex does; remaining relations become ghosts
+                # (reference: VertexLabel TTL semantics + GhostVertexRemover)
+                vl = tx.schema_by_id(label_id) if label_id else None
+                vttl = getattr(vl, "ttl_seconds", 0)
+                if vttl:
+                    import time as _time
+
+                    vexp = _time.time_ns() + int(vttl * 1e9)
+                    adds = [(c, v, vexp) for c, v in adds]
                 btx.mutate_edges(self.idm.get_key(vid), adds, [])
 
             # -- 2. deleted relations FIRST: a later buffered addition with
@@ -850,9 +860,40 @@ class JanusGraphTPU:
             )
 
     def _write_relation(self, tx: Transaction, rel, delete: bool) -> None:
+        expire = 0
+        if not delete:
+            el = tx.schema_by_id(rel.type_id)
+            ttl = getattr(el, "ttl_seconds", 0)
+            # a (static) TTL'd vertex label folds into its relations' TTL
+            # (reference: combined vertex-label + type TTL): static vertices
+            # only gain relations in their creating tx, so the label lookup
+            # via _new_vertex_labels covers the reference-legal cases
+            vids = (
+                [rel.out_vertex.id, rel.in_vertex.id]
+                if isinstance(rel, Edge)
+                else [rel.vertex.id]
+            )
+            for vid in vids:
+                lbl_id = tx._new_vertex_labels.get(vid)
+                if lbl_id:
+                    vl = tx.schema_by_id(lbl_id)
+                    vttl = getattr(vl, "ttl_seconds", 0)
+                    if vttl:
+                        ttl = vttl if not ttl else min(ttl, vttl)
+            if ttl:
+                import time as _time
+
+                expire = _time.time_ns() + int(ttl * 1e9)
         for key, cell in self._relation_cells(tx, rel):
             if delete:
                 tx.backend_tx.mutate_edges(key, [], [cell[0]])
+            elif expire:
+                # cell-TTL entry (column, value, expire_ns) — honored by
+                # backends advertising StoreFeatures.cell_ttl; set_ttl
+                # rejects TTL'd types on backends without it
+                tx.backend_tx.mutate_edges(
+                    key, [(cell[0], cell[1], expire)], []
+                )
             else:
                 tx.backend_tx.mutate_edges(key, [cell], [])
 

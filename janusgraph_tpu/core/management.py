@@ -132,6 +132,52 @@ class ManagementSystem:
         self.graph.management_logger.broadcast_eviction(el.id)
         return updated
 
+    def set_ttl(self, name: str, ttl_seconds: int):
+        """Attach a time-to-live to a property key, edge label, or vertex
+        label (reference: ManagementSystem.setTTL storing
+        TypeDefinitionCategory.TTL). Cells of the type are written with a
+        per-cell expiry; requires a backend advertising cell TTL
+        (StoreFeatures.cell_ttl — the reference likewise rejects setTTL on
+        backends without native cell TTL). Vertex-label TTL expires the
+        vertex existence cell; its relations become ghosts reclaimed by the
+        ghost remover (reference semantics)."""
+        if ttl_seconds < 0:
+            raise SchemaViolationError("ttl must be >= 0")
+        if ttl_seconds and not self.graph.backend.manager.features.cell_ttl:
+            raise SchemaViolationError(
+                "backend does not support cell TTL "
+                f"({self.graph.backend.manager.name})"
+            )
+        el = self.graph.schema_cache.get_by_name(name)
+        if el is None or not hasattr(el, "ttl_seconds"):
+            raise SchemaViolationError(f"{name} is not a schema type")
+        if (
+            ttl_seconds
+            and isinstance(el, VertexLabel)
+            and not el.static
+        ):
+            # reference: setTTL rejects non-static vertex labels — a
+            # non-static vertex could keep gaining never-expiring relations
+            # after its existence cell died
+            raise SchemaViolationError(
+                "vertex-label TTL requires a static label "
+                "(reference: ManagementSystem.setTTL)"
+            )
+        import dataclasses
+
+        updated = dataclasses.replace(el, ttl_seconds=int(ttl_seconds))
+        self._persist(updated)
+        self.graph.schema_cache.invalidate(name)
+        self.graph.schema_cache.invalidate_id(el.id)
+        self.graph.management_logger.broadcast_eviction(el.id)
+        return updated
+
+    def get_ttl(self, name: str) -> int:
+        el = self.graph.schema_cache.get_by_name(name)
+        if el is None or not hasattr(el, "ttl_seconds"):
+            raise SchemaViolationError(f"{name} is not a schema type")
+        return el.ttl_seconds
+
     def get_consistency(self, name: str) -> Consistency:
         el = self.graph.schema_cache.get_by_name(name)
         if el is None or not hasattr(el, "consistency"):

@@ -108,6 +108,9 @@ class PropertyKey:
     data_type: type
     cardinality: Cardinality = Cardinality.SINGLE
     consistency: Consistency = Consistency.DEFAULT
+    #: seconds until cells of this type expire (0 = never); requires a
+    #: cell-TTL backend (reference: ManagementSystem.setTTL)
+    ttl_seconds: int = 0
 
     @property
     def is_property_key(self) -> bool:
@@ -123,6 +126,7 @@ class PropertyKey:
             "dataType": _DATA_TYPE_NAMES[self.data_type],
             "cardinality": int(self.cardinality),
             "consistency": int(self.consistency),
+            "ttl": self.ttl_seconds,
         }
 
     def type_info(self) -> TypeInfo:
@@ -140,6 +144,7 @@ class EdgeLabel:
     sort_key: Tuple[int, ...] = ()
     unidirected: bool = False
     consistency: Consistency = Consistency.DEFAULT
+    ttl_seconds: int = 0
 
     @property
     def is_property_key(self) -> bool:
@@ -156,6 +161,7 @@ class EdgeLabel:
             "sortKey": list(self.sort_key),
             "unidirected": self.unidirected,
             "consistency": int(self.consistency),
+            "ttl": self.ttl_seconds,
         }
 
     def type_info(self) -> TypeInfo:
@@ -171,12 +177,14 @@ class VertexLabel:
     name: str
     partitioned: bool = False
     static: bool = False
+    ttl_seconds: int = 0
 
     def definition(self) -> dict:
         return {
             "kind": "vertexlabel",
             "partitioned": self.partitioned,
             "static": self.static,
+            "ttl": self.ttl_seconds,
         }
 
 
@@ -231,6 +239,7 @@ def schema_element_from_definition(sid: int, name: str, d: dict):
             _DATA_TYPES[d["dataType"]],
             Cardinality(d["cardinality"]),
             Consistency(d.get("consistency", 0)),
+            d.get("ttl", 0),
         )
     if kind == "edge":
         return EdgeLabel(
@@ -240,9 +249,13 @@ def schema_element_from_definition(sid: int, name: str, d: dict):
             tuple(d.get("sortKey", ())),
             d.get("unidirected", False),
             Consistency(d.get("consistency", 0)),
+            d.get("ttl", 0),
         )
     if kind == "vertexlabel":
-        return VertexLabel(sid, name, d.get("partitioned", False), d.get("static", False))
+        return VertexLabel(
+            sid, name, d.get("partitioned", False), d.get("static", False),
+            d.get("ttl", 0),
+        )
     if kind == "index":
         return IndexDefinition(
             sid,

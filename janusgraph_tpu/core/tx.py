@@ -234,6 +234,10 @@ class Transaction:
         self._check_writable()
         if getattr(e, "_replacement", None) is not None:
             return self.set_edge_property(e._replacement, key, value)
+        if e.is_removed:
+            raise InvalidElementError(
+                "cannot set a property on a removed edge", e
+            )
         pk = self._property_key(key, value)
         if e.is_new:
             e._props[pk.id] = value

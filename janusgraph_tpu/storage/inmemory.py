@@ -94,16 +94,35 @@ class InMemoryKeyColumnValueStore(KeyColumnValueStore):
         self._name = name
         self._rows: Dict[bytes, _Row] = {}
         self._write_lock = threading.Lock()
+        # cell-TTL side table: (key, column) -> expire_ns. Populated only by
+        # 3-tuple additions (column, value, expire_ns) — the reference
+        # delegates per-cell TTL to backends advertising it (cassandra cell
+        # TTL; StoreFeatures.cell_ttl); this store is such a backend.
+        self._expiry: Dict[Tuple[bytes, bytes], int] = {}
 
     @property
     def name(self) -> str:
         return self._name
 
+    def _filter_expired(self, key: bytes, entries: EntryList) -> EntryList:
+        if not self._expiry:
+            return entries
+        import time
+
+        now = time.time_ns()
+        out = []
+        for e in entries:
+            exp = self._expiry.get((key, e[0]))
+            if exp is not None and exp <= now:
+                continue
+            out.append(e)
+        return out
+
     def get_slice(self, query: KeySliceQuery, txh: StoreTransaction) -> EntryList:
         row = self._rows.get(query.key)
         if row is None:
             return []
-        return row.slice(query.slice)
+        return self._filter_expired(query.key, row.slice(query.slice))
 
     def mutate(
         self,
@@ -113,8 +132,17 @@ class InMemoryKeyColumnValueStore(KeyColumnValueStore):
         txh: StoreTransaction,
     ) -> None:
         with self._write_lock:
+            plain = []
+            for e in additions:
+                if len(e) >= 3 and e[2]:
+                    self._expiry[(key, e[0])] = e[2]
+                else:
+                    self._expiry.pop((key, e[0]), None)
+                plain.append((e[0], e[1]))
+            for col in deletions:
+                self._expiry.pop((key, col), None)
             row = self._rows.get(key, _EMPTY_ROW)
-            new_row = row.mutated(additions, deletions)
+            new_row = row.mutated(plain, deletions)
             if new_row.is_empty():
                 self._rows.pop(key, None)
             else:
@@ -135,9 +163,35 @@ class InMemoryKeyColumnValueStore(KeyColumnValueStore):
             row = self._rows.get(k)
             if row is None:
                 continue
-            entries = row.slice(sq)
+            entries = self._filter_expired(k, row.slice(sq))
             if entries:
                 yield k, entries
+
+    def purge_expired(self) -> int:
+        """Eagerly reclaim expired cells (reads only FILTER them — without
+        purging, short-TTL churn grows _rows/_expiry without bound; same
+        contract as TTLKCVStore.purge_expired). Returns cells purged."""
+        import time
+
+        now = time.time_ns()
+        with self._write_lock:
+            dead = [
+                (k, c) for (k, c), exp in self._expiry.items() if exp <= now
+            ]
+            by_key: Dict[bytes, List[bytes]] = {}
+            for k, c in dead:
+                by_key.setdefault(k, []).append(c)
+                del self._expiry[(k, c)]
+            for k, cols in by_key.items():
+                row = self._rows.get(k)
+                if row is None:
+                    continue
+                new_row = row.mutated([], cols)
+                if new_row.is_empty():
+                    self._rows.pop(k, None)
+                else:
+                    self._rows[k] = new_row
+        return len(dead)
 
     # -- introspection used by the OLAP bulk loader ------------------------
     def row_count(self) -> int:
@@ -146,6 +200,7 @@ class InMemoryKeyColumnValueStore(KeyColumnValueStore):
     def clear(self) -> None:
         with self._write_lock:
             self._rows.clear()
+            self._expiry.clear()
 
 
 class InMemoryStoreManager(KeyColumnValueStoreManager):
@@ -162,6 +217,7 @@ class InMemoryStoreManager(KeyColumnValueStoreManager):
             batch_mutation=True,
             key_consistent=True,
             persists=False,
+            cell_ttl=True,
         )
 
     @property

@@ -106,13 +106,15 @@ class KCVMutation:
     def merge(self, other: "KCVMutation") -> None:
         """Merge a *later* mutation into this one, preserving temporal order:
         a later deletion cancels an earlier addition of the same column and
-        vice versa (reference: KCVSMutation consolidation semantics)."""
+        vice versa (reference: KCVSMutation consolidation semantics).
+        Addition entries are (column, value) or (column, value, expire_ns)
+        for cell-TTL backends — indexed, never unpacked, so both co-exist."""
         if other.deletions:
             dels = set(other.deletions)
             self.additions = [e for e in self.additions if e[0] not in dels]
             self.deletions.extend(other.deletions)
         if other.additions:
-            adds = {c for c, _ in other.additions}
+            adds = {e[0] for e in other.additions}
             self.deletions = [d for d in self.deletions if d not in adds]
             self.additions.extend(other.additions)
 

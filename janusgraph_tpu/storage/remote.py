@@ -88,6 +88,11 @@ class _Reader:
         self.off += 4
         return v
 
+    def u64(self) -> int:
+        (v,) = struct.unpack_from(">Q", self.data, self.off)
+        self.off += 8
+        return v
+
     def u8(self) -> int:
         v = self.data[self.off]
         self.off += 1
@@ -113,6 +118,26 @@ def _encode_entries(out: List[bytes], entries: EntryList) -> None:
 def _decode_entries(r: _Reader) -> EntryList:
     n = r.u32()
     return [(r.bytes_(), r.bytes_()) for _ in range(n)]
+
+
+def _encode_additions(out: List[bytes], entries: EntryList) -> None:
+    """Mutation additions: (col, val[, expire_ns]) — a u64 expiry (0 = no
+    per-cell TTL) rides every entry so cell-TTL types work over the wire."""
+    out.append(struct.pack(">I", len(entries)))
+    for e in entries:
+        _pb(out, e[0])
+        _pb(out, e[1])
+        out.append(struct.pack(">Q", e[2] if len(e) >= 3 else 0))
+
+
+def _decode_additions(r: _Reader) -> EntryList:
+    n = r.u32()
+    out = []
+    for _ in range(n):
+        col, val = r.bytes_(), r.bytes_()
+        exp = r.u64()
+        out.append((col, val, exp) if exp else (col, val))
+    return out
 
 
 def _encode_slice(out: List[bytes], sq: SliceQuery) -> None:
@@ -207,7 +232,7 @@ class _Handler(socketserver.BaseRequestHandler):
         if op == _OP_MUTATE:
             store = mgr.open_database(r.str_())
             key = r.bytes_()
-            adds = _decode_entries(r)
+            adds = _decode_additions(r)
             ndels = r.u32()
             dels = [r.bytes_() for _ in range(ndels)]
             store.mutate(key, adds, dels, txh)
@@ -223,7 +248,7 @@ class _Handler(socketserver.BaseRequestHandler):
                 rows: Dict[bytes, KCVMutation] = {}
                 for _ in range(nrows):
                     key = r.bytes_()
-                    adds = _decode_entries(r)
+                    adds = _decode_additions(r)
                     ndels = r.u32()
                     dels = [r.bytes_() for _ in range(ndels)]
                     m = KCVMutation()
@@ -364,7 +389,7 @@ class RemoteKCVStore(KeyColumnValueStore):
         out: List[bytes] = []
         _ps(out, self._name)
         _pb(out, key)
-        _encode_entries(out, additions)
+        _encode_additions(out, additions)
         out.append(struct.pack(">I", len(deletions)))
         for col in deletions:
             _pb(out, col)
@@ -486,7 +511,7 @@ class RemoteStoreManager(KeyColumnValueStoreManager):
             out.append(struct.pack(">I", len(rows)))
             for key, m in rows.items():
                 _pb(out, key)
-                _encode_entries(out, m.additions)
+                _encode_additions(out, m.additions)
                 out.append(struct.pack(">I", len(m.deletions)))
                 for col in m.deletions:
                     _pb(out, col)

@@ -42,9 +42,18 @@ class TTLKCVStore(KeyColumnValueStore):
     def name(self) -> str:
         return self.wrapped.name
 
-    def _wrap_value(self, value: bytes) -> bytes:
+    def _wrap_value(self, value: bytes, cell_expire_ns: int = 0) -> bytes:
         exp = 0 if self.ttl_seconds <= 0 else _now_ns() + int(self.ttl_seconds * 1e9)
+        if cell_expire_ns:
+            # per-cell TTL (3-tuple addition): the earlier deadline wins
+            exp = cell_expire_ns if not exp else min(exp, cell_expire_ns)
         return _EXP.pack(exp) + value
+
+    def _frame_addition(self, e):
+        """(col, val[, expire_ns]) -> (col, framed-val): per-cell expiry is
+        folded into this wrapper's own value framing, so the wrapped store
+        needs no cell-TTL support of its own."""
+        return (e[0], self._wrap_value(e[1], e[2] if len(e) >= 3 else 0))
 
     @staticmethod
     def _live(framed: bytes, now: int) -> Optional[bytes]:
@@ -76,7 +85,7 @@ class TTLKCVStore(KeyColumnValueStore):
         deletions: Sequence[bytes],
         txh: StoreTransaction,
     ) -> None:
-        framed = [(c, self._wrap_value(v)) for c, v in additions]
+        framed = [self._frame_addition(e) for e in additions]
         self.wrapped.mutate(key, framed, deletions, txh)
 
     def get_keys(self, query, txh) -> Iterator[Tuple[bytes, EntryList]]:
@@ -145,7 +154,7 @@ class TTLStoreManager(KeyColumnValueStoreManager):
             framed[store_name] = {
                 key: KCVMutation(
                     additions=[
-                        (c, store._wrap_value(v)) for c, v in mut.additions
+                        store._frame_addition(e) for e in mut.additions
                     ],
                     deletions=list(mut.deletions),
                 )
