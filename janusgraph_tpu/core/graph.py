@@ -985,11 +985,26 @@ class JanusGraphTPU:
                 pending.extend(
                     self.index_serializer.index_updates(idx, vid, before, after)
                 )
+            # index entries of TTL'd key types expire with their data cells
+            # (earliest deadline wins) — otherwise expired properties leave
+            # phantom index hits + permanent index garbage
+            idx_ttl = 0
+            for key_id in idx.key_ids:
+                kt = getattr(tx.schema_by_id(key_id), "ttl_seconds", 0)
+                if kt:
+                    idx_ttl = kt if not idx_ttl else min(idx_ttl, kt)
+            idx_expire = 0
+            if idx_ttl:
+                import time as _time
+
+                idx_expire = _time.time_ns() + int(idx_ttl * 1e9)
             for row, _adds, dels in pending:
                 if dels:
                     btx.mutate_index(row, [], dels)
             for row, adds, _dels in pending:
                 if adds:
+                    if idx_expire:
+                        adds = [(e[0], e[1], idx_expire) for e in adds]
                     btx.mutate_index(row, adds, [])
 
     def _index_values_committed(self, tx, idx: IndexDefinition, vid: int):

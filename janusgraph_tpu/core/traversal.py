@@ -460,6 +460,7 @@ class GraphTraversal:
         self._steps: List[Callable[[List[Traverser]], List[Traverser]]] = []
         self._folding = True  # still collecting leading has() steps
         self._last_by: Optional[List] = None  # open by() modulator window
+        self._side_effects: Dict[str, List] = {}  # aggregate()/cap() buckets
 
     # -- filters ------------------------------------------------------------
     def has(self, key: str, value=None) -> "GraphTraversal":
@@ -701,6 +702,70 @@ class GraphTraversal:
 
     def range_(self, lo: int, hi: int) -> "GraphTraversal":
         self._add(lambda ts: ts[lo:hi])
+        return self
+
+    def tail(self, n: int = 1) -> "GraphTraversal":
+        self._add(lambda ts: ts[-n:] if n else [])
+        return self
+
+    def skip(self, n: int) -> "GraphTraversal":
+        self._add(lambda ts: ts[n:])
+        return self
+
+    def sample(self, n: int, seed: Optional[int] = None) -> "GraphTraversal":
+        """Uniform sample without replacement (TinkerPop sample();
+        deterministic when `seed` is given — compiler-friendly habit kept
+        even host-side)."""
+        import random
+
+        def step(ts):
+            if len(ts) <= n:
+                return list(ts)
+            rng = random.Random(seed)
+            return rng.sample(ts, n)
+
+        self._add(step, name=f"sample({n})")
+        return self
+
+    def coin(self, probability: float, seed: Optional[int] = None) -> "GraphTraversal":
+        """Keep each traverser with the given probability (TinkerPop coin())."""
+        import random
+
+        def step(ts):
+            rng = random.Random(seed)
+            return [t for t in ts if rng.random() < probability]
+
+        self._add(step, name=f"coin({probability})")
+        return self
+
+    # -- side-effect steps (TinkerPop aggregate/store/cap) --------------------
+    def aggregate(self, name: str) -> "GraphTraversal":
+        """Eagerly collect the CURRENT objects into side-effect `name`
+        (TinkerPop aggregate(): a barrier — the whole frontier is gathered
+        before traversal continues; read back with cap())."""
+
+        def step(ts):
+            bucket = self._side_effects.setdefault(name, [])
+            bucket.extend(t.obj for t in ts)
+            return ts
+
+        self._add(step, name=f"aggregate({name})")
+        return self
+
+    def store(self, name: str) -> "GraphTraversal":
+        """Lazily collect objects into side-effect `name` (TinkerPop
+        store() semantics — same collection mechanics here, kept as a
+        distinct step for API parity)."""
+        return self.aggregate(name)
+
+    def cap(self, name: str) -> "GraphTraversal":
+        """Replace the frontier with the collected side-effect list."""
+
+        def step(ts):
+            vals = list(self._side_effects.get(name, []))
+            return [Traverser(vals)]
+
+        self._add(step, name=f"cap({name})")
         return self
 
     def order(self, key: Optional[str] = None, reverse: bool = False) -> "GraphTraversal":
@@ -1202,6 +1267,9 @@ class GraphTraversal:
             raise QueryError(
                 "anonymous (sub-traversal) bodies cannot be executed directly"
             )
+        # fresh side-effect buckets per execution: re-running a traversal
+        # must not accumulate aggregate()/store() contents across runs
+        self._side_effects.clear()
         run = observe if observe is not None else (lambda _label, fn, ts: fn(ts))
         ts = run("start", lambda _: self._start.run(self._pre_has), None)
         for step in self._steps:

@@ -122,7 +122,15 @@ class InMemoryKeyColumnValueStore(KeyColumnValueStore):
         row = self._rows.get(query.key)
         if row is None:
             return []
-        return self._filter_expired(query.key, row.slice(query.slice))
+        sq = query.slice
+        if self._expiry and sq.limit is not None:
+            # filter BEFORE limiting: expired cells must not occupy the
+            # limit window (native cell-TTL backends count live cells only)
+            live = self._filter_expired(query.key, row.slice(
+                SliceQuery(sq.start, sq.end)
+            ))
+            return live[: sq.limit]
+        return self._filter_expired(query.key, row.slice(sq))
 
     def mutate(
         self,
@@ -133,14 +141,19 @@ class InMemoryKeyColumnValueStore(KeyColumnValueStore):
     ) -> None:
         with self._write_lock:
             plain = []
+            added_cols = set()
             for e in additions:
                 if len(e) >= 3 and e[2]:
                     self._expiry[(key, e[0])] = e[2]
                 else:
                     self._expiry.pop((key, e[0]), None)
                 plain.append((e[0], e[1]))
+                added_cols.add(e[0])
             for col in deletions:
-                self._expiry.pop((key, col), None)
+                # additions override same-column deletions (_Row.mutated
+                # contract) — their freshly-recorded expiry must survive too
+                if col not in added_cols:
+                    self._expiry.pop((key, col), None)
             row = self._rows.get(key, _EMPTY_ROW)
             new_row = row.mutated(plain, deletions)
             if new_row.is_empty():

@@ -181,3 +181,51 @@ def test_inmemory_purge_expired():
     s.mutate(b"k", [(b"a", b"1", 1), (b"b", b"2")], [], stx)  # 'a' long dead
     purged = s.purge_expired()
     assert purged == 1 and s.row_count() == 1
+
+
+def test_ttl_property_index_entries_expire_with_cells():
+    g = open_graph()
+    m = g.management()
+    m.make_property_key("session", str)
+    m.build_composite_index("bySession", ["session"])
+    m.set_ttl("session", 1)
+    tx = g.new_transaction()
+    v = tx.add_vertex()
+    v.property("session", "tok")
+    tx.commit()
+    assert [x.id for x in g.traversal().V().has("session", "tok").to_list()] == [v.id]
+    for store in (g.backend.edgestore, g.backend.indexstore):
+        while hasattr(store, "wrapped"):
+            store = store.wrapped
+        for k in list(store._expiry):
+            store._expiry[k] -= 2_000_000_000
+    for s in (g.backend.edgestore, g.backend.indexstore):
+        if hasattr(s, "invalidate_all"):
+            s.invalidate_all()
+    assert g.traversal().V().has("session", "tok").to_list() == []  # no phantom
+    g.close()
+
+
+def test_mutate_add_and_delete_same_column_keeps_ttl():
+    from janusgraph_tpu.storage.inmemory import InMemoryStoreManager as M
+
+    m = M()
+    s = m.open_database("t")
+    stx = m.begin_transaction()
+    import time
+
+    exp = time.time_ns() + 10**12
+    s.mutate(b"k", [(b"a", b"1", exp)], [b"a"], stx)  # add overrides delete
+    assert s._expiry[(b"k", b"a")] == exp  # TTL survives the override
+
+
+def test_limited_slice_counts_live_cells_only():
+    from janusgraph_tpu.storage.inmemory import InMemoryStoreManager as M
+    from janusgraph_tpu.storage.kcvs import KeySliceQuery, SliceQuery
+
+    m = M()
+    s = m.open_database("t")
+    stx = m.begin_transaction()
+    s.mutate(b"k", [(b"a", b"1", 1), (b"b", b"2", 1), (b"c", b"3"), (b"d", b"4")], [], stx)
+    got = s.get_slice(KeySliceQuery(b"k", SliceQuery(limit=2)), stx)
+    assert got == [(b"c", b"3"), (b"d", b"4")]

@@ -375,3 +375,48 @@ def test_match_pretagged_anchor(g):
         .to_list()
     )
     assert rows == ["saturn"]
+
+
+# ---- side-effect + sampling steps -------------------------------------------
+
+def test_aggregate_cap(g):
+    rows = (
+        g.V().has_label("god").values("name").aggregate("x")
+        .cap("x").next()
+    )
+    assert sorted(rows) == ["jupiter", "neptune", "pluto"]
+
+
+def test_store_is_aggregate(g):
+    rows = g.V().has_label("titan").values("name").store("t").cap("t").next()
+    assert rows == ["saturn"]
+
+
+def test_aggregate_with_where_subtraversal(g):
+    from janusgraph_tpu.core.traversal import P, __
+
+    # 'gods except jupiter' via aggregate + where(neq tag) pattern analogue
+    rows = (
+        g.V().has("name", "jupiter").as_("j")
+        .both("brother").where(P.neq("j"))
+        .values("name").dedup().to_list()
+    )
+    assert sorted(rows) == ["neptune", "pluto"]
+
+
+def test_tail_skip_sample_coin(g):
+    names = g.V().has_label("god").values("name").order().to_list()
+    assert g.V().has_label("god").values("name").order().tail(1).to_list() == names[-1:]
+    assert g.V().has_label("god").values("name").order().skip(1).to_list() == names[1:]
+    assert len(g.V().has_label("god").sample(2, seed=7).to_list()) == 2
+    kept = g.V().has_label("god").coin(1.0, seed=7).to_list()
+    assert len(kept) == 3
+    assert g.V().has_label("god").coin(0.0, seed=7).to_list() == []
+
+
+def test_aggregate_does_not_accumulate_across_runs(g):
+    t = g.V().has_label("god").values("name").aggregate("x").cap("x")
+    first = t.next()
+    t2 = g.V().has_label("god").values("name").aggregate("x").cap("x")
+    again = t2.next()
+    assert len(first) == 3 and len(again) == 3
