@@ -578,6 +578,9 @@ class JanusGraphTPU:
         self.indexes = {**self.indexes, idx.name: idx}
 
     def _load_index_registry(self) -> None:
+        from janusgraph_tpu.core.management import RELINDEX_REGISTRY_KEY
+        from janusgraph_tpu.core.schema import RelationIndex
+
         btx = self.backend.begin_transaction()
         entries = btx.index_query(KeySliceQuery(INDEX_REGISTRY_KEY, SliceQuery()))
         fresh: Dict[str, IndexDefinition] = {}
@@ -589,6 +592,23 @@ class JanusGraphTPU:
         # atomic swap: commit threads iterate a snapshot, never a dict being
         # mutated by the systemlog reader thread
         self.indexes = fresh
+        # relation-type (vertex-centric) indexes, grouped by edge label
+        rentries = btx.index_query(
+            KeySliceQuery(RELINDEX_REGISTRY_KEY, SliceQuery())
+        )
+        by_label: Dict[int, tuple] = {}
+        rel_ids = set()
+        for col, _ in rentries:
+            (sid,) = struct.unpack(">Q", col)
+            el = self.schema_cache.get_by_id(sid)
+            if isinstance(el, RelationIndex):
+                by_label[el.label_id] = by_label.get(el.label_id, ()) + (el,)
+                rel_ids.add(el.id)
+        self.relation_indexes = by_label
+        #: type ids whose cells are index copies — excluded from untyped
+        #: edge enumeration (reference: RelationTypeIndex types are
+        #: invisible system relation types)
+        self.relation_index_ids = frozenset(rel_ids)
 
     # ----------------------------------------------------------------- commit
     def commit_tx(self, tx: Transaction) -> None:
@@ -884,7 +904,10 @@ class JanusGraphTPU:
                 import time as _time
 
                 expire = _time.time_ns() + int(ttl * 1e9)
-        for key, cell in self._relation_cells(tx, rel):
+        cells = self._relation_cells(tx, rel)
+        if isinstance(rel, Edge):
+            cells = cells + self._relation_index_cells(tx, rel, delete)
+        for key, cell in cells:
             if delete:
                 tx.backend_tx.mutate_edges(key, [], [cell[0]])
             elif expire:
@@ -896,6 +919,47 @@ class JanusGraphTPU:
                 )
             else:
                 tx.backend_tx.mutate_edges(key, [cell], [])
+
+    def _relation_index_cells(
+        self, tx: Transaction, rel, for_delete: bool = False
+    ) -> list:
+        """Extra cells an edge writes for each RelationTypeIndex on its
+        label (reference: RelationTypeIndex — the index is itself a
+        relation type; its cells duplicate the edge under the index's type
+        id with the index sort key in the column). Edges missing an indexed
+        sort-key property are skipped (they are simply not indexed).
+        Deletions target the cells of EVERY index regardless of status —
+        a DISABLED index must not orphan cells that would resurface as
+        phantom edges on re-enable."""
+        out = []
+        ris = self.relation_indexes.get(rel.type_id, ())
+        if not ris:
+            return out
+        es = self.edge_serializer
+        ser = self.serializer
+        for ri in ris:
+            if not for_delete and ri.status not in ("REGISTERED", "ENABLED"):
+                continue
+            sk = ri.sort_key_bytes(ser, rel._props)
+            if sk is None:
+                continue
+            if ri.direction in (int(Direction.OUT), int(Direction.BOTH)):
+                out.append((
+                    self.idm.get_key(rel.out_vertex.id),
+                    es.write_edge(
+                        ri.id, Direction.OUT, rel.in_vertex.id,
+                        rel.id, sk, rel._props or None,
+                    ),
+                ))
+            if ri.direction in (int(Direction.IN), int(Direction.BOTH)):
+                out.append((
+                    self.idm.get_key(rel.in_vertex.id),
+                    es.write_edge(
+                        ri.id, Direction.IN, rel.out_vertex.id,
+                        rel.id, sk, rel._props or None,
+                    ),
+                ))
+        return out
 
     # ---------------------------------------------------------- index updates
     def _apply_index_updates(self, tx: Transaction, btx) -> None:

@@ -29,6 +29,7 @@ from janusgraph_tpu.core.schema import (
     EdgeLabel,
     IndexDefinition,
     PropertyKey,
+    RelationIndex,
     VertexLabel,
     encode_definition,
     _DATA_TYPE_NAMES,
@@ -40,6 +41,7 @@ SCHEMA_NAME_INDEX_PREFIX = b"\x00sn\x00"
 # (reference: buildIndex("name", ...) coexists with PropertyKey "name")
 INDEX_NAME_PREFIX = b"\x00in\x00"
 INDEX_REGISTRY_KEY = b"\x00indexes"
+RELINDEX_REGISTRY_KEY = b"\x00relindexes"
 
 
 class SchemaAction(Enum):
@@ -185,6 +187,129 @@ class ManagementSystem:
                 f"{name} is not a property key or edge label"
             )
         return el.consistency
+
+    # ----------------------------------------- relation-type (vertex-centric)
+    def build_edge_index(
+        self,
+        label_name: str,
+        name: str,
+        sort_keys: Sequence[str],
+        direction: Direction = Direction.BOTH,
+    ) -> RelationIndex:
+        """Create a vertex-centric index on an EXISTING edge label
+        (reference: ManagementSystem.buildEdgeIndex -> RelationTypeIndex).
+        New edges of the label immediately write index cells (status
+        REGISTERED); pre-existing edges become queryable after
+        reindex_relation_index(), which flips the index to ENABLED. Sort
+        keys must be fixed-width property keys (the same TPU-first
+        restriction as label sort keys)."""
+        label = self.graph.schema_cache.get_by_name(label_name)
+        if not isinstance(label, EdgeLabel):
+            raise SchemaViolationError(f"{label_name} is not an edge label")
+        self._check_fresh(name)
+        if not sort_keys:
+            raise SchemaViolationError("relation index needs sort keys")
+        key_ids = []
+        for key_name in sort_keys:
+            pk = self.graph.schema_cache.get_by_name(key_name)
+            if not isinstance(pk, PropertyKey):
+                raise SchemaViolationError(
+                    f"sort key {key_name} is not a property key"
+                )
+            ser = self.graph.serializer.serializer_for_type(pk.data_type)
+            if ser.fixed_width is None:
+                raise SchemaViolationError(
+                    f"sort key {key_name}: only fixed-width types can be "
+                    f"sort keys (got {pk.data_type.__name__})"
+                )
+            key_ids.append(pk.id)
+        sid = self.graph.id_assigner.assign_schema_id(
+            VertexIDType.USER_EDGE_LABEL
+        )
+        ri = RelationIndex(
+            sid, name, label.id, tuple(key_ids), int(direction), "REGISTERED"
+        )
+        self._persist(ri)
+        btx = self.graph.backend.begin_transaction()
+        btx.mutate_index(
+            RELINDEX_REGISTRY_KEY, [(struct.pack(">Q", sid), b"")], []
+        )
+        btx.commit()
+        self.graph._load_index_registry()
+        self.graph.management_logger.broadcast_eviction(sid)
+        return ri
+
+    def reindex_relation_index(self, name: str) -> int:
+        """Write index cells for every pre-existing edge of the indexed
+        label, then ENABLE the index (reference: mgmt.updateIndex(REINDEX)
+        on a RelationTypeIndex). Returns edges indexed."""
+        ri = self.graph.schema_cache.get_by_name(name)
+        if not isinstance(ri, RelationIndex):
+            raise SchemaViolationError(f"{name} is not a relation index")
+        g = self.graph
+        from janusgraph_tpu.storage.kcvs import SliceQuery
+
+        es = g.edge_serializer
+        ser = g.serializer
+        sq = es.get_type_slice(ri.label_id, True, Direction.OUT)
+        codec_schema = None
+        btx = g.backend.begin_transaction()
+        stx = g.backend.manager.begin_transaction()
+        count = 0
+        for key, entries in g.backend.edgestore.get_keys(
+            SliceQuery(sq.start, sq.end), stx
+        ):
+            vid = g.idm.get_vertex_id(key)
+            for entry in entries:
+                if codec_schema is None:
+                    from janusgraph_tpu.olap.csr import graph_codec_schema
+
+                    codec_schema = graph_codec_schema(g)
+                rc = es.parse_relation(entry, codec_schema)
+                if rc.type_id != ri.label_id or rc.direction != Direction.OUT:
+                    continue
+                props = rc.properties or {}
+                sk = ri.sort_key_bytes(ser, props)
+                if sk is None:
+                    continue
+                if ri.direction in (int(Direction.OUT), int(Direction.BOTH)):
+                    btx.mutate_edges(
+                        key,
+                        [es.write_edge(
+                            ri.id, Direction.OUT, rc.other_vertex_id,
+                            rc.relation_id, sk, props or None,
+                        )],
+                        [],
+                    )
+                if ri.direction in (int(Direction.IN), int(Direction.BOTH)):
+                    btx.mutate_edges(
+                        g.idm.get_key(rc.other_vertex_id),
+                        [es.write_edge(
+                            ri.id, Direction.IN, vid,
+                            rc.relation_id, sk, props or None,
+                        )],
+                        [],
+                    )
+                count += 1
+        btx.commit()
+        self.set_relation_index_status(name, "ENABLED")
+        return count
+
+    def set_relation_index_status(self, name: str, status: str) -> RelationIndex:
+        if status not in ("REGISTERED", "ENABLED", "DISABLED"):
+            raise SchemaViolationError(f"unknown relation-index status {status}")
+        ri = self.graph.schema_cache.get_by_name(name)
+        if not isinstance(ri, RelationIndex):
+            raise SchemaViolationError(f"{name} is not a relation index")
+        import dataclasses
+
+        updated = dataclasses.replace(ri, status=status)
+        self._persist(updated)
+        self.graph.schema_cache.invalidate(name)
+        self.graph.schema_cache.invalidate_id(ri.id)
+        self.graph._load_index_registry()
+        self.graph.management_logger.broadcast_eviction(ri.id)
+        return updated
 
     def make_vertex_label(
         self, name: str, partitioned: bool = False, static: bool = False

@@ -189,6 +189,58 @@ class VertexLabel:
 
 
 @dataclass(frozen=True)
+class RelationIndex:
+    """A vertex-centric index on one edge label, built AFTER the label
+    exists (reference: core/schema/RelationTypeIndex.java via
+    mgmt.buildEdgeIndex): edges of the label are additionally written as
+    cells under THIS type id with the index's sort key encoded in the
+    column, so sort-range slices work without the label itself being
+    sort-keyed. Index cells are invisible to untyped edge enumeration."""
+
+    id: int
+    name: str
+    label_id: int
+    #: property-key ids forming the index sort key (fixed-width encodings)
+    sort_key: Tuple[int, ...] = ()
+    #: Direction value the index covers (int(Direction.BOTH) = both)
+    direction: int = 2
+    # REGISTERED (written, not yet queryable) -> ENABLED -> DISABLED
+    status: str = "REGISTERED"
+
+    @property
+    def is_property_key(self) -> bool:
+        return False
+
+    @property
+    def is_edge_label(self) -> bool:
+        return False
+
+    def definition(self) -> dict:
+        return {
+            "kind": "relindex",
+            "label": self.label_id,
+            "sortKey": list(self.sort_key),
+            "direction": self.direction,
+            "status": self.status,
+        }
+
+    def type_info(self) -> TypeInfo:
+        return TypeInfo(self.id, True, Cardinality.SINGLE, self.sort_key)
+
+    def sort_key_bytes(self, serializer, props) -> Optional[bytes]:
+        """Order-preserving index sort-key bytes for an edge's properties,
+        or None when a key is missing (such edges are simply not indexed).
+        The ONE encoding shared by the write path, the reindex job, and
+        the tx overlay filter."""
+        parts = []
+        for key_id in self.sort_key:
+            if not props or key_id not in props:
+                return None
+            parts.append(serializer.write_ordered(props[key_id]))
+        return b"".join(parts)
+
+
+@dataclass(frozen=True)
 class IndexDefinition:
     """A graph index over property keys, optionally label-constrained.
     Composite (exact-match rows in `graphindex`) or mixed (documents in an
@@ -255,6 +307,15 @@ def schema_element_from_definition(sid: int, name: str, d: dict):
         return VertexLabel(
             sid, name, d.get("partitioned", False), d.get("static", False),
             d.get("ttl", 0),
+        )
+    if kind == "relindex":
+        return RelationIndex(
+            sid,
+            name,
+            d["label"],
+            tuple(d.get("sortKey", ())),
+            d.get("direction", 2),
+            d.get("status", "REGISTERED"),
         )
     if kind == "index":
         return IndexDefinition(

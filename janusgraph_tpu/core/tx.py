@@ -417,6 +417,7 @@ class Transaction:
                 ]
             else:
                 slices = self._edge_slices(direction, labels)
+            relidx_ids = self.graph.relation_index_ids
             for q in slices:
                 for entry in self._read_slice(v.id, q):
                     rc = es.parse_relation(entry, self._codec_schema)
@@ -424,6 +425,19 @@ class Transaction:
                         continue
                     if direction != Direction.BOTH and rc.direction != direction:
                         continue  # unlabeled ranges span both directions
+                    if rc.type_id in relidx_ids:
+                        if sr_bytes is None:
+                            # index copies are invisible to plain scans
+                            continue
+                        # explicit index-routed range: surface the edge
+                        # under its LABEL, not the index's type id — and
+                        # with the LABEL's sort key (empty: an index is
+                        # only consulted for labels without one), so a
+                        # later delete rebuilds the correct primary column
+                        rc.type_id = self.graph.schema_cache.get_by_id(
+                            rc.type_id
+                        ).label_id
+                        rc.sort_key = b""
                     results.append(self._edge_from_cache(v, rc))
         with self._lock:
             label_ids = self._label_ids(labels)
@@ -439,7 +453,16 @@ class Transaction:
                 if sr_bytes is not None:
                     # same [lo, hi) semantics as the committed column range
                     _el, lo_b, hi_b, _len = sr_bytes
-                    sk = rel._sort_key or b""
+                    if isinstance(_el, EdgeLabel):
+                        sk = rel._sort_key or b""
+                    else:
+                        # index-routed range: derive the INDEX sort key
+                        # from the overlay edge's properties
+                        sk = _el.sort_key_bytes(
+                            self.graph.serializer, rel._props
+                        )
+                        if sk is None:
+                            continue  # unindexed edge: not in range results
                     if (lo_b and sk < lo_b) or (hi_b and sk >= hi_b):
                         continue
                 results.append(rel)
@@ -527,7 +550,11 @@ class Transaction:
 
     def _encode_sort_range(self, labels, direction, sort_range):
         """Resolve (lo, hi) sort-range values into order-preserving byte
-        bounds for one sort-keyed label: (label, lo_bytes, hi_bytes, width)."""
+        bounds for one sort-keyed label: (target, lo_bytes, hi_bytes,
+        width). `target` is the label itself when it carries a sort key, or
+        an ENABLED RelationTypeIndex on the label covering the direction
+        (reference: sort-keyed labels vs post-hoc RelationTypeIndex — both
+        compile to the same vertex-centric column-range scan)."""
         from janusgraph_tpu.exceptions import QueryError
 
         if len(labels) != 1:
@@ -535,8 +562,20 @@ class Transaction:
         if direction == Direction.BOTH:
             raise QueryError("sort_range requires a concrete direction")
         el = self.schema_by_name(labels[0])
-        if not isinstance(el, EdgeLabel) or not el.sort_key:
-            raise QueryError(f"label {labels[0]!r} has no sort key")
+        if not isinstance(el, EdgeLabel):
+            raise QueryError(f"{labels[0]!r} is not an edge label")
+        if not el.sort_key:
+            for cand in self.graph.relation_indexes.get(el.id, ()):
+                if cand.status == "ENABLED" and cand.direction in (
+                    int(Direction.BOTH), int(direction)
+                ):
+                    el = cand
+                    break
+            else:
+                raise QueryError(
+                    f"label {labels[0]!r} has no sort key and no enabled "
+                    "relation index covering this direction"
+                )
         ser = self.graph.serializer
         sk_len = 0
         for key_id in el.sort_key:

@@ -172,6 +172,14 @@ def _scan_raw(
         if label_ids is not None
         else None
     )
+    # RelationTypeIndex cells duplicate edges under the index's type id —
+    # invisible to untyped edge enumeration (they'd double-count otherwise)
+    relidx_ids = getattr(graph, "relation_index_ids", frozenset())
+    relidx_filter = (
+        np.array(sorted(relidx_ids), dtype=np.int64)
+        if (relidx_ids and label_ids is None)
+        else None
+    )
 
     src_ids: List[np.ndarray] = []
     dst_ids: List[np.ndarray] = []
@@ -223,6 +231,8 @@ def _scan_raw(
         mask = dirs == int(Direction.OUT)
         if label_filter is not None:
             mask &= np.isin(tids, label_filter)
+        elif relidx_filter is not None:
+            mask &= ~np.isin(tids, relidx_filter)
         if not mask.any():
             return
         src_ids.append(owner[mask])
@@ -285,6 +295,8 @@ def _scan_raw(
                 if rc.direction != Direction.OUT or not rc.is_edge:
                     continue
                 if label_ids is not None and rc.type_id not in label_ids:
+                    continue
+                if label_ids is None and rc.type_id in relidx_ids:
                     continue
                 src_ids.append(np.array([vid], dtype=np.int64))
                 dst_ids.append(np.array([rc.other_vertex_id], dtype=np.int64))
@@ -541,6 +553,7 @@ def refresh_csr(graph, csr: CSRGraph, since_epoch: int) -> Tuple[CSRGraph, int]:
     store = graph.backend.edgestore
     full_q = SliceQuery(bytes([0]), bytes([4]))
     unpack_tid = _struct.Struct(">Q").unpack_from
+    relidx_ids = getattr(graph, "relation_index_ids", frozenset())
     canonicalize = idm.get_canonical_vertex_id
 
     touched: set = set()
@@ -570,13 +583,21 @@ def refresh_csr(graph, csr: CSRGraph, since_epoch: int) -> Tuple[CSRGraph, int]:
             elif cat == 3:
                 if len(col) == EDGE_COL_FIXED and not val:
                     # fixed-width fast parse
-                    if col[9] == int(Direction.OUT):
+                    tid = int.from_bytes(col[1:9], "big")
+                    if (
+                        col[9] == int(Direction.OUT)
+                        and tid not in relidx_ids
+                    ):
                         new_src.append(vid)
                         new_dst.append(int.from_bytes(col[11:19], "big"))
-                        new_et.append(int.from_bytes(col[1:9], "big"))
+                        new_et.append(tid)
                 else:
                     rc = es.parse_relation((col, val), graph_codec_schema(graph))
-                    if rc.is_edge and rc.direction == Direction.OUT:
+                    if (
+                        rc.is_edge
+                        and rc.direction == Direction.OUT
+                        and rc.type_id not in relidx_ids
+                    ):
                         new_src.append(vid)
                         new_dst.append(int(rc.other_vertex_id))
                         new_et.append(int(rc.type_id))

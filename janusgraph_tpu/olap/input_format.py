@@ -119,6 +119,7 @@ class GraphInputFormat:
                 if pk is not None:
                     sv.properties.setdefault(pk.name, []).append(rc.value)
             # out-edges
+            relidx_ids = getattr(g, "relation_index_ids", frozenset())
             for e in store.get_slice(KeySliceQuery(key, edge_q), store_tx):
                 try:
                     rc = self.es.parse_relation(e, schema)
@@ -126,6 +127,8 @@ class GraphInputFormat:
                     continue
                 if not rc.is_edge or rc.direction != Direction.OUT:
                     continue
+                if rc.type_id in relidx_ids:
+                    continue  # RelationTypeIndex copies are not edges
                 el = g.schema_cache.get_by_id(rc.type_id)
                 props = {}
                 if rc.properties:

@@ -99,13 +99,16 @@ class InMemoryKeyColumnValueStore(KeyColumnValueStore):
         # delegates per-cell TTL to backends advertising it (cassandra cell
         # TTL; StoreFeatures.cell_ttl); this store is such a backend.
         self._expiry: Dict[Tuple[bytes, bytes], int] = {}
+        # per-row count of TTL'd cells: limited slices only widen their
+        # range for rows that actually hold expiring cells
+        self._expiry_rows: Dict[bytes, int] = {}
 
     @property
     def name(self) -> str:
         return self._name
 
     def _filter_expired(self, key: bytes, entries: EntryList) -> EntryList:
-        if not self._expiry:
+        if not self._expiry_rows.get(key):
             return entries
         import time
 
@@ -118,12 +121,20 @@ class InMemoryKeyColumnValueStore(KeyColumnValueStore):
             out.append(e)
         return out
 
+    def _drop_expiry(self, key: bytes, col: bytes) -> None:
+        if self._expiry.pop((key, col), None) is not None:
+            n = self._expiry_rows.get(key, 0) - 1
+            if n > 0:
+                self._expiry_rows[key] = n
+            else:
+                self._expiry_rows.pop(key, None)
+
     def get_slice(self, query: KeySliceQuery, txh: StoreTransaction) -> EntryList:
         row = self._rows.get(query.key)
         if row is None:
             return []
         sq = query.slice
-        if self._expiry and sq.limit is not None:
+        if sq.limit is not None and self._expiry_rows.get(query.key):
             # filter BEFORE limiting: expired cells must not occupy the
             # limit window (native cell-TTL backends count live cells only)
             live = self._filter_expired(query.key, row.slice(
@@ -144,16 +155,20 @@ class InMemoryKeyColumnValueStore(KeyColumnValueStore):
             added_cols = set()
             for e in additions:
                 if len(e) >= 3 and e[2]:
+                    if (key, e[0]) not in self._expiry:
+                        self._expiry_rows[key] = (
+                            self._expiry_rows.get(key, 0) + 1
+                        )
                     self._expiry[(key, e[0])] = e[2]
                 else:
-                    self._expiry.pop((key, e[0]), None)
+                    self._drop_expiry(key, e[0])
                 plain.append((e[0], e[1]))
                 added_cols.add(e[0])
             for col in deletions:
                 # additions override same-column deletions (_Row.mutated
                 # contract) — their freshly-recorded expiry must survive too
                 if col not in added_cols:
-                    self._expiry.pop((key, col), None)
+                    self._drop_expiry(key, col)
             row = self._rows.get(key, _EMPTY_ROW)
             new_row = row.mutated(plain, deletions)
             if new_row.is_empty():
@@ -194,7 +209,7 @@ class InMemoryKeyColumnValueStore(KeyColumnValueStore):
             by_key: Dict[bytes, List[bytes]] = {}
             for k, c in dead:
                 by_key.setdefault(k, []).append(c)
-                del self._expiry[(k, c)]
+                self._drop_expiry(k, c)
             for k, cols in by_key.items():
                 row = self._rows.get(k)
                 if row is None:
@@ -214,6 +229,7 @@ class InMemoryKeyColumnValueStore(KeyColumnValueStore):
         with self._write_lock:
             self._rows.clear()
             self._expiry.clear()
+            self._expiry_rows.clear()
 
 
 class InMemoryStoreManager(KeyColumnValueStoreManager):

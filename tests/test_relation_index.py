@@ -1,0 +1,188 @@
+"""RelationTypeIndex: vertex-centric indexes built AFTER the edge label
+exists (reference: ManagementSystem.buildEdgeIndex ->
+core/schema/RelationTypeIndex.java; cells are a duplicate relation type,
+invisible to normal traversal, queried via sort-key column ranges)."""
+
+import pytest
+
+from janusgraph_tpu.core.codecs import Direction
+from janusgraph_tpu.core.graph import open_graph
+from janusgraph_tpu.exceptions import QueryError, SchemaViolationError
+
+
+def _graph_with_data():
+    g = open_graph()
+    m = g.management()
+    m.make_property_key("time", int)
+    m.make_edge_label("battled")  # NO sort key at creation
+    tx = g.new_transaction()
+    h = tx.add_vertex()
+    monsters = []
+    for t in (1, 5, 9, 12, 20):
+        mv = tx.add_vertex()
+        tx.add_edge(h, "battled", mv, time=t)
+        monsters.append((t, mv.id))
+    tx.commit()
+    return g, h.id, monsters
+
+
+def test_build_reindex_and_query():
+    g, hid, monsters = _graph_with_data()
+    m = g.management()
+    ri = m.build_edge_index("battled", "battlesByTime", ["time"])
+    assert ri.status == "REGISTERED"
+    # pre-existing edges need the reindex pass
+    n = m.reindex_relation_index("battlesByTime")
+    assert n == 5
+    tx = g.new_transaction()
+    hits = tx.get_edges(
+        tx.get_vertex(hid), Direction.OUT, ("battled",), sort_range=(5, 12)
+    )
+    assert sorted(e.value("time") for e in hits) == [5, 9]
+    g.close()
+
+
+def test_new_edges_indexed_without_reindex():
+    g, hid, _ = _graph_with_data()
+    m = g.management()
+    m.build_edge_index("battled", "battlesByTime", ["time"])
+    m.reindex_relation_index("battlesByTime")
+    tx = g.new_transaction()
+    h = tx.get_vertex(hid)
+    mv = tx.add_vertex()
+    tx.add_edge(h, "battled", mv, time=7)
+    tx.commit()
+    tx2 = g.new_transaction()
+    hits = tx2.get_edges(
+        tx2.get_vertex(hid), Direction.OUT, ("battled",), sort_range=(6, 10)
+    )
+    assert sorted(e.value("time") for e in hits) == [7, 9]
+    g.close()
+
+
+def test_overlay_edges_respect_index_range():
+    g, hid, _ = _graph_with_data()
+    m = g.management()
+    m.build_edge_index("battled", "battlesByTime", ["time"])
+    m.reindex_relation_index("battlesByTime")
+    tx = g.new_transaction()
+    h = tx.get_vertex(hid)
+    mv = tx.add_vertex()
+    tx.add_edge(h, "battled", mv, time=8)  # uncommitted
+    hits = tx.get_edges(h, Direction.OUT, ("battled",), sort_range=(6, 10))
+    assert sorted(e.value("time") for e in hits) == [8, 9]
+    g.close()
+
+
+def test_index_cells_invisible_to_plain_traversal():
+    g, hid, monsters = _graph_with_data()
+    m = g.management()
+    m.build_edge_index("battled", "battlesByTime", ["time"])
+    m.reindex_relation_index("battlesByTime")
+    tx = g.new_transaction()
+    edges = tx.get_edges(tx.get_vertex(hid), Direction.OUT, ())
+    assert len(edges) == 5  # no duplicates from index cells
+    assert {e.label for e in edges} == {"battled"}
+    # OLAP load is equally blind to index cells
+    from janusgraph_tpu.olap.csr import load_csr
+
+    csr = load_csr(g)
+    assert csr.num_edges == 5
+    g.close()
+
+
+def test_unindexed_label_range_still_rejected():
+    g, hid, _ = _graph_with_data()
+    tx = g.new_transaction()
+    with pytest.raises(QueryError):
+        tx.get_edges(
+            tx.get_vertex(hid), Direction.OUT, ("battled",), sort_range=(1, 2)
+        )
+    g.close()
+
+
+def test_disabled_index_not_used():
+    g, hid, _ = _graph_with_data()
+    m = g.management()
+    m.build_edge_index("battled", "battlesByTime", ["time"])
+    m.reindex_relation_index("battlesByTime")
+    m.set_relation_index_status("battlesByTime", "DISABLED")
+    tx = g.new_transaction()
+    with pytest.raises(QueryError):
+        tx.get_edges(
+            tx.get_vertex(hid), Direction.OUT, ("battled",), sort_range=(1, 2)
+        )
+    g.close()
+
+
+def test_build_validation():
+    g = open_graph()
+    m = g.management()
+    m.make_property_key("note", str)  # variable-width
+    m.make_property_key("t", int)
+    m.make_edge_label("l")
+    with pytest.raises(SchemaViolationError):
+        m.build_edge_index("nope", "x", ["t"])
+    with pytest.raises(SchemaViolationError):
+        m.build_edge_index("l", "x", ["note"])  # not fixed width
+    with pytest.raises(SchemaViolationError):
+        m.build_edge_index("l", "x", [])
+    g.close()
+
+
+def test_delete_via_index_routed_edge_removes_primary(
+
+
+):
+    g, hid, _ = _graph_with_data()
+    m = g.management()
+    m.build_edge_index("battled", "battlesByTime", ["time"])
+    m.reindex_relation_index("battlesByTime")
+    tx = g.new_transaction()
+    h = tx.get_vertex(hid)
+    [e] = tx.get_edges(h, Direction.OUT, ("battled",), sort_range=(5, 6))
+    e.remove()
+    tx.commit()
+    tx2 = g.new_transaction()
+    plain = tx2.get_edges(tx2.get_vertex(hid), Direction.OUT, ("battled",))
+    assert sorted(x.value("time") for x in plain) == [1, 9, 12, 20]
+    ranged = tx2.get_edges(
+        tx2.get_vertex(hid), Direction.OUT, ("battled",), sort_range=(0, 50)
+    )
+    assert sorted(x.value("time") for x in ranged) == [1, 9, 12, 20]
+    g.close()
+
+
+def test_delete_while_disabled_leaves_no_phantom():
+    g, hid, _ = _graph_with_data()
+    m = g.management()
+    m.build_edge_index("battled", "battlesByTime", ["time"])
+    m.reindex_relation_index("battlesByTime")
+    m.set_relation_index_status("battlesByTime", "DISABLED")
+    tx = g.new_transaction()
+    h = tx.get_vertex(hid)
+    [e] = [x for x in tx.get_edges(h, Direction.OUT, ("battled",))
+           if x.value("time") == 9]
+    e.remove()
+    tx.commit()
+    m.set_relation_index_status("battlesByTime", "ENABLED")
+    tx2 = g.new_transaction()
+    ranged = tx2.get_edges(
+        tx2.get_vertex(hid), Direction.OUT, ("battled",), sort_range=(0, 50)
+    )
+    assert sorted(x.value("time") for x in ranged) == [1, 5, 12, 20]
+    g.close()
+
+
+def test_input_format_blind_to_index_cells():
+    from janusgraph_tpu.olap.input_format import GraphInputFormat
+
+    g, hid, _ = _graph_with_data()
+    m = g.management()
+    m.build_edge_index("battled", "battlesByTime", ["time"])
+    m.reindex_relation_index("battlesByTime")
+    svs = list(GraphInputFormat(g).read_all())
+    edges = [e for sv in svs for e in sv.edges]
+    assert len(edges) == 5
+    assert {lbl for lbl, _other, _p in edges} == {"battled"}
+    g.close()
