@@ -582,11 +582,19 @@ class ManagementSystem:
     def print_schema(self) -> str:
         """Formatted schema overview (reference:
         ManagementSystem.printSchema — property keys, labels, indexes)."""
+        def _mods(el):
+            out = []
+            if getattr(el, "consistency", Consistency.DEFAULT) != Consistency.DEFAULT:
+                out.append(el.consistency.name)
+            if getattr(el, "ttl_seconds", 0):
+                out.append(f"ttl={el.ttl_seconds}s")
+            return (" " + " ".join(out)) if out else ""
+
         lines = ["--- property keys ---"]
         for pk in sorted(self.property_keys(), key=lambda e: e.name):
             lines.append(
                 f"{pk.name:<24} {pk.data_type.__name__:<12} "
-                f"{pk.cardinality.name}"
+                f"{pk.cardinality.name}{_mods(pk)}"
             )
         lines.append("--- edge labels ---")
         for el in sorted(self.edge_labels(), key=lambda e: e.name):
@@ -599,7 +607,7 @@ class ManagementSystem:
                 sk = f" sortKey={','.join(names)}"
             lines.append(
                 f"{el.name:<24} {el.multiplicity.name}"
-                f"{' unidirected' if el.unidirected else ''}{sk}"
+                f"{' unidirected' if el.unidirected else ''}{sk}{_mods(el)}"
             )
         lines.append("--- vertex labels ---")
         for vl in sorted(self.vertex_labels(), key=lambda e: e.name):
@@ -608,7 +616,19 @@ class ManagementSystem:
                 flags.append("partitioned")
             if vl.static:
                 flags.append("static")
-            lines.append(f"{vl.name:<24} {' '.join(flags)}")
+            lines.append(f"{vl.name:<24} {' '.join(flags)}{_mods(vl)}")
+        lines.append("--- relation indexes ---")
+        for lid, ris in sorted(self.graph.relation_indexes.items()):
+            for ri in ris:
+                label = self.graph.schema_cache.get_by_id(ri.label_id)
+                keys = ",".join(
+                    self.graph.schema_cache.get_by_id(k).name
+                    for k in ri.sort_key
+                )
+                lines.append(
+                    f"{ri.name:<24} on {label.name} [{keys}] "
+                    f"{Direction(ri.direction).name} {ri.status}"
+                )
         lines.append("--- indexes ---")
         for idx in sorted(self.indexes(), key=lambda i: i.name):
             kind = "mixed" if idx.mixed else "composite"
