@@ -229,3 +229,37 @@ def test_limited_slice_counts_live_cells_only():
     s.mutate(b"k", [(b"a", b"1", 1), (b"b", b"2", 1), (b"c", b"3"), (b"d", b"4")], [], stx)
     got = s.get_slice(KeySliceQuery(b"k", SliceQuery(limit=2)), stx)
     assert got == [(b"c", b"3"), (b"d", b"4")]
+
+
+def test_expired_static_vertex_reclaimed_by_ghost_remover():
+    """A TTL'd static vertex whose existence cell expired is a ghost; the
+    ghost remover purges its remaining row (reference: VertexLabel TTL +
+    GhostVertexRemover.java:44 — the same reclamation story)."""
+    from janusgraph_tpu.olap.jobs import GhostVertexRemover, run_scan_job
+
+    g = open_graph()
+    m = g.management()
+    m.make_vertex_label("tick", static=True)
+    m.set_ttl("tick", 1)
+    m.make_property_key("at", int)
+    tx = g.new_transaction()
+    v = tx.add_vertex(label="tick")
+    v.property("at", 7)
+    w = tx.add_vertex()  # unlabeled, no TTL: must survive
+    w.property("at", 9)
+    tx.commit()
+
+    store = g.backend.edgestore
+    while hasattr(store, "wrapped"):
+        store = store.wrapped
+    # expire ONLY the tick vertex's cells (they are the only TTL'd ones)
+    for k in list(store._expiry):
+        store._expiry[k] -= 2_000_000_000
+    if hasattr(g.backend.edgestore, "invalidate_all"):
+        g.backend.edgestore.invalidate_all()
+
+    run_scan_job(g, GhostVertexRemover(g))  # reclaims the expired row
+    tx2 = g.new_transaction()
+    assert tx2.get_vertex(v.id) is None        # expired + purged
+    assert tx2.get_vertex(w.id).value("at") == 9  # untouched
+    g.close()
