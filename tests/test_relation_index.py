@@ -186,3 +186,23 @@ def test_input_format_blind_to_index_cells():
     assert len(edges) == 5
     assert {lbl for lbl, _other, _p in edges} == {"battled"}
     g.close()
+
+
+def test_vertex_removal_strikes_index_cells():
+    g, hid, _ = _graph_with_data()
+    m = g.management()
+    m.build_edge_index("battled", "battlesByTime", ["time"])
+    m.reindex_relation_index("battlesByTime")
+    tx = g.new_transaction()
+    tx.remove_vertex(tx.get_vertex(hid))
+    tx.commit()
+    # raw row must hold NO index cells on the removed vertex's key
+    from janusgraph_tpu.storage.kcvs import KeySliceQuery, SliceQuery
+
+    key = g.idm.get_key(hid)
+    stx = g.backend.manager.begin_transaction()
+    store = g.backend.edgestore
+    while hasattr(store, "wrapped"):
+        store = store.wrapped
+    assert store.get_slice(KeySliceQuery(key, SliceQuery()), stx) == []
+    g.close()
