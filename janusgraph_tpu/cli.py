@@ -168,7 +168,9 @@ def cmd_config_docs(args) -> int:
             f.write(text)
         print(f"wrote {args.out}")
     else:
-        print(text)
+        # identical bytes on both paths: `print` would append a second
+        # newline and make regenerated docs churn a trailing blank line
+        sys.stdout.write(text)
     return 0
 
 

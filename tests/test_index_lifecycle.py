@@ -181,3 +181,22 @@ def test_print_schema_overview():
     assert "composite" in out and "ENABLED" in out
     assert "titan" in out
     g.close()
+
+
+def test_print_schema_shows_modifiers_and_relation_indexes():
+    from janusgraph_tpu.core.codecs import Consistency
+    from janusgraph_tpu.core.graph import open_graph
+
+    g = open_graph()
+    m = g.management()
+    m.make_property_key("session", str)
+    m.set_ttl("session", 60)
+    m.make_property_key("time", int)
+    m.make_edge_label("battled")
+    m.set_consistency("battled", Consistency.FORK)
+    m.build_edge_index("battled", "byTime", ["time"])
+    out = m.print_schema()
+    assert "ttl=60s" in out
+    assert "FORK" in out
+    assert "byTime" in out and "on battled [time] BOTH REGISTERED" in out
+    g.close()
