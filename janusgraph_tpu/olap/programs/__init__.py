@@ -12,3 +12,4 @@ from janusgraph_tpu.olap.programs.olap_traversal import (  # noqa: F401
     TraversalStep,
     steps_from_spec,
 )
+from janusgraph_tpu.olap.programs.degree import DegreeCountProgram  # noqa: F401

@@ -1,0 +1,46 @@
+"""Degree-count vertex program (reference: the degree-counting vertex
+programs exercised by janusgraph-test graphdb/olap/OLAPTest.java:779 — the
+simplest one-superstep message-count program, also the canonical smoke test
+for a GraphComputer implementation).
+
+One superstep: every vertex sends 1 along its out-edges; SUM-combining at
+the receiver yields the in-degree. The out-degree is already a dense CSR
+array, so both orientations land as compute keys in a single pass.
+"""
+
+from __future__ import annotations
+
+from janusgraph_tpu.olap.vertex_program import Combiner, VertexProgram
+
+
+class DegreeCountProgram(VertexProgram):
+    compute_keys = ("in_degree", "out_degree")
+    combiner = Combiner.SUM
+    max_iterations = 1
+
+    def setup(self, graph, xp):
+        n = graph.num_vertices
+        zeros = xp.zeros(n, dtype=xp.float32)
+        return (
+            {
+                "in_degree": zeros,
+                "out_degree": xp.asarray(graph.out_degree, dtype=xp.float32),
+            },
+            {"total": (Combiner.SUM, xp.sum(xp.asarray(graph.out_degree)))},
+        )
+
+    def message(self, state, superstep, graph, xp):
+        # every vertex contributes 1 per out-edge
+        return xp.ones(graph.local_num_vertices, dtype=xp.float32)
+
+    def apply(self, state, aggregated, superstep, memory_in, graph, xp):
+        return (
+            {"in_degree": aggregated, "out_degree": state["out_degree"]},
+            {"total": (Combiner.SUM, xp.sum(aggregated))},
+        )
+
+    def terminate(self, memory):
+        return memory.superstep >= 1
+
+    def terminate_device(self, values, steps_done, xp):
+        return steps_done >= 1

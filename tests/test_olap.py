@@ -226,3 +226,24 @@ def test_ell_auto_strategy_budget():
     assert TPUExecutor(sparse).strategy == "segment"
     # explicit strategy always wins over the heuristic
     assert TPUExecutor(sparse, strategy="ell").strategy == "ell"
+
+
+def test_degree_count_parity():
+    """Degree program: CPU oracle vs TPU executor vs ground truth
+    (reference: the degree-count programs of OLAPTest.java:779)."""
+    import numpy as np
+
+    from janusgraph_tpu.olap.cpu_executor import CPUExecutor
+    from janusgraph_tpu.olap.generators import rmat_csr
+    from janusgraph_tpu.olap.programs import DegreeCountProgram
+    from janusgraph_tpu.olap.tpu_executor import TPUExecutor
+
+    csr = rmat_csr(10, 8)
+    want_in = np.diff(csr.in_indptr).astype(np.float32)
+    for ex in (CPUExecutor(csr), TPUExecutor(csr)):
+        got = ex.run(DegreeCountProgram())
+        np.testing.assert_array_equal(np.asarray(got["in_degree"]), want_in)
+        np.testing.assert_array_equal(
+            np.asarray(got["out_degree"]),
+            csr.out_degree.astype(np.float32),
+        )
