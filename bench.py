@@ -33,7 +33,8 @@ Env knobs: BENCH_SCALES (default "16,20,22,23" — graph500-s23 north
 star last), BENCH_EDGE_FACTOR (16), PR_ITERS (20), BENCH_STRATEGY
 (auto|ell|segment|pallas), BENCH_BUDGET_S (supervisor budget, default
 2700), BENCH_INIT_TIMEOUT_S (cap on backend init before declaring the
-tunnel dead, default 1500), BENCH_CPU_SCALE (fallback scale, 16),
+tunnel dead, default 600 — a wedged claim relay must not eat the budget
+the CPU fallback and prior_tpu_evidence pointer need), BENCH_CPU_SCALE (fallback scale, 16),
 BENCH_EXTRAS_SCALE (default 20 — the ladder rung that additionally runs
 the CC / peer-pressure / 3-hop-count headline workloads; must appear in
 BENCH_SCALES to fire, and its compile time comes out of BENCH_BUDGET_S
@@ -503,7 +504,7 @@ def worker() -> None:
     # artifact distinguishes init-hang from silence, and give up past
     # BENCH_INIT_TIMEOUT_S so a dead tunnel doesn't eat the whole budget
     init_done = threading.Event()
-    init_cap = float(os.environ.get("BENCH_INIT_TIMEOUT_S", "1500"))
+    init_cap = float(os.environ.get("BENCH_INIT_TIMEOUT_S", "600"))
 
     def _ticker():
         while not init_done.wait(20.0):
