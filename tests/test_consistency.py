@@ -161,3 +161,41 @@ def test_chained_updates_through_stale_handle():
     [e3] = tx3.get_vertex(u.id).edges(Direction.OUT, "knows")
     assert e3.value("a") == 1 and e3.value("b") == 2
     g.close()
+
+
+def test_lock_consistency_over_remote_backend():
+    """The distributed story end-to-end: two graph instances whose shared
+    state lives behind the networked KCVS server — lock claims, expected
+    values, and the data cells all ride the wire (reference analogue: two
+    JanusGraph nodes on one Cassandra cluster using consistent-key
+    locking)."""
+    from janusgraph_tpu.storage.inmemory import InMemoryStoreManager
+    from janusgraph_tpu.storage.remote import (
+        RemoteStoreManager,
+        RemoteStoreServer,
+    )
+
+    server = RemoteStoreServer(InMemoryStoreManager()).start()
+    try:
+        host, port = server.address
+        g1 = open_graph(store_manager=RemoteStoreManager(host=host, port=port))
+        g1.management().make_property_key("serial", int)
+        g1.management().set_consistency("serial", Consistency.LOCK)
+        tx = g1.new_transaction()
+        v = tx.add_vertex()
+        v.property("serial", 1)
+        tx.commit()
+
+        g2 = open_graph(store_manager=RemoteStoreManager(host=host, port=port))
+        tx1, tx2 = g1.new_transaction(), g2.new_transaction()
+        tx1.get_vertex(v.id).property("serial", 2)
+        tx2.get_vertex(v.id).property("serial", 3)
+        tx1.commit()
+        with pytest.raises(Exception):
+            tx2.commit()
+        g3 = open_graph(store_manager=RemoteStoreManager(host=host, port=port))
+        assert g3.new_transaction().get_vertex(v.id).value("serial") == 2
+        for g in (g1, g2, g3):
+            g.close()
+    finally:
+        server.stop()
