@@ -287,3 +287,35 @@ class TestSchemaBroadcast:
         assert "byName" in g2.indexes
         g1.close()
         g2.close()
+
+    def test_consistency_change_reaches_other_instance(self):
+        """set_consistency's eviction broadcast refreshes the OTHER
+        instance's schema cache, so its next commit honors the LOCK
+        modifier (the cluster-agreement half of ConsistencyModifier)."""
+        from janusgraph_tpu.core.codecs import Consistency
+
+        mgr = InMemoryStoreManager()
+        g1 = JanusGraphTPU({"ids.authority-wait-ms": 0.0}, store_manager=mgr)
+        g2 = JanusGraphTPU({"ids.authority-wait-ms": 0.0}, store_manager=mgr)
+        g1.management().make_property_key("serial", int)
+        g1.management().broadcast_eviction(
+            g1.schema_cache.get_by_name("serial").id
+        )
+        deadline = time.monotonic() + 2.0
+        while (
+            g2.schema_cache.get_by_name("serial") is None
+            and time.monotonic() < deadline
+        ):
+            time.sleep(0.01)
+        g1.management().set_consistency("serial", Consistency.LOCK)
+        deadline = time.monotonic() + 2.0
+        while (
+            getattr(
+                g2.schema_cache.get_by_name("serial"), "consistency", None
+            ) is not Consistency.LOCK
+            and time.monotonic() < deadline
+        ):
+            time.sleep(0.01)
+        assert g2.management().get_consistency("serial") is Consistency.LOCK
+        g1.close()
+        g2.close()
