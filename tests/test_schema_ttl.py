@@ -263,3 +263,15 @@ def test_expired_static_vertex_reclaimed_by_ghost_remover():
     assert tx2.get_vertex(v.id) is None        # expired + purged
     assert tx2.get_vertex(w.id).value("at") == 9  # untouched
     g.close()
+
+
+def test_ttl_rejected_on_backend_without_cell_ttl(tmp_path):
+    """Backends without native cell TTL reject set_ttl (reference: the
+    berkeleyje backend likewise cannot honor setTTL)."""
+    from janusgraph_tpu.storage.localstore import open_local_kcvs
+
+    g = open_graph(store_manager=open_local_kcvs(str(tmp_path)))
+    g.management().make_property_key("s", str)
+    with pytest.raises(SchemaViolationError):
+        g.management().set_ttl("s", 10)
+    g.close()
