@@ -2,6 +2,15 @@
 janusgraph-examples + GraphOfTheGodsFactory.java:41): load the canonical
 demo graph, run OLTP traversals, then OLAP PageRank on the TPU executor."""
 
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")  # demo stays on host devices
+
 from janusgraph_tpu.core import gods
 from janusgraph_tpu.core.graph import open_graph
 from janusgraph_tpu.core.traversal import P

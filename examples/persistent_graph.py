@@ -1,6 +1,11 @@
 """Persistent local-backend example (reference analogue: the berkeleyje
 example app): data survives process restarts via the WAL-backed store."""
 
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
 import sys
 import tempfile
 

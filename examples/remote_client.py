@@ -1,6 +1,11 @@
 """Remote access example: start a server, query it over HTTP and WebSocket
 (reference analogue: janusgraph-examples remote graph app)."""
 
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
 from janusgraph_tpu.core import gods
 from janusgraph_tpu.core.graph import open_graph
 from janusgraph_tpu.driver import JanusGraphClient
