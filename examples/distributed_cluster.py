@@ -16,7 +16,9 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 import jax
 
-jax.config.update("jax_platforms", "cpu")  # demo stays on host devices
+# host devices by default (the ambient env may point JAX at a TPU that a
+# demo should not claim); set JG_EXAMPLE_PLATFORM=tpu to run the real chip
+jax.config.update("jax_platforms", os.environ.get("JG_EXAMPLE_PLATFORM", "cpu"))
 
 import numpy as np
 

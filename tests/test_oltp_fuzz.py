@@ -66,7 +66,9 @@ def test_fuzz_mutations_match_oracle():
                 e for e in model["edges"] if e[0] != vid and e[2] != vid
             ]
         for e in pending["removed_e"]:
-            model["edges"].remove(e)
+            # an endpoint removed in the same tx already dropped the edge
+            if e in model["edges"]:
+                model["edges"].remove(e)
         pending["vertices"].clear()
         pending["edges"].clear()
         pending["removed_v"].clear()
@@ -77,8 +79,9 @@ def test_fuzz_mutations_match_oracle():
 
     def vertex_pool():
         return [
-            vid for vid in
-            list(model["vertices"]) + list(pending["vertices"])
+            vid for vid in dict.fromkeys(
+                list(model["vertices"]) + list(pending["vertices"])
+            )
             if vid not in pending["removed_v"]
         ]
 
@@ -105,11 +108,27 @@ def test_fuzz_mutations_match_oracle():
             k, val = f"p{rng.randint(0,1)}", rng.randint(0, 99)
             v.property(k, val)
             pending["vertices"].setdefault(vid, {})[k] = val
-        elif op < 0.85 and pool:
+        elif op < 0.82 and pool:
             vid = rng.choice(pool)
             v = live_handles.get(vid) or tx.get_vertex(vid)
             tx.remove_vertex(v)
             pending["removed_v"].add(vid)
+        elif op < 0.90:
+            # remove one committed edge through a loaded handle
+            committed = [
+                e for e in model["edges"]
+                if e[0] not in pending["removed_v"]
+                and e[2] not in pending["removed_v"]
+                and e not in pending["removed_e"]
+            ]
+            if committed:
+                src, lbl, dst = rng.choice(committed)
+                v = tx.get_vertex(src)
+                for e in tx.get_edges(v, Direction.OUT, (lbl,)):
+                    if e.in_vertex.id == dst and not e.is_new:
+                        tx.remove_edge(e)
+                        pending["removed_e"].append((src, lbl, dst))
+                        break
         else:
             commit()
     commit()
