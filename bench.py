@@ -165,9 +165,12 @@ def _final_result(stages, fallback_note=None):
                 os.listdir(evidence),
                 key=lambda a: os.path.getmtime(os.path.join(evidence, a)),
             )
+            # a full-ladder supervisor capture is the strongest evidence;
+            # fall back to whatever hardware artifact is newest
+            full = [a for a in arts if "supervisor_full" in a]
             if arts:
                 out["prior_tpu_evidence"] = os.path.join(
-                    "bench_artifacts", arts[-1]
+                    "bench_artifacts", (full or arts)[-1]
                 )
                 out["prior_tpu_evidence_count"] = len(arts)
     return out
