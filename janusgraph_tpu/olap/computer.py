@@ -141,6 +141,7 @@ def run_on(
     sync_every: int = 1,
     checkpoint_every: int = 0,
     checkpoint_path: str = None,
+    frontier: str = "auto",
 ):
     if executor == "cpu":
         from janusgraph_tpu.olap.cpu_executor import CPUExecutor
@@ -150,7 +151,10 @@ def run_on(
         from janusgraph_tpu.olap.tpu_executor import TPUExecutor
 
         return TPUExecutor(
-            csr, strategy=strategy, ell_max_capacity=ell_max_capacity
+            csr,
+            strategy=strategy,
+            ell_max_capacity=ell_max_capacity,
+            frontier=frontier,
         ).run(
             program,
             sync_every=sync_every,

@@ -1,0 +1,248 @@
+"""Frontier-compacted SSSP/BFS supersteps (push-style, capped expansion).
+
+Reference behavior modeled: FulgoraGraphComputer special-cases the
+ShortestPath programs rather than running them through the generic BSP loop
+(reference: janusgraph-core .../olap/computer/FulgoraGraphComputer.java:249-253).
+The TPU-native form of that special case is *frontier compaction*: a dense
+superstep gathers every edge every superstep — at the measured v5e gather
+wall (~140M gathered elem/s, docs/tpu_notes.md) that is ~1.9 s/superstep at
+scale 23 even when the BFS frontier is a handful of vertices. Here each hop:
+
+  1. compacts the active frontier to a capped index buffer
+     (``jnp.nonzero(size=F_cap)`` — static shape, XLA-friendly),
+  2. expands it to a capped edge buffer via scatter+cumsum "pointer
+     spreading" (NO searchsorted: binary search is itself a gather chain
+     and would re-hit the gather wall),
+  3. gathers only the frontier's out-neighbors (E_frontier elements, not E),
+  4. scatter-mins the relaxed distances into the state.
+
+Tiers: one executable per (F_cap, E_cap) pair, caps growing in powers of 4
+up to (n, m) — the top tier IS the dense fallback, so a saturated frontier
+costs one full-edge pass and nothing is ever dropped. Per-step results are
+bit-identical to the dense BSP path: relaxing a non-frontier edge is a
+no-op (its source's distance has not changed since it was last relaxed), so
+skipping it cannot change any superstep's output, weighted or not.
+
+Int32 throughout (the telescoping cumsum trick needs diff headroom, hence
+the ``m < 2**30`` eligibility guard — beyond that the executor keeps the
+dense path).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+import numpy as np
+
+INF = 1e18
+
+
+def _tier(need: int, lo: int, hi: int) -> int:
+    """Smallest power-of-4 multiple of `lo`, >= need, clamped to hi (callers
+    guarantee hi >= need)."""
+    c = lo
+    while c < need:
+        c *= 4
+    return min(c, hi)
+
+
+class FrontierEngine:
+    """Per-executor engine: owns the device-resident CSR pointer arrays and
+    the tier-compiled step executables for ShortestPath-family programs."""
+
+    F_MIN = 1 << 10
+    E_MIN = 1 << 13
+    #: int32 telescoping headroom (see module docstring)
+    MAX_EDGES = 1 << 30
+
+    def __init__(self, executor):
+        self.ex = executor
+        self.jax = executor.jax
+        self.jnp = executor.jnp
+        csr = executor.csr
+        jnp = self.jnp
+        self.n = csr.num_vertices
+        self.m = csr.num_edges
+        if self.m >= self.MAX_EDGES:
+            raise ValueError("frontier engine requires < 2^30 edges")
+        # indptr padded to n+2 so a sentinel row (index n) reads degree 0
+        out_ip = np.concatenate(
+            [csr.out_indptr, csr.out_indptr[-1:]]
+        ).astype(np.int32)
+        in_ip = np.concatenate(
+            [csr.in_indptr, csr.in_indptr[-1:]]
+        ).astype(np.int32)
+        g = executor.g
+        # out_dst / in_src reuse the executor's device copies (no 2nd O(E)
+        # transfer); pointer/degree vectors are O(n) and shipped here once
+        self.fargs = {
+            "out_ip": jnp.asarray(out_ip),
+            "out_dst": g.out_dst,
+            "out_deg": jnp.asarray(np.diff(csr.out_indptr).astype(np.int32)),
+            "in_ip": jnp.asarray(in_ip),
+            "in_src": g.in_src,
+            "in_deg": jnp.asarray(np.diff(csr.in_indptr).astype(np.int32)),
+        }
+        if g.out_edge_weight is not None:
+            self.fargs["out_w"] = g.out_edge_weight
+        if g.in_edge_weight is not None:
+            self.fargs["in_w"] = g.in_edge_weight
+        self._plan = None
+
+    # ------------------------------------------------------------------ plan
+    def _plan_fn(self):
+        """(mask, fargs) -> (frontier count, out-edge total, in-edge total):
+        O(n) vector work, one fetch of three scalars per hop."""
+        if self._plan is not None:
+            return self._plan
+        jnp = self.jnp
+
+        def plan(mask, fargs):
+            zero = jnp.zeros((), jnp.int32)
+            count = jnp.sum(mask.astype(jnp.int32))
+            tot_out = jnp.sum(jnp.where(mask, fargs["out_deg"], zero))
+            tot_in = jnp.sum(jnp.where(mask, fargs["in_deg"], zero))
+            return count, tot_out, tot_in
+
+        self._plan = self.jax.jit(plan)
+        return self._plan
+
+    # ------------------------------------------------------------------ step
+    def _expand(self, idx, indptr, dst, E_cap):
+        """Capped frontier expansion: frontier rows -> (owner slot, edge pos,
+        neighbor, valid) buffers of static length E_cap.
+
+        own/pos come from scatter+cumsum over the *frontier-sized* start
+        offsets (telescoping piecewise-constant encoding) — per-slot cost is
+        two vector cumsums plus ONE m-table gather (dst), instead of a
+        log(F)-deep searchsorted gather chain.
+        """
+        jnp = self.jnp
+        F_cap = idx.shape[0]
+        starts = indptr[idx]
+        degs = indptr[idx + 1] - starts
+        cum = jnp.cumsum(degs)
+        total = cum[-1]
+        cum_ex = cum - degs
+        # ownership: +1 at each row's first slot (row 0 starts at owner 0);
+        # deg-0 rows collapse onto the next row's start and the scatter-adds
+        # accumulate, so cumsum lands on the LAST row covering a slot
+        inc = jnp.ones((F_cap,), jnp.int32).at[0].set(0)
+        own = jnp.cumsum(
+            jnp.zeros((E_cap,), jnp.int32).at[cum_ex].add(inc, mode="drop")
+        )
+        # edge position: pos[s] = s + (starts - cum_ex)[own[s]], encoded the
+        # same way (scatter the base DIFFS, cumsum telescopes them)
+        base = starts - cum_ex
+        dbase = jnp.concatenate([base[:1], jnp.diff(base)])
+        pos = jnp.arange(E_cap, dtype=jnp.int32) + jnp.cumsum(
+            jnp.zeros((E_cap,), jnp.int32).at[cum_ex].add(dbase, mode="drop")
+        )
+        valid = jnp.arange(E_cap, dtype=jnp.int32) < total
+        pos = jnp.clip(pos, 0, dst.shape[0] - 1)
+        nbr = jnp.where(valid, dst[pos], jnp.int32(self.n))
+        return own, pos, nbr, valid
+
+    def _step_fn(self, F_cap, E_cap, weighted, track_paths, undirected):
+        key = ("frontier-step", F_cap, E_cap, weighted, track_paths, undirected)
+        cache = self.ex._compiled
+        if key in cache:
+            return cache[key]
+        jnp = self.jnp
+        n = self.n
+
+        def one_orientation(tmp, dist, idx, indptr, dst, w):
+            own, pos, nbr, valid = self._expand(idx, indptr, dst, E_cap)
+            if weighted:
+                # message = sender distance (+ edge weight when present);
+                # invalid slots target the sentinel row, but mask the value
+                # anyway so a clamped gather can never leak a finite number
+                dist_f = dist[jnp.clip(idx, 0, n - 1)]
+                msg = dist_f[own]
+                if w is not None:
+                    msg = msg + w[pos]
+            elif track_paths:
+                # message = sender's (global) vertex index; MIN-combining
+                # yields the smallest-index frontier predecessor — the same
+                # encoding the dense program uses (programs/shortest_path.py)
+                msg = idx.astype(jnp.float32)[own]
+            else:
+                # unweighted: any finite marker means "reached this hop"
+                msg = jnp.zeros((E_cap,), jnp.float32)
+            msg = jnp.where(valid, msg, INF)
+            return tmp.at[nbr].min(msg)
+
+        def step(dist, pred, mask, t, fargs):
+            idx = jnp.nonzero(mask, size=F_cap, fill_value=n)[0]
+            idx = idx.astype(jnp.int32)
+            tmp = jnp.full((n + 1,), INF, jnp.float32)
+            tmp = one_orientation(
+                tmp, dist, idx, fargs["out_ip"], fargs["out_dst"],
+                fargs.get("out_w") if weighted else None,
+            )
+            if undirected:
+                tmp = one_orientation(
+                    tmp, dist, idx, fargs["in_ip"], fargs["in_src"],
+                    fargs.get("in_w") if weighted else None,
+                )
+            tmp = tmp[:n]
+            if weighted:
+                new = jnp.minimum(dist, tmp)
+                changed = new < dist
+                return new, pred, changed, jnp.sum(changed.astype(jnp.int32))
+            newly = (dist >= INF) & (tmp < INF)
+            new = jnp.where(newly, t + 1.0, dist)
+            if track_paths:
+                pred = jnp.where(newly, tmp, pred)
+            return new, pred, newly, jnp.sum(newly.astype(jnp.int32))
+
+        fn = self.jax.jit(step)
+        cache[key] = fn
+        return fn
+
+    # ------------------------------------------------------------------- run
+    def run(self, program) -> Dict[str, np.ndarray]:
+        """Host-driven hop loop: plan (3 scalars) -> pick tier -> one
+        compiled step. Two device round trips per hop; per-step output is
+        identical to the dense BSP path's."""
+        jax, jnp = self.jax, self.jnp
+        n = self.n
+        weighted = program.weighted
+        track = program.track_paths
+        und = program.undirected
+        idx0 = np.arange(n, dtype=np.int64)
+        dist = jnp.asarray(
+            np.where(idx0 == program.seed_index, 0.0, INF), jnp.float32
+        )
+        pred = None
+        if track:
+            pred = jnp.asarray(
+                np.where(
+                    idx0 == program.seed_index,
+                    float(program.seed_index), -1.0,
+                ),
+                jnp.float32,
+            )
+        mask = jnp.asarray(idx0 == program.seed_index)
+        plan = self._plan_fn()
+        if self.m == 0:
+            mask = jnp.zeros_like(mask)
+        for t in range(program.max_iterations):
+            count, tot_out, tot_in = (
+                int(x) for x in jax.device_get(plan(mask, self.fargs))
+            )
+            if count == 0:
+                break
+            need_e = max(tot_out, tot_in if und else 0, 1)
+            fn = self._step_fn(
+                _tier(count, self.F_MIN, n),
+                _tier(need_e, self.E_MIN, self.m),
+                weighted, track, und,
+            )
+            dist, pred, mask, _ = fn(
+                dist, pred, mask, jnp.asarray(t, jnp.float32), self.fargs
+            )
+        out = {"distance": np.asarray(dist)}
+        if track:
+            out["predecessor"] = np.asarray(pred)
+        return out

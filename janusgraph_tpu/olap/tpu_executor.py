@@ -136,6 +136,7 @@ class TPUExecutor:
         use_pallas: bool = False,
         strategy: str = "auto",
         ell_max_capacity: int = None,
+        frontier: str = "auto",
     ):
         import jax
         import jax.numpy as jnp
@@ -149,6 +150,12 @@ class TPUExecutor:
             strategy = "pallas"
         if strategy not in ("auto", "ell", "segment", "pallas"):
             raise ValueError(f"unknown aggregation strategy: {strategy!r}")
+        if frontier not in ("auto", "off"):
+            raise ValueError(f"unknown frontier mode: {frontier!r}")
+        # Frontier-compacted SSSP/BFS (olap/frontier.py): the ShortestPath
+        # special-case, mirroring FulgoraGraphComputer.java:249-253
+        self._frontier_cfg = frontier
+        self._frontier_engine = None
         # "auto" resolves lazily per edge view: an undirected program packs
         # in+out edges (~2x footprint), so the budget check must see the
         # view it will actually ship
@@ -555,6 +562,12 @@ class TPUExecutor:
         a failed Fulgora iteration aborts outright).
         """
         jnp = self.jnp
+        if (
+            not checkpoint_path
+            and self._frontier_cfg != "off"
+            and self._frontier_eligible(program)
+        ):
+            return self._run_frontier(program)
         if fused is None:
             fused = program.fused_eligible()
         if fused and type(program).combiner_for is VertexProgram.combiner_for:
@@ -564,6 +577,31 @@ class TPUExecutor:
         return self._run_host_loop(
             program, sync_every, checkpoint_path, checkpoint_every, resume
         )
+
+    def _frontier_eligible(self, program: VertexProgram) -> bool:
+        from janusgraph_tpu.olap.frontier import FrontierEngine
+        from janusgraph_tpu.olap.programs.shortest_path import (
+            ShortestPathProgram,
+        )
+
+        return (
+            type(program) is ShortestPathProgram
+            and self.csr.num_edges < FrontierEngine.MAX_EDGES
+            # track_paths encodes predecessor indices in float32 — the dense
+            # path's setup() raises above 2^24 vertices; mirror that guard
+            # here instead of silently rounding predecessors
+            and not (
+                program.track_paths
+                and self.csr.num_vertices >= (1 << 24)
+            )
+        )
+
+    def _run_frontier(self, program: VertexProgram) -> Dict[str, np.ndarray]:
+        from janusgraph_tpu.olap.frontier import FrontierEngine
+
+        if self._frontier_engine is None:
+            self._frontier_engine = FrontierEngine(self)
+        return self._frontier_engine.run(program)
 
     def _run_fused(
         self,

@@ -1,0 +1,158 @@
+"""Frontier-compacted SSSP/BFS (olap/frontier.py).
+
+Parity gates: the frontier path must be step-for-step identical to both the
+scalar CPU oracle and the dense TPU BSP path (frontier="off") — the
+ShortestPath special-case must never change results, only cost (reference
+model: FulgoraGraphComputer.java:249-253 special-casing ShortestPath).
+"""
+
+import numpy as np
+import pytest
+
+from janusgraph_tpu.olap import csr_from_edges
+from janusgraph_tpu.olap.cpu_executor import CPUExecutor
+from janusgraph_tpu.olap.frontier import _tier
+from janusgraph_tpu.olap.programs import ShortestPathProgram
+from janusgraph_tpu.olap.programs.shortest_path import reconstruct_path
+from janusgraph_tpu.olap.tpu_executor import TPUExecutor
+
+
+def random_graph(n=300, m=1500, seed=7, weights=False):
+    rng = np.random.default_rng(seed)
+    src = rng.integers(0, n, m).astype(np.int32)
+    dst = rng.integers(0, n, m).astype(np.int32)
+    w = rng.uniform(0.5, 2.0, m).astype(np.float32) if weights else None
+    return csr_from_edges(n, src, dst, w)
+
+
+def supernode_graph(n=400, seed=3):
+    """Vertex 0 is a hub (out-edges to everyone), many deg-0 vertices, plus
+    a sparse tail — exercises deg-0 collapse in the ownership scatter and
+    uneven tier growth."""
+    rng = np.random.default_rng(seed)
+    hub_dst = np.arange(1, n // 2, dtype=np.int32)
+    hub_src = np.zeros(len(hub_dst), dtype=np.int32)
+    tail_src = rng.integers(1, n // 2, 200).astype(np.int32)
+    tail_dst = rng.integers(0, n, 200).astype(np.int32)
+    return csr_from_edges(
+        n,
+        np.concatenate([hub_src, tail_src]),
+        np.concatenate([hub_dst, tail_dst]),
+    )
+
+
+def _dist(res):
+    d = np.asarray(res["distance"])
+    return np.where(d >= 1e17, np.inf, d)
+
+
+CASES = [
+    ("bfs", dict()),
+    ("bfs_undirected", dict(undirected=True)),
+    ("weighted", dict(weighted=True)),
+    ("weighted_undirected", dict(weighted=True, undirected=True)),
+    ("tracked", dict(track_paths=True)),
+]
+
+
+@pytest.mark.parametrize("name,kw", CASES, ids=[c[0] for c in CASES])
+def test_frontier_matches_cpu_and_dense(name, kw):
+    csr = random_graph(weights=kw.get("weighted", False))
+    prog = lambda: ShortestPathProgram(seed_index=0, **kw)  # noqa: E731
+    cpu = CPUExecutor(csr).run(prog())
+    dense = TPUExecutor(csr, frontier="off").run(prog())
+    ex = TPUExecutor(csr)
+    assert ex._frontier_eligible(prog())
+    sparse = ex.run(prog())
+    np.testing.assert_allclose(_dist(sparse), _dist(cpu), rtol=1e-6)
+    np.testing.assert_allclose(_dist(sparse), _dist(dense), rtol=1e-6)
+    if "predecessor" in sparse:
+        np.testing.assert_array_equal(
+            sparse["predecessor"], dense["predecessor"]
+        )
+
+
+def test_frontier_supernode_deg0():
+    csr = supernode_graph()
+    prog = lambda: ShortestPathProgram(seed_index=0)  # noqa: E731
+    cpu = CPUExecutor(csr).run(prog())
+    sparse = TPUExecutor(csr).run(prog())
+    np.testing.assert_allclose(_dist(sparse), _dist(cpu), rtol=1e-6)
+
+
+@pytest.mark.parametrize("max_iter", [0, 1, 2, 3])
+def test_frontier_step_parity_at_cutoff(max_iter):
+    """Per-superstep parity, not just fixpoint parity: truncated runs must
+    agree with the dense path at every intermediate hop."""
+    csr = random_graph(n=120, m=500, seed=11)
+    mk = lambda: ShortestPathProgram(seed_index=0, max_iterations=max_iter)  # noqa: E731
+    dense = TPUExecutor(csr, frontier="off").run(mk())
+    sparse = TPUExecutor(csr).run(mk())
+    np.testing.assert_allclose(_dist(sparse), _dist(dense), rtol=1e-6)
+
+
+def test_frontier_weighted_cutoff_parity():
+    csr = random_graph(n=120, m=500, seed=13, weights=True)
+    for it in (1, 2, 4):
+        mk = lambda: ShortestPathProgram(  # noqa: E731
+            seed_index=5, weighted=True, max_iterations=it
+        )
+        dense = TPUExecutor(csr, frontier="off").run(mk())
+        sparse = TPUExecutor(csr).run(mk())
+        np.testing.assert_allclose(_dist(sparse), _dist(dense), rtol=1e-6)
+
+
+def test_frontier_path_reconstruction():
+    csr = random_graph(n=150, m=700, seed=19)
+    res = TPUExecutor(csr).run(
+        ShortestPathProgram(seed_index=0, track_paths=True)
+    )
+    dist = _dist(res)
+    reached = [v for v in range(csr.num_vertices) if np.isfinite(dist[v])]
+    assert len(reached) > 1
+    for v in reached[:20]:
+        path = reconstruct_path(res, v)
+        assert path is not None and path[0] == 0 and path[-1] == v
+        assert len(path) == int(dist[v]) + 1
+        # every hop is a real edge
+        for a, b in zip(path, path[1:]):
+            row = csr.out_dst[csr.out_indptr[a]:csr.out_indptr[a + 1]]
+            assert b in row.tolist()
+
+
+def test_frontier_line_graph_many_hops():
+    """Tiny frontier (1 vertex) for many hops — the compaction sweet spot;
+    also crosses tier boundaries as the hop index grows."""
+    n = 40
+    src = np.arange(n - 1, dtype=np.int32)
+    dst = np.arange(1, n, dtype=np.int32)
+    csr = csr_from_edges(n, src, dst)
+    res = TPUExecutor(csr).run(ShortestPathProgram(seed_index=0))
+    np.testing.assert_allclose(_dist(res), np.arange(n, dtype=np.float32))
+
+
+def test_frontier_isolated_seed_and_empty_graph():
+    csr = csr_from_edges(5, np.zeros(0, np.int32), np.zeros(0, np.int32))
+    res = TPUExecutor(csr).run(ShortestPathProgram(seed_index=2))
+    d = _dist(res)
+    assert d[2] == 0 and np.all(np.isinf(np.delete(d, 2)))
+
+
+def test_frontier_off_and_subclass_fall_back_dense():
+    csr = random_graph(n=50, m=200)
+    ex = TPUExecutor(csr, frontier="off")
+    assert ex._frontier_cfg == "off"
+
+    class Custom(ShortestPathProgram):
+        pass
+
+    # subclasses may override message/apply — never special-case them
+    assert not TPUExecutor(csr)._frontier_eligible(Custom(seed_index=0))
+
+
+def test_tier_ladder():
+    assert _tier(1, 1 << 10, 1 << 20) == 1 << 10
+    assert _tier((1 << 10) + 1, 1 << 10, 1 << 20) == 1 << 12
+    assert _tier(1 << 19, 1 << 10, 1 << 20) == 1 << 20
+    # hi below the pow-4 ladder: clamps to hi (callers ensure hi >= need)
+    assert _tier(100, 1 << 10, 500) == 500
