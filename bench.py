@@ -229,7 +229,15 @@ def supervise() -> int:
             file=sys.stderr,
         )
         t_start = time.monotonic()
-        run = _WorkerRun(dict(os.environ))
+        env = dict(os.environ)
+        # how long the supervisor will let this worker live (absent a
+        # productive TPU rung): lets the worker size its init watchdog to
+        # the REAL budget instead of a fixed 600s — round 3 gave up on a
+        # slow tunnel at 600s with 1500+s of budget still unspent
+        env["BENCH_WORKER_BUDGET_S"] = str(
+            max(0.0, deadline - cpu_reserve - time.monotonic())
+        )
+        run = _WorkerRun(env)
         live["run"] = run
 
         def _tpu_deadline():
@@ -410,13 +418,22 @@ def _bench_scale(jax, platform, scale, edge_factor, pr_iters, strategy, t0):
     pr_eps = pr_iters * csr.num_edges / pr_s
     _hb(f"s{scale}: pagerank {pr_s:.3f}s ({pr_eps:.3e} edges/s)", t0)
 
+    # BFS both ways: frontier-compacted (the default; olap/frontier.py) and
+    # the dense BSP path it replaces — the delta is the VERDICT r3 #1 claim
     bfs_prog = ShortestPathProgram(seed_index=0, max_iterations=4)
-    ex.run(bfs_prog)
+    ex.run(bfs_prog)  # warm: compiles the per-tier step executables
     b0 = time.perf_counter()
-    bfs_res = ex.run(bfs_prog, sync_every=4)
+    bfs_res = ex.run(bfs_prog)
     jax.block_until_ready(bfs_res["distance"])
     bfs_s = time.perf_counter() - b0
-    _hb(f"s{scale}: bfs-4hop {bfs_s:.3f}s", t0)
+    _hb(f"s{scale}: bfs-4hop frontier {bfs_s:.3f}s", t0)
+    ex.run(bfs_prog, frontier="off")
+    b0 = time.perf_counter()
+    bfs_dense = ex.run(bfs_prog, sync_every=4, frontier="off")
+    jax.block_until_ready(bfs_dense["distance"])
+    bfs_dense_s = time.perf_counter() - b0
+    _hb(f"s{scale}: bfs-4hop dense {bfs_dense_s:.3f}s "
+        f"(frontier speedup {bfs_dense_s / max(bfs_s, 1e-9):.1f}x)", t0)
 
     base_iters = 3 if scale >= 20 else 5
     base_eps = host_pagerank_edges_per_sec(csr, iters=base_iters)
@@ -435,9 +452,18 @@ def _bench_scale(jax, platform, scale, edge_factor, pr_iters, strategy, t0):
         "pagerank_wall_s": round(pr_s, 3),
         "pagerank_superstep_ms": round(1000.0 * pr_s / pr_iters, 3),
         "bfs_4hop_wall_s": round(bfs_s, 3),
+        "bfs_strategy": "frontier",
+        "bfs_dense_4hop_wall_s": round(bfs_dense_s, 3),
+        "bfs_frontier_speedup": round(bfs_dense_s / max(bfs_s, 1e-9), 2),
         "graph_gen_s": round(gen_s, 2),
         "transfer_pack_s": round(transfer_s, 2),
         "compile_s": round(compile_s, 2),
+        # one-time setup vs steady state: graph-gen is disk-cached
+        # (.bench_cache), compiles persist (.jax_cache), transfer is paid
+        # once per executor lifetime — steady-state cost is the run walls
+        "setup_once_s": round(gen_s + transfer_s + compile_s, 2),
+        "setup_amortization": "gen+compile cached across runs; "
+                              "transfer once per executor",
         "ell_bytes": ell_fp["bytes"],
         "ell_pad_ratio": round(ell_fp["pad_ratio"], 3),
     })
@@ -508,6 +534,12 @@ def worker() -> None:
     # BENCH_INIT_TIMEOUT_S so a dead tunnel doesn't eat the whole budget
     init_done = threading.Event()
     init_cap = float(os.environ.get("BENCH_INIT_TIMEOUT_S", "600"))
+    worker_budget = float(os.environ.get("BENCH_WORKER_BUDGET_S", "0"))
+    if worker_budget:
+        # wait as long as the supervisor's budget allows, keeping ~400s so
+        # a late-arriving backend can still land the first ladder rung
+        # (s16+s20 measured well under that with warm caches)
+        init_cap = max(init_cap, worker_budget - 400.0)
 
     def _ticker():
         while not init_done.wait(20.0):

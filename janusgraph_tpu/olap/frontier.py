@@ -65,47 +65,71 @@ class FrontierEngine:
         self.m = csr.num_edges
         if self.m >= self.MAX_EDGES:
             raise ValueError("frontier engine requires < 2^30 edges")
-        # indptr padded to n+2 so a sentinel row (index n) reads degree 0
-        out_ip = np.concatenate(
-            [csr.out_indptr, csr.out_indptr[-1:]]
-        ).astype(np.int32)
-        in_ip = np.concatenate(
-            [csr.in_indptr, csr.in_indptr[-1:]]
-        ).astype(np.int32)
-        g = executor.g
-        # out_dst / in_src reuse the executor's device copies (no 2nd O(E)
-        # transfer); pointer/degree vectors are O(n) and shipped here once
-        self.fargs = {
-            "out_ip": jnp.asarray(out_ip),
-            "out_dst": g.out_dst,
-            "out_deg": jnp.asarray(np.diff(csr.out_indptr).astype(np.int32)),
-            "in_ip": jnp.asarray(in_ip),
-            "in_src": g.in_src,
-            "in_deg": jnp.asarray(np.diff(csr.in_indptr).astype(np.int32)),
-        }
-        if g.out_edge_weight is not None:
-            self.fargs["out_w"] = g.out_edge_weight
-        if g.in_edge_weight is not None:
-            self.fargs["in_w"] = g.in_edge_weight
-        self._plan = None
+        self._fargs_cache = {}
+        self._plans = {}
+
+    def _orientation_args(self, prefix: str):
+        """Device arrays for one orientation, built on first use — a
+        directed run never transfers the in-side O(E) arrays. dst/src
+        reuse the executor's lazy device copies (no 2nd transfer); the
+        pointer/degree vectors are O(n). Weights are attached separately
+        (`_fargs`) so unweighted runs never force the O(E) weight
+        transfer."""
+        csr, g, jnp = self.ex.csr, self.ex.g, self.jnp
+        args = self._fargs_cache.get(prefix)
+        if args is None:
+            if prefix == "out":
+                indptr, edges = csr.out_indptr, g.out_dst
+            else:
+                indptr, edges = csr.in_indptr, g.in_src
+            args = {
+                # indptr padded to n+2: a sentinel row (idx n) reads deg 0
+                f"{prefix}_ip": jnp.asarray(
+                    np.concatenate([indptr, indptr[-1:]]).astype(np.int32)
+                ),
+                "out_dst" if prefix == "out" else "in_src": edges,
+                f"{prefix}_deg": jnp.asarray(
+                    np.diff(indptr).astype(np.int32)
+                ),
+            }
+            self._fargs_cache[prefix] = args
+        return args
+
+    def _fargs(self, undirected: bool, weighted: bool):
+        g = self.ex.g
+        args = dict(self._orientation_args("out"))
+        if undirected:
+            args.update(self._orientation_args("in"))
+        if weighted:
+            if g.out_edge_weight is not None:
+                args["out_w"] = g.out_edge_weight
+            if undirected and g.in_edge_weight is not None:
+                args["in_w"] = g.in_edge_weight
+        return args
 
     # ------------------------------------------------------------------ plan
-    def _plan_fn(self):
+    def _plan_fn(self, undirected: bool):
         """(mask, fargs) -> (frontier count, out-edge total, in-edge total):
         O(n) vector work, one fetch of three scalars per hop."""
-        if self._plan is not None:
-            return self._plan
+        plan = self._plans.get(undirected)
+        if plan is not None:
+            return plan
         jnp = self.jnp
 
-        def plan(mask, fargs):
+        def plan_body(mask, fargs):
             zero = jnp.zeros((), jnp.int32)
             count = jnp.sum(mask.astype(jnp.int32))
             tot_out = jnp.sum(jnp.where(mask, fargs["out_deg"], zero))
-            tot_in = jnp.sum(jnp.where(mask, fargs["in_deg"], zero))
+            tot_in = (
+                jnp.sum(jnp.where(mask, fargs["in_deg"], zero))
+                if undirected
+                else zero
+            )
             return count, tot_out, tot_in
 
-        self._plan = self.jax.jit(plan)
-        return self._plan
+        plan = self.jax.jit(plan_body)
+        self._plans[undirected] = plan
+        return plan
 
     # ------------------------------------------------------------------ step
     def _expand(self, idx, indptr, dst, E_cap):
@@ -224,23 +248,24 @@ class FrontierEngine:
                 jnp.float32,
             )
         mask = jnp.asarray(idx0 == program.seed_index)
-        plan = self._plan_fn()
+        plan = self._plan_fn(und)
+        fargs = self._fargs(und, weighted)
         if self.m == 0:
             mask = jnp.zeros_like(mask)
         for t in range(program.max_iterations):
             count, tot_out, tot_in = (
-                int(x) for x in jax.device_get(plan(mask, self.fargs))
+                int(x) for x in jax.device_get(plan(mask, fargs))
             )
             if count == 0:
                 break
-            need_e = max(tot_out, tot_in if und else 0, 1)
+            need_e = max(tot_out, tot_in, 1)
             fn = self._step_fn(
                 _tier(count, self.F_MIN, n),
                 _tier(need_e, self.E_MIN, self.m),
                 weighted, track, und,
             )
             dist, pred, mask, _ = fn(
-                dist, pred, mask, jnp.asarray(t, jnp.float32), self.fargs
+                dist, pred, mask, jnp.asarray(t, jnp.float32), fargs
             )
         out = {"distance": np.asarray(dist)}
         if track:

@@ -80,6 +80,15 @@ def test_frontier_supernode_deg0():
     np.testing.assert_allclose(_dist(sparse), _dist(cpu), rtol=1e-6)
 
 
+def test_per_run_frontier_override():
+    """One executor serves both paths: run(frontier='off') forces dense."""
+    csr = random_graph(n=80, m=300)
+    ex = TPUExecutor(csr)
+    sparse = ex.run(ShortestPathProgram(seed_index=0))
+    dense = ex.run(ShortestPathProgram(seed_index=0), frontier="off")
+    np.testing.assert_allclose(_dist(sparse), _dist(dense), rtol=1e-6)
+
+
 @pytest.mark.parametrize("max_iter", [0, 1, 2, 3])
 def test_frontier_step_parity_at_cutoff(max_iter):
     """Per-superstep parity, not just fixpoint parity: truncated runs must
