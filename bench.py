@@ -533,13 +533,15 @@ def worker() -> None:
     # artifact distinguishes init-hang from silence, and give up past
     # BENCH_INIT_TIMEOUT_S so a dead tunnel doesn't eat the whole budget
     init_done = threading.Event()
-    init_cap = float(os.environ.get("BENCH_INIT_TIMEOUT_S", "600"))
+    init_cap = float(os.environ.get("BENCH_INIT_TIMEOUT_S", "0") or 0)
     worker_budget = float(os.environ.get("BENCH_WORKER_BUDGET_S", "0"))
-    if worker_budget:
-        # wait as long as the supervisor's budget allows, keeping ~400s so
-        # a late-arriving backend can still land the first ladder rung
-        # (s16+s20 measured well under that with warm caches)
-        init_cap = max(init_cap, worker_budget - 400.0)
+    if not init_cap:
+        # default: wait as long as the supervisor's budget allows, keeping
+        # ~400s so a late-arriving backend can still land the first ladder
+        # rung (s16+s20 measured well under that with warm caches). An
+        # EXPLICIT BENCH_INIT_TIMEOUT_S is honored verbatim — it exists to
+        # fail over to CPU fast on a known-dead tunnel.
+        init_cap = max(600.0, worker_budget - 400.0)
 
     def _ticker():
         while not init_done.wait(20.0):

@@ -1,0 +1,70 @@
+"""Networked deployment example: graph over a TCP storage backend PLUS a
+TCP mixed-index provider — the cql+elasticsearch deployment shape
+(reference analogue: janusgraph-dist config recipes wiring
+storage.backend=cql with index.search.backend=elasticsearch;
+janusgraph-es .../rest/RestElasticSearchClient.java:505).
+
+Both services here run in-process for a self-contained demo; in a real
+deployment each would live on its own host and the client config would
+point at their addresses.
+"""
+
+import os
+import sys
+import tempfile
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from janusgraph_tpu.core.graph import open_graph
+from janusgraph_tpu.core.traversal import P
+from janusgraph_tpu.indexing import LocalIndexProvider, RemoteIndexServer
+from janusgraph_tpu.storage.inmemory import InMemoryStoreManager
+from janusgraph_tpu.storage.remote import RemoteStoreManager, RemoteStoreServer
+
+
+def main() -> None:
+    with tempfile.TemporaryDirectory() as tmp:
+        # "cluster": a storage server and an index server
+        store_srv = RemoteStoreServer(InMemoryStoreManager()).start()
+        idx_srv = RemoteIndexServer(
+            LocalIndexProvider(directory=os.path.join(tmp, "idx"))
+        ).start()
+        print(f"storage server on {store_srv.address}, "
+              f"index server on {idx_srv.address}")
+
+        # client: a graph wired to both over TCP
+        graph = open_graph(
+            {
+                "schema.default": "auto",
+                "index.search.backend": "remote",
+                "index.search.hostname": idx_srv.address[0],
+                "index.search.port": idx_srv.address[1],
+            },
+            store_manager=RemoteStoreManager(*store_srv.address),
+        )
+        try:
+            mgmt = graph.management()
+            mgmt.make_property_key("bio", str)
+            mgmt.make_property_key("age", int)
+            mgmt.build_mixed_index("people", ["bio", "age"], backing="search")
+
+            tx = graph.new_transaction()
+            tx.add_vertex(name="hercules", bio="fought the nemean lion", age=30)
+            tx.add_vertex(name="jupiter", bio="god of thunder and sky", age=5000)
+            tx.commit()
+
+            t = graph.traversal()
+            print("text search 'thunder':",
+                  [v.value("name") for v in
+                   t.V().has("bio", P.text_contains("thunder")).to_list()])
+            print("range age < 500:",
+                  [v.value("name") for v in
+                   t.V().has("age", P.lt(500)).to_list()])
+        finally:
+            graph.close()
+            store_srv.stop()
+            idx_srv.stop()
+
+
+if __name__ == "__main__":
+    main()

@@ -220,6 +220,14 @@ TX_NS.option(
 )
 INDEX_NS.option("search.backend", str, "mixed index provider shorthand", "memindex")
 INDEX_NS.option("search.directory", str, "index data directory", "")
+INDEX_NS.option(
+    "search.hostname", str,
+    "remote index server host (backend=remote; reference: index.[X].hostname)",
+    "127.0.0.1",
+)
+INDEX_NS.option(
+    "search.port", int, "remote index server port (backend=remote)", 0
+)
 METRICS_NS.option("enabled", bool, "collect per-store operation metrics", False)
 COMPUTER_NS.option(
     "result-mode", str, "olap result mode ('memory'|'persist')", "memory",

@@ -292,6 +292,8 @@ class JanusGraphTPU:
             shared["search"] = open_index_provider(
                 cfg.get("index.search.backend"),
                 directory=cfg.get("index.search.directory"),
+                hostname=cfg.get("index.search.hostname"),
+                port=cfg.get("index.search.port"),
             )
         self.index_providers: Dict[str, object] = shared
         # {index_name: {field: KeyInformation}} for provider.mutate calls

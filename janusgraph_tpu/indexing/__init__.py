@@ -25,6 +25,10 @@ from janusgraph_tpu.indexing.provider import (
 )
 from janusgraph_tpu.indexing.memindex import InMemoryIndexProvider
 from janusgraph_tpu.indexing.localindex import LocalIndexProvider
+from janusgraph_tpu.indexing.remote import (
+    RemoteIndexProvider,
+    RemoteIndexServer,
+)
 
 __all__ = [
     "And",
@@ -36,6 +40,8 @@ __all__ = [
     "IndexTransaction",
     "InMemoryIndexProvider",
     "LocalIndexProvider",
+    "RemoteIndexProvider",
+    "RemoteIndexServer",
     "KeyInformation",
     "Mapping",
     "Not",

@@ -34,7 +34,9 @@ from typing import Dict, Optional
 
 import numpy as np
 
-INF = 1e18
+# the reached-ness tests below ("dist >= INF") are parity-equivalent to the
+# dense program only because both use the IDENTICAL constant
+from janusgraph_tpu.olap.programs.shortest_path import INF
 
 
 def _tier(need: int, lo: int, hi: int) -> int:

@@ -28,10 +28,24 @@ from janusgraph_tpu.storage.inmemory import InMemoryStoreManager
 # Abstract-suite pattern (reference: IndexProviderTest.java parameterized per
 # backend): every SPI-contract test below runs against BOTH the in-memory
 # provider and the persistent localindex provider.
-@pytest.fixture(params=["memindex", "localindex"])
+@pytest.fixture(params=["memindex", "localindex", "remote"])
 def provider(request, tmp_path):
+    server = None
     if request.param == "memindex":
         p = InMemoryIndexProvider()
+    elif request.param == "remote":
+        # the networked tier: a localindex served over TCP, queried through
+        # the wire client (reference: janusgraph-es RestElasticSearchClient)
+        from janusgraph_tpu.indexing import (
+            LocalIndexProvider,
+            RemoteIndexProvider,
+            RemoteIndexServer,
+        )
+
+        backend = LocalIndexProvider(directory=str(tmp_path / "idx"))
+        server = RemoteIndexServer(backend).start()
+        host, port = server.address
+        p = RemoteIndexProvider(hostname=host, port=port)
     else:
         from janusgraph_tpu.indexing import LocalIndexProvider
 
@@ -55,7 +69,10 @@ def provider(request, tmp_path):
             m.add(f, v)
         muts["store"][docid] = m
     p.mutate(muts, {})
-    return p
+    yield p
+    p.close()
+    if server is not None:
+        server.stop()
 
 
 def q(cond, **kw):
@@ -554,3 +571,131 @@ def test_localindex_rejects_foreign_format(tmp_path):
     p.close()
     with pytest.raises(BackendError, match="format"):
         _mk_local(tmp_path)
+
+
+# -------------------------------------------------------------- remote tier
+def test_remote_index_restore_and_features(tmp_path):
+    """restore() and features() cross the wire intact (reference:
+    IndexProvider.restore used by recovery/reindex; ES features flags)."""
+    from janusgraph_tpu.indexing import (
+        IndexEntry,
+        LocalIndexProvider,
+        RemoteIndexProvider,
+        RemoteIndexServer,
+    )
+
+    backend = LocalIndexProvider(directory=str(tmp_path / "idx"))
+    server = RemoteIndexServer(backend).start()
+    host, port = server.address
+    p = RemoteIndexProvider(hostname=host, port=port)
+    try:
+        assert p.features().supports_geo == backend.features().supports_geo
+        p.register("s", "name", KeyInformation(str, Mapping.TEXT))
+        p.restore(
+            {"s": {"d9": [IndexEntry("name", "restored hydra document")]}},
+            {"s": {"name": KeyInformation(str, Mapping.TEXT)}},
+        )
+        from janusgraph_tpu.core.predicates import Text
+
+        assert p.query(
+            "s",
+            IndexQuery(PredicateCondition("name", Text.CONTAINS, "hydra")),
+        ) == ["d9"]
+        assert p.exists()
+        # supports() memoizes: second identical ask answers without a call
+        info = KeyInformation(str, Mapping.TEXT)
+        assert p.supports(info, Text.CONTAINS)
+        n_before = p._pool_idx
+        assert p.supports(info, Text.CONTAINS)
+        assert p._pool_idx == n_before
+    finally:
+        p.close()
+        server.stop()
+
+
+def test_remote_index_error_mapping(tmp_path):
+    """Server-side failures surface as PermanentBackendError with the
+    original type name, not broken sockets."""
+    from janusgraph_tpu.exceptions import PermanentBackendError
+    from janusgraph_tpu.indexing import (
+        InMemoryIndexProvider,
+        RemoteIndexProvider,
+        RemoteIndexServer,
+    )
+
+    server = RemoteIndexServer(InMemoryIndexProvider()).start()
+    host, port = server.address
+    p = RemoteIndexProvider(hostname=host, port=port, retry_time_s=0.5)
+    try:
+        with pytest.raises(PermanentBackendError):
+            p._call(99, b"")  # unknown op: server maps to PERM status
+        with pytest.raises(PermanentBackendError):
+            # malformed body: server-side decode failure crosses back as a
+            # permanent error, and the connection stays usable after it
+            p._call(4, b"\xff\xff")
+        # connection still serves real requests after both failures
+        p.register("s", "w", KeyInformation(float))
+        m = IndexMutation(is_new=True)
+        m.add("w", 1.5)
+        p.mutate({"s": {"d1": m}}, {"s": {"w": KeyInformation(float)}})
+        assert p.query(
+            "s", IndexQuery(PredicateCondition("w", Cmp.GREATER_THAN, 1.0))
+        ) == ["d1"]
+    finally:
+        p.close()
+        server.stop()
+
+
+def test_graph_with_remote_storage_and_remote_index(tmp_path):
+    """The full networked deployment shape: graph -> TCP storage backend +
+    TCP index provider (reference: cql + es deployment,
+    janusgraph-dist config recipes)."""
+    from janusgraph_tpu.indexing import (
+        LocalIndexProvider,
+        RemoteIndexServer,
+    )
+    from janusgraph_tpu.storage.inmemory import InMemoryStoreManager
+    from janusgraph_tpu.storage.remote import (
+        RemoteStoreManager,
+        RemoteStoreServer,
+    )
+
+    store_srv = RemoteStoreServer(InMemoryStoreManager()).start()
+    idx_srv = RemoteIndexServer(
+        LocalIndexProvider(directory=str(tmp_path / "ridx"))
+    ).start()
+    sm = RemoteStoreManager(*store_srv.address)
+    g = open_graph(
+        {
+            "schema.default": "auto",
+            "index.search.backend": "remote",
+            "index.search.hostname": idx_srv.address[0],
+            "index.search.port": idx_srv.address[1],
+        },
+        store_manager=sm,
+    )
+    try:
+        mgmt = g.management()
+        mgmt.make_property_key("bio", str)
+        mgmt.make_property_key("age", int)
+        mgmt.build_mixed_index("people", ["bio", "age"], backing="search")
+        tx = g.new_transaction()
+        a = tx.add_vertex(bio="fought the nemean lion", age=30)
+        b = tx.add_vertex(bio="god of thunder and sky", age=5000)
+        tx.commit()
+        t = g.traversal()
+        hits = t.V().has("bio", P.text_contains("thunder")).to_list()
+        assert [v.id for v in hits] == [b.id]
+        hits = t.V().has("age", P.lt(500)).to_list()
+        assert [v.id for v in hits] == [a.id]
+        # removal propagates over the wire
+        tx = g.new_transaction()
+        tx.get_vertex(b.id).remove()
+        tx.commit()
+        assert g.traversal().V().has(
+            "bio", P.text_contains("thunder")
+        ).to_list() == []
+    finally:
+        g.close()
+        store_srv.stop()
+        idx_srv.stop()
