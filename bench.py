@@ -521,6 +521,40 @@ def _bench_scale(jax, platform, scale, edge_factor, pr_iters, strategy, t0):
             result_key="count",
             post=lambda res: {"paths": float(np.asarray(res["count"]).sum())},
         )
+        # filtered 3-hop: mid-chain has()-filter via device mask (the
+        # TraversalVertexProgram-with-HasStep shape; VERDICT r3 #4)
+        from janusgraph_tpu.olap.programs.olap_traversal import (
+            OLAPTraversalProgram,
+            PropertyFilter,
+            TraversalStep,
+            evaluate_filter_mask,
+        )
+        from janusgraph_tpu.core.predicates import Cmp
+
+        prop_rng = np.random.default_rng(scale)
+        csr.properties["score"] = prop_rng.uniform(
+            0, 10, csr.num_vertices
+        ).astype(np.float32)
+        flt = (PropertyFilter("score", Cmp.GREATER_THAN, 5.0),)
+        fmask = evaluate_filter_mask(csr, flt)
+        steps_f = (
+            TraversalStep("out"),
+            TraversalStep("out", None, flt),
+            TraversalStep("out"),
+        )
+        masks = np.stack(
+            [np.ones(csr.num_vertices, np.float32), fmask,
+             np.ones(csr.num_vertices, np.float32)], axis=1,
+        )
+        _workload(
+            "filtered_3hop",
+            OLAPTraversalProgram(steps_f, step_masks=masks),
+            result_key="count",
+            post=lambda res: {
+                "paths": float(np.asarray(res["count"]).sum()),
+                "filter_selectivity": round(float(fmask.mean()), 3),
+            },
+        )
     del ex, csr
 
 
@@ -533,9 +567,10 @@ def worker() -> None:
     # artifact distinguishes init-hang from silence, and give up past
     # BENCH_INIT_TIMEOUT_S so a dead tunnel doesn't eat the whole budget
     init_done = threading.Event()
-    init_cap = float(os.environ.get("BENCH_INIT_TIMEOUT_S", "0") or 0)
+    init_env = os.environ.get("BENCH_INIT_TIMEOUT_S")
+    init_cap = float(init_env) if init_env is not None else None
     worker_budget = float(os.environ.get("BENCH_WORKER_BUDGET_S", "0"))
-    if not init_cap:
+    if init_cap is None:
         # default: wait as long as the supervisor's budget allows, keeping
         # ~400s so a late-arriving backend can still land the first ladder
         # rung (s16+s20 measured well under that with warm caches). An

@@ -52,6 +52,7 @@ from janusgraph_tpu.storage.remote import (
     _Conn,
     _pb,
     _ps,
+    _raise_status,
     _Reader,
     _recv_exact,
 )
@@ -350,13 +351,6 @@ class RemoteIndexServer:
 
 
 # -------------------------------------------------------------------- client
-def _raise_status(status: int, payload: bytes):
-    msg = payload.decode("utf-8", "replace")
-    if status == _STATUS_TEMP:
-        raise TemporaryBackendError(msg)
-    raise PermanentBackendError(msg)
-
-
 class RemoteIndexProvider(IndexProvider):
     """Client-side IndexProvider speaking the remote index protocol —
     the janusgraph-es analogue (RestElasticSearchClient.java:505: pooled

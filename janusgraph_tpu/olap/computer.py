@@ -88,28 +88,55 @@ class GraphComputer:
         self._program = p
         return self
 
-    def traverse(self, *spec) -> "GraphComputer":
+    def traverse(self, *spec, seed_filters=None) -> "GraphComputer":
         """OLAP traversal shortcut (the TraversalVertexProgram analogue):
         compute().traverse(("out", ["knows"]), ("in", None)).submit() counts
         traversers per vertex; result.states["count"].sum() is the terminal
-        count (reference: BASELINE config #5)."""
-        from janusgraph_tpu.olap.programs import (
-            OLAPTraversalProgram,
-            steps_from_spec,
-        )
-
-        self._program = OLAPTraversalProgram(steps_from_spec(self.graph, spec))
+        count (reference: BASELINE config #5). Spec items may carry has()-
+        filters — ("out", ["knows"], [("age", Cmp.GREATER_THAN, 30)]) — and
+        `seed_filters` restricts the start set; filter masks are built from
+        the CSR snapshot at submit() (build_olap_traversal)."""
+        # defer program construction to submit(): filter masks need the
+        # loaded CSR's property columns
+        self._traverse_args = (spec, seed_filters)
+        self._program = None
         return self
 
     def submit(self) -> ComputerResult:
-        assert self._program is not None, "program() not set"
+        property_keys = self._property_keys
+        traverse_args = getattr(self, "_traverse_args", None)
+        if traverse_args is not None:
+            # filters reference property names: make sure the snapshot
+            # loads those columns
+            from janusgraph_tpu.olap.programs.olap_traversal import (
+                _parse_filters,
+                steps_from_spec,
+            )
+
+            spec, seed_filters = traverse_args
+            fkeys = {f.key for f in _parse_filters(seed_filters)}
+            for st in steps_from_spec(self.graph, spec):
+                fkeys.update(f.key for f in st.filters)
+            property_keys = tuple(set(property_keys or ()) | fkeys)
+        assert (
+            self._program is not None or traverse_args is not None
+        ), "program() not set"
         csr = load_csr(
             self.graph,
             edge_labels=self._edge_labels,
             vertex_labels=self._vertex_labels,
-            property_keys=self._property_keys,
+            property_keys=property_keys,
             weight_key=self._weight_key,
         )
+        if traverse_args is not None:
+            from janusgraph_tpu.olap.programs.olap_traversal import (
+                build_olap_traversal,
+            )
+
+            spec, seed_filters = traverse_args
+            self._program = build_olap_traversal(
+                self.graph, csr, spec, seed_filters=seed_filters
+            )
         cfg = getattr(self.graph, "config", None)
         run_kwargs = {}
         if cfg is not None and self.executor_kind == "tpu":

@@ -29,27 +29,69 @@ from dataclasses import dataclass
 
 
 @dataclass(frozen=True)
+class PropertyFilter:
+    """A mid-chain has()-filter: keep traversers only on vertices whose
+    property satisfies the predicate (reference: TraversalVertexProgram
+    executes arbitrary Gremlin OLAP-side incl. HasStep —
+    FulgoraGraphComputer.java:249-253 submits the full traversal).
+
+    Evaluation is HOST-side over the CSR's property arrays, producing an
+    (n,) {0,1} mask shipped to device once (rationale: every predicate —
+    Cmp, Text, Geo — works unchanged on any property type; the per-superstep
+    device cost is one elementwise multiply, and the mask IS the
+    device-resident form of the property column)."""
+
+    key: str
+    predicate: object  # a core.predicates.Predicate singleton
+    value: object
+
+
+@dataclass(frozen=True)
 class TraversalStep:
-    """One expansion: direction out/in/both, optional edge-label ids.
+    """One expansion: direction out/in/both, optional edge-label ids, and
+    optional post-expansion property filters (the `.out().has(...)` shape).
     Frozen/value-comparable so program cache keys (and the executors'
     channel caches) hit across instances built from the same spec."""
 
     direction: str = "out"
     labels: Optional[Tuple[int, ...]] = None
+    filters: Tuple[PropertyFilter, ...] = ()
 
     def __post_init__(self):
         if self.direction not in ("out", "in", "both"):
             raise ValueError(f"unknown step direction {self.direction!r}")
         if self.labels is not None:
             object.__setattr__(self, "labels", tuple(self.labels))
+        object.__setattr__(self, "filters", tuple(self.filters))
+
+
+def _parse_filters(filters) -> Tuple[PropertyFilter, ...]:
+    out = []
+    for f in filters or ():
+        if isinstance(f, PropertyFilter):
+            out.append(f)
+        else:
+            key, pred, value = f
+            out.append(PropertyFilter(key, pred, value))
+    return tuple(out)
 
 
 def steps_from_spec(graph, spec: Sequence) -> Tuple[TraversalStep, ...]:
-    """Build steps from ('out', ['knows']) pairs, resolving label NAMES to
-    schema ids via the graph (None/empty labels = all)."""
+    """Build steps from spec items, resolving label NAMES to schema ids via
+    the graph (None/empty labels = all). Item shapes:
+      'out'                                  — expand, all labels
+      ('out', ['knows'])                     — expand along labels
+      ('out', ['knows'], [(key, pred, v)])   — expand, then has()-filter
+    """
     out = []
     for item in spec:
-        direction, labels = (item, None) if isinstance(item, str) else item
+        filters = ()
+        if isinstance(item, str):
+            direction, labels = item, None
+        elif len(item) == 2:
+            direction, labels = item
+        else:
+            direction, labels, filters = item
         ids = None
         if labels:
             ids = []
@@ -61,8 +103,44 @@ def steps_from_spec(graph, spec: Sequence) -> Tuple[TraversalStep, ...]:
                     raise ValueError(f"unknown edge label {name!r}")
                 ids.append(el.id)
             ids = tuple(ids)
-        out.append(TraversalStep(direction, ids))
+        out.append(TraversalStep(direction, ids, _parse_filters(filters)))
     return tuple(out)
+
+
+def evaluate_filter_mask(csr, filters: Sequence[PropertyFilter]):
+    """AND-combined (n,) float32 {0,1} mask over the CSR's host property
+    arrays. Cmp predicates on numeric columns vectorize through numpy; every
+    other predicate falls back to the scalar evaluate() loop (correct for
+    text/geo/object types)."""
+    import numpy as np
+
+    n = csr.num_vertices
+    mask = np.ones(n, dtype=np.float32)
+    for f in filters:
+        col = csr.properties.get(f.key)
+        if col is None:
+            raise ValueError(
+                f"property {f.key!r} not loaded in this CSR snapshot — "
+                f"pass property_keys={f.key!r} to load_csr"
+            )
+        from janusgraph_tpu.core.predicates import _CmpPredicate
+
+        m = None
+        if isinstance(f.predicate, _CmpPredicate) and np.issubdtype(
+            np.asarray(col).dtype, np.number
+        ):
+            try:
+                with np.errstate(invalid="ignore"):
+                    m = f.predicate._fn(np.asarray(col), f.value)
+            except TypeError:
+                m = None  # mistyped condition: scalar evaluate() decides
+        if m is None:
+            m = np.fromiter(
+                (f.predicate.evaluate(v, f.value) for v in col),
+                dtype=bool, count=n,
+            )
+        mask *= m.astype(np.float32)
+    return mask
 
 
 class OLAPTraversalProgram(VertexProgram):
@@ -82,15 +160,39 @@ class OLAPTraversalProgram(VertexProgram):
     combiner = Combiner.SUM
     setup_only_params = ("seed_indices",)
 
-    def __init__(self, steps: Sequence[TraversalStep], seed_indices=None):
+    def __init__(
+        self,
+        steps: Sequence[TraversalStep],
+        seed_indices=None,
+        seed_mask=None,
+        step_masks=None,
+    ):
+        """`seed_mask`: (n,) {0,1} array filtering the start set (the
+        g.V().has(...) head). `step_masks`: (n, S) array, column k the
+        post-expansion filter mask of step k (ones where unfiltered) —
+        both prebuilt by `build_olap_traversal` from the steps' filters.
+        Masks travel through STATE (not closures) so they ride the jit
+        argument path like every other device array (_graph_args lesson:
+        big closure constants break remote compile)."""
         self.steps = tuple(steps)
         if not self.steps:
             raise ValueError("at least one traversal step required")
+        if step_masks is None and any(st.filters for st in self.steps):
+            # running a filter-bearing chain without masks would silently
+            # return unfiltered counts — demand the builder
+            raise ValueError(
+                "steps carry property filters but no step_masks were "
+                "built — construct via build_olap_traversal(graph, csr, "
+                "spec) so masks are evaluated against the CSR snapshot"
+            )
         self.seed_indices = (
             tuple(int(i) for i in seed_indices)
             if seed_indices is not None
             else None
         )
+        self._seed_mask = seed_mask
+        self._step_masks = step_masks
+        self.has_step_masks = step_masks is not None
         self.max_iterations = len(self.steps)
         # one named channel per step; labels=None channels still express
         # per-step direction through the same machinery
@@ -111,14 +213,106 @@ class OLAPTraversalProgram(VertexProgram):
         else:
             idx = xp.arange(n) + graph.global_offset
             count = xp.isin(idx, xp.asarray(self.seed_indices)).astype(float)
-        return {"count": count}, {}
+        if self._seed_mask is not None:
+            count = count * self._slice_local(self._seed_mask, graph, xp)
+        state = {"count": count}
+        if self.has_step_masks:
+            state["step_masks"] = self._slice_local(
+                self._step_masks, graph, xp
+            )
+        return state, {}
+
+    @staticmethod
+    def _slice_local(arr, graph, xp):
+        """A mask's shard-local rows: [global_offset, +local_n), zero-padded
+        where a sharded view pads past the global vertex count (padding
+        slots never hold traversers — `active` already zeroes them)."""
+        off = graph.global_offset
+        n = graph.local_num_vertices
+        a = xp.asarray(arr)
+        s = a[off:off + n]
+        short = n - s.shape[0]
+        if short > 0:
+            pad = [(0, short)] + [(0, 0)] * (a.ndim - 1)
+            s = xp.pad(s, pad)
+        return s
 
     def message(self, state, superstep, graph, xp):
         return state["count"]
 
     def apply(self, state, aggregated, superstep, memory_in, graph, xp):
-        # traversers MOVE: the new count is exactly what arrived
-        return {"count": aggregated}, {}
+        # traversers MOVE: the new count is exactly what arrived — then the
+        # step's has()-filter mask zeroes the vertices it rejects. Column
+        # select by the (traced) superstep index keeps ONE executable per
+        # channel; leading axis stays n so shard-by-vertex layouts hold.
+        new = {"count": aggregated}
+        if self.has_step_masks:
+            masks = state["step_masks"]
+            col = xp.clip(superstep, 0, masks.shape[1] - 1)
+            new["count"] = aggregated * masks[:, col]
+            new["step_masks"] = masks
+        return new, {}
 
     def terminate(self, memory):
         return False  # fixed-length chain; max_iterations bounds the run
+
+
+def build_olap_traversal(
+    graph,
+    csr,
+    spec: Sequence,
+    seeds=None,
+    seed_filters=None,
+) -> "OLAPTraversalProgram":
+    """Compile a filtered traversal spec against a CSR snapshot:
+    `g.V().has(seed_filters...).out(...).has(...)...` as one BSP program
+    (reference: FulgoraGraphComputer.submit(traversal),
+    FulgoraGraphComputer.java:155). Filter predicates evaluate host-side
+    over csr.properties into device masks (see PropertyFilter)."""
+    import numpy as np
+
+    steps = steps_from_spec(graph, spec)
+    seed_mask = None
+    if seed_filters:
+        seed_mask = evaluate_filter_mask(csr, _parse_filters(seed_filters))
+    step_masks = None
+    if any(st.filters for st in steps):
+        cols = [
+            evaluate_filter_mask(csr, st.filters)
+            if st.filters
+            else np.ones(csr.num_vertices, dtype=np.float32)
+            for st in steps
+        ]
+        step_masks = np.stack(cols, axis=1)  # (n, S): shard-by-vertex axis
+    seed_indices = None
+    if seeds is not None:
+        seed_indices = [csr.index_of(v) for v in seeds]
+    return OLAPTraversalProgram(
+        steps,
+        seed_indices=seed_indices,
+        seed_mask=seed_mask,
+        step_masks=step_masks,
+    )
+
+
+def group_count_by_label(graph, csr, counts) -> Dict[str, float]:
+    """Group-count terminal: traverser totals per vertex LABEL — the
+    g.V()...groupCount().by(label) shape (reference: TinkerPop
+    GroupCountStep run OLAP-side through TraversalVertexProgram). Host-side
+    bincount over the CSR's label column; O(n)."""
+    import numpy as np
+
+    if csr.labels is None:
+        raise ValueError(
+            "CSR snapshot has no vertex-label column — reload with load_csr"
+        )
+    counts = np.asarray(counts, dtype=np.float64)
+    labels = np.asarray(csr.labels)
+    out: Dict[str, float] = {}
+    for lbl in np.unique(labels):
+        total = float(counts[labels == lbl].sum())
+        if total == 0.0:
+            continue
+        el = graph.schema_cache.get_by_id(int(lbl))
+        out[el.name if el is not None else str(int(lbl))] = total
+    return out
