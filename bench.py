@@ -438,10 +438,33 @@ def _bench_scale(jax, platform, scale, edge_factor, pr_iters, strategy, t0):
     base_iters = 3 if scale >= 20 else 5
     base_eps = host_pagerank_edges_per_sec(csr, iters=base_iters)
 
+    # Fulgora-analogue architecture baseline (VERDICT r3 #5): the
+    # reference's threaded per-vertex hash-map BSP, measured — only at
+    # modest scales (pure-python per-edge cost; s20 = ~4.3s/superstep)
+    fulgora_fields = {}
+    if scale <= 20 and os.environ.get("BENCH_FULGORA", "1") != "0":
+        from janusgraph_tpu.olap.fulgora_baseline import (
+            measure_fulgora_baseline,
+        )
+
+        fb = measure_fulgora_baseline(
+            csr, iterations=3 if scale <= 16 else 1
+        )
+        fulgora_fields = {
+            "fulgora_analogue_eps": round(fb["edges_per_sec"], 1),
+            "vs_fulgora_analogue": round(pr_eps / fb["edges_per_sec"], 1),
+            "fulgora_note": "python analogue of "
+                "FulgoraGraphComputer.java:210-230 (GIL-bound; "
+                "see olap/fulgora_baseline.py)",
+        }
+        _hb(f"s{scale}: fulgora-analogue {fb['edges_per_sec']:.3e} edges/s "
+            f"(tpu/cpu path is {pr_eps / fb['edges_per_sec']:.0f}x)", t0)
+
     _emit({
         "stage": "pagerank",
         "value": round(pr_eps, 1),
         "vs_baseline": round(pr_eps / base_eps, 3),
+        **fulgora_fields,
         "platform": platform,
         "strategy": ex.strategy,
         "scale": scale,
