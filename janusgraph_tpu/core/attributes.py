@@ -237,11 +237,20 @@ def GeoshapePoint(lat: float, lon: float) -> Geoshape:
 
 class GeoshapeSerializer(AttributeSerializer):
     """Kind-tagged binary: 0x01 point[2d], 0x02 circle[3d], 0x03 box[4d],
-    0x04 polygon[count:2][2d each] (reference: Geoshape.GeoShapeSerializer
-    binary codec)."""
+    0x04 polygon[count:2][2d each], 0x05 line, 0x06 multipoint (point
+    lists), 0x07/0x08/0x09 multilinestring/multipolygon/collection
+    ([count:2] nested length-prefixed sub-shapes) — the full Geoshape
+    vocabulary (reference: Geoshape.GeoShapeSerializer binary codec,
+    attribute/Geoshape.java:623)."""
 
     type_id = 9
     py_type = Geoshape
+
+    _PART_TAGS = {
+        "MultiLineString": b"\x07",
+        "MultiPolygon": b"\x08",
+        "GeometryCollection": b"\x09",
+    }
 
     def write(self, value) -> bytes:
         if value.kind == "Point":
@@ -253,7 +262,26 @@ class GeoshapeSerializer(AttributeSerializer):
         if value.kind == "Box":
             (slat, slon), (nlat, nlon) = value.coords
             return b"\x03" + struct.pack(">dddd", slat, slon, nlat, nlon)
-        out = [b"\x04", struct.pack(">H", len(value.coords))]
+        tag = self._PART_TAGS.get(value.kind)
+        if tag is not None:
+            if len(value.parts) > 0xFFFF:
+                raise SerializerError(
+                    f"{value.kind} exceeds 65535 parts ({len(value.parts)})"
+                )
+            out = [tag, struct.pack(">H", len(value.parts))]
+            for p in value.parts:
+                sub = self.write(p)
+                out.append(struct.pack(">I", len(sub)))
+                out.append(sub)
+            return b"".join(out)
+        tag = {"Polygon": b"\x04", "Line": b"\x05", "MultiPoint": b"\x06"}[
+            value.kind
+        ]
+        if len(value.coords) > 0xFFFF:
+            raise SerializerError(
+                f"{value.kind} exceeds 65535 points ({len(value.coords)})"
+            )
+        out = [tag, struct.pack(">H", len(value.coords))]
         for la, lo in value.coords:
             out.append(struct.pack(">dd", la, lo))
         return b"".join(out)
@@ -266,10 +294,28 @@ class GeoshapeSerializer(AttributeSerializer):
             return Geoshape.circle(*struct.unpack(">ddd", data[1:25]))
         if kind == 3:
             return Geoshape.box(*struct.unpack(">dddd", data[1:33]))
+        if kind in (7, 8, 9):
+            (n,) = struct.unpack(">H", data[1:3])
+            off = 3
+            parts = []
+            for _ in range(n):
+                (ln,) = struct.unpack(">I", data[off:off + 4])
+                off += 4
+                parts.append(self.read(data[off:off + ln]))
+                off += ln
+            if kind == 7:
+                return Geoshape.multilinestring(parts)
+            if kind == 8:
+                return Geoshape.multipolygon(parts)
+            return Geoshape.geometry_collection(parts)
         (n,) = struct.unpack(">H", data[1:3])
         pts = [
             struct.unpack(">dd", data[3 + 16 * i : 19 + 16 * i]) for i in range(n)
         ]
+        if kind == 5:
+            return Geoshape.line(pts)
+        if kind == 6:
+            return Geoshape.multipoint(pts)
         return Geoshape.polygon(pts)
 
 
@@ -534,7 +580,185 @@ def _array_serializer(tid: int, np_dtype) -> NdArraySerializer:
 _ARRAY_IDS = [
     (20, np.bool_), (21, np.int8), (22, np.int16), (23, np.int32),
     (24, np.int64), (25, np.float32), (26, np.float64), (27, np.uint8),
+    (44, np.uint16), (45, np.uint32), (46, np.uint64), (47, np.float16),
 ]
+
+
+# --------------------------------------------------------------------------
+# Container / fallback serializers (reference: StandardSerializer.java
+# registers HashMap + TraverserSet through SerializableSerializer and an
+# Object fallback at id 1; the Python-idiomatic forms are a framed dict
+# codec, a framed heterogeneous tuple codec, and a pickle fallback)
+# --------------------------------------------------------------------------
+
+class DictSerializer(AttributeSerializer):
+    """dict with framed keys/values through the owning registry (reference:
+    StandardSerializer.java:132 HashMap registration)."""
+
+    type_id = 40
+    py_type = dict
+
+    def __init__(self, registry: "Serializer"):
+        self._reg = registry
+
+    def write_ordered(self, value) -> bytes:
+        # non-canonical encoding (insertion-order-dependent) — must never
+        # back a sort key or composite index row
+        raise SerializerError("dict values have no order-preserving encoding")
+
+    def write(self, value) -> bytes:
+        out = [struct.pack(">I", len(value))]
+        for k, v in value.items():
+            for obj in (k, v):
+                frame = self._reg.write_object(obj)
+                out.append(struct.pack(">I", len(frame)))
+                out.append(frame)
+        return b"".join(out)
+
+    def read(self, data: bytes):
+        (n,) = struct.unpack(">I", data[:4])
+        off = 4
+        out = {}
+        for _ in range(n):
+            pair = []
+            for _ in range(2):
+                (ln,) = struct.unpack(">I", data[off:off + 4])
+                off += 4
+                obj, _used = self._reg.read_object(data[off:off + ln])
+                pair.append(obj)
+                off += ln
+            out[pair[0]] = pair[1]
+        return out
+
+
+class TupleSerializer(AttributeSerializer):
+    """Heterogeneous tuple with framed elements (covers the reference's
+    boxed-array registrations — Parameter[]/char[] style fixed sequences —
+    StandardSerializer.java:98-106)."""
+
+    type_id = 41
+    py_type = tuple
+
+    def __init__(self, registry: "Serializer"):
+        self._reg = registry
+
+    def write_ordered(self, value) -> bytes:
+        raise SerializerError("tuple values have no order-preserving encoding")
+
+    def write(self, value) -> bytes:
+        out = [struct.pack(">I", len(value))]
+        for obj in value:
+            frame = self._reg.write_object(obj)
+            out.append(struct.pack(">I", len(frame)))
+            out.append(frame)
+        return b"".join(out)
+
+    def read(self, data: bytes):
+        (n,) = struct.unpack(">I", data[:4])
+        off = 4
+        out = []
+        for _ in range(n):
+            (ln,) = struct.unpack(">I", data[off:off + 4])
+            off += 4
+            obj, _used = self._reg.read_object(data[off:off + ln])
+            out.append(obj)
+            off += ln
+        return tuple(out)
+
+
+class PickledObjectSerializer(AttributeSerializer):
+    """Arbitrary-object fallback via pickle (reference:
+    StandardSerializer.java:78 ObjectSerializer / SerializableSerializer —
+    the Kryo catch-all). SECURITY: pickle deserialization executes code;
+    only registries opened with allow_pickle=True (the embedded graph's own
+    cells, same trust domain as the reference's Kryo) will decode it — the
+    network-facing registries (remote index server) refuse."""
+
+    type_id = 42
+    py_type = object
+
+    def __init__(self, registry: "Serializer"):
+        self._reg = registry
+
+    def write_ordered(self, value) -> bytes:
+        raise SerializerError(
+            "object-fallback values have no order-preserving encoding"
+        )
+
+    def write(self, value) -> bytes:
+        if not self._reg.allow_pickle:
+            raise SerializerError(
+                f"no serializer for {type(value).__name__} "
+                "(object-pickle fallback disabled on this registry)"
+            )
+        import pickle
+
+        try:
+            return pickle.dumps(value, protocol=4)
+        except Exception as e:
+            raise SerializerError(
+                f"object fallback cannot pickle {type(value).__name__}: {e}"
+            ) from e
+
+    def read(self, data: bytes):
+        if not self._reg.allow_pickle:
+            raise SerializerError(
+                "object-pickle payload refused (allow_pickle=False registry)"
+            )
+        import pickle
+
+        return pickle.loads(data)
+
+
+#: importable module prefixes for ClassSerializer.read — everything else is
+#: refused (a stored class name must not trigger arbitrary imports)
+_CLASS_IMPORT_ALLOW = (
+    "builtins", "janusgraph_tpu.", "numpy", "datetime", "decimal", "uuid",
+)
+
+
+def _class_path_allowed(mod: str, qual: str) -> bool:
+    if "<locals>" in qual:
+        return False  # function-local classes can never be re-imported
+    return mod in _CLASS_IMPORT_ALLOW or any(
+        mod.startswith(p) for p in _CLASS_IMPORT_ALLOW if p.endswith(".")
+    )
+
+
+class ClassSerializer(AttributeSerializer):
+    """Python type values by dotted path (reference:
+    StandardSerializer.java:126 Class registration) — schema/config cells
+    that record a datatype. Write-time validation mirrors read-time: a
+    class that could not be decoded later is refused BEFORE it reaches a
+    cell (undecodable persisted values are data loss)."""
+
+    type_id = 43
+    py_type = type
+
+    def write(self, value) -> bytes:
+        mod, qual = value.__module__, value.__qualname__
+        if not _class_path_allowed(mod, qual):
+            raise SerializerError(
+                f"class {mod}:{qual} not storable (module outside the "
+                f"import allowlist {_CLASS_IMPORT_ALLOW} or function-local)"
+            )
+        return f"{mod}:{qual}".encode()
+
+    def read(self, data: bytes):
+        mod, _, qual = data.decode().partition(":")
+        if not _class_path_allowed(mod, qual):
+            raise SerializerError(f"class import refused for module {mod!r}")
+        import importlib
+
+        try:
+            obj = importlib.import_module(mod)
+            for part in qual.split("."):
+                obj = getattr(obj, part)
+        except (ImportError, AttributeError) as e:
+            raise SerializerError(f"cannot resolve class {mod}:{qual}: {e}") from e
+        if not isinstance(obj, type):
+            raise SerializerError(f"{mod}:{qual} is not a type")
+        return obj
 
 
 class StringListSerializer(AttributeSerializer):
@@ -587,19 +811,21 @@ class EnumSerializer(AttributeSerializer):
 def _framework_enums():
     from janusgraph_tpu.core.codecs import (
         Cardinality,
+        Consistency,
         Direction,
         Multiplicity,
         RelationCategory,
     )
     from janusgraph_tpu.core.config import Mutability
-    from janusgraph_tpu.core.management import SchemaAction
+    from janusgraph_tpu.core.management import SchemaAction, SchemaStatus
     from janusgraph_tpu.core.txlog import LogTxStatus
     from janusgraph_tpu.indexing.provider import Mapping as IndexMapping
 
     return [
         (30, Direction), (31, RelationCategory), (32, Cardinality),
         (33, Multiplicity), (34, SchemaAction), (35, Mutability),
-        (36, LogTxStatus), (37, IndexMapping),
+        (36, LogTxStatus), (37, IndexMapping), (48, SchemaStatus),
+        (49, Consistency),
     ]
 
 
@@ -716,7 +942,11 @@ class Serializer:
     Values are framed as [type_id:2 BE][payload] so heterogeneous cells are
     self-describing (reference: StandardSerializer writeObjectNotNull)."""
 
-    def __init__(self):
+    def __init__(self, allow_pickle: bool = True):
+        #: whether the object-pickle fallback may encode/decode on this
+        #: registry (False for network-facing registries — see
+        #: PickledObjectSerializer)
+        self.allow_pickle = allow_pickle
         self._by_id: Dict[int, AttributeSerializer] = {}
         self._by_type: Dict[type, AttributeSerializer] = {}
         self._array_by_dtype: Dict[np.dtype, AttributeSerializer] = {}
@@ -743,8 +973,11 @@ class Serializer:
             StringListSerializer,
             BigIntegerSerializer,
             DecimalSerializer,
+            ClassSerializer,
         ):
             self.register(cls())
+        for cls in (DictSerializer, TupleSerializer, PickledObjectSerializer):
+            self.register(cls(self))
         for tid, dt in _ARRAY_IDS:
             ser = _array_serializer(tid, dt)
             self._by_id[tid] = ser

@@ -56,6 +56,21 @@ class SchemaAction(Enum):
     REMOVE_INDEX = "REMOVE_INDEX"
 
 
+class SchemaStatus(Enum):
+    """Index lifecycle states (reference: core/schema/SchemaStatus.java).
+    Management APIs accept either the enum or its string value; index cells
+    store the string form."""
+
+    INSTALLED = "INSTALLED"
+    REGISTERED = "REGISTERED"
+    ENABLED = "ENABLED"
+    DISABLED = "DISABLED"
+
+
+def _status_str(status) -> str:
+    return status.value if isinstance(status, SchemaStatus) else status
+
+
 class ManagementSystem:
     def __init__(self, graph):
         self.graph = graph
@@ -295,7 +310,8 @@ class ManagementSystem:
         self.set_relation_index_status(name, "ENABLED")
         return count
 
-    def set_relation_index_status(self, name: str, status: str) -> RelationIndex:
+    def set_relation_index_status(self, name: str, status) -> RelationIndex:
+        status = _status_str(status)
         if status not in ("REGISTERED", "ENABLED", "DISABLED"):
             raise SchemaViolationError(f"unknown relation-index status {status}")
         ri = self.graph.schema_cache.get_by_name(name)
@@ -516,11 +532,12 @@ class ManagementSystem:
         self.graph.update_schema_element(new)
 
     def await_graph_index_status(
-        self, name: str, status: str = "ENABLED", timeout_s: float = 10.0
+        self, name: str, status="ENABLED", timeout_s: float = 10.0
     ) -> bool:
         """Poll until the index reaches `status` (reference:
         GraphIndexStatusWatcher.java:102 — used after REGISTER/ENABLE to wait
         for cluster-wide acknowledgement)."""
+        status = _status_str(status)
         deadline = time.monotonic() + timeout_s
         while time.monotonic() < deadline:
             idx = self.graph.indexes.get(name)

@@ -182,17 +182,26 @@ def haversine_km(lat1: float, lon1: float, lat2: float, lon2: float) -> float:
 
 @dataclass(frozen=True)
 class Geoshape:
-    """Point / circle / box / polygon (reference: attribute/Geoshape.java:623).
+    """Geoshape vocabulary (reference: attribute/Geoshape.java:623 — point,
+    circle, box, line, polygon, multipoint, multilinestring, multipolygon,
+    geometrycollection).
 
-    kind: "Point" | "Circle" | "Box" | "Polygon"
+    kind: "Point" | "Circle" | "Box" | "Polygon" | "Line" | "MultiPoint"
+          | "MultiLineString" | "MultiPolygon" | "GeometryCollection"
     coords: Point -> [(lat, lon)]; Circle -> [(lat, lon)] + radius_km;
             Box -> [(sw_lat, sw_lon), (ne_lat, ne_lon)];
-            Polygon -> ring vertices [(lat, lon), ...]
+            Polygon -> ring vertices; Line/MultiPoint -> point list
+    parts:  MultiLineString -> Line shapes; MultiPolygon -> Polygon/Box
+            shapes; GeometryCollection -> any shapes
     """
 
     kind: str
     coords: Tuple[Tuple[float, float], ...]
     radius_km: float = 0.0
+    parts: Tuple["Geoshape", ...] = ()
+
+    #: kinds whose geometry lives in sub-shapes
+    _PART_KINDS = ("MultiLineString", "MultiPolygon", "GeometryCollection")
 
     # ------------------------------------------------------------- factories
     @staticmethod
@@ -214,6 +223,47 @@ class Geoshape:
             raise ValueError("polygon needs at least 3 points")
         return Geoshape("Polygon", pts)
 
+    @staticmethod
+    def line(points: Sequence[Tuple[float, float]]) -> "Geoshape":
+        pts = tuple((float(a), float(b)) for a, b in points)
+        if len(pts) < 2:
+            raise ValueError("line needs at least 2 points")
+        return Geoshape("Line", pts)
+
+    @staticmethod
+    def multipoint(points: Sequence[Tuple[float, float]]) -> "Geoshape":
+        pts = tuple((float(a), float(b)) for a, b in points)
+        if not pts:
+            raise ValueError("multipoint needs at least 1 point")
+        return Geoshape("MultiPoint", pts)
+
+    @staticmethod
+    def multilinestring(lines: Sequence) -> "Geoshape":
+        parts = tuple(
+            ln if isinstance(ln, Geoshape) else Geoshape.line(ln)
+            for ln in lines
+        )
+        if not parts or any(p.kind != "Line" for p in parts):
+            raise ValueError("multilinestring needs Line parts")
+        return Geoshape("MultiLineString", (), parts=parts)
+
+    @staticmethod
+    def multipolygon(polygons: Sequence) -> "Geoshape":
+        parts = tuple(
+            p if isinstance(p, Geoshape) else Geoshape.polygon(p)
+            for p in polygons
+        )
+        if not parts or any(p.kind not in ("Polygon", "Box") for p in parts):
+            raise ValueError("multipolygon needs Polygon/Box parts")
+        return Geoshape("MultiPolygon", (), parts=parts)
+
+    @staticmethod
+    def geometry_collection(shapes: Sequence["Geoshape"]) -> "Geoshape":
+        parts = tuple(shapes)
+        if not parts:
+            raise ValueError("geometrycollection needs at least one shape")
+        return Geoshape("GeometryCollection", (), parts=parts)
+
     # ------------------------------------------------------------- accessors
     @property
     def lat(self) -> float:
@@ -234,9 +284,21 @@ class Geoshape:
                 self.lat + dlat,
                 self.lon + dlon,
             )
+        if self.kind in Geoshape._PART_KINDS:
+            boxes = [p.bbox() for p in self.parts]
+            return (
+                min(b[0] for b in boxes), min(b[1] for b in boxes),
+                max(b[2] for b in boxes), max(b[3] for b in boxes),
+            )
         lats = [c[0] for c in self.coords]
         lons = [c[1] for c in self.coords]
         return (min(lats), min(lons), max(lats), max(lons))
+
+    def _probe_points(self) -> Tuple[Tuple[float, float], ...]:
+        """Representative points for conservative intersection sampling."""
+        if self.kind in Geoshape._PART_KINDS:
+            return tuple(pt for p in self.parts for pt in p._probe_points())
+        return self.coords
 
     # ------------------------------------------------------------ geometry
     def contains_point(self, lat: float, lon: float) -> bool:
@@ -247,6 +309,25 @@ class Geoshape:
         if self.kind == "Box":
             (slat, slon), (nlat, nlon) = self.coords
             return slat <= lat <= nlat and slon <= lon <= nlon
+        if self.kind == "MultiPoint":
+            return any(
+                math.isclose(lat, la) and math.isclose(lon, lo)
+                for la, lo in self.coords
+            )
+        if self.kind == "Line":
+            # on-segment test (planar, small-distance tolerance)
+            for (y1, x1), (y2, x2) in zip(self.coords, self.coords[1:]):
+                cross = (x2 - x1) * (lat - y1) - (y2 - y1) * (lon - x1)
+                if abs(cross) > 1e-9:
+                    continue
+                if (
+                    min(x1, x2) - 1e-12 <= lon <= max(x1, x2) + 1e-12
+                    and min(y1, y2) - 1e-12 <= lat <= max(y1, y2) + 1e-12
+                ):
+                    return True
+            return False
+        if self.kind in Geoshape._PART_KINDS:
+            return any(p.contains_point(lat, lon) for p in self.parts)
         # ray casting on the (lat, lon) plane
         inside = False
         pts = self.coords
@@ -262,10 +343,19 @@ class Geoshape:
         return inside
 
     def intersects(self, other: "Geoshape") -> bool:
+        # multi-shapes: any part intersecting is enough (both sides)
+        if self.kind in Geoshape._PART_KINDS:
+            return any(p.intersects(other) for p in self.parts)
+        if other.kind in Geoshape._PART_KINDS:
+            return any(self.intersects(p) for p in other.parts)
         if other.kind == "Point":
             return self.contains_point(other.lat, other.lon)
         if self.kind == "Point":
             return other.contains_point(self.lat, self.lon)
+        if other.kind == "MultiPoint":
+            return any(self.contains_point(la, lo) for la, lo in other.coords)
+        if self.kind == "MultiPoint":
+            return any(other.contains_point(la, lo) for la, lo in self.coords)
         if self.kind == "Circle" and other.kind == "Circle":
             return (
                 haversine_km(self.lat, self.lon, other.lat, other.lon)
@@ -275,15 +365,23 @@ class Geoshape:
         a, b = self.bbox(), other.bbox()
         if a[0] > b[2] or b[0] > a[2] or a[1] > b[3] or b[1] > a[3]:
             return False
-        probes = list(other.coords) + [((b[0] + b[2]) / 2, (b[1] + b[3]) / 2)]
+        probes = list(other._probe_points()) + [
+            ((b[0] + b[2]) / 2, (b[1] + b[3]) / 2)
+        ]
         if any(self.contains_point(la, lo) for la, lo in probes):
             return True
-        probes = list(self.coords) + [((a[0] + a[2]) / 2, (a[1] + a[3]) / 2)]
+        probes = list(self._probe_points()) + [
+            ((a[0] + a[2]) / 2, (a[1] + a[3]) / 2)
+        ]
         return any(other.contains_point(la, lo) for la, lo in probes)
 
     def within(self, other: "Geoshape") -> bool:
         if self.kind == "Point":
             return other.contains_point(self.lat, self.lon)
+        if self.kind in ("MultiPoint", "Line"):
+            return all(other.contains_point(la, lo) for la, lo in self.coords)
+        if self.kind in Geoshape._PART_KINDS:
+            return all(p.within(other) for p in self.parts)
         a = self.bbox()
         corners = [(a[0], a[1]), (a[0], a[3]), (a[2], a[1]), (a[2], a[3])]
         return all(other.contains_point(la, lo) for la, lo in corners)
@@ -308,6 +406,36 @@ class Geoshape:
                     [[slon, slat], [nlon, slat], [nlon, nlat], [slon, nlat], [slon, slat]]
                 ],
             }
+        elif self.kind == "Line":
+            geom = {
+                "type": "LineString",
+                "coordinates": [[lo, la] for la, lo in self.coords],
+            }
+        elif self.kind == "MultiPoint":
+            geom = {
+                "type": "MultiPoint",
+                "coordinates": [[lo, la] for la, lo in self.coords],
+            }
+        elif self.kind == "MultiLineString":
+            geom = {
+                "type": "MultiLineString",
+                "coordinates": [
+                    [[lo, la] for la, lo in p.coords] for p in self.parts
+                ],
+            }
+        elif self.kind == "MultiPolygon":
+            geom = {
+                "type": "MultiPolygon",
+                "coordinates": [
+                    [json.loads(p.to_geojson())["coordinates"][0]]
+                    for p in self.parts
+                ],
+            }
+        elif self.kind == "GeometryCollection":
+            geom = {
+                "type": "GeometryCollection",
+                "geometries": [json.loads(p.to_geojson()) for p in self.parts],
+            }
         else:
             ring = [[lo, la] for la, lo in self.coords]
             ring.append(ring[0])
@@ -316,7 +444,7 @@ class Geoshape:
 
     @staticmethod
     def from_geojson(text: str) -> "Geoshape":
-        g = json.loads(text)
+        g = json.loads(text) if isinstance(text, str) else text
         t = g["type"]
         if t == "Point":
             lon, lat = g["coordinates"]
@@ -327,6 +455,27 @@ class Geoshape:
         if t == "Polygon":
             ring = [(la, lo) for lo, la in g["coordinates"][0][:-1]]
             return _ring_to_shape(ring)
+        if t == "LineString":
+            return Geoshape.line([(la, lo) for lo, la in g["coordinates"]])
+        if t == "MultiPoint":
+            return Geoshape.multipoint(
+                [(la, lo) for lo, la in g["coordinates"]]
+            )
+        if t == "MultiLineString":
+            return Geoshape.multilinestring(
+                [[(la, lo) for lo, la in line] for line in g["coordinates"]]
+            )
+        if t == "MultiPolygon":
+            return Geoshape.multipolygon(
+                [
+                    _ring_to_shape([(la, lo) for lo, la in poly[0][:-1]])
+                    for poly in g["coordinates"]
+                ]
+            )
+        if t == "GeometryCollection":
+            return Geoshape.geometry_collection(
+                [Geoshape.from_geojson(sub) for sub in g["geometries"]]
+            )
         raise ValueError(f"unsupported GeoJSON type {t}")
 
     def to_wkt(self) -> str:
@@ -335,6 +484,26 @@ class Geoshape:
             return f"POINT ({self.lon} {self.lat})"
         if self.kind == "Circle":
             return f"BUFFER (POINT ({self.lon} {self.lat}), {self.radius_km})"
+        if self.kind == "Line":
+            inner = ", ".join(f"{lo} {la}" for la, lo in self.coords)
+            return f"LINESTRING ({inner})"
+        if self.kind == "MultiPoint":
+            inner = ", ".join(f"({lo} {la})" for la, lo in self.coords)
+            return f"MULTIPOINT ({inner})"
+        if self.kind == "MultiLineString":
+            inner = ", ".join(
+                "(" + ", ".join(f"{lo} {la}" for la, lo in p.coords) + ")"
+                for p in self.parts
+            )
+            return f"MULTILINESTRING ({inner})"
+        if self.kind == "MultiPolygon":
+            inner = ", ".join(
+                p.to_wkt()[len("POLYGON "):] for p in self.parts
+            )
+            return f"MULTIPOLYGON ({inner})"
+        if self.kind == "GeometryCollection":
+            inner = ", ".join(p.to_wkt() for p in self.parts)
+            return f"GEOMETRYCOLLECTION ({inner})"
         if self.kind == "Box":
             (slat, slon), (nlat, nlon) = self.coords
             ring = [
@@ -365,14 +534,74 @@ class Geoshape:
             )
         m = re.fullmatch(r"POLYGON\s*\(\(\s*(.*?)\s*\)\)", t, re.I)
         if m:
-            pts = []
-            for pair in m.group(1).split(","):
-                x, y = pair.split()
-                pts.append((float(y), float(x)))
-            if pts and pts[0] == pts[-1]:
-                pts = pts[:-1]
-            return _ring_to_shape(pts)
+            return _ring_to_shape(_wkt_ring(m.group(1)))
+        m = re.fullmatch(r"LINESTRING\s*\(\s*(.*?)\s*\)", t, re.I)
+        if m:
+            return Geoshape.line(_wkt_points(m.group(1)))
+        m = re.fullmatch(r"MULTIPOINT\s*\(\s*(.*?)\s*\)", t, re.I)
+        if m:
+            pts = [
+                _wkt_points(grp.strip().strip("()"))[0]
+                for grp in _split_top_level(m.group(1))
+            ]
+            return Geoshape.multipoint(pts)
+        m = re.fullmatch(r"MULTILINESTRING\s*\(\s*(.*?)\s*\)", t, re.I)
+        if m:
+            return Geoshape.multilinestring(
+                [
+                    _wkt_points(grp.strip()[1:-1])
+                    for grp in _split_top_level(m.group(1))
+                ]
+            )
+        m = re.fullmatch(r"MULTIPOLYGON\s*\(\s*(.*?)\s*\)", t, re.I)
+        if m:
+            polys = []
+            for grp in _split_top_level(m.group(1)):
+                ring_txt = grp.strip()
+                # strip the two polygon parens: ((a b, c d, ...))
+                ring_txt = ring_txt[1:-1].strip()[1:-1]
+                polys.append(_ring_to_shape(_wkt_ring(ring_txt)))
+            return Geoshape.multipolygon(polys)
+        m = re.fullmatch(r"GEOMETRYCOLLECTION\s*\(\s*(.*?)\s*\)", t, re.I)
+        if m:
+            return Geoshape.geometry_collection(
+                [
+                    Geoshape.from_wkt(grp.strip())
+                    for grp in _split_top_level(m.group(1))
+                ]
+            )
         raise ValueError(f"unsupported WKT {text!r}")
+
+
+def _wkt_points(text: str):
+    """'x y, x y, ...' -> [(lat, lon), ...] (WKT axis order is lon lat)."""
+    pts = []
+    for pair in text.split(","):
+        x, y = pair.split()
+        pts.append((float(y), float(x)))
+    return pts
+
+
+def _wkt_ring(text: str):
+    pts = _wkt_points(text)
+    if pts and pts[0] == pts[-1]:
+        pts = pts[:-1]
+    return pts
+
+
+def _split_top_level(text: str):
+    """Split on commas at paren depth 0 (WKT multi-geometry separators)."""
+    parts, depth, start = [], 0, 0
+    for i, ch in enumerate(text):
+        if ch == "(":
+            depth += 1
+        elif ch == ")":
+            depth -= 1
+        elif ch == "," and depth == 0:
+            parts.append(text[start:i])
+            start = i + 1
+    parts.append(text[start:])
+    return [p for p in (s.strip() for s in parts) if p]
 
 
 def _ring_to_shape(ring) -> "Geoshape":

@@ -72,8 +72,10 @@ _OP_EXISTS = 8
 _OP_CLEAR = 9
 _OP_FEATURES = 10
 
-#: one registry for the wire; user enums are not expected in index fields
-_SER = Serializer()
+#: one registry for the wire; user enums are not expected in index fields.
+#: allow_pickle=False: a network peer must never be able to ship a pickle
+#: payload into this process (see PickledObjectSerializer)
+_SER = Serializer(allow_pickle=False)
 
 
 # ------------------------------------------------------------------ encoding

@@ -80,6 +80,9 @@ def test_ordered_string_sorts_and_terminates(ser):
 
 
 def test_unknown_type_rejected(ser):
+    # unpicklable (local class) objects still fail loudly; picklable unknown
+    # types now ride the object fallback (reference: ObjectSerializer id 1,
+    # StandardSerializer.java:78) — see test_serializer_parity.py
     class Foo:
         pass
 

@@ -1,0 +1,129 @@
+"""Two-process jax.distributed smoke test (VERDICT r3 #6).
+
+Real multi-host hardware is unavailable here, but the multi-controller
+RUNTIME is exercisable on localhost: a coordinator + 2 worker processes,
+each contributing 2 virtual CPU devices to one 4-device global mesh
+(reference analogue: SparkGraphComputer executors over Hadoop input splits,
+HadoopInputFormat.java:34 — here the executors are JAX processes and the
+splits are host_partition_range blocks).
+
+Asserts, inside each process: init_multihost wiring, the global mesh
+spanning BOTH processes' devices, host_partition_range's disjoint cover,
+and a tiny power-iteration PageRank whose per-superstep psum crosses the
+process boundary, checked against a numpy oracle.
+"""
+
+import os
+import socket
+import subprocess
+import sys
+
+import numpy as np
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+_WORKER = r"""
+import os, sys
+pid = int(sys.argv[1]); port = sys.argv[2]
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+sys.path.insert(0, %(repo)r)
+
+from janusgraph_tpu.parallel.multihost import (
+    global_mesh,
+    host_partition_range,
+    init_multihost,
+)
+
+got_pid = init_multihost(
+    coordinator_address=f"127.0.0.1:{port}", num_processes=2, process_id=pid
+)
+assert got_pid == pid
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec as P
+from jax.experimental.shard_map import shard_map
+
+assert jax.process_count() == 2
+assert jax.process_index() == pid
+mesh = global_mesh()
+assert mesh.devices.size == 4, mesh.devices  # 2 local x 2 processes
+
+# disjoint contiguous cover of 8 storage partitions across the 2 hosts
+lo, hi = host_partition_range(8)
+assert (lo, hi) == ((0, 4) if pid == 0 else (4, 8))
+
+# tiny PageRank power iteration: A column-sharded, rank shard per device,
+# psum combines partial mat-vecs ACROSS processes every superstep
+n = 16
+rng = np.random.default_rng(0)
+A = (rng.random((n, n)) < 0.3).astype(np.float32)
+A = A / np.maximum(A.sum(axis=0, keepdims=True), 1.0)
+x0 = np.full((n,), 1.0 / n, dtype=np.float32)
+
+def superstep(a_blk, x_blk):
+    return jax.lax.psum(a_blk @ x_blk, "p")
+
+step = jax.jit(
+    shard_map(
+        superstep, mesh=mesh,
+        in_specs=(P(None, "p"), P("p")), out_specs=P(None),
+    )
+)
+A_sh = jax.device_put(A, NamedSharding(mesh, P(None, "p")))
+x = jax.device_put(x0, NamedSharding(mesh, P("p")))
+for _ in range(5):
+    full = step(A_sh, x)
+    x = jax.device_put(np.asarray(full), NamedSharding(mesh, P("p")))
+
+expect = x0.copy()
+for _ in range(5):
+    expect = A @ expect
+np.testing.assert_allclose(np.asarray(full), expect, rtol=1e-5)
+print(f"OK pid={pid} sum={float(np.asarray(full).sum()):.6f}", flush=True)
+"""
+
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def test_two_process_distributed_mesh(tmp_path):
+    script = tmp_path / "worker.py"
+    script.write_text(_WORKER % {"repo": _REPO})
+    port = _free_port()
+    env = {
+        k: v for k, v in os.environ.items()
+        # scrub the single-process test harness flags; workers set their own
+        if k not in ("XLA_FLAGS", "JAX_PLATFORMS", "PYTHONPATH")
+    }
+    env["PYTHONPATH"] = _REPO
+    procs = [
+        subprocess.Popen(
+            [sys.executable, str(script), str(pid), str(port)],
+            env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+        )
+        for pid in (0, 1)
+    ]
+    outs = []
+    try:
+        for p in procs:
+            out, err = p.communicate(timeout=240)
+            outs.append((p.returncode, out.decode(), err.decode()))
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+    for rc, out, err in outs:
+        assert rc == 0, f"worker failed rc={rc}\nstdout:{out}\nstderr:{err}"
+        assert "OK pid=" in out
+    # both processes computed the identical global result
+    sums = {line.split("sum=")[1] for rc, out, _ in outs
+            for line in out.splitlines() if "sum=" in line}
+    assert len(sums) == 1

@@ -1,0 +1,175 @@
+"""Round-4 serializer registry additions (reference:
+StandardSerializer.java:78-132): containers, object fallback, class values,
+extra array dtypes, lifecycle enums, and the extended Geoshape vocabulary
+(reference: attribute/Geoshape.java:623). Round-trip per type, plus
+order-preservation where the codec claims it."""
+
+import numpy as np
+import pytest
+
+from janusgraph_tpu.core.attributes import Serializer, SerializerError
+from janusgraph_tpu.core.codecs import Consistency
+from janusgraph_tpu.core.management import SchemaStatus
+from janusgraph_tpu.core.predicates import Geo, Geoshape
+
+
+@pytest.fixture(scope="module")
+def ser():
+    return Serializer()
+
+
+def rt(ser, value):
+    out, _ = ser.read_object(ser.write_object(value))
+    return out
+
+
+# ------------------------------------------------------------- containers
+def test_dict_roundtrip(ser):
+    d = {"a": 1, 2: "b", "nested": {"x": 0.5}, "list": [1.0, 2.0]}
+    assert rt(ser, d) == d
+
+
+def test_tuple_roundtrip_heterogeneous(ser):
+    t = ("s", 42, 0.5, True, (1, "inner"))
+    assert rt(ser, t) == t
+
+
+def test_object_pickle_fallback_roundtrip(ser):
+    class Thing:
+        def __init__(self, x):
+            self.x = x
+
+        def __eq__(self, o):
+            return o.x == self.x
+
+    # an unregistered, non-container stdlib type falls through to pickle
+    v = complex(1.5, -2.5)
+    assert rt(ser, v) == v
+    # dict SUBCLASSES ride the dict codec (value-preserving, type-erasing)
+    import collections
+
+    assert rt(ser, collections.Counter("aabbb")) == {"a": 2, "b": 3}
+
+
+def test_pickle_refused_on_network_registry():
+    safe = Serializer(allow_pickle=False)
+    with pytest.raises(SerializerError, match="fallback disabled"):
+        safe.write_object(complex(1, 2))
+    trusted = Serializer()
+    frame = trusted.write_object(complex(1, 2))
+    with pytest.raises(SerializerError, match="refused"):
+        safe.read_object(frame)
+
+
+def test_class_values_roundtrip(ser):
+    import decimal
+
+    for cls in (str, int, float, decimal.Decimal, Geoshape, np.int32):
+        assert rt(ser, cls) is cls
+
+
+def test_class_import_allowlist(ser):
+    frame = bytearray(ser.write_object(str))
+    evil = b"os:system"
+    bad = frame[:2] + evil
+    with pytest.raises(SerializerError, match="refused"):
+        ser.read_object(bytes(bad))
+
+
+def test_new_array_dtypes(ser):
+    for dt in (np.uint16, np.uint32, np.uint64, np.float16):
+        a = np.arange(5).astype(dt)
+        out = rt(ser, a)
+        assert out.dtype == a.dtype and np.array_equal(out, a)
+
+
+def test_lifecycle_enums_roundtrip(ser):
+    assert rt(ser, SchemaStatus.ENABLED) is SchemaStatus.ENABLED
+    assert rt(ser, Consistency.LOCK) is Consistency.LOCK
+
+
+def test_registry_id_count():
+    s = Serializer()
+    assert len(s._by_id) >= 48
+
+
+# ------------------------------------------------------------- geoshapes
+SHAPES = [
+    Geoshape.line([(0, 0), (1, 1), (1, 2)]),
+    Geoshape.multipoint([(0, 0), (2, 2)]),
+    Geoshape.multilinestring([[(0, 0), (1, 1)], [(2, 2), (3, 3)]]),
+    Geoshape.multipolygon(
+        [[(0, 0), (0, 2), (2, 2), (2, 1)], [(5, 5), (5, 7), (7, 7), (7, 5)]]
+    ),
+    Geoshape.geometry_collection(
+        [Geoshape.point(1, 1), Geoshape.circle(2, 2, 5.0),
+         Geoshape.line([(0, 0), (4, 4)])]
+    ),
+]
+
+
+@pytest.mark.parametrize("shape", SHAPES, ids=lambda s: s.kind)
+def test_geoshape_binary_roundtrip(ser, shape):
+    assert rt(ser, shape) == shape
+
+
+@pytest.mark.parametrize("shape", SHAPES, ids=lambda s: s.kind)
+def test_geoshape_wkt_roundtrip(shape):
+    back = Geoshape.from_wkt(shape.to_wkt())
+    # multipolygon boxes normalize: compare via WKT fixpoint
+    assert Geoshape.from_wkt(back.to_wkt()) == back
+
+
+@pytest.mark.parametrize("shape", SHAPES, ids=lambda s: s.kind)
+def test_geoshape_geojson_roundtrip(shape):
+    back = Geoshape.from_geojson(shape.to_geojson())
+    assert Geoshape.from_geojson(back.to_geojson()) == back
+
+
+def test_multi_shape_predicates():
+    mp = Geoshape.multipolygon(
+        [[(0, 0), (0, 2), (2, 2), (2, 0)], [(5, 5), (5, 7), (7, 7), (7, 5)]]
+    )
+    assert Geo.INTERSECT.evaluate(Geoshape.point(1, 1), mp)
+    assert Geo.INTERSECT.evaluate(Geoshape.point(6, 6), mp)
+    assert Geo.DISJOINT.evaluate(Geoshape.point(3.5, 3.5), mp)
+    assert Geo.WITHIN.evaluate(
+        Geoshape.multipoint([(1, 1), (6, 6)]), mp
+    )
+    assert not Geo.WITHIN.evaluate(
+        Geoshape.multipoint([(1, 1), (3.5, 3.5)]), mp
+    )
+    line = Geoshape.line([(1, -1), (1, 3)])
+    assert Geo.INTERSECT.evaluate(line, mp)
+    coll = Geoshape.geometry_collection([Geoshape.point(6, 6), line])
+    assert Geo.INTERSECT.evaluate(coll, mp)
+
+
+def test_line_contains_point():
+    ln = Geoshape.line([(0, 0), (2, 2)])
+    assert ln.contains_point(1, 1)
+    assert not ln.contains_point(1, 1.5)
+
+
+def test_mixed_index_multi_geoshape(tmp_path):
+    """The new shapes work through the index tier end to end."""
+    from janusgraph_tpu.indexing import (
+        IndexMutation,
+        IndexQuery,
+        KeyInformation,
+        LocalIndexProvider,
+        PredicateCondition,
+    )
+
+    p = LocalIndexProvider(directory=str(tmp_path / "gidx"))
+    p.register("s", "area", KeyInformation(Geoshape))
+    m = IndexMutation(is_new=True)
+    m.add("area", Geoshape.multipolygon(
+        [[(0, 0), (0, 2), (2, 2), (2, 0)], [(5, 5), (5, 7), (7, 7), (7, 5)]]
+    ))
+    p.mutate({"s": {"d1": m}}, {})
+    hits = p.query("s", IndexQuery(
+        PredicateCondition("area", Geo.INTERSECT, Geoshape.point(6, 6))
+    ))
+    assert hits == ["d1"]
+    p.close()
