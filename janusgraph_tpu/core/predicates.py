@@ -389,6 +389,9 @@ class Geoshape:
     # ---------------------------------------------------------------- codecs
     def to_geojson(self) -> str:
         """reference: Geoshape GeoJSON serializer (lon, lat axis order)."""
+        return json.dumps(self._geom_dict(), sort_keys=True)
+
+    def _geom_dict(self) -> dict:
         if self.kind == "Point":
             geom = {"type": "Point", "coordinates": [self.lon, self.lat]}
         elif self.kind == "Circle":
@@ -427,20 +430,19 @@ class Geoshape:
             geom = {
                 "type": "MultiPolygon",
                 "coordinates": [
-                    [json.loads(p.to_geojson())["coordinates"][0]]
-                    for p in self.parts
+                    [p._geom_dict()["coordinates"][0]] for p in self.parts
                 ],
             }
         elif self.kind == "GeometryCollection":
             geom = {
                 "type": "GeometryCollection",
-                "geometries": [json.loads(p.to_geojson()) for p in self.parts],
+                "geometries": [p._geom_dict() for p in self.parts],
             }
         else:
             ring = [[lo, la] for la, lo in self.coords]
             ring.append(ring[0])
             geom = {"type": "Polygon", "coordinates": [ring]}
-        return json.dumps(geom, sort_keys=True)
+        return geom
 
     @staticmethod
     def from_geojson(text: str) -> "Geoshape":

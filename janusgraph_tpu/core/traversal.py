@@ -185,22 +185,31 @@ class Traverser:
     (reference: TinkerPop traversers carry the same path/labels state; the
     reference reuses them via graphdb/tinkerpop/ glue)."""
 
-    __slots__ = ("obj", "prev", "path", "tags")
+    __slots__ = ("obj", "prev", "path", "tags", "sack")
 
-    def __init__(self, obj, prev=None, path=None, tags=None):
+    def __init__(self, obj, prev=None, path=None, tags=None, sack=None):
         self.obj = obj
         self.prev = prev
         self.path = (obj,) if path is None else path
         self.tags = tags
+        #: per-traverser scratch value (TinkerPop sack(); set by
+        #: with_sack(), transformed by sack(fn), read by sack())
+        self.sack = sack
 
     def child(self, obj, prev=None) -> "Traverser":
         """A traverser one step further along: path extended, tags kept."""
-        return Traverser(obj, prev=prev, path=self.path + (obj,), tags=self.tags)
+        return Traverser(
+            obj, prev=prev, path=self.path + (obj,), tags=self.tags,
+            sack=self.sack,
+        )
 
     def tagged(self, name: str) -> "Traverser":
         tags = dict(self.tags) if self.tags else {}
         tags[name] = self.obj
-        return Traverser(self.obj, prev=self.prev, path=self.path, tags=tags)
+        return Traverser(
+            self.obj, prev=self.prev, path=self.path, tags=tags,
+            sack=self.sack,
+        )
 
 
 class AnonymousTraversal:
@@ -243,6 +252,14 @@ class GraphTraversalSource:
     def __init__(self, graph, tx=None):
         self.graph = graph
         self.tx = tx or graph.new_transaction()
+        self._sack_init = None
+
+    def with_sack(self, initial) -> "GraphTraversalSource":
+        """Seed every traverser with a sack value (TinkerPop withSack();
+        a callable is invoked per traverser so mutable sacks don't alias)."""
+        src = GraphTraversalSource(self.graph, self.tx)
+        src._sack_init = initial if callable(initial) else (lambda: initial)
+        return src
 
     def V(self, *ids) -> "GraphTraversal":
         return GraphTraversal(self, _start_vertices(self, ids))
@@ -759,10 +776,14 @@ class GraphTraversal:
         return self.aggregate(name)
 
     def cap(self, name: str) -> "GraphTraversal":
-        """Replace the frontier with the collected side-effect list."""
+        """Replace the frontier with the collected side-effect — the list
+        for aggregate()/store(), or the materialized induced graph for
+        subgraph() buckets."""
 
         def step(ts):
             vals = list(self._side_effects.get(name, []))
+            if name in getattr(self, "_subgraph_names", ()):
+                return [Traverser(self._materialize_subgraph(vals))]
             return [Traverser(vals)]
 
         self._add(step, name=f"cap({name})")
@@ -918,6 +939,192 @@ class GraphTraversal:
 
         self._add(step, name="simplePath")
         return self
+
+    def cyclic_path(self) -> "GraphTraversal":
+        """Keep traversers whose path REVISITS an element — the complement
+        of simple_path() (TinkerPop CyclicPathStep)."""
+
+        def step(ts):
+            out = []
+            for t in ts:
+                seen = set()
+                cyclic = False
+                for o in t.path:
+                    k = o.id if isinstance(o, (Vertex, Edge)) else o
+                    try:
+                        if k in seen:
+                            cyclic = True
+                            break
+                        seen.add(k)
+                    except TypeError:
+                        pass
+                if cyclic:
+                    out.append(t)
+            return out
+
+        self._add(step, name="cyclicPath")
+        return self
+
+    def has_not(self, key: str) -> "GraphTraversal":
+        """Keep elements WITHOUT the property (TinkerPop hasNot())."""
+        tx = self.tx
+        self._add(
+            lambda ts: [
+                t for t in ts if _element_value(t, key, tx) is None
+            ],
+            name=f"hasNot({key})",
+        )
+        return self
+
+    def local(self, body) -> "GraphTraversal":
+        """Apply `body` to each traverser in ISOLATION (TinkerPop local()):
+        barrier semantics inside the body — order/limit/fold/count — scope
+        to one traverser's sub-frontier instead of the whole frontier."""
+        sub = self._sub_steps(body)
+
+        def step(ts):
+            out = []
+            for t in ts:
+                out.extend(self._apply_steps(sub, [t]))
+            return out
+
+        self._add(step, name="local")
+        return self
+
+    def tree(self) -> "GraphTraversal":
+        """Collapse the frontier into ONE nested-dict tree of all paths
+        (TinkerPop TreeStep / TreeSideEffectStep's terminal form): each
+        level maps a path element to the subtree of its continuations.
+        Optional by() modulates per-depth keys (property key or body)."""
+        by_list: List[Tuple] = []
+
+        def step(ts):
+            root: dict = {}
+            for t in ts:
+                node = root
+                for depth, o in enumerate(t.path):
+                    key = (
+                        self._by_value(by_list[depth % len(by_list)], o)
+                        if by_list
+                        else o
+                    )
+                    try:
+                        node = node.setdefault(key, {})
+                    except TypeError:  # unhashable key: fall back to repr
+                        node = node.setdefault(repr(key), {})
+            return [Traverser(root)]
+
+        self._add(step, name="tree")
+        self._last_by = by_list
+        return self
+
+    def sack(self, fn=None) -> "GraphTraversal":
+        """TinkerPop sack(): with no argument, map each traverser to its
+        sack value; with a binary fn, fold the current object into the sack
+        (`new_sack = fn(sack, value)`), where by() modulates which value is
+        folded (property key or body; default: the object itself)."""
+        if fn is None:
+            def step(ts):
+                return [t.child(t.sack, prev=t.prev) for t in ts]
+
+            self._add(step, name="sack")
+            return self
+
+        by_list: List[Tuple] = []
+
+        def step(ts):
+            out = []
+            for t in ts:
+                val = (
+                    self._by_value(by_list[0], t.obj) if by_list else t.obj
+                )
+                # fresh traverser, NOT in-place mutation: branch steps
+                # (union/coalesce/choose/local) hand the SAME traverser to
+                # every branch — TinkerPop split semantics require one
+                # branch's sack updates to stay invisible to the others.
+                # (A fn that mutates a shared mutable sack in place still
+                # aliases — same caveat as TinkerPop's split contract.)
+                out.append(
+                    Traverser(
+                        t.obj, prev=t.prev, path=t.path, tags=t.tags,
+                        sack=fn(t.sack, val),
+                    )
+                )
+            return out
+
+        self._add(step, name="sack(fn)")
+        self._last_by = by_list
+        return self
+
+    def subgraph(self, name: str) -> "GraphTraversal":
+        """Collect traversed EDGES into side-effect `name`; cap(name)
+        materializes the induced subgraph as a standalone in-memory graph
+        (TinkerPop SubgraphStep returns a Graph). Non-edge traversers are
+        rejected loudly — an edge-less subgraph() is a query bug."""
+
+        def step(ts):
+            bucket = self._side_effects.setdefault(name, [])
+            for t in ts:
+                if not isinstance(t.obj, Edge):
+                    raise QueryError(
+                        "subgraph() requires edge traversers "
+                        f"(got {type(t.obj).__name__}); use outE/inE/bothE"
+                    )
+                bucket.append(t.obj)
+            return ts
+
+        self._subgraph_names = getattr(self, "_subgraph_names", set())
+        self._subgraph_names.add(name)
+        self._add(step, name=f"subgraph({name})")
+        return self
+
+    def _materialize_subgraph(self, edges):
+        """Build the induced graph: new in-memory graph, auto schema, all
+        endpoint vertices + the collected edges with their properties."""
+        from janusgraph_tpu.core.graph import open_graph
+
+        from janusgraph_tpu.core.codecs import Cardinality
+
+        sg = open_graph({
+            "schema.default": "auto", "ids.authority-wait-ms": 0.0,
+        })
+        tx = sg.new_transaction()
+        vmap = {}
+        list_keys = set()
+
+        def copy_vertex(v):
+            if v.id not in vmap:
+                grouped: Dict[str, list] = {}
+                for p in v.properties():
+                    grouped.setdefault(p.key, []).append(p.value)
+                single = {k: vs[0] for k, vs in grouped.items() if len(vs) == 1}
+                nv = tx.add_vertex(v.label, **single)
+                # multi-valued (LIST/SET cardinality) keys keep EVERY value:
+                # declare the key LIST in the subgraph's schema, then append
+                for k, vs in grouped.items():
+                    if len(vs) == 1:
+                        continue
+                    if k not in list_keys:
+                        if sg.schema_cache.get_by_name(k) is None:
+                            sg.management().make_property_key(
+                                k, type(vs[0]), Cardinality.LIST
+                            )
+                        list_keys.add(k)
+                    for val in vs:
+                        nv.property(k, val)
+                vmap[v.id] = nv
+            return vmap[v.id]
+
+        seen_edges = set()
+        for e in edges:
+            if e.id in seen_edges:
+                continue
+            seen_edges.add(e.id)
+            ov = copy_vertex(e.out_vertex)
+            iv = copy_vertex(e.in_vertex)
+            tx.add_edge(ov, e.label, iv, **e.property_values())
+        tx.commit()
+        return sg
 
     # -- branching ------------------------------------------------------------
     def union(self, *bodies) -> "GraphTraversal":
@@ -1097,7 +1304,10 @@ class GraphTraversal:
                     frontier = nxt
                 for b in frontier:
                     out.append(
-                        Traverser(t.obj, prev=t.prev, path=t.path, tags=b)
+                        Traverser(
+                            t.obj, prev=t.prev, path=t.path, tags=b,
+                            sack=t.sack,
+                        )
                     )
             return out
 
@@ -1272,6 +1482,10 @@ class GraphTraversal:
         self._side_effects.clear()
         run = observe if observe is not None else (lambda _label, fn, ts: fn(ts))
         ts = run("start", lambda _: self._start.run(self._pre_has), None)
+        init = getattr(self.source, "_sack_init", None)
+        if init is not None:
+            for t in ts:
+                t.sack = init()
         for step in self._steps:
             ts = run(getattr(step, "_label", "step"), step, ts)
         return ts
