@@ -578,6 +578,66 @@ def _bench_scale(jax, platform, scale, edge_factor, pr_iters, strategy, t0):
                 "filter_selectivity": round(float(fmask.mean()), 3),
             },
         )
+        # LDBC-SNB-shaped proxy (BASELINE configs #2/#5 datasets): CC +
+        # filtered 3-hop on a community-structured heavy-tail graph, one
+        # scale below the R-MAT rung (same |E| order)
+        from janusgraph_tpu.olap.generators import ldbc_snb_csr
+
+        lcsr = ldbc_snb_csr(scale)
+        _hb(f"s{scale}: ldbc-shaped proxy |V|={lcsr.num_vertices} "
+            f"|E|={lcsr.num_edges}", t0)
+        lex = TPUExecutor(lcsr, strategy=strategy)
+
+        def _lworkload(name, prog, result_key, post=None, **runkw):
+            lex.run(prog, **runkw)
+            r0 = time.perf_counter()
+            res = lex.run(prog, **runkw)
+            np.asarray(res[result_key])
+            wall = round(time.perf_counter() - r0, 3)
+            line = {
+                "stage": "workload", "workload": name, "dataset": "ldbc-shaped",
+                "platform": platform, "scale": scale, "wall_s": wall,
+                "num_edges": lcsr.num_edges,
+            }
+            if post is not None:
+                line.update(post(res))
+            _hb(f"s{scale}: {name} {wall}s", t0)
+            _emit(line)
+
+        _lworkload(
+            "connected_components_ldbc",
+            ConnectedComponentsProgram(max_iterations=64),
+            "component",
+            post=lambda res: {
+                "components": int(
+                    len(np.unique(np.asarray(res["component"])))
+                ),
+            },
+        )
+        lmask = evaluate_filter_mask(
+            lcsr, (PropertyFilter("creation_day", Cmp.GREATER_THAN, 1825),)
+        )
+        _lworkload(
+            "filtered_3hop_ldbc",
+            OLAPTraversalProgram(
+                (
+                    TraversalStep("out"),
+                    TraversalStep(
+                        "out", None,
+                        (PropertyFilter("creation_day", Cmp.GREATER_THAN,
+                                        1825),),
+                    ),
+                    TraversalStep("out"),
+                ),
+                step_masks=np.stack(
+                    [np.ones(lcsr.num_vertices, np.float32), lmask,
+                     np.ones(lcsr.num_vertices, np.float32)], axis=1,
+                ),
+            ),
+            "count",
+            post=lambda res: {"paths": float(np.asarray(res["count"]).sum())},
+        )
+        del lex, lcsr
     del ex, csr
 
 

@@ -47,8 +47,22 @@ def cmd_server(args) -> int:
             HMACAuthenticator,
         )
 
-        creds_graph = _og(_load_config(args.auth_credentials))
-        authenticator = HMACAuthenticator(CredentialsAuthenticator(creds_graph))
+        creds_cfg = _load_config(args.auth_credentials)
+        # server.auth.credentials-db names the credentials graph
+        # (reference: the credentials-graph convention)
+        creds_cfg.setdefault(
+            "graph.graphname",
+            graph.config.get("server.auth.credentials-db"),
+        )
+        creds_graph = _og(creds_cfg)
+        secret = graph.config.get("server.auth.secret")
+        authenticator = HMACAuthenticator(
+            CredentialsAuthenticator(creds_graph),
+            secret=secret.encode() if secret else None,
+            token_ttl_seconds=(
+                graph.config.get("server.auth.token-ttl-ms") / 1000.0
+            ),
+        )
 
     server = JanusGraphServer(
         manager=manager,
@@ -56,6 +70,7 @@ def cmd_server(args) -> int:
         authenticator=authenticator,
         host=args.host,
         port=args.port,
+        max_request_bytes=graph.config.get("server.max-request-bytes"),
     ).start()
     print(f"JanusGraph-TPU server listening on {args.host}:{server.port}")
     try:

@@ -306,6 +306,151 @@ SERVER_NS.option("port", int, "bind port", 8182)
 SERVER_NS.option("auth.enabled", bool, "require HMAC token auth", False)
 SERVER_NS.option("auth.secret", str, "HMAC token signing secret", "")
 
+# ---- round-4 vocabulary growth: every option below is READ at a concrete
+# ---- site (named in its description) — no dead knobs
+QUERY_NS = ConfigNamespace("query", "query execution", ROOT)
+
+STORAGE.option(
+    "fsync", bool,
+    "fsync WAL appends on the persistent local backend (localstore). "
+    "Default True: matches the backend's own durable default", True,
+)
+STORAGE.option(
+    "backoff-base-ms", float,
+    "initial backoff of the temporary-failure retry guard (backend_op)",
+    50.0, Mutability.MASKABLE, lambda v: v > 0,
+)
+STORAGE.option(
+    "backoff-max-ms", float,
+    "backoff ceiling of the temporary-failure retry guard (backend_op)",
+    2000.0, Mutability.MASKABLE, lambda v: v > 0,
+)
+CACHE.option(
+    "edgestore-fraction", float,
+    "share of cache.db-cache-size given to the edgestore; the rest goes to "
+    "the graph-index store (Backend.java:107's 80/20 split)", 0.8,
+    Mutability.MASKABLE, lambda v: 0.0 < v < 1.0,
+)
+LOG_NS.option(
+    "send-delay-ms", float,
+    "max buffering delay before a log batch is flushed (KCVSLog sender)",
+    10.0, Mutability.MASKABLE, lambda v: v >= 0,
+)
+LOG_NS.option(
+    "ttl-seconds", float,
+    "expire log rows after this long (0 = keep; requires a cell-TTL "
+    "backend; read in Backend.get_log)", 0.0,
+    Mutability.GLOBAL_OFFLINE, lambda v: v >= 0,
+)
+COMPUTER_NS.option(
+    "frontier", str,
+    "ShortestPath frontier compaction ('auto'|'off'; olap/frontier.py)",
+    "auto", Mutability.MASKABLE, lambda v: v in ("auto", "off"),
+)
+COMPUTER_NS.option(
+    "ell-auto-budget-bytes", int,
+    "HBM budget the auto strategy lets the ELL pack use before falling "
+    "back to segment reduction (TPUExecutor._auto_strategy)",
+    6 << 30, Mutability.MASKABLE, lambda v: v > 0,
+)
+COMPUTER_NS.option(
+    "ell-auto-pad", float,
+    "padding-ratio ceiling for the auto ELL strategy", 3.0,
+    Mutability.MASKABLE, lambda v: v >= 1.0,
+)
+COMPUTER_NS.option(
+    "channel-cache-size", int,
+    "typed edge-channel ELL views kept device-resident (LRU)", 8,
+    Mutability.MASKABLE, lambda v: v > 0,
+)
+SERVER_NS.option(
+    "max-request-bytes", int,
+    "reject HTTP bodies/WS frames larger than this (server/server.py)",
+    1 << 20, Mutability.LOCAL, lambda v: v > 0,
+)
+SERVER_NS.option(
+    "auth.token-ttl-ms", float,
+    "HMAC token lifetime (server/auth.py TokenAuthenticator)", 3_600_000.0,
+    Mutability.LOCAL, lambda v: v > 0,
+)
+SERVER_NS.option(
+    "auth.credentials-db", str,
+    "name of the credentials graph/store for SASL-style user auth",
+    "credentials",
+)
+INDEX_NS.option(
+    "search.pool-size", int,
+    "client connections to the remote index server", 4,
+    Mutability.LOCAL, lambda v: v > 0,
+)
+INDEX_NS.option(
+    "search.retry-time-ms", float,
+    "retry budget for temporary remote-index failures", 10_000.0,
+    Mutability.MASKABLE, lambda v: v > 0,
+)
+INDEX_NS.option(
+    "search.fsync", bool, "fsync the persistent local index provider", False,
+)
+INDEX_NS.option(
+    "search.max-result-set-size", int,
+    "hard cap on mixed-index hits per query (reference: "
+    "index.[X].max-result-set-size; read in IndexSerializer.query)",
+    50_000, Mutability.MASKABLE, lambda v: v > 0,
+)
+QUERY_NS.option(
+    "batch-size", int,
+    "multiQuery prefetch chunk: vertices per batched multi-slice call "
+    "(tx.prefetch; reference: query.batch)", 2500,
+    Mutability.MASKABLE, lambda v: v > 0,
+)
+QUERY_NS.option(
+    "force-index", bool,
+    "refuse traversals that would fall back to a full graph scan "
+    "(reference: query.force-index)", False, Mutability.MASKABLE,
+)
+QUERY_NS.option(
+    "hard-max-limit", int,
+    "clamp on index-query limits (reference: query.hard-max-limit)",
+    1 << 20, Mutability.MASKABLE, lambda v: v > 0,
+)
+CLUSTER.option(
+    "coordinator-address", str,
+    "jax.distributed coordinator host:port for multi-host runs "
+    "(parallel/multihost.init_multihost; env JAX_COORDINATOR_ADDRESS wins)",
+    "",
+)
+CLUSTER.option(
+    "num-processes", int,
+    "process count of the multi-host run (0 = single-process)", 0,
+    Mutability.LOCAL, lambda v: v >= 0,
+)
+CLUSTER.option(
+    "process-id", int, "this host's process index in the multi-host run", 0,
+    Mutability.LOCAL, lambda v: v >= 0,
+)
+GRAPH.option(
+    "replace-instance-if-exists", bool,
+    "re-register over a stale instance id instead of refusing to open "
+    "(instance registry in core/graph.py)", False,
+)
+METRICS_NS.option(
+    "prefix", str, "prefix prepended to every emitted metric name",
+    "janusgraph",
+)
+METRICS_NS.option(
+    "console-interval-ms", float,
+    "periodic console metrics reporter (0 = off; util/metrics.py)", 0.0,
+    Mutability.LOCAL, lambda v: v >= 0,
+)
+METRICS_NS.option(
+    "csv-interval-ms", float,
+    "periodic CSV metrics reporter (0 = off)", 0.0,
+    Mutability.LOCAL, lambda v: v >= 0,
+)
+METRICS_NS.option(
+    "csv-directory", str, "directory the CSV reporter writes into", "",
+)
+
 
 def describe_options() -> str:
     """Render the registry as a config-reference table (reference:

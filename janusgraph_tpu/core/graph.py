@@ -56,7 +56,7 @@ def _open_local(cfg):
         raise ConfigurationError(
             "storage.backend=local requires storage.directory"
         )
-    return open_local_kcvs(directory)
+    return open_local_kcvs(directory, fsync=cfg.get("storage.fsync"))
 
 
 def _open_sharded(cfg):
@@ -81,6 +81,9 @@ def _open_remote(cfg):
         port,
         pool_size=cfg.get("storage.connection-pool-size"),
         retry_time_s=cfg.get("storage.retry-time-ms") / 1000.0,
+        backoff_base_s=cfg.get("storage.backoff-base-ms") / 1000.0,
+        backoff_max_s=cfg.get("storage.backoff-max-ms") / 1000.0,
+        parallel_ops=cfg.get("storage.parallel-backend-ops"),
     )
 
 
@@ -239,6 +242,7 @@ class JanusGraphTPU:
             id_block_size=cfg.get("ids.block-size"),
             cache_ttl_seconds=(ttl_ms / 1000.0) if ttl_ms > 0 else None,
             metrics_enabled=cfg.get("metrics.enabled"),
+            edgestore_cache_fraction=cfg.get("cache.edgestore-fraction"),
         )
         self.idm = IDManager(partition_bits=cfg.get("ids.partition-bits"))
         self.edge_serializer = EdgeSerializer(self.serializer, self.idm)
@@ -252,7 +256,12 @@ class JanusGraphTPU:
         self.instance_id = (
             cfg.get("graph.unique-instance-id") or generate_instance_id()
         )
+        self._metric_reporters = []
         self.instance_registry = InstanceRegistry(self.backend)
+        if cfg.get("graph.replace-instance-if-exists"):
+            # take over a stale registration instead of refusing to open
+            # (reference: graph.replace-instance-if-exists)
+            self.instance_registry.deregister(self.instance_id)
         self.instance_registry.register(self.instance_id)
         from janusgraph_tpu.core.placement import make_placement_strategy
 
@@ -273,6 +282,8 @@ class JanusGraphTPU:
             num_buckets=cfg.get("log.num-buckets"),
             send_batch_size=cfg.get("log.send-batch-size"),
             read_interval_ms=cfg.get("log.read-interval-ms"),
+            send_delay_ms=cfg.get("log.send-delay-ms"),
+            ttl_seconds=cfg.get("log.ttl-seconds"),
         )
         self._tx_log = None
         self._mgmt_logger = None
@@ -294,6 +305,9 @@ class JanusGraphTPU:
                 directory=cfg.get("index.search.directory"),
                 hostname=cfg.get("index.search.hostname"),
                 port=cfg.get("index.search.port"),
+                fsync=cfg.get("index.search.fsync"),
+                pool_size=cfg.get("index.search.pool-size"),
+                retry_time_s=cfg.get("index.search.retry-time-ms") / 1000.0,
             )
         self.index_providers: Dict[str, object] = shared
         # {index_name: {field: KeyInformation}} for provider.mutate calls
@@ -310,12 +324,57 @@ class JanusGraphTPU:
         # (reference: StandardJanusGraph.java:187-189 ManagementLogger on
         # systemlog)
         _ = self.management_logger
+        # multi-host runtime from config (cluster.* — the config-file
+        # deployment shape; env vars win inside init_multihost). Guarded so
+        # single-process opens never touch jax.distributed.
+        if cfg.get("cluster.num-processes") > 1:
+            from janusgraph_tpu.parallel.multihost import init_multihost
+
+            init_multihost(config=cfg)
+        # periodic metrics reporters LAST: started only once the open can
+        # no longer fail (a failed open must not leak reporter threads)
+        # (metrics.console-interval-ms / metrics.csv-interval-ms; reference
+        # reporter plumbing: GraphDatabaseConfiguration.java:1012-1094)
+        if cfg.get("metrics.enabled"):
+            from janusgraph_tpu.util.metrics import (
+                PeriodicReporter,
+                metrics as _process_metrics,
+            )
+
+            prefix = cfg.get("metrics.prefix")
+            ci = cfg.get("metrics.console-interval-ms")
+            if ci > 0:
+                self._metric_reporters.append(
+                    PeriodicReporter(
+                        _process_metrics, ci, "console", prefix=prefix
+                    ).start()
+                )
+            csv_i = cfg.get("metrics.csv-interval-ms")
+            if csv_i > 0:
+                self._metric_reporters.append(
+                    PeriodicReporter(
+                        _process_metrics, csv_i, "csv",
+                        directory=cfg.get("metrics.csv-directory"),
+                        prefix=prefix,
+                    ).start()
+                )
 
     # ------------------------------------------------------------- lifecycle
     def new_transaction(
-        self, read_only: bool = False, log_identifier: Optional[str] = None
+        self,
+        read_only: bool = False,
+        log_identifier: Optional[str] = None,
+        metrics_group: Optional[str] = None,
     ) -> Transaction:
-        return Transaction(self, read_only=read_only, log_identifier=log_identifier)
+        """`metrics_group` routes this transaction's operation counts under
+        `<metrics.prefix>.<group>.*` (reference: per-tx metric groups,
+        StandardJanusGraphTx.java:258-262 / groupName())."""
+        return Transaction(
+            self,
+            read_only=read_only,
+            log_identifier=log_identifier,
+            metrics_group=metrics_group,
+        )
 
     @property
     def tx_log(self):
@@ -451,6 +510,8 @@ class JanusGraphTPU:
 
     def close(self) -> None:
         if self._open:
+            for r in self._metric_reporters:
+                r.stop(final_flush=r.mode == "csv")
             self.instance_registry.deregister(self.instance_id)
             self.log_manager.close()
             self.backend.close()
@@ -1208,11 +1269,21 @@ class JanusGraphTPU:
         q = IndexQuery(
             cond,
             tuple(Order(k, desc) for k, desc in orders),
-            limit,
+            self._clamp_index_limit(limit),
             offset,
         )
         provider = self.index_providers[idx.backing]
         return [int(d) for d in provider.query(idx.name, q)]
+
+    def _clamp_index_limit(self, limit):
+        """index.search.max-result-set-size + query.hard-max-limit: every
+        mixed-index query gets a bounded limit (reference:
+        index.[X].max-result-set-size, query.hard-max-limit)."""
+        cap = min(
+            self.config.get("index.search.max-result-set-size"),
+            self.config.get("query.hard-max-limit"),
+        )
+        return cap if limit is None else min(limit, cap)
 
     def index_query(self, index_name: str, query: str, limit=None, offset=0):
         """Direct provider-syntax query returning [(vertex_id, score)]
@@ -1224,7 +1295,9 @@ class JanusGraphTPU:
         if idx is None or not idx.mixed:
             raise SchemaViolationError(f"{index_name} is not a mixed index")
         provider = self.index_providers[idx.backing]
-        hits = provider.raw_query(idx.name, RawQuery(query, limit, offset))
+        hits = provider.raw_query(
+            idx.name, RawQuery(query, self._clamp_index_limit(limit), offset)
+        )
         return [(int(d), score) for d, score in hits]
 
     def index_totals(self, index_name: str, query: str) -> int:

@@ -335,7 +335,13 @@ class _start_vertices:
             }
             vids = self.source.graph.mixed_index_query(tx, midx, covered)
             return _index_hits_with_tx_overlay(tx, vids, has_conditions)
-        # full scan (the reference warns here too)
+        # full scan (the reference warns here; query.force-index refuses)
+        if self.source.graph.config.get("query.force-index"):
+            raise QueryError(
+                "query.force-index is set and this traversal has no "
+                "index-covered start conditions — add an index or drop "
+                "the option (reference: query.force-index)"
+            )
         self.plan = {"access": "full-scan"}
         return _apply_has([Traverser(v) for v in tx.vertices()], has_conditions, tx)
 
@@ -1090,27 +1096,43 @@ class GraphTraversal:
         })
         tx = sg.new_transaction()
         vmap = {}
-        list_keys = set()
+
+        def grouped_props(v):
+            grouped: Dict[str, list] = {}
+            for p in v.properties():
+                grouped.setdefault(p.key, []).append(p.value)
+            return grouped
+
+        # pre-scan EVERY endpoint's keys BEFORE copying: a key that is
+        # multi-valued on any vertex must be declared LIST before the
+        # auto-schema path fixes it as SINGLE from a one-valued vertex
+        # (order-dependent silent value loss otherwise)
+        endpoints = {}
+        for e in edges:
+            for v in (e.out_vertex, e.in_vertex):
+                endpoints.setdefault(v.id, v)
+        multi_sample = {}
+        for v in endpoints.values():
+            for k, vs in grouped_props(v).items():
+                if len(vs) > 1 and k not in multi_sample:
+                    multi_sample[k] = vs[0]
+        for k, sample in multi_sample.items():
+            sg.management().make_property_key(
+                k, type(sample), Cardinality.LIST
+            )
 
         def copy_vertex(v):
             if v.id not in vmap:
-                grouped: Dict[str, list] = {}
-                for p in v.properties():
-                    grouped.setdefault(p.key, []).append(p.value)
-                single = {k: vs[0] for k, vs in grouped.items() if len(vs) == 1}
+                grouped = grouped_props(v)
+                single = {
+                    k: vs[0] for k, vs in grouped.items()
+                    if k not in multi_sample
+                }
                 nv = tx.add_vertex(v.label, **single)
-                # multi-valued (LIST/SET cardinality) keys keep EVERY value:
-                # declare the key LIST in the subgraph's schema, then append
-                for k, vs in grouped.items():
-                    if len(vs) == 1:
+                for k in grouped:
+                    if k not in multi_sample:
                         continue
-                    if k not in list_keys:
-                        if sg.schema_cache.get_by_name(k) is None:
-                            sg.management().make_property_key(
-                                k, type(vs[0]), Cardinality.LIST
-                            )
-                        list_keys.add(k)
-                    for val in vs:
+                    for val in grouped[k]:
                         nv.property(k, val)
                 vmap[v.id] = nv
             return vmap[v.id]

@@ -48,12 +48,23 @@ class Transaction:
         graph,
         read_only: bool = False,
         log_identifier: Optional[str] = None,
+        metrics_group: Optional[str] = None,
     ):
         self.graph = graph
         self.read_only = read_only
         # route this tx's change-set to the user CDC log "ulog_<identifier>"
         # (reference: StandardTransactionBuilder.logIdentifier)
         self.log_identifier = log_identifier
+        # per-tx metric group (reference: StandardJanusGraphTx.java:258-262)
+        self.metrics_group = metrics_group
+        self._metric = None
+        if metrics_group:
+            from janusgraph_tpu.util.metrics import metrics as _mm
+
+            prefix = graph.config.get("metrics.prefix")
+            self._metric = lambda op: _mm.counter(
+                f"{prefix}.{metrics_group}.{op}"
+            ).inc()
         self.backend_tx = graph.backend.begin_transaction()
         self._vertex_cache: Dict[int, Vertex] = {}
         # vid -> list of added relations incident to it (edges appear under
@@ -671,6 +682,8 @@ class Transaction:
         return el.type_info()
 
     def _read_slice(self, vid: int, q: SliceQuery) -> list:
+        if self._metric is not None:
+            self._metric("query")
         ck = (vid, q)
         cached = self._slice_cache.get(ck)
         if cached is not None:
@@ -692,15 +705,21 @@ class Transaction:
         vids = [v.id for v in vertices if not v.is_new]
         if not vids:
             return
+        # query.batch-size: chunk the multi-slice call so one huge frontier
+        # doesn't become a single unbounded backend request (reference:
+        # query.batch — multiQuery batch sizing)
+        chunk = self.graph.config.get("query.batch-size")
         for q in self._edge_slices(direction, labels):
             missing = [vid for vid in vids if (vid, q) not in self._slice_cache]
-            if not missing:
-                continue
-            res = self.backend_tx.edge_store_multi_query(
-                [self.graph.idm.get_key(vid) for vid in missing], q
-            )
-            for vid in missing:
-                self._slice_cache[(vid, q)] = res[self.graph.idm.get_key(vid)]
+            for lo in range(0, len(missing), chunk):
+                part = missing[lo:lo + chunk]
+                res = self.backend_tx.edge_store_multi_query(
+                    [self.graph.idm.get_key(vid) for vid in part], q
+                )
+                for vid in part:
+                    self._slice_cache[(vid, q)] = res[
+                        self.graph.idm.get_key(vid)
+                    ]
 
     # ------------------------------------------------------------------ labels
     def get_vertex_label(self, v: Vertex) -> str:
@@ -723,6 +742,8 @@ class Transaction:
     def commit(self) -> None:
         if not self._open:
             return
+        if self._metric is not None:
+            self._metric("commit")
         try:
             if self.has_mutations():
                 self.graph.commit_tx(self)

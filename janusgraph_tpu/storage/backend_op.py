@@ -20,19 +20,28 @@ from janusgraph_tpu.exceptions import (
 
 T = TypeVar("T")
 
+#: default backoff shape; per-client overrides come in as execute()
+#: arguments (storage.backoff-base-ms / storage.backoff-max-ms are wired
+#: per CLIENT — RemoteStoreManager/RemoteIndexProvider — not process-wide:
+#: two graphs in one process must not clobber each other's tuning)
+BASE_DELAY_S = 0.05
+MAX_DELAY_S = 2.0
+
 
 def execute(
     op: Callable[[], T],
     max_time_s: float = 10.0,
-    base_delay_s: float = 0.05,
-    max_delay_s: float = 2.0,
+    base_delay_s: float = None,
+    max_delay_s: float = None,
 ) -> T:
     """Run `op`, replaying temporary failures with exponential backoff until
     the time budget is spent; the last temporary error is then re-raised.
     Permanent failures propagate immediately (reference:
     BackendOperation.executeDirect semantics)."""
     deadline = time.monotonic() + max_time_s
-    delay = base_delay_s
+    delay = BASE_DELAY_S if base_delay_s is None else base_delay_s
+    if max_delay_s is None:
+        max_delay_s = MAX_DELAY_S
     attempt = 0
     while True:
         try:

@@ -261,12 +261,18 @@ class LogManager:
         num_buckets: int = 4,
         send_batch_size: int = 256,
         read_interval_ms: float = 20.0,
+        send_delay_ms: float = 10.0,
+        ttl_seconds: float = 0.0,
     ):
         self.manager = store_manager
         self.sender = sender
         self.num_buckets = num_buckets
         self.send_batch_size = send_batch_size
         self.read_interval_ms = read_interval_ms
+        self.send_delay_ms = send_delay_ms
+        # log.ttl-seconds: expire log rows via a cell-TTL wrapper (the
+        # reference's log.[X].ttl on ttl-capable stores)
+        self.ttl_seconds = ttl_seconds
         self._logs: Dict[str, KCVSLog] = {}
         self._lock = threading.Lock()
 
@@ -274,13 +280,19 @@ class LogManager:
         with self._lock:
             log = self._logs.get(name)
             if log is None:
+                store = self.manager.open_database(name)
+                if self.ttl_seconds > 0:
+                    from janusgraph_tpu.storage.ttl import TTLKCVStore
+
+                    store = TTLKCVStore(store, self.ttl_seconds)
                 log = KCVSLog(
                     name,
-                    self.manager.open_database(name),
+                    store,
                     self.manager.begin_transaction,
                     self.sender,
                     num_buckets=self.num_buckets,
                     send_batch_size=self.send_batch_size,
+                    send_interval_ms=self.send_delay_ms,
                     read_interval_ms=self.read_interval_ms,
                 )
                 self._logs[name] = log

@@ -374,6 +374,26 @@ class RemoteKCVStore(KeyColumnValueStore):
         return _decode_entries(_Reader(payload))
 
     def get_slice_multi(self, keys, slice_query, txh):
+        mgr = self._manager
+        keys = list(keys)
+        # client-side parallel multi-slice (reference: Backend.java:215-221
+        # parallelizes multi-key reads on an executor; storage.
+        # parallel-backend-ops): split the key set across the connection
+        # pool so independent sockets serve chunks concurrently
+        nconn = len(mgr._pool)
+        if mgr.parallel_ops and nconn > 1 and len(keys) > 2 * nconn:
+            chunk = -(-len(keys) // nconn)
+            parts = [keys[i:i + chunk] for i in range(0, len(keys), chunk)]
+            merged = {}
+            for res in mgr._executor().map(
+                lambda part: self._slice_multi_call(part, slice_query),
+                parts,
+            ):
+                merged.update(res)
+            return merged
+        return self._slice_multi_call(keys, slice_query)
+
+    def _slice_multi_call(self, keys, slice_query):
         out: List[bytes] = []
         _ps(out, self._name)
         out.append(struct.pack(">I", len(keys)))
@@ -383,7 +403,11 @@ class RemoteKCVStore(KeyColumnValueStore):
         payload = self._manager._call(_OP_GET_SLICE_MULTI, b"".join(out))
         r = _Reader(payload)
         n = r.u32()
-        return {r.bytes_(): _decode_entries(r) for _ in range(n)}
+        res = {}
+        for _ in range(n):
+            key = r.bytes_()
+            res[key] = _decode_entries(r)
+        return res
 
     def mutate(self, key, additions, deletions, txh) -> None:
         out: List[bytes] = []
@@ -449,14 +473,39 @@ class RemoteStoreManager(KeyColumnValueStoreManager):
     """Client-side manager speaking the remote KCVS protocol."""
 
     def __init__(self, host: str, port: int, pool_size: int = 4,
-                 retry_time_s: float = 10.0):
+                 retry_time_s: float = 10.0,
+                 backoff_base_s: float = None, backoff_max_s: float = None,
+                 parallel_ops: bool = True):
         self.host, self.port = host, port
         self.retry_time_s = retry_time_s
+        #: storage.parallel-backend-ops — client-side multi-slice fan-out
+        self.parallel_ops = parallel_ops
+        self._pool_executor = None
+        self._executor_lock = threading.Lock()
+        # per-CLIENT retry backoff (storage.backoff-base-ms/-max-ms):
+        # tuning one graph's backend must not affect others in-process
+        self.backoff_base_s = backoff_base_s
+        self.backoff_max_s = backoff_max_s
         self._pool = [_Conn(host, port) for _ in range(pool_size)]
         self._pool_lock = threading.Lock()
         self._pool_idx = 0
         self._stores: Dict[str, RemoteKCVStore] = {}
         self._features: Optional[StoreFeatures] = None
+
+    def _executor(self):
+        """Persistent fan-out pool for parallel multi-slice reads — per-call
+        ThreadPoolExecutor creation would pay thread spawn/join on every
+        batched backend read (hot under prefetch-heavy traversals)."""
+        if self._pool_executor is None:
+            from concurrent.futures import ThreadPoolExecutor
+
+            with self._executor_lock:
+                if self._pool_executor is None:
+                    self._pool_executor = ThreadPoolExecutor(
+                        max_workers=len(self._pool),
+                        thread_name_prefix="kcvs-multislice",
+                    )
+        return self._pool_executor
 
     def _acquire(self) -> _Conn:
         with self._pool_lock:
@@ -473,7 +522,12 @@ class RemoteStoreManager(KeyColumnValueStoreManager):
                 _raise_status(status, payload)
             return payload
 
-        return backend_op.execute(attempt, max_time_s=self.retry_time_s)
+        return backend_op.execute(
+            attempt,
+            max_time_s=self.retry_time_s,
+            base_delay_s=self.backoff_base_s,
+            max_delay_s=self.backoff_max_s,
+        )
 
     @property
     def features(self) -> StoreFeatures:
@@ -518,6 +572,9 @@ class RemoteStoreManager(KeyColumnValueStoreManager):
         self._call(_OP_MUTATE_MANY, b"".join(out))
 
     def close(self) -> None:
+        if self._pool_executor is not None:
+            self._pool_executor.shutdown(wait=False)
+            self._pool_executor = None
         for conn in self._pool:
             with conn.lock:
                 if conn.sock is not None:

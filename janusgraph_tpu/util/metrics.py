@@ -142,6 +142,89 @@ class MetricManager:
 metrics = MetricManager()
 
 
+class PeriodicReporter:
+    """Background reporter thread: periodically renders the registry to the
+    console or to per-metric CSV files (reference: the reporter plumbing of
+    GraphDatabaseConfiguration.java:1012-1094 — console/CSV reporters with
+    a fixed interval). Started from graph open when
+    metrics.console-interval-ms / metrics.csv-interval-ms are set."""
+
+    def __init__(
+        self,
+        manager: MetricManager,
+        interval_ms: float,
+        mode: str = "console",
+        directory: str = "",
+        prefix: str = "janusgraph",
+        sink=None,
+    ):
+        if mode not in ("console", "csv"):
+            raise ValueError(f"unknown reporter mode {mode!r}")
+        if mode == "csv" and not directory:
+            raise ValueError("csv reporter requires metrics.csv-directory")
+        self.manager = manager
+        self.interval_s = interval_ms / 1000.0
+        self.mode = mode
+        self.directory = directory
+        self.prefix = prefix
+        self._sink = sink if sink is not None else print
+        self._stop = threading.Event()
+        self._thread: "threading.Thread | None" = None
+
+    def start(self) -> "PeriodicReporter":
+        self._thread = threading.Thread(
+            target=self._loop, daemon=True, name=f"metrics-{self.mode}"
+        )
+        self._thread.start()
+        return self
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self.interval_s):
+            try:
+                self.flush()
+            except Exception as e:  # noqa: BLE001 — reporting must not die
+                self._sink(f"metrics reporter error: {e}")
+
+    def flush(self) -> None:
+        """One reporting tick (also callable directly, e.g. at close)."""
+        if self.mode == "console":
+            self._sink(
+                f"-- metrics [{self.prefix}] @ {time.strftime('%H:%M:%S')}\n"
+                + self.manager.report()
+            )
+            return
+        import os
+        import re
+
+        os.makedirs(self.directory, exist_ok=True)
+        now = time.time()
+        for name, m in self.manager.snapshot().items():
+            # metric names embed caller-supplied group strings: flatten
+            # anything path-like so files cannot escape csv-directory
+            safe = re.sub(r"[^\w.\-]", "_", f"{self.prefix}.{name}")
+            path = os.path.join(self.directory, f"{safe}.csv")
+            new = not os.path.exists(path)
+            with open(path, "a") as f:
+                if m["type"] == "counter":
+                    if new:
+                        f.write("t,count\n")
+                    f.write(f"{now:.3f},{m['count']}\n")
+                else:
+                    if new:
+                        f.write("t,count,mean_ms,total_ms,max_ms\n")
+                    f.write(
+                        f"{now:.3f},{m['count']},{m['mean_ms']:.3f},"
+                        f"{m['total_ms']:.2f},{m['max_ms']:.3f}\n"
+                    )
+
+    def stop(self, final_flush: bool = True) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+        if final_flush:
+            self.flush()
+
+
 class MetricInstrumentedStore(KeyColumnValueStore):
     """Times + counts every store operation (reference:
     MetricInstrumentedStore.java — M_GET_SLICE/M_MUTATE/... around each

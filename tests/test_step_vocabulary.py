@@ -193,3 +193,30 @@ def test_subgraph_preserves_list_cardinality():
     assert nicks == ["ace", "alpha"]
     sg.close()
     g2.close()
+
+
+def test_subgraph_multivalue_single_first_ordering():
+    """Regression: a key seen single-valued FIRST must still copy as LIST
+    when another endpoint holds several values (pre-scan, not first-wins)."""
+    from janusgraph_tpu.core.codecs import Cardinality
+
+    g2 = open_graph({"ids.authority-wait-ms": 0.0, "schema.default": "auto"})
+    mgmt = g2.management()
+    mgmt.make_property_key("nickname", str, Cardinality.LIST)
+    tx = g2.new_transaction()
+    a = tx.add_vertex(name="a")
+    a.property("nickname", "only")       # single-valued on a
+    b = tx.add_vertex(name="b")
+    b.property("nickname", "bee")
+    b.property("nickname", "buzz")       # multi-valued on b
+    tx.add_edge(a, "knows", b)           # a (out) copies BEFORE b (in)
+    tx.commit()
+    sg = g2.traversal().V().out_e("knows").subgraph("s").cap("s").to_list()[0]
+    vb = sg.traversal().V().has("name", "b").next()
+    assert sorted(p.value for p in vb.properties("nickname")) == [
+        "bee", "buzz"
+    ]
+    va = sg.traversal().V().has("name", "a").next()
+    assert [p.value for p in va.properties("nickname")] == ["only"]
+    sg.close()
+    g2.close()
