@@ -511,7 +511,10 @@ class JanusGraphTPU:
     def close(self) -> None:
         if self._open:
             for r in self._metric_reporters:
-                r.stop(final_flush=r.mode == "csv")
+                try:
+                    r.stop(final_flush=r.mode == "csv")
+                except OSError:
+                    pass  # reporting must never block deregister/close
             self.instance_registry.deregister(self.instance_id)
             self.log_manager.close()
             self.backend.close()

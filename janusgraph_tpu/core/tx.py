@@ -61,9 +61,10 @@ class Transaction:
         if metrics_group:
             from janusgraph_tpu.util.metrics import metrics as _mm
 
-            prefix = graph.config.get("metrics.prefix")
+            # bare <group>.<op>: the periodic reporters prepend
+            # metrics.prefix to EVERY name, same as store metrics
             self._metric = lambda op: _mm.counter(
-                f"{prefix}.{metrics_group}.{op}"
+                f"{metrics_group}.{op}"
             ).inc()
         self.backend_tx = graph.backend.begin_transaction()
         self._vertex_cache: Dict[int, Vertex] = {}

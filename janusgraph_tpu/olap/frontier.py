@@ -273,3 +273,40 @@ class FrontierEngine:
         if track:
             out["predecessor"] = np.asarray(pred)
         return out
+
+    def run_cc(self, program) -> Dict[str, np.ndarray]:
+        """Frontier-compacted connected components: min-LABEL propagation
+        with a changed-vertex frontier. Reuses the weighted-relaxation step
+        (message = sender's value, scatter-min, changed mask) — labels
+        propagate exactly like distances with zero edge weight. Late
+        supersteps touch a shrinking frontier, so fixpoint convergence
+        costs far less than |E| per superstep (the dense path's price).
+        Per-step parity with the dense BSP path: an unchanged vertex's
+        label was already absorbed by its neighbors when it last changed.
+        Labels ride float32 (exact below 2^24 — eligibility-guarded)."""
+        jax, jnp = self.jax, self.jnp
+        n = self.n
+        labels = jnp.asarray(np.arange(n, dtype=np.float32))
+        mask = jnp.ones((n,), bool)
+        plan = self._plan_fn(True)
+        # both orientations, NO weight arrays: the step fn's value-message
+        # branch adds w[pos] whenever weights are present in fargs, and a
+        # label must never absorb an edge weight
+        fargs = self._fargs(True, False)
+        if self.m == 0:
+            mask = jnp.zeros_like(mask)
+        for t in range(program.max_iterations):
+            count, tot_out, tot_in = (
+                int(x) for x in jax.device_get(plan(mask, fargs))
+            )
+            if count == 0:
+                break
+            fn = self._step_fn(
+                _tier(count, self.F_MIN, n),
+                _tier(max(tot_out, tot_in, 1), self.E_MIN, self.m),
+                weighted=True, track_paths=False, undirected=True,
+            )
+            labels, _, mask, _ = fn(
+                labels, None, mask, jnp.asarray(t, jnp.float32), fargs
+            )
+        return {"component": np.asarray(labels)}

@@ -714,27 +714,38 @@ class TPUExecutor:
 
     def _frontier_eligible(self, program: VertexProgram) -> bool:
         from janusgraph_tpu.olap.frontier import FrontierEngine
+        from janusgraph_tpu.olap.programs.connected_components import (
+            ConnectedComponentsProgram,
+        )
         from janusgraph_tpu.olap.programs.shortest_path import (
             ShortestPathProgram,
         )
 
-        return (
-            type(program) is ShortestPathProgram
-            and self.csr.num_edges < FrontierEngine.MAX_EDGES
-            # track_paths encodes predecessor indices in float32 — the dense
-            # path's setup() raises above 2^24 vertices; mirror that guard
-            # here instead of silently rounding predecessors
-            and not (
+        if self.csr.num_edges >= FrontierEngine.MAX_EDGES:
+            return False
+        if type(program) is ShortestPathProgram:
+            # track_paths encodes predecessor indices in float32 — the
+            # dense path's setup() raises above 2^24 vertices; mirror that
+            # guard here instead of silently rounding predecessors
+            return not (
                 program.track_paths
                 and self.csr.num_vertices >= (1 << 24)
             )
-        )
+        if type(program) is ConnectedComponentsProgram:
+            # labels are float32 vertex indices: exact below 2^24 only
+            return self.csr.num_vertices < (1 << 24)
+        return False
 
     def _run_frontier(self, program: VertexProgram) -> Dict[str, np.ndarray]:
         from janusgraph_tpu.olap.frontier import FrontierEngine
+        from janusgraph_tpu.olap.programs.connected_components import (
+            ConnectedComponentsProgram,
+        )
 
         if self._frontier_engine is None:
             self._frontier_engine = FrontierEngine(self)
+        if type(program) is ConnectedComponentsProgram:
+            return self._frontier_engine.run_cc(program)
         return self._frontier_engine.run(program)
 
     def _run_fused(

@@ -107,7 +107,8 @@ class Backend:
             # cross-instance staleness (cache.db-cache-time default 10s)
             f = edgestore_cache_fraction
             edgestore = ExpirationCacheStore(
-                edgestore, int(cache_size * f), ttl_seconds=cache_ttl_seconds
+                edgestore, max(1, int(cache_size * f)),
+                ttl_seconds=cache_ttl_seconds,
             )
             indexstore = ExpirationCacheStore(
                 indexstore, max(1, int(cache_size * (1.0 - f))),

@@ -108,7 +108,7 @@ def test_tx_metrics_group():
     tx = g.new_transaction(metrics_group="ingest")
     tx.add_vertex(name="x")
     tx.commit()
-    assert metrics.get_count("janusgraph.ingest.commit") == 1
+    assert metrics.get_count("ingest.commit") == 1
     g.close()
     metrics.reset()
 
@@ -132,7 +132,7 @@ def test_periodic_csv_reporter(tmp_path):
     time.sleep(0.15)
     g.close()  # final flush
     files = os.listdir(tmp_path / "m")
-    assert any("jgt.jgt.load.commit" in f for f in files)
+    assert any("jgt.load.commit" in f for f in files)
     assert all(os.sep not in f for f in files)
     content = open(tmp_path / "m" / sorted(files)[0]).read()
     assert content.startswith("t,")

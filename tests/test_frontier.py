@@ -165,3 +165,61 @@ def test_tier_ladder():
     assert _tier(1 << 19, 1 << 10, 1 << 20) == 1 << 20
     # hi below the pow-4 ladder: clamps to hi (callers ensure hi >= need)
     assert _tier(100, 1 << 10, 500) == 500
+
+
+# --------------------------------------------------------- frontier CC
+def test_frontier_cc_matches_cpu_and_dense():
+    from janusgraph_tpu.olap.programs import ConnectedComponentsProgram
+
+    csr = random_graph(n=250, m=600, seed=23)
+    mk = lambda: ConnectedComponentsProgram(max_iterations=100)  # noqa: E731
+    cpu = CPUExecutor(csr).run(mk())
+    dense = TPUExecutor(csr, frontier="off").run(mk())
+    sparse = TPUExecutor(csr).run(mk())
+    np.testing.assert_array_equal(
+        np.asarray(sparse["component"]), np.asarray(cpu["component"])
+    )
+    np.testing.assert_array_equal(
+        np.asarray(sparse["component"]), np.asarray(dense["component"])
+    )
+
+
+def test_frontier_cc_step_cutoff_parity():
+    from janusgraph_tpu.olap.programs import ConnectedComponentsProgram
+
+    csr = random_graph(n=120, m=260, seed=29)
+    for it in (1, 2, 3):
+        mk = lambda: ConnectedComponentsProgram(max_iterations=it)  # noqa: E731
+        dense = TPUExecutor(csr, frontier="off").run(mk())
+        sparse = TPUExecutor(csr).run(mk())
+        np.testing.assert_array_equal(
+            np.asarray(sparse["component"]), np.asarray(dense["component"])
+        )
+
+
+def test_frontier_cc_disconnected_and_isolated():
+    from janusgraph_tpu.olap.programs import ConnectedComponentsProgram
+
+    # two chains + isolated vertices
+    src = np.array([0, 1, 5, 6], np.int32)
+    dst = np.array([1, 2, 6, 7], np.int32)
+    csr = csr_from_edges(10, src, dst)
+    res = TPUExecutor(csr).run(ConnectedComponentsProgram())
+    comp = np.asarray(res["component"])
+    assert comp[0] == comp[1] == comp[2] == 0
+    assert comp[5] == comp[6] == comp[7] == 5
+    for iso in (3, 4, 8, 9):
+        assert comp[iso] == iso
+
+
+def test_frontier_cc_on_ldbc_proxy():
+    from janusgraph_tpu.olap.generators import ldbc_snb_csr
+    from janusgraph_tpu.olap.programs import ConnectedComponentsProgram
+
+    csr = ldbc_snb_csr(11)
+    mk = lambda: ConnectedComponentsProgram(max_iterations=64)  # noqa: E731
+    sparse = TPUExecutor(csr).run(mk())
+    cpu = CPUExecutor(csr).run(mk())
+    np.testing.assert_array_equal(
+        np.asarray(sparse["component"]), np.asarray(cpu["component"])
+    )
