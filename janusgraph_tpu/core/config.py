@@ -344,8 +344,9 @@ LOG_NS.option(
 )
 COMPUTER_NS.option(
     "frontier", str,
-    "ShortestPath frontier compaction ('auto'|'off'; olap/frontier.py)",
-    "auto", Mutability.MASKABLE, lambda v: v in ("auto", "off"),
+    "frontier compaction for ShortestPath/CC ('auto' sizes by graph, "
+    "'always' forces it, 'off' disables; olap/frontier.py)",
+    "auto", Mutability.MASKABLE, lambda v: v in ("auto", "off", "always"),
 )
 COMPUTER_NS.option(
     "ell-auto-budget-bytes", int,

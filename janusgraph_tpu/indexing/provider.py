@@ -168,6 +168,30 @@ class IndexProvider:
     def query(self, store: str, q: IndexQuery) -> List[str]:
         raise NotImplementedError
 
+    def query_stream(self, store: str, q: IndexQuery, page_size: int = 1000):
+        """Stream hits in pages — the scroll-API analogue (reference:
+        janusgraph-es .../ElasticSearchScroll.java:80 pages large result
+        sets instead of materializing them). Generic over every provider:
+        pages through offset/limit windows, so the remote provider issues
+        bounded wire calls per page. Results reflect committed state at
+        each page read (same visibility the ES scroll gives between
+        refreshes)."""
+        offset = q.offset
+        remaining = q.limit
+        while True:
+            page = page_size if remaining is None else min(page_size, remaining)
+            if page <= 0:
+                return
+            hits = self.query(
+                store, IndexQuery(q.condition, q.orders, page, offset)
+            )
+            yield from hits
+            if len(hits) < page:
+                return
+            offset += len(hits)
+            if remaining is not None:
+                remaining -= len(hits)
+
     def raw_query(self, store: str, q: RawQuery) -> List[Tuple[str, float]]:
         raise NotImplementedError
 

@@ -227,11 +227,35 @@ class FrontierEngine:
         return fn
 
     # ------------------------------------------------------------------- run
-    def run(self, program) -> Dict[str, np.ndarray]:
-        """Host-driven hop loop: plan (3 scalars) -> pick tier -> one
-        compiled step. Two device round trips per hop; per-step output is
-        identical to the dense BSP path's."""
+    def _hop_loop(
+        self, value, pred, mask, weighted, track, und, fargs, max_iterations
+    ):
+        """The shared host-driven loop: plan (3 scalars) -> pick tier ->
+        one compiled step. Two device round trips per hop; per-step output
+        is identical to the dense BSP path's."""
         jax, jnp = self.jax, self.jnp
+        plan = self._plan_fn(und)
+        if self.m == 0:
+            mask = jnp.zeros_like(mask)
+        for t in range(max_iterations):
+            count, tot_out, tot_in = (
+                int(x) for x in jax.device_get(plan(mask, fargs))
+            )
+            if count == 0:
+                break
+            fn = self._step_fn(
+                _tier(count, self.F_MIN, self.n),
+                _tier(max(tot_out, tot_in, 1), self.E_MIN, self.m),
+                weighted, track, und,
+            )
+            value, pred, mask, _ = fn(
+                value, pred, mask, jnp.asarray(t, jnp.float32), fargs
+            )
+        return value, pred
+
+    def run(self, program) -> Dict[str, np.ndarray]:
+        """SSSP/BFS through the shared hop loop."""
+        jnp = self.jnp
         n = self.n
         weighted = program.weighted
         track = program.track_paths
@@ -250,25 +274,10 @@ class FrontierEngine:
                 jnp.float32,
             )
         mask = jnp.asarray(idx0 == program.seed_index)
-        plan = self._plan_fn(und)
-        fargs = self._fargs(und, weighted)
-        if self.m == 0:
-            mask = jnp.zeros_like(mask)
-        for t in range(program.max_iterations):
-            count, tot_out, tot_in = (
-                int(x) for x in jax.device_get(plan(mask, fargs))
-            )
-            if count == 0:
-                break
-            need_e = max(tot_out, tot_in, 1)
-            fn = self._step_fn(
-                _tier(count, self.F_MIN, n),
-                _tier(need_e, self.E_MIN, self.m),
-                weighted, track, und,
-            )
-            dist, pred, mask, _ = fn(
-                dist, pred, mask, jnp.asarray(t, jnp.float32), fargs
-            )
+        dist, pred = self._hop_loop(
+            dist, pred, mask, weighted, track, und,
+            self._fargs(und, weighted), program.max_iterations,
+        )
         out = {"distance": np.asarray(dist)}
         if track:
             out["predecessor"] = np.asarray(pred)
@@ -284,29 +293,14 @@ class FrontierEngine:
         Per-step parity with the dense BSP path: an unchanged vertex's
         label was already absorbed by its neighbors when it last changed.
         Labels ride float32 (exact below 2^24 — eligibility-guarded)."""
-        jax, jnp = self.jax, self.jnp
-        n = self.n
-        labels = jnp.asarray(np.arange(n, dtype=np.float32))
-        mask = jnp.ones((n,), bool)
-        plan = self._plan_fn(True)
+        jnp = self.jnp
+        labels = jnp.asarray(np.arange(self.n, dtype=np.float32))
+        mask = jnp.ones((self.n,), bool)
         # both orientations, NO weight arrays: the step fn's value-message
         # branch adds w[pos] whenever weights are present in fargs, and a
         # label must never absorb an edge weight
-        fargs = self._fargs(True, False)
-        if self.m == 0:
-            mask = jnp.zeros_like(mask)
-        for t in range(program.max_iterations):
-            count, tot_out, tot_in = (
-                int(x) for x in jax.device_get(plan(mask, fargs))
-            )
-            if count == 0:
-                break
-            fn = self._step_fn(
-                _tier(count, self.F_MIN, n),
-                _tier(max(tot_out, tot_in, 1), self.E_MIN, self.m),
-                weighted=True, track_paths=False, undirected=True,
-            )
-            labels, _, mask, _ = fn(
-                labels, None, mask, jnp.asarray(t, jnp.float32), fargs
-            )
+        labels, _ = self._hop_loop(
+            labels, None, mask, True, False, True,
+            self._fargs(True, False), program.max_iterations,
+        )
         return {"component": np.asarray(labels)}

@@ -205,9 +205,9 @@ class TPUExecutor:
             strategy = "pallas"
         if strategy not in ("auto", "ell", "segment", "pallas"):
             raise ValueError(f"unknown aggregation strategy: {strategy!r}")
-        if frontier not in ("auto", "off"):
+        if frontier not in ("auto", "off", "always"):
             raise ValueError(f"unknown frontier mode: {frontier!r}")
-        # Frontier-compacted SSSP/BFS (olap/frontier.py): the ShortestPath
+        # Frontier-compacted SSSP/BFS/CC (olap/frontier.py): the program
         # special-case, mirroring FulgoraGraphComputer.java:249-253
         self._frontier_cfg = frontier
         self._frontier_engine = None
@@ -676,7 +676,9 @@ class TPUExecutor:
         """Run to termination.
 
         `frontier` (default: the executor's configured mode) — per-run
-        override of the ShortestPath frontier-compaction special case;
+        override of the frontier-compaction special case for
+        ShortestPath/ConnectedComponents: "auto" sizes by graph (BFS/SSSP
+        always; CC only above FRONTIER_CC_MIN_EDGES), "always" forces it,
         "off" forces the dense BSP path for this run.
 
         `fused` (default: auto) — compile the whole iteration into one
@@ -694,12 +696,13 @@ class TPUExecutor:
         a failed Fulgora iteration aborts outright).
         """
         jnp = self.jnp
-        if frontier not in (None, "auto", "off"):
+        if frontier not in (None, "auto", "off", "always"):
             raise ValueError(f"unknown frontier mode: {frontier!r}")
+        mode = frontier or self._frontier_cfg
         if (
             not checkpoint_path
-            and (frontier or self._frontier_cfg) != "off"
-            and self._frontier_eligible(program)
+            and mode != "off"
+            and self._frontier_eligible(program, mode)
         ):
             return self._run_frontier(program)
         if fused is None:
@@ -712,7 +715,14 @@ class TPUExecutor:
             program, sync_every, checkpoint_path, checkpoint_every, resume
         )
 
-    def _frontier_eligible(self, program: VertexProgram) -> bool:
+    #: graphs below this edge count run CC through the fused dense path
+    #: under frontier="auto": the frontier loop pays ~2 host round trips
+    #: per superstep, which only amortizes once a dense superstep costs
+    #: more than dispatch (BFS keeps frontier at every size — its dense
+    #: path rescans |E| for hops that touch a handful of vertices)
+    FRONTIER_CC_MIN_EDGES = 1 << 20
+
+    def _frontier_eligible(self, program: VertexProgram, mode: str) -> bool:
         from janusgraph_tpu.olap.frontier import FrontierEngine
         from janusgraph_tpu.olap.programs.connected_components import (
             ConnectedComponentsProgram,
@@ -733,7 +743,10 @@ class TPUExecutor:
             )
         if type(program) is ConnectedComponentsProgram:
             # labels are float32 vertex indices: exact below 2^24 only
-            return self.csr.num_vertices < (1 << 24)
+            return self.csr.num_vertices < (1 << 24) and (
+                mode == "always"
+                or self.csr.num_edges >= self.FRONTIER_CC_MIN_EDGES
+            )
         return False
 
     def _run_frontier(self, program: VertexProgram) -> Dict[str, np.ndarray]:

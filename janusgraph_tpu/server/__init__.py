@@ -13,5 +13,6 @@ from janusgraph_tpu.server.manager import (  # noqa: F401
 from janusgraph_tpu.server.auth import (  # noqa: F401
     CredentialsAuthenticator,
     HMACAuthenticator,
+    SaslAndHMACAuthenticator,
 )
 from janusgraph_tpu.server.server import JanusGraphServer  # noqa: F401

@@ -132,3 +132,13 @@ class HMACAuthenticator:
         if time.time() * 1000 > expiry:
             raise AuthenticationError("token expired")
         return username
+
+
+class SaslAndHMACAuthenticator(HMACAuthenticator):
+    """Combined authenticator: one instance answers BOTH username/password
+    (SASL-PLAIN-shaped Basic auth) and HMAC token requests (reference:
+    gremlin/server/auth/SaslAndHMACAuthenticator.java — the reference
+    registers this combination as one authenticator; here the server's
+    authenticate_request dispatches on the Authorization scheme, so the
+    combined class IS an HMACAuthenticator whose credentials checker backs
+    the Basic path). Named for discoverability/parity."""

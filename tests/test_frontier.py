@@ -62,7 +62,7 @@ def test_frontier_matches_cpu_and_dense(name, kw):
     cpu = CPUExecutor(csr).run(prog())
     dense = TPUExecutor(csr, frontier="off").run(prog())
     ex = TPUExecutor(csr)
-    assert ex._frontier_eligible(prog())
+    assert ex._frontier_eligible(prog(), "auto")
     sparse = ex.run(prog())
     np.testing.assert_allclose(_dist(sparse), _dist(cpu), rtol=1e-6)
     np.testing.assert_allclose(_dist(sparse), _dist(dense), rtol=1e-6)
@@ -156,7 +156,7 @@ def test_frontier_off_and_subclass_fall_back_dense():
         pass
 
     # subclasses may override message/apply — never special-case them
-    assert not TPUExecutor(csr)._frontier_eligible(Custom(seed_index=0))
+    assert not TPUExecutor(csr)._frontier_eligible(Custom(seed_index=0), "auto")
 
 
 def test_tier_ladder():
@@ -168,6 +168,19 @@ def test_tier_ladder():
 
 
 # --------------------------------------------------------- frontier CC
+def test_frontier_cc_auto_heuristic():
+    """Under 'auto', small-graph CC keeps the fused dense path (host-RTT
+    per frontier superstep would dominate); 'always' forces frontier."""
+    from janusgraph_tpu.olap.programs import ConnectedComponentsProgram
+
+    small = random_graph(n=50, m=120)
+    ex = TPUExecutor(small)
+    assert not ex._frontier_eligible(ConnectedComponentsProgram(), "auto")
+    assert ex._frontier_eligible(ConnectedComponentsProgram(), "always")
+    # BFS keeps frontier at every size
+    assert ex._frontier_eligible(ShortestPathProgram(seed_index=0), "auto")
+
+
 def test_frontier_cc_matches_cpu_and_dense():
     from janusgraph_tpu.olap.programs import ConnectedComponentsProgram
 
@@ -175,7 +188,9 @@ def test_frontier_cc_matches_cpu_and_dense():
     mk = lambda: ConnectedComponentsProgram(max_iterations=100)  # noqa: E731
     cpu = CPUExecutor(csr).run(mk())
     dense = TPUExecutor(csr, frontier="off").run(mk())
-    sparse = TPUExecutor(csr).run(mk())
+    ex = TPUExecutor(csr, frontier="always")
+    assert ex._frontier_eligible(mk(), "always")
+    sparse = ex.run(mk())
     np.testing.assert_array_equal(
         np.asarray(sparse["component"]), np.asarray(cpu["component"])
     )
@@ -191,7 +206,7 @@ def test_frontier_cc_step_cutoff_parity():
     for it in (1, 2, 3):
         mk = lambda: ConnectedComponentsProgram(max_iterations=it)  # noqa: E731
         dense = TPUExecutor(csr, frontier="off").run(mk())
-        sparse = TPUExecutor(csr).run(mk())
+        sparse = TPUExecutor(csr, frontier="always").run(mk())
         np.testing.assert_array_equal(
             np.asarray(sparse["component"]), np.asarray(dense["component"])
         )
@@ -204,7 +219,7 @@ def test_frontier_cc_disconnected_and_isolated():
     src = np.array([0, 1, 5, 6], np.int32)
     dst = np.array([1, 2, 6, 7], np.int32)
     csr = csr_from_edges(10, src, dst)
-    res = TPUExecutor(csr).run(ConnectedComponentsProgram())
+    res = TPUExecutor(csr, frontier="always").run(ConnectedComponentsProgram())
     comp = np.asarray(res["component"])
     assert comp[0] == comp[1] == comp[2] == 0
     assert comp[5] == comp[6] == comp[7] == 5
@@ -218,7 +233,7 @@ def test_frontier_cc_on_ldbc_proxy():
 
     csr = ldbc_snb_csr(11)
     mk = lambda: ConnectedComponentsProgram(max_iterations=64)  # noqa: E731
-    sparse = TPUExecutor(csr).run(mk())
+    sparse = TPUExecutor(csr, frontier="always").run(mk())
     cpu = CPUExecutor(csr).run(mk())
     np.testing.assert_array_equal(
         np.asarray(sparse["component"]), np.asarray(cpu["component"])

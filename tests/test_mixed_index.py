@@ -699,3 +699,53 @@ def test_graph_with_remote_storage_and_remote_index(tmp_path):
         g.close()
         store_srv.stop()
         idx_srv.stop()
+
+
+def test_query_stream_pages_through_results(tmp_path):
+    """Scroll-API analogue: query_stream pages through a large result set
+    and matches the one-shot query (reference: ElasticSearchScroll.java:80)."""
+    from janusgraph_tpu.indexing import (
+        InMemoryIndexProvider,
+        LocalIndexProvider,
+        RemoteIndexProvider,
+        RemoteIndexServer,
+    )
+
+    backend = LocalIndexProvider(directory=str(tmp_path / "sidx"))
+    server = RemoteIndexServer(backend).start()
+    remote = RemoteIndexProvider(
+        hostname=server.address[0], port=server.address[1]
+    )
+    mem = InMemoryIndexProvider()
+    try:
+        for p in (backend, mem):
+            p.register("s", "w", KeyInformation(float))
+        m = {"s": {}}
+        for i in range(57):
+            mu = IndexMutation(is_new=True)
+            mu.add("w", float(i))
+            m["s"][f"d{i:03}"] = mu
+        backend.mutate(m, {})
+        # rebuild equivalent mutations for the independent mem provider
+        m2 = {"s": {}}
+        for i in range(57):
+            mu = IndexMutation(is_new=True)
+            mu.add("w", float(i))
+            m2["s"][f"d{i:03}"] = mu
+        mem.mutate(m2, {})
+        q = IndexQuery(
+            PredicateCondition("w", Cmp.GREATER_THAN_EQUAL, 0.0),
+            orders=(Order("w"),),
+        )
+        expect = backend.query("s", q)
+        assert len(expect) == 57
+        for p in (backend, remote, mem):
+            got = list(p.query_stream("s", q, page_size=10))
+            assert got == expect, type(p).__name__
+        # limit + offset respected across pages
+        q2 = IndexQuery(q.condition, q.orders, limit=25, offset=5)
+        assert list(remote.query_stream("s", q2, page_size=10)) == expect[5:30]
+    finally:
+        remote.close()
+        server.stop()
+        backend.close()
