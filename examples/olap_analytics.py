@@ -1,0 +1,73 @@
+"""OLAP analytics tour: PageRank, frontier BFS with path reconstruction,
+connected components, and a filtered traversal with group-count-by-label —
+the TPU-native analogue of the reference's FulgoraGraphComputer workloads
+(reference: janusgraph-examples + OLAPTest.java vertex programs)."""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+
+# host devices by default (the ambient env may point JAX at a TPU that a
+# demo should not claim); set JG_EXAMPLE_PLATFORM=tpu to run the real chip
+jax.config.update("jax_platforms", os.environ.get("JG_EXAMPLE_PLATFORM", "cpu"))
+
+import numpy as np
+
+from janusgraph_tpu.core import gods
+from janusgraph_tpu.core.graph import open_graph
+from janusgraph_tpu.core.predicates import Cmp
+from janusgraph_tpu.olap.csr import load_csr
+from janusgraph_tpu.olap.programs import (
+    ConnectedComponentsProgram,
+    PageRankProgram,
+    ShortestPathProgram,
+)
+from janusgraph_tpu.olap.programs.olap_traversal import (
+    build_olap_traversal,
+    group_count_by_label,
+)
+from janusgraph_tpu.olap.programs.shortest_path import reconstruct_path
+from janusgraph_tpu.olap.tpu_executor import TPUExecutor
+
+
+def main() -> None:
+    graph = open_graph({"storage.backend": "inmemory"})
+    gods.load(graph)
+    csr = load_csr(graph, property_keys=("name", "age"))
+    names = csr.properties["name"]
+    ex = TPUExecutor(csr, frontier="always")
+
+    # PageRank (single compiled dispatch for the whole iteration)
+    ranks = ex.run(PageRankProgram(max_iterations=20, tol=0.0))["rank"]
+    top = np.argsort(np.asarray(ranks))[::-1][:3]
+    print("top pagerank:", [(names[i], round(float(ranks[i]), 4)) for i in top])
+
+    # frontier-compacted BFS with path reconstruction
+    herc = int(np.nonzero(names == "hercules")[0][0])
+    res = ex.run(ShortestPathProgram(seed_index=herc, track_paths=True))
+    tart = int(np.nonzero(names == "tartarus")[0][0])
+    path = reconstruct_path(res, tart)
+    print("hercules -> tartarus:", [names[v] for v in path])
+
+    # connected components (frontier min-label propagation)
+    comp = ex.run(ConnectedComponentsProgram())["component"]
+    n_comp = len(np.unique(np.asarray(comp)))
+    print("connected components:", n_comp)
+
+    # filtered OLAP traversal + group-count-by-label:
+    # g.V().out().has('age', gt(100)).groupCount().by(label)
+    prog = build_olap_traversal(
+        graph, csr, [("out", None, [("age", Cmp.GREATER_THAN, 100)])]
+    )
+    counts = ex.run(prog)["count"]
+    print("out().has(age>100) by label:",
+          group_count_by_label(graph, csr, counts))
+
+    graph.close()
+
+
+if __name__ == "__main__":
+    main()

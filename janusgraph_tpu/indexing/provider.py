@@ -169,13 +169,15 @@ class IndexProvider:
         raise NotImplementedError
 
     def query_stream(self, store: str, q: IndexQuery, page_size: int = 1000):
-        """Stream hits in pages — the scroll-API analogue (reference:
-        janusgraph-es .../ElasticSearchScroll.java:80 pages large result
-        sets instead of materializing them). Generic over every provider:
-        pages through offset/limit windows, so the remote provider issues
-        bounded wire calls per page. Results reflect committed state at
-        each page read (same visibility the ES scroll gives between
-        refreshes)."""
+        """Stream hits in pages — the scroll-API analogue in PURPOSE
+        (reference: janusgraph-es .../ElasticSearchScroll.java:80 pages
+        large result sets instead of materializing them), not in isolation
+        level: this is offset-window paging, with each page reading the
+        provider's CURRENT committed state. Under concurrent mutation a
+        shifting window can skip or repeat a document — run sweeps that
+        need exactly-once visitation (reindex/restore) against a quiesced
+        index, or use a single bounded query(). The remote provider issues
+        one bounded wire call per page."""
         offset = q.offset
         remaining = q.limit
         while True:

@@ -699,12 +699,19 @@ class TPUExecutor:
         if frontier not in (None, "auto", "off", "always"):
             raise ValueError(f"unknown frontier mode: {frontier!r}")
         mode = frontier or self._frontier_cfg
-        if (
-            not checkpoint_path
-            and mode != "off"
-            and self._frontier_eligible(program, mode)
-        ):
-            return self._run_frontier(program)
+        if not checkpoint_path and mode != "off":
+            if self._frontier_eligible(program, mode):
+                return self._run_frontier(program)
+            if mode == "always" and self._frontier_family(program):
+                # "always" must never silently time the dense path under a
+                # frontier label — surface WHY the guards refused
+                raise ValueError(
+                    "frontier='always' but the graph exceeds the frontier "
+                    f"engine's guards (|V|={self.csr.num_vertices}, "
+                    f"|E|={self.csr.num_edges}; float32 label/predecessor "
+                    "exactness needs |V| < 2^24, int32 expansion needs "
+                    "|E| < 2^30) — use frontier='auto' or 'off'"
+                )
         if fused is None:
             fused = program.fused_eligible()
         if fused and type(program).combiner_for is VertexProgram.combiner_for:
@@ -721,6 +728,19 @@ class TPUExecutor:
     #: more than dispatch (BFS keeps frontier at every size — its dense
     #: path rescans |E| for hops that touch a handful of vertices)
     FRONTIER_CC_MIN_EDGES = 1 << 20
+
+    @staticmethod
+    def _frontier_family(program: VertexProgram) -> bool:
+        from janusgraph_tpu.olap.programs.connected_components import (
+            ConnectedComponentsProgram,
+        )
+        from janusgraph_tpu.olap.programs.shortest_path import (
+            ShortestPathProgram,
+        )
+
+        return type(program) in (
+            ShortestPathProgram, ConnectedComponentsProgram
+        )
 
     def _frontier_eligible(self, program: VertexProgram, mode: str) -> bool:
         from janusgraph_tpu.olap.frontier import FrontierEngine
