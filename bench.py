@@ -33,8 +33,9 @@ Env knobs: BENCH_SCALES (default "16,20,22,23" — graph500-s23 north
 star last), BENCH_EDGE_FACTOR (16), PR_ITERS (20), BENCH_STRATEGY
 (auto|ell|segment|pallas), BENCH_BUDGET_S (supervisor budget, default
 2700), BENCH_INIT_TIMEOUT_S (cap on backend init before declaring the
-tunnel dead, default 600 — a wedged claim relay must not eat the budget
-the CPU fallback and prior_tpu_evidence pointer need), BENCH_CPU_SCALE (fallback scale, 16),
+tunnel dead; default sizes to the supervisor budget — a wedged claim
+relay must not eat the budget the CPU fallback and prior_tpu_evidence
+pointer need), BENCH_CPU_SCALE (fallback scale, 20),
 BENCH_EXTRAS_SCALE (default 20 — the ladder rung that additionally runs
 the CC / peer-pressure / 3-hop-count headline workloads; must appear in
 BENCH_SCALES to fire, and its compile time comes out of BENCH_BUDGET_S
@@ -273,7 +274,7 @@ def supervise() -> int:
         )
         env = dict(os.environ)
         env["JAX_PLATFORMS"] = "cpu"
-        env.setdefault("BENCH_CPU_SCALE", "16")
+        env.setdefault("BENCH_CPU_SCALE", "20")
         cpu_deadline = time.monotonic() + remaining
         cpu_run = _WorkerRun(env)
         live["run"] = cpu_run
@@ -496,7 +497,13 @@ def _bench_scale(jax, platform, scale, edge_factor, pr_iters, strategy, t0):
     # (phase-alternating -> host-loop path), and the 3-hop
     # TraversalVertexProgram-analogue count. Gated so the budget cost is
     # bounded; compile cache amortizes re-runs.
-    if scale == int(os.environ.get("BENCH_EXTRAS_SCALE", "20")):
+    # On the CPU FALLBACK the extras only fire when BENCH_EXTRAS_SCALE is
+    # explicitly set — the s20 peer-pressure compile alone runs minutes on
+    # host XLA and would eat the whole fallback reserve (measured round 4).
+    extras_env = os.environ.get("BENCH_EXTRAS_SCALE")
+    if scale == int(extras_env or "20") and (
+        platform == "tpu" or extras_env is not None
+    ):
         from janusgraph_tpu.olap.programs import (
             ConnectedComponentsProgram,
             PeerPressureProgram,
@@ -726,7 +733,8 @@ def worker() -> None:
         scales = [16, 20, 22, 23]
     if platform == "cpu":
         # clamp the ladder to the CPU cap and run just the largest rung
-        cap = int(os.environ.get("BENCH_CPU_SCALE", "16"))
+        # frontier BFS + lazy transfer made s20 cheap even on host
+        cap = int(os.environ.get("BENCH_CPU_SCALE", "20"))
         scales = [min(max(scales), cap)]
     edge_factor = int(os.environ.get("BENCH_EDGE_FACTOR", "16"))
     pr_iters = int(os.environ.get("PR_ITERS", "20"))

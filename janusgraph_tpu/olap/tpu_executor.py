@@ -699,12 +699,23 @@ class TPUExecutor:
         if frontier not in (None, "auto", "off", "always"):
             raise ValueError(f"unknown frontier mode: {frontier!r}")
         mode = frontier or self._frontier_cfg
-        if not checkpoint_path and mode != "off":
-            if self._frontier_eligible(program, mode):
+        if mode != "off" and self._frontier_family(program):
+            if checkpoint_path:
+                # the frontier loop has no checkpoint support; "always"
+                # must never silently time the dense path under a frontier
+                # label, so refuse the combination outright
+                if mode == "always":
+                    raise ValueError(
+                        "frontier='always' cannot be combined with "
+                        "checkpointing (the frontier loop does not "
+                        "checkpoint) — drop checkpoint_path or use "
+                        "frontier='auto'"
+                    )
+            elif self._frontier_eligible(program, mode):
                 return self._run_frontier(program)
-            if mode == "always" and self._frontier_family(program):
-                # "always" must never silently time the dense path under a
-                # frontier label — surface WHY the guards refused
+            elif mode == "always":
+                # surface WHY the guards refused instead of silently
+                # timing the dense path under a frontier label
                 raise ValueError(
                     "frontier='always' but the graph exceeds the frontier "
                     f"engine's guards (|V|={self.csr.num_vertices}, "
@@ -751,6 +762,8 @@ class TPUExecutor:
             ShortestPathProgram,
         )
 
+        if not self._frontier_family(program):
+            return False
         if self.csr.num_edges >= FrontierEngine.MAX_EDGES:
             return False
         if type(program) is ShortestPathProgram:

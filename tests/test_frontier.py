@@ -238,3 +238,62 @@ def test_frontier_cc_on_ldbc_proxy():
     np.testing.assert_array_equal(
         np.asarray(sparse["component"]), np.asarray(cpu["component"])
     )
+
+
+def test_frontier_fuzz_vs_dense():
+    """Property sweep: random graphs x seeds x cutoffs — the frontier path
+    must match the dense path everywhere, not just on the curated cases."""
+    from janusgraph_tpu.olap.programs import ConnectedComponentsProgram
+
+    rng = np.random.default_rng(101)
+    for trial in range(6):
+        n = int(rng.integers(20, 400))
+        m = int(rng.integers(0, 6 * n))
+        weights = bool(rng.integers(0, 2))
+        csr = csr_from_edges(
+            n,
+            rng.integers(0, n, m).astype(np.int32),
+            rng.integers(0, n, m).astype(np.int32),
+            rng.uniform(0.1, 3.0, m).astype(np.float32) if weights else None,
+        )
+        seed = int(rng.integers(0, n))
+        it = int(rng.integers(1, 12))
+        und = bool(rng.integers(0, 2))
+        mk = lambda: ShortestPathProgram(  # noqa: B023,E731
+            seed_index=seed, weighted=weights, undirected=und,
+            max_iterations=it,
+        )
+        dense = TPUExecutor(csr, frontier="off").run(mk())
+        sparse = TPUExecutor(csr, frontier="always").run(mk())
+        np.testing.assert_allclose(
+            _dist(sparse), _dist(dense), rtol=1e-6,
+            err_msg=f"trial={trial} n={n} m={m} w={weights} und={und} it={it}",
+        )
+        cc_d = TPUExecutor(csr, frontier="off").run(
+            ConnectedComponentsProgram(max_iterations=64)
+        )
+        cc_s = TPUExecutor(csr, frontier="always").run(
+            ConnectedComponentsProgram(max_iterations=64)
+        )
+        np.testing.assert_array_equal(
+            np.asarray(cc_s["component"]), np.asarray(cc_d["component"]),
+            err_msg=f"cc trial={trial} n={n} m={m}",
+        )
+
+
+def test_frontier_always_refuses_checkpointing(tmp_path):
+    csr = random_graph(n=50, m=200)
+    ex = TPUExecutor(csr, frontier="always")
+    with pytest.raises(ValueError, match="checkpoint"):
+        ex.run(
+            ShortestPathProgram(seed_index=0),
+            checkpoint_path=str(tmp_path / "ck"),
+            checkpoint_every=2,
+        )
+    # auto quietly uses the (checkpointable) dense path
+    res = TPUExecutor(csr).run(
+        ShortestPathProgram(seed_index=0),
+        checkpoint_path=str(tmp_path / "ck2"),
+        checkpoint_every=2,
+    )
+    assert "distance" in res
