@@ -428,6 +428,10 @@ def _bench_scale(jax, platform, scale, edge_factor, pr_iters, strategy, t0):
     jax.block_until_ready(bfs_res["distance"])
     bfs_s = time.perf_counter() - b0
     _hb(f"s{scale}: bfs-4hop frontier {bfs_s:.3f}s", t0)
+    bfs_tiers = [
+        {k: t[k] for k in ("hop", "frontier", "edges", "E_cap")}
+        for t in ex.last_run_info.get("tiers", [])
+    ]
     ex.run(bfs_prog, frontier="off")
     b0 = time.perf_counter()
     bfs_dense = ex.run(bfs_prog, sync_every=4, frontier="off")
@@ -477,6 +481,7 @@ def _bench_scale(jax, platform, scale, edge_factor, pr_iters, strategy, t0):
         "pagerank_superstep_ms": round(1000.0 * pr_s / pr_iters, 3),
         "bfs_4hop_wall_s": round(bfs_s, 3),
         "bfs_strategy": "frontier",
+        "bfs_frontier_tiers": bfs_tiers,
         "bfs_dense_4hop_wall_s": round(bfs_dense_s, 3),
         "bfs_frontier_speedup": round(bfs_dense_s / max(bfs_s, 1e-9), 2),
         "graph_gen_s": round(gen_s, 2),

@@ -409,6 +409,15 @@ class RemoteIndexProvider(IndexProvider):
                         "mid-request (not replayed; verify index state or "
                         "reindex)"
                     ) from None
+            if status == _STATUS_TEMP and not idempotent:
+                # a clean temporary-failure reply still means the provider
+                # may have PARTIALLY applied the mutation before failing —
+                # replaying would duplicate the applied entries
+                raise PermanentBackendError(
+                    "index mutation failed server-side with a temporary "
+                    f"error (not replayed; outcome may be partial): "
+                    f"{payload.decode('utf-8', 'replace')}"
+                )
             if status != _STATUS_OK:
                 _raise_status(status, payload)
             return payload

@@ -237,20 +237,27 @@ class FrontierEngine:
         plan = self._plan_fn(und)
         if self.m == 0:
             mask = jnp.zeros_like(mask)
+        trace = []
         for t in range(max_iterations):
             count, tot_out, tot_in = (
                 int(x) for x in jax.device_get(plan(mask, fargs))
             )
             if count == 0:
                 break
-            fn = self._step_fn(
-                _tier(count, self.F_MIN, self.n),
-                _tier(max(tot_out, tot_in, 1), self.E_MIN, self.m),
-                weighted, track, und,
+            f_cap = _tier(count, self.F_MIN, self.n)
+            e_cap = _tier(max(tot_out, tot_in, 1), self.E_MIN, self.m)
+            trace.append(
+                {"hop": t, "frontier": count,
+                 "edges": max(tot_out, tot_in), "F_cap": f_cap,
+                 "E_cap": e_cap}
             )
+            fn = self._step_fn(f_cap, e_cap, weighted, track, und)
             value, pred, mask, _ = fn(
                 value, pred, mask, jnp.asarray(t, jnp.float32), fargs
             )
+        # observability: which tiers each hop actually priced at — the
+        # per-hop analogue of .profile() (read via executor.last_run_info)
+        self.last_trace = trace
         return value, pred
 
     def run(self, program) -> Dict[str, np.ndarray]:

@@ -233,6 +233,9 @@ class TPUExecutor:
         )
         from collections import OrderedDict
 
+        #: per-run execution record ({"path", "supersteps", "wall_s", ...});
+        #: the executor-level analogue of the OLTP .profile() tree
+        self.last_run_info: Dict[str, object] = {}
         self._compiled: Dict[str, object] = {}
         # view-field access sets per compiled variant (discovery trace);
         # None record = not discovering
@@ -783,6 +786,8 @@ class TPUExecutor:
         return False
 
     def _run_frontier(self, program: VertexProgram) -> Dict[str, np.ndarray]:
+        import time
+
         from janusgraph_tpu.olap.frontier import FrontierEngine
         from janusgraph_tpu.olap.programs.connected_components import (
             ConnectedComponentsProgram,
@@ -790,9 +795,19 @@ class TPUExecutor:
 
         if self._frontier_engine is None:
             self._frontier_engine = FrontierEngine(self)
+        t0 = time.perf_counter()
         if type(program) is ConnectedComponentsProgram:
-            return self._frontier_engine.run_cc(program)
-        return self._frontier_engine.run(program)
+            out = self._frontier_engine.run_cc(program)
+        else:
+            out = self._frontier_engine.run(program)
+        trace = getattr(self._frontier_engine, "last_trace", [])
+        self.last_run_info = {
+            "path": "frontier",
+            "supersteps": len(trace),
+            "wall_s": round(time.perf_counter() - t0, 4),
+            "tiers": trace,
+        }
+        return out
 
     def _run_fused(
         self,
@@ -824,6 +839,7 @@ class TPUExecutor:
                 for k, (_o, v) in init_metrics.items()
             }
             if max_iter == 0:
+                self.last_run_info = {"path": "fused", "supersteps": 0}
                 return {k: np.asarray(v) for k, v in state.items()}
             # The while_loop carry must use apply's aggregator pytree, which
             # can add keys over setup's. Learn it via an abstract trace (no
@@ -876,6 +892,7 @@ class TPUExecutor:
                 )
             if terminated:
                 break
+        self.last_run_info = {"path": "fused", "supersteps": steps_done}
         return {k: np.asarray(v) for k, v in state.items()}
 
     def _run_host_loop(
@@ -947,6 +964,7 @@ class TPUExecutor:
                     )
                 if program.terminate(memory):
                     break
+        self.last_run_info = {"path": "host-loop", "supersteps": steps_done}
         return {k: np.asarray(v) for k, v in state.items()}
 
     # ------------------------------------------------------------ write-back

@@ -297,3 +297,19 @@ def test_frontier_always_refuses_checkpointing(tmp_path):
         checkpoint_every=2,
     )
     assert "distance" in res
+
+
+def test_last_run_info_records_paths_and_tiers():
+    csr = random_graph(n=200, m=900, seed=31)
+    ex = TPUExecutor(csr)
+    ex.run(ShortestPathProgram(seed_index=0, max_iterations=4))
+    info = ex.last_run_info
+    assert info["path"] == "frontier"
+    assert 1 <= info["supersteps"] <= 4
+    assert info["tiers"][0]["frontier"] == 1  # hop 0: the seed alone
+    assert all(t["E_cap"] >= t["edges"] for t in info["tiers"])
+    from janusgraph_tpu.olap.programs import PageRankProgram
+
+    ex.run(PageRankProgram(max_iterations=5, tol=0.0))
+    assert ex.last_run_info["path"] == "fused"
+    assert ex.last_run_info["supersteps"] == 5

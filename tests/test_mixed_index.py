@@ -749,3 +749,70 @@ def test_query_stream_pages_through_results(tmp_path):
         remote.close()
         server.stop()
         backend.close()
+
+
+def test_remote_index_retry_on_transient_failure(tmp_path):
+    """The retry guard replays idempotent index reads through transient
+    backend failures (reference: RestElasticSearchClient retry handling).
+    A provider that fails the first N calls with TemporaryBackendError is
+    served transparently; mutate (non-idempotent) is NOT replayed."""
+    from janusgraph_tpu.exceptions import TemporaryBackendError
+    from janusgraph_tpu.indexing import (
+        InMemoryIndexProvider,
+        RemoteIndexProvider,
+        RemoteIndexServer,
+    )
+
+    class Flaky(InMemoryIndexProvider):
+        def __init__(self):
+            super().__init__()
+            self.fail_next = 0
+            self.query_calls = 0
+            self.fail_mutate_next = 0
+            self.mutate_calls = 0
+
+        def query(self, store, q):
+            self.query_calls += 1
+            if self.fail_next > 0:
+                self.fail_next -= 1
+                raise TemporaryBackendError("injected index flake")
+            return super().query(store, q)
+
+        def mutate(self, mutations, key_infos):
+            self.mutate_calls += 1
+            if self.fail_mutate_next > 0:
+                self.fail_mutate_next -= 1
+                raise TemporaryBackendError("injected mutate flake")
+            return super().mutate(mutations, key_infos)
+
+    backend = Flaky()
+    server = RemoteIndexServer(backend).start()
+    p = RemoteIndexProvider(
+        hostname=server.address[0], port=server.address[1],
+        retry_time_s=5.0,
+    )
+    try:
+        p.register("s", "w", KeyInformation(float))
+        m = IndexMutation(is_new=True)
+        m.add("w", 2.0)
+        p.mutate({"s": {"d1": m}}, {})
+        backend.fail_next = 2
+        hits = p.query(
+            "s", IndexQuery(PredicateCondition("w", Cmp.GREATER_THAN, 1.0))
+        )
+        assert hits == ["d1"]
+        assert backend.query_calls >= 3  # 2 injected failures + success
+        # non-idempotent mutate: a server-side temporary failure surfaces
+        # as outcome-unknown WITHOUT replay (exactly one backend attempt)
+        from janusgraph_tpu.exceptions import PermanentBackendError
+
+        backend.fail_mutate_next = 1
+        before = backend.mutate_calls
+        m2 = IndexMutation(is_new=True)
+        m2.add("w", 9.0)
+        with pytest.raises(PermanentBackendError, match="not replayed"):
+            p.mutate({"s": {"d2": m2}}, {})
+        assert backend.mutate_calls == before + 1
+    finally:
+        p.close()
+        server.stop()
