@@ -428,6 +428,7 @@ def _bench_scale(jax, platform, scale, edge_factor, pr_iters, strategy, t0):
     jax.block_until_ready(bfs_res["distance"])
     bfs_s = time.perf_counter() - b0
     _hb(f"s{scale}: bfs-4hop frontier {bfs_s:.3f}s", t0)
+    bfs_path = ex.last_run_info.get("path", "unknown")
     bfs_tiers = [
         {k: t[k] for k in ("hop", "frontier", "edges", "E_cap")}
         for t in ex.last_run_info.get("tiers", [])
@@ -480,7 +481,7 @@ def _bench_scale(jax, platform, scale, edge_factor, pr_iters, strategy, t0):
         "pagerank_wall_s": round(pr_s, 3),
         "pagerank_superstep_ms": round(1000.0 * pr_s / pr_iters, 3),
         "bfs_4hop_wall_s": round(bfs_s, 3),
-        "bfs_strategy": "frontier",
+        "bfs_strategy": bfs_path,
         "bfs_frontier_tiers": bfs_tiers,
         "bfs_dense_4hop_wall_s": round(bfs_dense_s, 3),
         "bfs_frontier_speedup": round(bfs_dense_s / max(bfs_s, 1e-9), 2),

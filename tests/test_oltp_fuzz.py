@@ -137,3 +137,83 @@ def test_fuzz_mutations_match_oracle():
     graph2 = open_graph(store_manager=mgr)
     _check(graph2, model)
     graph2.close()
+
+
+def test_fuzz_mixed_index_consistency():
+    """Index-maintenance fuzz: random score updates/removals with commits,
+    then mixed-index range queries must agree EXACTLY with a dict oracle —
+    the drift-detection complement to the mutation fuzz (reference:
+    JanusGraphIndexTest's add/update/delete index maintenance matrix).
+    Also covers LIST-cardinality properties through the same stream."""
+    from janusgraph_tpu.core.traversal import P
+
+    rng = random.Random(77)
+    graph = open_graph({"schema.default": "none"})
+    m = graph.management()
+    m.make_property_key("score", float)
+    from janusgraph_tpu.core.codecs import Cardinality
+
+    m.make_property_key("tag", str, Cardinality.LIST)
+    m.build_mixed_index("scores", ["score"], backing="search")
+
+    model = {}      # vid -> score
+    tags = {}       # vid -> multiset of tags
+    tx = graph.new_transaction()
+    staged = {}
+    staged_tags = {}
+    removed = set()
+
+    def commit():
+        nonlocal tx, staged, staged_tags, removed
+        tx.commit()
+        for vid, s in staged.items():
+            model[vid] = s
+        for vid, ts in staged_tags.items():
+            tags.setdefault(vid, []).extend(ts)
+        for vid in removed:
+            model.pop(vid, None)
+            tags.pop(vid, None)
+        staged, staged_tags, removed = {}, {}, set()
+        # exact agreement with the oracle at 3 random thresholds
+        t = graph.traversal()
+        for _ in range(3):
+            thr = rng.uniform(0, 100)
+            got = {v.id for v in t.V().has("score", P.gt(thr)).to_list()}
+            want = {vid for vid, s in model.items() if s > thr}
+            assert got == want, (thr, got ^ want)
+        tx = graph.new_transaction()
+
+    for step in range(200):
+        op = rng.random()
+        pool = [v for v in model if v not in removed]
+        if op < 0.35 or not pool:
+            v = tx.add_vertex()
+            s = rng.uniform(0, 100)
+            v.property("score", s)
+            staged[v.id] = s
+        elif op < 0.60:
+            vid = rng.choice(pool)
+            v = tx.get_vertex(vid)
+            s = rng.uniform(0, 100)
+            v.property("score", s)  # SINGLE: replaces -> index move
+            staged[vid] = s
+        elif op < 0.72:
+            vid = rng.choice(pool)
+            v = tx.get_vertex(vid)
+            tg = f"t{rng.randint(0, 5)}"
+            v.property("tag", tg)
+            staged_tags.setdefault(vid, []).append(tg)
+        elif op < 0.82:
+            vid = rng.choice(pool)
+            tx.get_vertex(vid).remove()
+            removed.add(vid)
+        else:
+            commit()
+    commit()
+    # LIST values all survived in order-insensitive multiset terms
+    tx = graph.new_transaction()
+    for vid, ts in tags.items():
+        got = sorted(p.value for p in tx.get_vertex(vid).properties("tag"))
+        assert got == sorted(ts), vid
+    tx.rollback()
+    graph.close()
