@@ -185,7 +185,12 @@ def test_fuzz_mixed_index_consistency():
 
     for step in range(200):
         op = rng.random()
-        pool = [v for v in model if v not in removed]
+        # committed AND same-tx-staged vertices: the add->update->remove
+        # before-first-commit matrix must be exercised too
+        pool = [
+            v for v in dict.fromkeys(list(model) + list(staged))
+            if v not in removed
+        ]
         if op < 0.35 or not pool:
             v = tx.add_vertex()
             s = rng.uniform(0, 100)

@@ -1,0 +1,109 @@
+"""Operation-counting conformance: backend call counts prove cache behavior
+(reference: JanusGraphOperationCountingTest.java:649 — asserts getSlice
+counts through metrics instrumentation, demonstrating the tx-level and
+store-level caches actually absorb repeat reads)."""
+
+import pytest
+
+from janusgraph_tpu.core.graph import open_graph
+from janusgraph_tpu.util.metrics import metrics
+
+
+@pytest.fixture(autouse=True)
+def _reset_metrics():
+    metrics.reset()
+    yield
+    metrics.reset()
+
+
+def _slice_count():
+    return metrics.get_count("storage.edgestore.getSlice")
+
+
+def _load(g):
+    tx = g.new_transaction()
+    a = tx.add_vertex(name="a", score=1.0)
+    b = tx.add_vertex(name="b", score=2.0)
+    tx.add_edge(a, "knows", b)
+    tx.commit()
+    return a.id, b.id
+
+
+def test_repeat_reads_in_one_tx_hit_the_tx_cache():
+    g = open_graph({
+        "schema.default": "auto", "metrics.enabled": True,
+        "cache.db-cache": False,  # isolate the TX-level slice cache
+    })
+    aid, _ = _load(g)
+    tx = g.new_transaction()
+    v = tx.get_vertex(aid)
+    v.value("name")
+    first = _slice_count()
+    assert first > 0
+    # identical reads inside the SAME tx: served by the tx slice cache
+    for _ in range(5):
+        tx.get_vertex(aid).value("name")
+    assert _slice_count() == first
+    tx.rollback()
+    g.close()
+
+
+def test_fresh_tx_reads_hit_the_store_cache():
+    g = open_graph({
+        "schema.default": "auto", "metrics.enabled": True,
+        "cache.db-cache": True,
+    })
+    aid, _ = _load(g)
+    tx = g.new_transaction()
+    tx.get_vertex(aid).value("name")
+    tx.rollback()
+    warm = _slice_count()
+    # fresh transactions re-read the same rows: the db-cache sits ABOVE the
+    # instrumented store (Backend wraps instrumentation first), so repeat
+    # slice reads never reach the backend
+    for _ in range(4):
+        tx = g.new_transaction()
+        tx.get_vertex(aid).value("name")
+        tx.rollback()
+    assert _slice_count() == warm
+    g.close()
+
+
+def test_cache_disabled_reads_reach_the_backend():
+    g = open_graph({
+        "schema.default": "auto", "metrics.enabled": True,
+        "cache.db-cache": False,
+    })
+    aid, _ = _load(g)
+    tx = g.new_transaction()
+    tx.get_vertex(aid).value("name")
+    tx.rollback()
+    before = _slice_count()
+    for _ in range(3):
+        tx = g.new_transaction()
+        tx.get_vertex(aid).value("name")
+        tx.rollback()
+    # every fresh tx pays real backend reads with the cache off
+    assert _slice_count() > before
+    g.close()
+
+
+def test_mutation_invalidates_the_store_cache():
+    g = open_graph({
+        "schema.default": "auto", "metrics.enabled": True,
+        "cache.db-cache": True,
+    })
+    aid, _ = _load(g)
+    tx = g.new_transaction()
+    assert tx.get_vertex(aid).value("score") == 1.0
+    tx.rollback()
+    warm = _slice_count()
+    # a write through THIS instance invalidates the touched rows
+    tx = g.new_transaction()
+    tx.get_vertex(aid).property("score", 9.0)
+    tx.commit()
+    tx = g.new_transaction()
+    assert tx.get_vertex(aid).value("score") == 9.0  # fresh value visible
+    tx.rollback()
+    assert _slice_count() > warm  # the invalidated row was re-read
+    g.close()
