@@ -218,9 +218,23 @@ class Geoshape:
 
     @staticmethod
     def polygon(points: Sequence[Tuple[float, float]]) -> "Geoshape":
+        """Axis-aligned 4-vertex rectangles normalize to Box AT
+        CONSTRUCTION so every entry point (factories, WKT, GeoJSON, the
+        binary codec, the driver wire formats) agrees on the kind — the
+        reference's GeoJSON reader applies the same rectangle->box
+        normalization, and doing it here keeps the codecs' round trips
+        mutually consistent."""
         pts = tuple((float(a), float(b)) for a, b in points)
         if len(pts) < 3:
             raise ValueError("polygon needs at least 3 points")
+        if len(pts) == 4:
+            lats = sorted(p[0] for p in pts)
+            lons = sorted(p[1] for p in pts)
+            if set(pts) == {
+                (lats[0], lons[0]), (lats[0], lons[-1]),
+                (lats[-1], lons[0]), (lats[-1], lons[-1]),
+            }:
+                return Geoshape.box(lats[0], lons[0], lats[-1], lons[-1])
         return Geoshape("Polygon", pts)
 
     @staticmethod
@@ -249,8 +263,10 @@ class Geoshape:
 
     @staticmethod
     def multipolygon(polygons: Sequence) -> "Geoshape":
+        # raw rings normalize like every other ring entry point (axis-
+        # aligned rectangles become Box), so codec round trips are stable
         parts = tuple(
-            p if isinstance(p, Geoshape) else Geoshape.polygon(p)
+            p if isinstance(p, Geoshape) else _ring_to_shape(list(p))
             for p in polygons
         )
         if not parts or any(p.kind not in ("Polygon", "Box") for p in parts):
@@ -607,19 +623,9 @@ def _split_top_level(text: str):
 
 
 def _ring_to_shape(ring) -> "Geoshape":
-    """Axis-aligned rectangles normalize to Box in BOTH codecs, so shape
-    round-trips are stable (reference: Geoshape GeoJSON reader does the same
-    rectangle→box normalization)."""
-    if len(ring) == 4:
-        lats = sorted(p[0] for p in ring)
-        lons = sorted(p[1] for p in ring)
-        if set(ring) == {
-            (lats[0], lons[0]),
-            (lats[0], lons[-1]),
-            (lats[-1], lons[0]),
-            (lats[-1], lons[-1]),
-        }:
-            return Geoshape.box(lats[0], lons[0], lats[-1], lons[-1])
+    """Ring -> shape; the rectangle->box normalization now lives in
+    Geoshape.polygon() itself (construction-time), so this is a plain
+    alias kept for the codec call sites."""
     return Geoshape.polygon(ring)
 
 

@@ -9,6 +9,7 @@ containers nest; elements serialize to their identity + label + properties.
 Codes: 0x01 int64 | 0x02 double | 0x03 utf8 string | 0x04 bool | 0x05 null
        0x10 list | 0x11 map | 0x12 set
        0x20 vertex | 0x21 edge | 0x22 relation-identifier | 0x23 bytes
+       0x30-0x36 framework datatypes | 0x37 geoshape
 """
 
 from __future__ import annotations
@@ -150,6 +151,17 @@ def _encode_typed(obj: Any, out: bytearray) -> bool:
         out.append(0x35)
         out += _w_str(obj.isoformat())
         return True
+    from janusgraph_tpu.core.predicates import Geoshape
+
+    if isinstance(obj, Geoshape):
+        # reuse the storage codec: kind-tagged binary covering every shape
+        # (reference: GraphBinary Geoshape serializer delegates the same way)
+        from janusgraph_tpu.core.attributes import GeoshapeSerializer
+
+        raw = GeoshapeSerializer().write(obj)
+        out.append(0x37)
+        out += _U32.pack(len(raw)) + raw
+        return True
     return False
 
 
@@ -245,6 +257,12 @@ def _decode(data: bytes, pos: int) -> Tuple[Any, int]:
         pos += 4
         arr = np.frombuffer(data[pos : pos + n], dtype=dtype).reshape(shape)
         return arr.copy(), pos + n
+    if code == 0x37:
+        from janusgraph_tpu.core.attributes import GeoshapeSerializer
+
+        (n,) = _U32.unpack_from(data, pos)
+        pos += 4
+        return GeoshapeSerializer().read(data[pos : pos + n]), pos + n
     if code == 0x20:
         (vid,) = _I64.unpack_from(data, pos)
         pos += 8

@@ -112,6 +112,15 @@ def _encode(obj: Any):
         return {"@type": "g:LocalDate", "@value": obj.isoformat()}
     if isinstance(obj, _dt.time):
         return {"@type": "g:LocalTime", "@value": obj.isoformat()}
+    from janusgraph_tpu.core.predicates import Geoshape
+
+    if isinstance(obj, Geoshape):
+        # GeoJSON payload covers the full shape vocabulary incl. Circle
+        # and GeometryCollection (reference: Geoshape GraphSON serializer)
+        return {
+            "@type": "janusgraph:Geoshape",
+            "@value": {"geometry": obj._geom_dict()},
+        }
     # numpy scalars/arrays and anything float-like
     try:
         import numpy as np
@@ -170,6 +179,10 @@ def _decode(obj: Any):
         return {_decode(k): _decode(val) for k, val in zip(it, it)}
     if t == "janusgraph:RelationIdentifier":
         return RelationIdentifier.parse(v["relationId"])
+    if t == "janusgraph:Geoshape":
+        from janusgraph_tpu.core.predicates import Geoshape
+
+        return Geoshape.from_geojson(v["geometry"])
     if t == "janusgraph:Instant":
         from janusgraph_tpu.core.attributes import Instant
 

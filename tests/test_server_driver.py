@@ -412,3 +412,30 @@ def test_graphson_duration_lossless_and_weird_arrays():
         np.array([1 + 2j]),
     ):
         json.loads(graphson_dumps(weird))  # serializes without raising
+
+
+def test_driver_geoshape_round_trips_all_kinds():
+    """Every Geoshape kind crosses both driver codecs typed (reference:
+    JanusGraphSONModule + GraphBinary Geoshape serializers)."""
+    from janusgraph_tpu.core.predicates import Geoshape
+    from janusgraph_tpu.driver.graphbinary import binary_dumps, binary_loads
+    from janusgraph_tpu.driver.graphson import graphson_dumps, graphson_loads
+
+    shapes = (
+        Geoshape.point(1, 2),
+        Geoshape.circle(1, 2, 50.0),
+        Geoshape.box(0, 0, 2, 2),
+        Geoshape.polygon([(0, 0), (0, 3), (3, 0)]),
+        Geoshape.line([(0, 0), (1, 1)]),
+        Geoshape.multipoint([(0, 0), (2, 2)]),
+        Geoshape.multilinestring([[(0, 0), (1, 1)], [(2, 2), (3, 3)]]),
+        Geoshape.multipolygon(
+            [[(0, 0), (0, 2), (2, 2), (2, 0)], [(5, 5), (5, 7), (7, 6)]]
+        ),
+        Geoshape.geometry_collection(
+            [Geoshape.point(1, 1), Geoshape.line([(0, 0), (4, 4)])]
+        ),
+    )
+    for s in shapes:
+        assert graphson_loads(graphson_dumps(s)) == s, s.kind
+        assert binary_loads(binary_dumps(s)) == s, s.kind
