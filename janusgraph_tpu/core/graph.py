@@ -106,6 +106,31 @@ def open_graph(config: Optional[dict] = None, store_manager=None) -> "JanusGraph
     return JanusGraphTPU(config, store_manager=store_manager)
 
 
+def drop_graph(graph: "JanusGraphTPU") -> None:
+    """DESTROY the graph's storage and close it — every store, index, log,
+    and the instance registry (reference: JanusGraphFactory.drop). The
+    mixed-index providers attached to the store manager are cleared too so
+    a re-open starts from nothing. Irreversible.
+
+    Order matters: storage is cleared BEFORE close() — the persistent
+    local backend's clear_storage reopens its WAL handle, and only a
+    subsequent close() releases it (same ordering the multi-graph
+    manager's drop uses)."""
+    manager = graph.backend.manager
+    providers = graph.index_providers
+    try:
+        for provider in providers.values():
+            try:
+                provider.clear_storage()
+            except NotImplementedError:
+                pass
+        providers.clear()
+        manager.clear_storage()
+    finally:
+        if graph._open:
+            graph.close()
+
+
 class _MultiIndexTransaction:
     """Fans commit/rollback out to one IndexTransaction per provider."""
 

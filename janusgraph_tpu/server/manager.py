@@ -196,10 +196,11 @@ class ConfiguredGraphFactory:
     def drop(self, name: str) -> None:
         g = self.manager.remove_graph(name)
         if g is not None:
-            try:
-                g.backend.manager.clear_storage()
-            finally:
-                g.close()
+            from janusgraph_tpu.core.graph import drop_graph
+
+            # one drop implementation: storage AND the shared mixed-index
+            # providers are destroyed together (stale index hits otherwise)
+            drop_graph(g)
         self.remove_configuration(name)
 
     def graph_names(self) -> List[str]:

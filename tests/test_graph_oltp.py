@@ -388,3 +388,49 @@ def test_bigint_schema_key_accepts_plain_int():
     w.property("bignum", got)
     tx2.commit()
     graph.close()
+
+
+def test_drop_graph_destroys_everything():
+    """JanusGraphFactory.drop analogue: storage, indexes, and instance
+    registry all gone; a re-open over the same manager starts empty."""
+    from janusgraph_tpu.core.graph import drop_graph, open_graph
+    from janusgraph_tpu.core.traversal import P
+    from janusgraph_tpu.storage.inmemory import InMemoryStoreManager
+
+    sm = InMemoryStoreManager()
+    g = open_graph({"schema.default": "auto"}, store_manager=sm)
+    mgmt = g.management()
+    mgmt.make_property_key("bio", str)
+    mgmt.build_mixed_index("bios", ["bio"], backing="search")
+    tx = g.new_transaction()
+    tx.add_vertex(name="doomed", bio="soon gone")
+    tx.commit()
+    assert g.traversal().V().has("bio", P.text_contains("gone")).to_list()
+    drop_graph(g)
+    g2 = open_graph({"schema.default": "auto"}, store_manager=sm)
+    assert g2.traversal().V().to_list() == []
+    # schema gone too: the old mixed index no longer exists
+    assert "bios" not in g2.indexes
+    g2.close()
+
+
+def test_drop_graph_local_backend_releases_and_destroys(tmp_path):
+    """drop over the persistent backend: exists() false afterward, WAL
+    handle released, re-open empty (the close/clear ordering regression)."""
+    from janusgraph_tpu.core.graph import drop_graph, open_graph
+
+    d = str(tmp_path / "dropme")
+    g = open_graph({
+        "schema.default": "auto", "storage.backend": "local",
+        "storage.directory": d, "storage.fsync": False,
+    })
+    tx = g.new_transaction()
+    tx.add_vertex(name="gone")
+    tx.commit()
+    drop_graph(g)
+    g2 = open_graph({
+        "schema.default": "auto", "storage.backend": "local",
+        "storage.directory": d, "storage.fsync": False,
+    })
+    assert g2.traversal().V().to_list() == []
+    g2.close()
