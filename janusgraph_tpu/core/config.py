@@ -188,6 +188,13 @@ SCHEMA.option(
     "default", str, "auto-create schema on first use ('auto'|'none')", "auto",
     Mutability.MASKABLE, lambda v: v in ("auto", "none"),
 )
+SCHEMA.option(
+    "constraints", bool,
+    "enforce label property/connection constraints on writes (reference: "
+    "schema.constraints + SchemaManager.addProperties/addConnection; "
+    "with schema.default=auto missing constraints are auto-created, with "
+    "'none' they reject)", False, Mutability.GLOBAL_OFFLINE,
+)
 CLUSTER.option(
     "max-partitions", int,
     "virtual partitions for graph sharding (OLAP shard granularity)",

@@ -341,6 +341,12 @@ class JanusGraphTPU:
             self._load_schema_by_name, self._load_schema_by_id
         )
         self.auto_schema = cfg.get("schema.default") == "auto"
+        # cached: read on every property/edge write (GLOBAL_OFFLINE —
+        # immutable while the graph is open)
+        self.schema_constraints = bool(cfg.get("schema.constraints"))
+        #: serializes constraint-tuple read-modify-writes (auto-created
+        #: constraints arrive from concurrent writer transactions)
+        self._schema_rmw_lock = threading.Lock()
         self.indexes: Dict[str, IndexDefinition] = {}
         self._commit_lock = threading.Lock()
         self._open = True

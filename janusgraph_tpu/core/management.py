@@ -149,6 +149,72 @@ class ManagementSystem:
         self.graph.management_logger.broadcast_eviction(el.id)
         return updated
 
+    def add_properties(self, label_name: str, *key_names: str):
+        """Declare property keys for a vertex or edge label (reference:
+        SchemaManager.addProperties). With schema.constraints enabled,
+        EVERY key written on a non-default-labeled element must be
+        declared — a label with no declarations rejects all property
+        writes in 'none' mode (the reference's semantics); with
+        schema.default=auto, missing declarations are created on first
+        write. Additive across calls. The read-modify-write is serialized
+        (auto-created declarations arrive from concurrent writers)."""
+        with self.graph._schema_rmw_lock:
+            el = self.graph.schema_cache.get_by_name(label_name)
+            if el is None or not hasattr(el, "allowed_property_ids"):
+                raise SchemaViolationError(
+                    f"{label_name} is not a vertex or edge label"
+                )
+            ids = list(el.allowed_property_ids)
+            for kn in key_names:
+                pk = self.graph.schema_cache.get_by_name(kn)
+                if not isinstance(pk, PropertyKey):
+                    raise SchemaViolationError(f"{kn} is not a property key")
+                if pk.id not in ids:
+                    ids.append(pk.id)
+            import dataclasses
+
+            updated = dataclasses.replace(
+                el, allowed_property_ids=tuple(ids)
+            )
+            self._persist(updated)
+            self.graph.schema_cache.invalidate(label_name)
+            self.graph.schema_cache.invalidate_id(el.id)
+        self.graph.management_logger.broadcast_eviction(el.id)
+        return updated
+
+    def add_connection(
+        self, edge_label_name: str, out_label_name: str, in_label_name: str
+    ):
+        """Declare an (out-vertex-label, in-vertex-label) connection for an
+        edge label (reference: SchemaManager.addConnection). With
+        schema.constraints enabled, every edge between non-default-labeled
+        endpoints must match a declared connection — no declarations means
+        no such edges in 'none' mode; auto mode declares on first write.
+        Additive; RMW serialized like add_properties."""
+        with self.graph._schema_rmw_lock:
+            el = self.graph.schema_cache.get_by_name(edge_label_name)
+            if not isinstance(el, EdgeLabel):
+                raise SchemaViolationError(
+                    f"{edge_label_name} is not an edge label"
+                )
+            pair = []
+            for ln in (out_label_name, in_label_name):
+                vl = self.graph.schema_cache.get_by_name(ln)
+                if not isinstance(vl, VertexLabel):
+                    raise SchemaViolationError(f"{ln} is not a vertex label")
+                pair.append(vl.id)
+            conns = list(el.connections)
+            if tuple(pair) not in conns:
+                conns.append(tuple(pair))
+            import dataclasses
+
+            updated = dataclasses.replace(el, connections=tuple(conns))
+            self._persist(updated)
+            self.graph.schema_cache.invalidate(edge_label_name)
+            self.graph.schema_cache.invalidate_id(el.id)
+        self.graph.management_logger.broadcast_eviction(el.id)
+        return updated
+
     def set_ttl(self, name: str, ttl_seconds: int):
         """Attach a time-to-live to a property key, edge label, or vertex
         label (reference: ManagementSystem.setTTL storing

@@ -145,6 +145,12 @@ class EdgeLabel:
     unidirected: bool = False
     consistency: Consistency = Consistency.DEFAULT
     ttl_seconds: int = 0
+    #: schema constraints (reference: SchemaManager.addProperties /
+    #: addConnection) — enforcement is gated by the schema.constraints
+    #: option; when enabled, EMPTY tuples mean nothing is declared (all
+    #: writes reject in 'none' mode, auto mode declares on first write)
+    allowed_property_ids: Tuple[int, ...] = ()
+    connections: Tuple[Tuple[int, int], ...] = ()  # (outV label id, inV label id)
 
     @property
     def is_property_key(self) -> bool:
@@ -155,7 +161,7 @@ class EdgeLabel:
         return True
 
     def definition(self) -> dict:
-        return {
+        d = {
             "kind": "edge",
             "multiplicity": int(self.multiplicity),
             "sortKey": list(self.sort_key),
@@ -163,6 +169,11 @@ class EdgeLabel:
             "consistency": int(self.consistency),
             "ttl": self.ttl_seconds,
         }
+        if self.allowed_property_ids:
+            d["allowedProps"] = list(self.allowed_property_ids)
+        if self.connections:
+            d["connections"] = [list(c) for c in self.connections]
+        return d
 
     def type_info(self) -> TypeInfo:
         return TypeInfo(self.id, True, Cardinality.SINGLE, self.sort_key)
@@ -178,14 +189,19 @@ class VertexLabel:
     partitioned: bool = False
     static: bool = False
     ttl_seconds: int = 0
+    #: schema constraints (reference: SchemaManager.addProperties)
+    allowed_property_ids: Tuple[int, ...] = ()
 
     def definition(self) -> dict:
-        return {
+        d = {
             "kind": "vertexlabel",
             "partitioned": self.partitioned,
             "static": self.static,
             "ttl": self.ttl_seconds,
         }
+        if self.allowed_property_ids:
+            d["allowedProps"] = list(self.allowed_property_ids)
+        return d
 
 
 @dataclass(frozen=True)
@@ -302,11 +318,14 @@ def schema_element_from_definition(sid: int, name: str, d: dict):
             d.get("unidirected", False),
             Consistency(d.get("consistency", 0)),
             d.get("ttl", 0),
+            tuple(d.get("allowedProps", ())),
+            tuple(tuple(c) for c in d.get("connections", ())),
         )
     if kind == "vertexlabel":
         return VertexLabel(
             sid, name, d.get("partitioned", False), d.get("static", False),
             d.get("ttl", 0),
+            tuple(d.get("allowedProps", ())),
         )
     if kind == "relindex":
         return RelationIndex(

@@ -107,6 +107,70 @@ class Transaction:
             raise SchemaViolationError(f"{name} is not a property key")
         return el
 
+    # -- schema constraints (reference: StandardJanusGraphTx.java:669-698 —
+    # with schema.constraints enabled, labeled elements only carry declared
+    # keys/connections; auto schema auto-creates the missing constraint,
+    # 'none' rejects. The default "vertex" label is exempt, mirroring the
+    # BaseVertexLabel exemption.)
+    def _constraints_on(self) -> bool:
+        # cached at graph open (GLOBAL_OFFLINE: immutable for the graph's
+        # lifetime) — this sits on the hottest write path
+        return self.graph.schema_constraints
+
+    def _vertex_label_el(self, v: Vertex):
+        name = self.get_vertex_label(v)
+        if name == "vertex":
+            return None  # default label: exempt
+        return self.schema_by_name(name)
+
+    def _check_property_constraint(self, v: Vertex, pk: PropertyKey) -> None:
+        if not self._constraints_on():
+            return
+        vl = self._vertex_label_el(v)
+        if vl is None or not hasattr(vl, "allowed_property_ids"):
+            return
+        if pk.id in vl.allowed_property_ids:
+            return
+        if self.graph.auto_schema:
+            self.graph.management().add_properties(vl.name, pk.name)
+            return
+        raise SchemaViolationError(
+            f"property {pk.name!r} is not declared for vertex label "
+            f"{vl.name!r} (schema.constraints; mgmt.add_properties)"
+        )
+
+    def _check_edge_property_constraint(self, el: EdgeLabel, pk: PropertyKey) -> None:
+        if not self._constraints_on():
+            return
+        if pk.id in el.allowed_property_ids:
+            return
+        if self.graph.auto_schema:
+            self.graph.management().add_properties(el.name, pk.name)
+            return
+        raise SchemaViolationError(
+            f"property {pk.name!r} is not declared for edge label "
+            f"{el.name!r} (schema.constraints; mgmt.add_properties)"
+        )
+
+    def _check_connection_constraint(
+        self, el: EdgeLabel, out_v: Vertex, in_v: Vertex
+    ) -> None:
+        if not self._constraints_on():
+            return
+        ovl = self._vertex_label_el(out_v)
+        ivl = self._vertex_label_el(in_v)
+        if ovl is None or ivl is None:
+            return  # default-labeled endpoint: exempt
+        if (ovl.id, ivl.id) in el.connections:
+            return
+        if self.graph.auto_schema:
+            self.graph.management().add_connection(el.name, ovl.name, ivl.name)
+            return
+        raise SchemaViolationError(
+            f"connection {ovl.name!r}-[{el.name!r}]->{ivl.name!r} is not "
+            "declared (schema.constraints; mgmt.add_connection)"
+        )
+
     def _edge_label(self, name: str) -> EdgeLabel:
         el = self.schema_by_name(name)
         if el is None:
@@ -147,10 +211,12 @@ class Transaction:
             raise InvalidElementError("endpoint vertex was removed in this tx")
         el = self._edge_label(label)
         self._check_multiplicity(el, out_v, in_v)
+        self._check_connection_constraint(el, out_v, in_v)
         rid = self.graph.id_assigner.assign_relation_id()
         prop_ids = {}
         for k, val in props.items():
             pk = self._property_key(k, val)
+            self._check_edge_property_constraint(el, pk)
             prop_ids[pk.id] = val
         sort_key = self._build_sort_key(el, prop_ids)
         e = Edge(
@@ -204,6 +270,7 @@ class Transaction:
         if v.id in self._removed_vertices:
             raise InvalidElementError("vertex was removed in this tx")
         pk = self._property_key(key, value)
+        self._check_property_constraint(v, pk)
         if not isinstance(value, pk.data_type) or (
             pk.data_type is not bool and isinstance(value, bool)
         ):
@@ -251,6 +318,9 @@ class Transaction:
                 "cannot set a property on a removed edge", e
             )
         pk = self._property_key(key, value)
+        lbl = self.schema_by_id(e.type_id)
+        if isinstance(lbl, EdgeLabel):
+            self._check_edge_property_constraint(lbl, pk)
         if e.is_new:
             e._props[pk.id] = value
             # sort-key columns encode property values: rebuild so the stored

@@ -1,0 +1,182 @@
+"""Schema constraints (reference: SchemaManager.addProperties/addConnection
++ schema.constraints, enforced at StandardJanusGraphTx.java:669-698: with
+constraints enabled, labeled elements carry only declared keys and edges
+only declared (outLabel, inLabel) connections; auto schema creates missing
+constraints, 'none' rejects; the default label is exempt)."""
+
+import pytest
+
+from janusgraph_tpu.core.codecs import Multiplicity
+from janusgraph_tpu.core.graph import open_graph
+from janusgraph_tpu.exceptions import SchemaViolationError
+
+
+def _strict_graph():
+    g = open_graph({"schema.default": "none", "schema.constraints": True})
+    m = g.management()
+    m.make_vertex_label("person")
+    m.make_vertex_label("city")
+    m.make_property_key("name", str)
+    m.make_property_key("age", int)
+    m.make_property_key("weight", float)
+    m.make_edge_label("lives_in")
+    m.add_properties("person", "name", "age")
+    m.add_properties("lives_in", "weight")
+    m.add_connection("lives_in", "person", "city")
+    return g
+
+
+def test_declared_writes_pass_and_undeclared_reject():
+    g = _strict_graph()
+    tx = g.new_transaction()
+    p = tx.add_vertex("person", name="ada", age=36)
+    c = tx.add_vertex("city")
+    e = tx.add_edge(p, "lives_in", c, weight=1.0)
+    tx.commit()
+    tx = g.new_transaction()
+    with pytest.raises(SchemaViolationError, match="not declared"):
+        tx.get_vertex(p.id).property("weight", 2.0)  # undeclared on person
+    tx.rollback()
+    g.close()
+
+
+def test_undeclared_connection_rejects():
+    g = _strict_graph()
+    tx = g.new_transaction()
+    a = tx.add_vertex("person", name="a")
+    b = tx.add_vertex("person", name="b")
+    with pytest.raises(SchemaViolationError, match="connection"):
+        tx.add_edge(a, "lives_in", b)  # person->person not declared
+    tx.rollback()
+    g.close()
+
+
+def test_undeclared_edge_property_rejects():
+    g = _strict_graph()
+    m = g.management()
+    tx = g.new_transaction()
+    p = tx.add_vertex("person", name="x")
+    c = tx.add_vertex("city")
+    with pytest.raises(SchemaViolationError, match="not declared"):
+        tx.add_edge(p, "lives_in", c, name="home")  # name not on lives_in
+    tx.rollback()
+    g.close()
+
+
+def test_default_label_exempt():
+    g = open_graph({"schema.default": "none", "schema.constraints": True})
+    m = g.management()
+    m.make_property_key("note", str)
+    m.make_edge_label("rel")
+    tx = g.new_transaction()
+    a = tx.add_vertex(note="free")   # default 'vertex' label: exempt
+    b = tx.add_vertex()
+    tx.add_edge(a, "rel", b)         # default-labeled endpoints: exempt
+    tx.commit()
+    g.close()
+
+
+def test_auto_schema_auto_creates_constraints():
+    g = open_graph({"schema.default": "auto", "schema.constraints": True})
+    m = g.management()
+    m.make_vertex_label("thing")
+    tx = g.new_transaction()
+    t = tx.add_vertex("thing", kind="widget")  # auto-declares kind on thing
+    tx.commit()
+    vl = g.schema_cache.get_by_name("thing")
+    pk = g.schema_cache.get_by_name("kind")
+    assert pk.id in vl.allowed_property_ids
+    g.close()
+
+
+def test_constraints_survive_reopen():
+    from janusgraph_tpu.storage.inmemory import InMemoryStoreManager
+
+    sm = InMemoryStoreManager()
+    g = open_graph(
+        {"schema.default": "none", "schema.constraints": True},
+        store_manager=sm,
+    )
+    m = g.management()
+    m.make_vertex_label("person")
+    m.make_vertex_label("city")
+    m.make_property_key("name", str)
+    m.make_edge_label("lives_in")
+    m.add_properties("person", "name")
+    m.add_connection("lives_in", "person", "city")
+    g.close()
+    g2 = open_graph(
+        {"schema.default": "none", "schema.constraints": True},
+        store_manager=sm,
+    )
+    vl = g2.schema_cache.get_by_name("person")
+    el = g2.schema_cache.get_by_name("lives_in")
+    assert len(vl.allowed_property_ids) == 1
+    assert len(el.connections) == 1
+    tx = g2.new_transaction()
+    p = tx.add_vertex("person", name="ok")
+    with pytest.raises(SchemaViolationError):
+        tx.add_edge(p, "lives_in", tx.add_vertex("person", name="x"))
+    tx.rollback()
+    g2.close()
+
+
+def test_disabled_by_default_no_enforcement():
+    g = open_graph({"schema.default": "none"})
+    m = g.management()
+    m.make_vertex_label("person")
+    m.make_property_key("name", str)
+    m.make_property_key("other", str)
+    m.add_properties("person", "name")
+    tx = g.new_transaction()
+    # schema.constraints defaults False: declarations exist but don't bind
+    tx.add_vertex("person", other="fine")
+    tx.commit()
+    g.close()
+
+
+def test_set_edge_property_after_creation_enforced():
+    """Constraints bind post-creation edge property writes too (the
+    set_edge_property path, not just add_edge kwargs)."""
+    g = _strict_graph()
+    tx = g.new_transaction()
+    p = tx.add_vertex("person", name="y")
+    c = tx.add_vertex("city")
+    e = tx.add_edge(p, "lives_in", c)
+    with pytest.raises(SchemaViolationError, match="not declared"):
+        e.set_property("name", "home")
+    e.set_property("weight", 3.0)  # declared: fine
+    tx.commit()
+    g.close()
+
+
+def test_concurrent_auto_declarations_not_lost():
+    """Two threads auto-declaring different keys on one label must both
+    survive (the serialized RMW; lost-update regression)."""
+    import threading
+
+    g = open_graph({"schema.default": "auto", "schema.constraints": True})
+    g.management().make_vertex_label("thing")
+    errors = []
+
+    def write(key):
+        try:
+            tx = g.new_transaction()
+            tx.add_vertex("thing", **{key: "v"})
+            tx.commit()
+        except Exception as e:  # noqa: BLE001
+            errors.append(repr(e))
+
+    ts = [threading.Thread(target=write, args=(k,)) for k in
+          ("alpha", "beta", "gamma", "delta")]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join(timeout=60)
+    assert not errors, errors
+    vl = g.schema_cache.get_by_name("thing")
+    declared = {
+        g.schema_cache.get_by_id(i).name for i in vl.allowed_property_ids
+    }
+    assert {"alpha", "beta", "gamma", "delta"} <= declared
+    g.close()
