@@ -142,10 +142,15 @@ class ManagementSystem:
             )
         import dataclasses
 
-        updated = dataclasses.replace(el, consistency=consistency)
-        self._persist(updated)
-        self.graph.schema_cache.invalidate(name)
-        self.graph.schema_cache.invalidate_id(el.id)
+        # same RMW lock as the constraint declarations: auto-created
+        # declarations arrive from concurrent writers and every schema
+        # field update must see them
+        with self.graph._schema_rmw_lock:
+            el = self.graph.schema_cache.get_by_name(name)
+            updated = dataclasses.replace(el, consistency=consistency)
+            self._persist(updated)
+            self.graph.schema_cache.invalidate(name)
+            self.graph.schema_cache.invalidate_id(el.id)
         self.graph.management_logger.broadcast_eviction(el.id)
         return updated
 
@@ -248,10 +253,12 @@ class ManagementSystem:
             )
         import dataclasses
 
-        updated = dataclasses.replace(el, ttl_seconds=int(ttl_seconds))
-        self._persist(updated)
-        self.graph.schema_cache.invalidate(name)
-        self.graph.schema_cache.invalidate_id(el.id)
+        with self.graph._schema_rmw_lock:
+            el = self.graph.schema_cache.get_by_name(name)
+            updated = dataclasses.replace(el, ttl_seconds=int(ttl_seconds))
+            self._persist(updated)
+            self.graph.schema_cache.invalidate(name)
+            self.graph.schema_cache.invalidate_id(el.id)
         self.graph.management_logger.broadcast_eviction(el.id)
         return updated
 
@@ -671,6 +678,19 @@ class ManagementSystem:
                 out.append(el.consistency.name)
             if getattr(el, "ttl_seconds", 0):
                 out.append(f"ttl={el.ttl_seconds}s")
+            if getattr(el, "allowed_property_ids", ()):
+                names = ",".join(
+                    self.graph.schema_cache.get_by_id(i).name
+                    for i in el.allowed_property_ids
+                )
+                out.append(f"props=[{names}]")
+            if getattr(el, "connections", ()):
+                conns = ",".join(
+                    f"{self.graph.schema_cache.get_by_id(o).name}->"
+                    f"{self.graph.schema_cache.get_by_id(i).name}"
+                    for o, i in el.connections
+                )
+                out.append(f"connections=[{conns}]")
             return (" " + " ".join(out)) if out else ""
 
         lines = ["--- property keys ---"]

@@ -370,36 +370,45 @@ class SchemaCache:
         self._load_name = loader_by_name
         self._load_id = loader_by_id
         self._lock = RLock()
+        # bumped on every invalidation: a load that STARTED before a
+        # concurrent invalidation must not repopulate the cache with its
+        # (stale) result — same guard the slice cache uses
+        self._generation = 0
 
     def get_by_name(self, name: str):
         with self._lock:
             el = self._by_name.get(name)
+            gen = self._generation
         if el is not None:
             return el
         el = self._load_name(name)
         if el is not None:
             with self._lock:
-                self._by_name[name] = el
-                self._by_id[el.id] = el
+                if self._generation == gen:
+                    self._by_name[name] = el
+                    self._by_id[el.id] = el
         return el
 
     def get_by_id(self, sid: int):
         with self._lock:
             el = self._by_id.get(sid)
+            gen = self._generation
         if el is not None:
             return el
         el = self._load_id(sid)
         if el is not None:
             with self._lock:
-                self._by_id[sid] = el
-                # index names are a separate namespace: never let an index
-                # shadow a relation type of the same name
-                if not isinstance(el, IndexDefinition):
-                    self._by_name[el.name] = el
+                if self._generation == gen:
+                    self._by_id[sid] = el
+                    # index names are a separate namespace: never let an
+                    # index shadow a relation type of the same name
+                    if not isinstance(el, IndexDefinition):
+                        self._by_name[el.name] = el
         return el
 
     def invalidate(self, name: Optional[str] = None) -> None:
         with self._lock:
+            self._generation += 1
             if name is None:
                 self._by_name.clear()
                 self._by_id.clear()
@@ -410,6 +419,7 @@ class SchemaCache:
 
     def invalidate_id(self, sid: int) -> None:
         with self._lock:
+            self._generation += 1
             el = self._by_id.pop(sid, None)
             if el is not None:
                 self._by_name.pop(el.name, None)

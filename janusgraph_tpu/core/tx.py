@@ -270,7 +270,6 @@ class Transaction:
         if v.id in self._removed_vertices:
             raise InvalidElementError("vertex was removed in this tx")
         pk = self._property_key(key, value)
-        self._check_property_constraint(v, pk)
         if not isinstance(value, pk.data_type) or (
             pk.data_type is not bool and isinstance(value, bool)
         ):
@@ -288,6 +287,10 @@ class Transaction:
                     f"property {key} expects {pk.data_type.__name__}, "
                     f"got {type(value).__name__}"
                 )
+        # AFTER type validation: the auto-schema constraint path persists a
+        # durable schema mutation — a write that is going to be rejected
+        # must not leave one behind
+        self._check_property_constraint(v, pk)
         if pk.cardinality == Cardinality.SINGLE:
             for existing in self.get_properties(v, key):
                 self.remove_property(existing)

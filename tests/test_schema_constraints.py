@@ -121,6 +121,14 @@ def test_constraints_survive_reopen():
     g2.close()
 
 
+def test_print_schema_shows_declarations():
+    g = _strict_graph()
+    out = g.management().print_schema()
+    assert "props=[name,age]" in out
+    assert "connections=[person->city]" in out
+    g.close()
+
+
 def test_disabled_by_default_no_enforcement():
     g = open_graph({"schema.default": "none"})
     m = g.management()
@@ -179,4 +187,59 @@ def test_concurrent_auto_declarations_not_lost():
         g.schema_cache.get_by_id(i).name for i in vl.allowed_property_ids
     }
     assert {"alpha", "beta", "gamma", "delta"} <= declared
+    g.close()
+
+
+def test_rejected_type_write_leaves_no_schema_mutation():
+    """A type-invalid write must not auto-declare the key first (durable
+    schema side effects from failed writes)."""
+    g = open_graph({"schema.default": "auto", "schema.constraints": True})
+    m = g.management()
+    m.make_vertex_label("person")
+    m.make_property_key("age", int)
+    tx = g.new_transaction()
+    v = tx.add_vertex("person")
+    with pytest.raises(SchemaViolationError, match="expects"):
+        v.property("age", "not-a-number")
+    vl = g.schema_cache.get_by_name("person")
+    assert vl.allowed_property_ids == ()  # nothing declared by the failure
+    tx.rollback()
+    g.close()
+
+
+def test_set_ttl_and_declarations_compose():
+    """set_ttl/set_consistency share the RMW lock with declarations —
+    neither update may erase the other."""
+    import threading
+
+    g = open_graph({"schema.default": "auto", "schema.constraints": True})
+    m = g.management()
+    m.make_vertex_label("thing")
+    done = []
+
+    def declare():
+        tx = g.new_transaction()
+        tx.add_vertex("thing", zeta="v")
+        tx.commit()
+        done.append("declare")
+
+    def modify():
+        # static-label-free TTL rejection would end the thread early on
+        # inmemory (supports cell ttl); use consistency instead for a
+        # schema-field RMW racing the declaration
+        from janusgraph_tpu.core.codecs import Consistency
+
+        m2 = g.management()
+        m2.make_property_key("guarded", str)
+        m2.set_consistency("guarded", Consistency.LOCK)
+        done.append("modify")
+
+    ts = [threading.Thread(target=declare), threading.Thread(target=modify)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join(timeout=60)
+    assert sorted(done) == ["declare", "modify"]
+    vl = g.schema_cache.get_by_name("thing")
+    assert len(vl.allowed_property_ids) == 1  # declaration survived
     g.close()
