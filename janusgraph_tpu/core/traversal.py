@@ -688,6 +688,84 @@ class GraphTraversal:
         )
         return self
 
+    def label(self) -> "GraphTraversal":
+        """Map each element to its label string (TinkerPop LabelStep).
+        `label_` is the same step under its historical spelling."""
+        self._add(
+            lambda ts: [t.child(_label_of(t.obj), prev=t.prev) for t in ts],
+            name="label",
+        )
+        return self
+
+    label_ = label
+
+    def element_map(self, *keys: str) -> "GraphTraversal":
+        """One flat dict per element: id + label + single-valued properties
+        (TinkerPop ElementMapStep; multi-valued keys keep the LAST value,
+        matching TinkerPop's elementMap flattening)."""
+        tx = self.tx
+
+        def step(ts):
+            out = []
+            for t in ts:
+                obj = t.obj
+                if isinstance(obj, Vertex):
+                    m = {"id": obj.id, "label": obj.label}
+                    for p in tx.get_properties(obj, *keys):
+                        m[p.key] = p.value
+                elif isinstance(obj, Edge):
+                    # TinkerPop elementMap() on edges includes the endpoint
+                    # summaries under Direction keys
+                    m = {
+                        "id": obj.identifier,
+                        "label": obj.label,
+                        "OUT": {
+                            "id": obj.out_vertex.id,
+                            "label": obj.out_vertex.label,
+                        },
+                        "IN": {
+                            "id": obj.in_vertex.id,
+                            "label": obj.in_vertex.label,
+                        },
+                    }
+                    for k, v in obj.property_values().items():
+                        if not keys or k in keys:
+                            m[k] = v
+                else:
+                    raise QueryError(
+                        f"element_map() requires vertex or edge traversers "
+                        f"(got {type(obj).__name__})"
+                    )
+                out.append(t.child(m, prev=t.prev))
+            return out
+
+        self._add(step, name="elementMap")
+        return self
+
+    def drop(self) -> "GraphTraversal":
+        """Remove every element on the frontier — vertices (with their
+        incident edges), edges, or vertex properties (TinkerPop DropStep).
+        Mutations join the surrounding transaction; commit as usual."""
+        tx = self.tx
+
+        def step(ts):
+            for t in ts:
+                obj = t.obj
+                if isinstance(obj, Vertex):
+                    tx.remove_vertex(obj)
+                elif isinstance(obj, Edge):
+                    tx.remove_edge(obj)
+                elif isinstance(obj, VertexProperty):
+                    tx.remove_property(obj)
+                else:
+                    raise QueryError(
+                        f"drop() cannot remove {type(obj).__name__}"
+                    )
+            return []
+
+        self._add(step, name="drop")
+        return self
+
     def value_map(self, *keys: str) -> "GraphTraversal":
         tx = self.tx
 
@@ -710,9 +788,6 @@ class GraphTraversal:
         self._add(lambda ts: [t.child(t.obj.id, prev=t.prev) for t in ts])
         return self
 
-    def label_(self) -> "GraphTraversal":
-        self._add(lambda ts: [t.child(_label_of(t.obj), prev=t.prev) for t in ts])
-        return self
 
     # -- collection/order/slicing -------------------------------------------
     def dedup(self) -> "GraphTraversal":

@@ -220,3 +220,51 @@ def test_subgraph_multivalue_single_first_ordering():
     assert [p.value for p in va.properties("nickname")] == ["only"]
     sg.close()
     g2.close()
+
+
+def test_label_step(g):
+    labels = set(g.traversal().V().label().to_list())
+    assert "god" in labels and "monster" in labels
+
+
+def test_element_map(g):
+    m = (
+        g.traversal().V().has("name", "hercules").element_map().to_list()[0]
+    )
+    assert m["label"] == "demigod" and m["name"] == "hercules"
+    assert m["age"] == 30 and "id" in m
+    only_name = (
+        g.traversal().V().has("name", "hercules")
+        .element_map("name").to_list()[0]
+    )
+    assert set(only_name) == {"id", "label", "name"}
+    # edges carry endpoint summaries under Direction keys (TinkerPop shape)
+    em = (
+        g.traversal().V().has("name", "hercules")
+        .out_e("battled").element_map().to_list()[0]
+    )
+    assert em["label"] == "battled"
+    assert em["OUT"]["label"] == "demigod" and em["IN"]["label"] == "monster"
+    # non-element traversers refuse loudly
+    with pytest.raises(QueryError, match="element_map"):
+        g.traversal().V().values("name").element_map().to_list()
+
+
+def test_drop_step_vertices_edges_properties(g):
+    t = g.traversal()
+    n_before = t.V().count()
+    # drop edges first (battled), then a vertex, then a property
+    src = t.V().has("name", "hercules").next()
+    assert t.V().has_id(src.id).out_e("battled").count() == 3
+    tx = t.tx
+    t.V().has_id(src.id).out_e("battled").drop().to_list()
+    tx.commit()
+    t2 = g.traversal()
+    assert t2.V().has_id(src.id).out_e("battled").count() == 0
+    t2.V().has("name", "nemean").drop().to_list()
+    t2.tx.commit()
+    t3 = g.traversal()
+    assert t3.V().count() == n_before - 1
+    t3.V().has("name", "jupiter").properties("age").drop().to_list()
+    t3.tx.commit()
+    assert g.traversal().V().has("name", "jupiter").next().value("age") is None
