@@ -189,6 +189,37 @@ def cmd_config_docs(args) -> int:
     return 0
 
 
+def cmd_export(args) -> int:
+    from janusgraph_tpu.core.graph import open_graph
+    from janusgraph_tpu.core.io import export_graphson
+
+    graph = open_graph(_load_config(args.config))
+    try:
+        counts = export_graphson(graph, args.out)
+        print(f"exported {counts['vertices']} vertices, "
+              f"{counts['edges']} edges -> {args.out}")
+    finally:
+        graph.close()
+    return 0
+
+
+def cmd_import(args) -> int:
+    from janusgraph_tpu.core.graph import open_graph
+    from janusgraph_tpu.core.io import import_graphson
+
+    if args.batch < 1:
+        print("--batch must be >= 1", file=sys.stderr)
+        return 2
+    graph = open_graph(_load_config(args.config))
+    try:
+        counts = import_graphson(graph, args.infile, batch_size=args.batch)
+        print(f"imported {counts['vertices']} vertices, "
+              f"{counts['edges']} edges from {args.infile}")
+    finally:
+        graph.close()
+    return 0
+
+
 def main(argv=None) -> int:
     parser = argparse.ArgumentParser(prog="janusgraph_tpu")
     sub = parser.add_subparsers(dest="command", required=True)
@@ -229,6 +260,28 @@ def main(argv=None) -> int:
     pd = sub.add_parser("config-docs", help="render the config reference")
     pd.add_argument("--out", help="write to this file instead of stdout")
     pd.set_defaults(fn=cmd_config_docs)
+
+    pe = sub.add_parser(
+        "export", help="export a graph to line-delimited GraphSON"
+    )
+    # required: a no-config export would truncate the output with a fresh
+    # (empty) in-memory graph's contents
+    pe.add_argument("--config", required=True, help="graph config JSON file")
+    pe.add_argument("out", help="output .graphson path")
+    pe.set_defaults(fn=cmd_export)
+
+    pi = sub.add_parser(
+        "import", help="import line-delimited GraphSON into a graph"
+    )
+    # required: importing into an unnamed in-memory graph that closes right
+    # after would silently discard everything
+    pi.add_argument("--config", required=True, help="graph config JSON file")
+    pi.add_argument(
+        "--batch", type=int, default=1000,
+        help="elements per import transaction (>= 1)",
+    )
+    pi.add_argument("infile", help="input .graphson path")
+    pi.set_defaults(fn=cmd_import)
 
     args = parser.parse_args(argv)
     return args.fn(args)
