@@ -22,3 +22,25 @@ def open_graph(config=None):
     from janusgraph_tpu.core.graph import open_graph as _open
 
     return _open(config)
+
+
+def drop_graph(graph):
+    """Destroy a graph's storage and close it (JanusGraphFactory.drop
+    equivalent). Irreversible."""
+    from janusgraph_tpu.core.graph import drop_graph as _drop
+
+    return _drop(graph)
+
+
+def export_graphson(graph, path_or_file):
+    """Export a graph to line-delimited GraphSON (TinkerPop io() analogue)."""
+    from janusgraph_tpu.core.io import export_graphson as _exp
+
+    return _exp(graph, path_or_file)
+
+
+def import_graphson(graph, path_or_file, batch_size=1000):
+    """Import a line-delimited GraphSON export (ids remapped)."""
+    from janusgraph_tpu.core.io import import_graphson as _imp
+
+    return _imp(graph, path_or_file, batch_size=batch_size)

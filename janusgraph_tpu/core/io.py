@@ -28,8 +28,33 @@ def export_graphson(graph, path_or_file: Union[str, TextIO]) -> Dict[str, int]:
     else:
         f = path_or_file
     nv = ne = 0
+    # schema records first: the importing graph must know cardinalities
+    # and datatypes BEFORE values arrive (a LIST key re-created as SINGLE
+    # by auto-schema would silently drop all but the last entry)
+    from janusgraph_tpu.core.schema import _DATA_TYPE_NAMES
+
+    mgmt = graph.management()
+    for pk in mgmt.property_keys():
+        f.write(json.dumps({
+            "kind": "propertykey", "name": pk.name,
+            "dataType": _DATA_TYPE_NAMES[pk.data_type],
+            "cardinality": int(pk.cardinality),
+        }) + "\n")
+    for vl in mgmt.vertex_labels():
+        f.write(json.dumps({
+            "kind": "vertexlabel", "name": vl.name,
+            "partitioned": vl.partitioned, "static": vl.static,
+        }) + "\n")
+    for el in mgmt.edge_labels():
+        f.write(json.dumps({
+            "kind": "edgelabel", "name": el.name,
+            "multiplicity": int(el.multiplicity),
+        }) + "\n")
     tx = graph.new_transaction()
     try:
+        # ONE pass: each vertex record followed by its OUT edges (import
+        # resolves forward references, so record order is free and the
+        # second full-graph scan would be pure wasted I/O)
         for v in tx.vertices():
             props = []
             for p in v.properties():
@@ -39,7 +64,6 @@ def export_graphson(graph, path_or_file: Union[str, TextIO]) -> Dict[str, int]:
                 "properties": props,
             }) + "\n")
             nv += 1
-        for v in tx.vertices():
             for e in tx.get_edges(v, Direction.OUT, ()):
                 f.write(json.dumps({
                     "kind": "edge",
@@ -65,8 +89,11 @@ def import_graphson(
     batch_size: int = 1000,
 ) -> Dict[str, int]:
     """Load a line-delimited GraphSON export into `graph` (ids remapped;
-    commits every `batch_size` elements so imports stream). Returns
-    {"vertices": n, "edges": m}."""
+    commits every `batch_size` elements). Edges whose endpoints are
+    already imported process as encountered; FORWARD references defer in
+    memory until the end — exports from export_graphson (vertex followed
+    by its out-edges) defer only edges pointing at later vertices.
+    Returns {"vertices": n, "edges": m}."""
     from janusgraph_tpu.driver.graphson import _decode
 
     close = False
@@ -88,6 +115,27 @@ def import_graphson(
             tx = graph.new_transaction()
             pending = 0
 
+    def add_edge_record(obj):
+        nonlocal ne
+        out_id = id_map.get(obj["out"])
+        in_id = id_map.get(obj["in"])
+        if out_id is None or in_id is None:
+            raise ValueError(
+                f"edge references unknown vertex {obj['out']}→{obj['in']}"
+            )
+        v_out = tx.get_vertex(out_id)
+        v_in = tx.get_vertex(in_id)
+        if v_out is None or v_in is None:
+            raise ValueError(
+                f"edge endpoint not visible in the import tx "
+                f"({obj['out']}→{obj['in']})"
+            )
+        e = tx.add_edge(v_out, obj["label"], v_in)
+        for k, val in obj.get("properties", {}).items():
+            e.set_property(k, _decode(val))
+        ne += 1
+        maybe_commit()
+
     try:
         deferred_edges = []
         for line in f:
@@ -95,46 +143,64 @@ def import_graphson(
             if not line:
                 continue
             obj = json.loads(line)
+            kind = obj["kind"]
+            if kind in ("propertykey", "vertexlabel", "edgelabel"):
+                _ensure_schema(graph, obj)
+                continue
             if obj["kind"] == "vertex":
-                props = {
-                    p["key"]: _decode(p["value"])
-                    for p in obj.get("properties", ())
-                }
                 label = obj.get("label") or None
-                v = tx.add_vertex(
-                    label if label != "vertex" else None, **props
-                )
+                v = tx.add_vertex(label if label != "vertex" else None)
+                # per-entry add_property, NOT kwargs: multi-valued
+                # (LIST/SET) keys keep every entry, and a property that
+                # happens to be named "label" cannot collide with the
+                # label argument
+                for p in obj.get("properties", ()):
+                    tx.add_property(v, p["key"], _decode(p["value"]))
                 id_map[obj["original_id"]] = v.id
                 nv += 1
                 maybe_commit()
             elif obj["kind"] == "edge":
-                deferred_edges.append(obj)
+                if obj["out"] in id_map and obj["in"] in id_map:
+                    add_edge_record(obj)  # streamable: endpoints known
+                else:
+                    deferred_edges.append(obj)  # forward reference
             else:
                 raise ValueError(f"unknown record kind {obj['kind']!r}")
-        # edges after all vertices so forward references resolve
         for obj in deferred_edges:
-            out_id = id_map.get(obj["out"])
-            in_id = id_map.get(obj["in"])
-            if out_id is None or in_id is None:
-                raise ValueError(
-                    f"edge references unknown vertex "
-                    f"{obj['out']}→{obj['in']}"
-                )
-            props = {
-                k: _decode(v) for k, v in obj.get("properties", {}).items()
-            }
-            v_out = tx.get_vertex(out_id)
-            v_in = tx.get_vertex(in_id)
-            if v_out is None or v_in is None:
-                raise ValueError(
-                    f"edge endpoint not visible in the import tx "
-                    f"({obj['out']}→{obj['in']})"
-                )
-            tx.add_edge(v_out, obj["label"], v_in, **props)
-            ne += 1
-            maybe_commit()
+            add_edge_record(obj)
         tx.commit()
     finally:
+        try:
+            tx.rollback()  # no-op after a successful commit; on error it
+            # releases the dangling backend transaction
+        except Exception:  # noqa: BLE001 — teardown must not mask errors
+            pass
         if close:
             f.close()
     return {"vertices": nv, "edges": ne}
+
+
+def _ensure_schema(graph, obj) -> None:
+    """Create an exported schema element in the target when absent
+    (existing definitions win — imports into populated graphs must not
+    clobber their schema)."""
+    from janusgraph_tpu.core.codecs import Cardinality, Multiplicity
+    from janusgraph_tpu.core.schema import _DATA_TYPES
+
+    if graph.schema_cache.get_by_name(obj["name"]) is not None:
+        return
+    mgmt = graph.management()
+    if obj["kind"] == "propertykey":
+        mgmt.make_property_key(
+            obj["name"], _DATA_TYPES[obj["dataType"]],
+            Cardinality(obj["cardinality"]),
+        )
+    elif obj["kind"] == "vertexlabel":
+        mgmt.make_vertex_label(
+            obj["name"], partitioned=obj.get("partitioned", False),
+            static=obj.get("static", False),
+        )
+    else:
+        mgmt.make_edge_label(
+            obj["name"], Multiplicity(obj.get("multiplicity", 0)),
+        )

@@ -62,9 +62,34 @@ def test_typed_values_and_id_remap(tmp_path):
         [[(0, 0), (0, 2), (2, 2), (2, 0)]]
     )
     assert va.value("score") == 1.5
-    assert va.id != a.id or True  # ids remapped by the target authority
     e = g2.traversal().V().has("name", "a").out_e("near").to_list()[0]
     assert e.value("distance") == 3.25
+    g.close()
+    g2.close()
+
+
+def test_multivalued_and_label_named_properties(tmp_path):
+    """LIST-cardinality keys keep every entry and a property literally
+    named 'label' survives (the kwargs-collision regression)."""
+    from janusgraph_tpu.core.codecs import Cardinality
+
+    g = open_graph({"schema.default": "auto"})
+    m = g.management()
+    m.make_property_key("tag", str, Cardinality.LIST)
+    tx = g.new_transaction()
+    v = tx.add_vertex(name="multi")
+    v.property("tag", "a")
+    v.property("tag", "b")
+    tx.add_property(v, "label", "weird-key")
+    tx.commit()
+    buf = _io.StringIO()
+    export_graphson(g, buf)
+    buf.seek(0)
+    g2 = open_graph({"schema.default": "auto"})
+    import_graphson(g2, buf)
+    v2 = g2.traversal().V().has("name", "multi").next()
+    assert sorted(p.value for p in v2.properties("tag")) == ["a", "b"]
+    assert v2.value("label") == "weird-key"
     g.close()
     g2.close()
 
