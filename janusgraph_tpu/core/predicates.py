@@ -206,15 +206,23 @@ class Geoshape:
     # ------------------------------------------------------------- factories
     @staticmethod
     def point(lat: float, lon: float) -> "Geoshape":
-        return Geoshape("Point", ((lat, lon),))
+        # float coercion at every factory: a stored-and-reloaded shape must
+        # be indistinguishable from the constructed one (the codec reads
+        # back doubles)
+        return Geoshape("Point", ((float(lat), float(lon)),))
 
     @staticmethod
     def circle(lat: float, lon: float, radius_km: float) -> "Geoshape":
-        return Geoshape("Circle", ((lat, lon),), radius_km)
+        return Geoshape(
+            "Circle", ((float(lat), float(lon)),), float(radius_km)
+        )
 
     @staticmethod
     def box(sw_lat: float, sw_lon: float, ne_lat: float, ne_lon: float) -> "Geoshape":
-        return Geoshape("Box", ((sw_lat, sw_lon), (ne_lat, ne_lon)))
+        return Geoshape(
+            "Box",
+            ((float(sw_lat), float(sw_lon)), (float(ne_lat), float(ne_lon))),
+        )
 
     @staticmethod
     def polygon(points: Sequence[Tuple[float, float]]) -> "Geoshape":

@@ -1,0 +1,94 @@
+"""Systematic property semantics matrix: cardinality x datatype through
+write -> commit -> reload -> index paths (reference model:
+JanusGraphTest.java's wide datatype/cardinality matrix)."""
+
+import datetime
+import uuid
+
+import pytest
+
+from janusgraph_tpu.core.codecs import Cardinality
+from janusgraph_tpu.core.graph import open_graph
+from janusgraph_tpu.core.predicates import Geoshape
+from janusgraph_tpu.storage.inmemory import InMemoryStoreManager
+
+VALUES = [
+    ("s", str, "héllo ✓", "other"),
+    ("i", int, 42, -7),
+    ("f", float, 2.5, -0.125),
+    ("b", bool, True, False),
+    ("by", bytes, b"\x00\xff", b"raw"),
+    ("dt", datetime.datetime,
+     datetime.datetime(2026, 7, 30, 12, 0, tzinfo=datetime.timezone.utc),
+     datetime.datetime(1999, 1, 1, tzinfo=datetime.timezone.utc)),
+    ("u", uuid.UUID, uuid.uuid5(uuid.NAMESPACE_DNS, "a"),
+     uuid.uuid5(uuid.NAMESPACE_DNS, "b")),
+    ("g", Geoshape, Geoshape.point(1, 2),
+     Geoshape.multipolygon([[(0, 0), (0, 2), (2, 2), (2, 1)]])),
+]
+
+
+@pytest.mark.parametrize("card", [
+    Cardinality.SINGLE, Cardinality.LIST, Cardinality.SET
+], ids=lambda c: c.name)
+def test_cardinality_datatype_matrix(card):
+    sm = InMemoryStoreManager()
+    g = open_graph({"schema.default": "none"}, store_manager=sm)
+    m = g.management()
+    for name, typ, _v1, _v2 in VALUES:
+        m.make_property_key(name, typ, card)
+    tx = g.new_transaction()
+    v = tx.add_vertex()
+    for name, _typ, v1, v2 in VALUES:
+        v.property(name, v1)
+        v.property(name, v2)
+        if card == Cardinality.SET:
+            v.property(name, v2)  # duplicate: SET dedupes
+    tx.commit()
+    vid = v.id
+    g.close()
+
+    # reload through a fresh graph over the same backend
+    g2 = open_graph({"schema.default": "none"}, store_manager=sm)
+    tx = g2.new_transaction()
+    v = tx.get_vertex(vid)
+    for name, _typ, v1, v2 in VALUES:
+        got = [p.value for p in v.properties(name)]
+        if card == Cardinality.SINGLE:
+            assert got == [v2], name       # last write wins
+        elif card == Cardinality.LIST:
+            assert sorted(map(repr, got)) == sorted(
+                map(repr, [v1, v2])
+            ), name                         # both kept
+        else:
+            assert sorted(map(repr, got)) == sorted(
+                map(repr, [v1, v2])
+            ), name                         # deduped to two
+    tx.rollback()
+    g2.close()
+
+
+def test_single_cardinality_composite_index_follows_updates():
+    """Index rows move with SINGLE updates across every indexable type."""
+    g = open_graph({"schema.default": "none"})
+    m = g.management()
+    m.make_property_key("k_str", str)
+    m.make_property_key("k_int", int)
+    m.build_composite_index("by_str", ["k_str"])
+    m.build_composite_index("by_int", ["k_int"])
+    tx = g.new_transaction()
+    v = tx.add_vertex()
+    v.property("k_str", "first")
+    v.property("k_int", 1)
+    tx.commit()
+    tx = g.new_transaction()
+    v2 = tx.get_vertex(v.id)
+    v2.property("k_str", "second")
+    v2.property("k_int", 2)
+    tx.commit()
+    t = g.traversal()
+    assert [x.id for x in t.V().has("k_str", "second").to_list()] == [v.id]
+    assert t.V().has("k_str", "first").to_list() == []
+    assert [x.id for x in t.V().has("k_int", 2).to_list()] == [v.id]
+    assert t.V().has("k_int", 1).to_list() == []
+    g.close()
