@@ -127,6 +127,7 @@ METRICS_NS = ConfigNamespace("metrics", "metrics collection", ROOT)
 COMPUTER_NS = ConfigNamespace("computer", "OLAP graph computer", ROOT)
 LOCK_NS = ConfigNamespace("locks", "distributed locking", ROOT)
 SERVER_NS = ConfigNamespace("server", "server endpoint", ROOT)
+ATTRIBUTE_NS = ConfigNamespace("attributes", "attribute serialization", ROOT)
 
 STORAGE.option("backend", str, "store manager shorthand", "inmemory")
 STORAGE.option("directory", str, "data directory for persistent backends", "")
@@ -224,6 +225,14 @@ TX_NS.option(
     "max-commit-time-ms", float,
     "recovery considers a tx abandoned after this long", 10_000.0,
     Mutability.GLOBAL,
+)
+ATTRIBUTE_NS.option(
+    "allow-pickle", str,
+    "arbitrary-object pickle frames in the attribute serializer: 'auto' "
+    "permits them only when the backing store is in-process/local-disk "
+    "(a remote KCVS peer must never be able to plant a pickle payload "
+    "that executes on read); 'true'/'false' force the choice",
+    "auto", Mutability.LOCAL, lambda v: v in ("auto", "true", "false"),
 )
 INDEX_NS.option("search.backend", str, "mixed index provider shorthand", "memindex")
 INDEX_NS.option("search.directory", str, "index data directory", "")

@@ -251,7 +251,17 @@ class JanusGraphTPU:
             except (TypeError, ValueError):
                 pass
             store_manager = factory(cfg) if takes_cfg else factory()
-        self.serializer = Serializer()
+        pickle_mode = cfg.get("attributes.allow-pickle")
+        if pickle_mode == "auto":
+            # a network-attached KCVS store is a trust boundary: any
+            # co-writer could plant a pickle frame that executes on read,
+            # so auto disables object-pickle payloads there. Asked of the
+            # resolved store manager (not the config string) so injected
+            # and plugin-registered remote adapters are covered too
+            allow_pickle = not store_manager.features.network_attached
+        else:
+            allow_pickle = pickle_mode == "true"
+        self.serializer = Serializer(allow_pickle=allow_pickle)
         # reconcile cluster-global options BEFORE building the backend so
         # stored GLOBAL/FIXED values govern its construction (reference:
         # GraphDatabaseConfigurationBuilder.java:41 opens the backend

@@ -93,7 +93,14 @@ def import_graphson(
     already imported process as encountered; FORWARD references defer in
     memory until the end — exports from export_graphson (vertex followed
     by its out-edges) defer only edges pointing at later vertices.
-    Returns {"vertices": n, "edges": m}."""
+    Returns {"vertices": n, "edges": m}.
+
+    NOT atomic: each batch commits durably as it completes, so a failure
+    mid-file (malformed record, constraint violation, edge referencing an
+    unknown vertex) leaves earlier batches in the graph. The raised
+    exception carries ``committed = {"vertices": n, "edges": m}`` — the
+    counts that are already durable — so callers can detect a partial
+    import and clean up (or re-export/re-import into a fresh graph)."""
     from janusgraph_tpu.driver.graphson import _decode
 
     close = False
@@ -104,14 +111,16 @@ def import_graphson(
         f = path_or_file
     id_map: Dict[int, int] = {}
     nv = ne = 0
+    nv_committed = ne_committed = 0
     tx = graph.new_transaction()
     pending = 0
 
     def maybe_commit():
-        nonlocal tx, pending
+        nonlocal tx, pending, nv_committed, ne_committed
         pending += 1
         if pending >= batch_size:
             tx.commit()
+            nv_committed, ne_committed = nv, ne
             tx = graph.new_transaction()
             pending = 0
 
@@ -169,6 +178,12 @@ def import_graphson(
         for obj in deferred_edges:
             add_edge_record(obj)
         tx.commit()
+        nv_committed, ne_committed = nv, ne
+    except BaseException as exc:
+        # see docstring: earlier batches are already durable — surface how
+        # much so the caller can clean up the partial import
+        exc.committed = {"vertices": nv_committed, "edges": ne_committed}
+        raise
     finally:
         try:
             tx.rollback()  # no-op after a successful commit; on error it

@@ -714,16 +714,17 @@ class GraphTraversal:
                     for p in tx.get_properties(obj, *keys):
                         m[p.key] = p.value
                 elif isinstance(obj, Edge):
-                    # TinkerPop elementMap() on edges includes the endpoint
-                    # summaries under Direction keys
+                    # TinkerPop elementMap() on edges keys the endpoint
+                    # summaries by Direction.OUT/Direction.IN enum members
+                    # (ElementMapStep), not strings
                     m = {
                         "id": obj.identifier,
                         "label": obj.label,
-                        "OUT": {
+                        Direction.OUT: {
                             "id": obj.out_vertex.id,
                             "label": obj.out_vertex.label,
                         },
-                        "IN": {
+                        Direction.IN: {
                             "id": obj.in_vertex.id,
                             "label": obj.in_vertex.label,
                         },

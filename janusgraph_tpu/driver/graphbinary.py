@@ -7,6 +7,7 @@ shape here: one type-code byte, then a fixed or length-prefixed payload;
 containers nest; elements serialize to their identity + label + properties.
 
 Codes: 0x01 int64 | 0x02 double | 0x03 utf8 string | 0x04 bool | 0x05 null
+       0x06 direction
        0x10 list | 0x11 map | 0x12 set
        0x20 vertex | 0x21 edge | 0x22 relation-identifier | 0x23 bytes
        0x30-0x36 framework datatypes | 0x37 geoshape
@@ -29,6 +30,19 @@ def _w_str(s: str) -> bytes:
     return _U32.pack(len(b)) + b
 
 
+_DIRECTION = None
+
+
+def _is_direction(obj: Any) -> bool:
+    # lazily cached: runs per encoded value (see graphson._direction_cls)
+    global _DIRECTION
+    if _DIRECTION is None:
+        from janusgraph_tpu.core.codecs import Direction
+
+        _DIRECTION = Direction
+    return isinstance(obj, _DIRECTION)
+
+
 def _encode(obj: Any, out: bytearray) -> None:
     from janusgraph_tpu.core.elements import Edge, Vertex
 
@@ -37,6 +51,11 @@ def _encode(obj: Any, out: bytearray) -> None:
     elif isinstance(obj, bool):
         out.append(0x04)
         out.append(1 if obj else 0)
+    elif _is_direction(obj):
+        # before the int branch: Direction is an IntEnum (elementMap
+        # endpoint keys must round-trip typed, like GraphSON g:Direction)
+        out.append(0x06)
+        out.append(int(obj))
     elif isinstance(obj, int):
         out.append(0x01)
         out += _I64.pack(obj)
@@ -201,6 +220,10 @@ def _decode(data: bytes, pos: int) -> Tuple[Any, int]:
         return bool(data[pos]), pos + 1
     if code == 0x01:
         return _I64.unpack_from(data, pos)[0], pos + 8
+    if code == 0x06:
+        from janusgraph_tpu.core.codecs import Direction
+
+        return Direction(data[pos]), pos + 1
     if code == 0x02:
         return _F64.unpack_from(data, pos)[0], pos + 8
     if code == 0x03:

@@ -22,6 +22,19 @@ from typing import Any
 
 from janusgraph_tpu.driver.relation_identifier import RelationIdentifier
 
+_DIRECTION = None
+
+
+def _direction_cls():
+    # lazily cached: the isinstance check runs per encoded value, and the
+    # driver must not import core modules until such objects can flow
+    global _DIRECTION
+    if _DIRECTION is None:
+        from janusgraph_tpu.core.codecs import Direction
+
+        _DIRECTION = Direction
+    return _DIRECTION
+
 
 def _encode(obj: Any):
     # lazy import: the driver must not depend on server-side storage modules
@@ -36,6 +49,10 @@ def _encode(obj: Any):
         if isinstance(obj, Char):  # str subclass — must stay typed
             return {"@type": "janusgraph:Char", "@value": str(obj)}
         return obj
+    if isinstance(obj, _direction_cls()):
+        # before the int branch: Direction is an IntEnum, and TinkerPop
+        # GraphSON 3.0 ships it typed (elementMap endpoint keys)
+        return {"@type": "g:Direction", "@value": obj.name}
     if isinstance(obj, int):
         return {"@type": "g:Int64", "@value": obj}
     if isinstance(obj, float):
@@ -177,6 +194,10 @@ def _decode(obj: Any):
     if t == "g:Map":
         it = iter(v)
         return {_decode(k): _decode(val) for k, val in zip(it, it)}
+    if t == "g:Direction":
+        from janusgraph_tpu.core.codecs import Direction
+
+        return Direction[v]
     if t == "janusgraph:RelationIdentifier":
         return RelationIdentifier.parse(v["relationId"])
     if t == "janusgraph:Geoshape":

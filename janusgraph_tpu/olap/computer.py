@@ -86,6 +86,9 @@ class GraphComputer:
 
     def program(self, p: VertexProgram) -> "GraphComputer":
         self._program = p
+        # an explicit program supersedes any earlier traverse() shortcut —
+        # submit() must not rebuild an OLAP-traversal program over it
+        self._traverse_args = None
         return self
 
     def traverse(self, *spec, seed_filters=None) -> "GraphComputer":

@@ -134,6 +134,12 @@ class StoreFeatures:
     transactional: bool = False
     key_consistent: bool = False   # quorum-consistent single-key reads
     distributed: bool = False
+    #: storage is reachable by writers OUTSIDE this process (a network
+    #: client adapter): cell payloads cross a trust boundary, so upper
+    #: layers must not decode formats that execute on read (pickle).
+    #: distinct from `distributed` — an in-process sharded composite is
+    #: distributed but only this process writes to it
+    network_attached: bool = False
     persists: bool = False
     cell_ttl: bool = False
     timestamps: bool = False

@@ -537,6 +537,7 @@ class RemoteStoreManager(KeyColumnValueStoreManager):
             remote = json.loads(self._call(_OP_FEATURES, b"").decode())
             self._features = StoreFeatures(
                 distributed=True,
+                network_attached=True,  # peers beyond this process can write
                 locking=False,       # consistent-key locker wraps this store
                 transactional=False,  # autocommit per request (CQL model)
                 multi_query=True,

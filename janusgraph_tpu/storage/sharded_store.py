@@ -121,6 +121,11 @@ class ShardedStoreManager(KeyColumnValueStoreManager):
             key_consistent=True,
             distributed=True,
             persists=any(m.features.persists for m in self.nodes),
+            # a composite over network clients crosses the trust boundary
+            # wherever any node does (drives the allow-pickle=auto guard)
+            network_attached=any(
+                m.features.network_attached for m in self.nodes
+            ),
         )
 
     @property

@@ -110,3 +110,32 @@ def test_batched_import_streams(tmp_path):
     assert g2.traversal().E().count() == 24
     g.close()
     g2.close()
+
+
+def test_partial_import_reports_committed_counts():
+    """A malformed record mid-file aborts the import, but earlier batches
+    are already durable — the exception carries the committed counts so
+    callers can detect and clean up (core/io.py docstring contract)."""
+    import io as _io
+    import json
+
+    import pytest
+
+    from janusgraph_tpu.core.graph import open_graph
+
+    lines = [
+        json.dumps({"kind": "vertex", "original_id": i, "label": "vertex",
+                    "properties": []})
+        for i in range(5)
+    ]
+    lines.append(json.dumps({"kind": "edge", "label": "x",
+                             "out": 999, "in": 998, "properties": {}}))
+    g = open_graph({"storage.backend": "inmemory"})
+    with pytest.raises(ValueError, match="unknown vertex") as ei:
+        import_graphson(g, _io.StringIO("\n".join(lines)), batch_size=2)
+    # batches of 2: 4 vertices durably committed before the bad edge
+    assert ei.value.committed == {"vertices": 4, "edges": 0}
+    tx = g.new_transaction()
+    assert sum(1 for _ in tx.vertices()) == 4
+    tx.rollback()
+    g.close()

@@ -350,3 +350,14 @@ def test_compute_traverse_filtered_facade(g):
             (TraversalStep("out", None,
                            (PropertyFilter("age", Cmp.GREATER_THAN, 1),)),)
         )
+
+
+def test_program_supersedes_earlier_traverse(g):
+    """compute().traverse(...).program(p) runs p — program() must clear the
+    deferred traverse spec, not let submit() silently rebuild over it."""
+    from janusgraph_tpu.olap.programs.pagerank import PageRankProgram
+
+    c = g.compute(executor="cpu").traverse(("out", ["father"]))
+    c.program(PageRankProgram(max_iterations=3))
+    res = c.submit()
+    assert "rank" in res.states and "count" not in res.states

@@ -210,3 +210,38 @@ def test_parallel_multi_slice_fanout():
         par.close()
         ser.close()
         server.stop()
+
+
+def test_remote_graph_refuses_pickle_by_default():
+    """attributes.allow-pickle=auto disables object-pickle frames over a
+    remote store (a compromised peer must not execute code on read) but
+    keeps them for in-process graphs; 'true' opts back in explicitly."""
+    from janusgraph_tpu.core.graph import open_graph
+    from janusgraph_tpu.core.attributes import SerializerError
+
+    server = RemoteStoreServer(InMemoryStoreManager()).start()
+    host, port = server.address
+    remote_cfg = {
+        "storage.backend": "remote",
+        "storage.hostname": host,
+        "storage.port": port,
+    }
+    g = open_graph(remote_cfg)
+    assert not g.serializer.allow_pickle
+    with pytest.raises(SerializerError, match="fallback disabled"):
+        g.serializer.write_object(complex(1, 2))
+    g.close()
+
+    g = open_graph(dict(remote_cfg, **{"attributes.allow-pickle": "true"}))
+    assert g.serializer.allow_pickle
+    g.close()
+    server.stop()
+
+    local = open_graph({"storage.backend": "inmemory"})
+    assert local.serializer.allow_pickle
+    local.close()
+    forced = open_graph({
+        "storage.backend": "inmemory", "attributes.allow-pickle": "false",
+    })
+    assert not forced.serializer.allow_pickle
+    forced.close()

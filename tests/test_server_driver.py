@@ -439,3 +439,53 @@ def test_driver_geoshape_round_trips_all_kinds():
     for s in shapes:
         assert graphson_loads(graphson_dumps(s)) == s, s.kind
         assert binary_loads(binary_dumps(s)) == s, s.kind
+
+
+def test_graphson_direction_roundtrip():
+    """elementMap endpoint keys ride the wire as g:Direction (GraphSON 3.0
+    DirectionSerializer), not degraded g:Int64 0/1."""
+    from janusgraph_tpu.core.codecs import Direction
+    from janusgraph_tpu.driver.graphson import graphson_dumps, graphson_loads
+
+    m = {Direction.OUT: {"id": 1}, Direction.IN: {"id": 2}, "label": "x"}
+    wire = graphson_dumps(m)
+    assert '"g:Direction"' in wire and '"OUT"' in wire
+    back = graphson_loads(wire)
+    assert back[Direction.OUT] == {"id": 1} and back[Direction.IN] == {"id": 2}
+
+
+def test_graphbinary_direction_roundtrip():
+    from janusgraph_tpu.core.codecs import Direction
+    from janusgraph_tpu.driver.graphbinary import binary_dumps, binary_loads
+
+    m = {Direction.OUT: {"id": 1}, Direction.IN: {"id": 2}, "label": "x"}
+    back = binary_loads(binary_dumps(m))
+    assert back[Direction.OUT] == {"id": 1} and back[Direction.IN] == {"id": 2}
+    assert isinstance(next(iter(back)), Direction)
+
+
+def test_sharded_composite_of_remote_nodes_refuses_pickle():
+    """network_attached propagates through the sharded composite, so
+    allow-pickle=auto stays off when any node is a network client."""
+    from janusgraph_tpu.storage.inmemory import InMemoryStoreManager
+    from janusgraph_tpu.storage.remote import (
+        RemoteStoreManager,
+        RemoteStoreServer,
+    )
+    from janusgraph_tpu.storage.sharded_store import ShardedStoreManager
+
+    servers = [RemoteStoreServer(InMemoryStoreManager()).start()
+               for _ in range(2)]
+    addrs = [s.address for s in servers]
+    mgr = ShardedStoreManager(
+        num_nodes=2, node_factory=lambda i: RemoteStoreManager(
+            host=addrs[i][0], port=addrs[i][1]),
+    )
+    assert mgr.features.network_attached
+    from janusgraph_tpu.core.graph import open_graph
+
+    g = open_graph({"storage.backend": "inmemory"}, store_manager=mgr)
+    assert not g.serializer.allow_pickle
+    g.close()
+    for s in servers:
+        s.stop()

@@ -244,7 +244,10 @@ def test_element_map(g):
         .out_e("battled").element_map().to_list()[0]
     )
     assert em["label"] == "battled"
-    assert em["OUT"]["label"] == "demigod" and em["IN"]["label"] == "monster"
+    from janusgraph_tpu.core.codecs import Direction
+
+    assert em[Direction.OUT]["label"] == "demigod"
+    assert em[Direction.IN]["label"] == "monster"
     # non-element traversers refuse loudly
     with pytest.raises(QueryError, match="element_map"):
         g.traversal().V().values("name").element_map().to_list()
