@@ -381,7 +381,9 @@ def _cached_rmat_csr(scale, edge_factor, t0):
     return csr
 
 
-def _bench_scale(jax, platform, scale, edge_factor, pr_iters, strategy, t0):
+def _bench_scale(
+    jax, platform, scale, edge_factor, pr_iters, strategy, t0, extras_scale
+):
     """One ladder rung: generate, transfer, compile, run, report."""
     import numpy as np
 
@@ -508,13 +510,13 @@ def _bench_scale(jax, platform, scale, edge_factor, pr_iters, strategy, t0):
     # (phase-alternating -> host-loop path), and the 3-hop
     # TraversalVertexProgram-analogue count. Gated so the budget cost is
     # bounded; compile cache amortizes re-runs.
-    # On the CPU FALLBACK the extras only fire when BENCH_EXTRAS_SCALE is
-    # explicitly set — the s20 peer-pressure compile alone runs minutes on
-    # host XLA and would eat the whole fallback reserve (measured round 4).
-    extras_env = os.environ.get("BENCH_EXTRAS_SCALE")
-    if scale == int(extras_env or "20") and (
-        platform == "tpu" or extras_env is not None
-    ):
+    # On the CPU FALLBACK the extras run at the CHEAP rung (s16) instead of
+    # being skipped, so all five BASELINE workload shapes still produce
+    # numbers (VERDICT r4 weak #5) — the s20 peer-pressure compile alone
+    # runs minutes on host XLA and would eat the whole fallback reserve
+    # (measured round 4), but s16 fits. The rung is chosen (and clamped)
+    # once in worker() and passed in.
+    if scale == extras_scale:
         from janusgraph_tpu.olap.programs import (
             ConnectedComponentsProgram,
             PeerPressureProgram,
@@ -742,11 +744,20 @@ def worker() -> None:
         scales = [int(os.environ["BENCH_SCALE"])]
     else:
         scales = [16, 20, 22, 23]
+    # the one rung where the BASELINE workload extras fire (computed HERE,
+    # passed down — the worker's clamping and _bench_scale's gate must
+    # agree or the extras silently never run)
+    extras_env = os.environ.get("BENCH_EXTRAS_SCALE")
     if platform == "cpu":
-        # clamp the ladder to the CPU cap and run just the largest rung
-        # frontier BFS + lazy transfer made s20 cheap even on host
+        # clamp the ladder to the CPU cap: the cheap extras rung (s16,
+        # where the five BASELINE workload shapes run — see _bench_scale)
+        # plus the largest affordable pagerank rung. Frontier BFS + lazy
+        # transfer made s20 cheap even on host.
         cap = int(os.environ.get("BENCH_CPU_SCALE", "20"))
-        scales = [min(max(scales), cap)]
+        extras_scale = min(int(extras_env) if extras_env else 16, cap)
+        scales = sorted({extras_scale, min(max(scales), cap)})
+    else:
+        extras_scale = int(extras_env) if extras_env else 20
     edge_factor = int(os.environ.get("BENCH_EDGE_FACTOR", "16"))
     pr_iters = int(os.environ.get("PR_ITERS", "20"))
     strategy = os.environ.get("BENCH_STRATEGY", "auto")
@@ -754,7 +765,8 @@ def worker() -> None:
     for scale in scales:
         try:
             _bench_scale(
-                jax, platform, scale, edge_factor, pr_iters, strategy, t0
+                jax, platform, scale, edge_factor, pr_iters, strategy, t0,
+                extras_scale,
             )
         except Exception as e:  # report and stop climbing
             _hb(f"s{scale}: FAILED {type(e).__name__}: {e}", t0)
@@ -765,6 +777,18 @@ def worker() -> None:
                 "error": f"{type(e).__name__}: {e}"[:500],
             })
             break
+
+    # OLTP micro-bench: host-side, platform-independent, bounded by the
+    # edge cap (~10-20s for both backends)
+    if os.environ.get("BENCH_OLTP", "1") != "0":
+        try:
+            _oltp_stage(t0)
+        except Exception as e:
+            _hb(f"oltp stage FAILED {type(e).__name__}: {e}", t0)
+            _emit({
+                "stage": "oltp", "ok": False,
+                "error": f"{type(e).__name__}: {e}"[:500],
+            })
 
     # pallas kernel evidence (VERDICT r2 #5): compiled run at s16 with
     # parity vs the ell result; failure is recorded, not fatal. The stage
@@ -798,6 +822,107 @@ def worker() -> None:
                 "error": f"{type(e).__name__}: {e}"[:500],
             })
         done.set()
+
+
+def _oltp_stage(t0):
+    """OLTP throughput micro-bench (VERDICT r4 #7): tx-path batched addEdge
+    commits/s and multiQuery reads/s on the inmemory and remote backends.
+    The reference publishes no OLTP numbers (SURVEY §6) — this establishes
+    the framework's own regression baseline. Reference hot loops:
+    StandardJanusGraph.java:674-830 (commit), StandardJanusGraphTx.java:1118
+    (multiQuery). Host-side pure-Python: platform-independent, so it runs
+    on the CPU fallback too."""
+    import numpy as np
+
+    from janusgraph_tpu.core.codecs import Direction
+    from janusgraph_tpu.core.graph import open_graph
+    from janusgraph_tpu.olap.generators import rmat_csr
+
+    scale = int(os.environ.get("BENCH_OLTP_SCALE", "16"))
+    edge_cap = int(os.environ.get("BENCH_OLTP_EDGE_CAP", "100000"))
+    batch = 5000
+    csr = rmat_csr(scale, 16)
+    src = np.repeat(
+        np.arange(csr.num_vertices), np.diff(csr.out_indptr)
+    )[:edge_cap]
+    dst = csr.out_dst[:edge_cap]
+
+    def _measure(backend_name, cfg):
+        g = open_graph(cfg)
+        g.management().make_edge_label("knows")
+        v0 = time.perf_counter()
+        tx = g.new_transaction()
+        ids = [tx.add_vertex().id for _ in range(csr.num_vertices)]
+        tx.commit()
+        vertex_s = time.perf_counter() - v0
+
+        e0 = time.perf_counter()
+        commits = 0
+        pending = 0
+        tx = g.new_transaction()
+        for i in range(len(src)):
+            sv = tx.get_vertex(ids[src[i]])
+            dv = tx.get_vertex(ids[dst[i]])
+            tx.add_edge(sv, "knows", dv)
+            pending += 1
+            if pending == batch:
+                tx.commit()
+                commits += 1
+                pending = 0
+                tx = g.new_transaction()
+        if pending:
+            tx.commit()
+            commits += 1
+        else:
+            tx.rollback()
+        edge_s = time.perf_counter() - e0
+
+        rng = np.random.default_rng(0)
+        sample = rng.choice(ids, size=2000, replace=False)
+        q0 = time.perf_counter()
+        tx = g.new_transaction()
+        vs = [tx.get_vertex(int(i)) for i in sample]
+        tx.prefetch(vs, Direction.OUT, ("knows",))  # the multiQuery batch
+        edges_read = 0
+        for v in vs:
+            edges_read += sum(
+                1 for _ in tx.get_edges(v, Direction.OUT, ("knows",))
+            )
+        query_s = time.perf_counter() - q0
+        tx.rollback()
+        g.close()
+        line = {
+            "stage": "oltp", "backend": backend_name, "scale": scale,
+            "vertices": csr.num_vertices, "edges_written": len(src),
+            "commit_batch": batch,
+            "add_vertex_per_s": round(csr.num_vertices / vertex_s, 1),
+            "add_edge_per_s": round(len(src) / edge_s, 1),
+            "commits_per_s": round(commits / edge_s, 2),
+            "multiquery_vertices_per_s": round(len(vs) / query_s, 1),
+            "multiquery_edges_read": edges_read,
+        }
+        _hb(
+            f"oltp[{backend_name}]: {line['add_edge_per_s']:.0f} addEdge/s "
+            f"{line['commits_per_s']:.1f} commits/s "
+            f"{line['multiquery_vertices_per_s']:.0f} mq-vertices/s", t0,
+        )
+        _emit(line)
+
+    _measure("inmemory", {"storage.backend": "inmemory"})
+
+    from janusgraph_tpu.storage.inmemory import InMemoryStoreManager
+    from janusgraph_tpu.storage.remote import RemoteStoreServer
+
+    server = RemoteStoreServer(InMemoryStoreManager()).start()
+    host, port = server.address
+    try:
+        _measure("remote", {
+            "storage.backend": "remote",
+            "storage.hostname": host,
+            "storage.port": port,
+        })
+    finally:
+        server.stop()
 
 
 def _pallas_stage(jax, pr_iters, t0):

@@ -1,0 +1,34 @@
+#!/bin/bash
+# TPU tunnel watcher (round 5): retry the bench ladder until a TPU stage
+# lands or the hard deadline passes. Artifacts land in bench_artifacts/.
+# Kill cleanly:  touch /tmp/tpu_watch.stop   (checked between attempts)
+# Never leaves a worker running past its per-attempt timeout.
+set -u
+REPO=/root/repo
+OUT=$REPO/bench_artifacts
+mkdir -p "$OUT"
+rm -f /tmp/tpu_watch.stop   # a stale stop file must not kill a fresh launch
+DEADLINE=$(( $(date +%s) + ${TPU_WATCH_BUDGET_S:-30600} ))   # default 8.5h
+ATTEMPT=0
+echo $$ > /tmp/tpu_watch.pid
+while [ "$(date +%s)" -lt "$DEADLINE" ] && [ ! -f /tmp/tpu_watch.stop ]; do
+  ATTEMPT=$((ATTEMPT + 1))
+  LOG=$OUT/r5_watch_attempt${ATTEMPT}.log
+  JSONL=$OUT/r5_watch_attempt${ATTEMPT}.jsonl
+  echo "[tpu_watch] attempt $ATTEMPT $(date -u +%H:%M:%S)" >> "$OUT/r5_watch.log"
+  PYTHONPATH=/root/.axon_site:$REPO \
+    BENCH_INIT_TIMEOUT_S=${TPU_WATCH_INIT_S:-1500} \
+    BENCH_WORKER_BUDGET_S=3600 \
+    timeout 3900 python "$REPO/bench.py" --worker > "$JSONL" 2> "$LOG"
+  rc=$?
+  echo "[tpu_watch] attempt $ATTEMPT exit=$rc" >> "$OUT/r5_watch.log"
+  if grep -q '"platform": "tpu"' "$JSONL" 2>/dev/null; then
+    cp "$JSONL" "$OUT/r5_tpu_ladder.json"
+    echo "[tpu_watch] TPU STAGES LANDED -> r5_tpu_ladder.json" >> "$OUT/r5_watch.log"
+    break
+  fi
+  rm -f "$JSONL"  # keep logs, drop empty jsonl
+  sleep "${TPU_WATCH_SLEEP_S:-600}"
+done
+rm -f /tmp/tpu_watch.pid
+echo "[tpu_watch] done $(date -u +%H:%M:%S)" >> "$OUT/r5_watch.log"

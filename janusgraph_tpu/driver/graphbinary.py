@@ -30,20 +30,10 @@ def _w_str(s: str) -> bytes:
     return _U32.pack(len(b)) + b
 
 
-_DIRECTION = None
-
-
-def _is_direction(obj: Any) -> bool:
-    # lazily cached: runs per encoded value (see graphson._direction_cls)
-    global _DIRECTION
-    if _DIRECTION is None:
-        from janusgraph_tpu.core.codecs import Direction
-
-        _DIRECTION = Direction
-    return isinstance(obj, _DIRECTION)
 
 
 def _encode(obj: Any, out: bytearray) -> None:
+    from janusgraph_tpu.core.codecs import Direction
     from janusgraph_tpu.core.elements import Edge, Vertex
 
     if obj is None:
@@ -51,7 +41,7 @@ def _encode(obj: Any, out: bytearray) -> None:
     elif isinstance(obj, bool):
         out.append(0x04)
         out.append(1 if obj else 0)
-    elif _is_direction(obj):
+    elif isinstance(obj, Direction):
         # before the int branch: Direction is an IntEnum (elementMap
         # endpoint keys must round-trip typed, like GraphSON g:Direction)
         out.append(0x06)

@@ -22,23 +22,10 @@ from typing import Any
 
 from janusgraph_tpu.driver.relation_identifier import RelationIdentifier
 
-_DIRECTION = None
-
-
-def _direction_cls():
-    # lazily cached: the isinstance check runs per encoded value, and the
-    # driver must not import core modules until such objects can flow
-    global _DIRECTION
-    if _DIRECTION is None:
-        from janusgraph_tpu.core.codecs import Direction
-
-        _DIRECTION = Direction
-    return _DIRECTION
-
-
 def _encode(obj: Any):
     # lazy import: the driver must not depend on server-side storage modules
     # unless elements actually flow through
+    from janusgraph_tpu.core.codecs import Direction
     from janusgraph_tpu.core.elements import Edge, Vertex, VertexProperty
 
     if obj is None or isinstance(obj, bool):
@@ -49,7 +36,7 @@ def _encode(obj: Any):
         if isinstance(obj, Char):  # str subclass — must stay typed
             return {"@type": "janusgraph:Char", "@value": str(obj)}
         return obj
-    if isinstance(obj, _direction_cls()):
+    if isinstance(obj, Direction):
         # before the int branch: Direction is an IntEnum, and TinkerPop
         # GraphSON 3.0 ships it typed (elementMap endpoint keys)
         return {"@type": "g:Direction", "@value": obj.name}
