@@ -48,6 +48,44 @@ def _tier(need: int, lo: int, hi: int) -> int:
     return min(c, hi)
 
 
+def capped_expand(jnp, idx, indptr, dst, E_cap, sentinel):
+    """Capped frontier expansion: frontier rows -> (owner slot, edge pos,
+    neighbor, valid) buffers of static length E_cap. Shared by the
+    single-chip and sharded engines (the sharded CSC is over message-table
+    slots with a local-destination sentinel; here over vertices).
+
+    own/pos come from scatter+cumsum over the *frontier-sized* start
+    offsets (telescoping piecewise-constant encoding) — per-slot cost is
+    two vector cumsums plus ONE m-table gather (dst), instead of a
+    log(F)-deep searchsorted gather chain. Requires total edges < 2^31
+    (int32 telescoping headroom; callers guard at MAX_EDGES = 2^30).
+    """
+    F_cap = idx.shape[0]
+    starts = indptr[idx]
+    degs = indptr[idx + 1] - starts
+    cum = jnp.cumsum(degs)
+    total = cum[-1]
+    cum_ex = cum - degs
+    # ownership: +1 at each row's first slot (row 0 starts at owner 0);
+    # deg-0 rows collapse onto the next row's start and the scatter-adds
+    # accumulate, so cumsum lands on the LAST row covering a slot
+    inc = jnp.ones((F_cap,), jnp.int32).at[0].set(0)
+    own = jnp.cumsum(
+        jnp.zeros((E_cap,), jnp.int32).at[cum_ex].add(inc, mode="drop")
+    )
+    # edge position: pos[s] = s + (starts - cum_ex)[own[s]], encoded the
+    # same way (scatter the base DIFFS, cumsum telescopes them)
+    base = starts - cum_ex
+    dbase = jnp.concatenate([base[:1], jnp.diff(base)])
+    pos = jnp.arange(E_cap, dtype=jnp.int32) + jnp.cumsum(
+        jnp.zeros((E_cap,), jnp.int32).at[cum_ex].add(dbase, mode="drop")
+    )
+    valid = jnp.arange(E_cap, dtype=jnp.int32) < total
+    pos = jnp.clip(pos, 0, dst.shape[0] - 1)
+    nbr = jnp.where(valid, dst[pos], jnp.int32(sentinel))
+    return own, pos, nbr, valid
+
+
 class FrontierEngine:
     """Per-executor engine: owns the device-resident CSR pointer arrays and
     the tier-compiled step executables for ShortestPath-family programs."""
@@ -135,39 +173,9 @@ class FrontierEngine:
 
     # ------------------------------------------------------------------ step
     def _expand(self, idx, indptr, dst, E_cap):
-        """Capped frontier expansion: frontier rows -> (owner slot, edge pos,
-        neighbor, valid) buffers of static length E_cap.
-
-        own/pos come from scatter+cumsum over the *frontier-sized* start
-        offsets (telescoping piecewise-constant encoding) — per-slot cost is
-        two vector cumsums plus ONE m-table gather (dst), instead of a
-        log(F)-deep searchsorted gather chain.
-        """
-        jnp = self.jnp
-        F_cap = idx.shape[0]
-        starts = indptr[idx]
-        degs = indptr[idx + 1] - starts
-        cum = jnp.cumsum(degs)
-        total = cum[-1]
-        cum_ex = cum - degs
-        # ownership: +1 at each row's first slot (row 0 starts at owner 0);
-        # deg-0 rows collapse onto the next row's start and the scatter-adds
-        # accumulate, so cumsum lands on the LAST row covering a slot
-        inc = jnp.ones((F_cap,), jnp.int32).at[0].set(0)
-        own = jnp.cumsum(
-            jnp.zeros((E_cap,), jnp.int32).at[cum_ex].add(inc, mode="drop")
-        )
-        # edge position: pos[s] = s + (starts - cum_ex)[own[s]], encoded the
-        # same way (scatter the base DIFFS, cumsum telescopes them)
-        base = starts - cum_ex
-        dbase = jnp.concatenate([base[:1], jnp.diff(base)])
-        pos = jnp.arange(E_cap, dtype=jnp.int32) + jnp.cumsum(
-            jnp.zeros((E_cap,), jnp.int32).at[cum_ex].add(dbase, mode="drop")
-        )
-        valid = jnp.arange(E_cap, dtype=jnp.int32) < total
-        pos = jnp.clip(pos, 0, dst.shape[0] - 1)
-        nbr = jnp.where(valid, dst[pos], jnp.int32(self.n))
-        return own, pos, nbr, valid
+        """See capped_expand (module level; shared with the sharded
+        engine): sentinel = n, the dead scatter slot."""
+        return capped_expand(self.jnp, idx, indptr, dst, E_cap, self.n)
 
     def _step_fn(self, F_cap, E_cap, weighted, track_paths, undirected):
         key = ("frontier-step", F_cap, E_cap, weighted, track_paths, undirected)
