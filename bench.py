@@ -598,6 +598,43 @@ def _bench_scale(
                 "filter_selectivity": round(float(fmask.mean()), 3),
             },
         )
+        # path()-carrying OLAP traversal (VERDICT r4 #4): device reach
+        # masks + host backward enumeration, seeded (full-V 3-hop path
+        # enumeration is combinatorial; the count sum prices it)
+        from janusgraph_tpu.olap.programs.olap_traversal import (
+            enumerate_paths,
+        )
+
+        rng_p = np.random.default_rng(7)
+        pseeds = tuple(
+            int(s) for s in rng_p.choice(csr.num_vertices, 8, replace=False)
+        )
+        prog_p = OLAPTraversalProgram(
+            (TraversalStep("out"), TraversalStep("out"),
+             TraversalStep("out")),
+            seed_indices=pseeds, record_reach=True,
+        )
+        ex.run(prog_p)
+        r0 = time.perf_counter()
+        res_p = ex.run(prog_p)
+        device_wall = round(time.perf_counter() - r0, 3)
+        r0 = time.perf_counter()
+        sample = list(enumerate_paths(csr, prog_p, res_p, limit=10_000))
+        enum_wall = round(time.perf_counter() - r0, 3)
+        _hb(f"s{scale}: paths_3hop device {device_wall}s "
+            f"enum[{len(sample)}] {enum_wall}s", t0)
+        _emit({
+            "stage": "workload", "workload": "paths_3hop_seeded",
+            "platform": platform, "scale": scale,
+            "wall_s": device_wall, "enum_wall_s": enum_wall,
+            "seeds": len(pseeds), "paths_enumerated": len(sample),
+            # f64 accumulator; per-vertex f32 counts cap exactness at 2^24
+            # per vertex — beyond that the total is an estimate
+            "paths_total": float(
+                np.asarray(res_p["count"], np.float64).sum()
+            ),
+        })
+
         # LDBC-SNB-shaped proxy (BASELINE configs #2/#5 datasets): CC +
         # filtered 3-hop on a community-structured heavy-tail graph, one
         # scale below the R-MAT rung (same |E| order)

@@ -26,6 +26,43 @@ class ComputerResult:
     #: map-reduce results keyed by each job's memory_key (reference:
     #: FulgoraMemory holding MapReduce side-effect keys)
     memory: Dict[str, object] = field(default_factory=dict)
+    #: the program that produced `states` (path()/select() terminals)
+    program: object = None
+    #: name of path position 0 for select() (compute().traverse(source_as=))
+    source_as: object = None
+
+    def paths(self, limit=None):
+        """Enumerate traverser paths (tuples of vertex ids, seed first) —
+        requires compute().traverse(..., paths=True). Lazy generator;
+        pass `limit` on dense graphs (path counts explode — the device
+        count sum prices the enumeration: states['count'].sum())."""
+        from janusgraph_tpu.olap.programs.olap_traversal import (
+            enumerate_paths,
+        )
+
+        if "reach" not in self.states:
+            raise ValueError(
+                "no reach masks recorded — run "
+                "compute().traverse(..., paths=True)"
+            )
+        return enumerate_paths(self.csr, self.program, self.states, limit)
+
+    def select(self, *names, limit=None):
+        """Project as()-labeled path positions (TinkerPop SelectStep shape):
+        yields {name: vertex_id} dicts. Label steps via 4-tuple spec items
+        ('out', labels, filters, 'b'); name the source with
+        traverse(source_as='a')."""
+        from janusgraph_tpu.olap.programs.olap_traversal import select_paths
+
+        if "reach" not in self.states:
+            raise ValueError(
+                "no reach masks recorded — run "
+                "compute().traverse(..., paths=True)"
+            )
+        return select_paths(
+            self.csr, self.program, self.states, names,
+            source_as=self.source_as, limit=limit,
+        )
 
     def value(self, key: str, vertex_id: int) -> float:
         return float(self.states[key][self.csr.index_of(vertex_id)])
@@ -91,17 +128,24 @@ class GraphComputer:
         self._traverse_args = None
         return self
 
-    def traverse(self, *spec, seed_filters=None) -> "GraphComputer":
+    def traverse(
+        self, *spec, seed_filters=None, paths=False, source_as=None,
+    ) -> "GraphComputer":
         """OLAP traversal shortcut (the TraversalVertexProgram analogue):
         compute().traverse(("out", ["knows"]), ("in", None)).submit() counts
         traversers per vertex; result.states["count"].sum() is the terminal
         count (reference: BASELINE config #5). Spec items may carry has()-
         filters — ("out", ["knows"], [("age", Cmp.GREATER_THAN, 30)]) — and
         `seed_filters` restricts the start set; filter masks are built from
-        the CSR snapshot at submit() (build_olap_traversal)."""
+        the CSR snapshot at submit() (build_olap_traversal).
+
+        `paths=True` additionally records per-step reach masks device-side
+        so the result supports `.paths()` / `.select()` (host traverser
+        bookkeeping; olap_traversal.enumerate_paths). `source_as` names
+        path position 0 for select()."""
         # defer program construction to submit(): filter masks need the
         # loaded CSR's property columns
-        self._traverse_args = (spec, seed_filters)
+        self._traverse_args = (spec, seed_filters, paths, source_as)
         self._program = None
         return self
 
@@ -116,7 +160,7 @@ class GraphComputer:
                 steps_from_spec,
             )
 
-            spec, seed_filters = traverse_args
+            spec, seed_filters, _paths, _src_as = traverse_args
             fkeys = {f.key for f in _parse_filters(seed_filters)}
             for st in steps_from_spec(self.graph, spec):
                 fkeys.update(f.key for f in st.filters)
@@ -136,9 +180,10 @@ class GraphComputer:
                 build_olap_traversal,
             )
 
-            spec, seed_filters = traverse_args
+            spec, seed_filters, want_paths, source_as = traverse_args
             self._program = build_olap_traversal(
-                self.graph, csr, spec, seed_filters=seed_filters
+                self.graph, csr, spec, seed_filters=seed_filters,
+                record_reach=want_paths,
             )
         cfg = getattr(self.graph, "config", None)
         run_kwargs = {}
@@ -162,7 +207,11 @@ class GraphComputer:
             for mr in self._map_reduces:
                 memory[mr.memory_key] = run_map_reduce(mr, states, csr)
         return ComputerResult(
-            states=states, csr=csr, graph=self.graph, memory=memory
+            states=states, csr=csr, graph=self.graph, memory=memory,
+            program=self._program,
+            source_as=(
+                traverse_args[3] if traverse_args is not None else None
+            ),
         )
 
 

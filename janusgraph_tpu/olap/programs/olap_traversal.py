@@ -56,6 +56,9 @@ class TraversalStep:
     direction: str = "out"
     labels: Optional[Tuple[int, ...]] = None
     filters: Tuple[PropertyFilter, ...] = ()
+    #: step label for select() over enumerated paths (the as() tag of
+    #: TinkerPop; reference: TraversalVertexProgram carrying path labels)
+    as_label: Optional[str] = None
 
     def __post_init__(self):
         if self.direction not in ("out", "in", "both"):
@@ -82,16 +85,20 @@ def steps_from_spec(graph, spec: Sequence) -> Tuple[TraversalStep, ...]:
       'out'                                  — expand, all labels
       ('out', ['knows'])                     — expand along labels
       ('out', ['knows'], [(key, pred, v)])   — expand, then has()-filter
+      ('out', ['knows'], [...], 'b')         — ... and as('b')-tag the step
     """
     out = []
     for item in spec:
         filters = ()
+        as_label = None
         if isinstance(item, str):
             direction, labels = item, None
         elif len(item) == 2:
             direction, labels = item
-        else:
+        elif len(item) == 3:
             direction, labels, filters = item
+        else:
+            direction, labels, filters, as_label = item
         ids = None
         if labels:
             ids = []
@@ -103,7 +110,9 @@ def steps_from_spec(graph, spec: Sequence) -> Tuple[TraversalStep, ...]:
                     raise ValueError(f"unknown edge label {name!r}")
                 ids.append(el.id)
             ids = tuple(ids)
-        out.append(TraversalStep(direction, ids, _parse_filters(filters)))
+        out.append(
+            TraversalStep(direction, ids, _parse_filters(filters), as_label)
+        )
     return tuple(out)
 
 
@@ -166,6 +175,7 @@ class OLAPTraversalProgram(VertexProgram):
         seed_indices=None,
         seed_mask=None,
         step_masks=None,
+        record_reach: bool = False,
     ):
         """`seed_mask`: (n,) {0,1} array filtering the start set (the
         g.V().has(...) head). `step_masks`: (n, S) array, column k the
@@ -193,6 +203,11 @@ class OLAPTraversalProgram(VertexProgram):
         self._seed_mask = seed_mask
         self._step_masks = step_masks
         self.has_step_masks = step_masks is not None
+        #: device-side half of path()/select(): record, per superstep, the
+        #: {0,1} mask of vertices holding >=1 traverser — the per-level
+        #: reachability host enumeration walks backward over
+        #: (enumerate_paths; SURVEY §7 hard part (a)'s hybrid design)
+        self.record_reach = record_reach
         self.max_iterations = len(self.steps)
         # one named channel per step; labels=None channels still express
         # per-step direction through the same machinery
@@ -220,6 +235,13 @@ class OLAPTraversalProgram(VertexProgram):
             state["step_masks"] = self._slice_local(
                 self._step_masks, graph, xp
             )
+        if self.record_reach:
+            # column k = mask after step k (column 0: the seed set)
+            ncols = len(self.steps) + 1
+            reach = xp.zeros((n, ncols), dtype=count.dtype)
+            onehot = (xp.arange(ncols) == 0).astype(count.dtype)
+            reach = reach + (count > 0).astype(count.dtype)[:, None] * onehot
+            state["reach"] = reach
         return state, {}
 
     @staticmethod
@@ -251,6 +273,18 @@ class OLAPTraversalProgram(VertexProgram):
             col = xp.clip(superstep, 0, masks.shape[1] - 1)
             new["count"] = aggregated * masks[:, col]
             new["step_masks"] = masks
+        if self.record_reach:
+            # one-hot column write (xp-agnostic: no .at[] in numpy) —
+            # column superstep+1 becomes this step's arrival mask
+            reach = state["reach"]
+            ncols = reach.shape[1]
+            col1 = xp.clip(superstep, 0, ncols - 2) + 1
+            onehot = (xp.arange(ncols) == col1).astype(reach.dtype)
+            arrived = (new["count"] > 0).astype(reach.dtype)
+            new["reach"] = (
+                reach * (1.0 - onehot)[None, :]
+                + arrived[:, None] * onehot[None, :]
+            )
         return new, {}
 
     def terminate(self, memory):
@@ -263,6 +297,7 @@ def build_olap_traversal(
     spec: Sequence,
     seeds=None,
     seed_filters=None,
+    record_reach: bool = False,
 ) -> "OLAPTraversalProgram":
     """Compile a filtered traversal spec against a CSR snapshot:
     `g.V().has(seed_filters...).out(...).has(...)...` as one BSP program
@@ -292,7 +327,94 @@ def build_olap_traversal(
         seed_indices=seed_indices,
         seed_mask=seed_mask,
         step_masks=step_masks,
+        record_reach=record_reach,
     )
+
+
+def enumerate_paths(csr, program, states, limit=None):
+    """Host half of OLAP path(): lazily enumerate the traverser paths of a
+    `record_reach` run, as tuples of GRAPH vertex ids (seed first).
+
+    Hybrid design (SURVEY §7 hard part (a); reference:
+    FulgoraGraphComputer.java:155 shipping TraversalVertexProgram with
+    per-traverser path objects): the DEVICE ran the frontier expansion and
+    recorded per-step reach masks — exact reachability, counts > 0 — and
+    the HOST walks them backward over each step's edge view. A backward
+    neighbor u of v at level k-1 with reach[u, k-1] set lies on a real
+    seed-to-v path, so the DFS emits exactly the OLTP traverser paths
+    (parallel edges yield one path per edge instance, like OLTP
+    traversers). Cost is O(paths emitted) adjacency probes after an
+    O(E log E) per-step reverse-sort — independent of |V| once built.
+
+    Generator: bound it with `limit` (3-hop path counts explode on dense
+    graphs; the device-side `count` sum prices the enumeration first).
+    """
+    import numpy as np
+
+    from janusgraph_tpu.olap.csr import channel_edges
+
+    reach = np.asarray(states["reach"]) > 0          # (n, S+1)
+    S = len(program.steps)
+    n = csr.num_vertices
+    rev = []
+    for k in range(S):
+        src, dst, _w = channel_edges(
+            csr, program.edge_channels[f"s{k}"]
+        )
+        order = np.argsort(dst, kind="stable")
+        srcs = src[order]
+        indptr = np.zeros(n + 1, dtype=np.int64)
+        np.cumsum(np.bincount(dst, minlength=n), out=indptr[1:])
+        rev.append((indptr, srcs))
+    vids = csr.vertex_ids
+
+    def back(v, k):
+        if k == 0:
+            yield (v,)
+            return
+        indptr, srcs = rev[k - 1]
+        for u in srcs[indptr[v]: indptr[v + 1]]:
+            if reach[u, k - 1]:
+                for prefix in back(int(u), k - 1):
+                    yield prefix + (v,)
+
+    emitted = 0
+    if limit is not None and limit <= 0:
+        return
+    for v in np.nonzero(reach[:, S])[0]:
+        for p in back(int(v), S):
+            yield tuple(int(vids[i]) for i in p)
+            emitted += 1
+            if limit is not None and emitted >= limit:
+                return
+
+
+def select_paths(csr, program, states, names, source_as=None, limit=None):
+    """select() over enumerated paths: project the as()-labeled positions
+    of each path into a dict (reference: TinkerPop SelectStep consuming
+    step labels). `source_as` names path position 0 (the g.V() head)."""
+    positions = {}
+    if source_as is not None:
+        positions[source_as] = 0
+    for i, st in enumerate(program.steps):
+        if st.as_label is not None:
+            if st.as_label in positions:
+                # TinkerPop collects duplicated labels into lists; this
+                # projection is single-valued — refuse rather than
+                # silently dropping the earlier binding
+                raise ValueError(
+                    f"duplicate as()-label {st.as_label!r} — give each "
+                    "selected step a distinct label"
+                )
+            positions[st.as_label] = i + 1
+    missing = [nm for nm in names if nm not in positions]
+    if missing:
+        raise ValueError(
+            f"select() names {missing} match no as()-labeled step "
+            f"(labeled: {sorted(positions)})"
+        )
+    for p in enumerate_paths(csr, program, states, limit=limit):
+        yield {nm: p[positions[nm]] for nm in names}
 
 
 def group_count_by_label(graph, csr, counts) -> Dict[str, float]:

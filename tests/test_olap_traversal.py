@@ -361,3 +361,132 @@ def test_program_supersedes_earlier_traverse(g):
     c.program(PageRankProgram(max_iterations=3))
     res = c.submit()
     assert "rank" in res.states and "count" not in res.states
+
+
+# -------------------------------------------------------------------- paths
+# OLAP path()/select(): device reach masks + host backward enumeration
+# (olap_traversal.enumerate_paths; VERDICT r4 #4, SURVEY §7 hard part (a)).
+
+
+def oltp_paths(g, chain):
+    trav = g.traversal().V()
+    for direction, labels in chain:
+        trav = {"out": trav.out, "in": trav.in_, "both": trav.both}[
+            direction
+        ](*(labels or ()))
+    return sorted(
+        tuple(v.id for v in p) for p in trav.path().to_list()
+    )
+
+
+@pytest.mark.parametrize("chain", [
+    [("out", ["father"]), ("out", ["father"])],
+    [("out", ["battled"]), ("in", ["battled"]), ("out", ["father"])],
+    [("both", ["brother"]), ("out", ["lives"])],
+])
+def test_olap_paths_match_oltp_gods(g, chain):
+    res = g.compute(executor="cpu").traverse(
+        *[(d, l) for d, l in chain], paths=True
+    ).submit()
+    got = sorted(res.paths())
+    want = oltp_paths(g, chain)
+    assert got == want
+    # the device count prices the enumeration exactly
+    assert len(got) == int(np.asarray(res.states["count"]).sum())
+
+
+def test_olap_paths_random_graph_all_executors(mesh8):
+    from janusgraph_tpu.olap.csr import csr_from_edges
+    from janusgraph_tpu.olap.programs.olap_traversal import (
+        TraversalStep,
+        enumerate_paths,
+    )
+
+    rng = np.random.default_rng(17)
+    n, m = 60, 200
+    src = rng.integers(0, n, m).astype(np.int32)
+    dst = rng.integers(0, n, m).astype(np.int32)
+    csr = csr_from_edges(n, src, dst)
+    seeds = tuple(int(s) for s in rng.choice(n, 5, replace=False))
+    prog = OLAPTraversalProgram(
+        (TraversalStep("out"), TraversalStep("out"), TraversalStep("out")),
+        seed_indices=seeds, record_reach=True,
+    )
+    # numpy oracle: explicit 3-hop chain enumeration
+    adj = [[] for _ in range(n)]
+    for s, d in zip(src, dst):
+        adj[s].append(int(d))
+    want = sorted(
+        (a, b, c, d)
+        for a in seeds for b in adj[a] for c in adj[b] for d in adj[c]
+    )
+    for make in (
+        lambda: CPUExecutor(csr).run(prog),
+        lambda: TPUExecutor(csr).run(prog),
+        lambda: ShardedExecutor(csr, mesh=mesh8).run(prog),
+    ):
+        states = make()
+        got = sorted(enumerate_paths(csr, prog, states))
+        # vertex ids == indices for csr_from_edges-built graphs
+        assert got == want
+        assert len(got) == int(np.asarray(states["count"]).sum())
+
+
+def test_olap_paths_respect_filters(g):
+    """A mid-chain has()-filter (arrival-vertex property) must prune
+    enumerated paths exactly like the OLTP filter step."""
+    from janusgraph_tpu.core.predicates import Cmp
+    from janusgraph_tpu.core.traversal import P
+
+    res = g.compute(executor="cpu").traverse(
+        ("out", ["battled"], [("name", Cmp.NOT_EQUAL, "hydra")]),
+        ("in", ["battled"]),
+        paths=True,
+    ).submit()
+    got = sorted(res.paths())
+    trav = (
+        g.traversal().V().out("battled")
+        .has("name", P._of(Cmp.NOT_EQUAL, "hydra", "neq"))
+        .in_("battled").path().to_list()
+    )
+    want = sorted(tuple(v.id for v in p) for p in trav)
+    assert got == want and got  # non-empty: the filter prunes, not empties
+
+
+def test_olap_select_labeled_steps(g):
+    res = g.compute(executor="cpu").traverse(
+        ("out", ["father"], (), "f"),
+        ("out", ["father"], (), "gf"),
+        paths=True, source_as="me",
+    ).submit()
+    rows = sorted(
+        (d["me"], d["f"], d["gf"]) for d in res.select("me", "f", "gf")
+    )
+    assert rows == oltp_paths(
+        g, [("out", ["father"]), ("out", ["father"])]
+    )
+    with pytest.raises(ValueError, match="match no as"):
+        list(res.select("nope"))
+
+
+def test_olap_paths_limit_and_missing_reach(g):
+    res = g.compute(executor="cpu").traverse(
+        ("out", ["battled"]), ("in", ["battled"]), paths=True
+    ).submit()
+    all_paths = list(res.paths())
+    assert list(res.paths(limit=2)) == all_paths[:2]
+    plain = g.compute(executor="cpu").traverse(("out", ["father"])).submit()
+    with pytest.raises(ValueError, match="paths=True"):
+        plain.paths()
+
+
+def test_olap_paths_limit_zero_and_duplicate_label(g):
+    res = g.compute(executor="cpu").traverse(
+        ("out", ["father"]), paths=True
+    ).submit()
+    assert list(res.paths(limit=0)) == []
+    dup = g.compute(executor="cpu").traverse(
+        ("out", None, (), "x"), ("out", None, (), "x"), paths=True
+    ).submit()
+    with pytest.raises(ValueError, match="duplicate as"):
+        list(dup.select("x"))
