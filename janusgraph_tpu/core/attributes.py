@@ -820,12 +820,18 @@ def _framework_enums():
     from janusgraph_tpu.core.management import SchemaAction, SchemaStatus
     from janusgraph_tpu.core.txlog import LogTxStatus
     from janusgraph_tpu.indexing.provider import Mapping as IndexMapping
+    from janusgraph_tpu.storage.idauthority import ConflictAvoidanceMode
+    from janusgraph_tpu.util.timestamps import TimestampProviders
 
     return [
         (30, Direction), (31, RelationCategory), (32, Cardinality),
         (33, Multiplicity), (34, SchemaAction), (35, Mutability),
         (36, LogTxStatus), (37, IndexMapping), (48, SchemaStatus),
         (49, Consistency),
+        # user-visible config enums serialized into global config
+        # (reference: StandardSerializer.java:90-104 registering
+        # TimestampProviders + ConflictAvoidanceMode)
+        (50, TimestampProviders), (51, ConflictAvoidanceMode),
     ]
 
 

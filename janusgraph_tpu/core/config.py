@@ -171,6 +171,26 @@ IDS.option(
     "claim-verification wait for the consistent-key id authority", 0.5,
     Mutability.GLOBAL_OFFLINE,
 )
+IDS.option(
+    "authority.conflict-avoidance-mode", str,
+    "id-block claim contention avoidance (reference: "
+    "ConflictAvoidanceMode.java:76): none | local_manual | global_manual "
+    "| global_auto — tagged modes stripe the block space so allocators "
+    "never race on one claim key",
+    "none", Mutability.GLOBAL_OFFLINE,
+    lambda v: v in ("none", "local_manual", "global_manual", "global_auto"),
+)
+IDS.option(
+    "authority.conflict-avoidance-tag", int,
+    "this instance's claim tag for the manual conflict-avoidance modes",
+    0, Mutability.LOCAL, lambda v: v >= 0,
+)
+IDS.option(
+    "authority.conflict-avoidance-tag-bits", int,
+    "bits of claim-tag space (num tags = 2^bits); governs the id-space "
+    "striping factor of tagged modes",
+    4, Mutability.FIXED, lambda v: 0 < v <= 16,
+)
 CACHE.option("db-cache", bool, "enable the store-level slice cache", True)
 CACHE.option(
     "db-cache-size", int, "slice cache entry budget", 65536,
@@ -208,6 +228,15 @@ GRAPH.option(
     "unique-instance-id", str,
     "cluster-unique id of this open instance (auto-generated when empty)", "",
 )
+GRAPH.option(
+    "timestamps", str,
+    "resolution of storage-visible timestamps (reference: "
+    "TimestampProviders + graph.timestamps): nano | micro | milli — "
+    "stamped onto durable-log messages; coarser values trade ordering "
+    "granularity for cross-instance clock tolerance",
+    "nano", Mutability.GLOBAL_OFFLINE,
+    lambda v: v in ("nano", "micro", "milli"),
+)
 LOG_NS.option(
     "num-buckets", int, "write-parallelism buckets per log partition", 4,
     Mutability.GLOBAL_OFFLINE, lambda v: v > 0,
@@ -215,6 +244,13 @@ LOG_NS.option(
 LOG_NS.option(
     "send-batch-size", int, "max messages per batched log append", 256,
     Mutability.MASKABLE, lambda v: v > 0,
+)
+LOG_NS.option(
+    "read-lag-ms", float,
+    "pullers stop this far behind now so same-tick cross-sender stragglers "
+    "still get consumed under coarse graph.timestamps resolutions; -1 = "
+    "auto (0 for nano, 500 otherwise; reference: KCVSLog read-lag-time)",
+    -1.0, Mutability.MASKABLE,
 )
 LOG_NS.option(
     "read-interval-ms", float, "poll interval of log message pullers", 20.0,
@@ -225,6 +261,46 @@ TX_NS.option(
     "max-commit-time-ms", float,
     "recovery considers a tx abandoned after this long", 10_000.0,
     Mutability.GLOBAL,
+)
+IDS.option(
+    "authority.max-retries", int,
+    "id-block claim attempts before giving up (each pays authority-wait)",
+    20, Mutability.MASKABLE, lambda v: v > 0,
+)
+STORAGE.option(
+    "read-only", bool,
+    "open the storage backend read-only: every mutation attempt raises "
+    "(reference: storage.read-only)", False,
+)
+STORAGE.option(
+    "remote.connect-timeout-ms", float,
+    "TCP connect timeout of the remote storage/index clients",
+    30_000.0, Mutability.MASKABLE, lambda v: v > 0,
+)
+CACHE.option(
+    "db-cache-clean-wait-ms", float,
+    "grace period after a row invalidation during which the slice cache "
+    "refuses to re-admit that row — covers eventually-consistent backends "
+    "still propagating the write (reference: cache.db-cache-clean-wait)",
+    0.0, Mutability.MASKABLE, lambda v: v >= 0,
+)
+COMPUTER_NS.option(
+    "frontier-cc-min-edges", int,
+    "edge count above which frontier='auto' engages the compacted path "
+    "for ConnectedComponents (below it the dense superstep is cheaper "
+    "than 2 host round trips/hop)", 1 << 20,
+    Mutability.MASKABLE, lambda v: v >= 0,
+)
+COMPUTER_NS.option(
+    "frontier-f-min", int,
+    "smallest frontier-compaction tier (vertex cap) — smaller recompiles "
+    "more tiers, larger wastes work on tiny frontiers", 1 << 10,
+    Mutability.MASKABLE, lambda v: v > 0,
+)
+COMPUTER_NS.option(
+    "frontier-e-min", int,
+    "smallest frontier-expansion tier (edge cap)", 1 << 13,
+    Mutability.MASKABLE, lambda v: v > 0,
 )
 ATTRIBUTE_NS.option(
     "allow-pickle", str,
@@ -325,6 +401,20 @@ SERVER_NS.option("auth.secret", str, "HMAC token signing secret", "")
 # ---- round-4 vocabulary growth: every option below is READ at a concrete
 # ---- site (named in its description) — no dead knobs
 QUERY_NS = ConfigNamespace("query", "query execution", ROOT)
+
+QUERY_NS.option(
+    "fast-property", bool,
+    "prefetch the whole property range in one slice on a keyed property "
+    "read so the row cache serves later reads (reference: "
+    "query.fast-property / PROPERTY_PREFETCHING; read in tx.get_properties)",
+    True, Mutability.MASKABLE,
+)
+QUERY_NS.option(
+    "max-repeat-loops", int,
+    "graph-wide bound on until-only repeat() loops (cycles would never "
+    "drain; read in GraphTraversal.repeat)", 64,
+    Mutability.MASKABLE, lambda v: v > 0,
+)
 
 STORAGE.option(
     "fsync", bool,

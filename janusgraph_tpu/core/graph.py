@@ -84,6 +84,8 @@ def _open_remote(cfg):
         backoff_base_s=cfg.get("storage.backoff-base-ms") / 1000.0,
         backoff_max_s=cfg.get("storage.backoff-max-ms") / 1000.0,
         parallel_ops=cfg.get("storage.parallel-backend-ops"),
+        connect_timeout_s=cfg.get("storage.remote.connect-timeout-ms")
+        / 1000.0,
     )
 
 
@@ -275,6 +277,15 @@ class JanusGraphTPU:
             cache_enabled=cfg.get("cache.db-cache"),
             cache_size=cfg.get("cache.db-cache-size"),
             id_block_size=cfg.get("ids.block-size"),
+            id_conflict_mode=cfg.get("ids.authority.conflict-avoidance-mode"),
+            id_conflict_tag=cfg.get("ids.authority.conflict-avoidance-tag"),
+            id_conflict_tag_bits=cfg.get(
+                "ids.authority.conflict-avoidance-tag-bits"
+            ),
+            id_max_retries=cfg.get("ids.authority.max-retries"),
+            cache_clean_wait_seconds=cfg.get("cache.db-cache-clean-wait-ms")
+            / 1000.0,
+            read_only=cfg.get("storage.read-only"),
             cache_ttl_seconds=(ttl_ms / 1000.0) if ttl_ms > 0 else None,
             metrics_enabled=cfg.get("metrics.enabled"),
             edgestore_cache_fraction=cfg.get("cache.edgestore-fraction"),
@@ -311,9 +322,14 @@ class JanusGraphTPU:
         # (reference: Backend.java:267,312,316 — txlog/systemlog/user logs)
         from janusgraph_tpu.storage.log import LogManager
 
+        from janusgraph_tpu.util.timestamps import TimestampProviders
+
         self.log_manager = LogManager(
             store_manager,
             sender=self.backend.rid,
+            timestamps=TimestampProviders.of(cfg.get("graph.timestamps")),
+            read_lag_ms=cfg.get("log.read-lag-ms"),
+            read_only=cfg.get("storage.read-only"),
             num_buckets=cfg.get("log.num-buckets"),
             send_batch_size=cfg.get("log.send-batch-size"),
             read_interval_ms=cfg.get("log.read-interval-ms"),

@@ -1493,7 +1493,7 @@ class GraphTraversal:
         times: Optional[int] = None,
         until=None,
         emit: bool = False,
-        max_loops: int = 64,
+        max_loops: Optional[int] = None,
     ) -> "GraphTraversal":
         """t.repeat(lambda t: t.out('knows'), times=3)
         t.repeat(body, until=lambda t: t.has('name','x'))  # do-while
@@ -1512,6 +1512,10 @@ class GraphTraversal:
 
         body_steps = self._sub_steps(body)
         until_steps = self._sub_steps(until) if until is not None else None
+        if max_loops is None:
+            # query.max-repeat-loops bounds until-only loops graph-wide
+            cfg = getattr(self.tx.graph, "config", None)
+            max_loops = cfg.get("query.max-repeat-loops") if cfg else 64
 
         def step(ts):
             results: List[Traverser] = []

@@ -444,13 +444,24 @@ class Transaction:
     def get_properties(self, v: Vertex, *keys: str) -> List[VertexProperty]:
         es = self.graph.edge_serializer
         results: List[VertexProperty] = []
+        fast = self.graph.config.get("query.fast-property")
         if keys:
-            slices = []
+            key_ids = set()
             for k in keys:
                 pk = self.schema_by_name(k)
                 if isinstance(pk, PropertyKey):
-                    slices.append((pk, es.get_type_slice(pk.id, False)))
-            key_ids = {pk.id for pk, _ in slices}
+                    key_ids.add(pk.id)
+            if fast:
+                # query.fast-property: ONE wide slice over the whole
+                # property range instead of a slice per key — the backend
+                # cache then serves every later property read of this row
+                # (reference: GraphDatabaseConfiguration.PROPERTY_PREFETCHING)
+                slices = [(None, es.user_relations_bounds()[0])]
+            else:
+                slices = [
+                    (None, es.get_type_slice(tid, False))
+                    for tid in sorted(key_ids)
+                ]
         else:
             slices = [(None, es.user_relations_bounds()[0])]
             key_ids = None
@@ -460,6 +471,8 @@ class Transaction:
                     rc = es.parse_relation(entry, self._codec_schema)
                     if rc.relation_id in self._deleted_ids:
                         continue
+                    if key_ids is not None and rc.type_id not in key_ids:
+                        continue  # fast-property over-fetch: filter here
                     results.append(
                         VertexProperty(
                             rc.relation_id, rc.type_id, v, rc.value, self,

@@ -31,6 +31,19 @@ class ComputerResult:
     #: name of path position 0 for select() (compute().traverse(source_as=))
     source_as: object = None
 
+    def _path_index(self):
+        """Memoized reverse-adjacency index: paths() then select() on the
+        same result must not pay the O(E log E) per-step sorts twice."""
+        from janusgraph_tpu.olap.programs.olap_traversal import (
+            build_path_index,
+        )
+
+        idx = getattr(self, "_path_index_cache", None)
+        if idx is None:
+            idx = build_path_index(self.csr, self.program)
+            object.__setattr__(self, "_path_index_cache", idx)
+        return idx
+
     def paths(self, limit=None):
         """Enumerate traverser paths (tuples of vertex ids, seed first) —
         requires compute().traverse(..., paths=True). Lazy generator;
@@ -45,7 +58,10 @@ class ComputerResult:
                 "no reach masks recorded — run "
                 "compute().traverse(..., paths=True)"
             )
-        return enumerate_paths(self.csr, self.program, self.states, limit)
+        return enumerate_paths(
+            self.csr, self.program, self.states, limit,
+            path_index=self._path_index(),
+        )
 
     def select(self, *names, limit=None):
         """Project as()-labeled path positions (TinkerPop SelectStep shape):
@@ -62,6 +78,7 @@ class ComputerResult:
         return select_paths(
             self.csr, self.program, self.states, names,
             source_as=self.source_as, limit=limit,
+            path_index=self._path_index(),
         )
 
     def value(self, key: str, vertex_id: int) -> float:
@@ -198,6 +215,11 @@ class GraphComputer:
                 "ell_auto_bytes": cfg.get("computer.ell-auto-budget-bytes"),
                 "ell_auto_pad": cfg.get("computer.ell-auto-pad"),
                 "channel_cache_size": cfg.get("computer.channel-cache-size"),
+                "frontier_cc_min_edges": cfg.get(
+                    "computer.frontier-cc-min-edges"
+                ),
+                "frontier_f_min": cfg.get("computer.frontier-f-min"),
+                "frontier_e_min": cfg.get("computer.frontier-e-min"),
             }
         states = run_on(csr, self._program, self.executor_kind, **run_kwargs)
         memory = {}
@@ -228,6 +250,9 @@ def run_on(
     ell_auto_bytes: int = None,
     ell_auto_pad: float = None,
     channel_cache_size: int = None,
+    frontier_cc_min_edges: int = None,
+    frontier_f_min: int = None,
+    frontier_e_min: int = None,
 ):
     if executor == "cpu":
         from janusgraph_tpu.olap.cpu_executor import CPUExecutor
@@ -244,6 +269,9 @@ def run_on(
             ell_auto_bytes=ell_auto_bytes,
             ell_auto_pad=ell_auto_pad,
             channel_cache_size=channel_cache_size,
+            frontier_cc_min_edges=frontier_cc_min_edges,
+            frontier_f_min=frontier_f_min,
+            frontier_e_min=frontier_e_min,
         ).run(
             program,
             sync_every=sync_every,

@@ -99,6 +99,11 @@ class FrontierEngine:
         self.ex = executor
         self.jax = executor.jax
         self.jnp = executor.jnp
+        # computer.frontier-f-min / frontier-e-min overrides
+        if getattr(executor, "_frontier_f_min", None):
+            self.F_MIN = executor._frontier_f_min
+        if getattr(executor, "_frontier_e_min", None):
+            self.E_MIN = executor._frontier_e_min
         csr = executor.csr
         jnp = self.jnp
         self.n = csr.num_vertices

@@ -331,7 +331,28 @@ def build_olap_traversal(
     )
 
 
-def enumerate_paths(csr, program, states, limit=None):
+def build_path_index(csr, program):
+    """The per-step reverse adjacency enumerate_paths walks: one
+    O(E log E) sort per step. Build ONCE per (csr, program) and reuse —
+    ComputerResult memoizes it so paths() + select() on the same result
+    don't pay it twice."""
+    import numpy as np
+
+    from janusgraph_tpu.olap.csr import channel_edges
+
+    n = csr.num_vertices
+    rev = []
+    for k in range(len(program.steps)):
+        src, dst, _w = channel_edges(csr, program.edge_channels[f"s{k}"])
+        order = np.argsort(dst, kind="stable")
+        srcs = src[order]
+        indptr = np.zeros(n + 1, dtype=np.int64)
+        np.cumsum(np.bincount(dst, minlength=n), out=indptr[1:])
+        rev.append((indptr, srcs))
+    return rev
+
+
+def enumerate_paths(csr, program, states, limit=None, path_index=None):
     """Host half of OLAP path(): lazily enumerate the traverser paths of a
     `record_reach` run, as tuples of GRAPH vertex ids (seed first).
 
@@ -351,21 +372,11 @@ def enumerate_paths(csr, program, states, limit=None):
     """
     import numpy as np
 
-    from janusgraph_tpu.olap.csr import channel_edges
-
     reach = np.asarray(states["reach"]) > 0          # (n, S+1)
     S = len(program.steps)
-    n = csr.num_vertices
-    rev = []
-    for k in range(S):
-        src, dst, _w = channel_edges(
-            csr, program.edge_channels[f"s{k}"]
-        )
-        order = np.argsort(dst, kind="stable")
-        srcs = src[order]
-        indptr = np.zeros(n + 1, dtype=np.int64)
-        np.cumsum(np.bincount(dst, minlength=n), out=indptr[1:])
-        rev.append((indptr, srcs))
+    rev = path_index if path_index is not None else build_path_index(
+        csr, program
+    )
     vids = csr.vertex_ids
 
     def back(v, k):
@@ -389,7 +400,9 @@ def enumerate_paths(csr, program, states, limit=None):
                 return
 
 
-def select_paths(csr, program, states, names, source_as=None, limit=None):
+def select_paths(
+    csr, program, states, names, source_as=None, limit=None, path_index=None,
+):
     """select() over enumerated paths: project the as()-labeled positions
     of each path into a dict (reference: TinkerPop SelectStep consuming
     step labels). `source_as` names path position 0 (the g.V() head)."""
@@ -413,7 +426,9 @@ def select_paths(csr, program, states, names, source_as=None, limit=None):
             f"select() names {missing} match no as()-labeled step "
             f"(labeled: {sorted(positions)})"
         )
-    for p in enumerate_paths(csr, program, states, limit=limit):
+    for p in enumerate_paths(
+        csr, program, states, limit=limit, path_index=path_index,
+    ):
         yield {nm: p[positions[nm]] for nm in names}
 
 

@@ -192,6 +192,9 @@ class TPUExecutor:
         ell_auto_bytes: int = None,
         ell_auto_pad: float = None,
         channel_cache_size: int = None,
+        frontier_cc_min_edges: int = None,
+        frontier_f_min: int = None,
+        frontier_e_min: int = None,
     ):
         import jax
         import jax.numpy as jnp
@@ -219,6 +222,11 @@ class TPUExecutor:
             self.ELL_AUTO_PAD = ell_auto_pad
         if channel_cache_size is not None:
             self.CHANNEL_CACHE_SIZE = channel_cache_size
+        # computer.frontier-cc-min-edges / frontier-f-min / frontier-e-min
+        if frontier_cc_min_edges is not None:
+            self.FRONTIER_CC_MIN_EDGES = frontier_cc_min_edges
+        self._frontier_f_min = frontier_f_min
+        self._frontier_e_min = frontier_e_min
         # "auto" resolves lazily per edge view: an undirected program packs
         # in+out edges (~2x footprint), so the budget check must see the
         # view it will actually ship

@@ -14,8 +14,10 @@ import threading
 import uuid
 from typing import Dict, List, Optional, Sequence
 
+from janusgraph_tpu.exceptions import PermanentBackendError
 from janusgraph_tpu.storage.cache import ExpirationCacheStore
 from janusgraph_tpu.storage.idauthority import (
+    ConflictAvoidanceMode,
     ConsistentKeyIDAuthority,
     ID_STORE_NAME,
 )
@@ -84,12 +86,20 @@ class Backend:
         cache_enabled: bool = True,
         cache_size: int = 65536,
         id_block_size: int = 10_000,
+        id_conflict_mode: str = "none",
+        id_conflict_tag: int = 0,
+        id_conflict_tag_bits: int = 4,
+        id_max_retries: int = 20,
         cache_ttl_seconds: Optional[float] = 10.0,
+        cache_clean_wait_seconds: float = 0.0,
         metrics_enabled: bool = False,
         edgestore_cache_fraction: float = 0.8,
+        read_only: bool = False,
     ):
         self.manager = manager
         self.metrics_enabled = metrics_enabled
+        #: storage.read-only: every mutation through this backend raises
+        self.read_only = read_only
         self._base_tx = manager.begin_transaction()
         edgestore = manager.open_database(EDGESTORE_NAME)
         indexstore = manager.open_database(INDEXSTORE_NAME)
@@ -109,10 +119,12 @@ class Backend:
             edgestore = ExpirationCacheStore(
                 edgestore, max(1, int(cache_size * f)),
                 ttl_seconds=cache_ttl_seconds,
+                clean_wait_seconds=cache_clean_wait_seconds,
             )
             indexstore = ExpirationCacheStore(
                 indexstore, max(1, int(cache_size * (1.0 - f))),
                 ttl_seconds=cache_ttl_seconds,
+                clean_wait_seconds=cache_clean_wait_seconds,
             )
         self.edgestore = edgestore
         self.indexstore = indexstore
@@ -120,7 +132,12 @@ class Backend:
         self.global_config = GlobalConfigStore(manager)
         self.id_store = manager.open_database(ID_STORE_NAME)
         self.id_authority = ConsistentKeyIDAuthority(
-            self.id_store, self._base_tx, block_size=id_block_size
+            self.id_store, self._base_tx, block_size=id_block_size,
+            conflict_mode=ConflictAvoidanceMode(id_conflict_mode),
+            conflict_tag=id_conflict_tag,
+            conflict_tag_bits=id_conflict_tag_bits,
+            max_retries=id_max_retries,
+            read_only=read_only,
         )
         # mutation-epoch tracker: edgestore row key -> epoch of its last
         # committed mutation (this instance). Powers incremental CSR refresh
@@ -258,6 +275,10 @@ class BackendTransaction:
 
     # ---------------------------------------------------------------- writes
     def _buffer(self, store: str, key: bytes, additions: EntryList, deletions: Sequence[bytes]):
+        if self.backend.read_only:
+            raise PermanentBackendError(
+                "storage.read-only: the backend was opened read-only"
+            )
         with self._lock:
             rows = self._mutations.setdefault(store, {})
             m = rows.setdefault(key, KCVMutation())

@@ -39,10 +39,17 @@ class ExpirationCacheStore(KeyColumnValueStore):
         store: KeyColumnValueStore,
         max_entries: int = 65536,
         ttl_seconds: Optional[float] = None,
+        clean_wait_seconds: float = 0.0,
     ):
         self._store = store
         self._max = max_entries
         self._ttl = ttl_seconds
+        # cache.db-cache-clean-wait-ms: after a row invalidation, refuse to
+        # re-admit that row for this long — an eventually-consistent backend
+        # may still be propagating the write that invalidated it
+        # (reference: ExpirationKCVSCache.java penaltyCountdown)
+        self._clean_wait = clean_wait_seconds
+        self._dirty_rows: Dict[bytes, float] = {}
         self._lock = threading.Lock()
         # (key, slice) -> (entries, inserted_at)
         self._cache: "OrderedDict[Tuple[bytes, SliceQuery], Tuple[EntryList, float]]" = (
@@ -83,6 +90,12 @@ class ExpirationCacheStore(KeyColumnValueStore):
                 # a row was invalidated during the unlocked fetch; our result
                 # may predate the write — serve it but don't cache it
                 return list(entries)
+            if self._clean_wait > 0:
+                dirty_at = self._dirty_rows.get(query.key)
+                if dirty_at is not None:
+                    if time.monotonic() - dirty_at < self._clean_wait:
+                        return list(entries)  # within the clean-wait window
+                    del self._dirty_rows[query.key]
             self._cache[ck] = (entries, now)
             self._by_row.setdefault(query.key, set()).add(ck)
             while len(self._cache) > self._max:
@@ -110,6 +123,16 @@ class ExpirationCacheStore(KeyColumnValueStore):
     def invalidate(self, key: bytes) -> None:
         with self._lock:
             self._generation += 1
+            if self._clean_wait > 0:
+                now = time.monotonic()
+                self._dirty_rows[key] = now
+                # amortized prune: rows written but never re-read would
+                # otherwise accumulate for the process lifetime
+                if len(self._dirty_rows) > max(1024, 2 * self._max):
+                    self._dirty_rows = {
+                        k: at for k, at in self._dirty_rows.items()
+                        if now - at < self._clean_wait
+                    }
             for ck in self._by_row.pop(key, ()):  # all slices of this row
                 self._cache.pop(ck, None)
                 self.metrics.invalidations += 1

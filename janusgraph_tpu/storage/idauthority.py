@@ -33,6 +33,7 @@ import struct
 import threading
 import time
 import uuid
+from enum import Enum
 from typing import Dict, Optional
 
 from janusgraph_tpu.exceptions import (
@@ -51,6 +52,30 @@ ID_STORE_NAME = "janusgraph_ids"
 
 _BLOCK_SIZE_KEY = b"\x00block_size"
 _BLOCK_SIZE_COL = b"size"
+
+
+class ConflictAvoidanceMode(Enum):
+    """How allocators avoid contending on the same id-block claim key
+    (reference: diskstorage/idmanagement/ConflictAvoidanceMode.java:76 —
+    a user-visible config enum serialized into global config).
+
+    NONE          — all instances race on one claim key per (ns, partition);
+                    the claim protocol resolves conflicts (default).
+    LOCAL_MANUAL  — this instance uses its locally configured tag.
+    GLOBAL_MANUAL — every instance is expected to carry a (distinct)
+                    configured tag; same mechanics as LOCAL_MANUAL here,
+                    the distinction is operational intent.
+    GLOBAL_AUTO   — each authority draws a random tag at startup.
+
+    A tagged authority claims under key+tag and owns the tag's whole
+    block-number subsequence (global block = local * num_tags + tag), so
+    tagged allocators NEVER contend — at the cost of id-space striping.
+    """
+
+    NONE = "none"
+    LOCAL_MANUAL = "local_manual"
+    GLOBAL_MANUAL = "global_manual"
+    GLOBAL_AUTO = "global_auto"
 
 
 def _partition_key(namespace: int, partition: int) -> bytes:
@@ -100,10 +125,34 @@ class ConsistentKeyIDAuthority:
         uid: Optional[bytes] = None,
         max_retries: int = 20,
         wait_ms: float = 2.0,
+        conflict_mode: ConflictAvoidanceMode = ConflictAvoidanceMode.NONE,
+        conflict_tag: int = 0,
+        conflict_tag_bits: int = 4,
+        read_only: bool = False,
     ):
         self.store = store
         self.txh = txh
         self.block_size = block_size
+        #: storage.read-only: refuse block claims up front — the claim
+        #: protocol writes to the id store before anything else would
+        self.read_only = read_only
+        self.conflict_mode = conflict_mode
+        if conflict_mode is ConflictAvoidanceMode.NONE:
+            self.num_tags = 1
+            self.tag = 0
+        else:
+            self.num_tags = 1 << conflict_tag_bits
+            if conflict_mode is ConflictAvoidanceMode.GLOBAL_AUTO:
+                import random
+
+                self.tag = random.randrange(self.num_tags)
+            else:
+                if not 0 <= conflict_tag < self.num_tags:
+                    raise ValueError(
+                        f"conflict-avoidance tag {conflict_tag} outside "
+                        f"[0, 2^{conflict_tag_bits})"
+                    )
+                self.tag = conflict_tag
         self.uid = uid if uid is not None else (
             uuid.uuid4().bytes[:12] + os.getpid().to_bytes(4, "big")
         )
@@ -143,7 +192,18 @@ class ConsistentKeyIDAuthority:
             )
 
     def get_id_block(self, namespace: int, partition: int) -> IDBlock:
+        if self.read_only:
+            from janusgraph_tpu.exceptions import PermanentBackendError
+
+            raise PermanentBackendError(
+                "storage.read-only: id-block claims write to the id store"
+            )
         key = _partition_key(namespace, partition)
+        if self.num_tags > 1:
+            # tagged claim space: no cross-tag contention; the frontier
+            # under key+tag counts TAG-LOCAL blocks, remapped to a globally
+            # disjoint block-number stripe below
+            key += struct.pack(">H", self.tag)
         for _ in range(self.max_retries):
             frontier = self._read_frontier(key)
             block_end = frontier + self.block_size
@@ -165,6 +225,12 @@ class ConsistentKeyIDAuthority:
             )
             if rivals and rivals[0][0] == claim_col:
                 self._frontier_cache[key] = block_end
+                if self.num_tags > 1:
+                    # local block b -> global block b*num_tags + tag: every
+                    # tag owns a disjoint stripe of the id space
+                    b = frontier // self.block_size
+                    start = (b * self.num_tags + self.tag) * self.block_size
+                    return IDBlock(start + 1, self.block_size)
                 return IDBlock(frontier + 1, self.block_size)
             # lost the race: withdraw and retry from a fresh frontier
             self.store.mutate(key, [], [claim_col], self.txh)

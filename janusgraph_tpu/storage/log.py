@@ -75,10 +75,32 @@ class KCVSLog:
         send_batch_size: int = 256,
         send_interval_ms: float = 10.0,
         read_interval_ms: float = 20.0,
+        timestamps=None,
+        read_lag_ms: float = -1.0,
+        read_only: bool = False,
     ):
+        from janusgraph_tpu.util.timestamps import TimestampProviders
+
         self.name = name
         self.store = store
         self._tx_factory = tx_factory
+        #: graph.timestamps: resolution all appended messages are stamped
+        #: at (reference: KCVSLog times from the cluster TimestampProvider)
+        self.timestamps = timestamps or TimestampProviders.NANO
+        #: log.read-lag-ms: pullers stop this far behind now, so a message
+        #: stamped in the window still counts as "not yet visible" — with
+        #: coarse timestamp resolutions a same-tick late flush from another
+        #: sender would otherwise sort below the cursor and be skipped
+        #: forever (reference: KCVSLog maxReadTime / read-lag-time).
+        #: auto (-1): 0 for NANO stamps (same-tick cross-sender collisions
+        #: are impossible, and added read latency would be pure cost),
+        #: 500ms for coarser resolutions (covers send-batch flush delay)
+        if read_lag_ms < 0:
+            read_lag_ms = (
+                0.0 if self.timestamps is TimestampProviders.NANO else 500.0
+            )
+        self._read_lag_ns = int(read_lag_ms * 1e6)
+        self.read_only = read_only
         self.sender = (sender + b"\x00" * 8)[:8]
         self.num_buckets = num_buckets
         self.send_batch_size = send_batch_size
@@ -100,8 +122,14 @@ class KCVSLog:
     def add(self, content: bytes, bucket: Optional[int] = None) -> None:
         """Append a message (batched; the send thread flushes). A partition
         key may pin the bucket so one entity's messages stay ordered."""
+        if self.read_only:
+            from janusgraph_tpu.exceptions import PermanentBackendError
+
+            raise PermanentBackendError(
+                "storage.read-only: log appends write to the log store"
+            )
         with self._lock:
-            ts = time.time_ns()
+            ts = self.timestamps.time_ns()
             self._seq += 1
             col = (
                 ts.to_bytes(8, "big")
@@ -219,17 +247,23 @@ class KCVSLog:
         while not self._closed.is_set():
             try:
                 stx = self._tx_factory()
-                # resume the ranged scan at the cursor's row
+                # resume the ranged scan at the cursor's row; stop read-lag
+                # behind now so same-tick stragglers still get consumed
                 resume_ns = int.from_bytes(cursor[0], "big") * _SLICE_NS
+                end_ns = time.time_ns() - self._read_lag_ns
                 for row, entries in self._bucket_rows(
-                    bucket, resume_ns, time.time_ns(), stx
+                    bucket, resume_ns, end_ns, stx
                 ):
                     row_slice = row[1:9]
                     for col, val in entries:
                         if (row_slice, col) <= cursor:
                             continue
-                        cursor = (row_slice, col)
                         ts = int.from_bytes(col[:8], "big")
+                        if ts > end_ns:
+                            # inside the lag window: revisit next poll —
+                            # cursor must NOT advance past it
+                            continue
+                        cursor = (row_slice, col)
                         if ts < start_ns:
                             continue
                         try:
@@ -263,9 +297,15 @@ class LogManager:
         read_interval_ms: float = 20.0,
         send_delay_ms: float = 10.0,
         ttl_seconds: float = 0.0,
+        timestamps=None,
+        read_lag_ms: float = -1.0,
+        read_only: bool = False,
     ):
         self.manager = store_manager
         self.sender = sender
+        self.timestamps = timestamps
+        self.read_lag_ms = read_lag_ms
+        self.read_only = read_only
         self.num_buckets = num_buckets
         self.send_batch_size = send_batch_size
         self.read_interval_ms = read_interval_ms
@@ -294,6 +334,9 @@ class LogManager:
                     send_batch_size=self.send_batch_size,
                     send_interval_ms=self.send_delay_ms,
                     read_interval_ms=self.read_interval_ms,
+                    timestamps=self.timestamps,
+                    read_lag_ms=self.read_lag_ms,
+                    read_only=self.read_only,
                 )
                 self._logs[name] = log
             return log

@@ -321,13 +321,16 @@ class RemoteStoreServer:
 class _Conn:
     """One pooled connection; serialized per-request by its own lock."""
 
-    def __init__(self, host: str, port: int):
+    def __init__(self, host: str, port: int, connect_timeout_s: float = 30.0):
         self.host, self.port = host, port
+        self.connect_timeout_s = connect_timeout_s
         self.lock = threading.Lock()
         self.sock: Optional[socket.socket] = None
 
     def _connect(self):
-        s = socket.create_connection((self.host, self.port), timeout=30)
+        s = socket.create_connection(
+            (self.host, self.port), timeout=self.connect_timeout_s
+        )
         s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
         self.sock = s
 
@@ -475,9 +478,11 @@ class RemoteStoreManager(KeyColumnValueStoreManager):
     def __init__(self, host: str, port: int, pool_size: int = 4,
                  retry_time_s: float = 10.0,
                  backoff_base_s: float = None, backoff_max_s: float = None,
-                 parallel_ops: bool = True):
+                 parallel_ops: bool = True,
+                 connect_timeout_s: float = 30.0):
         self.host, self.port = host, port
         self.retry_time_s = retry_time_s
+        self.connect_timeout_s = connect_timeout_s
         #: storage.parallel-backend-ops — client-side multi-slice fan-out
         self.parallel_ops = parallel_ops
         self._pool_executor = None
@@ -486,7 +491,9 @@ class RemoteStoreManager(KeyColumnValueStoreManager):
         # tuning one graph's backend must not affect others in-process
         self.backoff_base_s = backoff_base_s
         self.backoff_max_s = backoff_max_s
-        self._pool = [_Conn(host, port) for _ in range(pool_size)]
+        self._pool = [
+            _Conn(host, port, connect_timeout_s) for _ in range(pool_size)
+        ]
         self._pool_lock = threading.Lock()
         self._pool_idx = 0
         self._stores: Dict[str, RemoteKCVStore] = {}

@@ -1,0 +1,150 @@
+"""Round-5 config options: each test drives the BEHAVIOR the option claims
+(reference: GraphDatabaseConfiguration.java option vocabulary)."""
+
+import numpy as np
+import pytest
+
+from janusgraph_tpu.core.graph import open_graph
+
+
+def test_fast_property_single_wide_slice():
+    """query.fast-property=True fetches ONE wide slice for keyed property
+    reads (cache-warming over-fetch); False slices per key."""
+    g = open_graph({"storage.backend": "inmemory"})
+    tx = g.new_transaction()
+    v = tx.add_vertex(name="a", age=3, city="x")
+    tx.commit()
+
+    store = g.backend.edgestore
+    tx = g.new_transaction()
+    v = tx.get_vertex(v.id)
+    tx.get_properties(v, "name")
+    # the wide slice is reused for any later key: no new backend read
+    m0 = store.metrics.misses
+    tx.get_properties(v, "age")
+    assert store.metrics.misses == m0
+    g.close()
+
+    g2 = open_graph({
+        "storage.backend": "inmemory", "query.fast-property": False,
+    })
+    tx = g2.new_transaction()
+    v = tx.add_vertex(name="a", age=3)
+    tx.commit()
+    tx = g2.new_transaction()
+    v = tx.get_vertex(v.id)
+    st = g2.backend.edgestore
+    tx.get_properties(v, "name")
+    miss0 = st.metrics.misses
+    tx.get_properties(v, "age")  # per-key slice: a fresh miss
+    assert st.metrics.misses > miss0
+    g2.close()
+
+
+def test_max_repeat_loops_bounds_cycles():
+    g = open_graph({
+        "storage.backend": "inmemory", "query.max-repeat-loops": 2,
+    })
+    tx = g.new_transaction()
+    a, b = tx.add_vertex(), tx.add_vertex()
+    tx.add_edge(a, "next", b)
+    tx.add_edge(b, "next", a)  # a 2-cycle: an until-only loop never drains
+    tx.commit()
+    out = (
+        g.traversal().V().repeat(
+            lambda t: t.out("next"),
+            until=lambda t: t.has("name", "nope"),
+        ).to_list()
+    )
+    # bounded at 2 loops: traversers exit instead of spinning forever
+    assert len(out) == 2
+    g.close()
+
+
+def test_storage_read_only_refuses_mutations():
+    from janusgraph_tpu.exceptions import PermanentBackendError
+    from janusgraph_tpu.storage.inmemory import InMemoryStoreManager
+
+    mgr = InMemoryStoreManager()
+    g = open_graph({"storage.backend": "inmemory"}, store_manager=mgr)
+    tx = g.new_transaction()
+    tx.add_vertex(name="pre")
+    tx.commit()
+    g.close()
+
+    ro = open_graph(
+        {"storage.backend": "inmemory", "storage.read-only": True},
+        store_manager=mgr,
+    )
+    tx = ro.new_transaction()
+    assert list(tx.vertices())  # reads fine
+    # enforcement fires at the FIRST write — the id-block claim — before
+    # any WAL precommit could leave a phantom entry
+    with pytest.raises(PermanentBackendError, match="read-only"):
+        tx.add_vertex()
+    # log appends refuse too
+    with pytest.raises(PermanentBackendError, match="read-only"):
+        ro.log_manager.open_log("ulog_x").add(b"nope")
+    ro.close()
+
+
+def test_cache_clean_wait_blocks_readmission():
+    import time
+
+    from janusgraph_tpu.storage.cache import ExpirationCacheStore
+    from janusgraph_tpu.storage.inmemory import InMemoryStoreManager
+    from janusgraph_tpu.storage.kcvs import KeySliceQuery, SliceQuery
+
+    mgr = InMemoryStoreManager()
+    raw = mgr.open_database("t")
+    store = ExpirationCacheStore(raw, clean_wait_seconds=0.2)
+    txh = mgr.begin_transaction()
+    q = KeySliceQuery(b"k", SliceQuery(b"a", b"z"))
+    raw.mutate(b"k", [(b"c", b"1")], [], txh)
+    store.get_slice(q, txh)
+    assert store.get_slice(q, txh) and store.metrics.hits == 1
+    store.mutate(b"k", [(b"c", b"2")], [], txh)  # invalidates + marks dirty
+    store.get_slice(q, txh)
+    h = store.metrics.hits
+    store.get_slice(q, txh)  # NOT re-admitted inside the window
+    assert store.metrics.hits == h
+    time.sleep(0.25)
+    store.get_slice(q, txh)  # window over: re-admitted...
+    store.get_slice(q, txh)
+    assert store.metrics.hits > h  # ...and hit
+
+
+def test_frontier_knobs_reach_engine():
+    from janusgraph_tpu.olap.frontier import FrontierEngine
+    from janusgraph_tpu.olap.generators import rmat_csr
+    from janusgraph_tpu.olap.tpu_executor import TPUExecutor
+
+    csr = rmat_csr(8, 8)
+    ex = TPUExecutor(
+        csr, frontier_cc_min_edges=5, frontier_f_min=64, frontier_e_min=128,
+    )
+    assert ex.FRONTIER_CC_MIN_EDGES == 5
+    eng = FrontierEngine(ex)
+    assert eng.F_MIN == 64 and eng.E_MIN == 128
+
+
+def test_remote_connect_timeout_and_id_retries_wire():
+    from janusgraph_tpu.storage.inmemory import InMemoryStoreManager
+    from janusgraph_tpu.storage.remote import (
+        RemoteStoreManager,
+        RemoteStoreServer,
+    )
+
+    server = RemoteStoreServer(InMemoryStoreManager()).start()
+    host, port = server.address
+    g = open_graph({
+        "storage.backend": "remote",
+        "storage.hostname": host, "storage.port": port,
+        "storage.remote.connect-timeout-ms": 1234.0,
+        "ids.authority.max-retries": 7,
+    })
+    assert isinstance(g.backend.manager, RemoteStoreManager)
+    assert g.backend.manager.connect_timeout_s == pytest.approx(1.234)
+    assert g.backend.id_authority.max_retries == 7
+    g.close()
+    server.stop()

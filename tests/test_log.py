@@ -319,3 +319,21 @@ class TestSchemaBroadcast:
         assert g2.management().get_consistency("serial") is Consistency.LOCK
         g1.close()
         g2.close()
+
+
+def test_log_timestamp_provider_resolution():
+    """graph.timestamps governs the resolution of log message stamps
+    (reference: TimestampProviders + KCVSLog timestamping)."""
+    import time
+
+    from janusgraph_tpu.core.graph import open_graph
+
+    g = open_graph({
+        "storage.backend": "inmemory", "graph.timestamps": "milli",
+    })
+    log = g.log_manager.open_log("testlog")
+    log.add(b"hello")
+    log.flush()
+    msgs = log.read_range(0)
+    assert msgs and all(m.timestamp_ns % 1_000_000 == 0 for m in msgs)
+    g.close()
