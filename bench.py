@@ -815,6 +815,17 @@ def worker() -> None:
             })
             break
 
+    # BASELINE dataset-fidelity rows (configs #2/#4)
+    if os.environ.get("BENCH_DATASETS", "1") != "0":
+        try:
+            _datasets_stage(jax, platform, t0)
+        except Exception as e:
+            _hb(f"datasets stage FAILED {type(e).__name__}: {e}", t0)
+            _emit({
+                "stage": "dataset", "ok": False,
+                "error": f"{type(e).__name__}: {e}"[:500],
+            })
+
     # OLTP micro-bench: host-side, platform-independent, bounded by the
     # edge cap (~10-20s for both backends)
     if os.environ.get("BENCH_OLTP", "1") != "0":
@@ -859,6 +870,74 @@ def worker() -> None:
                 "error": f"{type(e).__name__}: {e}"[:500],
             })
         done.set()
+
+
+def _datasets_stage(jax, platform, t0):
+    """BASELINE dataset-fidelity rows (VERDICT r4 #6): ConnectedComponents
+    on the LDBC-SF1-SIZED SNB-shaped proxy (config #2) and PeerPressure on
+    the Twitter-2010-shaped power-law proxy (config #4). On TPU the LDBC
+    proxy is the documented SF1 size (3.2M vertices / 17.3M edges) and the
+    Twitter proxy runs 2M vertices / 73M edges; the CPU fallback runs the
+    same SHAPES scaled down so the rows always produce numbers."""
+    import numpy as np
+
+    from janusgraph_tpu.olap.generators import ldbc_sf_csr, twitter_csr
+    from janusgraph_tpu.olap.programs import (
+        ConnectedComponentsProgram,
+        PeerPressureProgram,
+    )
+    from janusgraph_tpu.olap.tpu_executor import TPUExecutor
+
+    if platform == "tpu":
+        ldbc_kw = {"sf": 1, "scale_down": 1}
+        tw_n, tw_ef = 1 << 21, 35.0
+    else:
+        ldbc_kw = {"sf": 1, "scale_down": 8}
+        tw_n, tw_ef = 1 << 16, 35.0
+
+    g0 = time.perf_counter()
+    lcsr = ldbc_sf_csr(**ldbc_kw)
+    _hb(f"datasets: ldbc-sf1 proxy |V|={lcsr.num_vertices} "
+        f"|E|={lcsr.num_edges} ({time.perf_counter() - g0:.1f}s)", t0)
+    ex = TPUExecutor(lcsr)
+    prog = ConnectedComponentsProgram(max_iterations=64)
+    ex.run(prog)
+    r0 = time.perf_counter()
+    res = ex.run(prog)
+    comp = np.asarray(res["component"])
+    wall = round(time.perf_counter() - r0, 3)
+    _emit({
+        "stage": "dataset", "workload": "connected_components",
+        "dataset": "ldbc-sf1-shaped", "baseline_config": 2,
+        "platform": platform, "num_vertices": lcsr.num_vertices,
+        "num_edges": lcsr.num_edges, "wall_s": wall,
+        "scale_down": ldbc_kw["scale_down"],
+        "components": int(len(np.unique(comp))),
+        "path": ex.last_run_info.get("path"),
+    })
+    _hb(f"datasets: ldbc-sf1 CC {wall}s", t0)
+    del ex, lcsr, res
+
+    g0 = time.perf_counter()
+    tcsr = twitter_csr(tw_n, tw_ef)
+    _hb(f"datasets: twitter-shaped proxy |V|={tcsr.num_vertices} "
+        f"|E|={tcsr.num_edges} ({time.perf_counter() - g0:.1f}s)", t0)
+    ex = TPUExecutor(tcsr)
+    pp = PeerPressureProgram(rounds=5)
+    ex.run(pp, sync_every=5)
+    r0 = time.perf_counter()
+    res = ex.run(pp, sync_every=5)
+    cl = np.asarray(res["cluster"])
+    wall = round(time.perf_counter() - r0, 3)
+    _emit({
+        "stage": "dataset", "workload": "peer_pressure",
+        "dataset": "twitter2010-shaped", "baseline_config": 4,
+        "platform": platform, "num_vertices": tcsr.num_vertices,
+        "num_edges": tcsr.num_edges, "wall_s": wall,
+        "clusters": int(len(np.unique(cl))),
+    })
+    _hb(f"datasets: twitter peer-pressure {wall}s", t0)
+    del ex, tcsr, res
 
 
 def _oltp_stage(t0):

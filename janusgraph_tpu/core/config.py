@@ -247,10 +247,11 @@ LOG_NS.option(
 )
 LOG_NS.option(
     "read-lag-ms", float,
-    "pullers stop this far behind now so same-tick cross-sender stragglers "
-    "still get consumed under coarse graph.timestamps resolutions; -1 = "
-    "auto (0 for nano, 500 otherwise; reference: KCVSLog read-lag-time)",
-    -1.0, Mutability.MASKABLE,
+    "pullers stop this far behind now so a cross-sender message stamped "
+    "earlier but flushed later (stamp-to-flush delay <= the send "
+    "interval) is never skipped past the cursor; -1 = auto (3x "
+    "log.send-delay-ms + one graph.timestamps tick; reference: KCVSLog "
+    "read-lag-time)", -1.0, Mutability.MASKABLE,
 )
 LOG_NS.option(
     "read-interval-ms", float, "poll interval of log message pullers", 20.0,
@@ -620,21 +621,26 @@ class GraphConfiguration:
             self.backend.set_global_config(path, self._encode(value))
 
     def attach_backend(self, backend) -> None:
-        """Bind the opened backend, then reconcile cluster-global options."""
+        """Bind the opened backend, then reconcile cluster-global options.
+        Against a read-only store the freeze-on-first-use WRITES are
+        skipped (reads + FIXED-mismatch checks still apply): a read-only
+        open must not initialize cluster config."""
         self.backend = backend
+        writable = not getattr(backend, "read_only", False)
         for path, value in list(self.local.items()):
             opt = REGISTRY[path]
             if opt.mutability is Mutability.FIXED:
                 stored = self._stored(path)
                 if stored is None:
-                    self._store(path, value)
+                    if writable:
+                        self._store(path, value)
                 elif stored != value:
                     raise ConfigurationError(
                         f"{path} is FIXED: cluster value {stored!r} != "
                         f"local value {value!r}"
                     )
             elif opt.mutability in (Mutability.GLOBAL, Mutability.GLOBAL_OFFLINE):
-                if self._stored(path) is None:
+                if writable and self._stored(path) is None:
                     self._store(path, value)
 
     # -- reads --------------------------------------------------------------

@@ -270,7 +270,9 @@ class JanusGraphTPU:
         # temporarily to merge KCVS-stored config first)
         from janusgraph_tpu.storage.backend import GlobalConfigStore
 
-        cfg.attach_backend(GlobalConfigStore(store_manager))
+        cfg.attach_backend(GlobalConfigStore(
+            store_manager, read_only=cfg.get("storage.read-only")
+        ))
         ttl_ms = cfg.get("cache.db-cache-time-ms")
         self.backend = Backend(
             store_manager,
@@ -304,11 +306,12 @@ class JanusGraphTPU:
         )
         self._metric_reporters = []
         self.instance_registry = InstanceRegistry(self.backend)
-        if cfg.get("graph.replace-instance-if-exists"):
-            # take over a stale registration instead of refusing to open
-            # (reference: graph.replace-instance-if-exists)
-            self.instance_registry.deregister(self.instance_id)
-        self.instance_registry.register(self.instance_id)
+        if not self.backend.read_only:
+            if cfg.get("graph.replace-instance-if-exists"):
+                # take over a stale registration instead of refusing to
+                # open (reference: graph.replace-instance-if-exists)
+                self.instance_registry.deregister(self.instance_id)
+            self.instance_registry.register(self.instance_id)
         from janusgraph_tpu.core.placement import make_placement_strategy
 
         self.id_assigner = VertexIDAssigner(
@@ -572,7 +575,8 @@ class JanusGraphTPU:
                     r.stop(final_flush=r.mode == "csv")
                 except OSError:
                     pass  # reporting must never block deregister/close
-            self.instance_registry.deregister(self.instance_id)
+            if not self.backend.read_only:
+                self.instance_registry.deregister(self.instance_id)
             self.log_manager.close()
             self.backend.close()
             self._open = False

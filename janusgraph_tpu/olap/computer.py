@@ -58,9 +58,11 @@ class ComputerResult:
                 "no reach masks recorded — run "
                 "compute().traverse(..., paths=True)"
             )
+        # the bound method, NOT called: the generator resolves it on first
+        # iteration, so un-iterated paths() costs nothing
         return enumerate_paths(
             self.csr, self.program, self.states, limit,
-            path_index=self._path_index(),
+            path_index=self._path_index,
         )
 
     def select(self, *names, limit=None):
@@ -78,7 +80,7 @@ class ComputerResult:
         return select_paths(
             self.csr, self.program, self.states, names,
             source_as=self.source_as, limit=limit,
-            path_index=self._path_index(),
+            path_index=self._path_index,
         )
 
     def value(self, key: str, vertex_id: int) -> float:

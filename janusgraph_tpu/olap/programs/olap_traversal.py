@@ -374,6 +374,11 @@ def enumerate_paths(csr, program, states, limit=None, path_index=None):
 
     reach = np.asarray(states["reach"]) > 0          # (n, S+1)
     S = len(program.steps)
+    # path_index may be a zero-arg callable (memoized builder): the
+    # O(E log E) build then happens on FIRST ITERATION, after cheap
+    # validation (unknown select() names must not pay for the sorts)
+    if callable(path_index):
+        path_index = path_index()
     rev = path_index if path_index is not None else build_path_index(
         csr, program
     )

@@ -47,11 +47,22 @@ class GlobalConfigStore:
 
     _CONFIG_KEY = b"\x00config"
 
-    def __init__(self, manager: KeyColumnValueStoreManager):
+    def __init__(
+        self, manager: KeyColumnValueStoreManager, read_only: bool = False,
+    ):
         self._store = manager.open_database(SYSTEM_PROPERTIES_NAME)
         self._tx = manager.begin_transaction()
+        #: storage.read-only: global-config/instance-registry writes refuse
+        self.read_only = read_only
+
+    def _check_writable(self) -> None:
+        if self.read_only:
+            raise PermanentBackendError(
+                "storage.read-only: global config writes refused"
+            )
 
     def set_global_config(self, name: str, value: bytes) -> None:
+        self._check_writable()
         self._store.mutate(
             self._CONFIG_KEY, [(name.encode(), value)], [], self._tx
         )
@@ -65,6 +76,7 @@ class GlobalConfigStore:
         return entries[0][1] if entries else None
 
     def del_global_config(self, name: str) -> None:
+        self._check_writable()
         self._store.mutate(self._CONFIG_KEY, [], [name.encode()], self._tx)
 
     def list_global_config(self, prefix: str = "") -> List[str]:
@@ -129,7 +141,7 @@ class Backend:
         self.edgestore = edgestore
         self.indexstore = indexstore
         self.system_properties = manager.open_database(SYSTEM_PROPERTIES_NAME)
-        self.global_config = GlobalConfigStore(manager)
+        self.global_config = GlobalConfigStore(manager, read_only=read_only)
         self.id_store = manager.open_database(ID_STORE_NAME)
         self.id_authority = ConsistentKeyIDAuthority(
             self.id_store, self._base_tx, block_size=id_block_size,

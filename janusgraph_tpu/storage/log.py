@@ -88,16 +88,18 @@ class KCVSLog:
         #: at (reference: KCVSLog times from the cluster TimestampProvider)
         self.timestamps = timestamps or TimestampProviders.NANO
         #: log.read-lag-ms: pullers stop this far behind now, so a message
-        #: stamped in the window still counts as "not yet visible" — with
-        #: coarse timestamp resolutions a same-tick late flush from another
-        #: sender would otherwise sort below the cursor and be skipped
+        #: stamped in the window still counts as "not yet visible". The
+        #: race is STAMP-TO-FLUSH delay, independent of resolution: a
+        #: message is stamped at add() but flushes up to send_interval
+        #: later, and a cross-sender message stamped earlier but flushed
+        #: later would sort below the advanced cursor and be skipped
         #: forever (reference: KCVSLog maxReadTime / read-lag-time).
-        #: auto (-1): 0 for NANO stamps (same-tick cross-sender collisions
-        #: are impossible, and added read latency would be pure cost),
-        #: 500ms for coarser resolutions (covers send-batch flush delay)
+        #: auto (-1): 3x the send interval (covers the batch flush delay
+        #: with margin) + one resolution tick for coarse stamps.
         if read_lag_ms < 0:
             read_lag_ms = (
-                0.0 if self.timestamps is TimestampProviders.NANO else 500.0
+                3.0 * send_interval_ms
+                + self.timestamps.resolution_ns / 1e6
             )
         self._read_lag_ns = int(read_lag_ms * 1e6)
         self.read_only = read_only

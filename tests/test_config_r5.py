@@ -148,3 +148,36 @@ def test_remote_connect_timeout_and_id_retries_wire():
     assert g.backend.id_authority.max_retries == 7
     g.close()
     server.stop()
+
+
+def test_read_only_open_writes_nothing():
+    """A read-only open must leave the store byte-identical: no instance
+    registration, no global-config freeze writes, no id claims."""
+    from janusgraph_tpu.storage.inmemory import InMemoryStoreManager
+
+    mgr = InMemoryStoreManager()
+    g = open_graph({"storage.backend": "inmemory"}, store_manager=mgr)
+    tx = g.new_transaction()
+    tx.add_vertex(name="pre")
+    tx.commit()
+    g.close()
+
+    def snapshot():
+        out = {}
+        for name, store in mgr._stores.items():
+            rows = {}
+            for key, row in store._rows.items():
+                rows[key] = (tuple(row.columns), tuple(row.values))
+            out[name] = rows
+        return out
+
+    before = snapshot()
+    ro = open_graph(
+        {"storage.backend": "inmemory", "storage.read-only": True},
+        store_manager=mgr,
+    )
+    tx = ro.new_transaction()
+    assert len(list(tx.vertices())) == 1
+    tx.rollback()
+    ro.close()
+    assert snapshot() == before

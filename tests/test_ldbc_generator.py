@@ -72,3 +72,42 @@ def test_cc_and_filtered_3hop_run_on_proxy():
         np.asarray(r_cpu["count"], np.float64), rtol=1e-5,
     )
     assert float(np.asarray(r_tpu["count"]).sum()) > 0
+
+
+def test_ldbc_sf_sized_proxy():
+    """ldbc_sf_csr hits the documented SF1 dimensions (scaled) and keeps
+    the SNB shape: community structure + heavy-tailed degrees."""
+    import numpy as np
+
+    from janusgraph_tpu.olap.generators import LDBC_SF_SIZES, ldbc_sf_csr
+
+    csr = ldbc_sf_csr(1, scale_down=32)  # 100k / 540k — CI-sized
+    nv, ne = LDBC_SF_SIZES[1]
+    assert csr.num_vertices == nv // 32
+    assert csr.num_edges == ne // 32  # lands EXACTLY (_land_edge_count)
+    assert "community" in csr.properties
+    deg = np.diff(csr.out_indptr)
+    # heavy tail: p99 well above the mean
+    assert np.percentile(deg, 99) > 4 * deg.mean()
+
+
+def test_twitter_shaped_proxy_power_law():
+    import numpy as np
+
+    from janusgraph_tpu.olap.generators import twitter_csr
+
+    csr = twitter_csr(1 << 15, 30)
+    assert csr.num_edges == (1 << 15) * 30  # exact (_land_edge_count)
+    ind = np.diff(csr.in_indptr)
+    # celebrity hubs: the top account is followed by >1% of all users
+    assert ind.max() > csr.num_vertices * 0.01
+    # power-law tail: CCDF log-log slope ~ -(2.3 - 1)
+    x = ind[ind >= 10].astype(float)
+    uniq = np.unique(x)
+    ccdf = np.array([(x >= v).mean() for v in uniq])
+    slope = np.polyfit(np.log(uniq), np.log(ccdf), 1)[0]
+    assert -1.8 < slope < -0.9, slope
+    # determinism
+    a = twitter_csr(1 << 12, 20, seed=3)
+    b = twitter_csr(1 << 12, 20, seed=3)
+    assert np.array_equal(a.in_src, b.in_src)
