@@ -71,6 +71,8 @@ def cmd_server(args) -> int:
         host=args.host,
         port=args.port,
         max_request_bytes=graph.config.get("server.max-request-bytes"),
+        max_query_length=graph.config.get("server.max-query-length"),
+        request_timeout_s=graph.config.get("server.request-timeout-s"),
     ).start()
     print(f"JanusGraph-TPU server listening on {args.host}:{server.port}")
     try:

@@ -410,6 +410,36 @@ QUERY_NS.option(
     "query.fast-property / PROPERTY_PREFETCHING; read in tx.get_properties)",
     True, Mutability.MASKABLE,
 )
+METRICS_NS.option(
+    "slow-query-threshold-ms", float,
+    "traversal executions slower than this bump the query.slow counter "
+    "(0 = off; read in GraphTraversal._execute)", 0.0,
+    Mutability.MASKABLE, lambda v: v >= 0,
+)
+SERVER_NS.option(
+    "max-query-length", int,
+    "refuse submitted queries longer than this many characters (bounds "
+    "AST parse cost; read in the server eval path)", 65536,
+    Mutability.MASKABLE, lambda v: v > 0,
+)
+SERVER_NS.option(
+    "request-timeout-s", float,
+    "per-connection socket timeout of the HTTP/WS handlers (0 = no "
+    "timeout: idle WebSocket sessions live indefinitely)", 120.0,
+    Mutability.MASKABLE, lambda v: v >= 0,
+)
+TX_NS.option(
+    "read-only-default", bool,
+    "new transactions default to read-only (pairs with storage.read-only "
+    "replicas; read in new_transaction)", False, Mutability.MASKABLE,
+)
+SCHEMA.option(
+    "eviction-ack-timeout-ms", float,
+    "how long a schema change waits for every open instance to "
+    "acknowledge the cache-eviction broadcast (reference: "
+    "ManagementLogger ack tracking)", 5000.0,
+    Mutability.MASKABLE, lambda v: v > 0,
+)
 QUERY_NS.option(
     "max-repeat-loops", int,
     "graph-wide bound on until-only repeat() loops (cycles would never "

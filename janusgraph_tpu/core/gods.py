@@ -36,7 +36,7 @@ def load(graph) -> None:
     mgmt.build_composite_index("name", ["name"], unique=True)
     mgmt.build_composite_index("age", ["age"])
 
-    tx = graph.new_transaction()
+    tx = graph.new_transaction(read_only=False)
     saturn = tx.add_vertex("titan", name="saturn", age=10000)
     sky = tx.add_vertex("location", name="sky")
     sea = tx.add_vertex("location", name="sea")

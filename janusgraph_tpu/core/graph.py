@@ -304,6 +304,11 @@ class JanusGraphTPU:
         self.instance_id = (
             cfg.get("graph.unique-instance-id") or generate_instance_id()
         )
+        # resolved ONCE at open: _execute is the hottest path and a
+        # MASKABLE get() can fall through to a store read per call
+        self._slow_query_threshold_ms = cfg.get(
+            "metrics.slow-query-threshold-ms"
+        )
         self._metric_reporters = []
         self.instance_registry = InstanceRegistry(self.backend)
         if not self.backend.read_only:
@@ -422,13 +427,16 @@ class JanusGraphTPU:
     # ------------------------------------------------------------- lifecycle
     def new_transaction(
         self,
-        read_only: bool = False,
+        read_only: Optional[bool] = None,
         log_identifier: Optional[str] = None,
         metrics_group: Optional[str] = None,
     ) -> Transaction:
         """`metrics_group` routes this transaction's operation counts under
         `<metrics.prefix>.<group>.*` (reference: per-tx metric groups,
-        StandardJanusGraphTx.java:258-262 / groupName())."""
+        StandardJanusGraphTx.java:258-262 / groupName()).
+        `read_only` defaults to tx.read-only-default."""
+        if read_only is None:
+            read_only = self.config.get("tx.read-only-default")
         return Transaction(
             self,
             read_only=read_only,

@@ -112,7 +112,7 @@ def import_graphson(
     id_map: Dict[int, int] = {}
     nv = ne = 0
     nv_committed = ne_committed = 0
-    tx = graph.new_transaction()
+    tx = graph.new_transaction(read_only=False)
     pending = 0
 
     def maybe_commit():
@@ -121,7 +121,7 @@ def import_graphson(
         if pending >= batch_size:
             tx.commit()
             nv_committed, ne_committed = nv, ne
-            tx = graph.new_transaction()
+            tx = graph.new_transaction(read_only=False)
             pending = 0
 
     def add_edge_record(obj):

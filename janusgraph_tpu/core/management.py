@@ -748,10 +748,18 @@ class ManagementSystem:
         return self.graph.load_all_schema_elements()
 
     # -------------------------------------------------------- schema eviction
-    def broadcast_eviction(self, schema_id: int, timeout_s: float = 5.0) -> bool:
+    def broadcast_eviction(
+        self, schema_id: int, timeout_s: Optional[float] = None,
+    ) -> bool:
         """Tell every open instance to drop `schema_id` from its caches and
         wait for their acknowledgements (reference: ManagementLogger.java:287
-        eviction broadcast + ack tracking)."""
+        eviction broadcast + ack tracking). `timeout_s` defaults to
+        schema.eviction-ack-timeout-ms."""
+        if timeout_s is None:
+            timeout_s = (
+                self.graph.config.get("schema.eviction-ack-timeout-ms")
+                / 1000.0
+            )
         ml = self.graph.management_logger
         evict_id = ml.broadcast_eviction(schema_id)
         expected = len(self.open_instances())

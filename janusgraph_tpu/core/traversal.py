@@ -1583,6 +1583,9 @@ class GraphTraversal:
         # must not accumulate aggregate()/store() contents across runs
         self._side_effects.clear()
         run = observe if observe is not None else (lambda _label, fn, ts: fn(ts))
+        import time as _time
+
+        t0 = _time.perf_counter()
         ts = run("start", lambda _: self._start.run(self._pre_has), None)
         init = getattr(self.source, "_sack_init", None)
         if init is not None:
@@ -1590,6 +1593,13 @@ class GraphTraversal:
                 t.sack = init()
         for step in self._steps:
             ts = run(getattr(step, "_label", "step"), step, ts)
+        # metrics.slow-query-threshold-ms: observability for outlier
+        # traversals; resolved once at graph open (hot path)
+        thr = getattr(self.tx.graph, "_slow_query_threshold_ms", 0.0)
+        if thr > 0 and (_time.perf_counter() - t0) * 1000.0 > thr:
+            from janusgraph_tpu.util.metrics import metrics as _mm
+
+            _mm.counter("query.slow").inc()
         return ts
 
     def profile(self):

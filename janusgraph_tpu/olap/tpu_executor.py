@@ -1016,7 +1016,7 @@ def write_back(graph, csr: CSRGraph, result: Dict[str, np.ndarray], keys=None, b
 def _write_back_tx(graph, vids, name, values, batch: int) -> None:
     values = np.asarray(values, dtype=np.float64)
     for lo in range(0, len(vids), batch):
-        tx = graph.new_transaction()
+        tx = graph.new_transaction(read_only=False)  # write-back writes
         for i in range(lo, min(lo + batch, len(vids))):
             v = tx.get_vertex(int(vids[i]))
             if v is not None:

@@ -121,7 +121,7 @@ class ConfiguredGraphFactory:
         name = config.get("graph.graphname")
         if not name:
             raise ConfigurationError("config must set graph.graphname")
-        tx = self.management_graph.new_transaction()
+        tx = self.management_graph.new_transaction(read_only=False)
         src = self.management_graph.traversal()
         existing = src.V().has(self.NAME_KEY, name).to_list()
         if existing:

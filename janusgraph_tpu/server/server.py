@@ -31,6 +31,10 @@ from typing import Optional
 
 from janusgraph_tpu.driver.graphson import graphson_dumps
 from janusgraph_tpu.server.auth import AuthenticationError
+
+
+class QueryTooLongError(ValueError):
+    """Submitted query exceeds server.max-query-length (maps to 413)."""
 from janusgraph_tpu.server.manager import JanusGraphManager
 
 _WS_MAGIC = "258EAFA5-E914-47DA-95CA-C5AB0DC85B11"
@@ -95,6 +99,8 @@ class JanusGraphServer:
         host: str = "127.0.0.1",
         port: int = 0,
         max_request_bytes: int = 1 << 20,
+        max_query_length: int = 65536,
+        request_timeout_s: float = 120.0,
     ):
         self.manager = manager or JanusGraphManager.get_instance()
         self.default_graph = default_graph
@@ -103,6 +109,10 @@ class JanusGraphServer:
         self._port = port
         #: server.max-request-bytes — HTTP body / WS frame size ceiling
         self.max_request_bytes = max_request_bytes
+        #: server.max-query-length — bounds AST parse cost
+        self.max_query_length = max_query_length
+        #: server.request-timeout-s — per-connection socket timeout
+        self.request_timeout_s = request_timeout_s
         self._httpd: Optional[ThreadingHTTPServer] = None
         self._thread: Optional[threading.Thread] = None
 
@@ -116,6 +126,8 @@ class JanusGraphServer:
 
         class Handler(_Handler):
             jg_server = server
+            # socket read timeout; 0 = disabled (None = stdlib no-timeout)
+            timeout = server.request_timeout_s or None
 
         self._httpd = ThreadingHTTPServer((self.host, self._port), Handler)
         self._thread = threading.Thread(
@@ -151,6 +163,11 @@ class JanusGraphServer:
     def execute(self, query: str, graph_name: Optional[str] = None):
         from janusgraph_tpu.core.traversal import GraphTraversalSource
 
+        if len(query) > self.max_query_length:
+            raise QueryTooLongError(
+                f"query length {len(query)} exceeds server.max-query-length "
+                f"({self.max_query_length})"
+            )
         ns = self._namespace(query, graph_name)
         try:
             return _evaluate(query, ns)
@@ -209,6 +226,13 @@ class _Handler(BaseHTTPRequestHandler):
             result = self.jg_server.execute(query, graph)
             data = json.loads(graphson_dumps(result))
             return {"result": {"data": data}, "status": {"code": 200}}
+        except QueryTooLongError as e:
+            # client error, like the 413 for max-request-bytes — a retry
+            # of the identical oversized query can never succeed
+            return {
+                "result": {"data": None},
+                "status": {"code": 413, "message": str(e)},
+            }
         except Exception as e:  # noqa: BLE001 - surface to client
             return {
                 "result": {"data": None},

@@ -181,3 +181,52 @@ def test_read_only_open_writes_nothing():
     tx.rollback()
     ro.close()
     assert snapshot() == before
+
+
+def test_batch2_options_wire_through():
+    """Round-5 batch 2: slow-query counter, tx read-only default, server
+    query-length cap, eviction-ack timeout."""
+    import time as _t
+
+    from janusgraph_tpu.util.metrics import metrics as mm
+
+    g = open_graph({
+        "storage.backend": "inmemory",
+        "metrics.slow-query-threshold-ms": 0.0001,
+        "tx.read-only-default": True,
+        "schema.eviction-ack-timeout-ms": 750.0,
+    })
+    tx = g.new_transaction()          # defaults read-only now
+    assert tx.read_only
+    tx.rollback()
+    tx = g.new_transaction(read_only=False)
+    v = tx.add_vertex(name="n")
+    tx.commit()
+
+    before = mm.counter("query.slow").count
+    g.traversal().V().has("name", "n").to_list()
+    assert mm.counter("query.slow").count > before  # threshold ~0: fires
+
+    # eviction-ack timeout actually reaches wait_for_acks (ms -> s)
+    ml = g.management_logger
+    captured = {}
+    orig = ml.wait_for_acks
+    ml.wait_for_acks = (
+        lambda eid, exp, t: captured.setdefault("timeout_s", t) or True
+    )
+    try:
+        g.management().broadcast_eviction(12345)
+    finally:
+        ml.wait_for_acks = orig
+    assert captured["timeout_s"] == pytest.approx(0.75)
+
+    # server query-length cap
+    from janusgraph_tpu.server.manager import JanusGraphManager
+    from janusgraph_tpu.server.server import JanusGraphServer, QueryTooLongError
+
+    mgr = JanusGraphManager()
+    mgr.put_graph("graph", g)
+    srv = JanusGraphServer(manager=mgr, max_query_length=10)
+    with pytest.raises(QueryTooLongError, match="max-query-length"):
+        srv.execute("g.V().has('name','n').count()")
+    g.close()
