@@ -206,3 +206,29 @@ def test_columnar_write_back_non_float_key_keeps_schema_type():
     with pytest.raises(SchemaViolationError):
         write_back(g, FakeCSR, {"hops": np.arange(5, dtype=np.float64)})
     g.close()
+
+
+def test_ingestion_timing_s16_localstore(tmp_path):
+    """Always-on scale rung on the PERSISTENT local store (the s18 gate's
+    backend at 1/4 size — CI exercises the WAL+snapshot scale path every
+    run; VERDICT r4 weak #8)."""
+    from janusgraph_tpu.storage.localstore import open_local_kcvs
+
+    mgr = open_local_kcvs(str(tmp_path / "s16"), fsync=False)
+    g = open_graph(store_manager=mgr)
+    _populate(g, 16)
+
+    t0 = time.perf_counter()
+    csr = load_csr(g)
+    load_s = time.perf_counter() - t0
+    assert csr.num_vertices == 1 << 16 and csr.num_edges > 1_000_000
+
+    t0 = time.perf_counter()
+    write_back(
+        g, csr, {"rank": np.random.default_rng(0).random(csr.num_vertices)}
+    )
+    wb_s = time.perf_counter() - t0
+    print(f"\ns16/localstore: load_csr {load_s:.2f}s, write_back {wb_s:.2f}s")
+    assert load_s < 30.0 / 4  # s16 is 1/4 of the s18 gate
+    assert wb_s < 10.0 / 4
+    g.close()
