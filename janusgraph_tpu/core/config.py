@@ -229,6 +229,14 @@ GRAPH.option(
     "cluster-unique id of this open instance (auto-generated when empty)", "",
 )
 GRAPH.option(
+    "set-vertex-id", bool,
+    "allow callers to supply their own vertex ids "
+    "(tx.add_vertex(vertex_id=...); bulk loaders needing deterministic "
+    "ids — reference: graph.set-vertex-id). Custom ids bypass the id "
+    "authority; collision avoidance is the operator's responsibility",
+    False, Mutability.FIXED,
+)
+GRAPH.option(
     "timestamps", str,
     "resolution of storage-visible timestamps (reference: "
     "TimestampProviders + graph.timestamps): nano | micro | milli — "

@@ -188,12 +188,65 @@ class Transaction:
         if self.read_only:
             raise ReadOnlyTransactionError("read-only transaction")
 
-    def add_vertex(self, label: Optional[str] = None, **props) -> Vertex:
+    def add_vertex(
+        self,
+        label: Optional[str] = None,
+        vertex_id: Optional[int] = None,
+        **props,
+    ) -> Vertex:
+        """`vertex_id`: caller-chosen id, permitted only under
+        graph.set-vertex-id=true (reference: graph.set-vertex-id — bulk
+        loaders that need deterministic ids). Must be a well-formed
+        NORMAL user vertex id not already present; custom ids bypass the
+        id authority, so mixing them with authority-assigned ids is the
+        operator's responsibility (same contract as the reference)."""
         self._check_writable()
+        if vertex_id is not None:
+            # validate BEFORE label resolution: a rejected call must not
+            # auto-create the label as a side effect
+            if not self.graph.config.get("graph.set-vertex-id"):
+                raise InvalidElementError(
+                    "custom vertex ids require graph.set-vertex-id=true"
+                )
+            idm = self.graph.idm
+            from janusgraph_tpu.core.ids import VertexIDType
+
+            if (
+                not idm.is_user_vertex_id(vertex_id)
+                or idm.id_type(vertex_id) is not VertexIDType.NORMAL
+            ):
+                raise InvalidElementError(
+                    f"{vertex_id} is not a well-formed NORMAL user vertex "
+                    "id — build one with graph.idm.make_vertex_id(count, "
+                    "partition) (reference: IDManager.toVertexId only "
+                    "produces normal-family ids)"
+                )
+            if vertex_id in self._removed_vertices:
+                raise InvalidElementError(
+                    f"vertex id {vertex_id} was removed in this "
+                    "transaction — commit the removal first"
+                )
+            if self.get_vertex(vertex_id) is not None:
+                raise InvalidElementError(
+                    f"vertex id {vertex_id} already exists"
+                )
+            existing_label = self.graph.schema_cache.get_by_name(
+                label or "vertex"
+            )
+            if existing_label is not None and getattr(
+                existing_label, "partitioned", False
+            ):
+                raise InvalidElementError(
+                    "custom vertex ids cannot target a PARTITIONED label "
+                    "(vertex-cut copies derive their own id family)"
+                )
         label_el = self.graph.get_or_create_vertex_label(label or "vertex")
-        vid = self.graph.id_assigner.assign_vertex_id(
-            partitioned=label_el.partitioned, label=label_el, props=props
-        )
+        if vertex_id is not None:
+            vid = vertex_id
+        else:
+            vid = self.graph.id_assigner.assign_vertex_id(
+                partitioned=label_el.partitioned, label=label_el, props=props
+            )
         v = Vertex(vid, self, LifeCycle.NEW)
         v._label_cache = label_el.name
         with self._lock:

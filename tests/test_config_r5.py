@@ -230,3 +230,76 @@ def test_batch2_options_wire_through():
     with pytest.raises(QueryTooLongError, match="max-query-length"):
         srv.execute("g.V().has('name','n').count()")
     g.close()
+
+
+def test_set_vertex_id():
+    """graph.set-vertex-id: caller-chosen vertex ids (reference:
+    graph.set-vertex-id + IDManager.toVertexId)."""
+    from janusgraph_tpu.exceptions import InvalidElementError
+
+    g = open_graph({"storage.backend": "inmemory"})
+    tx = g.new_transaction()
+    with pytest.raises(InvalidElementError, match="set-vertex-id"):
+        tx.add_vertex(vertex_id=g.idm.make_vertex_id(7, 3))
+    tx.rollback()
+    g.close()
+
+    g = open_graph({
+        "storage.backend": "inmemory", "graph.set-vertex-id": True,
+    })
+    tx = g.new_transaction()
+    vid = g.idm.make_vertex_id(7, 3)
+    v = tx.add_vertex(vertex_id=vid, name="pinned")
+    assert v.id == vid
+    w = tx.add_vertex(name="assigned")  # authority path still works
+    tx.add_edge(v, "knows", w)
+    tx.commit()
+
+    tx = g.new_transaction()
+    got = tx.get_vertex(vid)
+    assert got is not None and got.value("name") == "pinned"
+    from janusgraph_tpu.core.codecs import Direction
+
+    assert [
+        e.in_vertex.id
+        for e in tx.get_edges(got, Direction.OUT, ("knows",))
+    ] == [w.id]
+    # duplicate refuses
+    with pytest.raises(InvalidElementError, match="already exists"):
+        tx.add_vertex(vertex_id=vid)
+    # malformed refuses (schema-marked id)
+    with pytest.raises(InvalidElementError, match="well-formed"):
+        tx.add_vertex(vertex_id=-5)
+    tx.rollback()
+    g.close()
+
+
+def test_set_vertex_id_edge_cases():
+    """Custom-id guards: NORMAL family only, no removed-in-tx re-adds, no
+    partitioned labels, and no label auto-creation on rejection."""
+    from janusgraph_tpu.core.ids import VertexIDType
+    from janusgraph_tpu.exceptions import InvalidElementError
+
+    g = open_graph({
+        "storage.backend": "inmemory", "graph.set-vertex-id": True,
+    })
+    tx = g.new_transaction()
+    # partitioned-family id refused
+    pid = g.idm.make_vertex_id(3, 0, VertexIDType.PARTITIONED)
+    with pytest.raises(InvalidElementError, match="NORMAL"):
+        tx.add_vertex(vertex_id=pid)
+    # removed-in-tx id refused
+    v = tx.add_vertex(vertex_id=g.idm.make_vertex_id(9, 1))
+    tx.remove_vertex(v)
+    with pytest.raises(InvalidElementError, match="removed in this"):
+        tx.add_vertex(vertex_id=v.id)
+    # rejection must not auto-create the label
+    with pytest.raises(InvalidElementError, match="NORMAL"):
+        tx.add_vertex(label="typo_label", vertex_id=pid)
+    assert g.schema_cache.get_by_name("typo_label") is None
+    # partitioned label refused for custom ids
+    g.management().make_vertex_label("cut", partitioned=True)
+    with pytest.raises(InvalidElementError, match="PARTITIONED"):
+        tx.add_vertex(label="cut", vertex_id=g.idm.make_vertex_id(11, 1))
+    tx.rollback()
+    g.close()
