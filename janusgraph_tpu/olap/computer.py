@@ -149,6 +149,7 @@ class GraphComputer:
 
     def traverse(
         self, *spec, seed_filters=None, paths=False, source_as=None,
+        sack=None, sack_init=None,
     ) -> "GraphComputer":
         """OLAP traversal shortcut (the TraversalVertexProgram analogue):
         compute().traverse(("out", ["knows"]), ("in", None)).submit() counts
@@ -161,10 +162,17 @@ class GraphComputer:
         `paths=True` additionally records per-step reach masks device-side
         so the result supports `.paths()` / `.select()` (host traverser
         bookkeeping; olap_traversal.enumerate_paths). `source_as` names
-        path position 0 for select()."""
+        path position 0 for select().
+
+        `sack="sum"|"mult"` carries a per-traverser sack folded with the
+        edge weight each hop (withSack().sack(op).by(weight)); pair with
+        .weight(key) so the CSR ships the weight column. result.states
+        ["sack"][v] = total sack mass of the traversers at v."""
         # defer program construction to submit(): filter masks need the
         # loaded CSR's property columns
-        self._traverse_args = (spec, seed_filters, paths, source_as)
+        self._traverse_args = (
+            spec, seed_filters, paths, source_as, sack, sack_init,
+        )
         self._program = None
         return self
 
@@ -179,7 +187,7 @@ class GraphComputer:
                 steps_from_spec,
             )
 
-            spec, seed_filters, _paths, _src_as = traverse_args
+            spec, seed_filters = traverse_args[0], traverse_args[1]
             fkeys = {f.key for f in _parse_filters(seed_filters)}
             for st in steps_from_spec(self.graph, spec):
                 fkeys.update(f.key for f in st.filters)
@@ -199,10 +207,12 @@ class GraphComputer:
                 build_olap_traversal,
             )
 
-            spec, seed_filters, want_paths, source_as = traverse_args
+            spec, seed_filters, want_paths, source_as, sack, sack_init = (
+                traverse_args
+            )
             self._program = build_olap_traversal(
                 self.graph, csr, spec, seed_filters=seed_filters,
-                record_reach=want_paths,
+                record_reach=want_paths, sack=sack, sack_init=sack_init,
             )
         cfg = getattr(self.graph, "config", None)
         run_kwargs = {}

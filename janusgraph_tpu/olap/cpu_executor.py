@@ -18,9 +18,9 @@ import numpy as np
 from janusgraph_tpu.olap.csr import CSRGraph
 from janusgraph_tpu.olap.vertex_program import (
     Combiner,
-    EdgeTransform,
     Memory,
     VertexProgram,
+    apply_edge_transform,
 )
 
 
@@ -57,11 +57,10 @@ class CPUExecutor:
             aggregated = np.full(agg_shape, identity, dtype=np.float64)
 
             def deliver(dst: int, src: int, weight):
-                msg = outgoing[src]
-                if program.edge_transform == EdgeTransform.MUL_WEIGHT:
-                    msg = msg * weight
-                elif program.edge_transform == EdgeTransform.ADD_WEIGHT:
-                    msg = msg + weight
+                msg = apply_edge_transform(
+                    np, outgoing[src], weight,
+                    program.edge_transform, program.edge_transform_cols,
+                )
                 aggregated[dst] = _combine(op, aggregated[dst], msg)
 
             ch_name = program.channel_for(step)

@@ -29,7 +29,11 @@ from typing import List, Optional, Tuple
 
 import numpy as np
 
-from janusgraph_tpu.olap.vertex_program import Combiner, EdgeTransform
+from janusgraph_tpu.olap.vertex_program import (
+    Combiner,
+    EdgeTransform,
+    apply_edge_transform,
+)
 
 
 # --------------------------------------------------------------------------
@@ -223,16 +227,20 @@ def ell_aggregate(
     msgs,
     op: str,
     edge_transform: str = EdgeTransform.NONE,
+    edge_transform_cols=None,
 ):
     """Aggregate per-vertex messages over an ELLPack.
 
     msgs: (n,) or (n, k) per-source message array. Returns (n,) / (n, k)
     aggregated-by-destination, monoid identity where a vertex has no edges.
+    `edge_transform_cols`: per-column transforms for k-column messages
+    (see vertex_program.apply_edge_transform).
     """
     identity = Combiner.IDENTITY[op]
     if not pack.has_weight:
         # mirror the segment path: transforms only apply when weights exist
         edge_transform = EdgeTransform.NONE
+        edge_transform_cols = None
     # sentinel slot so padded indices read the identity
     pad_shape = (1,) + tuple(msgs.shape[1:])
     msgs_ext = jnp.concatenate(
@@ -245,15 +253,17 @@ def ell_aggregate(
             # weighted pack: apply the transform, then force padded slots
             # back to the identity (a transform can disturb it, e.g.
             # identity*0 = nan for MIN's +inf)
-            if m.ndim == 3:
-                w_ = w[:, :, None]
-                valid_ = valid[:, :, None]
+            valid_ = valid[:, :, None] if m.ndim == 3 else valid
+            if edge_transform_cols is not None:
+                m = apply_edge_transform(
+                    jnp, m, w, edge_transform, edge_transform_cols
+                )
             else:
-                w_, valid_ = w, valid
-            if edge_transform == EdgeTransform.MUL_WEIGHT:
-                m = m * w_
-            elif edge_transform == EdgeTransform.ADD_WEIGHT:
-                m = m + w_
+                w_ = w[:, :, None] if m.ndim == 3 else w
+                if edge_transform == EdgeTransform.MUL_WEIGHT:
+                    m = m * w_
+                elif edge_transform == EdgeTransform.ADD_WEIGHT:
+                    m = m + w_
             m = jnp.where(valid_ > 0, m, identity)
         # unweighted pack: padded slots index the sentinel, which already
         # reads the identity — no mask needed

@@ -21,6 +21,7 @@ from typing import Dict, Optional, Sequence, Tuple
 from janusgraph_tpu.olap.vertex_program import (
     Combiner,
     EdgeChannel,
+    EdgeTransform,
     VertexProgram,
 )
 
@@ -176,6 +177,8 @@ class OLAPTraversalProgram(VertexProgram):
         seed_mask=None,
         step_masks=None,
         record_reach: bool = False,
+        sack: Optional[str] = None,
+        sack_init: Optional[float] = None,
     ):
         """`seed_mask`: (n,) {0,1} array filtering the start set (the
         g.V().has(...) head). `step_masks`: (n, S) array, column k the
@@ -208,6 +211,32 @@ class OLAPTraversalProgram(VertexProgram):
         #: reachability host enumeration walks backward over
         #: (enumerate_paths; SURVEY §7 hard part (a)'s hybrid design)
         self.record_reach = record_reach
+        #: OLAP-side sack (TinkerPop withSack().sack(op).by('weight')):
+        #: state["sack"][v] = total sack mass of the traversers at v.
+        #:   "sum"  — each hop adds the edge weight per traverser:
+        #:            S'[v] = Σ_{u→v} (S[u] + w·c[u]); message columns
+        #:            [count, sack, count] ride per-column transforms
+        #:            (NONE, NONE, MUL_WEIGHT) — the third column carries
+        #:            the cross-term Σ w·c (apply_edge_transform)
+        #:   "mult" — each hop multiplies by the edge weight:
+        #:            S'[v] = Σ S[u]·w; columns [count, sack] with
+        #:            (NONE, MUL_WEIGHT)
+        if sack not in (None, "sum", "mult"):
+            raise ValueError(f"unknown sack op {sack!r} (sum|mult)")
+        self.sack = sack
+        self.sack_init = (
+            sack_init if sack_init is not None
+            else (0.0 if sack == "sum" else 1.0)
+        )
+        if sack == "sum":
+            self.edge_transform_cols = (
+                EdgeTransform.NONE, EdgeTransform.NONE,
+                EdgeTransform.MUL_WEIGHT,
+            )
+        elif sack == "mult":
+            self.edge_transform_cols = (
+                EdgeTransform.NONE, EdgeTransform.MUL_WEIGHT,
+            )
         self.max_iterations = len(self.steps)
         # one named channel per step; labels=None channels still express
         # per-step direction through the same machinery
@@ -231,6 +260,8 @@ class OLAPTraversalProgram(VertexProgram):
         if self._seed_mask is not None:
             count = count * self._slice_local(self._seed_mask, graph, xp)
         state = {"count": count}
+        if self.sack is not None:
+            state["sack"] = count * self.sack_init
         if self.has_step_masks:
             state["step_masks"] = self._slice_local(
                 self._step_masks, graph, xp
@@ -260,6 +291,14 @@ class OLAPTraversalProgram(VertexProgram):
         return s
 
     def message(self, state, superstep, graph, xp):
+        if self.sack == "sum":
+            # [count, sack, count]: the 3rd column rides MUL_WEIGHT and
+            # aggregates to the cross-term Σ w·c (see __init__)
+            return xp.stack(
+                [state["count"], state["sack"], state["count"]], axis=1
+            )
+        if self.sack == "mult":
+            return xp.stack([state["count"], state["sack"]], axis=1)
         return state["count"]
 
     def apply(self, state, aggregated, superstep, memory_in, graph, xp):
@@ -267,11 +306,23 @@ class OLAPTraversalProgram(VertexProgram):
         # step's has()-filter mask zeroes the vertices it rejects. Column
         # select by the (traced) superstep index keeps ONE executable per
         # channel; leading axis stays n so shard-by-vertex layouts hold.
-        new = {"count": aggregated}
+        if self.sack == "sum":
+            new = {
+                "count": aggregated[:, 0],
+                # S' = Σ S[u] + Σ w·c[u]
+                "sack": aggregated[:, 1] + aggregated[:, 2],
+            }
+        elif self.sack == "mult":
+            new = {"count": aggregated[:, 0], "sack": aggregated[:, 1]}
+        else:
+            new = {"count": aggregated}
         if self.has_step_masks:
             masks = state["step_masks"]
             col = xp.clip(superstep, 0, masks.shape[1] - 1)
-            new["count"] = aggregated * masks[:, col]
+            new["count"] = new["count"] * masks[:, col]
+            if self.sack is not None:
+                # rejected traversers take their sack mass with them
+                new["sack"] = new["sack"] * masks[:, col]
             new["step_masks"] = masks
         if self.record_reach:
             # one-hot column write (xp-agnostic: no .at[] in numpy) —
@@ -298,6 +349,8 @@ def build_olap_traversal(
     seeds=None,
     seed_filters=None,
     record_reach: bool = False,
+    sack: Optional[str] = None,
+    sack_init: Optional[float] = None,
 ) -> "OLAPTraversalProgram":
     """Compile a filtered traversal spec against a CSR snapshot:
     `g.V().has(seed_filters...).out(...).has(...)...` as one BSP program
@@ -322,12 +375,23 @@ def build_olap_traversal(
     seed_indices = None
     if seeds is not None:
         seed_indices = [csr.index_of(v) for v in seeds]
+    if sack is not None and (
+        csr.in_edge_weight is None and csr.out_edge_weight is None
+    ):
+        # fail fast like TinkerPop's .by('weight') on a missing key —
+        # silently folding w=1 would produce plausible wrong numbers
+        raise ValueError(
+            f"sack={sack!r} folds edge weights but the CSR snapshot "
+            "carries none — load with compute().weight(<property key>)"
+        )
     return OLAPTraversalProgram(
         steps,
         seed_indices=seed_indices,
         seed_mask=seed_mask,
         step_masks=step_masks,
         record_reach=record_reach,
+        sack=sack,
+        sack_init=sack_init,
     )
 
 

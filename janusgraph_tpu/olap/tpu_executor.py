@@ -25,6 +25,7 @@ from janusgraph_tpu.olap.vertex_program import (
     EdgeTransform,
     Memory,
     VertexProgram,
+    apply_edge_transform,
 )
 
 
@@ -550,11 +551,10 @@ class TPUExecutor:
             has_weight = pack_meta.has_weight
 
         def aggregate(outgoing, src_idx, dst_seg, weight):
-            msgs = outgoing[src_idx]
-            if program.edge_transform == EdgeTransform.MUL_WEIGHT and weight is not None:
-                msgs = msgs * (weight[:, None] if msgs.ndim == 2 else weight)
-            elif program.edge_transform == EdgeTransform.ADD_WEIGHT and weight is not None:
-                msgs = msgs + (weight[:, None] if msgs.ndim == 2 else weight)
+            msgs = apply_edge_transform(
+                jnp, outgoing[src_idx], weight,
+                program.edge_transform, program.edge_transform_cols,
+            )
             return _segment_reduce(jnp, op, msgs, dst_seg, n)
 
         def pallas_aggregate(outgoing, gv):
@@ -589,7 +589,8 @@ class TPUExecutor:
                     gargs["ell"], bucket_slots, gargs["unpermute"], has_weight
                 )
                 agg = ell_aggregate(
-                    jnp, pv, outgoing, op, program.edge_transform
+                    jnp, pv, outgoing, op, program.edge_transform,
+                    program.edge_transform_cols,
                 )
             elif strategy == "pallas" and outgoing.ndim == 1:
                 agg = pallas_aggregate(outgoing, gv)

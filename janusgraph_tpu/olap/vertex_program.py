@@ -34,6 +34,7 @@ from __future__ import annotations
 
 from dataclasses import dataclass, field
 from types import MappingProxyType
+from functools import lru_cache
 from typing import Dict, Mapping, Optional, Tuple
 
 
@@ -53,6 +54,66 @@ class EdgeTransform:
     NONE = "none"
     MUL_WEIGHT = "mul"   # msg * w  (e.g. weighted pagerank)
     ADD_WEIGHT = "add"   # msg + w  (e.g. shortest path)
+
+
+@lru_cache(maxsize=64)
+def _col_masks(cols):
+    """Per-column {0,1} transform masks, cached as NUMPY — the CPU oracle
+    calls the transform once per edge delivery, and caching xp arrays
+    would leak tracers out of jit scopes."""
+    import numpy as _np
+
+    mul = _np.asarray(
+        [1.0 if t == EdgeTransform.MUL_WEIGHT else 0.0 for t in cols],
+        dtype=_np.float32,
+    )
+    add = _np.asarray(
+        [1.0 if t == EdgeTransform.ADD_WEIGHT else 0.0 for t in cols],
+        dtype=_np.float32,
+    )
+    return mul, add
+
+
+def apply_edge_transform(xp, msgs, w, transform, cols=None):
+    """Apply a program's in-flight edge transform — THE one shared
+    implementation (cpu/tpu-segment/ELL/sharded bodies all route here so
+    per-column semantics can never drift between executors).
+
+    `msgs`: (..., k) message columns or (...) scalars, `w`: per-edge
+    weights broadcastable to msgs minus its column axis (None = pass).
+    With `cols` (= program.edge_transform_cols) set and k-column
+    messages, column j rides its own transform: masked as
+      msgs * (1 + (w-1)*mul_j) + w*add_j
+    (branch-free — compiles to two broadcasts under jit).
+    """
+    if w is None:
+        return msgs
+    w = xp.asarray(w)
+    if cols is not None:
+        # the program contract: with per-column transforms, messages ARE
+        # k-column and the LAST axis is the column axis in every layout
+        # (flat (E,k), ELL (rows,c,k), oracle row (k,))
+        k = msgs.shape[-1]
+        if len(cols) != k:
+            raise ValueError(
+                f"edge_transform_cols has {len(cols)} entries for "
+                f"{k}-column messages"
+            )
+        mul_np, add_np = _col_masks(cols)
+        mul = xp.asarray(mul_np, dtype=msgs.dtype)
+        add = xp.asarray(add_np, dtype=msgs.dtype)
+        shape = (1,) * (msgs.ndim - 1) + (k,)
+        wb = w[..., None]
+        # where-select, NOT msgs*(1+(w-1)*mul): the algebraic form absorbs
+        # |w-1| below float32 eps and mis-scales tiny weights 100%
+        return xp.where(
+            mul.reshape(shape) > 0, msgs * wb, msgs
+        ) + wb * add.reshape(shape)
+    if transform == EdgeTransform.MUL_WEIGHT:
+        return msgs * (w[..., None] if msgs.ndim > w.ndim else w)
+    if transform == EdgeTransform.ADD_WEIGHT:
+        return msgs + (w[..., None] if msgs.ndim > w.ndim else w)
+    return msgs
 
 
 @dataclass(frozen=True)
@@ -97,6 +158,12 @@ class VertexProgram:
       compute_keys    — state entries that write-back persists as properties
       combiner        — Combiner monoid (or override combiner_for per phase)
       edge_transform  — EdgeTransform applied to messages in flight
+      edge_transform_cols — per-COLUMN EdgeTransforms for 2-D messages
+                        (overrides edge_transform; the substrate for
+                        OLAP-side sack: one message column can ride
+                        MUL_WEIGHT while the traverser-count column
+                        passes untransformed). SUM combiner only — the
+                        post-transform identity masking is uniform.
       undirected      — aggregate over both edge orientations
       max_iterations  — hard superstep cap
     """
@@ -104,6 +171,7 @@ class VertexProgram:
     compute_keys: Tuple[str, ...] = ()
     combiner: str = Combiner.SUM
     edge_transform: str = EdgeTransform.NONE
+    edge_transform_cols: Optional[Tuple[str, ...]] = None
     undirected: bool = False
     max_iterations: int = 100
 

@@ -42,9 +42,9 @@ import numpy as np
 from janusgraph_tpu.olap.csr import CSRGraph
 from janusgraph_tpu.olap.vertex_program import (
     Combiner,
-    EdgeTransform,
     Memory,
     VertexProgram,
+    apply_edge_transform,
 )
 
 _ELL_MAX_CAPACITY = 1 << 14
@@ -729,12 +729,10 @@ class ShardedExecutor:
                 dst = jax.lax.dynamic_slice(g["ring_dst"], (start,), (Eo,))
                 valid = jax.lax.dynamic_slice(g["ring_valid"], (start,), (Eo,))
                 weight = jax.lax.dynamic_slice(g["ring_weight"], (start,), (Eo,))
-                msgs = block[src]
-                w = weight[:, None] if msgs.ndim == 2 else weight
-                if program.edge_transform == EdgeTransform.MUL_WEIGHT:
-                    msgs = msgs * w
-                elif program.edge_transform == EdgeTransform.ADD_WEIGHT:
-                    msgs = msgs + w
+                msgs = apply_edge_transform(
+                    jnp, block[src], weight,
+                    program.edge_transform, program.edge_transform_cols,
+                )
                 mask = valid[:, None] if msgs.ndim == 2 else valid
                 msgs = jnp.where(mask > 0, msgs, identity)
                 part = seg_reduce(msgs, dst)
@@ -798,14 +796,12 @@ class ShardedExecutor:
                     if wm is not None:
                         # weighted pack: transform, then re-assert the
                         # identity on padded slots (see kernels.py)
-                        if m.ndim == 3:
-                            wm_, va_ = wm[:, :, None], va[:, :, None]
-                        else:
-                            wm_, va_ = wm, va
-                        if program.edge_transform == EdgeTransform.MUL_WEIGHT:
-                            m = m * wm_
-                        elif program.edge_transform == EdgeTransform.ADD_WEIGHT:
-                            m = m + wm_
+                        va_ = va[:, :, None] if m.ndim == 3 else va
+                        m = apply_edge_transform(
+                            jnp, m, wm,
+                            program.edge_transform,
+                            program.edge_transform_cols,
+                        )
                         m = jnp.where(va_ > 0, m, identity)
                     r = reduce_cols(m, 1)
                     if n_slots is not None:
@@ -818,10 +814,10 @@ class ShardedExecutor:
             else:
                 msgs = tab[g["src_idx"]]
                 weight, valid = g["weight"], g["valid"]
-                if program.edge_transform == EdgeTransform.MUL_WEIGHT:
-                    msgs = msgs * (weight[:, None] if msgs.ndim == 2 else weight)
-                elif program.edge_transform == EdgeTransform.ADD_WEIGHT:
-                    msgs = msgs + (weight[:, None] if msgs.ndim == 2 else weight)
+                msgs = apply_edge_transform(
+                    jnp, msgs, weight,
+                    program.edge_transform, program.edge_transform_cols,
+                )
                 vmask = valid[:, None] if msgs.ndim == 2 else valid
                 msgs = jnp.where(vmask > 0, msgs, identity)
                 agg_v = seg_reduce(msgs, g["dst_loc"])

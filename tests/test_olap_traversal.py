@@ -490,3 +490,122 @@ def test_olap_paths_limit_zero_and_duplicate_label(g):
     ).submit()
     with pytest.raises(ValueError, match="duplicate as"):
         list(dup.select("x"))
+
+
+# --------------------------------------------------------------------- sack
+# OLAP-side sack (withSack().sack(op).by(weight)): per-column edge
+# transforms carry [count, sack(, w*count)] through one BSP run.
+
+
+def test_olap_sack_matches_enumeration_all_executors(mesh8):
+    from janusgraph_tpu.olap.csr import csr_from_edges
+    from janusgraph_tpu.olap.programs.olap_traversal import TraversalStep
+
+    rng = np.random.default_rng(5)
+    n, m = 60, 200
+    src = rng.integers(0, n, m).astype(np.int32)
+    dst = rng.integers(0, n, m).astype(np.int32)
+    w = rng.uniform(0.5, 2.0, m).astype(np.float32)
+    csr = csr_from_edges(n, src, dst, weights=w)
+
+    adj = [[] for _ in range(n)]
+    for s, d, wt in zip(src, dst, w):
+        adj[s].append((int(d), float(wt)))
+    per_v_sum = np.zeros(n)
+    per_v_mult = np.zeros(n)
+    for a in range(n):
+        for b, w1 in adj[a]:
+            for c, w2 in adj[b]:
+                per_v_sum[c] += w1 + w2
+                per_v_mult[c] += w1 * w2
+
+    steps = (TraversalStep("out"), TraversalStep("out"))
+    for make in (
+        lambda p: CPUExecutor(csr).run(p),
+        lambda p: TPUExecutor(csr).run(p),
+        lambda p: ShardedExecutor(csr, mesh=mesh8).run(p),
+    ):
+        rs = make(OLAPTraversalProgram(steps, sack="sum"))
+        np.testing.assert_allclose(
+            np.asarray(rs["sack"], np.float64), per_v_sum,
+            rtol=1e-3, atol=1e-4,
+        )
+        rm = make(OLAPTraversalProgram(steps, sack="mult"))
+        np.testing.assert_allclose(
+            np.asarray(rm["sack"], np.float64), per_v_mult,
+            rtol=1e-3, atol=1e-4,
+        )
+
+
+def test_olap_sack_matches_oltp_oracle(g):
+    """g.withSack(0).V().outE('battled').sack(sum w).inV() — OLTP folds
+    per traverser; the OLAP total sack mass must agree."""
+    csr = load_csr(g, weight_key="time")
+    prog = OLAPTraversalProgram(
+        steps_from_spec(g, [("out", ["battled"])]), sack="sum",
+    )
+    res = CPUExecutor(csr).run(prog)
+    olap_total = float(np.asarray(res["sack"], np.float64).sum())
+
+    # OLTP oracle via edge iteration (sack == sum of traversed weights)
+    tx = g.new_transaction()
+    from janusgraph_tpu.core.codecs import Direction
+
+    total = 0.0
+    for v in tx.vertices():
+        for e in tx.get_edges(v, Direction.OUT, ("battled",)):
+            total += float(e.value("time"))
+    tx.rollback()
+    assert olap_total == pytest.approx(total, rel=1e-6)
+
+
+def test_olap_sack_with_filters_and_facade(g):
+    """Facade: compute().weight('time').traverse(..., sack='sum') — step
+    filters drop rejected traversers' sack mass too."""
+    from janusgraph_tpu.core.predicates import Cmp
+
+    res = g.compute(executor="cpu").weight("time").traverse(
+        ("out", ["battled"], [("name", Cmp.EQUAL, "hydra")]),
+        sack="sum",
+    ).submit()
+    # only the hercules->hydra battle (time=2) survives the filter
+    tx = g.new_transaction()
+    from janusgraph_tpu.core.codecs import Direction
+
+    want = 0.0
+    for v in tx.vertices():
+        for e in tx.get_edges(v, Direction.OUT, ("battled",)):
+            if e.in_vertex.value("name") == "hydra":
+                want += float(e.value("time"))
+    tx.rollback()
+    assert float(
+        np.asarray(res.states["sack"], np.float64).sum()
+    ) == pytest.approx(want, rel=1e-6)
+    assert np.asarray(res.states["count"]).sum() == 1
+
+
+def test_olap_sack_tiny_weight_exact_and_unweighted_refused(g):
+    """Per-column MUL must stay exact for |w-1| below f32 eps (the
+    where-select form), and sack on a weightless CSR fails fast."""
+    import numpy as np
+
+    from janusgraph_tpu.olap.vertex_program import (
+        EdgeTransform,
+        apply_edge_transform,
+    )
+
+    msgs = np.ones((1, 2), np.float32)
+    w = np.asarray([1e-8], np.float32)
+    out = apply_edge_transform(
+        np, msgs, w, EdgeTransform.NONE,
+        (EdgeTransform.NONE, EdgeTransform.MUL_WEIGHT),
+    )
+    assert out[0, 0] == 1.0 and out[0, 1] == np.float32(1e-8)
+
+    from janusgraph_tpu.olap.programs.olap_traversal import (
+        build_olap_traversal,
+    )
+
+    csr = load_csr(g)  # no weight_key -> no weight column
+    with pytest.raises(ValueError, match="weight"):
+        build_olap_traversal(g, csr, [("out", ["battled"])], sack="sum")
