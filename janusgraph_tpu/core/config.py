@@ -345,8 +345,22 @@ COMPUTER_NS.option(
     1 << 14, Mutability.MASKABLE, lambda v: v >= 8,
 )
 COMPUTER_NS.option(
-    "executor", str, "default executor for graph.compute() ('tpu'|'cpu')",
-    "tpu", Mutability.MASKABLE, lambda v: v in ("tpu", "cpu"),
+    "executor", str,
+    "default executor for graph.compute(): 'tpu' (single device), "
+    "'sharded' (mesh over every visible device), 'cpu' (scalar oracle)",
+    "tpu", Mutability.MASKABLE, lambda v: v in ("tpu", "cpu", "sharded"),
+)
+COMPUTER_NS.option(
+    "exchange", str,
+    "sharded-executor message exchange: boundary-bucket all_to_all, "
+    "ppermute ring streaming, or full all_gather (debug)", "a2a",
+    Mutability.MASKABLE, lambda v: v in ("a2a", "ring", "gather"),
+)
+COMPUTER_NS.option(
+    "agg", str,
+    "sharded-executor local aggregation: uniform degree-bucketed ELL or "
+    "flat segment reduction (ring/gather require 'segment')", "ell",
+    Mutability.MASKABLE, lambda v: v in ("ell", "segment"),
 )
 COMPUTER_NS.option(
     "write-back-batch", int,

@@ -216,6 +216,15 @@ class GraphComputer:
             )
         cfg = getattr(self.graph, "config", None)
         run_kwargs = {}
+        if cfg is not None and self.executor_kind == "sharded":
+            run_kwargs = {
+                "sync_every": cfg.get("computer.sync-every"),
+                "checkpoint_every": cfg.get("computer.checkpoint-every"),
+                "checkpoint_path": cfg.get("computer.checkpoint-path") or None,
+                "frontier": cfg.get("computer.frontier"),
+                "exchange": cfg.get("computer.exchange"),
+                "agg": cfg.get("computer.agg"),
+            }
         if cfg is not None and self.executor_kind == "tpu":
             run_kwargs = {
                 "strategy": cfg.get("computer.strategy"),
@@ -265,11 +274,25 @@ def run_on(
     frontier_cc_min_edges: int = None,
     frontier_f_min: int = None,
     frontier_e_min: int = None,
+    exchange: str = "a2a",
+    agg: str = "ell",
 ):
     if executor == "cpu":
         from janusgraph_tpu.olap.cpu_executor import CPUExecutor
 
         return CPUExecutor(csr).run(program)
+    if executor == "sharded":
+        from janusgraph_tpu.parallel import ShardedExecutor
+
+        return ShardedExecutor(
+            csr, exchange=exchange, agg=agg,
+        ).run(
+            program,
+            sync_every=sync_every,
+            checkpoint_every=checkpoint_every,
+            checkpoint_path=checkpoint_path,
+            frontier=frontier,
+        )
     if executor == "tpu":
         from janusgraph_tpu.olap.tpu_executor import TPUExecutor
 

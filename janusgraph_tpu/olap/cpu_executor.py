@@ -39,6 +39,11 @@ class CPUExecutor:
         self.graph = graph
 
     def run(self, program: VertexProgram) -> Dict[str, np.ndarray]:
+        from janusgraph_tpu.olap.vertex_program import (
+            check_weighted_transforms,
+        )
+
+        check_weighted_transforms(program, self.graph)
         g = self.graph
         n = g.num_vertices
         memory = Memory()
@@ -74,12 +79,12 @@ class CPUExecutor:
                     g, program.edge_channels[ch_name]
                 )
                 for e in range(len(ch_src)):
-                    w = float(ch_w[e]) if ch_w is not None else 1.0
+                    w = float(ch_w[e]) if ch_w is not None else None
                     deliver(int(ch_dst[e]), int(ch_src[e]), w)
             else:
                 for i in range(n):
                     for e in range(g.in_indptr[i], g.in_indptr[i + 1]):
-                        w = g.in_edge_weight[e] if g.in_edge_weight is not None else 1.0
+                        w = g.in_edge_weight[e] if g.in_edge_weight is not None else None
                         deliver(i, int(g.in_src[e]), w)
                 if program.undirected:
                     for i in range(n):
@@ -87,7 +92,7 @@ class CPUExecutor:
                             w = (
                                 g.out_edge_weight[e]
                                 if g.out_edge_weight is not None
-                                else 1.0
+                                else None
                             )
                             deliver(i, int(g.out_dst[e]), w)
 

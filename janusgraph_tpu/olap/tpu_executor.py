@@ -708,6 +708,11 @@ class TPUExecutor:
         a failed Fulgora iteration aborts outright).
         """
         jnp = self.jnp
+        from janusgraph_tpu.olap.vertex_program import (
+            check_weighted_transforms,
+        )
+
+        check_weighted_transforms(program, self.csr)
         if frontier not in (None, "auto", "off", "always"):
             raise ValueError(f"unknown frontier mode: {frontier!r}")
         mode = frontier or self._frontier_cfg

@@ -56,6 +56,22 @@ class EdgeTransform:
     ADD_WEIGHT = "add"   # msg + w  (e.g. shortest path)
 
 
+def check_weighted_transforms(program, csr) -> None:
+    """Executors call this at run() entry: a program declaring per-column
+    weight transforms over a weightless CSR would otherwise silently
+    compute as if no transform existed (every executor skips transforms
+    when weights are absent) — plausible wrong numbers, not an error."""
+    cols = getattr(program, "edge_transform_cols", None)
+    if cols and any(t != EdgeTransform.NONE for t in cols):
+        if csr.in_edge_weight is None and csr.out_edge_weight is None:
+            raise ValueError(
+                f"{type(program).__name__} declares per-column weight "
+                "transforms but the CSR snapshot carries no edge weights "
+                "— load with a weight key (compute().weight(key) / "
+                "load_csr(weight_key=...))"
+            )
+
+
 @lru_cache(maxsize=64)
 def _col_masks(cols):
     """Per-column {0,1} transform masks, cached as NUMPY — the CPU oracle

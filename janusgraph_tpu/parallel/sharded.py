@@ -730,7 +730,9 @@ class ShardedExecutor:
                 valid = jax.lax.dynamic_slice(g["ring_valid"], (start,), (Eo,))
                 weight = jax.lax.dynamic_slice(g["ring_weight"], (start,), (Eo,))
                 msgs = apply_edge_transform(
-                    jnp, block[src], weight,
+                    # ones-materialized pad weights must NOT transform on a
+                    # weightless view — None matches every other executor
+                    jnp, block[src], weight if sc.has_weight else None,
                     program.edge_transform, program.edge_transform_cols,
                 )
                 mask = valid[:, None] if msgs.ndim == 2 else valid
@@ -815,7 +817,7 @@ class ShardedExecutor:
                 msgs = tab[g["src_idx"]]
                 weight, valid = g["weight"], g["valid"]
                 msgs = apply_edge_transform(
-                    jnp, msgs, weight,
+                    jnp, msgs, weight if sc.has_weight else None,
                     program.edge_transform, program.edge_transform_cols,
                 )
                 vmask = valid[:, None] if msgs.ndim == 2 else valid
@@ -1007,6 +1009,11 @@ class ShardedExecutor:
         the dense path: frontier runs are short)."""
         import jax.numpy as jnp
 
+        from janusgraph_tpu.olap.vertex_program import (
+            check_weighted_transforms,
+        )
+
+        check_weighted_transforms(program, self.csr)
         if frontier not in ("auto", "off", "always"):
             raise ValueError(f"unknown frontier mode: {frontier!r}")
         from janusgraph_tpu.olap.tpu_executor import TPUExecutor

@@ -609,3 +609,46 @@ def test_olap_sack_tiny_weight_exact_and_unweighted_refused(g):
     csr = load_csr(g)  # no weight_key -> no weight column
     with pytest.raises(ValueError, match="weight"):
         build_olap_traversal(g, csr, [("out", ["battled"])], sack="sum")
+
+
+def test_compute_facade_sharded_executor(g):
+    """graph.compute(executor='sharded'): the mesh executor behind the
+    same facade (computer.executor config or explicit arg), with
+    computer.exchange/agg selecting the comm/agg strategy."""
+    from janusgraph_tpu.olap.programs import PageRankProgram
+
+    res = g.compute(executor="sharded").traverse(
+        ("out", ["father"]), ("out", ["father"])
+    ).submit()
+    assert int(np.asarray(res.states["count"]).sum()) == oltp_count(
+        g, [("out", ["father"]), ("out", ["father"])]
+    )
+    # config-driven default executor + ring exchange
+    g.config.local["computer.executor"] = "sharded"
+    g.config.local["computer.exchange"] = "ring"
+    g.config.local["computer.agg"] = "segment"
+    res2 = g.compute().program(
+        PageRankProgram(max_iterations=5, tol=0.0)
+    ).submit()
+    cpu = g.compute(executor="cpu").program(
+        PageRankProgram(max_iterations=5, tol=0.0)
+    ).submit()
+    np.testing.assert_allclose(
+        np.asarray(res2.states["rank"], np.float64),
+        np.asarray(cpu.states["rank"], np.float64), rtol=1e-4, atol=1e-6,
+    )
+
+
+def test_sack_on_weightless_csr_refused_by_every_executor(g, mesh8):
+    """The guard lives at run() entry, not just the builder: direct
+    OLAPTraversalProgram construction cannot silently fold w=1."""
+    csr = load_csr(g)  # weightless
+    prog = OLAPTraversalProgram(
+        steps_from_spec(g, [("out", ["battled"])]), sack="sum",
+    )
+    for ex in (
+        CPUExecutor(csr), TPUExecutor(csr),
+        ShardedExecutor(csr, mesh=mesh8),
+    ):
+        with pytest.raises(ValueError, match="no edge weights"):
+            ex.run(prog)
