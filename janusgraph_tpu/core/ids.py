@@ -76,18 +76,27 @@ SCHEMA_TYPES = (
 )
 
 
+#: low-3-bits -> normal-family type (plain dict: this is THE hottest id
+#: decode — enum property descriptors cost ~2x the arithmetic around them)
+_NORMAL_BY_LOW = {
+    0b000: VertexIDType.NORMAL,
+    0b010: VertexIDType.PARTITIONED,
+    0b100: VertexIDType.UNMODIFIABLE,
+}
+
+
 def _suffix_of(vid: int) -> VertexIDType:
-    if vid & SCHEMA_MARK == SCHEMA_MARK:
+    low = vid & 0b111
+    if low == SCHEMA_MARK:
         kind = (vid >> 3) & 0b111
         t = _SCHEMA_KINDS.get(kind)
         if t is None:
             raise InvalidIDError(f"unknown schema kind in id {vid}")
         return t
-    low = vid & 0b111
-    for t in (VertexIDType.NORMAL, VertexIDType.PARTITIONED, VertexIDType.UNMODIFIABLE):
-        if low == t.suffix:
-            return t
-    raise InvalidIDError(f"unrecognized id suffix in {vid}")
+    t = _NORMAL_BY_LOW.get(low)
+    if t is None:
+        raise InvalidIDError(f"unrecognized id suffix in {vid}")
+    return t
 
 
 @dataclass(frozen=True)
@@ -99,10 +108,14 @@ class IDManager:
     def __post_init__(self):
         if not (0 <= self.partition_bits <= 16):
             raise InvalidIDError("partition_bits must be in [0, 16]")
+        # frozen dataclass: the memo rides object.__setattr__ (it is pure
+        # derived state, not identity — hashing/eq stay field-based)
+        object.__setattr__(self, "_key_cache", {})
+        object.__setattr__(self, "_num_partitions", 1 << self.partition_bits)
 
     @property
     def num_partitions(self) -> int:
-        return 1 << self.partition_bits
+        return self._num_partitions
 
     def count_bits(self, id_type: VertexIDType) -> int:
         return TOTAL_BITS - self.partition_bits - id_type.suffix_bits
@@ -185,18 +198,30 @@ class IDManager:
         ]
 
     # -- key <-> id ---------------------------------------------------------
+    #: get_key memo bound — the render is pure, OLTP touches the same
+    #: vertices repeatedly, and ~90 bytes/entry keeps 1M entries < 100MB
+    KEY_CACHE_MAX = 1 << 20
+
     def get_key(self, vid: int) -> bytes:
         """8-byte BE row key with the partition moved to the top bits, making
-        each partition a contiguous key range (reference: IDManager.getKey:480)."""
+        each partition a contiguous key range (reference: IDManager.getKey:480).
+        Memoized: the hottest decode on the OLTP write path (one decode +
+        one render per relation endpoint per cell)."""
+        key = self._key_cache.get(vid)
+        if key is not None:
+            return key
         if vid <= 0:
             raise InvalidIDError(f"cannot make key for non-positive id {vid}")
         t = _suffix_of(vid)
-        partition = self.get_partition_id(vid)
-        count = self.get_count(vid)
-        rest_bits = TOTAL_BITS - self.partition_bits
-        rest = (count << t.suffix_bits) | t.suffix
-        key_int = (partition << rest_bits) | rest
-        return key_int.to_bytes(8, "big")
+        suffix, suffix_bits = t.value  # plain tuple: skip enum descriptors
+        partition = (vid >> suffix_bits) & (self.num_partitions - 1)
+        count = vid >> (suffix_bits + self.partition_bits)
+        rest = (count << suffix_bits) | suffix
+        key_int = (partition << (TOTAL_BITS - self.partition_bits)) | rest
+        key = key_int.to_bytes(8, "big")
+        if len(self._key_cache) < self.KEY_CACHE_MAX:
+            self._key_cache[vid] = key
+        return key
 
     def get_keys_array(self, vids) -> "list":
         """Vectorized get_key for USER vertex ids (3-bit suffix): one numpy

@@ -57,15 +57,22 @@ class EdgeTransform:
 
 
 def check_weighted_transforms(program, csr) -> None:
-    """Executors call this at run() entry: a program declaring per-column
-    weight transforms over a weightless CSR would otherwise silently
-    compute as if no transform existed (every executor skips transforms
-    when weights are absent) — plausible wrong numbers, not an error."""
+    """Executors call this at run() entry: a program declaring weight
+    transforms (scalar edge_transform OR per-column cols) over a
+    weightless CSR would otherwise silently compute as if no transform
+    existed (every executor skips transforms when weights are absent) —
+    plausible wrong numbers, not an error. E.g. weighted SSSP on a
+    weightless snapshot would relax every distance to 0."""
     cols = getattr(program, "edge_transform_cols", None)
-    if cols and any(t != EdgeTransform.NONE for t in cols):
+    wants_weights = bool(
+        cols and any(t != EdgeTransform.NONE for t in cols)
+    ) or getattr(
+        program, "edge_transform", EdgeTransform.NONE
+    ) != EdgeTransform.NONE
+    if wants_weights:
         if csr.in_edge_weight is None and csr.out_edge_weight is None:
             raise ValueError(
-                f"{type(program).__name__} declares per-column weight "
+                f"{type(program).__name__} declares weight-dependent edge "
                 "transforms but the CSR snapshot carries no edge weights "
                 "— load with a weight key (compute().weight(key) / "
                 "load_csr(weight_key=...))"

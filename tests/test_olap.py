@@ -247,3 +247,21 @@ def test_degree_count_parity():
             np.asarray(got["out_degree"]),
             csr.out_degree.astype(np.float32),
         )
+
+
+def test_weighted_program_on_weightless_csr_refused():
+    """check_weighted_transforms: a weighted SSSP over a snapshot with no
+    weight column fails fast instead of relaxing every distance to 0."""
+    import pytest
+
+    from janusgraph_tpu.olap import csr_from_edges
+    from janusgraph_tpu.olap.cpu_executor import CPUExecutor
+    from janusgraph_tpu.olap.programs import ShortestPathProgram
+
+    csr = csr_from_edges(
+        4, np.asarray([0, 1, 2]), np.asarray([1, 2, 3])
+    )
+    with pytest.raises(ValueError, match="no edge weights"):
+        CPUExecutor(csr).run(
+            ShortestPathProgram(seed_index=0, weighted=True)
+        )
