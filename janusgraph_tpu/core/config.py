@@ -229,6 +229,34 @@ GRAPH.option(
     "cluster-unique id of this open instance (auto-generated when empty)", "",
 )
 GRAPH.option(
+    "unique-instance-id-suffix", str,
+    "discriminator appended to auto-generated instance ids (reference: "
+    "computeUniqueInstanceId; read in generate_instance_id)", "",
+)
+GRAPH.option(
+    "use-hostname-for-unique-instance-id", bool,
+    "base auto-generated instance ids on the host name so registry "
+    "entries are operator-recognizable", False,
+)
+STORAGE.option(
+    "write-attempts", int,
+    "cap the retry guard's replay COUNT in addition to its time budget "
+    "(0 = time budget only; reference: storage.write-attempts; read by "
+    "the remote client's backend_op.execute calls)",
+    0, Mutability.MASKABLE, lambda v: v >= 0,
+)
+LOCK_NS.option(
+    "clean-expired", bool,
+    "delete expired lock-claim columns encountered during lock checks "
+    "(dead holders' claims otherwise linger; reference: "
+    "ConsistentKeyLocker CLEAN_EXPIRED)", False, Mutability.MASKABLE,
+)
+METRICS_NS.option(
+    "merge-stores", bool,
+    "report store metrics under one 'stores' bucket instead of "
+    "per-store names (reference: metrics.merge-stores)", False,
+)
+GRAPH.option(
     "set-vertex-id", bool,
     "allow callers to supply their own vertex ids "
     "(tx.add_vertex(vertex_id=...); bulk loaders needing deterministic "
@@ -748,8 +776,22 @@ class GraphConfiguration:
 _INSTANCE_PREFIX = "cluster.instance."
 
 
-def generate_instance_id() -> str:
-    return f"{os.getpid():x}-{uuid.uuid4().hex[:12]}"
+def generate_instance_id(suffix: str = "", use_hostname: bool = False) -> str:
+    """Cluster-unique instance id (reference: computeUniqueInstanceId —
+    graph.unique-instance-id-suffix appends a configured discriminator,
+    graph.use-hostname-for-unique-instance-id bases the id on the host
+    name so registrations are operator-recognizable)."""
+    if use_hostname:
+        import socket
+
+        # keep a short random tail: two graphs in one process (or a pid
+        # reused after a crash, racing a stale registration) must still
+        # get distinct registry keys
+        base = socket.gethostname().replace(".", "-")
+        core = f"{base}-{os.getpid():x}-{uuid.uuid4().hex[:6]}"
+    else:
+        core = f"{os.getpid():x}-{uuid.uuid4().hex[:12]}"
+    return f"{core}-{suffix}" if suffix else core
 
 
 class InstanceRegistry:

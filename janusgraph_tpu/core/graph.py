@@ -86,6 +86,7 @@ def _open_remote(cfg):
         parallel_ops=cfg.get("storage.parallel-backend-ops"),
         connect_timeout_s=cfg.get("storage.remote.connect-timeout-ms")
         / 1000.0,
+        max_attempts=cfg.get("storage.write-attempts"),
     )
 
 
@@ -290,6 +291,7 @@ class JanusGraphTPU:
             read_only=cfg.get("storage.read-only"),
             cache_ttl_seconds=(ttl_ms / 1000.0) if ttl_ms > 0 else None,
             metrics_enabled=cfg.get("metrics.enabled"),
+            metrics_merge_stores=cfg.get("metrics.merge-stores"),
             edgestore_cache_fraction=cfg.get("cache.edgestore-fraction"),
         )
         self.idm = IDManager(partition_bits=cfg.get("ids.partition-bits"))
@@ -300,9 +302,15 @@ class JanusGraphTPU:
             wait_ms=cfg.get("locks.wait-ms"),
             expiry_ms=cfg.get("locks.expiry-ms"),
             retries=cfg.get("locks.retries"),
+            clean_expired=cfg.get("locks.clean-expired"),
         )
         self.instance_id = (
-            cfg.get("graph.unique-instance-id") or generate_instance_id()
+            cfg.get("graph.unique-instance-id") or generate_instance_id(
+                suffix=cfg.get("graph.unique-instance-id-suffix"),
+                use_hostname=cfg.get(
+                    "graph.use-hostname-for-unique-instance-id"
+                ),
+            )
         )
         # resolved ONCE at open: _execute is the hottest path and a
         # MASKABLE get() can fall through to a store read per call

@@ -105,6 +105,7 @@ class Backend:
         cache_ttl_seconds: Optional[float] = 10.0,
         cache_clean_wait_seconds: float = 0.0,
         metrics_enabled: bool = False,
+        metrics_merge_stores: bool = False,
         edgestore_cache_fraction: float = 0.8,
         read_only: bool = False,
     ):
@@ -121,8 +122,12 @@ class Backend:
             # Backend.java:184-188 MetricInstrumentedStore wrapping)
             from janusgraph_tpu.util.metrics import MetricInstrumentedStore
 
-            edgestore = MetricInstrumentedStore(edgestore)
-            indexstore = MetricInstrumentedStore(indexstore)
+            edgestore = MetricInstrumentedStore(
+                edgestore, merge_stores=metrics_merge_stores
+            )
+            indexstore = MetricInstrumentedStore(
+                indexstore, merge_stores=metrics_merge_stores
+            )
         if cache_enabled:
             # edge/index cache split like the reference's 80/20
             # (Backend.java:107; cache.edgestore-fraction); the TTL bounds
@@ -193,12 +198,14 @@ class Backend:
                 store.invalidate_all()
 
     def configure_lockers(
-        self, wait_ms: float, expiry_ms: float, retries: int
+        self, wait_ms: float, expiry_ms: float, retries: int,
+        clean_expired: bool = False,
     ) -> None:
         for locker in (self.edge_locker, self.index_locker):
             locker.wait_ms = wait_ms
             locker.expiry_ms = expiry_ms
             locker.retries = retries
+            locker.clean_expired = clean_expired
 
     def begin_transaction(self, config: Optional[dict] = None) -> "BackendTransaction":
         return BackendTransaction(self, self.manager.begin_transaction(config))

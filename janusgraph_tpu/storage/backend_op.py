@@ -33,11 +33,14 @@ def execute(
     max_time_s: float = 10.0,
     base_delay_s: float = None,
     max_delay_s: float = None,
+    max_attempts: int = 0,
 ) -> T:
     """Run `op`, replaying temporary failures with exponential backoff until
     the time budget is spent; the last temporary error is then re-raised.
     Permanent failures propagate immediately (reference:
-    BackendOperation.executeDirect semantics)."""
+    BackendOperation.executeDirect semantics). `max_attempts` (> 0) caps
+    the replay COUNT as well as the time budget — whichever trips first
+    (reference: storage.write-attempts / read-attempts)."""
     deadline = time.monotonic() + max_time_s
     delay = BASE_DELAY_S if base_delay_s is None else base_delay_s
     if max_delay_s is None:
@@ -51,7 +54,7 @@ def execute(
         except TemporaryBackendError:
             attempt += 1
             now = time.monotonic()
-            if now >= deadline:
+            if now >= deadline or (max_attempts and attempt >= max_attempts):
                 raise
             time.sleep(min(delay, max_delay_s, max(0.0, deadline - now)))
             delay *= 2

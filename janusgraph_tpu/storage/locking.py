@@ -123,6 +123,7 @@ class ConsistentKeyLocker:
         wait_ms: float = 1.0,
         expiry_ms: float = 10_000.0,
         retries: int = 3,
+        clean_expired: bool = False,
     ):
         self.store = lock_store
         self._tx_factory = store_tx_factory
@@ -131,6 +132,10 @@ class ConsistentKeyLocker:
         self.wait_ms = wait_ms
         self.expiry_ms = expiry_ms
         self.retries = retries
+        #: locks.clean-expired: delete expired claim columns encountered
+        #: during checks (dead holders' claims otherwise linger until a
+        #: compaction; reference: ConsistentKeyLocker CLEAN_EXPIRED)
+        self.clean_expired = clean_expired
         self._locks: Dict[object, Dict[KeyColumn, _LockStatus]] = {}
         self._guard = threading.Lock()
 
@@ -202,12 +207,19 @@ class ConsistentKeyLocker:
                 KeySliceQuery(row, SliceQuery()), stx
             )
             winner = None
+            stale: list = []
             for col, _val in entries:  # columns sort by timestamp
                 ts = int.from_bytes(col[:8], "big")
                 if ts < cutoff_ns:
-                    continue  # expired claim
+                    stale.append(col)  # expired claim
+                    continue
                 winner = col[8:]
                 break
+            if self.clean_expired and stale:
+                try:  # best-effort: cleanup must never fail the check
+                    self.store.mutate(row, [], stale, stx)
+                except Exception:  # noqa: BLE001
+                    pass
             if winner != self.rid:
                 self._release_target(target, status, tx, stx)
                 raise TemporaryLockingError(

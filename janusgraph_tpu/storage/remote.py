@@ -479,10 +479,12 @@ class RemoteStoreManager(KeyColumnValueStoreManager):
                  retry_time_s: float = 10.0,
                  backoff_base_s: float = None, backoff_max_s: float = None,
                  parallel_ops: bool = True,
-                 connect_timeout_s: float = 30.0):
+                 connect_timeout_s: float = 30.0,
+                 max_attempts: int = 0):
         self.host, self.port = host, port
         self.retry_time_s = retry_time_s
         self.connect_timeout_s = connect_timeout_s
+        self.max_attempts = max_attempts
         #: storage.parallel-backend-ops — client-side multi-slice fan-out
         self.parallel_ops = parallel_ops
         self._pool_executor = None
@@ -534,6 +536,7 @@ class RemoteStoreManager(KeyColumnValueStoreManager):
             max_time_s=self.retry_time_s,
             base_delay_s=self.backoff_base_s,
             max_delay_s=self.backoff_max_s,
+            max_attempts=self.max_attempts,
         )
 
     @property

@@ -235,10 +235,14 @@ class MetricInstrumentedStore(KeyColumnValueStore):
         store: KeyColumnValueStore,
         manager: Optional[MetricManager] = None,
         prefix: str = "storage",
+        merge_stores: bool = False,
     ):
         self._store = store
         self._m = manager if manager is not None else metrics
-        self._prefix = f"{prefix}.{store.name}"
+        # metrics.merge-stores: one "stores" bucket instead of per-store
+        # names (reference: MERGE_BASIC_METRICS / generateName)
+        bucket = "stores" if merge_stores else store.name
+        self._prefix = f"{prefix}.{bucket}"
 
     @property
     def name(self) -> str:

@@ -303,3 +303,88 @@ def test_set_vertex_id_edge_cases():
         tx.add_vertex(label="cut", vertex_id=g.idm.make_vertex_id(11, 1))
     tx.rollback()
     g.close()
+
+
+def test_batch3_options_wire_through():
+    """write-attempts cap, lock clean-expired, instance-id knobs, merged
+    store metrics."""
+    from janusgraph_tpu.exceptions import TemporaryBackendError
+    from janusgraph_tpu.storage import backend_op
+
+    # attempts cap trips before the time budget
+    calls = {"n": 0}
+
+    def flaky():
+        calls["n"] += 1
+        raise TemporaryBackendError("still down")
+
+    with pytest.raises(TemporaryBackendError):
+        backend_op.execute(
+            flaky, max_time_s=60.0, base_delay_s=0.001, max_attempts=3,
+        )
+    assert calls["n"] == 3
+
+    # instance-id generation knobs
+    import socket
+
+    from janusgraph_tpu.core.config import generate_instance_id
+
+    iid = generate_instance_id(suffix="rack7", use_hostname=True)
+    assert iid.endswith("-rack7")
+    assert socket.gethostname().replace(".", "-") in iid
+    g = open_graph({
+        "storage.backend": "inmemory",
+        "graph.unique-instance-id-suffix": "z9",
+    })
+    assert g.instance_id.endswith("-z9")
+    g.close()
+
+    # merged store metrics bucket
+    from janusgraph_tpu.util.metrics import metrics as mm
+
+    g2 = open_graph({
+        "storage.backend": "inmemory",
+        "metrics.enabled": True, "metrics.merge-stores": True,
+    })
+    tx = g2.new_transaction()
+    tx.add_vertex(name="m")
+    tx.commit()
+    names = {
+        n for n in list(mm._timers) if n.startswith("storage.stores.")
+    }
+    assert names, "merged bucket metrics missing"
+    g2.close()
+
+
+def test_lock_clean_expired_removes_stale_claims():
+    import time as _t
+
+    from janusgraph_tpu.storage.inmemory import InMemoryStoreManager
+    from janusgraph_tpu.storage.kcvs import KeySliceQuery, SliceQuery
+    from janusgraph_tpu.storage.locking import (
+        ConsistentKeyLocker,
+        KeyColumn,
+        LocalLockMediator,
+        lock_row_key,
+    )
+
+    mgr = InMemoryStoreManager()
+    store = mgr.open_database("locks")
+    target = KeyColumn(b"k", b"c")
+    row = lock_row_key(target)
+    # a dead holder's EXPIRED claim
+    stale_col = (1).to_bytes(8, "big") + b"deadrid1"
+    store.mutate(row, [(stale_col, b"")], [], mgr.begin_transaction())
+
+    locker = ConsistentKeyLocker(
+        store, mgr.begin_transaction, b"livverid", LocalLockMediator(),
+        wait_ms=0.0, expiry_ms=10_000.0, clean_expired=True,
+    )
+    tx = object()
+    locker.write_lock(target, tx)
+    locker.check_locks(tx)
+    cols = [c for c, _ in store.get_slice(
+        KeySliceQuery(row, SliceQuery()), mgr.begin_transaction()
+    )]
+    assert stale_col not in cols  # cleaned
+    assert any(c.endswith(b"livverid") for c in cols)
