@@ -104,6 +104,16 @@ _CAT_USER_PROP = 1
 _CAT_SYS_EDGE = 2
 _CAT_USER_EDGE = 3
 
+#: hot-decode helpers: compiled Structs skip per-call format parsing and
+#: the table skips IntEnum.__call__ (parse_relation runs once per cell)
+_S_HEADER = struct.Struct(">BQB")
+_S_QQ = struct.Struct(">QQ")
+_DIR_BY_VALUE = {
+    Direction.OUT.value: Direction.OUT,
+    Direction.IN.value: Direction.IN,
+    Direction.BOTH.value: Direction.BOTH,
+}
+
 EDGE_COL_FIXED = 1 + 8 + 1 + 1 + 8 + 8  # cat, type, dir, sklen=0, other, rel
 
 
@@ -254,17 +264,21 @@ class EdgeSerializer:
     def parse_relation(
         self, entry: Entry, schema: SchemaLookup
     ) -> RelationCache:
+        # THE hottest OLTP read decode (one call per cell) — compiled
+        # Structs + a direction lookup table, no enum construction
         col, val = entry
-        cat, type_id, direction = struct.unpack(">BQB", col[:10])
+        cat, type_id, direction = _S_HEADER.unpack_from(col)
+        if direction > 2:  # corrupt cell: keep a diagnosable message
+            raise ValueError(f"{direction} is not a valid Direction byte")
         if cat in (_CAT_SYS_EDGE, _CAT_USER_EDGE):
             sklen = col[10]
             off = 11 + sklen
-            other_vid, rel_id = struct.unpack(">QQ", col[off : off + 16])
+            other_vid, rel_id = _S_QQ.unpack_from(col, off)
             props = self._parse_inline_props(val) if val else None
             return RelationCache(
                 relation_id=rel_id,
                 type_id=type_id,
-                direction=Direction(direction),
+                direction=_DIR_BY_VALUE[direction],
                 other_vertex_id=other_vid,
                 properties=props,
                 sort_key=col[11:off],
