@@ -300,6 +300,12 @@ TX_NS.option(
     Mutability.GLOBAL,
 )
 IDS.option(
+    "renew-timeout-ms", float,
+    "bound the wait for an in-flight background id-block fetch "
+    "(0 = wait forever; reference: ids.renew-timeout; read in "
+    "StandardIDPool.next_id)", 0.0, Mutability.MASKABLE, lambda v: v >= 0,
+)
+IDS.option(
     "authority.max-retries", int,
     "id-block claim attempts before giving up (each pays authority-wait)",
     20, Mutability.MASKABLE, lambda v: v > 0,
@@ -489,6 +495,12 @@ SCHEMA.option(
     "acknowledge the cache-eviction broadcast (reference: "
     "ManagementLogger ack tracking)", 5000.0,
     Mutability.MASKABLE, lambda v: v > 0,
+)
+QUERY_NS.option(
+    "batch", bool,
+    "batched multiQuery prefetch in traversal expansion steps (off = one "
+    "slice read per vertex; reference: query.batch; read in the "
+    "expansion step + tx.prefetch)", True, Mutability.MASKABLE,
 )
 QUERY_NS.option(
     "max-repeat-loops", int,

@@ -163,21 +163,23 @@ class VertexIDAssigner:
         idm: IDManager,
         renew_fraction: Optional[float] = None,
         placement=None,
+        renew_timeout_ms: float = 0.0,
     ):
         from janusgraph_tpu.core.placement import SimpleBulkPlacementStrategy
 
         self.authority = authority
         self.idm = idm
         self.renew_fraction = renew_fraction  # ids.renew-percentage
+        self.renew_timeout_ms = renew_timeout_ms  # ids.renew-timeout-ms
         self.placement = placement or SimpleBulkPlacementStrategy()
         self._vertex_pools: Dict[int, StandardIDPool] = {}
         self._relation_pool = StandardIDPool(
             authority, ConsistentKeyIDAuthority.NS_RELATION, 0,
-            renew_fraction=renew_fraction,
+            renew_fraction=renew_fraction, renew_timeout_ms=renew_timeout_ms,
         )
         self._schema_pool = StandardIDPool(
             authority, ConsistentKeyIDAuthority.NS_SCHEMA, 0,
-            renew_fraction=renew_fraction,
+            renew_fraction=renew_fraction, renew_timeout_ms=renew_timeout_ms,
         )
         self._rr = 0
         self._lock = threading.Lock()
@@ -189,6 +191,7 @@ class VertexIDAssigner:
                 pool = StandardIDPool(
                     self.authority, ConsistentKeyIDAuthority.NS_VERTEX, partition,
                     renew_fraction=self.renew_fraction,
+                    renew_timeout_ms=self.renew_timeout_ms,
                 )
                 self._vertex_pools[partition] = pool
             return pool
@@ -312,11 +315,12 @@ class JanusGraphTPU:
                 ),
             )
         )
-        # resolved ONCE at open: _execute is the hottest path and a
-        # MASKABLE get() can fall through to a store read per call
+        # resolved ONCE at open: these sit on the hottest query paths and
+        # a MASKABLE get() can fall through to a store read per call
         self._slow_query_threshold_ms = cfg.get(
             "metrics.slow-query-threshold-ms"
         )
+        self._query_batch = cfg.get("query.batch")
         self._metric_reporters = []
         self.instance_registry = InstanceRegistry(self.backend)
         if not self.backend.read_only:
@@ -330,6 +334,7 @@ class JanusGraphTPU:
         self.id_assigner = VertexIDAssigner(
             self.backend.id_authority, self.idm,
             renew_fraction=cfg.get("ids.renew-percentage"),
+            renew_timeout_ms=cfg.get("ids.renew-timeout-ms"),
             placement=make_placement_strategy(
                 cfg.get("ids.placement"), cfg.get("ids.placement-key")
             ),

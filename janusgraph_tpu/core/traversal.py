@@ -587,7 +587,8 @@ class GraphTraversal:
 
         def step(ts: List[Traverser]) -> List[Traverser]:
             vs = [t.obj for t in ts if isinstance(t.obj, Vertex)]
-            if sort_range is None:
+            # query.batch, resolved once at graph open (hot path)
+            if sort_range is None and tx.graph._query_batch:
                 tx.prefetch(vs, direction, labels)  # the multiQuery batch
             out: List[Traverser] = []
             for t in ts:

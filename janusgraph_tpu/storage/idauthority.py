@@ -270,11 +270,15 @@ class StandardIDPool:
         partition: int,
         max_id: Optional[int] = None,
         renew_fraction: Optional[float] = None,
+        renew_timeout_ms: float = 0.0,
     ):
         self.authority = authority
         self.namespace = namespace
         self.partition = partition
         self.max_id = max_id
+        #: ids.renew-timeout-ms: bound the wait for an in-flight background
+        #: block fetch (0 = wait forever; reference: ids.renew-timeout)
+        self.renew_timeout_ms = renew_timeout_ms
         self.RENEW_FRACTION = (
             renew_fraction if renew_fraction is not None else type(self).RENEW_FRACTION
         )
@@ -312,7 +316,17 @@ class StandardIDPool:
                     # state, since another thread may have swapped already
                     self._lock.release()
                     try:
-                        t.join()
+                        timeout = (
+                            self.renew_timeout_ms / 1000.0
+                            if self.renew_timeout_ms > 0 else None
+                        )
+                        t.join(timeout)
+                        if t.is_alive():
+                            raise TemporaryBackendError(
+                                "id-block renewal exceeded "
+                                f"ids.renew-timeout-ms "
+                                f"({self.renew_timeout_ms:.0f}ms)"
+                            )
                     finally:
                         self._lock.acquire()
                     if self._next_block is None and self._prefetch_error is not None:

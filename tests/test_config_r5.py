@@ -388,3 +388,48 @@ def test_lock_clean_expired_removes_stale_claims():
     )]
     assert stale_col not in cols  # cleaned
     assert any(c.endswith(b"livverid") for c in cols)
+
+
+def test_query_batch_toggle_and_renew_timeout():
+    """query.batch=False expands per-vertex (no multiQuery prefetch);
+    ids.renew-timeout-ms bounds the prefetch wait."""
+    g = open_graph({"storage.backend": "inmemory", "query.batch": False})
+    tx = g.new_transaction()
+    a, b = tx.add_vertex(name="a"), tx.add_vertex(name="b")
+    tx.add_edge(a, "knows", b)
+    tx.commit()
+    # correctness unchanged without the batch
+    assert g.traversal().V().has("name", "a").out("knows").values(
+        "name"
+    ).to_list() == ["b"]
+    assert g.id_assigner.renew_timeout_ms == 0.0
+    g.close()
+
+    g2 = open_graph({
+        "storage.backend": "inmemory", "ids.renew-timeout-ms": 1234.0,
+    })
+    assert g2.id_assigner.renew_timeout_ms == 1234.0
+    assert g2.id_assigner._relation_pool.renew_timeout_ms == 1234.0
+    g2.close()
+
+    # the timeout actually fires against a hung prefetch
+    import threading
+
+    import pytest as _pytest
+
+    from janusgraph_tpu.exceptions import TemporaryBackendError
+    from janusgraph_tpu.storage.idauthority import StandardIDPool
+
+    class _HungAuthority:
+        block_size = 10
+
+        def get_id_block(self, ns, p):
+            threading.Event().wait(10)  # never returns in test time
+
+    pool = StandardIDPool(_HungAuthority(), 0, 0, renew_timeout_ms=50.0)
+    # force an in-flight prefetch thread that never completes
+    t = threading.Thread(target=lambda: threading.Event().wait(10), daemon=True)
+    t.start()
+    pool._prefetch_thread = t
+    with _pytest.raises(TemporaryBackendError, match="renew-timeout"):
+        pool.next_id()
