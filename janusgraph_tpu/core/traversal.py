@@ -744,6 +744,43 @@ class GraphTraversal:
         self._add(step, name="elementMap")
         return self
 
+    def property(self, key: str, value=None, **props) -> "GraphTraversal":
+        """Set properties on each element traverser (TinkerPop
+        PropertyStep: ``g.V().has(...).property('age', 31)``). Vertex
+        properties respect the key's cardinality (SINGLE replaces, LIST
+        appends, SET dedups — the same semantics as tx.add_property);
+        edge properties replace. Traversers pass through unchanged;
+        mutations join the surrounding transaction — commit as usual."""
+        tx = self.tx
+        kv = dict(props)
+        if key is not None:
+            kv[key] = value
+        if not kv:
+            raise QueryError("property() needs a key/value")
+
+        def step(ts):
+            for t in ts:
+                obj = t.obj
+                if isinstance(obj, Vertex):
+                    for k, v in kv.items():
+                        tx.add_property(obj, k, v)
+                elif isinstance(obj, Edge):
+                    # loaded edges rewrite as delete + re-add: chain the
+                    # LIVE replacement back into the traverser, or every
+                    # downstream step reads/mutates a dead handle
+                    for k, v in kv.items():
+                        obj = obj.set_property(k, v)
+                    t.obj = obj
+                else:
+                    raise QueryError(
+                        "property() requires vertex or edge traversers "
+                        f"(got {type(obj).__name__})"
+                    )
+            return ts
+
+        self._add(step, name=f"property({sorted(kv)})")
+        return self
+
     def drop(self) -> "GraphTraversal":
         """Remove every element on the frontier — vertices (with their
         incident edges), edges, or vertex properties (TinkerPop DropStep).

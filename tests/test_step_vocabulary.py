@@ -271,3 +271,52 @@ def test_drop_step_vertices_edges_properties(g):
     t3.V().has("name", "jupiter").properties("age").drop().to_list()
     t3.tx.commit()
     assert g.traversal().V().has("name", "jupiter").next().value("age") is None
+
+
+def test_property_step_mutates_elements(g):
+    """TinkerPop PropertyStep: g.V().has(...).property('k', v) updates
+    through the traversal; SINGLE cardinality replaces; edges update too."""
+    t = g.traversal()
+    # vertex property (SINGLE: replaces)
+    t.V().has("name", "hercules").property("age", 31).iterate()
+    t.tx.commit()
+    assert g.traversal().V().has("name", "hercules").values(
+        "age"
+    ).to_list() == [31]
+    # multiple kwargs at once
+    t2 = g.traversal()
+    t2.V().has("name", "hercules").property(None, None, age=32).iterate()
+    t2.tx.commit()
+    assert g.traversal().V().has("name", "hercules").values(
+        "age"
+    ).to_list() == [32]
+    # edge property
+    t3 = g.traversal()
+    t3.V().has("name", "hercules").out_e("battled").property(
+        "place_name", "arena"
+    ).iterate()
+    t3.tx.commit()
+    vals = (
+        g.traversal().V().has("name", "hercules").out_e("battled")
+        .values("place_name").to_list()
+    )
+    assert vals == ["arena", "arena", "arena"]
+    # non-element traversers refuse
+    import pytest as _p
+
+    with _p.raises(QueryError, match="property"):
+        g.traversal().V().values("name").property("x", 1).to_list()
+    # same-traversal visibility + drop() must act on the LIVE edge
+    vals = (
+        g.traversal().V().has("name", "hercules").out_e("battled")
+        .property("place_name", "pit").values("place_name").to_list()
+    )
+    assert vals == ["pit", "pit", "pit"]
+    td = g.traversal()
+    td.V().has("name", "hercules").out_e("battled").property(
+        "x", 1
+    ).drop().iterate()
+    td.tx.commit()
+    assert g.traversal().V().has("name", "hercules").out_e(
+        "battled"
+    ).to_list() == []
