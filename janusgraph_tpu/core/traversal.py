@@ -744,6 +744,102 @@ class GraphTraversal:
         self._add(step, name="elementMap")
         return self
 
+    def add_e_(self, label: str, **props) -> "GraphTraversal":
+        """Mid-traversal edge creation (TinkerPop AddEdgeStep):
+        ``g.V().has(...).add_e_('knows').to_(other)`` wires one edge per
+        incoming vertex traverser; the traverser becomes the new Edge.
+        Endpoints: OUT defaults to the incoming vertex, overridable with
+        ``from_``; IN comes from ``to_``. Targets may be a Vertex, a tag
+        name bound with as_(), or an anonymous traversal evaluated from
+        the incoming vertex that must yield exactly ONE vertex. (Named
+        add_e_ — the traversal SOURCE's add_e creates an edge directly.)"""
+        tx = self.tx
+        spec = {"to": None, "from": None}
+        self._last_add_e = spec
+
+        def step(ts):
+            # sub-traversal endpoints compile ONCE per execution, not per
+            # traverser; every resolved endpoint must be a Vertex (an
+            # edge-tagged as_() label would otherwise wire a corrupt edge
+            # that only explodes at commit)
+            compiled = {
+                side: (
+                    self._sub_steps(tgt)
+                    if tgt is not None
+                    and not isinstance(tgt, (Vertex, str))
+                    else None
+                )
+                for side, tgt in spec.items()
+            }
+
+            def resolve(side, t):
+                target = spec[side]
+                if target is None:
+                    return None
+                if isinstance(target, str):  # as_() tag
+                    tags = t.tags or {}
+                    if target not in tags:
+                        raise QueryError(
+                            f"add_e_ endpoint tag {target!r} is not bound"
+                        )
+                    target = tags[target]
+                if isinstance(target, Vertex):
+                    return target
+                if compiled[side] is None:
+                    raise QueryError(
+                        f"add_e_ endpoint must be a vertex "
+                        f"(got {type(target).__name__})"
+                    )
+                hits = [
+                    r.obj for r in self._apply_steps(compiled[side], [t])
+                ]
+                if len(hits) != 1 or not isinstance(hits[0], Vertex):
+                    raise QueryError(
+                        f"add_e_ endpoint must resolve to exactly one "
+                        f"vertex (got "
+                        f"{[type(h).__name__ for h in hits] or 'nothing'})"
+                    )
+                return hits[0]
+
+            out = []
+            for t in ts:
+                v = t.obj
+                if not isinstance(v, Vertex):
+                    raise QueryError(
+                        "add_e_() requires vertex traversers "
+                        f"(got {type(v).__name__})"
+                    )
+                src = resolve("from", t) or v
+                dst = resolve("to", t)
+                if dst is None:
+                    raise QueryError(
+                        "add_e_() needs a to_(target) endpoint"
+                    )
+                e = tx.add_edge(src, label, dst, **props)
+                # prev = the edge's anchoring vertex: other_v() etc. must
+                # see the incident vertex, not the pre-step history
+                out.append(t.child(e, prev=v))
+            return out
+
+        self._add(step, name=f"addE({label})")
+        return self
+
+    def to_(self, target) -> "GraphTraversal":
+        """Bind the IN endpoint of the preceding add_e_() step."""
+        spec = getattr(self, "_last_add_e", None)
+        if spec is None:
+            raise QueryError("to_() must follow add_e_()")
+        spec["to"] = target
+        return self
+
+    def from_(self, target) -> "GraphTraversal":
+        """Bind the OUT endpoint of the preceding add_e_() step."""
+        spec = getattr(self, "_last_add_e", None)
+        if spec is None:
+            raise QueryError("from_() must follow add_e_()")
+        spec["from"] = target
+        return self
+
     def property(self, key: str, value=None, **props) -> "GraphTraversal":
         """Set properties on each element traverser (TinkerPop
         PropertyStep: ``g.V().has(...).property('age', 31)``). Vertex
@@ -766,11 +862,22 @@ class GraphTraversal:
                         tx.add_property(obj, k, v)
                 elif isinstance(obj, Edge):
                     # loaded edges rewrite as delete + re-add: chain the
-                    # LIVE replacement back into the traverser, or every
-                    # downstream step reads/mutates a dead handle
+                    # LIVE replacement back into the traverser — including
+                    # path history and as_() tags, which path()/select()
+                    # read downstream — or they see a dead handle
+                    stale = obj
                     for k, v in kv.items():
                         obj = obj.set_property(k, v)
                     t.obj = obj
+                    if obj is not stale:
+                        t.path = tuple(
+                            obj if p is stale else p for p in t.path
+                        )
+                        if t.tags:
+                            t.tags = {
+                                nm: (obj if tv is stale else tv)
+                                for nm, tv in t.tags.items()
+                            }
                 else:
                     raise QueryError(
                         "property() requires vertex or edge traversers "

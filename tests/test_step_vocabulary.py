@@ -320,3 +320,84 @@ def test_property_step_mutates_elements(g):
     assert g.traversal().V().has("name", "hercules").out_e(
         "battled"
     ).to_list() == []
+
+
+def test_add_e_step_wires_edges(g):
+    """TinkerPop AddEdgeStep: g.V().has(...).add_e_('l').to_(target) — one
+    edge per traverser; targets as Vertex, as_() tag, or sub-traversal."""
+    t = g.traversal()
+    jup = t.V().has("name", "jupiter").next()
+    # vertex target + property
+    t2 = g.traversal()
+    t2.V().has("name", "hercules").add_e_("admires", since=2020).to_(
+        jup
+    ).iterate()
+    t2.tx.commit()
+    edges = g.traversal().V().has("name", "hercules").out_e(
+        "admires"
+    ).to_list()
+    assert len(edges) == 1 and edges[0].value("since") == 2020
+    assert edges[0].in_vertex.value("name") == "jupiter"
+
+    # sub-traversal target, from_ overriding the out endpoint
+    t3 = g.traversal()
+    t3.V().has("name", "hercules").add_e_("patron").from_(
+        __.out("father")
+    ).to_(__.out("mother")).iterate()
+    t3.tx.commit()
+    e = g.traversal().V().has("name", "jupiter").out_e("patron").to_list()
+    assert len(e) == 1 and e[0].in_vertex.value("name") == "alcmene"
+
+    # as_() tag target
+    t4 = g.traversal()
+    t4.V().has("name", "pluto").as_("p").out("brother").add_e_(
+        "rival"
+    ).to_("p").iterate()
+    t4.tx.commit()
+    rivals = {
+        e.out_vertex.value("name")
+        for e in g.traversal().V().has("name", "pluto").in_e("rival").to_list()
+    }
+    assert rivals == {"jupiter", "neptune"}
+
+    # errors: missing to_, ambiguous sub-traversal, non-vertex frontier
+    with pytest.raises(QueryError, match="to_"):
+        g.traversal().V().add_e_("x").to_list()
+    with pytest.raises(QueryError, match="exactly one"):
+        tt = g.traversal()
+        tt.V().has("name", "jupiter").add_e_("x").to_(
+            __.out("brother")  # two brothers
+        ).to_list()
+    with pytest.raises(QueryError, match="vertex traversers"):
+        g.traversal().V().values("name").add_e_("x").to_(jup).to_list()
+
+
+def test_add_e_and_property_handle_liveness(g):
+    """Review regressions: other_v() after add_e_ sees the anchor vertex;
+    edge-tagged endpoints refuse; path()/select() after edge property()
+    carry the LIVE replacement."""
+    t = g.traversal()
+    jup = t.V().has("name", "jupiter").next()
+    # other_v() works right after add_e_
+    others = (
+        g.traversal().V().has("name", "hercules")
+        .add_e_("cheers").to_(jup).other_v().values("name").to_list()
+    )
+    assert others == ["jupiter"]
+    # edge-tagged endpoint refuses loudly instead of corrupting
+    with pytest.raises(QueryError, match="must be a vertex"):
+        (
+            g.traversal().V().has("name", "hercules").out_e("battled")
+            .as_("e").out_v().add_e_("weird").to_("e").iterate()
+        )
+    # path()/select() read the live post-property edge
+    p = (
+        g.traversal().V().has("name", "hercules").out_e("battled")
+        .property("pp", 7).path().to_list()[0]
+    )
+    assert p[-1].value("pp") == 7
+    sel = (
+        g.traversal().V().has("name", "hercules").out_e("battled")
+        .as_("e").property("qq", 8).select("e").to_list()
+    )
+    assert all(e.value("qq") == 8 for e in sel)
