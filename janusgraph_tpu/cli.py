@@ -193,11 +193,12 @@ def cmd_config_docs(args) -> int:
 
 def cmd_export(args) -> int:
     from janusgraph_tpu.core.graph import open_graph
-    from janusgraph_tpu.core.io import export_graphson
+    from janusgraph_tpu.core.io import export_graphml, export_graphson
 
+    fn = export_graphml if args.format == "graphml" else export_graphson
     graph = open_graph(_load_config(args.config))
     try:
-        counts = export_graphson(graph, args.out)
+        counts = fn(graph, args.out)
         print(f"exported {counts['vertices']} vertices, "
               f"{counts['edges']} edges -> {args.out}")
     finally:
@@ -207,14 +208,15 @@ def cmd_export(args) -> int:
 
 def cmd_import(args) -> int:
     from janusgraph_tpu.core.graph import open_graph
-    from janusgraph_tpu.core.io import import_graphson
+    from janusgraph_tpu.core.io import import_graphml, import_graphson
 
     if args.batch < 1:
         print("--batch must be >= 1", file=sys.stderr)
         return 2
+    fn = import_graphml if args.format == "graphml" else import_graphson
     graph = open_graph(_load_config(args.config))
     try:
-        counts = import_graphson(graph, args.infile, batch_size=args.batch)
+        counts = fn(graph, args.infile, batch_size=args.batch)
         print(f"imported {counts['vertices']} vertices, "
               f"{counts['edges']} edges from {args.infile}")
     finally:
@@ -264,16 +266,20 @@ def main(argv=None) -> int:
     pd.set_defaults(fn=cmd_config_docs)
 
     pe = sub.add_parser(
-        "export", help="export a graph to line-delimited GraphSON"
+        "export", help="export a graph (GraphSON or GraphML)"
     )
     # required: a no-config export would truncate the output with a fresh
     # (empty) in-memory graph's contents
     pe.add_argument("--config", required=True, help="graph config JSON file")
-    pe.add_argument("out", help="output .graphson path")
+    pe.add_argument(
+        "--format", choices=("graphson", "graphml"), default="graphson",
+        help="interchange format (graphml: primitive values only)",
+    )
+    pe.add_argument("out", help="output path")
     pe.set_defaults(fn=cmd_export)
 
     pi = sub.add_parser(
-        "import", help="import line-delimited GraphSON into a graph"
+        "import", help="import GraphSON or GraphML into a graph"
     )
     # required: importing into an unnamed in-memory graph that closes right
     # after would silently discard everything
@@ -282,7 +288,11 @@ def main(argv=None) -> int:
         "--batch", type=int, default=1000,
         help="elements per import transaction (>= 1)",
     )
-    pi.add_argument("infile", help="input .graphson path")
+    pi.add_argument(
+        "--format", choices=("graphson", "graphml"), default="graphson",
+        help="interchange format",
+    )
+    pi.add_argument("infile", help="input path")
     pi.set_defaults(fn=cmd_import)
 
     args = parser.parse_args(argv)

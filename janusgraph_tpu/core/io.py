@@ -219,3 +219,251 @@ def _ensure_schema(graph, obj) -> None:
         mgmt.make_edge_label(
             obj["name"], Multiplicity(obj.get("multiplicity", 0)),
         )
+
+
+# ---------------------------------------------------------------- GraphML
+# (reference: graph.io(IoCore.graphml()) — the TinkerPop interchange XML;
+# JanusGraph.java io() support, demo data ships as grateful-dead.xml.)
+# TinkerPop conventions honored: vertex label under <data key="labelV">,
+# edge label under <data key="labelE">, typed <key> declarations.
+
+_GRAPHML_PARSERS = {
+    "string": str, "int": int, "long": int,
+    "float": float, "double": float,
+    # xs:boolean lexical space: true/false/1/0 (case tolerated)
+    "boolean": lambda s: s.strip().lower() in ("true", "1"),
+}
+
+
+def export_graphml(graph, path_or_file: Union[str, TextIO]) -> Dict[str, int]:
+    """Write the graph as TinkerPop-convention GraphML. PRIMITIVE property
+    values only (string/long/double/boolean — the format's own limitation,
+    same as TinkerPop's GraphMLWriter); richer datatypes need the
+    GraphSON exporter. Returns {"vertices": n, "edges": m}."""
+    from xml.sax.saxutils import escape, quoteattr
+
+    from janusgraph_tpu.core.codecs import Direction
+
+    close = False
+    if isinstance(path_or_file, str):
+        # explicit utf-8: XML default encoding must not follow the locale
+        f = open(path_or_file, "w", encoding="utf-8")
+        close = True
+    else:
+        f = path_or_file
+
+    def _type_of(key: str, value) -> str:
+        # bool FIRST: it subclasses int
+        if isinstance(value, bool):
+            return "boolean"
+        if isinstance(value, str):
+            return "string"
+        if isinstance(value, int):
+            return "long"
+        if isinstance(value, float):
+            return "double"
+        raise ValueError(
+            f"GraphML supports primitive values only; property {key!r} "
+            f"holds {type(value).__name__} — use export_graphson for "
+            "typed values"
+        )
+
+    def _fmt(value) -> str:
+        if isinstance(value, bool):
+            return "true" if value else "false"
+        return escape(str(value))
+
+    tx = graph.new_transaction()
+    nv = ne = 0
+    try:
+        # pass 1: collect typed keys (GraphML declares them up front)
+        vkeys: Dict[str, str] = {}
+        ekeys: Dict[str, str] = {}
+        for v in tx.vertices():
+            for p in v.properties():
+                vkeys.setdefault(p.key, _type_of(p.key, p.value))
+            for e in tx.get_edges(v, Direction.OUT, ()):
+                for k, val in e.property_values().items():
+                    ekeys.setdefault(k, _type_of(k, val))
+        f.write('<?xml version="1.0" ?>')
+        f.write(
+            '<graphml xmlns="http://graphml.graphdrawing.org/xmlns">'
+        )
+        f.write(
+            '<key id="labelV" for="node" attr.name="labelV" '
+            'attr.type="string"/>'
+        )
+        for k, t in sorted(vkeys.items()):
+            f.write(
+                f'<key id={quoteattr(k)} for="node" '
+                f'attr.name={quoteattr(k)} attr.type="{t}"/>'
+            )
+        f.write(
+            '<key id="labelE" for="edge" attr.name="labelE" '
+            'attr.type="string"/>'
+        )
+        for k, t in sorted(ekeys.items()):
+            # id carries the E- disambiguation prefix; attr.name stays the
+            # bare key so the importer files edge props under it
+            f.write(
+                f'<key id={quoteattr("E-" + k)} for="edge" '
+                f'attr.name={quoteattr(k)} attr.type="{t}"/>'
+            )
+        f.write('<graph id="G" edgedefault="directed">')
+        for v in tx.vertices():
+            f.write(f'<node id="{v.id}">')
+            f.write(f'<data key="labelV">{escape(v.label)}</data>')
+            for p in v.properties():
+                f.write(
+                    f'<data key={quoteattr(p.key)}>{_fmt(p.value)}</data>'
+                )
+            f.write("</node>")
+            nv += 1
+        for v in tx.vertices():
+            for e in tx.get_edges(v, Direction.OUT, ()):
+                f.write(
+                    f'<edge source="{e.out_vertex.id}" '
+                    f'target="{e.in_vertex.id}">'
+                )
+                f.write(f'<data key="labelE">{escape(e.label)}</data>')
+                for k, val in e.property_values().items():
+                    f.write(
+                        f'<data key={quoteattr("E-" + k)}>{_fmt(val)}'
+                        "</data>"
+                    )
+                f.write("</edge>")
+                ne += 1
+        f.write("</graph></graphml>")
+    finally:
+        tx.rollback()
+        if close:
+            f.close()
+    return {"vertices": nv, "edges": ne}
+
+
+def import_graphml(
+    graph, path_or_file: Union[str, TextIO], batch_size: int = 1000,
+) -> Dict[str, int]:
+    """Load TinkerPop-convention GraphML (labelV/labelE keys, typed <key>
+    declarations — the shape GraphMLWriter emits and the reference's
+    grateful-dead.xml demo uses). Ids are remapped; commits every
+    `batch_size` elements with the same partial-commit contract as
+    import_graphson (the raised exception carries ``committed``)."""
+    import xml.etree.ElementTree as ET
+
+    close = False
+    if isinstance(path_or_file, str):
+        f = open(path_or_file, "rb")
+        close = True
+    else:
+        f = path_or_file
+
+    def _local(tag: str) -> str:
+        return tag.rsplit("}", 1)[-1]
+
+    key_types: Dict[str, tuple] = {}  # key id -> (attr.name, parser)
+    id_map: Dict[str, int] = {}
+    nv = ne = 0
+    nv_committed = ne_committed = 0
+    pending = 0
+    tx = graph.new_transaction(read_only=False)
+    try:
+        for _event, el in ET.iterparse(f, events=("end",)):
+            tag = _local(el.tag)
+            if tag == "key":
+                parser = _GRAPHML_PARSERS.get(
+                    el.get("attr.type", "string"), str
+                )
+                key_types[el.get("id")] = (
+                    el.get("attr.name", el.get("id")), parser,
+                )
+            elif tag == "node":
+                label = None
+                entries = []  # (name, value) — LIST/SET keys repeat
+                for d in el:
+                    if _local(d.tag) != "data":
+                        continue
+                    name, parser = key_types.get(
+                        d.get("key"), (d.get("key"), str)
+                    )
+                    text = d.text or ""
+                    if name == "labelV":
+                        label = text or None
+                    else:
+                        # empty string IS a value (grateful-dead.xml has
+                        # empty songType cells)
+                        entries.append((name, parser(text)))
+                v = tx.add_vertex(label if label != "vertex" else None)
+                dup = {
+                    nm for nm in {n for n, _ in entries}
+                    if sum(1 for n, _ in entries if n == nm) > 1
+                }
+                for nm in dup:
+                    # GraphML carries no schema records: a repeated key
+                    # imported through an auto-created SINGLE key would
+                    # silently keep only the last value
+                    pk = graph.schema_cache.get_by_name(nm)
+                    if pk is None or int(
+                        getattr(pk, "cardinality", 0)
+                    ) == 0:
+                        raise ValueError(
+                            f"node {el.get('id')} repeats key {nm!r} but "
+                            "the key is (or would be auto-created) "
+                            "SINGLE-cardinality — pre-create it as "
+                            "LIST/SET or use GraphSON, which carries "
+                            "schema records"
+                        )
+                for k, val in entries:
+                    tx.add_property(v, k, val)
+                id_map[el.get("id")] = v.id
+                nv += 1
+                pending += 1
+                el.clear()
+            elif tag == "edge":
+                src = id_map.get(el.get("source"))
+                dst = id_map.get(el.get("target"))
+                if src is None or dst is None:
+                    raise ValueError(
+                        f"edge references unknown node "
+                        f"{el.get('source')}->{el.get('target')} (GraphML "
+                        "nodes must precede their edges)"
+                    )
+                label = "edge"
+                props = {}
+                for d in el:
+                    if _local(d.tag) != "data":
+                        continue
+                    name, parser = key_types.get(
+                        d.get("key"), (d.get("key"), str)
+                    )
+                    text = d.text or ""
+                    if name == "labelE":
+                        label = text or "edge"
+                    else:
+                        props[name] = parser(text)
+                e = tx.add_edge(
+                    tx.get_vertex(src), label, tx.get_vertex(dst)
+                )
+                for k, val in props.items():
+                    e.set_property(k, val)
+                ne += 1
+                pending += 1
+                el.clear()
+            if pending >= batch_size:
+                tx.commit()
+                nv_committed, ne_committed = nv, ne
+                tx = graph.new_transaction(read_only=False)
+                pending = 0
+        tx.commit()
+        nv_committed, ne_committed = nv, ne
+    except BaseException as exc:
+        exc.committed = {"vertices": nv_committed, "edges": ne_committed}
+        raise
+    finally:
+        try:
+            tx.rollback()
+        except Exception:  # noqa: BLE001 — teardown must not mask errors
+            pass
+        if close:
+            f.close()
+    return {"vertices": nv, "edges": ne}

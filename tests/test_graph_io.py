@@ -139,3 +139,153 @@ def test_partial_import_reports_committed_counts():
     assert sum(1 for _ in tx.vertices()) == 4
     tx.rollback()
     g.close()
+
+
+def test_graphml_round_trip(tmp_path):
+    """GraphML (TinkerPop labelV/labelE convention) round-trips primitive
+    properties, labels, and topology with their types."""
+    from janusgraph_tpu.core.graph import open_graph
+    from janusgraph_tpu.core.io import export_graphml, import_graphml
+
+    src = open_graph()
+    tx = src.new_transaction()
+    a = tx.add_vertex("person", name="ada", age=36, score=2.5, vip=True)
+    b = tx.add_vertex("person", name="bob", age=40)
+    c = tx.add_vertex("city", name="london")
+    e = tx.add_edge(a, "knows", b, since=1840)
+    tx.add_edge(a, "lives", c)
+    tx.commit()
+    path = str(tmp_path / "small.graphml")
+    counts = export_graphml(src, path)
+    assert counts == {"vertices": 3, "edges": 2}
+
+    dst = open_graph()
+    got = import_graphml(dst, path)
+    assert got == counts
+    t = dst.traversal()
+    ada = t.V().has("name", "ada").next()
+    assert ada.label == "person"
+    assert ada.value("age") == 36          # long stays int
+    assert ada.value("score") == 2.5       # double stays float
+    assert ada.value("vip") is True        # boolean stays bool
+    assert t.V().has("name", "ada").out("lives").values(
+        "name"
+    ).to_list() == ["london"]
+    ek = t.V().has("name", "ada").out_e("knows").to_list()
+    assert ek[0].value("since") == 1840
+    src.close()
+    dst.close()
+
+
+def test_graphml_tinkerpop_shape_and_limits(tmp_path):
+    """Imports the exact key/labelV/labelE shape TinkerPop's GraphMLWriter
+    emits (the reference's grateful-dead.xml demo format); non-primitive
+    values refuse with a pointer at GraphSON."""
+    import io as _io
+
+    import pytest
+
+    from janusgraph_tpu.core.graph import open_graph
+    from janusgraph_tpu.core.io import export_graphml, import_graphml
+
+    xml = (
+        '<?xml version="1.0" ?>'
+        '<graphml xmlns="http://graphml.graphdrawing.org/xmlns">'
+        '<key id="labelV" for="node" attr.name="labelV" attr.type="string"/>'
+        '<key id="name" for="node" attr.name="name" attr.type="string"/>'
+        '<key id="performances" for="node" attr.name="performances" '
+        'attr.type="int"/>'
+        '<key id="labelE" for="edge" attr.name="labelE" attr.type="string"/>'
+        '<key id="weight" for="edge" attr.name="weight" attr.type="int"/>'
+        '<graph id="G" edgedefault="directed">'
+        '<node id="1"><data key="labelV">song</data>'
+        '<data key="name">HEY BO DIDDLEY</data>'
+        '<data key="performances">5</data></node>'
+        '<node id="2"><data key="labelV">artist</data>'
+        '<data key="name">Garcia</data></node>'
+        '<edge source="1" target="2"><data key="labelE">sungBy</data>'
+        '<data key="weight">3</data></edge>'
+        "</graph></graphml>"
+    )
+    g = open_graph()
+    got = import_graphml(g, _io.BytesIO(xml.encode()))
+    assert got == {"vertices": 2, "edges": 1}
+    t = g.traversal()
+    song = t.V().has("name", "HEY BO DIDDLEY").next()
+    assert song.label == "song" and song.value("performances") == 5
+    e = t.V().has("name", "HEY BO DIDDLEY").out_e("sungBy").to_list()
+    assert len(e) == 1 and e[0].value("weight") == 3
+    g.close()
+
+    rich = open_graph()
+    tx = rich.new_transaction()
+    tx.add_vertex(spot=__import__(
+        "janusgraph_tpu.core.predicates", fromlist=["Geoshape"]
+    ).Geoshape.point(1, 2))
+    tx.commit()
+    import io as _io2
+
+    with pytest.raises(ValueError, match="primitive"):
+        export_graphml(rich, _io2.StringIO())
+    rich.close()
+
+
+def test_graphml_edge_cases():
+    """Review regressions: empty-string values survive, xs:boolean lexical
+    forms parse, repeated keys refuse under SINGLE auto-schema but import
+    under a pre-created LIST key, quotes in keys stay well-formed."""
+    import io as _io
+
+    import pytest
+
+    from janusgraph_tpu.core.codecs import Cardinality
+    from janusgraph_tpu.core.graph import open_graph
+    from janusgraph_tpu.core.io import export_graphml, import_graphml
+
+    xml = (
+        '<?xml version="1.0" ?><graphml>'
+        '<key id="labelV" for="node" attr.name="labelV" attr.type="string"/>'
+        '<key id="s" for="node" attr.name="s" attr.type="string"/>'
+        '<key id="ok" for="node" attr.name="ok" attr.type="boolean"/>'
+        '<graph edgedefault="directed">'
+        '<node id="1"><data key="labelV">x</data>'
+        "<data key=\"s\"></data><data key=\"ok\">1</data></node>"
+        "</graph></graphml>"
+    )
+    g = open_graph()
+    import_graphml(g, _io.BytesIO(xml.encode()))
+    v = g.traversal().V().next()
+    assert v.value("s") == "" and v.value("ok") is True
+    g.close()
+
+    # repeated key without LIST schema refuses
+    dup = (
+        '<graphml><key id="nick" for="node" attr.name="nick" '
+        'attr.type="string"/><graph>'
+        '<node id="1"><data key="nick">a</data><data key="nick">b</data>'
+        "</node></graph></graphml>"
+    )
+    g2 = open_graph()
+    with pytest.raises(ValueError, match="SINGLE"):
+        import_graphml(g2, _io.BytesIO(dup.encode()))
+    g2.close()
+    # ...but imports fine under a pre-created LIST key
+    g3 = open_graph()
+    g3.management().make_property_key("nick", str, Cardinality.LIST)
+    import_graphml(g3, _io.BytesIO(dup.encode()))
+    v = g3.traversal().V().next()
+    assert sorted(p.value for p in v.properties("nick")) == ["a", "b"]
+    g3.close()
+
+    # quote-bearing keys round-trip well-formed
+    g4 = open_graph()
+    tx = g4.new_transaction()
+    tx.add_vertex(**{'odd"key': "v"})
+    tx.commit()
+    buf = _io.StringIO()
+    export_graphml(g4, buf)
+    g5 = open_graph()
+    import_graphml(g5, _io.BytesIO(buf.getvalue().encode()))
+    assert g5.traversal().V().next().value('odd"key') == "v"
+    g4.close()
+    g5.close()
