@@ -582,6 +582,32 @@ class JanusGraphTPU:
     def management(self) -> ManagementSystem:
         return ManagementSystem(self)
 
+    def io(self, format: str = "graphson"):
+        """TinkerPop-style io facade (reference: graph.io(IoCore.graphml())
+        .writeGraph(path)): ``graph.io("graphml").write(path)`` /
+        ``.read(path)``. Formats: graphson (typed, schema-carrying,
+        line-delimited) | graphml (TinkerPop XML, primitives only).
+        Gryo is a JVM Kryo format with no Python analogue — use graphson
+        for full-fidelity interchange."""
+        from janusgraph_tpu.core import io as _io_mod
+
+        try:
+            writer = getattr(_io_mod, f"export_{format}")
+            reader = getattr(_io_mod, f"import_{format}")
+        except AttributeError:
+            raise ConfigurationError(
+                f"unknown io format {format!r} (graphson|graphml)"
+            )
+
+        class _Io:
+            def write(self, path_or_file, _g=self):
+                return writer(_g, path_or_file)
+
+            def read(self, path_or_file, _g=self, **kw):
+                return reader(_g, path_or_file, **kw)
+
+        return _Io()
+
     def compute(self, executor: str = None):
         """OLAP entry point (reference: JanusGraph.compute()). Defaults the
         executor to the computer.executor config option."""

@@ -244,14 +244,6 @@ def export_graphml(graph, path_or_file: Union[str, TextIO]) -> Dict[str, int]:
 
     from janusgraph_tpu.core.codecs import Direction
 
-    close = False
-    if isinstance(path_or_file, str):
-        # explicit utf-8: XML default encoding must not follow the locale
-        f = open(path_or_file, "w", encoding="utf-8")
-        close = True
-    else:
-        f = path_or_file
-
     def _type_of(key: str, value) -> str:
         # bool FIRST: it subclasses int
         if isinstance(value, bool):
@@ -275,16 +267,38 @@ def export_graphml(graph, path_or_file: Union[str, TextIO]) -> Dict[str, int]:
 
     tx = graph.new_transaction()
     nv = ne = 0
+    close = False
+    f = None
     try:
-        # pass 1: collect typed keys (GraphML declares them up front)
+        # pass 1 BEFORE opening the output: collect typed keys (GraphML
+        # declares them up front) and validate — a type/name rejection
+        # must not have truncated an existing file at the destination
         vkeys: Dict[str, str] = {}
         ekeys: Dict[str, str] = {}
         for v in tx.vertices():
             for p in v.properties():
+                if p.key in ("labelV", "labelE") or p.key.startswith("E-"):
+                    raise ValueError(
+                        f"vertex property key {p.key!r} collides with "
+                        "GraphML's reserved labelV/labelE/E- id namespace "
+                        "— rename it or use export_graphson"
+                    )
                 vkeys.setdefault(p.key, _type_of(p.key, p.value))
             for e in tx.get_edges(v, Direction.OUT, ()):
                 for k, val in e.property_values().items():
+                    if k in ("labelV", "labelE"):
+                        raise ValueError(
+                            f"edge property key {k!r} collides with "
+                            "GraphML's reserved label keys — rename it "
+                            "or use export_graphson"
+                        )
                     ekeys.setdefault(k, _type_of(k, val))
+        if isinstance(path_or_file, str):
+            # explicit utf-8: XML must not follow the locale encoding
+            f = open(path_or_file, "w", encoding="utf-8")
+            close = True
+        else:
+            f = path_or_file
         f.write('<?xml version="1.0" ?>')
         f.write(
             '<graphml xmlns="http://graphml.graphdrawing.org/xmlns">'
@@ -336,7 +350,7 @@ def export_graphml(graph, path_or_file: Union[str, TextIO]) -> Dict[str, int]:
         f.write("</graph></graphml>")
     finally:
         tx.rollback()
-        if close:
+        if close and f is not None:
             f.close()
     return {"vertices": nv, "edges": ne}
 
@@ -363,12 +377,34 @@ def import_graphml(
 
     key_types: Dict[str, tuple] = {}  # key id -> (attr.name, parser)
     id_map: Dict[str, int] = {}
+    deferred_edges: list = []
     nv = ne = 0
     nv_committed = ne_committed = 0
     pending = 0
     tx = graph.new_transaction(read_only=False)
+
+    def _add_edge(rec):
+        nonlocal ne
+        src_id, dst_id, label, props = rec
+        src = id_map.get(src_id)
+        dst = id_map.get(dst_id)
+        if src is None or dst is None:
+            raise ValueError(
+                f"edge references unknown node {src_id}->{dst_id}"
+            )
+        e = tx.add_edge(tx.get_vertex(src), label, tx.get_vertex(dst))
+        for k, val in props.items():
+            e.set_property(k, val)
+        ne += 1
+
     try:
-        for _event, el in ET.iterparse(f, events=("end",)):
+        container = None  # the <graph> element records accumulate under
+        since_clear = 0
+        for event, el in ET.iterparse(f, events=("start", "end")):
+            if event == "start":
+                if _local(el.tag) == "graph":
+                    container = el
+                continue
             tag = _local(el.tag)
             if tag == "key":
                 parser = _GRAPHML_PARSERS.get(
@@ -420,14 +456,6 @@ def import_graphml(
                 pending += 1
                 el.clear()
             elif tag == "edge":
-                src = id_map.get(el.get("source"))
-                dst = id_map.get(el.get("target"))
-                if src is None or dst is None:
-                    raise ValueError(
-                        f"edge references unknown node "
-                        f"{el.get('source')}->{el.get('target')} (GraphML "
-                        "nodes must precede their edges)"
-                    )
                 label = "edge"
                 props = {}
                 for d in el:
@@ -439,16 +467,40 @@ def import_graphml(
                     text = d.text or ""
                     if name == "labelE":
                         label = text or "edge"
+                    elif name in props:
+                        # edges carry single-valued properties: a repeat
+                        # is data loss, fail like the node path does
+                        raise ValueError(
+                            f"edge {el.get('source')}->"
+                            f"{el.get('target')} repeats key {name!r}"
+                        )
                     else:
                         props[name] = parser(text)
-                e = tx.add_edge(
-                    tx.get_vertex(src), label, tx.get_vertex(dst)
-                )
-                for k, val in props.items():
-                    e.set_property(k, val)
-                ne += 1
-                pending += 1
+                rec = (el.get("source"), el.get("target"), label, props)
+                if rec[0] in id_map and rec[1] in id_map:
+                    _add_edge(rec)
+                    pending += 1
+                else:
+                    # spec permits edges before their nodes: defer like
+                    # import_graphson's forward references
+                    deferred_edges.append(rec)
                 el.clear()
+            since_clear += 1
+            if since_clear >= batch_size and container is not None:
+                # el.clear() empties elements, but they stay CHILDREN of
+                # <graph> (the parser's stack keeps appending there) —
+                # clear the container or import memory grows O(n); safe
+                # on an end event: only ancestors are open
+                container.clear()
+                since_clear = 0
+            if pending >= batch_size:
+                tx.commit()
+                nv_committed, ne_committed = nv, ne
+                tx = graph.new_transaction(read_only=False)
+                pending = 0
+        for rec in deferred_edges:
+            _add_edge(rec)
+            pending += 1
             if pending >= batch_size:
                 tx.commit()
                 nv_committed, ne_committed = nv, ne

@@ -289,3 +289,83 @@ def test_graphml_edge_cases():
     assert g5.traversal().V().next().value('odd"key') == "v"
     g4.close()
     g5.close()
+
+
+def test_graph_io_facade(tmp_path):
+    """graph.io('graphml').write/read — the TinkerPop io() shape."""
+    import pytest
+
+    from janusgraph_tpu.core.graph import open_graph
+    from janusgraph_tpu.exceptions import ConfigurationError
+
+    g = open_graph()
+    tx = g.new_transaction()
+    a, b = tx.add_vertex(name="p"), tx.add_vertex(name="q")
+    tx.add_edge(a, "r", b)
+    tx.commit()
+    for fmt, ext in (("graphson", "json"), ("graphml", "xml")):
+        path = str(tmp_path / f"g.{ext}")
+        assert g.io(fmt).write(path) == {"vertices": 2, "edges": 1}
+        dst = open_graph()
+        assert dst.io(fmt).read(path) == {"vertices": 2, "edges": 1}
+        assert dst.traversal().V().has("name", "p").out("r").values(
+            "name"
+        ).to_list() == ["q"]
+        dst.close()
+    with pytest.raises(ConfigurationError, match="unknown io format"):
+        g.io("gryo")
+    g.close()
+
+
+def test_graphml_review_regressions(tmp_path):
+    """Reserved-key refusal preserves existing files; edges may precede
+    nodes; repeated edge keys refuse; big imports stay bounded (container
+    clearing exercised via a small batch_size)."""
+    import io as _io
+
+    import pytest
+
+    from janusgraph_tpu.core.graph import open_graph
+    from janusgraph_tpu.core.io import export_graphml, import_graphml
+
+    # failed export must NOT truncate the existing destination
+    path = str(tmp_path / "keep.graphml")
+    open(path, "w").write("precious")
+    g = open_graph()
+    tx = g.new_transaction()
+    tx.add_vertex(labelV="oops")  # reserved name
+    tx.commit()
+    with pytest.raises(ValueError, match="reserved"):
+        export_graphml(g, path)
+    assert open(path).read() == "precious"
+    g.close()
+
+    # edge-before-node order (spec-valid) defers and resolves
+    xml = (
+        "<graphml>"
+        '<key id="labelV" for="node" attr.name="labelV" attr.type="string"/>'
+        '<key id="labelE" for="edge" attr.name="labelE" attr.type="string"/>'
+        "<graph>"
+        '<edge source="1" target="2"><data key="labelE">r</data></edge>'
+        '<node id="1"><data key="labelV">x</data></node>'
+        '<node id="2"><data key="labelV">x</data></node>'
+        "</graph></graphml>"
+    )
+    g2 = open_graph()
+    got = import_graphml(g2, _io.BytesIO(xml.encode()), batch_size=1)
+    assert got == {"vertices": 2, "edges": 1}
+    assert len(g2.traversal().V().out_e("r").to_list()) == 1
+    g2.close()
+
+    # repeated edge key refuses
+    dup = (
+        "<graphml><graph>"
+        '<node id="1"/><node id="2"/>'
+        '<edge source="1" target="2"><data key="w">1</data>'
+        '<data key="w">2</data></edge>'
+        "</graph></graphml>"
+    )
+    g3 = open_graph()
+    with pytest.raises(ValueError, match="repeats key"):
+        import_graphml(g3, _io.BytesIO(dup.encode()))
+    g3.close()
