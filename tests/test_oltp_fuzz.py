@@ -97,16 +97,42 @@ def test_fuzz_mutations_match_oracle():
             live_handles[v.id] = v
         elif op < 0.55 and len(pool) >= 2:
             a, b = rng.sample(pool, 2)
-            va = live_handles.get(a) or tx.get_vertex(a)
-            vb = live_handles.get(b) or tx.get_vertex(b)
             lbl = f"e{rng.randint(0,1)}"
-            tx.add_edge(va, lbl, vb)
+            committed_pair = (
+                a in model["vertices"] and a not in pending["vertices"]
+                and b in model["vertices"] and b not in pending["vertices"]
+            )
+            if committed_pair and rng.random() < 0.4:
+                # round-5 AddEdgeStep path through the DSL
+                from janusgraph_tpu.core.traversal import (
+                    GraphTraversalSource,
+                )
+
+                vb = live_handles.get(b) or tx.get_vertex(b)
+                GraphTraversalSource(graph, tx).V(a).add_e_(lbl).to_(
+                    vb
+                ).iterate()
+            else:
+                va = live_handles.get(a) or tx.get_vertex(a)
+                vb = live_handles.get(b) or tx.get_vertex(b)
+                tx.add_edge(va, lbl, vb)
             pending["edges"].append((a, lbl, b))
         elif op < 0.75 and pool:
             vid = rng.choice(pool)
-            v = live_handles.get(vid) or tx.get_vertex(vid)
             k, val = f"p{rng.randint(0,1)}", rng.randint(0, 99)
-            v.property(k, val)
+            if rng.random() < 0.5 or vid in pending["vertices"]:
+                v = live_handles.get(vid) or tx.get_vertex(vid)
+                v.property(k, val)
+            else:
+                # round-5 PropertyStep path: mutate COMMITTED vertices
+                # through the traversal DSL inside the SAME fuzz tx
+                from janusgraph_tpu.core.traversal import (
+                    GraphTraversalSource,
+                )
+
+                GraphTraversalSource(graph, tx).V(vid).property(
+                    k, val
+                ).iterate()
             pending["vertices"].setdefault(vid, {})[k] = val
         elif op < 0.82 and pool:
             vid = rng.choice(pool)
