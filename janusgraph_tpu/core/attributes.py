@@ -999,6 +999,12 @@ class Serializer:
     def register(self, ser: AttributeSerializer) -> None:
         if ser.type_id in self._by_id:
             raise SerializerError(f"duplicate serializer id {ser.type_id}")
+        if ser.type_id >= 0xFFFF:
+            # 0xFFFF is the property-cell META marker (codecs._META_MARKER)
+            # — a value frame starting with it would misparse as metas
+            raise SerializerError(
+                f"serializer id {ser.type_id} reserved (>= 0xFFFF)"
+            )
         self._by_id[ser.type_id] = ser
         # first registration wins the python-type slot (list maps to
         # FloatListSerializer; StringListSerializer dispatches by content)

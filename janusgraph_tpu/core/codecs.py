@@ -104,6 +104,11 @@ _CAT_USER_PROP = 1
 _CAT_SYS_EDGE = 2
 _CAT_USER_EDGE = 3
 
+#: meta-carrying property cells prefix their inline-props block with this
+#: marker — 0xFFFF is never a valid serializer type id, so meta-free cells
+#: (whose next 2 bytes are the value's type id) stay unambiguous
+_META_MARKER = b"\xff\xff"
+
 #: hot-decode helpers: compiled Structs skip per-call format parsing and
 #: the table skips IntEnum.__call__ (parse_relation runs once per cell)
 _S_HEADER = struct.Struct(">BQB")
@@ -240,16 +245,27 @@ class EdgeSerializer:
         relation_id: int,
         value,
         cardinality: Cardinality = Cardinality.SINGLE,
+        meta: Optional[Dict[int, object]] = None,
     ) -> Entry:
+        """`meta`: META-properties (properties ON this vertex property —
+        the reference's JanusGraphVertexProperty-extends-Relation feature),
+        the same inline-props block edge cells use, marker-prefixed and
+        placed BEFORE the framed value: variable-length serializers read
+        to the end of the buffer, so the value must stay last; the
+        0xFFFF marker (never a valid type id) distinguishes meta-carrying
+        cells, keeping meta-free cells byte-identical to the old layout."""
         cat = _category_byte(type_id, False, self.idm)
         head = struct.pack(">BQB", cat, type_id, 0)
         framed = self.serializer.write_object(value)
+        metas = (
+            _META_MARKER + self._write_inline_props(meta) if meta else b""
+        )
         if cardinality == Cardinality.SINGLE:
-            return (head, struct.pack(">Q", relation_id) + framed)
+            return (head, struct.pack(">Q", relation_id) + metas + framed)
         if cardinality == Cardinality.LIST:
-            return (head + struct.pack(">Q", relation_id), framed)
+            return (head + struct.pack(">Q", relation_id), metas + framed)
         # SET: value bytes in the column => set semantics by column uniqueness
-        return (head + framed, struct.pack(">Q", relation_id))
+        return (head + framed, struct.pack(">Q", relation_id) + metas)
 
     def _write_inline_props(self, props: Dict[int, object]) -> bytes:
         if not props:
@@ -286,21 +302,30 @@ class EdgeSerializer:
         info = schema(type_id)
         if info.cardinality == Cardinality.SINGLE:
             (rel_id,) = struct.unpack(">Q", val[:8])
-            value, _ = self.serializer.read_object(val[8:])
+            metas, rest = self._split_meta(val[8:])
+            value, _ = self.serializer.read_object(rest)
         elif info.cardinality == Cardinality.LIST:
             (rel_id,) = struct.unpack(">Q", col[10:18])
-            value, _ = self.serializer.read_object(val)
+            metas, rest = self._split_meta(val)
+            value, _ = self.serializer.read_object(rest)
         else:  # SET
             value, _ = self.serializer.read_object(col[10:])
             (rel_id,) = struct.unpack(">Q", val[:8])
+            metas, _rest = self._split_meta(val[8:])
         return RelationCache(
             relation_id=rel_id,
             type_id=type_id,
             direction=Direction.OUT,
             value=value,
+            properties=metas,
         )
 
     def _parse_inline_props(self, data: bytes) -> Dict[int, object]:
+        return self._parse_inline_props_sized(data)[0]
+
+    def _parse_inline_props_sized(self, data: bytes):
+        """(props, bytes consumed) — the block is self-delimiting, so a
+        framed value may follow it (meta-carrying property cells)."""
         (count,) = struct.unpack(">H", data[:2])
         off = 2
         props: Dict[int, object] = {}
@@ -310,7 +335,15 @@ class EdgeSerializer:
             value, _ = self.serializer.read_object(data[off : off + vlen])
             off += vlen
             props[key_id] = value
-        return props
+        return props, off
+
+    def _split_meta(self, buf: bytes):
+        """(meta props or None, remaining buffer) — strips the marker-
+        prefixed meta block so the variable-length value read stays last."""
+        if buf[:2] == _META_MARKER:
+            props, off = self._parse_inline_props_sized(buf[2:])
+            return props, buf[2 + off:]
+        return None, buf
 
     # ------------------------------------------------------------------ bounds
     def get_bounds(self, category: RelationCategory, system: bool = False) -> SliceQuery:

@@ -60,9 +60,9 @@ class Vertex(Element):
         self._label_cache: Optional[str] = None
 
     # -- properties ---------------------------------------------------------
-    def property(self, key: str, value=None) -> "VertexProperty":
+    def property(self, key: str, value=None, **meta) -> "VertexProperty":
         if value is not None:
-            return self.tx.add_property(self, key, value)
+            return self.tx.add_property(self, key, value, **meta)
         props = self.tx.get_properties(self, key)
         if not props:
             raise KeyError(key)
@@ -207,16 +207,51 @@ class Edge(Relation):
 
 
 class VertexProperty(Relation):
-    __slots__ = ("vertex", "value")
+    __slots__ = ("vertex", "value", "_meta", "_replacement")
 
-    def __init__(self, rid: int, type_id: int, vertex: Vertex, value, tx, lifecycle):
+    def __init__(
+        self, rid: int, type_id: int, vertex: Vertex, value, tx, lifecycle,
+        meta=None,
+    ):
         super().__init__(rid, type_id, tx, lifecycle)
         self.vertex = vertex
         self.value = value
+        #: META-properties — properties on this property, keyed by the
+        #: meta key's schema id (reference: JanusGraphVertexProperty
+        #: extends Relation; TinkerPop vertexProperty.property(...))
+        self._meta = dict(meta) if meta else {}
+        self._replacement = None
 
     @property
     def key(self) -> str:
         return self.tx.schema_name(self.type_id)
+
+    # -- meta-properties (mirrors the Edge inline-property API) ------------
+    def value_of(self, key: str):
+        """Meta-property value, or None (vp.value stays the property's own
+        value — TinkerPop's vertexProperty.value(metaKey) analogue)."""
+        el = self.tx.schema_by_name(key)
+        if el is None:
+            return None
+        return self._meta.get(el.id)
+
+    def property_values(self) -> dict:
+        """{meta key name: value}."""
+        return {
+            self.tx.schema_name(tid): v for tid, v in self._meta.items()
+        }
+
+    def set_property(self, key: str, value) -> "VertexProperty":
+        """Set a meta-property. New properties mutate in place; LOADED
+        ones are rewritten (metas live inside the property cell) and this
+        handle forwards to the live replacement — chained calls compose,
+        like Edge.set_property."""
+        if self._replacement is not None:
+            return self._replacement.set_property(key, value)
+        live = self.tx.set_meta_property(self, key, value)
+        if live is not self:
+            self._replacement = live
+        return live
 
     def remove(self) -> None:
         self.tx.remove_property(self)

@@ -1011,7 +1011,10 @@ class JanusGraphTPU:
         )
         return [(
             self.idm.get_key(rel.vertex.id),
-            es.write_property(rel.type_id, rel.id, rel.value, card),
+            es.write_property(
+                rel.type_id, rel.id, rel.value, card,
+                meta=getattr(rel, "_meta", None) or None,
+            ),
         )]
 
     def _register_consistency_locks(self, tx: Transaction) -> None:

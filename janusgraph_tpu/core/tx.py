@@ -95,6 +95,30 @@ class Transaction:
             raise SchemaViolationError(f"unknown schema id {sid}")
         return el.name
 
+    @staticmethod
+    def _coerce_value(pk: PropertyKey, key: str, value):
+        """Type-check a value against its key's declared datatype, with the
+        int->float / int->BigInt literal conveniences; raises
+        SchemaViolationError on mismatch (shared by plain and META
+        properties)."""
+        if not isinstance(value, pk.data_type) or (
+            pk.data_type is not bool and isinstance(value, bool)
+        ):
+            from janusgraph_tpu.core.attributes import BigInt
+
+            if pk.data_type is float and isinstance(value, int) and not isinstance(value, bool):
+                return float(value)
+            if pk.data_type is BigInt and isinstance(value, int) and not isinstance(value, bool):
+                # plain ints promote to declared BigInteger keys (and the
+                # codec reads back plain int, so round-trip writes stay
+                # legal)
+                return BigInt(value)
+            raise SchemaViolationError(
+                f"property {key} expects {pk.data_type.__name__}, "
+                f"got {type(value).__name__}"
+            )
+        return value
+
     def _property_key(self, name: str, value=None) -> PropertyKey:
         el = self.schema_by_name(name)
         if el is None:
@@ -317,29 +341,25 @@ class Transaction:
                     f"{in_v} already has an incoming edge"
                 )
 
-    def add_property(self, v: Vertex, key: str, value) -> VertexProperty:
+    def add_property(self, v: Vertex, key: str, value, **meta) -> VertexProperty:
+        """`**meta`: META-properties on the new vertex property
+        (reference: TinkerPop v.property(key, value, metaK, metaV, ...);
+        JanusGraphVertexProperty extends Relation). Typed through the same
+        schema machinery as ordinary keys; not indexed (as in the
+        reference)."""
         self._check_writable()
         v._check_alive()
         if v.id in self._removed_vertices:
             raise InvalidElementError("vertex was removed in this tx")
         pk = self._property_key(key, value)
-        if not isinstance(value, pk.data_type) or (
-            pk.data_type is not bool and isinstance(value, bool)
-        ):
-            # ints are acceptable doubles (common literal convenience)
-            from janusgraph_tpu.core.attributes import BigInt
-
-            if pk.data_type is float and isinstance(value, int) and not isinstance(value, bool):
-                value = float(value)
-            elif pk.data_type is BigInt and isinstance(value, int) and not isinstance(value, bool):
-                # plain ints promote to declared BigInteger keys (and the
-                # codec reads back plain int, so round-trip writes stay legal)
-                value = BigInt(value)
-            else:
-                raise SchemaViolationError(
-                    f"property {key} expects {pk.data_type.__name__}, "
-                    f"got {type(value).__name__}"
-                )
+        value = self._coerce_value(pk, key, value)
+        # resolve + validate metas BEFORE any destructive step (the SINGLE
+        # removal below and the durable auto-schema constraint): a write
+        # that is going to be rejected must not leave mutations behind
+        meta_ids = {}
+        for mk, mv in meta.items():
+            mpk = self._property_key(mk, mv)
+            meta_ids[mpk.id] = self._coerce_value(mpk, mk, mv)
         # AFTER type validation: the auto-schema constraint path persists a
         # durable schema mutation — a write that is going to be rejected
         # must not leave one behind
@@ -350,12 +370,47 @@ class Transaction:
         elif pk.cardinality == Cardinality.SET:
             for existing in self.get_properties(v, key):
                 if existing.value == value:
+                    if meta_ids:
+                        # SET dedup must not silently drop metas: update
+                        # the existing entry (reference semantics)
+                        live = existing
+                        for mk, mv in meta.items():
+                            live = live.set_property(mk, mv)
+                        return live
                     return existing
         rid = self.graph.id_assigner.assign_relation_id()
-        p = VertexProperty(rid, pk.id, v, value, self, LifeCycle.NEW)
+        p = VertexProperty(
+            rid, pk.id, v, value, self, LifeCycle.NEW, meta=meta_ids
+        )
         with self._lock:
             self._added[v.id].append(p)
         return p
+
+    def set_meta_property(self, p: VertexProperty, key: str, value):
+        """Set a meta-property on `p`. NEW properties mutate in place;
+        LOADED ones rewrite as remove + re-add (metas live inside the
+        property cell), preserving the other metas and — for LIST keys —
+        leaving sibling entries untouched."""
+        self._check_writable()
+        if p.is_removed:
+            raise InvalidElementError(
+                "cannot set a meta-property on a removed property"
+            )
+        mpk = self._property_key(key, value)
+        value = self._coerce_value(mpk, key, value)
+        if p.is_new:
+            p._meta[mpk.id] = value
+            return p
+        metas = dict(p._meta)
+        metas[mpk.id] = value
+        named = {
+            self.schema_name(tid): val for tid, val in metas.items()
+        }
+        vertex = p.vertex
+        pkey = p.key
+        pval = p.value
+        self.remove_property(p)
+        return self.add_property(vertex, pkey, pval, **named)
 
     def set_edge_property(self, e: Edge, key: str, value) -> "Edge":
         """Set an inline edge property. New edges mutate in place; LOADED
@@ -529,7 +584,7 @@ class Transaction:
                     results.append(
                         VertexProperty(
                             rc.relation_id, rc.type_id, v, rc.value, self,
-                            LifeCycle.LOADED,
+                            LifeCycle.LOADED, meta=rc.properties,
                         )
                     )
         with self._lock:

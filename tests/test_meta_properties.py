@@ -1,0 +1,171 @@
+"""META-properties: properties on vertex properties (reference:
+JanusGraphVertexProperty extends Relation; TinkerPop
+v.property(key, value, metaK, metaV) — JanusGraph's signature multi/meta
+property model). Encoded as the same inline-props block edge cells use,
+appended to the property cell."""
+
+import pytest
+
+from janusgraph_tpu.core.codecs import Cardinality
+from janusgraph_tpu.core.graph import open_graph
+
+
+@pytest.fixture()
+def g():
+    graph = open_graph({"ids.authority-wait-ms": 0.0})
+    yield graph
+    graph.close()
+
+
+def test_meta_properties_roundtrip_all_cardinalities(g):
+    mgmt = g.management()
+    mgmt.make_property_key("single", str, Cardinality.SINGLE)
+    mgmt.make_property_key("lst", str, Cardinality.LIST)
+    mgmt.make_property_key("st", str, Cardinality.SET)
+    tx = g.new_transaction()
+    v = tx.add_vertex()
+    tx.add_property(v, "single", "a", since=2020, by="me")
+    tx.add_property(v, "lst", "x", since=2021)
+    tx.add_property(v, "lst", "y")  # no metas
+    tx.add_property(v, "st", "s1", since=2022)
+    tx.commit()
+
+    tx = g.new_transaction()
+    v = tx.get_vertex(v.id)
+    (sp,) = tx.get_properties(v, "single")
+    assert sp.value_of("since") == 2020 and sp.value_of("by") == "me"
+    assert sp.property_values() == {"since": 2020, "by": "me"}
+    lst = {p.value: p.property_values() for p in tx.get_properties(v, "lst")}
+    assert lst == {"x": {"since": 2021}, "y": {}}
+    (stp,) = tx.get_properties(v, "st")
+    assert stp.value_of("since") == 2022
+    tx.rollback()
+
+
+def test_meta_property_set_on_new_and_loaded(g):
+    tx = g.new_transaction()
+    v = tx.add_vertex()
+    p = tx.add_property(v, "name", "ada")
+    p.set_property("since", 1840)  # NEW: mutates in place
+    tx.commit()
+
+    tx = g.new_transaction()
+    v = tx.get_vertex(v.id)
+    (p,) = tx.get_properties(v, "name")
+    assert p.value_of("since") == 1840
+    # LOADED: rewrite preserves value + other metas, updates the target
+    live = p.set_property("by", "babbage")
+    live2 = p.set_property("since", 1841)  # forwards through replacement
+    tx.commit()
+
+    tx = g.new_transaction()
+    (p,) = tx.get_properties(tx.get_vertex(v.id), "name")
+    assert p.value == "ada"
+    assert p.property_values() == {"since": 1841, "by": "babbage"}
+    tx.rollback()
+
+
+def test_meta_properties_typed_and_list_siblings_untouched(g):
+    from janusgraph_tpu.exceptions import SchemaViolationError
+
+    mgmt = g.management()
+    mgmt.make_property_key("nick", str, Cardinality.LIST)
+    mgmt.make_property_key("since", int)
+    tx = g.new_transaction()
+    v = tx.add_vertex()
+    a = tx.add_property(v, "nick", "ace", since=1)
+    tx.add_property(v, "nick", "alpha", since=2)
+    tx.commit()
+
+    tx = g.new_transaction()
+    v = tx.get_vertex(v.id)
+    target = next(
+        p for p in tx.get_properties(v, "nick") if p.value == "ace"
+    )
+    target.set_property("since", 99)
+    tx.commit()
+    tx = g.new_transaction()
+    vals = {
+        p.value: p.value_of("since")
+        for p in tx.get_properties(tx.get_vertex(v.id), "nick")
+    }
+    assert vals == {"ace": 99, "alpha": 2}
+    # meta values respect the meta key's declared type
+    tx2 = g.new_transaction()
+    with pytest.raises(SchemaViolationError):
+        tx2.add_property(tx2.get_vertex(v.id), "name", "x", since="not-int")
+    tx2.rollback()
+    tx.rollback()
+
+
+def test_meta_free_cells_unchanged_and_graphson_unaffected(g):
+    """Meta-free property cells stay byte-identical to the old layout
+    (trailing-bytes extension), and GraphSON export still works."""
+    import io
+
+    from janusgraph_tpu.core.io import export_graphson
+
+    tx = g.new_transaction()
+    tx.add_vertex(name="plain", n=3)
+    tx.commit()
+    buf = io.StringIO()
+    assert export_graphson(g, buf)["vertices"] == 1
+
+
+def test_meta_review_regressions(g):
+    """Rejected meta writes leave NO mutations (SINGLE survives); removed
+    properties refuse meta sets; SET dedup keeps metas; reserved
+    serializer id refused; v.property(...) forwards metas."""
+    from janusgraph_tpu.core.attributes import Serializer, SerializerError
+    from janusgraph_tpu.exceptions import (
+        InvalidElementError,
+        SchemaViolationError,
+    )
+
+    g.management().make_property_key("since", int)
+    tx = g.new_transaction()
+    v = tx.add_vertex()
+    v.property("name", "ada", since=1840)  # element-level meta forwarding
+    tx.commit()
+
+    # rejected meta write must NOT remove the committed SINGLE value
+    tx = g.new_transaction()
+    v = tx.get_vertex(v.id)
+    with pytest.raises(SchemaViolationError):
+        tx.add_property(v, "name", "x", since="not-an-int")
+    tx.commit()
+    tx = g.new_transaction()
+    v = tx.get_vertex(v.id)
+    assert v.value("name") == "ada"  # survived the rejected write
+
+    # removed property refuses meta sets
+    (p,) = tx.get_properties(v, "name")
+    tx.remove_property(p)
+    with pytest.raises(InvalidElementError, match="removed"):
+        p.set_property("since", 1)
+    tx.rollback()
+
+    # SET dedup keeps the caller's metas
+    from janusgraph_tpu.core.codecs import Cardinality
+
+    g.management().make_property_key("tag", str, Cardinality.SET)
+    tx = g.new_transaction()
+    v = tx.get_vertex(v.id)
+    tx.add_property(v, "tag", "t1")
+    tx.commit()
+    tx = g.new_transaction()
+    v = tx.get_vertex(v.id)
+    live = tx.add_property(v, "tag", "t1", since=7)  # dedup + meta update
+    tx.commit()
+    tx = g.new_transaction()
+    (tp,) = tx.get_properties(tx.get_vertex(v.id), "tag")
+    assert tp.value == "t1" and tp.value_of("since") == 7
+    tx.rollback()
+
+    # the 0xFFFF meta marker can never collide with a registered id
+    s = Serializer()
+    with pytest.raises(SerializerError, match="reserved"):
+        class _Weird:
+            type_id = 0xFFFF
+            py_type = bytes
+        s.register(_Weird())
