@@ -58,7 +58,15 @@ def export_graphson(graph, path_or_file: Union[str, TextIO]) -> Dict[str, int]:
         for v in tx.vertices():
             props = []
             for p in v.properties():
-                props.append({"key": p.key, "value": _encode(p.value)})
+                rec = {"key": p.key, "value": _encode(p.value)}
+                metas = p.property_values()
+                if metas:
+                    # META-properties ride a nested typed map (TinkerPop
+                    # GraphSON writes vp properties the same way)
+                    rec["properties"] = {
+                        mk: _encode(mv) for mk, mv in metas.items()
+                    }
+                props.append(rec)
             f.write(json.dumps({
                 "kind": "vertex", "original_id": v.id, "label": v.label,
                 "properties": props,
@@ -164,7 +172,13 @@ def import_graphson(
                 # happens to be named "label" cannot collide with the
                 # label argument
                 for p in obj.get("properties", ()):
-                    tx.add_property(v, p["key"], _decode(p["value"]))
+                    tx.add_property(
+                        v, p["key"], _decode(p["value"]),
+                        **{
+                            mk: _decode(mv)
+                            for mk, mv in p.get("properties", {}).items()
+                        },
+                    )
                 id_map[obj["original_id"]] = v.id
                 nv += 1
                 maybe_commit()

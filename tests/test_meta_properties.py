@@ -169,3 +169,22 @@ def test_meta_review_regressions(g):
             type_id = 0xFFFF
             py_type = bytes
         s.register(_Weird())
+
+
+def test_meta_properties_survive_graphson_roundtrip(g, tmp_path):
+    import io
+
+    from janusgraph_tpu.core.graph import open_graph
+    from janusgraph_tpu.core.io import export_graphson, import_graphson
+
+    tx = g.new_transaction()
+    v = tx.add_vertex()
+    v.property("name", "ada", since=1840, by="x")
+    tx.commit()
+    buf = io.StringIO()
+    export_graphson(g, buf)
+    dst = open_graph()
+    import_graphson(dst, io.StringIO(buf.getvalue()))
+    (p,) = dst.traversal().V().next().properties("name")
+    assert p.property_values() == {"since": 1840, "by": "x"}
+    dst.close()
