@@ -144,9 +144,9 @@ class JanusGraphServer:
 
     # ------------------------------------------------------------ execution
     def _namespace(self, query: str, graph_name: Optional[str]) -> dict:
-        from janusgraph_tpu.core.traversal import P, __ as _anon
+        from janusgraph_tpu.server.gremlin_compat import compat_namespace
 
-        ns = {"P": P, "__": _anon}
+        ns = compat_namespace()  # P, __, and bare Gremlin predicates
         name = graph_name or self.default_graph
         g = self.manager.get_graph(name)
         if g is None:
@@ -168,6 +168,9 @@ class JanusGraphServer:
                 f"query length {len(query)} exceeds server.max-query-length "
                 f"({self.max_query_length})"
             )
+        from janusgraph_tpu.server.gremlin_compat import translate
+
+        query = translate(query)  # Gremlin dialect -> DSL (lexical only)
         ns = self._namespace(query, graph_name)
         try:
             return _evaluate(query, ns)
