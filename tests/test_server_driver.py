@@ -569,3 +569,50 @@ def test_gremlin_dialect_over_http():
     assert got == {"jupiter", "neptune"}  # saturn is a titan
     srv.stop()
     g.close()
+
+
+def test_gremlin_dialect_fuzz_equivalence():
+    """Random step chains rendered in BOTH spellings (Gremlin camelCase /
+    python snake_case) must return identical results through the server —
+    the broad guarantee behind the dialect rewrite."""
+    import random
+
+    from janusgraph_tpu.core import gods
+    from janusgraph_tpu.core.graph import open_graph
+    from janusgraph_tpu.server.manager import JanusGraphManager
+    from janusgraph_tpu.server.server import JanusGraphServer
+
+    g = open_graph()
+    gods.load(g)
+    mgr = JanusGraphManager()
+    mgr.put_graph("graph", g)
+    srv = JanusGraphServer(manager=mgr)
+
+    # (gremlin spelling, python spelling) step pool; {0} = edge label
+    steps = [
+        ("out('{0}')", "out('{0}')"),
+        ("in('{0}')", "in_('{0}')"),
+        ("both('{0}')", "both('{0}')"),
+        ("outE('{0}').inV()", "out_e('{0}').in_v()"),
+        ("inE('{0}').outV()", "in_e('{0}').out_v()"),
+        ("hasLabel('god')", "has_label('god')"),
+        ("has('age', gt(100))", "has('age', P.gt(100))"),
+        ("hasNot('age')", "has_not('age')"),
+        ("simplePath()", "simple_path()"),
+        ("dedup()", "dedup()"),
+        ("limit(5)", "limit(5)"),
+        ("where(out('{0}'))", "where(__.out('{0}'))"),
+    ]
+    labels = ["father", "brother", "battled", "lives", "pet", "mother"]
+    rng = random.Random(20260731)
+    for _ in range(40):
+        chain = rng.sample(steps, rng.randint(1, 4))
+        lbls = [rng.choice(labels) for _ in chain]
+        gq = "g.V()." + ".".join(
+            s[0].format(l) for s, l in zip(chain, lbls)
+        ) + ".values('name')"
+        pq = "g.V()." + ".".join(
+            s[1].format(l) for s, l in zip(chain, lbls)
+        ) + ".values('name')"
+        assert sorted(srv.execute(gq)) == sorted(srv.execute(pq)), gq
+    g.close()
