@@ -270,6 +270,15 @@ class GraphTraversalSource:
     def add_v(self, label: Optional[str] = None, **props) -> Vertex:
         return self.tx.add_vertex(label, **props)
 
+    def add_v_(self, label: Optional[str] = None) -> "GraphTraversal":
+        """TinkerPop AddVertexStartStep: ``g.add_v_('person')
+        .property('name', 'marko')`` — a TRAVERSAL seeded with a new
+        vertex, so property()/add_e_() chains compose (the Gremlin-text
+        endpoint maps ``g.addV(...)`` here; the plain add_v returns the
+        raw Vertex for direct-API callers). LAZY like the reference: the
+        vertex is created per EXECUTION, inside the start step."""
+        return GraphTraversal(self, _start_new_vertex(self, label))
+
     def add_e(self, out_v: Vertex, label: str, in_v: Vertex, **props) -> Edge:
         return self.tx.add_edge(out_v, label, in_v, **props)
 
@@ -283,6 +292,22 @@ class GraphTraversalSource:
 
 
 # ---------------------------------------------------------------- start steps
+class _start_new_vertex:
+    """AddVertexStartStep: creates the vertex at run() — a traversal that
+    never executes (or fails while being built) must not leave a phantom
+    vertex in the transaction, and each execution creates a fresh one."""
+
+    def __init__(self, source: GraphTraversalSource, label):
+        self.source = source
+        self.label = label
+        self.plan = {"access": "addV"}
+
+    def run(self, has_conditions) -> List[Traverser]:
+        tx = self.source.tx
+        v = tx.add_vertex(self.label)
+        return _apply_has([Traverser(v)], has_conditions, tx)
+
+
 class _start_vertices:
     def __init__(self, source: GraphTraversalSource, ids):
         self.source = source
@@ -742,6 +767,19 @@ class GraphTraversal:
             return out
 
         self._add(step, name="elementMap")
+        return self
+
+    def add_v_(self, label: Optional[str] = None) -> "GraphTraversal":
+        """Mid-traversal AddVertexStep: one NEW vertex per incoming
+        traverser, whatever its object (the canonical upsert
+        ``fold().coalesce(__.unfold(), __.add_v_('person'))`` spawns from
+        the empty-fold list traverser)."""
+        tx = self.tx
+
+        def step(ts):
+            return [t.child(tx.add_vertex(label)) for t in ts]
+
+        self._add(step, name=f"addV({label})")
         return self
 
     def add_e_(self, label: str, **props) -> "GraphTraversal":

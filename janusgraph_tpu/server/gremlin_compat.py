@@ -38,7 +38,7 @@ STEP_MAP = {
     "bothV": "both_v",
     "otherV": "other_v",
     "addE": "add_e_",
-    "addV": "add_v",
+    "addV": "add_v_",
     "hasNot": "has_not",
     "hasLabel": "has_label",
     "hasId": "has_id",

@@ -616,3 +616,57 @@ def test_gremlin_dialect_fuzz_equivalence():
         ) + ".values('name')"
         assert sorted(srv.execute(gq)) == sorted(srv.execute(pq)), gq
     g.close()
+
+
+def test_gremlin_addv_insert_form(gods_graph, manager):
+    """g.addV('person').property('name','marko') — the canonical Gremlin
+    insert — works over the endpoint, and add_v_ composes with add_e_."""
+    srv = JanusGraphServer(manager=manager)
+    out = srv.execute(
+        "g.addV('person').property('name','marko').values('name')"
+    )
+    assert out == ["marko"]
+    # committed via a follow-up (server txs roll back per request —
+    # mutations need an explicit API tx; verify via direct API instead)
+    t = gods_graph.traversal()
+    v = t.add_v_("person").property("name", "ada").next()
+    t.add_v_("person").property("name", "bob").add_e_("knows").to_(
+        v
+    ).iterate()
+    t.tx.commit()
+    assert gods_graph.traversal().V().has("name", "bob").out(
+        "knows"
+    ).values("name").to_list() == ["ada"]
+
+
+def test_gremlin_addv_lazy_and_upsert(gods_graph, manager):
+    """Review regressions: addV is lazy (no phantom vertex when the chain
+    fails at build time; one vertex per execution) and the canonical
+    coalesce-upsert works over the endpoint."""
+    srv = JanusGraphServer(manager=manager)
+    t = gods_graph.traversal()
+    before = len(t.V().to_list())
+    # build-time failure leaves NO vertex behind
+    import pytest as _p
+
+    from janusgraph_tpu.core.traversal import QueryError
+
+    with _p.raises(QueryError):
+        gods_graph.traversal().add_v_("ghost").property(None)
+    assert len(gods_graph.traversal().V().to_list()) == before
+    # one vertex PER EXECUTION
+    trav = gods_graph.traversal().add_v_("dup")
+    a = trav.next()
+    b = trav.next()
+    assert a.id != b.id
+    # the canonical Gremlin upsert over the endpoint
+    out = srv.execute(
+        "g.V().has('name','nosuch').fold()"
+        ".coalesce(__.unfold(), __.addV('person')).label()"
+    )
+    assert out == ["person"]
+    out2 = srv.execute(
+        "g.V().has('name','hercules').fold()"
+        ".coalesce(__.unfold(), __.addV('person')).values('name')"
+    )
+    assert out2 == ["hercules"]
