@@ -73,6 +73,7 @@ def cmd_server(args) -> int:
         max_request_bytes=graph.config.get("server.max-request-bytes"),
         max_query_length=graph.config.get("server.max-query-length"),
         request_timeout_s=graph.config.get("server.request-timeout-s"),
+        auto_commit=graph.config.get("server.auto-commit"),
     ).start()
     print(f"JanusGraph-TPU server listening on {args.host}:{server.port}")
     try:

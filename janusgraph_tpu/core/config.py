@@ -484,6 +484,14 @@ SERVER_NS.option(
     "timeout: idle WebSocket sessions live indefinitely)", 120.0,
     Mutability.MASKABLE, lambda v: v >= 0,
 )
+SERVER_NS.option(
+    "auto-commit", bool,
+    "commit each successful request's transaction (the reference Gremlin "
+    "Server's sessionless semantics — mutating queries like mergeV/addV "
+    "persist); false rolls every request back, making the endpoint "
+    "read-only (read in JanusGraphServer.execute)", True,
+    Mutability.MASKABLE,
+)
 TX_NS.option(
     "read-only-default", bool,
     "new transactions default to read-only (pairs with storage.read-only "

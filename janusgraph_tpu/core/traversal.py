@@ -21,6 +21,7 @@ batch thinking of the TPU OLAP path.
 
 from __future__ import annotations
 
+import enum
 from collections import Counter
 from typing import Callable, List, Optional, Sequence, Tuple
 
@@ -29,6 +30,33 @@ from janusgraph_tpu.core.elements import Edge, Vertex, VertexProperty
 from janusgraph_tpu.core.predicates import Cmp, Geo, Text
 from janusgraph_tpu.core.schema import IndexDefinition
 from janusgraph_tpu.exceptions import QueryError
+
+
+class T(enum.Enum):
+    """TinkerPop structure tokens: the map keys that address an element's
+    id/label DISTINCTLY from same-named property keys — merge_v/merge_e
+    match maps and the Gremlin-text dialect use them (surface reached
+    through the reference's TinkerPop dependency: gremlin-core
+    structure.T, used by every mergeV example in its docs)."""
+
+    id = "id"
+    label = "label"
+
+
+def _split_merge_map(match: dict):
+    """(id, label, {prop: value}) from a merge match map keyed by T tokens
+    and property names. Direction keys (merge_e endpoints) are stripped by
+    the caller first."""
+    vid = match.get(T.id)
+    label = match.get(T.label)
+    props = {
+        k: v for k, v in match.items()
+        if not isinstance(k, (T, Direction))
+    }
+    for k in props:
+        if not isinstance(k, str):
+            raise QueryError(f"merge map key {k!r} is not a property name")
+    return vid, label, props
 
 
 class P:
@@ -282,6 +310,34 @@ class GraphTraversalSource:
     def add_e(self, out_v: Vertex, label: str, in_v: Vertex, **props) -> Edge:
         return self.tx.add_edge(out_v, label, in_v, **props)
 
+    def merge_v(self, match: dict) -> "GraphTraversal":
+        """TinkerPop MergeVertexStep (start): ``g.merge_v({T.label: 'person',
+        'name': 'marko'}).on_create({'age': 29})`` — emit every vertex
+        matching the map (label + property equalities, index-folded like
+        V().has()), or create one from the map if none match. on_create()
+        extends the creation map; on_match() sets properties on matched
+        vertices. The declarative spelling of the
+        ``fold().coalesce(unfold(), add_v_())`` upsert idiom."""
+        start = _start_merge_vertex(self, dict(match))
+        t = GraphTraversal(self, start)
+        t._last_merge = start.spec
+        return t
+
+    def merge_e(self, match: dict) -> "GraphTraversal":
+        """TinkerPop MergeEdgeStep (start): match keys Direction.OUT /
+        Direction.IN (Vertex or vertex id), T.label, plus property
+        equalities; emits matching edges or creates one. on_create()/
+        on_match() as in merge_v."""
+        start = _start_merge_edge(self, dict(match))
+        t = GraphTraversal(self, start)
+        t._last_merge = start.spec
+        return t
+
+    def inject(self, *values) -> "GraphTraversal":
+        """TinkerPop InjectStep (start): a traversal over the given raw
+        values — ``g.inject(1, 2).map_(...)`` shapes."""
+        return GraphTraversal(self, _start_inject(self, values))
+
     def commit(self) -> None:
         self.tx.commit()
         self.tx = self.graph.new_transaction()
@@ -306,6 +362,183 @@ class _start_new_vertex:
         tx = self.source.tx
         v = tx.add_vertex(self.label)
         return _apply_has([Traverser(v)], has_conditions, tx)
+
+
+def _merge_find_vertices(source, match) -> List[Vertex]:
+    """Vertices matching a merge_v map: T.id short-circuits to a point
+    lookup; otherwise label + property equalities run through the normal
+    V().has() start so composite-index folding applies."""
+    vid, label, props = _split_merge_map(match)
+    tx = source.tx
+    if vid is not None:
+        v = tx.get_vertex(vid.id if isinstance(vid, Vertex) else vid)
+        if v is None:
+            return []
+        if label is not None and v.label != label:
+            return []
+        for k, want in props.items():
+            if want not in [p.value for p in tx.get_properties(v, k)]:
+                return []
+        return [v]
+    t = GraphTraversal(source, _start_vertices(source, ()))
+    if label is not None:
+        t = t.has_label(label)
+    for k, v in props.items():
+        t = t.has(k, v)
+    return t.to_list()
+
+
+def _merge_vertex(source, match, spec) -> List[Vertex]:
+    """Find-or-create for merge_v: returns the matched vertices (after
+    applying on_match properties) or the one created vertex (from the
+    match map merged with the on_create map)."""
+    tx = source.tx
+    # validate the on_create modulator EAGERLY — before the match runs —
+    # so a bad query fails the same way regardless of data state
+    vid, label, props = _split_merge_map(match)
+    cid, clabel, cprops = _split_merge_map(spec["on_create"])
+    if cid is not None:
+        raise QueryError("on_create() cannot set T.id")
+    if clabel is not None and label is not None and clabel != label:
+        raise QueryError("on_create() T.label conflicts with the merge map")
+    overlap = set(props) & set(cprops)
+    if overlap:
+        # TinkerPop rejects onCreate overriding merge-map keys: the created
+        # element would not match its own merge map, duplicating on re-run
+        raise QueryError(
+            f"on_create() cannot override merge-map keys {sorted(overlap)}"
+        )
+    found = _merge_find_vertices(source, match)
+    if found:
+        for v in found:
+            for k, val in spec["on_match"].items():
+                tx.add_property(v, k, val)
+        return found
+    # a T.id-keyed merge that misses must create WITH that id (TinkerPop
+    # contract — anything else duplicates on every re-run); custom ids
+    # need graph.set-vertex-id=true, and tx.add_vertex raises if not
+    v = tx.add_vertex(
+        label or clabel,
+        vertex_id=vid.id if isinstance(vid, Vertex) else vid,
+        **{**props, **cprops},
+    )
+    return [v]
+
+
+def _merge_resolve_endpoint(tx, target, side: str) -> Vertex:
+    if isinstance(target, Vertex):
+        return target
+    v = tx.get_vertex(target)
+    if v is None:
+        raise QueryError(f"merge_e {side} endpoint {target!r} not found")
+    return v
+
+
+def _merge_edge(source, match, spec, default_v: Optional[Vertex] = None):
+    """Find-or-create for merge_e. Endpoints default to `default_v` (the
+    incoming vertex in mid-traversal position) when the map omits them;
+    on_create may supply endpoints/label the match map lacks."""
+    tx = source.tx
+    # on_create fills in whatever the match map lacks (endpoints, label);
+    # a CONFLICTING on_create label is an error, not a silent override
+    eid, label, props = _split_merge_map(match)
+    if eid is not None:
+        # no edge-by-id access path exists (edges are addressed through
+        # their incident vertices here, like the reference's relation
+        # ids) — refuse loudly rather than match the wrong edge
+        raise QueryError(
+            "merge_e does not support T.id matching; address the edge "
+            "via Direction.OUT/Direction.IN + T.label"
+        )
+    cid, clabel, cprops = _split_merge_map(spec["on_create"])
+    if cid is not None:
+        raise QueryError("on_create() cannot set T.id")
+    if clabel is not None and label is not None and clabel != label:
+        raise QueryError("on_create() T.label conflicts with the merge map")
+    overlap = set(props) & set(cprops)
+    if overlap:
+        raise QueryError(
+            f"on_create() cannot override merge-map keys {sorted(overlap)}"
+        )
+    merged = {**spec["on_create"], **match}
+    out_t = merged.get(Direction.OUT, default_v)
+    in_t = merged.get(Direction.IN, default_v)
+    if out_t is None or in_t is None:
+        raise QueryError(
+            "merge_e needs Direction.OUT and Direction.IN endpoints "
+            "(from the merge map, on_create(), or an incoming vertex)"
+        )
+    if label is None and clabel is None:
+        raise QueryError("merge_e needs a T.label entry")
+    out_v = _merge_resolve_endpoint(tx, out_t, "OUT")
+    in_v = _merge_resolve_endpoint(tx, in_t, "IN")
+    found = []
+    # match on the MATCH map only: no T.label there means any label
+    # between the endpoints matches (on_create's label is creation-only)
+    for e in tx.get_edges(out_v, Direction.OUT,
+                          (label,) if label is not None else ()):
+        if e.in_vertex.id != in_v.id:
+            continue
+        vals = e.property_values()
+        if all(vals.get(k) == want for k, want in props.items()):
+            found.append(e)
+    if found:
+        out = []
+        for e in found:
+            for k, val in spec["on_match"].items():
+                e = e.set_property(k, val)
+            out.append(e)
+        return out
+    e = tx.add_edge(
+        out_v, label or clabel, in_v, **{**props, **cprops}
+    )
+    return [e]
+
+
+class _start_merge_vertex:
+    """MergeVertexStep in start position: find-or-create runs at run() so
+    an unexecuted traversal leaves no phantom writes (same laziness as
+    _start_new_vertex), and on_create()/on_match() modulators registered
+    after construction are honored via the shared spec."""
+
+    def __init__(self, source: GraphTraversalSource, match: dict):
+        self.source = source
+        self.match = match
+        self.spec = {"on_create": {}, "on_match": {}}
+        self.plan = {"access": "mergeV"}
+
+    def run(self, has_conditions) -> List[Traverser]:
+        vs = _merge_vertex(self.source, self.match, self.spec)
+        return _apply_has(
+            [Traverser(v) for v in vs], has_conditions, self.source.tx
+        )
+
+
+class _start_merge_edge:
+    def __init__(self, source: GraphTraversalSource, match: dict):
+        self.source = source
+        self.match = match
+        self.spec = {"on_create": {}, "on_match": {}}
+        self.plan = {"access": "mergeE"}
+
+    def run(self, has_conditions) -> List[Traverser]:
+        es = _merge_edge(self.source, self.match, self.spec)
+        return _apply_has(
+            [Traverser(e) for e in es], has_conditions, self.source.tx
+        )
+
+
+class _start_inject:
+    def __init__(self, source: GraphTraversalSource, values):
+        self.source = source
+        self.values = tuple(values)
+        self.plan = {"access": "inject"}
+
+    def run(self, has_conditions) -> List[Traverser]:
+        return _apply_has(
+            [Traverser(v) for v in self.values], has_conditions,
+            self.source.tx,
+        )
 
 
 class _start_vertices:
@@ -876,6 +1109,95 @@ class GraphTraversal:
         if spec is None:
             raise QueryError("from_() must follow add_e_()")
         spec["from"] = target
+        return self
+
+    def merge_v(self, match: Optional[dict] = None) -> "GraphTraversal":
+        """Mid-traversal MergeVertexStep: find-or-create per incoming
+        traverser. With no map, the incoming traverser's object IS the
+        merge map (the ``inject({...}).merge_v()`` bulk-upsert shape);
+        each match (or the one created vertex) continues the traversal."""
+        source = self.source
+        spec = {"on_create": {}, "on_match": {}}
+        self._last_merge = spec
+
+        def step(ts):
+            out = []
+            for t in ts:
+                m = match if match is not None else t.obj
+                if not isinstance(m, dict):
+                    raise QueryError(
+                        "merge_v() without a map needs dict traversers "
+                        f"(got {type(m).__name__})"
+                    )
+                for v in _merge_vertex(source, m, spec):
+                    out.append(t.child(v))
+            return out
+
+        self._add(step, name="mergeV")
+        return self
+
+    def merge_e(self, match: Optional[dict] = None) -> "GraphTraversal":
+        """Mid-traversal MergeEdgeStep: endpoints the map omits default to
+        the incoming vertex (TinkerPop's incident-vertex default)."""
+        source = self.source
+        spec = {"on_create": {}, "on_match": {}}
+        self._last_merge = spec
+
+        def step(ts):
+            out = []
+            for t in ts:
+                m = match if match is not None else t.obj
+                if not isinstance(m, dict):
+                    raise QueryError(
+                        "merge_e() without a map needs dict traversers "
+                        f"(got {type(m).__name__})"
+                    )
+                default_v = t.obj if isinstance(t.obj, Vertex) else None
+                for e in _merge_edge(source, m, spec, default_v):
+                    out.append(t.child(e, prev=default_v))
+            return out
+
+        self._add(step, name="mergeE")
+        return self
+
+    def on_create(self, props: dict) -> "GraphTraversal":
+        """Creation-side modulator for the preceding merge_v()/merge_e():
+        extends the creation map (properties, and for merge_e endpoints/
+        label the match map lacks)."""
+        spec = getattr(self, "_last_merge", None)
+        if spec is None:
+            raise QueryError("on_create() must follow merge_v()/merge_e()")
+        spec["on_create"].update(props)
+        return self
+
+    def on_match(self, props: dict) -> "GraphTraversal":
+        """Match-side modulator for the preceding merge_v()/merge_e():
+        properties set on every matched element."""
+        spec = getattr(self, "_last_merge", None)
+        if spec is None:
+            raise QueryError("on_match() must follow merge_v()/merge_e()")
+        for k in props:
+            if not isinstance(k, str):
+                raise QueryError(f"on_match() key {k!r} is not a property")
+        spec["on_match"].update(props)
+        return self
+
+    def inject(self, *values) -> "GraphTraversal":
+        """Mid-traversal InjectStep: append the given raw values to the
+        traverser stream (TinkerPop semantics — existing traversers pass
+        through, injected values start fresh paths)."""
+
+        def step(ts):
+            return list(ts) + [Traverser(v) for v in values]
+
+        self._add(step, name="inject")
+        return self
+
+    def constant(self, value) -> "GraphTraversal":
+        """ConstantStep: map every traverser to the given value."""
+        self._add(
+            lambda ts: [t.child(value) for t in ts], name="constant"
+        )
         return self
 
     def property(self, key: str, value=None, **props) -> "GraphTraversal":

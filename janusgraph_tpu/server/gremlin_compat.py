@@ -52,6 +52,10 @@ STEP_MAP = {
     "toList": "to_list",
     "toSet": "to_set",
     "withSack": "with_sack",
+    "mergeV": "merge_v",
+    "mergeE": "merge_e",
+    "onCreate": "on_create",
+    "onMatch": "on_match",
 }
 
 #: bare Gremlin predicates -> P methods (Gremlin exposes them unqualified)
@@ -108,14 +112,16 @@ def compat_namespace() -> dict:
     vocabulary under its Gremlin spellings, and ANONYMOUS STEPS as the
     Gremlin-Groovy static imports (`where(out('x'))` without `__.`) —
     each bare step name binds to the `__` recorder's method."""
+    from janusgraph_tpu.core.codecs import Direction
     from janusgraph_tpu.core.traversal import (
         AnonymousTraversal,
         GraphTraversal,
         P,
+        T,
     )
 
     anon = AnonymousTraversal()
-    ns = {"P": P, "__": anon}
+    ns = {"P": P, "__": anon, "T": T, "Direction": Direction}
     for gname, pname in PREDICATE_MAP.items():
         ns[gname] = getattr(P, pname)
     # every public GraphTraversal step, under BOTH spellings (the recorder

@@ -101,6 +101,7 @@ class JanusGraphServer:
         max_request_bytes: int = 1 << 20,
         max_query_length: int = 65536,
         request_timeout_s: float = 120.0,
+        auto_commit: bool = True,
     ):
         self.manager = manager or JanusGraphManager.get_instance()
         self.default_graph = default_graph
@@ -113,6 +114,8 @@ class JanusGraphServer:
         self.max_query_length = max_query_length
         #: server.request-timeout-s — per-connection socket timeout
         self.request_timeout_s = request_timeout_s
+        #: server.auto-commit — sessionless per-request commit on success
+        self.auto_commit = auto_commit
         self._httpd: Optional[ThreadingHTTPServer] = None
         self._thread: Optional[threading.Thread] = None
 
@@ -172,14 +175,24 @@ class JanusGraphServer:
 
         query = translate(query)  # Gremlin dialect -> DSL (lexical only)
         ns = self._namespace(query, graph_name)
+        ok = False
         try:
-            return _evaluate(query, ns)
+            result = _evaluate(query, ns)
+            ok = True
+            return result
         finally:
             for v in ns.values():
                 if isinstance(v, GraphTraversalSource):
-                    # release the source's transaction without reopening
-                    # (source.rollback() would start a fresh one)
-                    v.tx.rollback()
+                    # sessionless semantics (the reference's Gremlin Server
+                    # commits each successful request's tx automatically;
+                    # errors roll back) — server.auto-commit=false restores
+                    # the read-only-endpoint behavior. Release WITHOUT
+                    # reopening (source.commit()/rollback() would start a
+                    # fresh tx).
+                    if ok and self.auto_commit:
+                        v.tx.commit()
+                    else:
+                        v.tx.rollback()
 
     def authenticate_request(self, headers) -> Optional[str]:
         """Returns username, or raises. None when auth is disabled."""

@@ -1,0 +1,244 @@
+"""merge_v/merge_e (TinkerPop 3.6 MergeVertexStep/MergeEdgeStep — the
+declarative upsert surface reached through the reference's TinkerPop
+dependency), plus inject()/constant() and the T structure tokens."""
+
+import pytest
+
+from janusgraph_tpu.core import gods
+from janusgraph_tpu.core.codecs import Direction
+from janusgraph_tpu.core.graph import open_graph
+from janusgraph_tpu.core.traversal import AnonymousTraversal, QueryError, T
+
+__ = AnonymousTraversal()
+
+
+@pytest.fixture()
+def g():
+    graph = open_graph({"ids.authority-wait-ms": 0.0})
+    gods.load(graph)
+    yield graph
+    graph.close()
+
+
+# ------------------------------------------------------------------ merge_v
+def test_merge_v_matches_existing(g):
+    t = g.traversal()
+    before = len(t.V().to_list())
+    hits = t.merge_v({T.label: "god", "name": "jupiter"}).to_list()
+    assert len(hits) == 1 and hits[0].value("name") == "jupiter"
+    assert len(t.V().to_list()) == before  # nothing created
+
+
+def test_merge_v_creates_when_absent(g):
+    t = g.traversal()
+    v = t.merge_v({T.label: "god", "name": "janus"}).next()
+    assert v.label == "god" and v.value("name") == "janus"
+    # second run matches the vertex just created — idempotent upsert
+    again = t.merge_v({T.label: "god", "name": "janus"}).to_list()
+    assert len(again) == 1 and again[0].id == v.id
+
+
+def test_merge_v_on_create_on_match(g):
+    t = g.traversal()
+    v = (
+        t.merge_v({T.label: "god", "name": "minerva"})
+        .on_create({"age": 100})
+        .on_match({"seen": True})
+        .next()
+    )
+    assert v.value("age") == 100  # created: on_create applied
+    assert not [p for p in g.new_transaction().get_properties(v, "seen")]
+    v2 = (
+        t.merge_v({T.label: "god", "name": "minerva"})
+        .on_create({"age": 999})
+        .on_match({"seen": True})
+        .next()
+    )
+    assert v2.id == v.id
+    assert v2.value("age") == 100  # matched: on_create NOT applied
+    assert v2.value("seen") is True  # on_match applied
+
+
+def test_merge_v_by_id_token(g):
+    t = g.traversal()
+    jup = t.V().has("name", "jupiter").next()
+    assert t.merge_v({T.id: jup.id}).next().id == jup.id
+    # a miss on T.id attempts creation, and T.id creation is not supported
+    with pytest.raises(QueryError):
+        t.merge_v({"name": "nobody-here"}).on_create({T.id: 123}).next()
+
+
+def test_merge_v_lazy_no_phantom(g):
+    t = g.traversal()
+    before = len(t.V().to_list())
+    t.merge_v({T.label: "god", "name": "phantom"})  # never executed
+    assert len(t.V().to_list()) == before
+
+
+def test_merge_v_mid_traversal_stream_of_maps(g):
+    t = g.traversal()
+    made = (
+        t.inject({T.label: "titan", "name": "kronos"},
+                 {T.label: "titan", "name": "rhea"})
+        .merge_v()
+        .to_list()
+    )
+    assert {v.value("name") for v in made} == {"kronos", "rhea"}
+    assert all(v.label == "titan" for v in made)
+
+
+def test_merge_v_tid_creation_is_idempotent():
+    """A T.id-keyed merge that misses creates WITH that id (under
+    graph.set-vertex-id), so re-running the same merge matches instead of
+    duplicating."""
+    graph = open_graph({
+        "ids.authority-wait-ms": 0.0, "graph.set-vertex-id": True,
+    })
+    try:
+        t = graph.traversal()
+        vid = graph.idm.make_vertex_id(7, 3)
+        v1 = t.merge_v({T.id: vid, "name": "pinned"}).next()
+        assert v1.id == vid
+        v2 = t.merge_v({T.id: vid, "name": "pinned"}).next()
+        assert v2.id == vid
+        assert len(t.V().has("name", "pinned").to_list()) == 1
+    finally:
+        graph.close()
+
+
+def test_merge_v_tid_creation_without_config_raises(g):
+    from janusgraph_tpu.exceptions import InvalidElementError
+
+    t = g.traversal()
+    vid = g.idm.make_vertex_id(7, 3)
+    with pytest.raises(InvalidElementError, match="set-vertex-id"):
+        t.merge_v({T.id: vid, "name": "nope"}).next()
+
+
+# ------------------------------------------------------------------ merge_e
+def test_merge_e_matches_existing(g):
+    t = g.traversal()
+    jup = t.V().has("name", "jupiter").next()
+    nep = t.V().has("name", "neptune").next()
+    before = len(t.V().has("name", "jupiter").out_e("brother").to_list())
+    e = t.merge_e(
+        {Direction.OUT: jup, Direction.IN: nep, T.label: "brother"}
+    ).next()
+    assert e.label == "brother" and e.in_vertex.id == nep.id
+    after = len(t.V().has("name", "jupiter").out_e("brother").to_list())
+    assert after == before  # matched, not created
+
+
+def test_merge_e_creates_with_on_create(g):
+    t = g.traversal()
+    jup = t.V().has("name", "jupiter").next()
+    sky = t.V().has("name", "sky").next()
+    e = (
+        t.merge_e({Direction.OUT: jup, Direction.IN: sky.id,
+                   T.label: "rules"})
+        .on_create({"since": "always"})
+        .next()
+    )
+    assert e.property_values().get("since") == "always"
+    # re-merge matches (property equality is NOT part of this match map)
+    e2 = t.merge_e(
+        {Direction.OUT: jup, Direction.IN: sky.id, T.label: "rules"}
+    ).on_match({"checked": 1}).next()
+    assert e2.property_values().get("checked") == 1
+
+
+def test_merge_e_mid_traversal_defaults_to_incoming_vertex(g):
+    t = g.traversal()
+    plu = t.V().has("name", "pluto").next()
+    es = (
+        t.V().has("name", "jupiter")
+        .merge_e({Direction.IN: plu, T.label: "brother"})
+        .to_list()
+    )
+    assert len(es) == 1 and es[0].out_vertex.value("name") == "jupiter"
+
+
+def test_merge_e_requires_label(g):
+    t = g.traversal()
+    jup = t.V().has("name", "jupiter").next()
+    with pytest.raises(QueryError):
+        t.merge_e({Direction.OUT: jup, Direction.IN: jup}).next()
+
+
+def test_merge_e_label_from_on_create(g):
+    """on_create may supply what the match map lacks; a label-less match
+    map matches edges of ANY label between the endpoints."""
+    t = g.traversal()
+    jup = t.V().has("name", "jupiter").next()
+    nep = t.V().has("name", "neptune").next()
+    # brother edge already exists jupiter->neptune: label-less map matches
+    e = (
+        t.merge_e({Direction.OUT: jup, Direction.IN: nep})
+        .on_create({T.label: "admires"})
+        .next()
+    )
+    assert e.label == "brother"  # matched, on_create label unused
+    # no edge jupiter->tartarus: creation takes on_create's label
+    tart = t.V().has("name", "tartarus").next()
+    e2 = (
+        t.merge_e({Direction.OUT: jup, Direction.IN: tart})
+        .on_create({T.label: "admires"})
+        .next()
+    )
+    assert e2.label == "admires"
+    # conflicting on_create label is an error, matching merge_v
+    with pytest.raises(QueryError):
+        t.merge_e({Direction.OUT: jup, Direction.IN: tart,
+                   T.label: "admires"}).on_create({T.label: "other"}).next()
+
+
+def test_merge_on_create_cannot_override_match_keys(g):
+    """on_create overriding a merge-map key would create an element that
+    does not match its own merge map (duplicating on every re-run) —
+    rejected eagerly, and eagerly also means the error does NOT depend on
+    whether a match happens to exist."""
+    t = g.traversal()
+    with pytest.raises(QueryError, match="override merge-map"):
+        t.merge_v({T.label: "person", "name": "x"}).on_create(
+            {"name": "y"}
+        ).next()
+    # eager validation: same error even though 'jupiter' EXISTS (the
+    # match path would never consult on_create)
+    with pytest.raises(QueryError, match="cannot set T.id"):
+        t.merge_v({"name": "jupiter"}).on_create({T.id: 1}).next()
+    jup = t.V().has("name", "jupiter").next()
+    nep = t.V().has("name", "neptune").next()
+    with pytest.raises(QueryError, match="override merge-map"):
+        t.merge_e({Direction.OUT: jup, Direction.IN: nep,
+                   T.label: "brother", "w": 1}).on_create({"w": 2}).next()
+
+
+def test_merge_e_tid_refused(g):
+    t = g.traversal()
+    e = t.V().has("name", "jupiter").out_e("brother").next()
+    with pytest.raises(QueryError, match="T.id"):
+        t.merge_e({T.id: e.id}).next()
+
+
+# ------------------------------------------------------------- inject/const
+def test_inject_start_and_mid(g):
+    t = g.traversal()
+    assert t.inject(1, 2, 3).to_list() == [1, 2, 3]
+    vals = t.V().has("name", "jupiter").inject("x").to_list()
+    assert vals[-1] == "x" and len(vals) == 2
+
+
+def test_constant(g):
+    t = g.traversal()
+    out = t.V().has_label("god").constant("fixed").to_list()
+    assert out and set(out) == {"fixed"}
+
+
+# ----------------------------------------------------------- gremlin dialect
+def test_gremlin_text_merge_spelling():
+    from janusgraph_tpu.server.gremlin_compat import translate
+
+    q = "g.mergeV({T.label: 'god', 'name': 'x'}).onCreate({'age': 1})"
+    out = translate(q)
+    assert "merge_v" in out and "on_create" in out
+    assert "'god'" in out  # string literals untouched

@@ -626,8 +626,9 @@ def test_gremlin_addv_insert_form(gods_graph, manager):
         "g.addV('person').property('name','marko').values('name')"
     )
     assert out == ["marko"]
-    # committed via a follow-up (server txs roll back per request —
-    # mutations need an explicit API tx; verify via direct API instead)
+    # sessionless auto-commit (server.auto-commit, default on): the
+    # mutation persists across requests like the reference Gremlin Server
+    assert srv.execute("g.V().has('name','marko').count()") == 1
     t = gods_graph.traversal()
     v = t.add_v_("person").property("name", "ada").next()
     t.add_v_("person").property("name", "bob").add_e_("knows").to_(
@@ -670,3 +671,26 @@ def test_gremlin_addv_lazy_and_upsert(gods_graph, manager):
         ".coalesce(__.unfold(), __.addV('person')).values('name')"
     )
     assert out2 == ["hercules"]
+
+
+def test_server_auto_commit_and_read_only_mode(gods_graph, manager):
+    """server.auto-commit: sessionless requests commit on success (the
+    reference Gremlin Server's default); auto_commit=False makes the
+    endpoint read-only (every request rolls back); errors roll back."""
+    srv = JanusGraphServer(manager=manager)
+    srv.execute("g.mergeV({T.label: 'god', 'name': 'fortuna'})"
+                ".onCreate({'age': 7}).iterate()")
+    assert srv.execute("g.V().has('name','fortuna').values('age')") == [7]
+    # merge across requests matches (no duplicate)
+    srv.execute("g.mergeV({T.label: 'god', 'name': 'fortuna'}).iterate()")
+    assert srv.execute("g.V().has('name','fortuna').count()") == 1
+    # a FAILING request rolls back its mutation: the vertex is created in
+    # the tx, then next() on the empty expansion raises at execution time
+    with pytest.raises(Exception):
+        srv.execute("g.addV('person').property('name','ghost')"
+                    ".out('nothing').next()")
+    assert srv.execute("g.V().has('name','ghost').count()") == 0
+    # read-only endpoint
+    ro = JanusGraphServer(manager=manager, auto_commit=False)
+    ro.execute("g.addV('person').property('name','volatile').iterate()")
+    assert ro.execute("g.V().has('name','volatile').count()") == 0
