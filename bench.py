@@ -39,7 +39,12 @@ pointer need), BENCH_CPU_SCALE (fallback scale, 20),
 BENCH_EXTRAS_SCALE (default 20 — the ladder rung that additionally runs
 the CC / peer-pressure / 3-hop-count headline workloads; must appear in
 BENCH_SCALES to fire, and its compile time comes out of BENCH_BUDGET_S
-before the s23 rung).
+before the s23 rung), BENCH_STAGE_TIMEOUT_S (900; worker exits — with
+every completed stage already emitted — when no phase completes for
+this long: a wedged tunnel claim must not eat the ladder),
+BENCH_DENSE_MAX_SCALE (21; dense-BFS comparison rungs above this are
+skipped — their walls are the measured r3 gather-wall numbers and their
+compiles are where the tunnel wedge bites).
 """
 
 import json
@@ -298,7 +303,15 @@ def _emit(obj: dict) -> None:
     sys.stdout.flush()
 
 
+#: last-progress timestamp for the stage watchdog (see worker()): _hb is
+#: called after every phase that completes, so a silent gap this long
+#: means a wedged device call (observed: the r5 s22 dense-BFS compile
+#: hung the tunnel claim indefinitely and ate the remaining ladder)
+_PROGRESS = {"t": time.monotonic()}
+
+
 def _hb(msg: str, t0: float) -> None:
+    _PROGRESS["t"] = time.monotonic()
     print(f"bench worker [{time.monotonic() - t0:8.1f}s] {msg}", file=sys.stderr, flush=True)
 
 
@@ -421,32 +434,6 @@ def _bench_scale(
     pr_eps = pr_iters * csr.num_edges / pr_s
     _hb(f"s{scale}: pagerank {pr_s:.3f}s ({pr_eps:.3e} edges/s)", t0)
 
-    # BFS both ways: frontier-compacted (the default; olap/frontier.py) and
-    # the dense BSP path it replaces — the delta is the VERDICT r3 #1 claim.
-    # Seed at the max-out-degree hub: seed 0 can be a SINK on R-MAT draws
-    # (observed at s20: out-degree 0 -> a one-hop no-op "benchmark"), and
-    # hub-seeded 4-hop reaches most of the graph — the honest workload.
-    bfs_seed = int(np.argmax(csr.out_degree))
-    bfs_prog = ShortestPathProgram(seed_index=bfs_seed, max_iterations=4)
-    ex.run(bfs_prog)  # warm: compiles the per-tier step executables
-    b0 = time.perf_counter()
-    bfs_res = ex.run(bfs_prog)
-    jax.block_until_ready(bfs_res["distance"])
-    bfs_s = time.perf_counter() - b0
-    _hb(f"s{scale}: bfs-4hop frontier {bfs_s:.3f}s", t0)
-    bfs_path = ex.last_run_info.get("path", "unknown")
-    bfs_tiers = [
-        {k: t[k] for k in ("hop", "frontier", "edges", "E_cap")}
-        for t in ex.last_run_info.get("tiers", [])
-    ]
-    ex.run(bfs_prog, frontier="off")
-    b0 = time.perf_counter()
-    bfs_dense = ex.run(bfs_prog, sync_every=4, frontier="off")
-    jax.block_until_ready(bfs_dense["distance"])
-    bfs_dense_s = time.perf_counter() - b0
-    _hb(f"s{scale}: bfs-4hop dense {bfs_dense_s:.3f}s "
-        f"(frontier speedup {bfs_dense_s / max(bfs_s, 1e-9):.1f}x)", t0)
-
     base_iters = 3 if scale >= 20 else 5
     base_eps = host_pagerank_edges_per_sec(csr, iters=base_iters)
 
@@ -472,6 +459,9 @@ def _bench_scale(
         _hb(f"s{scale}: fulgora-analogue {fb['edges_per_sec']:.3e} edges/s "
             f"(tpu/cpu path is {pr_eps / fb['edges_per_sec']:.0f}x)", t0)
 
+    # the pagerank stage emits BEFORE the BFS section: a wedged device
+    # call later in the rung (observed r5: the s22 dense-BFS compile hung
+    # the tunnel claim) must not lose the rung's headline measurement
     _emit({
         "stage": "pagerank",
         "value": round(pr_eps, 1),
@@ -486,12 +476,6 @@ def _bench_scale(
         "pr_iters": pr_iters,
         "pagerank_wall_s": round(pr_s, 3),
         "pagerank_superstep_ms": round(1000.0 * pr_s / pr_iters, 3),
-        "bfs_4hop_wall_s": round(bfs_s, 3),
-        "bfs_strategy": bfs_path,
-        "bfs_seed": bfs_seed,
-        "bfs_frontier_tiers": bfs_tiers,
-        "bfs_dense_4hop_wall_s": round(bfs_dense_s, 3),
-        "bfs_frontier_speedup": round(bfs_dense_s / max(bfs_s, 1e-9), 2),
         "graph_gen_s": round(gen_s, 2),
         "transfer_pack_s": round(transfer_s, 2),
         "compile_s": round(compile_s, 2),
@@ -504,6 +488,55 @@ def _bench_scale(
         "ell_bytes": ell_fp["bytes"],
         "ell_pad_ratio": round(ell_fp["pad_ratio"], 3),
     })
+
+    # BFS both ways: frontier-compacted (the default; olap/frontier.py) and
+    # the dense BSP path it replaces — the delta is the VERDICT r3 #1 claim.
+    # Seed at the max-out-degree hub: seed 0 can be a SINK on R-MAT draws
+    # (observed at s20: out-degree 0 -> a one-hop no-op "benchmark"), and
+    # hub-seeded 4-hop reaches most of the graph — the honest workload.
+    bfs_seed = int(np.argmax(csr.out_degree))
+    bfs_prog = ShortestPathProgram(seed_index=bfs_seed, max_iterations=4)
+    ex.run(bfs_prog)  # warm: compiles the per-tier step executables
+    b0 = time.perf_counter()
+    bfs_res = ex.run(bfs_prog)
+    jax.block_until_ready(bfs_res["distance"])
+    bfs_s = time.perf_counter() - b0
+    _hb(f"s{scale}: bfs-4hop frontier {bfs_s:.3f}s", t0)
+    _emit({
+        "stage": "bfs",
+        "platform": platform,
+        "scale": scale,
+        "bfs_4hop_wall_s": round(bfs_s, 3),
+        "bfs_strategy": ex.last_run_info.get("path", "unknown"),
+        "bfs_seed": bfs_seed,
+        "bfs_frontier_tiers": [
+            {k: t[k] for k in ("hop", "frontier", "edges", "E_cap")}
+            for t in ex.last_run_info.get("tiers", [])
+        ],
+    })
+
+    # dense comparison capped by default: the dense executables at the top
+    # rungs are exactly the gather-wall walls the r3 artifacts measured
+    # (s23 dense 4-hop 7.6-8.3s), and their compile is where the tunnel
+    # wedge bit — keep the ladder's critical path off it
+    dense_max = int(os.environ.get("BENCH_DENSE_MAX_SCALE", "21"))
+    if scale <= dense_max:
+        ex.run(bfs_prog, frontier="off")
+        b0 = time.perf_counter()
+        bfs_dense = ex.run(bfs_prog, sync_every=4, frontier="off")
+        jax.block_until_ready(bfs_dense["distance"])
+        bfs_dense_s = time.perf_counter() - b0
+        _hb(f"s{scale}: bfs-4hop dense {bfs_dense_s:.3f}s "
+            f"(frontier speedup {bfs_dense_s / max(bfs_s, 1e-9):.1f}x)", t0)
+        _emit({
+            "stage": "bfs_dense",
+            "platform": platform,
+            "scale": scale,
+            "bfs_dense_4hop_wall_s": round(bfs_dense_s, 3),
+            "bfs_frontier_speedup": round(
+                bfs_dense_s / max(bfs_s, 1e-9), 2
+            ),
+        })
 
     # Remaining BASELINE.md headline workloads (configs #2/#4/#5) at ONE
     # ladder scale: ConnectedComponent, PeerPressure label propagation
@@ -754,6 +787,31 @@ def worker() -> None:
     devs = jax.devices()
     init_s = time.perf_counter() - i0
     init_done.set()
+
+    # stage watchdog: every completed phase heartbeats through _hb; a
+    # silent gap past BENCH_STAGE_TIMEOUT_S means a device call wedged
+    # (r5: s22 dense-BFS compile hung the tunnel claim for 15+ min) —
+    # exit so the already-emitted stages become the artifact instead of
+    # the supervisor burning its whole budget on the hang. 900s default
+    # clears the longest legitimate gaps (s23 graph gen ~170s, big
+    # compiles ~240s) with margin.
+    stage_cap = float(os.environ.get("BENCH_STAGE_TIMEOUT_S", "900"))
+    if stage_cap > 0:
+        def _stage_watchdog():
+            while True:
+                time.sleep(30.0)
+                gap = time.monotonic() - _PROGRESS["t"]
+                if gap > stage_cap:
+                    _hb(f"no progress for {gap:.0f}s — wedged device "
+                        "call, exiting with recorded stages", t0)
+                    _emit({
+                        "stage": "error",
+                        "error": f"stage watchdog: no progress for "
+                                 f"{gap:.0f}s (wedged device call)",
+                    })
+                    os._exit(3)
+
+        threading.Thread(target=_stage_watchdog, daemon=True).start()
     platform = devs[0].platform
     if platform == "axon":  # axon = the TPU tunnel's PJRT plugin name
         platform = "tpu"

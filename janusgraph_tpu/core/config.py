@@ -484,6 +484,49 @@ SERVER_NS.option(
     "timeout: idle WebSocket sessions live indefinitely)", 120.0,
     Mutability.MASKABLE, lambda v: v >= 0,
 )
+# ---- round-5 batch: remaining reference-vocabulary knobs that were
+# ---- hard-coded constants; each names its read site
+QUERY_NS.option(
+    "ignore-unknown-index-key", bool,
+    "graph-centric queries over a property key absent from the schema: "
+    "false (reference default) raises QueryError, true treats the "
+    "condition as unsatisfiable (reference: "
+    "query.ignore-unknown-index-key; read in the V().has() start-step "
+    "fold)", False, Mutability.MASKABLE,
+)
+INDEX_NS.option(
+    "search.scroll-page-size", int,
+    "page size of IndexProvider.query_stream scroll-style paging "
+    "(reference: the ES scroll window, ElasticSearchScroll.java:80; "
+    "read in provider.query_stream)", 1000,
+    Mutability.MASKABLE, lambda v: v > 0,
+)
+SCHEMA.option(
+    "eviction-ack-poll-ms", float,
+    "polling cadence while a schema change waits for cache-eviction "
+    "acks (read in ManagementLogger.wait_for_acks)", 5.0,
+    Mutability.MASKABLE, lambda v: v > 0,
+)
+LOG_NS.option(
+    "slice-granularity-ms", int,
+    "time window of one log row: messages within a window share a "
+    "sorted row, bounding per-row width vs row count (FIXED — row keys "
+    "are derived from it; read at KCVSLog construction)", 100,
+    Mutability.FIXED, lambda v: v > 0,
+)
+STORAGE.option(
+    "remote.parallel-slice-factor", int,
+    "client-side multi-slice fan-out fires when the key count exceeds "
+    "factor x pool connections (read in RemoteStoreManager multi-slice)",
+    2, Mutability.MASKABLE, lambda v: v >= 1,
+)
+COMPUTER_NS.option(
+    "frontier-tier-growth", int,
+    "growth factor between frontier tier capacities — one compiled "
+    "executable per tier, so smaller factors mean tighter capacity fit "
+    "but more compiles (read in the frontier tier ladder)", 4,
+    Mutability.MASKABLE, lambda v: v >= 2,
+)
 SERVER_NS.option(
     "auto-commit", bool,
     "commit each successful request's transaction (the reference Gremlin "

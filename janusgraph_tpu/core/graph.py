@@ -87,6 +87,9 @@ def _open_remote(cfg):
         connect_timeout_s=cfg.get("storage.remote.connect-timeout-ms")
         / 1000.0,
         max_attempts=cfg.get("storage.write-attempts"),
+        parallel_slice_factor=cfg.get(
+            "storage.remote.parallel-slice-factor"
+        ),
     )
 
 
@@ -356,6 +359,7 @@ class JanusGraphTPU:
             read_interval_ms=cfg.get("log.read-interval-ms"),
             send_delay_ms=cfg.get("log.send-delay-ms"),
             ttl_seconds=cfg.get("log.ttl-seconds"),
+            slice_granularity_ms=cfg.get("log.slice-granularity-ms"),
         )
         self._tx_log = None
         self._mgmt_logger = None
@@ -380,6 +384,7 @@ class JanusGraphTPU:
                 fsync=cfg.get("index.search.fsync"),
                 pool_size=cfg.get("index.search.pool-size"),
                 retry_time_s=cfg.get("index.search.retry-time-ms") / 1000.0,
+                scroll_page_size=cfg.get("index.search.scroll-page-size"),
             )
         self.index_providers: Dict[str, object] = shared
         # {index_name: {field: KeyInformation}} for provider.mutate calls

@@ -380,6 +380,11 @@ def _merge_find_vertices(source, match) -> List[Vertex]:
             if want not in [p.value for p in tx.get_properties(v, k)]:
                 return []
         return [v]
+    # a key the schema has never seen cannot match anything — that is the
+    # CREATE path of the upsert, not a query error (so the
+    # query.ignore-unknown-index-key strictness does not apply here)
+    if any(not _is_property_key(source.graph, k) for k in props):
+        return []
     t = GraphTraversal(source, _start_vertices(source, ()))
     if label is not None:
         t = t.has_label(label)
@@ -551,6 +556,10 @@ class _start_vertices:
     def run(self, has_conditions) -> List[Traverser]:
         tx = self.source.tx
         if self.ids:
+            # id point-lookups keep plain filter semantics: the reference's
+            # query.ignore-unknown-index-key governs only graph-centric
+            # (index-planned) queries — JanusGraphStep with ids bypasses
+            # GraphCentricQueryBuilder
             self.plan = {"access": "ids"}
             out = []
             for i in self.ids:
@@ -558,6 +567,25 @@ class _start_vertices:
                 if v is not None:
                     out.append(Traverser(v))
             return _apply_has(out, has_conditions, tx)
+        # query.ignore-unknown-index-key (reference default false): a
+        # graph-centric query over a key the schema has never seen is
+        # almost always a typo — raise unless the option opts into
+        # treating the condition as unsatisfiable (reference:
+        # GraphCentricQueryBuilder unknown-key handling)
+        graph = self.source.graph
+        unknown = [
+            k for k, _p in has_conditions
+            if k is not None and not _is_property_key(graph, k)
+        ]
+        if unknown:
+            if not graph.config.get("query.ignore-unknown-index-key"):
+                raise QueryError(
+                    f"unknown property key(s) {sorted(set(unknown))} in "
+                    "graph query; set query.ignore-unknown-index-key=true "
+                    "to treat as no-match"
+                )
+            self.plan = {"access": "unknown-key", "keys": unknown}
+            return []
         # index folding: find a composite index fully covered by eq conditions
         eqs = {
             key: p.eq_value
@@ -699,6 +727,16 @@ def _element_value(t: Traverser, key: str, tx):
     return None
 
 
+def _is_property_key(graph, name: str) -> bool:
+    """True when `name` is a PROPERTY KEY in the schema — a vertex/edge
+    label with the same name must not satisfy a has(key, ...) lookup
+    (the reference's unknown-key check is PropertyKey-specific)."""
+    from janusgraph_tpu.core.schema import PropertyKey
+
+    el = graph.schema_cache.get_by_name(name)
+    return isinstance(el, PropertyKey)
+
+
 def _apply_has(ts: List[Traverser], conditions, tx) -> List[Traverser]:
     out = ts
     for key, p in conditions:
@@ -809,6 +847,7 @@ class GraphTraversal:
     def _add(self, step, name: Optional[str] = None) -> None:
         self._folding = False
         self._last_by = None  # a new step closes the previous by() window
+        self._last_repeat = None  # ... and the repeat modulator window
         # label for .profile(): the public step method that registered it
         import sys
 
@@ -2007,26 +2046,47 @@ class GraphTraversal:
         TinkerPop repeat().until()/emit() semantics: the body runs, then the
         until filter splits satisfied traversers out of the loop; emit copies
         every surviving traverser into the output each round. `max_loops`
-        bounds until-only loops (cycles would otherwise never drain)."""
-        if until is None and not emit:
-            if times is None:
-                raise QueryError("repeat() needs times= and/or until=/emit=")
+        bounds until-only loops (cycles would otherwise never drain).
+
+        The REAL Gremlin spelling chains the loop controls as modulators —
+        ``repeat(out('knows')).times(2)``, ``repeat(...).until(...)``,
+        ``repeat(...).emit()`` — so a bare repeat(body) defers: the
+        following times()/until()/emit() calls complete it, and execution
+        without any control raises. (Pre-positioned ``until().repeat()``
+        do-while ordering is not supported — use the kwargs.)"""
+        if until is None and not emit and times is not None:
+            # kwarg times-only fast path: inline the body, no loop step
             for _ in range(times):
                 body(self)
             return self
 
         body_steps = self._sub_steps(body)
-        until_steps = self._sub_steps(until) if until is not None else None
         if max_loops is None:
             # query.max-repeat-loops bounds until-only loops graph-wide
             cfg = getattr(self.tx.graph, "config", None)
             max_loops = cfg.get("query.max-repeat-loops") if cfg else 64
+        spec = {
+            "times": times,
+            "until_steps": (
+                self._sub_steps(until) if until is not None else None
+            ),
+            "emit": emit,
+            "emit_steps": None,
+        }
 
         def step(ts):
+            times_ = spec["times"]
+            until_steps = spec["until_steps"]
+            emit_ = spec["emit"]
+            if times_ is None and until_steps is None and not emit_:
+                raise QueryError(
+                    "repeat() needs times()/until()/emit() — chained "
+                    "modulators or the times=/until=/emit= kwargs"
+                )
             results: List[Traverser] = []
             frontier = ts
             loops = 0
-            bound = times if times is not None else max_loops
+            bound = times_ if times_ is not None else max_loops
             while frontier and loops < bound:
                 frontier = self._apply_steps(body_steps, frontier)
                 loops += 1
@@ -2038,16 +2098,63 @@ class GraphTraversal:
                         else:
                             cont.append(t)
                     frontier = cont
-                if emit:
-                    results.extend(frontier)
-            if until_steps is None and not emit:
+                if emit_:
+                    es = spec["emit_steps"]
+                    if es is None:
+                        results.extend(frontier)
+                    else:
+                        results.extend(
+                            t for t in frontier
+                            if self._apply_steps(es, [t])
+                        )
+            if until_steps is None and not emit_:
                 return frontier
-            if until_steps is not None and not emit:
+            if until_steps is not None and not emit_:
                 # loop bound exhausted: remaining traversers exit as output
                 results.extend(frontier)
             return results
 
         self._add(step, name="repeat")
+        # open the modulator window AFTER _add (which closes the previous
+        # one): chained times()/until()/emit() write into this spec
+        self._last_repeat = spec
+        return self
+
+    def times(self, n: int) -> "GraphTraversal":
+        """Loop-count modulator for the preceding repeat() (the Gremlin
+        ``repeat(...).times(n)`` spelling)."""
+        spec = getattr(self, "_last_repeat", None)
+        if spec is None:
+            raise QueryError("times() must follow repeat()")
+        spec["times"] = n
+        return self
+
+    def until(self, cond) -> "GraphTraversal":
+        """Exit-condition modulator for the preceding repeat()
+        (post-positioned only — do-while ``until().repeat()`` ordering is
+        not supported; use repeat(body, until=...))."""
+        spec = getattr(self, "_last_repeat", None)
+        if spec is None:
+            raise QueryError(
+                "until() must follow repeat() (pre-positioned until() is "
+                "not supported — use repeat(body, until=...))"
+            )
+        spec["until_steps"] = self._sub_steps(cond)
+        return self
+
+    def emit(self, arg=True) -> "GraphTraversal":
+        """Emit modulator for the preceding repeat(): copy surviving
+        traversers into the output each round. ``emit(predicate)`` (an
+        anonymous traversal / callable) emits only the traversers the
+        filter passes — the Gremlin emit(has(...)) form."""
+        spec = getattr(self, "_last_repeat", None)
+        if spec is None:
+            raise QueryError("emit() must follow repeat()")
+        if isinstance(arg, bool):
+            spec["emit"] = arg
+        else:
+            spec["emit"] = True
+            spec["emit_steps"] = self._sub_steps(arg)
         return self
 
     # -- aggregation ---------------------------------------------------------

@@ -310,13 +310,18 @@ class ManagementLogger:
     def wait_for_acks(
         self, evict_id: int, expected: int, timeout_s: float = 5.0
     ) -> bool:
+        # schema.eviction-ack-poll-ms: ack-check cadence (trade latency of
+        # schema-change completion against systemlog read pressure)
+        poll_s = self.graph.config.get("schema.eviction-ack-poll-ms") / 1000.0
         deadline = time.monotonic() + timeout_s
-        while time.monotonic() < deadline:
+        while True:
             with self._lock:
                 if len(self._acks.get(evict_id, ())) >= expected:
                     return True
-            time.sleep(0.005)
-        return False
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                return False
+            time.sleep(min(poll_s, remaining))
 
     def _on_message(self, msg: LogMessage) -> None:
         tag = msg.content[:2]

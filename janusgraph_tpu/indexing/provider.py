@@ -168,7 +168,12 @@ class IndexProvider:
     def query(self, store: str, q: IndexQuery) -> List[str]:
         raise NotImplementedError
 
-    def query_stream(self, store: str, q: IndexQuery, page_size: int = 1000):
+    #: index.search.scroll-page-size (set by open_index_provider)
+    scroll_page_size = 1000
+
+    def query_stream(
+        self, store: str, q: IndexQuery, page_size: Optional[int] = None
+    ):
         """Stream hits in pages — the scroll-API analogue in PURPOSE
         (reference: janusgraph-es .../ElasticSearchScroll.java:80 pages
         large result sets instead of materializing them), not in isolation
@@ -178,6 +183,8 @@ class IndexProvider:
         need exactly-once visitation (reindex/restore) against a quiesced
         index, or use a single bounded query(). The remote provider issues
         one bounded wire call per page."""
+        if page_size is None:
+            page_size = self.scroll_page_size
         offset = q.offset
         remaining = q.limit
         while True:
@@ -277,9 +284,14 @@ def register_index_provider(name: str, factory) -> None:
         _PROVIDERS[name] = factory
 
 
-def open_index_provider(name: str, **kwargs) -> IndexProvider:
+def open_index_provider(
+    name: str, scroll_page_size: Optional[int] = None, **kwargs
+) -> IndexProvider:
     with _PROVIDERS_LOCK:
         factory = _PROVIDERS.get(name)
     if factory is None:
         raise ConfigurationError(f"unknown index backend {name!r}")
-    return factory(**kwargs)
+    provider = factory(**kwargs)
+    if scroll_page_size:
+        provider.scroll_page_size = scroll_page_size
+    return provider

@@ -224,6 +224,9 @@ class GraphComputer:
                 "frontier": cfg.get("computer.frontier"),
                 "exchange": cfg.get("computer.exchange"),
                 "agg": cfg.get("computer.agg"),
+                "frontier_tier_growth": cfg.get(
+                    "computer.frontier-tier-growth"
+                ),
             }
         if cfg is not None and self.executor_kind == "tpu":
             run_kwargs = {
@@ -241,6 +244,9 @@ class GraphComputer:
                 ),
                 "frontier_f_min": cfg.get("computer.frontier-f-min"),
                 "frontier_e_min": cfg.get("computer.frontier-e-min"),
+                "frontier_tier_growth": cfg.get(
+                    "computer.frontier-tier-growth"
+                ),
             }
         states = run_on(csr, self._program, self.executor_kind, **run_kwargs)
         memory = {}
@@ -274,6 +280,7 @@ def run_on(
     frontier_cc_min_edges: int = None,
     frontier_f_min: int = None,
     frontier_e_min: int = None,
+    frontier_tier_growth: int = None,
     exchange: str = "a2a",
     agg: str = "ell",
 ):
@@ -286,6 +293,7 @@ def run_on(
 
         return ShardedExecutor(
             csr, exchange=exchange, agg=agg,
+            frontier_tier_growth=frontier_tier_growth,
         ).run(
             program,
             sync_every=sync_every,
@@ -307,6 +315,7 @@ def run_on(
             frontier_cc_min_edges=frontier_cc_min_edges,
             frontier_f_min=frontier_f_min,
             frontier_e_min=frontier_e_min,
+            frontier_tier_growth=frontier_tier_growth,
         ).run(
             program,
             sync_every=sync_every,

@@ -39,12 +39,17 @@ import numpy as np
 from janusgraph_tpu.olap.programs.shortest_path import INF
 
 
-def _tier(need: int, lo: int, hi: int) -> int:
-    """Smallest power-of-4 multiple of `lo`, >= need, clamped to hi (callers
-    guarantee hi >= need)."""
+def _tier(need: int, lo: int, hi: int, growth: int = 4) -> int:
+    """Smallest `growth`-power multiple of `lo`, >= need, clamped to hi
+    (callers guarantee hi >= need). Growth trades executable count for
+    capacity fit (computer.frontier-tier-growth)."""
+    if growth < 2:
+        raise ValueError(
+            f"frontier tier growth must be >= 2 (got {growth})"
+        )
     c = lo
     while c < need:
-        c *= 4
+        c *= growth
     return min(c, hi)
 
 
@@ -92,6 +97,7 @@ class FrontierEngine:
 
     F_MIN = 1 << 10
     E_MIN = 1 << 13
+    GROWTH = 4
     #: int32 telescoping headroom (see module docstring)
     MAX_EDGES = 1 << 30
 
@@ -104,6 +110,8 @@ class FrontierEngine:
             self.F_MIN = executor._frontier_f_min
         if getattr(executor, "_frontier_e_min", None):
             self.E_MIN = executor._frontier_e_min
+        if getattr(executor, "_frontier_tier_growth", None):
+            self.GROWTH = executor._frontier_tier_growth
         csr = executor.csr
         jnp = self.jnp
         self.n = csr.num_vertices
@@ -257,8 +265,10 @@ class FrontierEngine:
             )
             if count == 0:
                 break
-            f_cap = _tier(count, self.F_MIN, self.n)
-            e_cap = _tier(max(tot_out, tot_in, 1), self.E_MIN, self.m)
+            f_cap = _tier(count, self.F_MIN, self.n, self.GROWTH)
+            e_cap = _tier(
+                max(tot_out, tot_in, 1), self.E_MIN, self.m, self.GROWTH
+            )
             trace.append(
                 {"hop": t, "frontier": count,
                  "edges": max(tot_out, tot_in), "F_cap": f_cap,

@@ -196,6 +196,7 @@ class TPUExecutor:
         frontier_cc_min_edges: int = None,
         frontier_f_min: int = None,
         frontier_e_min: int = None,
+        frontier_tier_growth: int = None,
     ):
         import jax
         import jax.numpy as jnp
@@ -228,6 +229,8 @@ class TPUExecutor:
             self.FRONTIER_CC_MIN_EDGES = frontier_cc_min_edges
         self._frontier_f_min = frontier_f_min
         self._frontier_e_min = frontier_e_min
+        # computer.frontier-tier-growth — tier ladder growth factor
+        self._frontier_tier_growth = frontier_tier_growth
         # "auto" resolves lazily per edge view: an undirected program packs
         # in+out edges (~2x footprint), so the budget check must see the
         # view it will actually ship

@@ -506,6 +506,7 @@ class ShardedExecutor:
         axis: str = "p",
         exchange: str = "a2a",
         agg: str = "ell",
+        frontier_tier_growth: int = None,
     ):
         import jax
         from jax.sharding import Mesh
@@ -540,6 +541,8 @@ class ShardedExecutor:
         # shard body is traced (see TPUExecutor._metric_ops)
         self._metric_ops: Dict[Tuple, Dict[str, str]] = {}
         self._frontier_engine = None
+        # computer.frontier-tier-growth (ShardedFrontierEngine override)
+        self._frontier_tier_growth = frontier_tier_growth
         #: observability for the most recent run (path + frontier tiers)
         self.last_run_info: Dict[str, object] = {}
 

@@ -48,11 +48,14 @@ class ShardedFrontierEngine:
 
     F_MIN = 1 << 10
     E_MIN = 1 << 13
+    GROWTH = 4
     #: int32 telescoping-cumsum headroom (see olap/frontier.py)
     MAX_EDGES = 1 << 30
 
     def __init__(self, executor):
         self.ex = executor
+        if getattr(executor, "_frontier_tier_growth", None):
+            self.GROWTH = executor._frontier_tier_growth
         self.jax = executor.jax
         self.axis = executor.axis
         self.mesh = executor.mesh
@@ -223,8 +226,8 @@ class ShardedFrontierEngine:
             )
             if csum == 0:
                 break
-            f_cap = _tier(max(cmax, 1), self.F_MIN, T)
-            e_cap = _tier(max(emax, 1), self.E_MIN, Em)
+            f_cap = _tier(max(cmax, 1), self.F_MIN, T, self.GROWTH)
+            e_cap = _tier(max(emax, 1), self.E_MIN, Em, self.GROWTH)
             trace.append({
                 "hop": t, "frontier": csum, "edges": esum,
                 "shard_max_frontier": cmax, "shard_max_edges": emax,

@@ -32,8 +32,7 @@ from janusgraph_tpu.storage.kcvs import (
     SliceQuery,
 )
 
-_SLICE_MS = 100  # row time-granularity
-_SLICE_NS = _SLICE_MS * 1_000_000
+_SLICE_MS = 100  # default row time-granularity (log.slice-granularity-ms)
 
 
 @dataclass(frozen=True)
@@ -78,9 +77,13 @@ class KCVSLog:
         timestamps=None,
         read_lag_ms: float = -1.0,
         read_only: bool = False,
+        slice_granularity_ms: int = _SLICE_MS,
     ):
         from janusgraph_tpu.util.timestamps import TimestampProviders
 
+        #: log.slice-granularity-ms — row time window (FIXED: row keys
+        #: derive from it, so all writers/readers of a log must agree)
+        self._slice_ns = slice_granularity_ms * 1_000_000
         self.name = name
         self.store = store
         self._tx_factory = tx_factory
@@ -119,7 +122,7 @@ class KCVSLog:
 
     # ------------------------------------------------------------------ write
     def _row_key(self, bucket: int, ts_ns: int) -> bytes:
-        return bytes([bucket]) + (ts_ns // _SLICE_NS).to_bytes(8, "big")
+        return bytes([bucket]) + (ts_ns // self._slice_ns).to_bytes(8, "big")
 
     def add(self, content: bytes, bucket: Optional[int] = None) -> None:
         """Append a message (batched; the send thread flushes). A partition
@@ -218,8 +221,12 @@ class KCVSLog:
     def _bucket_rows(self, bucket: int, start_ns: int, end_ns: int, stx):
         """Ordered scan of one bucket's rows in [start_ns, end_ns] — a key
         RANGE scan, so sparse logs cost only their actual rows."""
-        start_key = bytes([bucket]) + (start_ns // _SLICE_NS).to_bytes(8, "big")
-        end_key = bytes([bucket]) + (end_ns // _SLICE_NS + 1).to_bytes(8, "big")
+        start_key = bytes([bucket]) + (
+            start_ns // self._slice_ns
+        ).to_bytes(8, "big")
+        end_key = bytes([bucket]) + (
+            end_ns // self._slice_ns + 1
+        ).to_bytes(8, "big")
         return self.store.get_keys(
             KeyRangeQuery(start_key, end_key, SliceQuery()), stx
         )
@@ -245,13 +252,13 @@ class KCVSLog:
         self, bucket: int, start_ns: int, reader, poll_ms: float
     ) -> None:
         # strictly-increasing (row-slice, column) cursor per bucket
-        cursor = ((start_ns // _SLICE_NS).to_bytes(8, "big"), b"")
+        cursor = ((start_ns // self._slice_ns).to_bytes(8, "big"), b"")
         while not self._closed.is_set():
             try:
                 stx = self._tx_factory()
                 # resume the ranged scan at the cursor's row; stop read-lag
                 # behind now so same-tick stragglers still get consumed
-                resume_ns = int.from_bytes(cursor[0], "big") * _SLICE_NS
+                resume_ns = int.from_bytes(cursor[0], "big") * self._slice_ns
                 end_ns = time.time_ns() - self._read_lag_ns
                 for row, entries in self._bucket_rows(
                     bucket, resume_ns, end_ns, stx
@@ -302,7 +309,9 @@ class LogManager:
         timestamps=None,
         read_lag_ms: float = -1.0,
         read_only: bool = False,
+        slice_granularity_ms: int = _SLICE_MS,
     ):
+        self.slice_granularity_ms = slice_granularity_ms
         self.manager = store_manager
         self.sender = sender
         self.timestamps = timestamps
@@ -339,6 +348,7 @@ class LogManager:
                     timestamps=self.timestamps,
                     read_lag_ms=self.read_lag_ms,
                     read_only=self.read_only,
+                    slice_granularity_ms=self.slice_granularity_ms,
                 )
                 self._logs[name] = log
             return log

@@ -384,7 +384,8 @@ class RemoteKCVStore(KeyColumnValueStore):
         # parallel-backend-ops): split the key set across the connection
         # pool so independent sockets serve chunks concurrently
         nconn = len(mgr._pool)
-        if mgr.parallel_ops and nconn > 1 and len(keys) > 2 * nconn:
+        if (mgr.parallel_ops and nconn > 1
+                and len(keys) > mgr.parallel_slice_factor * nconn):
             chunk = -(-len(keys) // nconn)
             parts = [keys[i:i + chunk] for i in range(0, len(keys), chunk)]
             merged = {}
@@ -480,13 +481,17 @@ class RemoteStoreManager(KeyColumnValueStoreManager):
                  backoff_base_s: float = None, backoff_max_s: float = None,
                  parallel_ops: bool = True,
                  connect_timeout_s: float = 30.0,
-                 max_attempts: int = 0):
+                 max_attempts: int = 0,
+                 parallel_slice_factor: int = 2):
         self.host, self.port = host, port
         self.retry_time_s = retry_time_s
         self.connect_timeout_s = connect_timeout_s
         self.max_attempts = max_attempts
         #: storage.parallel-backend-ops — client-side multi-slice fan-out
         self.parallel_ops = parallel_ops
+        #: storage.remote.parallel-slice-factor — fan-out fires past
+        #: factor x pool connections (below that, chunking overhead wins)
+        self.parallel_slice_factor = parallel_slice_factor
         self._pool_executor = None
         self._executor_lock = threading.Lock()
         # per-CLIENT retry backoff (storage.backoff-base-ms/-max-ms):

@@ -401,3 +401,78 @@ def test_add_e_and_property_handle_liveness(g):
         .as_("e").property("qq", 8).select("e").to_list()
     )
     assert all(e.value("qq") == 8 for e in sel)
+
+
+# ------------------------------------------------- chained repeat modulators
+def test_repeat_chained_modulators(g):
+    """The REAL Gremlin loop spellings: repeat(...).times(n) /
+    .until(...) / .emit() as POST-modulators (TinkerPop RepeatStep
+    modulation), equivalent to the kwarg forms."""
+    t = g.traversal()
+    chained = t.V().has("name", "saturn").repeat(
+        __.in_("father")
+    ).times(2).values("name").to_list()
+    kwarg = t.V().has("name", "saturn").repeat(
+        __.in_("father"), times=2
+    ).values("name").to_list()
+    assert chained == kwarg == ["hercules"]
+
+    got = t.V().has("name", "hercules").repeat(__.out("father")).until(
+        __.has("name", "saturn")
+    ).values("name").to_list()
+    assert got == ["saturn"]
+
+    emitted = t.V().has("name", "saturn").repeat(
+        __.in_("father")
+    ).emit().values("name").to_list()
+    assert set(emitted) == {"jupiter", "hercules"}
+
+    # until + emit combined, chained in either order
+    both = t.V().has("name", "hercules").repeat(__.out("father")).emit(
+    ).until(__.has("name", "saturn")).values("name").to_list()
+    assert set(both) == {"jupiter", "saturn"}
+
+
+def test_repeat_modulator_window_rules(g):
+    from janusgraph_tpu.core.traversal import QueryError
+
+    t = g.traversal()
+    # bare repeat with no control raises at EXECUTION
+    with pytest.raises(QueryError, match="times\\(\\)/until\\(\\)/emit"):
+        t.V().repeat(__.out("father")).to_list()
+    # modulators without a preceding repeat raise at build
+    with pytest.raises(QueryError, match="must follow repeat"):
+        t.V().times(2)
+    with pytest.raises(QueryError, match="must follow repeat"):
+        t.V().until(__.has("name", "x"))
+    # a step between repeat and the modulator closes the window
+    with pytest.raises(QueryError, match="must follow repeat"):
+        t.V().repeat(__.out("father")).count_().times(2)
+
+
+def test_emit_predicate_filter(g):
+    """emit(predicate): the Gremlin emit(has(...)) filter form."""
+    t = g.traversal()
+    only = t.V().has("name", "saturn").repeat(__.in_("father")).emit(
+        __.has("name", "hercules")
+    ).values("name").to_list()
+    assert only == ["hercules"]
+
+
+def test_has_on_label_name_is_unknown_key(g):
+    """A has() key colliding with a vertex/edge LABEL name is still an
+    unknown PROPERTY key (the check is PropertyKey-specific)."""
+    from janusgraph_tpu.core.traversal import QueryError
+
+    t = g.traversal()
+    with pytest.raises(QueryError, match="unknown property key"):
+        t.V().has("god", 1).to_list()  # 'god' is a vertex label
+    with pytest.raises(QueryError, match="unknown property key"):
+        t.V().has("father", 1).to_list()  # 'father' is an edge label
+
+
+def test_frontier_tier_growth_guard():
+    from janusgraph_tpu.olap.frontier import _tier
+
+    with pytest.raises(ValueError, match="growth"):
+        _tier(5000, 1 << 10, 1 << 20, 1)
