@@ -1964,6 +1964,139 @@ class GraphTraversal:
         self._add(lambda ts: [t for t in ts if p.test(t.obj)], name=f"is({p.label})")
         return self
 
+    def math(self, expression: str) -> "GraphTraversal":
+        """TinkerPop MathStep: evaluate an arithmetic expression per
+        traverser — ``math('_ + 100')`` (``_`` = incoming value),
+        ``math('a / b')`` over as_() tag bindings, with by() extracting a
+        number from element-valued variables (``math('_ * 2').by('age')``).
+        Functions: abs ceil floor sqrt exp log log10 sin cos tan signum.
+        The expression is AST-validated (numbers, variables, arithmetic
+        operators, whitelisted calls only) — same sandboxing stance as the
+        server's eval path."""
+        import ast
+        import math as _pymath
+
+        funcs = {
+            "abs": abs, "ceil": _pymath.ceil, "floor": _pymath.floor,
+            "sqrt": _pymath.sqrt, "exp": _pymath.exp, "log": _pymath.log,
+            "log10": _pymath.log10, "sin": _pymath.sin, "cos": _pymath.cos,
+            "tan": _pymath.tan,
+            "signum": lambda x: (x > 0) - (x < 0),
+        }
+        try:
+            tree = ast.parse(expression, mode="eval")
+        except SyntaxError as e:
+            raise QueryError(f"math(): bad expression {expression!r}: {e}")
+        _ALLOWED_OPS = (
+            ast.Add, ast.Sub, ast.Mult, ast.Div, ast.Mod, ast.Pow,
+            ast.USub, ast.UAdd,
+        )
+        call_positions = set()  # Name nodes that ARE a call's function
+        name_nodes: List[ast.Name] = []
+        for node in ast.walk(tree):
+            if isinstance(node, (ast.Expression, ast.Load)):
+                continue
+            if isinstance(node, (ast.BinOp, ast.UnaryOp)):
+                continue
+            if isinstance(node, _ALLOWED_OPS):
+                continue
+            if isinstance(node, ast.Constant):
+                if isinstance(node.value, bool) or not isinstance(
+                    node.value, (int, float)
+                ):
+                    raise QueryError(
+                        f"math(): non-numeric constant {node.value!r}"
+                    )
+                continue
+            if isinstance(node, ast.Call):
+                if (
+                    not isinstance(node.func, ast.Name)
+                    or node.func.id not in funcs
+                    or node.keywords
+                ):
+                    raise QueryError(
+                        "math(): only the built-in functions "
+                        f"{sorted(funcs)} may be called"
+                    )
+                call_positions.add(id(node.func))
+                continue
+            if isinstance(node, ast.Name):
+                name_nodes.append(node)
+                continue
+            raise QueryError(
+                f"math(): {type(node).__name__} is not allowed in "
+                f"{expression!r}"
+            )
+        # variables in SOURCE left-to-right order — by() modulators bind
+        # round-robin in the order variables appear in the expression, and
+        # ast.walk is breadth-first, which reorders nested operands
+        variables: List[str] = []
+        for node in sorted(
+            name_nodes, key=lambda n: (n.lineno, n.col_offset)
+        ):
+            if id(node) in call_positions:
+                continue
+            if node.id in funcs:
+                raise QueryError(
+                    f"math(): {node.id!r} is a function — call it, "
+                    "don't use it as a value"
+                )
+            if node.id not in variables:
+                variables.append(node.id)
+        code = compile(tree, "<math>", "eval")
+        # funcs ride the (immutable) globals, built once; per-traverser
+        # locals carry only the variable bindings
+        gbl = {"__builtins__": {}, **funcs}
+        by_list: List[Tuple] = []
+
+        def step(ts):
+            out = []
+            for t in ts:
+                env = {}
+                for i, nm in enumerate(variables):
+                    if nm == "_":
+                        val = t.obj
+                    else:
+                        tags = t.tags or {}
+                        if nm not in tags:
+                            raise QueryError(
+                                f"math(): variable {nm!r} is not a bound "
+                                "as_() tag"
+                            )
+                        val = tags[nm]
+                    if isinstance(val, (Vertex, Edge)) or by_list:
+                        spec = (
+                            by_list[i % len(by_list)]
+                            if by_list else ("id", None, False)
+                        )
+                        val = self._by_value(spec, val)
+                    if not isinstance(val, (int, float)) or isinstance(
+                        val, bool
+                    ):
+                        raise QueryError(
+                            f"math(): variable {nm!r} is "
+                            f"{type(val).__name__}, not a number "
+                            "(use by('key') to extract one)"
+                        )
+                    env[nm] = val
+                try:
+                    res = eval(code, gbl, env)
+                except QueryError:
+                    raise
+                except Exception as e:
+                    # divergence note: Java doubles yield Infinity/NaN on
+                    # division by zero; here every evaluation error is a
+                    # uniform QueryError (the step's whole contract)
+                    raise QueryError(
+                        f"math({expression!r}): {type(e).__name__}: {e}"
+                    )
+                out.append(t.child(res))
+            return out
+
+        self._add(step, name=f"math({expression})")
+        self._last_by = by_list
+        return self
+
     # -- projections over sub-traversals --------------------------------------
     def project(self, *names: str) -> "GraphTraversal":
         """project('a','b').by(...).by(...) — one dict per traverser."""

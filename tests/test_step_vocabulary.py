@@ -476,3 +476,59 @@ def test_frontier_tier_growth_guard():
 
     with pytest.raises(ValueError, match="growth"):
         _tier(5000, 1 << 10, 1 << 20, 1)
+
+
+# ------------------------------------------------------------------- math()
+def test_math_step(g):
+    """TinkerPop MathStep: '_' = incoming value, tag variables, by()
+    extraction, whitelisted functions, sandboxed expressions."""
+    t = g.traversal()
+    vals = t.V().has("name", "jupiter").values("age").math("_ / 1000").to_list()
+    assert vals == [5.0]
+    # tag variables with by() extraction
+    got = (
+        t.V().has("name", "jupiter").as_("a")
+        .out("brother").as_("b")
+        .math("a - b").by("age")
+        .to_list()
+    )
+    assert set(got) == {500, 1000}  # 5000 - 4500, 5000 - 4000
+    # functions
+    assert t.V().has("name", "jupiter").values("age").math(
+        "sqrt(_) + abs(-1)"
+    ).to_list() == [5000 ** 0.5 + 1]
+    # by() binds in SOURCE left-to-right order even under nesting
+    # (ast.walk is BFS and would yield c before a/b, swapping specs):
+    # a -> by('age'), b -> by('age'), c (a numeric tag) -> identity by()
+    got = (
+        t.V().has("name", "jupiter").as_("a")
+        .out("brother").has("name", "neptune").as_("b")
+        .values("age").as_("c")
+        .math("(a + b) * c").by("age").by("age").by()
+        .to_list()
+    )
+    assert got == [(5000 + 4500) * 4500]
+
+
+def test_math_step_sandbox(g):
+    from janusgraph_tpu.core.traversal import QueryError
+
+    t = g.traversal()
+    for bad in ("__import__('os')", "_.denominator", "'x' + 'y'",
+                "a if a else 0", "[1,2][0]", "lambda: 1",
+                "sqrt", "sqrt + 1", "_ + True"):
+        with pytest.raises(QueryError):
+            t.V().values("age").math(bad)
+    # runtime evaluation errors surface as QueryError uniformly
+    with pytest.raises(QueryError, match="ZeroDivision"):
+        t.V().has("name", "jupiter").values("age").math("_ / 0").to_list()
+    with pytest.raises(QueryError, match="math"):
+        t.V().has("name", "jupiter").values("age").math(
+            "sqrt(0 - _)"
+        ).to_list()
+    # unbound tag at execution
+    with pytest.raises(QueryError, match="not a bound"):
+        t.V().has("name", "jupiter").math("zz + 1").to_list()
+    # non-numeric value at execution
+    with pytest.raises(QueryError, match="not a number"):
+        t.V().has("name", "jupiter").values("name").math("_ + 1").to_list()
