@@ -292,8 +292,8 @@ class GraphTraversalSource:
     def V(self, *ids) -> "GraphTraversal":
         return GraphTraversal(self, _start_vertices(self, ids))
 
-    def E(self) -> "GraphTraversal":
-        return GraphTraversal(self, _start_edges(self))
+    def E(self, *ids) -> "GraphTraversal":
+        return GraphTraversal(self, _start_edges(self, ids))
 
     def add_v(self, label: Optional[str] = None, **props) -> Vertex:
         return self.tx.add_vertex(label, **props)
@@ -447,14 +447,8 @@ def _merge_edge(source, match, spec, default_v: Optional[Vertex] = None):
     # on_create fills in whatever the match map lacks (endpoints, label);
     # a CONFLICTING on_create label is an error, not a silent override
     eid, label, props = _split_merge_map(match)
-    if eid is not None:
-        # no edge-by-id access path exists (edges are addressed through
-        # their incident vertices here, like the reference's relation
-        # ids) — refuse loudly rather than match the wrong edge
-        raise QueryError(
-            "merge_e does not support T.id matching; address the edge "
-            "via Direction.OUT/Direction.IN + T.label"
-        )
+    # on_create validation runs BEFORE any lookup so a bad query fails
+    # the same way regardless of data state
     cid, clabel, cprops = _split_merge_map(spec["on_create"])
     if cid is not None:
         raise QueryError("on_create() cannot set T.id")
@@ -465,6 +459,38 @@ def _merge_edge(source, match, spec, default_v: Optional[Vertex] = None):
         raise QueryError(
             f"on_create() cannot override merge-map keys {sorted(overlap)}"
         )
+    if eid is not None:
+        # T.id-keyed edge merge: RelationIdentifier point lookup; a miss
+        # cannot create (edge ids are not user-assignable), so it is an
+        # error rather than a silent duplicate
+        try:
+            e = tx.get_edge(eid)
+        except Exception:
+            raise QueryError(
+                f"merge_e: T.id must be a RelationIdentifier or its "
+                f"string form (got {eid!r})"
+            )
+        if e is None:
+            raise QueryError(
+                f"merge_e: no edge with id {eid!r}, and edge ids cannot "
+                "be chosen at creation"
+            )
+        if label is not None and e.label != label:
+            return []
+        # endpoint constraints in the map must agree with the edge
+        for dkey, attr in ((Direction.OUT, "out_vertex"),
+                           (Direction.IN, "in_vertex")):
+            want = match.get(dkey)
+            if want is not None:
+                wid = want.id if isinstance(want, Vertex) else want
+                if getattr(e, attr).id != wid:
+                    return []
+        vals = e.property_values()
+        if not all(vals.get(k) == want for k, want in props.items()):
+            return []
+        for k, val in spec["on_match"].items():
+            e = e.set_property(k, val)
+        return [e]
     merged = {**spec["on_create"], **match}
     out_t = merged.get(Direction.OUT, default_v)
     in_t = merged.get(Direction.IN, default_v)
@@ -633,11 +659,30 @@ class _start_vertices:
 
 
 class _start_edges:
-    def __init__(self, source: GraphTraversalSource):
+    def __init__(self, source: GraphTraversalSource, ids=()):
         self.source = source
+        self.ids = ids
 
     def run(self, has_conditions) -> List[Traverser]:
         tx = self.source.tx
+        if self.ids:
+            # E(rid, ...) point lookups by RelationIdentifier / its
+            # string form / an Edge (reference: graph.edges(ids) ->
+            # StandardJanusGraphTx.getEdge per id)
+            out = []
+            for i in self.ids:
+                try:
+                    e = tx.get_edge(
+                        i.identifier if isinstance(i, Edge) else i
+                    )
+                except Exception:
+                    raise QueryError(
+                        f"E(): not an edge id (RelationIdentifier or its "
+                        f"string form): {i!r}"
+                    )
+                if e is not None:
+                    out.append(Traverser(e))
+            return _apply_has(out, has_conditions, tx)
         out, seen = [], set()
         for v in tx.vertices():
             for e in tx.get_edges(v, Direction.OUT, ()):
@@ -812,7 +857,19 @@ class GraphTraversal:
         return self
 
     def has_id(self, *ids) -> "GraphTraversal":
-        idset = {i.id if isinstance(i, Vertex) else i for i in ids}
+        from janusgraph_tpu.core.codecs import RelationIdentifier
+
+        idset = set()
+        rid_set = set()  # edge ids are RelationIdentifiers (see id_())
+        for i in ids:
+            if isinstance(i, Vertex):
+                idset.add(i.id)
+            elif isinstance(i, Edge):
+                rid_set.add(i.identifier)
+            elif isinstance(i, RelationIdentifier):
+                rid_set.add(i)
+            else:
+                idset.add(i)
         # AdjacentVertex rewrite (reference: optimize/strategy/
         # AdjacentVertexHasIdOptimizerStrategy): `.out(lbl).has_id(v)`
         # collapses the expansion + filter into per-traverser adjacency
@@ -837,7 +894,12 @@ class GraphTraversal:
             adjacency._label = f"adjacentVertexHasId{tuple(sorted(idset))!r}"
             self._steps[-1] = adjacency
             return self
-        self._add(lambda ts: [t for t in ts if getattr(t.obj, "id", None) in idset])
+        def _id_hit(obj):
+            if isinstance(obj, Edge) and obj.identifier in rid_set:
+                return True
+            return getattr(obj, "id", None) in idset
+
+        self._add(lambda ts: [t for t in ts if _id_hit(t.obj)])
         return self
 
     def filter_(self, fn: Callable[[object], bool]) -> "GraphTraversal":
@@ -1330,7 +1392,16 @@ class GraphTraversal:
         return self
 
     def id_(self) -> "GraphTraversal":
-        self._add(lambda ts: [t.child(t.obj.id, prev=t.prev) for t in ts])
+        """Element id step (TinkerPop id()): vertex ids are longs; an
+        EDGE's id is its RelationIdentifier (the reference's edge-id
+        contract — round-trips through E(id)/mergeE({T.id: ...}))."""
+        self._add(lambda ts: [
+            t.child(
+                t.obj.identifier if isinstance(t.obj, Edge) else t.obj.id,
+                prev=t.prev,
+            )
+            for t in ts
+        ])
         return self
 
 

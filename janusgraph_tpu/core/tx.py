@@ -682,6 +682,33 @@ class Transaction:
                     results.append(rel)
         return results
 
+    def get_edge(self, rid) -> Optional[Edge]:
+        """Point lookup by RelationIdentifier or its string form
+        (reference: StandardJanusGraphTx.getEdge(RelationIdentifier) —
+        the identifier carries the OUT vertex and type, so the read is
+        one label-restricted slice of one row, not a scan)."""
+        from janusgraph_tpu.core.codecs import RelationIdentifier
+
+        if isinstance(rid, str):
+            rid = RelationIdentifier.parse(rid)
+        if not isinstance(rid, RelationIdentifier):
+            raise InvalidElementError(
+                f"not a relation identifier: {rid!r}", rid
+            )
+        v = self.get_vertex(rid.out_vertex_id)
+        if v is None:
+            return None
+        el = self.graph.schema_cache.get_by_id(rid.type_id)
+        if el is None:
+            return None
+        for e in self.get_edges(v, Direction.OUT, (el.name,)):
+            if (
+                e.id == rid.relation_id
+                and e.in_vertex.id == rid.in_vertex_id
+            ):
+                return e
+        return None
+
     def adjacency_edges(
         self,
         v: Vertex,

@@ -58,6 +58,10 @@ STEP_MAP = {
     "onMatch": "on_match",
 }
 
+#: step names that collide with structure-token attributes (T.id): only
+#: rewritten in CALL position — `.id()` is the step, `T.id` is the token
+CALL_ONLY_STEP_MAP = {"id": "id_"}
+
 #: bare Gremlin predicates -> P methods (Gremlin exposes them unqualified)
 PREDICATE_MAP = {
     "eq": "eq", "neq": "neq", "gt": "gt", "gte": "gte", "lt": "lt",
@@ -86,7 +90,7 @@ def translate(text: str) -> str:
         )
     except (tokenize.TokenError, IndentationError):
         return text  # let the AST sandbox produce the real error
-    for tok in tokens:
+    for i, tok in enumerate(tokens):
         ttype, string, start, end, line = tok
         if ttype == token_mod.NAME and string in STEP_MAP:
             # dotted steps AND bare anonymous steps (Gremlin-Groovy's
@@ -95,6 +99,17 @@ def translate(text: str) -> str:
             # BoolOp nodes aren't whitelisted), so the rewrite is safe
             # everywhere; bare predicates resolve via compat_namespace
             string = STEP_MAP[string]
+        elif ttype == token_mod.NAME and string in CALL_ONLY_STEP_MAP:
+            # names that are ALSO structure-token attributes (T.id): only
+            # the call position `.id()` is the step — `T.id` stays intact
+            nxt = next(
+                (t for t in tokens[i + 1:]
+                 if t[0] not in (token_mod.NL, token_mod.NEWLINE,
+                                 tokenize.COMMENT)),
+                None,
+            )
+            if nxt is not None and nxt[1] == "(":
+                string = CALL_ONLY_STEP_MAP[string]
         if ttype not in (
             token_mod.NL, token_mod.NEWLINE, token_mod.INDENT,
             token_mod.DEDENT, tokenize.COMMENT,

@@ -213,11 +213,40 @@ def test_merge_on_create_cannot_override_match_keys(g):
                    T.label: "brother", "w": 1}).on_create({"w": 2}).next()
 
 
-def test_merge_e_tid_refused(g):
+def test_merge_e_by_tid(g):
+    """merge_e T.id: RelationIdentifier point lookup; misses cannot
+    create (edge ids are not user-assignable)."""
     t = g.traversal()
     e = t.V().has("name", "jupiter").out_e("brother").next()
-    with pytest.raises(QueryError, match="T.id"):
-        t.merge_e({T.id: e.id}).next()
+    hit = t.merge_e({T.id: e.identifier}).on_match({"w": 9}).next()
+    assert hit.id == e.id and hit.property_values().get("w") == 9
+    # string form of the identifier works too
+    hit2 = t.merge_e({T.id: str(e.identifier)}).next()
+    assert hit2.id == e.id
+    # conflicting label in the match map = no match -> empty, not create
+    assert t.merge_e(
+        {T.id: e.identifier, T.label: "other"}
+    ).to_list() == []
+    # a missing id is an error (cannot create with a chosen edge id)
+    from janusgraph_tpu.core.codecs import RelationIdentifier
+
+    missing = RelationIdentifier(999999, e.out_vertex.id, e.type_id,
+                                 e.in_vertex.id)
+    with pytest.raises(QueryError, match="cannot"):
+        t.merge_e({T.id: missing}).next()
+
+
+def test_e_start_by_id(g):
+    """E(rid) point lookup (graph.edges(ids) parity)."""
+    t = g.traversal()
+    e = t.V().has("name", "jupiter").out_e("brother").next()
+    assert t.E(e.identifier).next().id == e.id
+    assert t.E(str(e.identifier)).next().id == e.id
+    assert t.E(e).next().id == e.id
+    # two id args -> two traversers (both resolve to the same edge)
+    got = t.E(e.identifier, str(e.identifier)).to_list()
+    assert len(got) == 2 and {x.id for x in got} == {e.id}
+
 
 
 # ------------------------------------------------------------- inject/const
@@ -242,3 +271,34 @@ def test_gremlin_text_merge_spelling():
     out = translate(q)
     assert "merge_v" in out and "on_create" in out
     assert "'god'" in out  # string literals untouched
+
+
+def test_merge_e_tid_respects_endpoints_and_eager_validation(g):
+    """T.id merge still honors endpoint constraints in the map, and
+    on_create validation fires before the lookup (data-state-independent
+    errors)."""
+    t = g.traversal()
+    e = t.V().has("name", "jupiter").out_e("brother").next()
+    wrong = t.V().has("name", "hercules").next()
+    assert t.merge_e(
+        {T.id: e.identifier, Direction.OUT: wrong}
+    ).to_list() == []
+    assert t.merge_e(
+        {T.id: e.identifier, Direction.IN: e.in_vertex}
+    ).next().id == e.id
+    with pytest.raises(QueryError, match="cannot set T.id"):
+        t.merge_e({T.id: e.identifier}).on_create({T.id: 1}).next()
+    # non-rid T.id values get a clean QueryError, not internal errors
+    with pytest.raises(QueryError, match="RelationIdentifier"):
+        t.merge_e({T.id: e.id}).next()
+    with pytest.raises(QueryError, match="edge id"):
+        t.E("garbage").to_list()
+
+
+def test_has_id_accepts_relation_identifier(g):
+    """E().has_id(rid) round-trips the id_() contract."""
+    t = g.traversal()
+    e = t.V().has("name", "jupiter").out_e("brother").next()
+    rid = t.E(e.identifier).id_().next()
+    assert t.E().has_id(rid).next().id == e.id
+    assert t.E().has_id(e).next().id == e.id
