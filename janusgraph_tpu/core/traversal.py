@@ -825,6 +825,10 @@ class GraphTraversal:
         self._folding = True  # still collecting leading has() steps
         self._last_by: Optional[List] = None  # open by() modulator window
         self._side_effects: Dict[str, List] = {}  # aggregate()/cap() buckets
+        #: transient OLAP-bridge results {(vid, key): value} — per
+        #: TRAVERSAL (sub-traversal bodies share the root's dict via
+        #: _sub_steps); never written to the tx, schema, or source
+        self._olap_overlay: Dict = {}
 
     # -- filters ------------------------------------------------------------
     def has(self, key: str, value=None) -> "GraphTraversal":
@@ -837,9 +841,8 @@ class GraphTraversal:
         if self._folding:
             self._pre_has.append((key, p))
         else:
-            tx = self.tx
             self._add(
-                lambda ts: [t for t in ts if p.test(_element_value(t, key, tx))],
+                lambda ts: [t for t in ts if p.test(self._elem_val(t, key))],
                 name=f"has({key})",
             )
         return self
@@ -1024,8 +1027,15 @@ class GraphTraversal:
             out = []
             for t in ts:
                 if isinstance(t.obj, Vertex):
+                    shadowed = set()
+                    for k, val in self._overlay_items(t.obj, keys):
+                        out.append(t.child(val, prev=t.prev))
+                        shadowed.add(k)
                     props = tx.get_properties(t.obj, *keys)
-                    out.extend(t.child(p.value, prev=t.prev) for p in props)
+                    out.extend(
+                        t.child(p.value, prev=t.prev)
+                        for p in props if p.key not in shadowed
+                    )
                 elif isinstance(t.obj, Edge):
                     pv = t.obj.property_values()
                     for k, v in pv.items():
@@ -1381,8 +1391,13 @@ class GraphTraversal:
             for t in ts:
                 if isinstance(t.obj, Vertex):
                     m = {}
+                    shadowed = set()
+                    for k, val in self._overlay_items(t.obj, keys):
+                        m[k] = [val]  # shadows the stored property
+                        shadowed.add(k)
                     for p in tx.get_properties(t.obj, *keys):
-                        m.setdefault(p.key, []).append(p.value)
+                        if p.key not in shadowed:
+                            m.setdefault(p.key, []).append(p.value)
                     out.append(t.child(m, prev=t.prev))
                 elif isinstance(t.obj, Edge):
                     out.append(t.child(t.obj.property_values(), prev=t.prev))
@@ -1487,7 +1502,6 @@ class GraphTraversal:
         return self
 
     def order(self, key: Optional[str] = None, reverse: bool = False) -> "GraphTraversal":
-        tx = self.tx
         by_list: List[Tuple] = []
 
         def step(ts):
@@ -1504,8 +1518,8 @@ class GraphTraversal:
                 return sorted(ts, key=lambda t: t.obj, reverse=reverse)
             return sorted(
                 ts,
-                key=lambda t: (_element_value(t, key, tx) is None,
-                               _element_value(t, key, tx)),
+                key=lambda t: (self._elem_val(t, key) is None,
+                               self._elem_val(t, key)),
                 reverse=reverse,
             )
 
@@ -1519,6 +1533,7 @@ class GraphTraversal:
     def _sub_steps(self, body) -> List[Callable]:
         sub = GraphTraversal(self.source, None)
         sub._folding = False  # has() inside a body is a plain filter
+        sub._olap_overlay = self._olap_overlay  # share the ROOT's overlay
         r = body(sub)
         return (r if isinstance(r, GraphTraversal) else sub)._steps
 
@@ -1539,12 +1554,50 @@ class GraphTraversal:
             return ("sub", self._sub_steps(spec))
         raise QueryError(f"unsupported by() modulator: {spec!r}")
 
+    def _overlay_get(self, obj, key):
+        """(hit, value) from the OLAP overlay (see _olap_annotate):
+        transient computer results consulted before (and SHADOWING) real
+        properties. Scoped to THIS traversal — sub-traversal bodies
+        (by(traversal)/where(traversal)) share the root's dict through
+        _sub_steps; other traversals, even from the same source, never
+        see it."""
+        ov = self._olap_overlay
+        if ov and isinstance(obj, Vertex):
+            k = (obj.id, key)
+            if k in ov:
+                return True, ov[k]
+        return False, None
+
+    def _overlay_items(self, obj, keys=()):
+        """[(key, value)] overlay entries for this vertex — restricted to
+        `keys` when given, ALL annotated keys otherwise (so no-arg
+        values()/value_map() surface them too)."""
+        ov = self._olap_overlay
+        if not ov or not isinstance(obj, Vertex):
+            return []
+        if keys:
+            out = []
+            for k in keys:
+                hit, val = self._overlay_get(obj, k)
+                if hit:
+                    out.append((k, val))
+            return out
+        return [
+            (k, val) for (vid, k), val in ov.items() if vid == obj.id
+        ]
+
+    def _elem_val(self, t, key):
+        hit, val = self._overlay_get(t.obj, key)
+        if hit:
+            return val
+        return _element_value(t, key, self.tx)
+
     def _by_value(self, resolved, obj):
         kind, arg = resolved[0], resolved[1]
         if kind == "id":
             return obj
         if kind == "key":
-            return _element_value(Traverser(obj), arg, self.tx)
+            return self._elem_val(Traverser(obj), arg)
         hits = self._apply_steps(arg, [Traverser(obj)])
         return hits[0].obj if hits else None
 
@@ -1664,10 +1717,9 @@ class GraphTraversal:
 
     def has_not(self, key: str) -> "GraphTraversal":
         """Keep elements WITHOUT the property (TinkerPop hasNot())."""
-        tx = self.tx
         self._add(
             lambda ts: [
-                t for t in ts if _element_value(t, key, tx) is None
+                t for t in ts if self._elem_val(t, key) is None
             ],
             name=f"hasNot({key})",
         )
@@ -2168,6 +2220,167 @@ class GraphTraversal:
         self._last_by = by_list
         return self
 
+    # -- OLAP-bridge steps ----------------------------------------------------
+    def _olap_annotate(self, program, state_key, key, to_value, name):
+        """Shared body of the traversal-embedded OLAP steps (TinkerPop
+        pageRank()/connectedComponent(), which the reference routes
+        through FulgoraGraphComputer as a TraversalVertexProgram stage):
+        a BARRIER that runs `program` on the graph's configured OLAP
+        executor over the COMMITTED graph, then exposes the result via a
+        TRAVERSAL-LOCAL overlay — downstream values(key)/order().by(key)/
+        has(key)/value_map/group_count of THIS traversal read it like a
+        property, nothing is ever written to the transaction or schema
+        (the reference's computer results are likewise never persisted),
+        read-only transactions work, and other traversals never see it.
+        Uncommitted vertices are not in the compute scope and stay
+        unannotated. Persist explicitly with
+        graph.compute().program(...).submit().write_back()."""
+        source = self.source
+
+        def step(ts):
+            if not ts:  # nothing downstream can read the annotation
+                return ts
+            res = source.graph.compute().program(program).submit()
+            if to_value is None:
+                by_vid = res.by_vertex(state_key)
+            else:
+                by_vid = {
+                    int(v): to_value(res, x)
+                    for v, x in zip(
+                        res.csr.vertex_ids, res.states[state_key]
+                    )
+                }
+            ov = self._olap_overlay
+            for vid, val in by_vid.items():
+                ov[(vid, key)] = val
+            return ts
+
+        self._add(step, name=name)
+        return self
+
+    def page_rank(
+        self, key: str = "pagerank", iterations: int = 20,
+        alpha: float = 0.85,
+    ) -> "GraphTraversal":
+        """TinkerPop pageRank() step: ``g.V().page_rank().order().by(
+        'pagerank', reverse=True).limit(3)`` — runs PageRank on the OLAP
+        engine (TPU/CPU/sharded per computer.executor) and exposes the
+        rank as the `key` property of the frontier's vertices.
+        ``page_rank(0.85)`` (TinkerPop's alpha overload) is honored as
+        the damping factor."""
+        from janusgraph_tpu.olap.programs import PageRankProgram
+
+        if isinstance(key, (int, float)) and not isinstance(key, bool):
+            alpha, key = float(key), "pagerank"
+        return self._olap_annotate(
+            PageRankProgram(damping=alpha, max_iterations=iterations),
+            "rank", key, None, f"pageRank({key})",
+        )
+
+    def connected_component(
+        self, key: str = "component", iterations: int = 200
+    ) -> "GraphTraversal":
+        """TinkerPop connectedComponent() step: the component id is the
+        smallest member VERTEX ID (stable across runs, like the
+        reference's smallest-element-id convention)."""
+        from janusgraph_tpu.olap.programs import ConnectedComponentsProgram
+
+        return self._olap_annotate(
+            ConnectedComponentsProgram(max_iterations=iterations),
+            "component", key,
+            lambda res, x: int(res.csr.vertex_ids[int(x)]),
+            f"connectedComponent({key})",
+        )
+
+    def shortest_path(
+        self, target=None, max_hops: int = 10
+    ) -> "GraphTraversal":
+        """TinkerPop shortestPath() step (the reference special-cases the
+        backing program at FulgoraGraphComputer.java:249-253): for each
+        incoming VERTEX, run the frontier-compacted BFS with predecessor
+        tracking on the OLAP engine and emit one PATH (list of vertices,
+        source first) per reached target. `target` filters the targets
+        (an anonymous traversal, evaluated per candidate target vertex);
+        the source itself is never a target. Paths reflect the COMMITTED
+        graph (the OLAP snapshot), like the other computer steps."""
+        from janusgraph_tpu.olap.computer import run_on
+        from janusgraph_tpu.olap.csr import load_csr
+        from janusgraph_tpu.olap.programs import ShortestPathProgram
+        from janusgraph_tpu.olap.programs.shortest_path import (
+            INF,
+            reconstruct_path,
+        )
+
+        source = self.source
+        target_steps = (
+            self._sub_steps(target) if target is not None else None
+        )
+
+        def step(ts):
+            import numpy as np
+
+            sources = [t for t in ts if isinstance(t.obj, Vertex)]
+            if not sources:
+                return []
+            csr = load_csr(source.graph)
+            index_of = {
+                int(v): i for i, v in enumerate(csr.vertex_ids)
+            }
+            cfg = getattr(source.graph, "config", None)
+            executor = cfg.get("computer.executor") if cfg else "tpu"
+            tx = self.tx
+            # per-vertex caches shared across ALL (source, target) pairs:
+            # the target verdict and the vid->Vertex fetch are per-vertex
+            # facts, not per-pair
+            vertex_cache: dict = {}
+            verdict_cache: dict = {}
+
+            def _vertex_at(i):
+                if i not in vertex_cache:
+                    vertex_cache[i] = tx.get_vertex(int(csr.vertex_ids[i]))
+                return vertex_cache[i]
+
+            def _is_target(i):
+                if target_steps is None:
+                    return True
+                if i not in verdict_cache:
+                    tv = _vertex_at(i)
+                    verdict_cache[i] = tv is not None and bool(
+                        self._apply_steps(target_steps, [Traverser(tv)])
+                    )
+                return verdict_cache[i]
+
+            out = []
+            for t in sources:
+                seed = index_of.get(t.obj.id)
+                if seed is None:  # uncommitted vertex: not in the snapshot
+                    continue
+                res = run_on(
+                    csr,
+                    ShortestPathProgram(
+                        seed_index=seed, max_iterations=max_hops,
+                        track_paths=True,
+                    ),
+                    executor,
+                )
+                dist = np.asarray(res["distance"])
+                for ti in range(len(dist)):
+                    if ti == seed or dist[ti] >= INF:
+                        continue
+                    if _vertex_at(ti) is None or not _is_target(ti):
+                        continue
+                    chain = reconstruct_path(res, ti)
+                    if chain is None:
+                        continue
+                    path_vs = [_vertex_at(i) for i in chain]
+                    if any(v is None for v in path_vs):
+                        continue
+                    out.append(t.child(path_vs, prev=t.prev))
+            return out
+
+        self._add(step, name="shortestPath")
+        return self
+
     # -- projections over sub-traversals --------------------------------------
     def project(self, *names: str) -> "GraphTraversal":
         """project('a','b').by(...).by(...) — one dict per traverser."""
@@ -2381,11 +2594,10 @@ class GraphTraversal:
         return sum(vals) / len(vals) if vals else None
 
     def group_count(self, key: Optional[str] = None) -> dict:
-        tx = self.tx
         ts = self._execute()
         if key is None:
             return dict(Counter(t.obj for t in ts))
-        return dict(Counter(_element_value(t, key, tx) for t in ts))
+        return dict(Counter(self._elem_val(t, key) for t in ts))
 
     # -- terminals -----------------------------------------------------------
     def _execute(self, observe=None) -> List[Traverser]:

@@ -56,6 +56,9 @@ STEP_MAP = {
     "mergeE": "merge_e",
     "onCreate": "on_create",
     "onMatch": "on_match",
+    "pageRank": "page_rank",
+    "connectedComponent": "connected_component",
+    "shortestPath": "shortest_path",
 }
 
 #: step names that collide with structure-token attributes (T.id): only

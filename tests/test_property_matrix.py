@@ -92,3 +92,26 @@ def test_single_cardinality_composite_index_follows_updates():
     assert [x.id for x in t.V().has("k_int", 2).to_list()] == [v.id]
     assert t.V().has("k_int", 1).to_list() == []
     g.close()
+
+
+def test_value_map_list_cardinality_preserved():
+    """value_map keeps every value of LIST-cardinality keys (regression:
+    an overlay-shadowing guard must not halt multi-value accumulation)."""
+    from janusgraph_tpu.core.codecs import Cardinality
+    from janusgraph_tpu.core.graph import open_graph
+
+    g = open_graph({"ids.authority-wait-ms": 0.0})
+    mgmt = g.management()
+    mgmt.make_property_key("tag", str, cardinality=Cardinality.LIST)
+    mgmt.make_vertex_label("doc")
+    t = g.traversal()
+    v = t.add_v("doc")
+    tx = t.tx
+    tx.add_property(v, "tag", "a")
+    tx.add_property(v, "tag", "b")
+    t.commit()
+    got = g.traversal().V().has_label("doc").value_map("tag").to_list()
+    assert got == [{"tag": ["a", "b"]}]
+    vals = g.traversal().V().has_label("doc").values("tag").to_list()
+    assert sorted(vals) == ["a", "b"]
+    g.close()

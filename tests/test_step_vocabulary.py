@@ -532,3 +532,108 @@ def test_math_step_sandbox(g):
     # non-numeric value at execution
     with pytest.raises(QueryError, match="not a number"):
         t.V().has("name", "jupiter").values("name").math("_ + 1").to_list()
+
+
+# ----------------------------------------------- traversal-embedded OLAP
+def test_page_rank_step(g):
+    """g.V().pageRank(): OLAP ranks flow into the OLTP traversal as a
+    transient property (TinkerPop pageRank() through the computer)."""
+    t = g.traversal()
+    top = (
+        t.V().page_rank()
+        .order("pagerank", reverse=True).limit(2)
+        .values("name").to_list()
+    )
+    # jupiter is the gods graph's hub; ranks exist on every vertex
+    assert len(top) == 2
+    ranks = t.V().page_rank().values("pagerank").to_list()
+    assert len(ranks) == 12 and all(r > 0 for r in ranks)
+    assert abs(sum(ranks) - 1.0) < 1e-3
+    # transient: other traversals (even from the same source) never see
+    # the overlay, and nothing was written to the tx or the schema
+    t2 = g.traversal()
+    assert t2.V().has_label("god").value_map("pagerank").to_list()[0] == {}
+    assert g.schema_cache.get_by_name("pagerank") is None
+    # read-only transactions can run the computer steps (pure reads)
+    from janusgraph_tpu.core.traversal import GraphTraversalSource
+
+    ro = GraphTraversalSource(g, g.new_transaction(read_only=True))
+    ranks_ro = ro.V().page_rank().values("pagerank").to_list()
+    assert len(ranks_ro) == 12
+
+
+def test_page_rank_overlay_semantics(g):
+    """Overlay SHADOWS stored same-key properties, is visible to
+    sub-traversal bodies, and honors the TinkerPop alpha overload."""
+    t = g.traversal()
+    vals = t.V().page_rank().values("pagerank").to_list()
+    assert len(vals) == 12
+    # overlay SHADOWS a stored same-key property: one value per vertex
+    gods_with_age = t.V().has("age").count()
+    shadowed = t.V().has("age").page_rank(key="age").values("age").to_list()
+    assert len(shadowed) == gods_with_age  # no duplicates
+    assert all(v < 1 for v in shadowed)  # ranks, not the stored ages
+    vm = t.V().has("age").page_rank(key="age").value_map("age").to_list()
+    assert all(len(m["age"]) == 1 for m in vm)
+    # the overlay does NOT leak into later traversals from the SAME source
+    later = t.V().has("name", "jupiter").values("age").to_list()
+    assert later == [5000]
+    # no-arg value_map/values surface the annotated key in-traversal
+    full = t.V().page_rank().has("name", "jupiter").value_map().to_list()
+    assert "pagerank" in full[0]
+    # sub-traversal by() form sees the overlay
+    via_body = (
+        t.V().page_rank()
+        .order().by(lambda x: x.values("pagerank"), reverse=True)
+        .limit(1).values("name").to_list()
+    )
+    via_key = (
+        t.V().page_rank()
+        .order("pagerank", reverse=True).limit(1).values("name").to_list()
+    )
+    assert via_body == via_key
+    # alpha overload
+    r_none = t.V().page_rank("pagerank", iterations=30).values(
+        "pagerank").to_list()
+    r_low = t.V().page_rank(0.5, iterations=30).values(
+        "pagerank").to_list()
+    assert r_none != r_low  # damping changed the fixpoint
+    # empty frontier short-circuits the compute entirely (barrier guard)
+    assert t.V().has("name", "nobody-with-this-name").page_rank(
+    ).to_list() == []
+
+
+def test_connected_component_step(g):
+    t = g.traversal()
+    comps = t.V().connected_component().values("component").to_list()
+    assert len(comps) == 12
+    assert len(set(comps)) == 1  # gods graph is one connected component
+    # the component id is a real member vertex id
+    assert comps[0] in {v.id for v in t.V().to_list()}
+
+
+def test_shortest_path_step(g):
+    """TinkerPop shortestPath(): per-source BFS paths via the OLAP
+    predecessor-tracking program."""
+    t = g.traversal()
+    paths = t.V().has("name", "hercules").shortest_path().to_list()
+    assert paths and all(p[0].value("name") == "hercules" for p in paths)
+    by_target = {p[-1].value("name"): p for p in paths}
+    # hercules -> jupiter is one hop (father)
+    assert len(by_target["jupiter"]) == 2
+    # hercules -> saturn is two hops (father.father)
+    assert len(by_target["saturn"]) == 3
+    # target filter narrows the emitted paths
+    only = t.V().has("name", "hercules").shortest_path(
+        target=__.has("name", "saturn")
+    ).to_list()
+    assert len(only) == 1 and only[0][-1].value("name") == "saturn"
+    # every path is a genuine edge chain
+    tx = t.tx
+    from janusgraph_tpu.core.codecs import Direction
+
+    for p in only:
+        for a, b in zip(p, p[1:]):
+            nbrs = {e.other(a).id
+                    for e in tx.get_edges(a, Direction.BOTH, ())}
+            assert b.id in nbrs
