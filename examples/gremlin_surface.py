@@ -1,0 +1,88 @@
+"""The round-5 Gremlin surface in one tour: mergeV/mergeE upserts, the
+chained repeat modulators, math(), edge identity round-trips, and the
+traversal-embedded OLAP computer steps — everything in BOTH spellings
+(python DSL here; the camelCase forms run verbatim over the HTTP
+endpoint, see remote_client.py).
+
+Run:  python examples/gremlin_surface.py
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+from janusgraph_tpu.core import gods
+from janusgraph_tpu.core.codecs import Direction
+from janusgraph_tpu.core.graph import open_graph
+from janusgraph_tpu.core.traversal import AnonymousTraversal, T
+
+__ = AnonymousTraversal()
+
+
+def main():
+    graph = open_graph({"ids.authority-wait-ms": 0.0})
+    gods.load(graph)
+    g = graph.traversal()
+
+    # --- declarative upserts (TinkerPop 3.6 mergeV/mergeE) -------------
+    minerva = (
+        g.merge_v({T.label: "god", "name": "minerva"})
+        .on_create({"age": 100})
+        .on_match({"seen": True})
+        .next()
+    )
+    print("mergeV created:", minerva.value("name"), minerva.value("age"))
+    again = g.merge_v({T.label: "god", "name": "minerva"}).next()
+    print("idempotent:", again.id == minerva.id)
+
+    jupiter = g.V().has("name", "jupiter").next()
+    e = (
+        g.merge_e({Direction.OUT: jupiter, Direction.IN: minerva,
+                   T.label: "sired"})
+        .on_create({"order": 1})
+        .next()
+    )
+    print("mergeE:", e.label, e.property_values())
+
+    # --- edge identity round-trip --------------------------------------
+    rid = g.V().has("name", "jupiter").out_e("brother").id_().next()
+    print("edge id:", rid, "->", g.E(rid).next().label)
+
+    # --- chained loop modulators (real Gremlin spelling) ---------------
+    names = (
+        g.V().has("name", "hercules")
+        .repeat(__.out("father")).until(__.has("name", "saturn"))
+        .values("name").to_list()
+    )
+    print("repeat().until():", names)
+
+    # --- math() ---------------------------------------------------------
+    ratios = (
+        g.V().has("name", "jupiter").as_("a")
+        .out("brother").math("a / _").by("age").to_list()
+    )
+    print("math('a / _'):", ratios)
+
+    # --- traversal-embedded OLAP (runs on the configured executor) ------
+    top = (
+        g.V().page_rank()
+        .order("pagerank", reverse=True).limit(3).values("name").to_list()
+    )
+    print("pageRank top-3:", top)
+    comp = g.V().connected_component().group_count("component")
+    print("connectedComponent sizes:", comp)
+    path = g.V().has("name", "hercules").shortest_path(
+        target=__.has("name", "saturn")
+    ).next()
+    print("shortestPath:", [v.value("name") for v in path])
+
+    graph.close()
+
+
+if __name__ == "__main__":
+    main()

@@ -1504,23 +1504,27 @@ class GraphTraversal:
     def order(self, key: Optional[str] = None, reverse: bool = False) -> "GraphTraversal":
         by_list: List[Tuple] = []
 
+        def _sort_missing_last(ts, value_of, rev):
+            # traversers MISSING the key sort LAST in either direction
+            # (a naive (is-None, val) tuple under reverse=True would put
+            # them FIRST — observed with uncommitted vertices absent from
+            # a pageRank() snapshot); values computed ONCE per traverser
+            keyed = [(value_of(t), t) for t in ts]
+            have = [(v, t) for v, t in keyed if v is not None]
+            missing = [t for v, t in keyed if v is None]
+            have.sort(key=lambda p: p[0], reverse=rev)
+            return [t for _v, t in have] + missing
+
         def step(ts):
             if by_list:  # .order().by('name') / .by(body, reverse=True)
                 spec = by_list[0]
-                return sorted(
-                    ts,
-                    key=lambda t: (
-                        (v := self._by_value(spec, t.obj)) is None, v
-                    ),
-                    reverse=spec[2],
+                return _sort_missing_last(
+                    ts, lambda t: self._by_value(spec, t.obj), spec[2]
                 )
             if key is None:
                 return sorted(ts, key=lambda t: t.obj, reverse=reverse)
-            return sorted(
-                ts,
-                key=lambda t: (self._elem_val(t, key) is None,
-                               self._elem_val(t, key)),
-                reverse=reverse,
+            return _sort_missing_last(
+                ts, lambda t: self._elem_val(t, key), reverse
             )
 
         self._add(step)
