@@ -487,6 +487,16 @@ SERVER_NS.option(
 # ---- round-5 batch: remaining reference-vocabulary knobs that were
 # ---- hard-coded constants; each names its read site
 QUERY_NS.option(
+    "max-traversers", int,
+    "frontier-size budget per traversal execution (0 = unlimited): an "
+    "exploding chain — e.g. unbounded repeat().emit() on a cyclic label "
+    "doubles the frontier every loop — raises QueryError instead of "
+    "consuming the process (the role of the reference Gremlin Server's "
+    "evaluationTimeout, as a SIZE bound since Python threads cannot be "
+    "interrupted; read in GraphTraversal._execute + the repeat loop)",
+    1_000_000, Mutability.MASKABLE, lambda v: v >= 0,
+)
+QUERY_NS.option(
     "ignore-unknown-index-key", bool,
     "graph-centric queries over a property key absent from the schema: "
     "false (reference default) raises QueryError, true treats the "

@@ -324,6 +324,7 @@ class JanusGraphTPU:
             "metrics.slow-query-threshold-ms"
         )
         self._query_batch = cfg.get("query.batch")
+        self._max_traversers = cfg.get("query.max-traversers")
         self._metric_reporters = []
         self.instance_registry = InstanceRegistry(self.backend)
         if not self.backend.read_only:

@@ -2508,9 +2508,16 @@ class GraphTraversal:
             frontier = ts
             loops = 0
             bound = times_ if times_ is not None else max_loops
+            cap = getattr(self.tx.graph, "_max_traversers", 0)
             while frontier and loops < bound:
                 frontier = self._apply_steps(body_steps, frontier)
                 loops += 1
+                if cap and len(frontier) + len(results) > cap:
+                    raise QueryError(
+                        f"traverser count {len(frontier) + len(results)} "
+                        f"exceeds query.max-traversers ({cap}) in "
+                        f"repeat() loop {loops}"
+                    )
                 if until_steps is not None:
                     cont = []
                     for t in frontier:
@@ -2623,8 +2630,21 @@ class GraphTraversal:
         if init is not None:
             for t in ts:
                 t.sack = init()
+        # query.max-traversers: frontier-size budget — an exploding chain
+        # (e.g. an unbounded repeat().emit() on a cyclic label doubles the
+        # frontier every loop) fails loudly instead of consuming the
+        # process (the reference's Gremlin Server bounds runaway scripts
+        # with evaluationTimeout; a Python thread cannot be interrupted,
+        # so the budget is on SIZE, which is what actually explodes)
+        cap = getattr(self.tx.graph, "_max_traversers", 0)
         for step in self._steps:
             ts = run(getattr(step, "_label", "step"), step, ts)
+            if cap and len(ts) > cap:
+                raise QueryError(
+                    f"traverser count {len(ts)} exceeds "
+                    f"query.max-traversers ({cap}) after "
+                    f"{getattr(step, '_label', 'step')!r}"
+                )
         # metrics.slow-query-threshold-ms: observability for outlier
         # traversals; resolved once at graph open (hot path)
         thr = getattr(self.tx.graph, "_slow_query_threshold_ms", 0.0)

@@ -555,3 +555,35 @@ def test_eviction_ack_poll_config():
     mgmt = g.management()
     mgmt.make_property_key("k1", int)
     g.close()
+
+
+def test_max_traversers_budget():
+    """query.max-traversers: an exponentially exploding repeat().emit()
+    raises instead of consuming the process."""
+    from janusgraph_tpu.core import gods
+    from janusgraph_tpu.core.traversal import AnonymousTraversal, QueryError
+
+    __ = AnonymousTraversal()
+    g = open_graph({
+        "ids.authority-wait-ms": 0.0, "query.max-traversers": 500,
+    })
+    gods.load(g)
+    try:
+        t = g.traversal()
+        with pytest.raises(QueryError, match="max-traversers"):
+            # brother<->brother cycles double the frontier every loop
+            t.V().repeat(__.both("brother"), emit=True).to_list()
+        # bounded chains still work
+        assert t.V().repeat(__.out("father"), times=2).to_list()
+        # a plain wide step over the budget trips the per-step check
+        g2 = open_graph({
+            "ids.authority-wait-ms": 0.0, "query.max-traversers": 2,
+        })
+        gods.load(g2)
+        try:
+            with pytest.raises(QueryError, match="max-traversers"):
+                g2.traversal().V().out("battled").to_list()
+        finally:
+            g2.close()
+    finally:
+        g.close()
