@@ -2297,7 +2297,8 @@ class GraphTraversal:
         )
 
     def shortest_path(
-        self, target=None, max_hops: int = 10
+        self, target=None, max_hops: int = 10,
+        weight_key: Optional[str] = None,
     ) -> "GraphTraversal":
         """TinkerPop shortestPath() step (the reference special-cases the
         backing program at FulgoraGraphComputer.java:249-253): for each
@@ -2305,14 +2306,19 @@ class GraphTraversal:
         tracking on the OLAP engine and emit one PATH (list of vertices,
         source first) per reached target. `target` filters the targets
         (an anonymous traversal, evaluated per candidate target vertex);
-        the source itself is never a target. Paths reflect the COMMITTED
-        graph (the OLAP snapshot), like the other computer steps."""
+        the source itself is never a target. `weight_key` switches to
+        weighted (Dijkstra-equivalent) paths over that edge property: the
+        device program relaxes distances to fixpoint and the predecessor
+        array derives host-side from the relaxation equation
+        (weighted_predecessors). Paths reflect the COMMITTED graph (the
+        OLAP snapshot), like the other computer steps."""
         from janusgraph_tpu.olap.computer import run_on
         from janusgraph_tpu.olap.csr import load_csr
         from janusgraph_tpu.olap.programs import ShortestPathProgram
         from janusgraph_tpu.olap.programs.shortest_path import (
             INF,
             reconstruct_path,
+            weighted_predecessors,
         )
 
         source = self.source
@@ -2326,7 +2332,14 @@ class GraphTraversal:
             sources = [t for t in ts if isinstance(t.obj, Vertex)]
             if not sources:
                 return []
-            csr = load_csr(source.graph)
+            if weight_key is not None and not _is_property_key(
+                source.graph, weight_key
+            ):
+                raise QueryError(
+                    f"shortest_path: weight_key {weight_key!r} is not a "
+                    "property key in the schema"
+                )
+            csr = load_csr(source.graph, weight_key=weight_key)
             index_of = {
                 int(v): i for i, v in enumerate(csr.vertex_ids)
             }
@@ -2359,14 +2372,29 @@ class GraphTraversal:
                 seed = index_of.get(t.obj.id)
                 if seed is None:  # uncommitted vertex: not in the snapshot
                     continue
+                # weighted mode MUST reach the relaxation fixpoint (the
+                # predecessor derivation requires it) — the program stops
+                # early at fixpoint anyway, so the cap is just a
+                # Bellman-Ford worst-case bound; max_hops caps only the
+                # unweighted hop count
                 res = run_on(
                     csr,
                     ShortestPathProgram(
-                        seed_index=seed, max_iterations=max_hops,
-                        track_paths=True,
+                        seed_index=seed,
+                        max_iterations=(
+                            max_hops if weight_key is None
+                            else csr.num_vertices + 1
+                        ),
+                        weighted=weight_key is not None,
+                        track_paths=weight_key is None,
                     ),
                     executor,
                 )
+                res = dict(res)
+                if weight_key is not None:
+                    res["predecessor"] = weighted_predecessors(
+                        csr, res, seed
+                    )
                 dist = np.asarray(res["distance"])
                 for ti in range(len(dist)):
                     if ti == seed or dist[ti] >= INF:

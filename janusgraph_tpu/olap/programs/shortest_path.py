@@ -48,7 +48,9 @@ class ShortestPathProgram(VertexProgram):
         if track_paths and weighted:
             raise ValueError(
                 "track_paths requires unweighted BFS (frontier-index "
-                "predecessor encoding); run weighted distances without paths"
+                "predecessor encoding); for weighted paths run distances "
+                "to fixpoint and derive predecessors with "
+                "weighted_predecessors(csr, result, seed)"
             )
         self.seed_index = seed_index
         self.weighted = weighted
@@ -130,3 +132,70 @@ def reconstruct_path(result, target_index: int):
         path.append(p)
         v = p
     return None  # cycle guard — malformed predecessor array
+
+
+def weighted_predecessors(csr, result, seed_index: int):
+    """Predecessor array for a WEIGHTED run, derived host-side from the
+    converged distances in one vectorized O(E) pass: v's predecessor is
+    any in-neighbor u with dist[u] + w(u,v) == dist[v] (ties broken by
+    first slot). The device program cannot carry predecessors in weighted
+    mode (its frontier-index encoding is hop-count-based), but at a
+    FIXPOINT the relaxation equation identifies them exactly — so paths
+    come from distances, not from extra device state. Returns an array
+    shaped like the unweighted tracker: pred[seed] = seed, -1 where
+    unreached, ready for reconstruct_path (reference capability:
+    TinkerPop ShortestPathVertexProgram with the distance modulator).
+    Float tolerance: weights accumulate in f32 on device, so the
+    equality check allows 1e-4 relative slack."""
+    import numpy as np
+
+    dist = np.asarray(result["distance"], dtype=np.float64)
+    n = csr.num_vertices
+    if csr.in_edge_weight is None:
+        raise ValueError(
+            "weighted_predecessors needs a weight-materialized CSR "
+            "(load_csr(..., weight_key=...))"
+        )
+    src = csr.in_src.astype(np.int64)
+    w = csr.in_edge_weight.astype(np.float64)
+    dstv = np.repeat(
+        np.arange(n, dtype=np.int64), np.diff(csr.in_indptr)
+    )
+    cand = dist[src] + w
+    ok = np.abs(cand - dist[dstv]) <= 1e-4 * np.maximum(
+        1.0, np.abs(dist[dstv])
+    )
+    ok &= dist[dstv] < INF
+    ok &= src != dstv  # a self-loop must never be its own predecessor
+    pred = np.full(n, -1, dtype=np.int64)
+    pred[seed_index] = seed_index
+    # Phase 1 — STRICT edges (dist[u] < dist[v]): any satisfying slot is
+    # a valid predecessor; chains strictly decrease in distance, so no
+    # cycles are possible.
+    strict = ok & (dist[src] < dist[dstv])
+    s_slots = np.nonzero(strict)[0][::-1]  # first slot wins
+    mask = pred[dstv[s_slots]] == -1
+    # the seed's pred stays itself even if a strict in-edge matches
+    mask &= dstv[s_slots] != seed_index
+    pred[dstv[s_slots][mask]] = src[s_slots][mask]
+    # Phase 2 — zero-weight (sub-tolerance) equality edges: dist[u] ==
+    # dist[v]. Naive slot-order picks can form u<->v cycles; instead BFS
+    # from the already-assigned set through these edges, so every
+    # assignment points strictly toward the seed along a real shortest
+    # path (the entering vertex of each equal-distance class was
+    # assigned in phase 1, or IS the seed).
+    eq_slots = np.nonzero(ok & (dist[src] >= dist[dstv]))[0]
+    if len(eq_slots):
+        from collections import defaultdict, deque
+
+        out_eq = defaultdict(list)  # u -> [v] over equality edges
+        for i in eq_slots:
+            out_eq[int(src[i])].append(int(dstv[i]))
+        queue = deque(int(v) for v in np.nonzero(pred != -1)[0])
+        while queue:
+            u = queue.popleft()
+            for v in out_eq.get(u, ()):
+                if pred[v] == -1:
+                    pred[v] = u
+                    queue.append(v)
+    return pred

@@ -687,3 +687,23 @@ def test_order_missing_key_sorts_last_both_directions(g):
         "name").to_list()
     assert set(desc_by[-k:]) == no_age
     assert desc_by[:-k] == desc[:-k]
+
+
+def test_shortest_path_weighted(g):
+    """shortest_path(weight_key=...): Dijkstra-equivalent paths over an
+    edge property (battled edges carry 'time')."""
+    t = g.traversal()
+    paths = t.V().has("name", "hercules").shortest_path(
+        weight_key="time", max_hops=50
+    ).to_list()
+    assert paths
+    # weighted reach includes battled monsters; each path is a real chain
+    names = {p[-1].value("name") for p in paths}
+    assert "nemean" in names or "hydra" in names
+    # a typo'd weight key fails eagerly with the real cause
+    from janusgraph_tpu.core.traversal import QueryError
+
+    with pytest.raises(QueryError, match="not a property key"):
+        t.V().has("name", "hercules").shortest_path(
+            weight_key="tmie"
+        ).to_list()
