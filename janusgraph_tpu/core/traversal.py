@@ -213,7 +213,7 @@ class Traverser:
     (reference: TinkerPop traversers carry the same path/labels state; the
     reference reuses them via graphdb/tinkerpop/ glue)."""
 
-    __slots__ = ("obj", "prev", "path", "tags", "sack")
+    __slots__ = ("obj", "prev", "path", "tags", "sack", "loops")
 
     def __init__(self, obj, prev=None, path=None, tags=None, sack=None):
         self.obj = obj
@@ -223,13 +223,18 @@ class Traverser:
         #: per-traverser scratch value (TinkerPop sack(); set by
         #: with_sack(), transformed by sack(fn), read by sack())
         self.sack = sack
+        #: repeat() loop depth (TinkerPop loops(); stamped by the repeat
+        #: loop on every round's survivors, read by the loops() step)
+        self.loops = 0
 
     def child(self, obj, prev=None) -> "Traverser":
         """A traverser one step further along: path extended, tags kept."""
-        return Traverser(
+        c = Traverser(
             obj, prev=prev, path=self.path + (obj,), tags=self.tags,
             sack=self.sack,
         )
+        c.loops = self.loops  # repeat() depth survives map steps
+        return c
 
     def tagged(self, name: str) -> "Traverser":
         tags = dict(self.tags) if self.tags else {}
@@ -1309,6 +1314,24 @@ class GraphTraversal:
         self._add(
             lambda ts: [t.child(value) for t in ts], name="constant"
         )
+        return self
+
+    def loops(self) -> "GraphTraversal":
+        """TinkerPop loops(): the traverser's current repeat() depth —
+        ``repeat(out()).until(loops().is_(3))`` bounds a loop by depth."""
+        self._add(
+            lambda ts: [t.child(t.loops) for t in ts], name="loops"
+        )
+        return self
+
+    def barrier(self, max_size: Optional[int] = None) -> "GraphTraversal":
+        """TinkerPop barrier([maxBarrierSize]): an explicit
+        synchronization point. The execution model here is already
+        batch-at-a-time (every step maps the WHOLE traverser list), so
+        this is a documented no-op — including the size argument, which
+        tunes TinkerPop's lazy-stream batching that does not exist
+        here."""
+        self._add(lambda ts: ts, name="barrier")
         return self
 
     def property(self, key: str, value=None, **props) -> "GraphTraversal":
@@ -2503,12 +2526,6 @@ class GraphTraversal:
         following times()/until()/emit() calls complete it, and execution
         without any control raises. (Pre-positioned ``until().repeat()``
         do-while ordering is not supported — use the kwargs.)"""
-        if until is None and not emit and times is not None:
-            # kwarg times-only fast path: inline the body, no loop step
-            for _ in range(times):
-                body(self)
-            return self
-
         body_steps = self._sub_steps(body)
         if max_loops is None:
             # query.max-repeat-loops bounds until-only loops graph-wide
@@ -2540,6 +2557,8 @@ class GraphTraversal:
             while frontier and loops < bound:
                 frontier = self._apply_steps(body_steps, frontier)
                 loops += 1
+                for t in frontier:  # TinkerPop loops() visibility
+                    t.loops = loops
                 if cap and len(frontier) + len(results) > cap:
                     raise QueryError(
                         f"traverser count {len(frontier) + len(results)} "
@@ -2556,13 +2575,18 @@ class GraphTraversal:
                     frontier = cont
                 if emit_:
                     es = spec["emit_steps"]
-                    if es is None:
-                        results.extend(frontier)
-                    else:
-                        results.extend(
-                            t for t in frontier
-                            if self._apply_steps(es, [t])
+                    emitted = (
+                        frontier if es is None else
+                        [t for t in frontier
+                         if self._apply_steps(es, [t])]
+                    )
+                    for t in emitted:
+                        c = Traverser(
+                            t.obj, prev=t.prev, path=t.path,
+                            tags=t.tags, sack=t.sack,
                         )
+                        c.loops = t.loops
+                        results.append(c)
             if until_steps is None and not emit_:
                 return frontier
             if until_steps is not None and not emit_:

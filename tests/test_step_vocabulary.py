@@ -707,3 +707,55 @@ def test_shortest_path_weighted(g):
         t.V().has("name", "hercules").shortest_path(
             weight_key="tmie"
         ).to_list()
+
+
+def test_loops_and_barrier(g):
+    """loops() reads repeat() depth (until(loops().is_(n)) bounds);
+    barrier() is the documented batch-model no-op."""
+    t = g.traversal()
+    got = (
+        t.V().has("name", "saturn")
+        .repeat(__.in_("father")).until(__.loops().is_(2))
+        .values("name").to_list()
+    )
+    # exactly 2 hops up the father chain from saturn
+    assert got == ["hercules"]
+    assert t.V().barrier().count() == 12
+    # depth visible via emit too: emitted traversers carry their depth
+    depths = (
+        t.V().has("name", "saturn").repeat(__.in_("father")).emit()
+        .loops().to_list()
+    )
+    assert sorted(depths) == [1, 2]
+
+
+def test_loops_depth_semantics(g):
+    """Review repros: depth survives map steps (child), emitted depths
+    are frozen per round (no aliasing rewrite), and the kwarg times form
+    matches the chained spelling."""
+    t = g.traversal()
+    # filter-only body + emit: depths are per-round, not all-final
+    depths = t.V().has("name", "saturn").repeat(
+        __.in_("father")
+    ).emit().out_e("father").loops().to_list()
+    # jupiter (depth 1) and hercules (depth 2) each have an out-father
+    # edge; the depth rides through the edge expansion
+    assert sorted(depths) == [1, 2]
+    # depth survives map steps after the loop
+    d2 = t.V().has("name", "saturn").repeat(__.in_("father")).emit(
+    ).values("name").loops().to_list()
+    assert sorted(d2) == [1, 2]
+    # kwarg times == chained times for loops()
+    a = t.V().has("name", "saturn").repeat(
+        __.in_("father"), times=2
+    ).loops().to_list()
+    b = t.V().has("name", "saturn").repeat(
+        __.in_("father")
+    ).times(2).loops().to_list()
+    assert a == b == [2]
+    # aliasing: filter-only body emits each round's own depth
+    fa = t.V().has("name", "jupiter").repeat(__.has("age")).emit(
+    ).times(3).loops().to_list()
+    assert sorted(fa) == [1, 2, 3]
+    # barrier accepts TinkerPop's size argument
+    assert t.V().barrier(2500).count() == 12
