@@ -914,6 +914,69 @@ class GraphTraversal:
         self._add(lambda ts: [t for t in ts if fn(t.obj)])
         return self
 
+    def identity(self) -> "GraphTraversal":
+        """TinkerPop identity(): pass traversers through unchanged."""
+        self._add(lambda ts: ts, name="identity")
+        return self
+
+    def none(self) -> "GraphTraversal":
+        """TinkerPop none(): discard every traverser (the iterate()
+        companion for mutation-only chains)."""
+        self._add(lambda ts: [], name="none")
+        return self
+
+    def map_(self, fn) -> "GraphTraversal":
+        """TinkerPop map(): one output per input. Accepts a python
+        callable on the raw object OR a traversal body — ``map(values(
+        'name'))`` over the text endpoint — whose FIRST result is the
+        output (traversers with no result are dropped, the TinkerPop
+        map-traversal contract)."""
+        if isinstance(fn, (AnonymousTraversal, GraphTraversal)):
+            steps = self._sub_steps(fn)
+
+            def step(ts):
+                out = []
+                for t in ts:
+                    hits = self._apply_steps(steps, [t])
+                    if hits:
+                        out.append(t.child(hits[0].obj))
+                return out
+
+        else:
+            def step(ts):
+                return [t.child(fn(t.obj)) for t in ts]
+
+        self._add(step, name="map")
+        return self
+
+    def flat_map(self, fn) -> "GraphTraversal":
+        """TinkerPop flatMap(): each input yields zero or more outputs.
+        Accepts a traversal body (``flatMap(out('knows'))`` — every
+        result becomes a traverser) or a python callable returning an
+        iterable."""
+        if isinstance(fn, (AnonymousTraversal, GraphTraversal)):
+            steps = self._sub_steps(fn)
+
+            def step(ts):
+                out = []
+                for t in ts:
+                    out.extend(
+                        t.child(r.obj)
+                        for r in self._apply_steps(steps, [t])
+                    )
+                return out
+
+        else:
+            def step(ts):
+                out = []
+                for t in ts:
+                    for x in fn(t.obj):
+                        out.append(t.child(x))
+                return out
+
+        self._add(step, name="flatMap")
+        return self
+
     def _add(self, step, name: Optional[str] = None) -> None:
         self._folding = False
         self._last_by = None  # a new step closes the previous by() window
@@ -1060,6 +1123,66 @@ class GraphTraversal:
                 if isinstance(t.obj, Vertex)
                 for p in tx.get_properties(t.obj, *keys)
             ]
+        )
+        return self
+
+    def key(self) -> "GraphTraversal":
+        """TinkerPop key(): property traverser -> its key string."""
+
+        def step(ts):
+            out = []
+            for t in ts:
+                if not isinstance(t.obj, VertexProperty):
+                    raise QueryError(
+                        "key() requires property traversers "
+                        f"(got {type(t.obj).__name__})"
+                    )
+                out.append(t.child(t.obj.key, prev=t.prev))
+            return out
+
+        self._add(step, name="key")
+        return self
+
+    def value(self) -> "GraphTraversal":
+        """TinkerPop value(): property traverser -> its value."""
+
+        def step(ts):
+            out = []
+            for t in ts:
+                if not isinstance(t.obj, VertexProperty):
+                    raise QueryError(
+                        "value() requires property traversers "
+                        f"(got {type(t.obj).__name__})"
+                    )
+                out.append(t.child(t.obj.value, prev=t.prev))
+            return out
+
+        self._add(step, name="value")
+        return self
+
+    def has_key(self, *keys: str) -> "GraphTraversal":
+        """TinkerPop hasKey(): keep property traversers with these keys."""
+        ks = set(keys)
+        self._add(
+            lambda ts: [
+                t for t in ts
+                if isinstance(t.obj, VertexProperty) and t.obj.key in ks
+            ],
+            name=f"hasKey{tuple(sorted(ks))!r}",
+        )
+        return self
+
+    def has_value(self, *values) -> "GraphTraversal":
+        """TinkerPop hasValue(): keep property traversers whose value
+        matches one of the arguments (or a P predicate)."""
+        preds = [v if isinstance(v, P) else P.eq(v) for v in values]
+        self._add(
+            lambda ts: [
+                t for t in ts
+                if isinstance(t.obj, VertexProperty)
+                and any(p.test(t.obj.value) for p in preds)
+            ],
+            name="hasValue",
         )
         return self
 
@@ -2435,6 +2558,22 @@ class GraphTraversal:
 
         self._add(step, name="shortestPath")
         return self
+
+    def peer_pressure(
+        self, key: str = "cluster", rounds: int = 30
+    ) -> "GraphTraversal":
+        """TinkerPop peerPressure() step: label-propagation clustering on
+        the OLAP engine; the cluster id lands in the overlay like the
+        other computer steps."""
+        from janusgraph_tpu.olap.programs import PeerPressureProgram
+
+        return self._olap_annotate(
+            PeerPressureProgram(rounds=rounds), "cluster", key,
+            # cluster id = a member VERTEX ID (TinkerPop's convention,
+            # same as connected_component), not the internal CSR index
+            lambda res, x: int(res.csr.vertex_ids[int(x)]),
+            f"peerPressure({key})",
+        )
 
     # -- projections over sub-traversals --------------------------------------
     def project(self, *names: str) -> "GraphTraversal":

@@ -59,6 +59,11 @@ STEP_MAP = {
     "pageRank": "page_rank",
     "connectedComponent": "connected_component",
     "shortestPath": "shortest_path",
+    "peerPressure": "peer_pressure",
+    "hasKey": "has_key",
+    "hasValue": "has_value",
+    "flatMap": "flat_map",
+    "map": "map_",
 }
 
 #: step names that collide with structure-token attributes (T.id): only

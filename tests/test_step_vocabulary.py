@@ -759,3 +759,63 @@ def test_loops_depth_semantics(g):
     assert sorted(fa) == [1, 2, 3]
     # barrier accepts TinkerPop's size argument
     assert t.V().barrier(2500).count() == 12
+
+
+def test_round5_small_steps(g):
+    """identity/none/map_/flat_map/key/value/has_key/has_value/
+    peer_pressure — the remaining TinkerPop step-library vocabulary."""
+    t = g.traversal()
+    assert t.V().identity().count() == 12
+    assert t.V().none().to_list() == []
+    assert sorted(
+        t.V().has_label("god").map_(lambda v: v.value("name")).to_list()
+    ) == ["jupiter", "neptune", "pluto"]
+    assert sorted(
+        t.V().has("name", "jupiter").flat_map(
+            lambda v: v.value("name")
+        ).to_list()
+    ) == sorted("jupiter")
+    # property-traverser steps
+    ks = t.V().has("name", "saturn").properties().key().to_list()
+    assert set(ks) == {"name", "age"}
+    vs = t.V().has("name", "saturn").properties("age").value().to_list()
+    assert vs == [10000]
+    assert t.V().properties().has_key("age").count() == len(
+        t.V().has("age").to_list()
+    )
+    assert t.V().properties().has_value("saturn").value().to_list() == [
+        "saturn"
+    ]
+    from janusgraph_tpu.core.predicates import Cmp  # noqa: F401
+    from janusgraph_tpu.core.traversal import P
+
+    assert t.V().properties("age").has_value(P.gt(9000)).count() == 1
+    # key()/value() on non-properties raise
+    from janusgraph_tpu.core.traversal import QueryError
+
+    with pytest.raises(QueryError):
+        t.V().key().to_list()
+    # peerPressure computer step: cluster ids are member VERTEX ids
+    clusters = t.V().peer_pressure().values("cluster").to_list()
+    vids = {v.id for v in t.V().to_list()}
+    assert len(clusters) == 12 and set(clusters) <= vids
+    # brothers end up co-clustered with high probability on this tiny
+    # graph; at minimum the key exists for every vertex and is stable
+    again = t.V().peer_pressure().values("cluster").to_list()
+    assert clusters == again
+
+
+def test_map_flatmap_traversal_bodies(g):
+    """map(traversal)/flatMap(traversal) — the only form expressible over
+    the text endpoint (the sandbox rejects lambdas)."""
+    t = g.traversal()
+    names = t.V().has("name", "jupiter").flat_map(
+        __.out("brother")
+    ).values("name").to_list()
+    assert sorted(names) == ["neptune", "pluto"]
+    firsts = t.V().has_label("god").map_(__.values("name")).to_list()
+    assert sorted(firsts) == ["jupiter", "neptune", "pluto"]
+    # map drops traversers whose body yields nothing
+    assert t.V().has_label("monster").map_(
+        __.out("father")
+    ).to_list() == []
