@@ -32,6 +32,14 @@ from janusgraph_tpu.core.schema import IndexDefinition
 from janusgraph_tpu.exceptions import QueryError
 
 
+class Pick(enum.Enum):
+    """TinkerPop branch() option tokens: Pick.none = the default branch
+    (runs when no concrete option matched), Pick.any = always runs."""
+
+    none = "none"
+    any = "any"
+
+
 class T(enum.Enum):
     """TinkerPop structure tokens: the map keys that address an element's
     id/label DISTINCTLY from same-named property keys — merge_v/merge_e
@@ -931,7 +939,12 @@ class GraphTraversal:
         'name'))`` over the text endpoint — whose FIRST result is the
         output (traversers with no result are dropped, the TinkerPop
         map-traversal contract)."""
-        if isinstance(fn, (AnonymousTraversal, GraphTraversal)):
+        if isinstance(fn, GraphTraversal):
+            raise QueryError(
+                "use an anonymous traversal (__) as the body, not an "
+                "executable traversal"
+            )
+        if isinstance(fn, AnonymousTraversal):
             steps = self._sub_steps(fn)
 
             def step(ts):
@@ -939,7 +952,9 @@ class GraphTraversal:
                 for t in ts:
                     hits = self._apply_steps(steps, [t])
                     if hits:
-                        out.append(t.child(hits[0].obj))
+                        out.append(
+                            t.child(hits[0].obj, prev=hits[0].prev)
+                        )
                 return out
 
         else:
@@ -954,14 +969,19 @@ class GraphTraversal:
         Accepts a traversal body (``flatMap(out('knows'))`` — every
         result becomes a traverser) or a python callable returning an
         iterable."""
-        if isinstance(fn, (AnonymousTraversal, GraphTraversal)):
+        if isinstance(fn, GraphTraversal):
+            raise QueryError(
+                "use an anonymous traversal (__) as the body, not an "
+                "executable traversal"
+            )
+        if isinstance(fn, AnonymousTraversal):
             steps = self._sub_steps(fn)
 
             def step(ts):
                 out = []
                 for t in ts:
                     out.extend(
-                        t.child(r.obj)
+                        t.child(r.obj, prev=r.prev)
                         for r in self._apply_steps(steps, [t])
                     )
                 return out
@@ -981,6 +1001,8 @@ class GraphTraversal:
         self._folding = False
         self._last_by = None  # a new step closes the previous by() window
         self._last_repeat = None  # ... and the repeat modulator window
+        self._last_branch = None  # ... and the branch option window
+        self._last_merge = None  # ... and the merge on_create/on_match window
         # label for .profile(): the public step method that registered it
         import sys
 
@@ -1357,7 +1379,6 @@ class GraphTraversal:
         each match (or the one created vertex) continues the traversal."""
         source = self.source
         spec = {"on_create": {}, "on_match": {}}
-        self._last_merge = spec
 
         def step(ts):
             out = []
@@ -1373,6 +1394,7 @@ class GraphTraversal:
             return out
 
         self._add(step, name="mergeV")
+        self._last_merge = spec  # reopen after _add closed the windows
         return self
 
     def merge_e(self, match: Optional[dict] = None) -> "GraphTraversal":
@@ -1380,7 +1402,6 @@ class GraphTraversal:
         the incoming vertex (TinkerPop's incident-vertex default)."""
         source = self.source
         spec = {"on_create": {}, "on_match": {}}
-        self._last_merge = spec
 
         def step(ts):
             out = []
@@ -1397,6 +1418,7 @@ class GraphTraversal:
             return out
 
         self._add(step, name="mergeE")
+        self._last_merge = spec  # reopen after _add closed the windows
         return self
 
     def on_create(self, props: dict) -> "GraphTraversal":
@@ -1437,6 +1459,104 @@ class GraphTraversal:
         self._add(
             lambda ts: [t.child(value) for t in ts], name="constant"
         )
+        return self
+
+    def branch(self, selector) -> "GraphTraversal":
+        """TinkerPop branch(selector).option(value, body)...: the
+        selector (a traversal body or python callable) computes a pick
+        value per traverser; every option registered for that value runs
+        (plus Pick.any options always, and Pick.none options when no
+        concrete option matched). Results of all fired branches
+        concatenate."""
+        selector_steps = (
+            self._sub_steps(selector)
+            if isinstance(selector, AnonymousTraversal)
+            else None
+        )
+        spec = {"options": []}
+
+        def step(ts):
+            compiled = [
+                (pick, self._sub_steps(body))
+                for pick, body in spec["options"]
+            ]
+            if not compiled:
+                raise QueryError("branch() needs at least one option()")
+            out = []
+            for t in ts:
+                if selector_steps is not None:
+                    hits = self._apply_steps(selector_steps, [t])
+                    v = hits[0].obj if hits else None
+                else:
+                    v = selector(t.obj)
+                matched = False
+                fired = []
+                for pick, body_steps in compiled:
+                    if pick is Pick.any or (
+                        not isinstance(pick, Pick) and pick == v
+                    ):
+                        if not isinstance(pick, Pick):
+                            matched = True
+                        fired.append(body_steps)
+                if not matched:
+                    fired.extend(
+                        bs for pick, bs in compiled if pick is Pick.none
+                    )
+                for body_steps in fired:
+                    out.extend(self._apply_steps(body_steps, [t]))
+            return out
+
+        self._add(step, name="branch")
+        self._last_branch = spec  # reopen after _add closed windows
+        return self
+
+    def option(self, pick, body) -> "GraphTraversal":
+        """Register one branch() option (see branch())."""
+        spec = getattr(self, "_last_branch", None)
+        if spec is None:
+            raise QueryError("option() must follow branch()")
+        spec["options"].append((pick, body))
+        return self
+
+    def fail(self, message: str = "fail() step reached") -> "GraphTraversal":
+        """TinkerPop fail(): abort the traversal with an error when any
+        traverser reaches this step."""
+
+        def step(ts):
+            if ts:
+                raise QueryError(message)
+            return ts
+
+        self._add(step, name="fail")
+        return self
+
+    def property_map(self, *keys: str) -> "GraphTraversal":
+        """TinkerPop propertyMap(): like value_map but vertex map values
+        are the VertexProperty objects themselves (meta-properties
+        reachable); edge properties are inline values (no standalone
+        Property object exists for them here). Reads STORED properties
+        only — the transient OLAP overlay holds raw values, not property
+        objects, so it is not surfaced."""
+        tx = self.tx
+
+        def step(ts):
+            out = []
+            for t in ts:
+                if isinstance(t.obj, Vertex):
+                    m: dict = {}
+                    for p in tx.get_properties(t.obj, *keys):
+                        m.setdefault(p.key, []).append(p)
+                    out.append(t.child(m, prev=t.prev))
+                elif isinstance(t.obj, Edge):
+                    pv = t.obj.property_values()
+                    out.append(t.child(
+                        {k: v for k, v in pv.items()
+                         if not keys or k in keys},
+                        prev=t.prev,
+                    ))
+            return out
+
+        self._add(step, name="propertyMap")
         return self
 
     def loops(self) -> "GraphTraversal":

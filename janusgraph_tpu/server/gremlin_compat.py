@@ -64,6 +64,7 @@ STEP_MAP = {
     "hasValue": "has_value",
     "flatMap": "flat_map",
     "map": "map_",
+    "propertyMap": "property_map",
 }
 
 #: step names that collide with structure-token attributes (T.id): only
@@ -140,11 +141,13 @@ def compat_namespace() -> dict:
         AnonymousTraversal,
         GraphTraversal,
         P,
+        Pick,
         T,
     )
 
     anon = AnonymousTraversal()
-    ns = {"P": P, "__": anon, "T": T, "Direction": Direction}
+    ns = {"P": P, "__": anon, "T": T, "Direction": Direction,
+          "Pick": Pick}
     for gname, pname in PREDICATE_MAP.items():
         ns[gname] = getattr(P, pname)
     # every public GraphTraversal step, under BOTH spellings (the recorder

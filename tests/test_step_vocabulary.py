@@ -819,3 +819,63 @@ def test_map_flatmap_traversal_bodies(g):
     assert t.V().has_label("monster").map_(
         __.out("father")
     ).to_list() == []
+
+
+def test_branch_option_fail_property_map(g):
+    """branch().option() multiway dispatch with Pick tokens; fail();
+    propertyMap() with VertexProperty values."""
+    from janusgraph_tpu.core.traversal import Pick, QueryError
+
+    t = g.traversal()
+    got = (
+        t.V().has_label("god")
+        .branch(__.values("name"))
+        .option("jupiter", __.out("brother").values("name"))
+        .option(Pick.none, __.constant("other-god"))
+        .to_list()
+    )
+    assert sorted(got) == ["neptune", "other-god", "other-god", "pluto"]
+    # Pick.any fires alongside the matched option
+    got2 = (
+        t.V().has("name", "jupiter")
+        .branch(__.label())
+        .option("god", __.constant("matched"))
+        .option(Pick.any, __.constant("always"))
+        .to_list()
+    )
+    assert sorted(got2) == ["always", "matched"]
+    # fail()
+    with pytest.raises(QueryError, match="no monsters allowed"):
+        t.V().has_label("monster").fail("no monsters allowed").to_list()
+    assert t.V().has_label("nosuchlabel").fail().to_list() == []
+    # propertyMap: values are VertexProperty objects (meta reachable)
+    pm = t.V().has("name", "saturn").property_map("name").next()
+    from janusgraph_tpu.core.elements import VertexProperty
+
+    assert isinstance(pm["name"][0], VertexProperty)
+    assert pm["name"][0].value == "saturn"
+
+
+def test_step_window_and_prev_regressions(g):
+    """Review repros: prev survives map_/flat_map; property_map handles
+    edges; misplaced merge modulators raise; GraphTraversal args raise
+    cleanly."""
+    from janusgraph_tpu.core.traversal import QueryError, T
+
+    t = g.traversal()
+    names = (
+        t.V().has("name", "jupiter").out_e("brother")
+        .flat_map(__.identity()).other_v().values("name").to_list()
+    )
+    assert sorted(names) == ["neptune", "pluto"]
+    em = t.V().has("name", "hercules").out_e("battled").property_map(
+    ).to_list()
+    assert em and all("time" in m for m in em)
+    # a step between merge and its modulator closes the window
+    with pytest.raises(QueryError, match="must follow"):
+        t.merge_v({T.label: "god", "name": "x"}).identity().on_create(
+            {"age": 1}
+        )
+    # non-anonymous traversal argument is a clean type error
+    with pytest.raises((QueryError, TypeError)):
+        t.V().map_(t.V()).to_list()
