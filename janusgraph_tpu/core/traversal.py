@@ -838,7 +838,7 @@ class GraphTraversal:
         self._folding = True  # still collecting leading has() steps
         self._last_by: Optional[List] = None  # open by() modulator window
         self._side_effects: Dict[str, List] = {}  # aggregate()/cap() buckets
-        #: transient OLAP-bridge results {(vid, key): value} — per
+        #: transient OLAP-bridge results {vid: {key: value}} — per
         #: TRAVERSAL (sub-traversal bodies share the root's dict via
         #: _sub_steps); never written to the tx, schema, or source
         self._olap_overlay: Dict = {}
@@ -1833,9 +1833,9 @@ class GraphTraversal:
         see it."""
         ov = self._olap_overlay
         if ov and isinstance(obj, Vertex):
-            k = (obj.id, key)
-            if k in ov:
-                return True, ov[k]
+            per = ov.get(obj.id)
+            if per is not None and key in per:
+                return True, per[key]
         return False, None
 
     def _overlay_items(self, obj, keys=()):
@@ -1852,9 +1852,8 @@ class GraphTraversal:
                 if hit:
                     out.append((k, val))
             return out
-        return [
-            (k, val) for (vid, k), val in ov.items() if vid == obj.id
-        ]
+        per = ov.get(obj.id)
+        return list(per.items()) if per else []
 
     def _elem_val(self, t, key):
         hit, val = self._overlay_get(t.obj, key)
@@ -2522,7 +2521,7 @@ class GraphTraversal:
                 }
             ov = self._olap_overlay
             for vid, val in by_vid.items():
-                ov[(vid, key)] = val
+                ov.setdefault(vid, {})[key] = val
             return ts
 
         self._add(step, name=name)

@@ -92,7 +92,6 @@ def translate(text: str) -> str:
     """Rewrite Gremlin-dialect step names to the DSL. Token-level: string
     literals and python-named queries are untouched."""
     out = []
-    prev_significant = None
     try:
         tokens = list(
             tokenize.generate_tokens(io.StringIO(text).readline)
@@ -119,11 +118,6 @@ def translate(text: str) -> str:
             )
             if nxt is not None and nxt[1] == "(":
                 string = CALL_ONLY_STEP_MAP[string]
-        if ttype not in (
-            token_mod.NL, token_mod.NEWLINE, token_mod.INDENT,
-            token_mod.DEDENT, tokenize.COMMENT,
-        ):
-            prev_significant = string
         out.append((ttype, string))
     try:
         return tokenize.untokenize(out)
