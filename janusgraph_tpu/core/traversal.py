@@ -330,7 +330,14 @@ class GraphTraversalSource:
         V().has()), or create one from the map if none match. on_create()
         extends the creation map; on_match() sets properties on matched
         vertices. The declarative spelling of the
-        ``fold().coalesce(unfold(), add_v_())`` upsert idiom."""
+        ``fold().coalesce(unfold(), add_v_())`` upsert idiom.
+
+        Concurrency: like the reference, merge does NOT serialize racing
+        upserts by itself — two overlapping transactions can both miss
+        and both create. Guard the merge key with a UNIQUE composite
+        index (+ its consistent-key lock): the second commit then fails
+        with SchemaViolationError and a retry matches (see
+        tests/test_merge_steps.py::test_merge_v_race_unique_index)."""
         start = _start_merge_vertex(self, dict(match))
         t = GraphTraversal(self, start)
         t._last_merge = start.spec

@@ -302,3 +302,32 @@ def test_has_id_accepts_relation_identifier(g):
     rid = t.E(e.identifier).id_().next()
     assert t.E().has_id(rid).next().id == e.id
     assert t.E().has_id(e).next().id == e.id
+
+
+def test_merge_v_race_unique_index():
+    """Racing upserts: both transactions miss and create; a UNIQUE
+    composite index refuses the second commit (the reference's guard),
+    and the loser's retry matches the winner."""
+    from janusgraph_tpu.exceptions import SchemaViolationError
+
+    g = open_graph({"ids.authority-wait-ms": 0.0})
+    mgmt = g.management()
+    mgmt.make_property_key("name", str)
+    mgmt.make_vertex_label("user")
+    mgmt.build_composite_index("byName", ["name"], unique=True)
+    try:
+        t1, t2 = g.traversal(), g.traversal()
+        t1.merge_v({T.label: "user", "name": "alice"}).next()
+        t2.merge_v({T.label: "user", "name": "alice"}).next()
+        t1.commit()
+        with pytest.raises(SchemaViolationError, match="unique"):
+            t2.commit()
+        # the loser retries in a fresh tx and MATCHES the winner's vertex
+        winner = g.traversal().V().has("name", "alice").next()
+        retry = g.traversal().merge_v(
+            {T.label: "user", "name": "alice"}
+        ).next()
+        assert retry.id == winner.id
+        assert len(g.traversal().V().has("name", "alice").to_list()) == 1
+    finally:
+        g.close()
