@@ -9,6 +9,7 @@ graphdb/JanusGraphTest.java's wide mutation/read matrix)."""
 import random
 
 from janusgraph_tpu.core.codecs import Direction
+from janusgraph_tpu.core.traversal import GraphTraversalSource
 from janusgraph_tpu.core.graph import open_graph
 from janusgraph_tpu.storage.inmemory import InMemoryStoreManager
 
@@ -22,6 +23,9 @@ def _check(graph, model):
             assert v.value(k) == val, (vid, k)
     for vid in model["removed"]:
         assert tx.get_vertex(vid) is None, f"vertex {vid} resurrected"
+    # EXACT vertex-set equality: a mutation that silently creates a
+    # phantom vertex (e.g. a merge that matches AND creates) must diverge
+    assert {v.id for v in tx.vertices()} == set(model["vertices"])
     # edge sets per vertex (as (label, other) multisets)
     for vid in model["vertices"]:
         want = sorted(
@@ -104,10 +108,6 @@ def test_fuzz_mutations_match_oracle():
             )
             if committed_pair and rng.random() < 0.4:
                 # round-5 AddEdgeStep path through the DSL
-                from janusgraph_tpu.core.traversal import (
-                    GraphTraversalSource,
-                )
-
                 vb = live_handles.get(b) or tx.get_vertex(b)
                 GraphTraversalSource(graph, tx).V(a).add_e_(lbl).to_(
                     vb
@@ -126,10 +126,6 @@ def test_fuzz_mutations_match_oracle():
             else:
                 # round-5 PropertyStep path: mutate COMMITTED vertices
                 # through the traversal DSL inside the SAME fuzz tx
-                from janusgraph_tpu.core.traversal import (
-                    GraphTraversalSource,
-                )
-
                 GraphTraversalSource(graph, tx).V(vid).property(
                     k, val
                 ).iterate()
@@ -139,7 +135,7 @@ def test_fuzz_mutations_match_oracle():
             v = live_handles.get(vid) or tx.get_vertex(vid)
             tx.remove_vertex(v)
             pending["removed_v"].add(vid)
-        elif op < 0.90:
+        elif op < 0.88:
             # remove one committed edge through a loaded handle
             committed = [
                 e for e in model["edges"]
@@ -155,6 +151,28 @@ def test_fuzz_mutations_match_oracle():
                         tx.remove_edge(e)
                         pending["removed_e"].append((src, lbl, dst))
                         break
+        elif op < 0.94:
+            # round-5 merge_v upsert through the DSL: the model does the
+            # SAME find-or-create over its tx-visible view
+            uk = rng.randint(0, 19)
+            visible = {}
+            for vid in vertex_pool():
+                props = dict(model["vertices"].get(vid, {}))
+                props.update(pending["vertices"].get(vid, {}))
+                visible[vid] = props
+            expect_match = [
+                vid for vid, props in visible.items()
+                if props.get("uk") == uk
+            ]
+            got = GraphTraversalSource(graph, tx).merge_v(
+                {"uk": uk}
+            ).to_list()
+            if expect_match:
+                assert sorted(v.id for v in got) == sorted(expect_match)
+            else:
+                assert len(got) == 1
+                pending["vertices"][got[0].id] = {"uk": uk}
+                live_handles[got[0].id] = got[0]
         else:
             commit()
     commit()
