@@ -90,15 +90,20 @@ class JanusGraphClient:
             return json.loads(resp.read()).get("status") == "ok"
 
     # ------------------------------------------------------------ WebSocket
-    def ws(self) -> "WebSocketSession":
-        return WebSocketSession(self)
+    def ws(self, session: bool = False) -> "WebSocketSession":
+        """Open a persistent WS connection; session=True switches it to
+        the server's in-session mode (one transaction spans submits until
+        the query commits — g.commit() — or the connection closes, which
+        rolls back)."""
+        return WebSocketSession(self, session=session)
 
 
 class WebSocketSession:
     """Persistent WS connection; submit() round-trips one JSON request."""
 
-    def __init__(self, client: JanusGraphClient):
+    def __init__(self, client: JanusGraphClient, session: bool = False):
         self.client = client
+        self.session = session
         self.sock = socket.create_connection((client.host, client.port))
         key = base64.b64encode(os.urandom(16)).decode()
         auth = client._auth_header()
@@ -123,7 +128,10 @@ class WebSocketSession:
             raise ConnectionError(f"ws upgrade rejected: {status_line}")
 
     def submit(self, gremlin: str, graph: Optional[str] = None) -> Any:
-        self._send(json.dumps({"gremlin": gremlin, "graph": graph}))
+        req = {"gremlin": gremlin, "graph": graph}
+        if self.session:
+            req["session"] = True
+        self._send(json.dumps(req))
         payload = json.loads(self._recv())
         status = payload.get("status", {})
         if status.get("code") != 200:

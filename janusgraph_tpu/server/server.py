@@ -163,17 +163,22 @@ class JanusGraphServer:
                 ns[f"g_{other}"] = og.traversal()
         return ns
 
-    def execute(self, query: str, graph_name: Optional[str] = None):
-        from janusgraph_tpu.core.traversal import GraphTraversalSource
+    def _prepare(self, query: str) -> str:
+        """Shared request preamble: length guard + dialect translation
+        (one implementation for the sessionless and in-session paths)."""
+        from janusgraph_tpu.server.gremlin_compat import translate
 
         if len(query) > self.max_query_length:
             raise QueryTooLongError(
                 f"query length {len(query)} exceeds server.max-query-length "
                 f"({self.max_query_length})"
             )
-        from janusgraph_tpu.server.gremlin_compat import translate
+        return translate(query)  # Gremlin dialect -> DSL (lexical only)
 
-        query = translate(query)  # Gremlin dialect -> DSL (lexical only)
+    def execute(self, query: str, graph_name: Optional[str] = None):
+        from janusgraph_tpu.core.traversal import GraphTraversalSource
+
+        query = self._prepare(query)
         ns = self._namespace(query, graph_name)
         ok = False
         try:
@@ -193,6 +198,59 @@ class JanusGraphServer:
                         v.tx.commit()
                     else:
                         v.tx.rollback()
+
+    # ------------------------------------------------------------- sessions
+    def open_session(self) -> dict:
+        """State for one in-session WS connection (the reference Gremlin
+        Server's session mode): namespaces (one per referenced graph)
+        persist across messages, so ONE transaction spans requests until
+        the query itself commits (`g.commit()`) or rolls back — no
+        per-request auto-commit. Close with close_session."""
+        return {}
+
+    def execute_session(
+        self, query: str, graph_name: Optional[str], session: dict
+    ):
+        if not self.auto_commit:
+            # server.auto-commit=false is the READ-ONLY endpoint mode;
+            # a session's explicit g.commit() would bypass it
+            raise PermissionError(
+                "sessions are disabled on a read-only endpoint "
+                "(server.auto-commit=false)"
+            )
+        query = self._prepare(query)
+        # ONE traversal source (= one transaction) per GRAPH for the whole
+        # session, however the graph is addressed (default, the graph
+        # request field, or a g_<name> reference in any later message) —
+        # the namespace is rebuilt per message, the sources persist
+        sources = session.setdefault("_sources", {})
+
+        def source_of(name):
+            if name not in sources:
+                graph = self.manager.get_graph(name)
+                if graph is None:
+                    raise KeyError(f"graph {name!r} not registered")
+                sources[name] = graph.traversal()
+            return sources[name]
+
+        from janusgraph_tpu.server.gremlin_compat import compat_namespace
+
+        ns = compat_namespace()
+        ns["g"] = source_of(graph_name or self.default_graph)
+        for other in set(re.findall(r"\bg_([A-Za-z0-9]\w*)", query)):
+            if self.manager.get_graph(other) is not None:
+                ns[f"g_{other}"] = source_of(other)
+        return _evaluate(query, ns)
+
+    def close_session(self, session: dict) -> None:
+        """Roll back every open session transaction (connection closed
+        without commit — the reference's session close semantics)."""
+        for src in session.get("_sources", {}).values():
+            try:
+                src.tx.rollback()
+            except Exception:  # noqa: BLE001 - already closed
+                pass
+        session.clear()
 
     def authenticate_request(self, headers) -> Optional[str]:
         """Returns username, or raises. None when auth is disabled."""
@@ -235,11 +293,16 @@ class _Handler(BaseHTTPRequestHandler):
             self._send_json(401, {"status": {"code": 401, "message": str(e)}})
             return False
 
-    def _run_request(self, req: dict) -> dict:
+    def _run_request(self, req: dict, session: Optional[dict] = None) -> dict:
         query = req.get("gremlin", "")
         graph = req.get("graph")
         try:
-            result = self.jg_server.execute(query, graph)
+            if session is not None:
+                result = self.jg_server.execute_session(
+                    query, graph, session
+                )
+            else:
+                result = self.jg_server.execute(query, graph)
             data = json.loads(graphson_dumps(result))
             return {"result": {"data": data}, "status": {"code": 200}}
         except QueryTooLongError as e:
@@ -323,6 +386,11 @@ class _Handler(BaseHTTPRequestHandler):
         self.send_header("Sec-WebSocket-Accept", accept)
         self.end_headers()
         sock = self.connection
+        # session mode (the reference's in-session requests): any message
+        # carrying a truthy "session" field switches this CONNECTION to a
+        # shared-transaction session; the tx spans messages until the
+        # query commits/rolls back, and a close without commit rolls back
+        session = None
         try:
             while True:
                 msg = _ws_recv(sock, self.jg_server.max_request_bytes)
@@ -335,9 +403,16 @@ class _Handler(BaseHTTPRequestHandler):
                         {"status": {"code": 400, "message": "bad json"}}
                     ))
                     continue
-                _ws_send(sock, json.dumps(self._run_request(req)))
+                if req.get("session") and session is None:
+                    session = self.jg_server.open_session()
+                _ws_send(sock, json.dumps(
+                    self._run_request(req, session=session)
+                ))
         except (ConnectionError, OSError):
             pass
+        finally:
+            if session is not None:
+                self.jg_server.close_session(session)
         self.close_connection = True
 
 

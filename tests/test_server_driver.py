@@ -722,3 +722,66 @@ def test_server_auto_commit_and_read_only_mode(gods_graph, manager):
     ro = JanusGraphServer(manager=manager, auto_commit=False)
     ro.execute("g.addV('person').property('name','volatile').iterate()")
     assert ro.execute("g.V().has('name','volatile').count()") == 0
+
+
+def test_ws_session_transaction_semantics(server):
+    """In-session WS requests share ONE transaction (the reference
+    Gremlin Server's session mode): uncommitted writes are visible to
+    later session requests but not to sessionless ones; g.commit()
+    persists; a close without commit rolls back."""
+    client = JanusGraphClient(port=server.port)
+    ws = client.ws(session=True)
+    try:
+        ws.submit("g.addV('person').property('name','sess1').iterate()")
+        # visible in-session, invisible sessionless (uncommitted)
+        assert ws.submit("g.V().has('name','sess1').count()") == 1
+        assert client.submit("g.V().has('name','sess1').count()") == 0
+        ws.submit("g.commit()")
+        assert client.submit("g.V().has('name','sess1').count()") == 1
+        # a second uncommitted write rolls back on close
+        ws.submit("g.addV('person').property('name','sess2').iterate()")
+        assert ws.submit("g.V().has('name','sess2').count()") == 1
+    finally:
+        ws.close()
+    import time
+
+    for _ in range(50):  # close is async on the server thread
+        if client.submit("g.V().has('name','sess2').count()") == 0:
+            break
+        time.sleep(0.05)
+    assert client.submit("g.V().has('name','sess2').count()") == 0
+    assert client.submit("g.V().has('name','sess1').count()") == 1
+
+
+def test_ws_session_read_only_and_cross_graph(manager, gods_graph):
+    """Review regressions: read-only endpoints refuse sessions (explicit
+    g.commit() would bypass the guarantee); later session messages may
+    reference g_<name> sources the first message didn't."""
+    other = open_graph({"ids.authority-wait-ms": 0.0})
+    manager.put_graph("other", other)
+    srv = JanusGraphServer(manager=manager).start()
+    try:
+        client = JanusGraphClient(port=srv.port)
+        ws = client.ws(session=True)
+        try:
+            assert ws.submit("g.V().count()") == 12
+            # a LATER message referencing g_other still resolves
+            assert ws.submit("g_other.V().count()") == 0
+        finally:
+            ws.close()
+    finally:
+        srv.stop()
+
+    ro = JanusGraphServer(manager=manager, auto_commit=False).start()
+    try:
+        from janusgraph_tpu.driver.client import RemoteError
+
+        ws = JanusGraphClient(port=ro.port).ws(session=True)
+        try:
+            with pytest.raises(RemoteError, match="read-only"):
+                ws.submit("g.V().count()")
+        finally:
+            ws.close()
+    finally:
+        ro.stop()
+        other.close()
