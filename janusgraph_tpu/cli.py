@@ -103,8 +103,14 @@ def cmd_console(args) -> int:
             f"{args.remote}"
         )
     else:
+        from janusgraph_tpu.core.codecs import Direction
         from janusgraph_tpu.core.graph import open_graph
-        from janusgraph_tpu.core.traversal import P, __ as _anon
+        from janusgraph_tpu.core.traversal import (
+            P,
+            Pick,
+            T,
+            __ as _anon,
+        )
 
         graph = open_graph(_load_config(args.config))
         if args.load_gods:
@@ -113,6 +119,7 @@ def cmd_console(args) -> int:
             gods.load(graph)
         ns.update({
             "graph": graph, "g": graph.traversal(), "P": P, "__": _anon,
+            "T": T, "Direction": Direction, "Pick": Pick,
         })
     code.interact(banner=banner, local=ns)
     return 0
