@@ -6,6 +6,7 @@ model; after every commit the committed state must match the model exactly.
 This is the breadth-style complement to the targeted suites (reference:
 graphdb/JanusGraphTest.java's wide mutation/read matrix)."""
 
+import pytest
 import random
 
 from janusgraph_tpu.core.codecs import Direction
@@ -41,8 +42,9 @@ def _check(graph, model):
     tx.rollback()
 
 
-def test_fuzz_mutations_match_oracle():
-    rng = random.Random(20260730)
+@pytest.mark.parametrize("seed", [20260730, 7, 424242])
+def test_fuzz_mutations_match_oracle(seed):
+    rng = random.Random(seed)
     mgr = InMemoryStoreManager()
     graph = open_graph(store_manager=mgr)
     m = graph.management()
