@@ -358,6 +358,14 @@ class GraphTraversalSource:
         values — ``g.inject(1, 2).map_(...)`` shapes."""
         return GraphTraversal(self, _start_inject(self, values))
 
+    def io(self, path: str) -> "_IoStep":
+        """TinkerPop IoStep spelling: ``g.io('graph.json').read()`` /
+        ``.write()`` — format inferred from the extension (.xml/.graphml
+        -> graphml, else graphson), overridable with ``.with_('graphml')``.
+        Delegates to graph.io() (core/io.py); read/write execute
+        immediately, like iterate()d Io traversals."""
+        return _IoStep(self.graph, path)
+
     def commit(self) -> None:
         self.tx.commit()
         self.tx = self.graph.new_transaction()
@@ -365,6 +373,29 @@ class GraphTraversalSource:
     def rollback(self) -> None:
         self.tx.rollback()
         self.tx = self.graph.new_transaction()
+
+
+class _IoStep:
+    """g.io(path).read()/.write() — the TinkerPop IoStep spelling over
+    the graph.io() facade."""
+
+    def __init__(self, graph, path: str):
+        self._graph = graph
+        self._path = path
+        lower = path.lower()
+        self._format = (
+            "graphml" if lower.endswith((".xml", ".graphml")) else "graphson"
+        )
+
+    def with_(self, format: str) -> "_IoStep":
+        self._format = format
+        return self
+
+    def read(self) -> dict:
+        return self._graph.io(self._format).read(self._path)
+
+    def write(self) -> dict:
+        return self._graph.io(self._format).write(self._path)
 
 
 # ---------------------------------------------------------------- start steps

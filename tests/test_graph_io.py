@@ -369,3 +369,30 @@ def test_graphml_review_regressions(tmp_path):
     with pytest.raises(ValueError, match="repeats key"):
         import_graphml(g3, _io.BytesIO(dup.encode()))
     g3.close()
+
+
+def test_traversal_io_step_spelling(tmp_path):
+    """g.io(path).read()/.write(): the TinkerPop IoStep spelling over the
+    graph.io() facade; format inferred from the extension."""
+    import pytest
+
+    from janusgraph_tpu.core import gods
+    from janusgraph_tpu.core.graph import open_graph
+
+    g = open_graph({"ids.authority-wait-ms": 0.0})
+    gods.load(g)
+    t = g.traversal()
+    out = str(tmp_path / "gods.json")
+    counts = t.io(out).write()
+    assert counts["vertices"] == 12
+    # graphml inferred from the extension — gods carries Geoshapes,
+    # which GraphML (primitives only) refuses, proving the format took
+    xml = str(tmp_path / "gods.xml")
+    with pytest.raises(ValueError, match="GraphML"):
+        t.io(xml).write()
+
+    g2 = open_graph({"ids.authority-wait-ms": 0.0})
+    got = g2.traversal().io(out).read()
+    assert got["vertices"] == 12
+    assert g2.traversal().V().count() == 12
+    g.close(); g2.close()
