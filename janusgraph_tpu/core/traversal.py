@@ -1186,6 +1186,23 @@ class GraphTraversal:
         )
         return self
 
+    def element(self) -> "GraphTraversal":
+        """TinkerPop element(): property traverser -> its owning element."""
+
+        def step(ts):
+            out = []
+            for t in ts:
+                if not isinstance(t.obj, VertexProperty):
+                    raise QueryError(
+                        "element() requires property traversers "
+                        f"(got {type(t.obj).__name__})"
+                    )
+                out.append(t.child(t.obj.vertex, prev=t.prev))
+            return out
+
+        self._add(step, name="element")
+        return self
+
     def key(self) -> "GraphTraversal":
         """TinkerPop key(): property traverser -> its key string."""
 
@@ -3034,6 +3051,11 @@ class GraphTraversal:
 
     def to_set(self) -> set:
         return set(self.to_list())
+
+    def to_bulk_set(self):
+        """TinkerPop toBulkSet(): results with multiplicity — a Counter
+        keyed by result object."""
+        return Counter(self.to_list())
 
     def next(self):
         res = self._execute()

@@ -51,6 +51,7 @@ STEP_MAP = {
     "tryNext": "try_next",
     "toList": "to_list",
     "toSet": "to_set",
+    "toBulkSet": "to_bulk_set",
     "withSack": "with_sack",
     "mergeV": "merge_v",
     "mergeE": "merge_e",

@@ -879,3 +879,11 @@ def test_step_window_and_prev_regressions(g):
     # non-anonymous traversal argument is a clean type error
     with pytest.raises((QueryError, TypeError)):
         t.V().map_(t.V()).to_list()
+
+
+def test_to_bulk_set_and_element(g):
+    t = g.traversal()
+    bulk = t.V().out("brother").values("name").to_bulk_set()
+    assert bulk["jupiter"] == 2  # two brothers point back at jupiter
+    owners = t.V().properties("age").element().dedup().count()
+    assert owners == len(t.V().has("age").to_list())
