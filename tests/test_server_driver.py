@@ -785,3 +785,26 @@ def test_ws_session_read_only_and_cross_graph(manager, gods_graph):
     finally:
         ro.stop()
         other.close()
+
+
+def test_ws_session_merge_upsert_flow(server):
+    """Round-5 features composed: mergeV upserts inside ONE session
+    transaction — intermediate state visible in-session only, one commit
+    persists the batch atomically."""
+    client = JanusGraphClient(port=server.port)
+    ws = client.ws(session=True)
+    try:
+        for name in ("minerva", "vulcan", "minerva"):  # dup merges once
+            ws.submit(
+                "g.mergeV({T.label: 'god', 'name': '%s'})"
+                ".onCreate({'age': 1}).iterate()" % name
+            )
+        assert ws.submit(
+            "g.V().hasLabel('god').has('age', 1).count()") == 2
+        assert client.submit(
+            "g.V().hasLabel('god').has('age', 1).count()") == 0
+        ws.submit("g.commit()")
+    finally:
+        ws.close()
+    assert client.submit("g.V().hasLabel('god').has('age', 1).count()") == 2
+    assert client.submit("g.V().has('name','minerva').count()") == 1
