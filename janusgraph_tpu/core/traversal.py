@@ -79,11 +79,15 @@ class P:
         eq_value=None,
         predicate=None,
         condition=None,
+        in_values=None,
     ):
         self.test = test
         self.label = label
         #: set when the predicate is a plain equality — index-foldable
         self.eq_value = eq_value
+        #: set for within(): the finite value set — index-foldable as a
+        #: UNION of point lookups (the reference's Contain.IN handling)
+        self.in_values = in_values
         #: structured predicate for mixed-index pushdown (None = opaque)
         self.predicate = predicate
         self.condition = condition
@@ -152,7 +156,10 @@ class P:
     @staticmethod
     def within(*vs) -> "P":
         s = set(vs)
-        return P(lambda x: x in s, f"within{tuple(vs)!r}")
+        return P(
+            lambda x: x in s, f"within{tuple(vs)!r}",
+            in_values=tuple(dict.fromkeys(vs)),  # deduped, order kept
+        )
 
     @staticmethod
     def without(*vs) -> "P":
@@ -663,27 +670,60 @@ class _start_vertices:
                 )
             self.plan = {"access": "unknown-key", "keys": unknown}
             return []
-        # index folding: find a composite index fully covered by eq conditions
-        eqs = {
-            key: p.eq_value
-            for key, p in has_conditions
-            if p.eq_value is not None and key is not None
-        }
+        # index folding: find a composite index fully covered by eq (one
+        # value) or within (a finite value set) conditions — within folds
+        # as a UNION of point lookups, the reference's Contain.IN handling
+        # (GraphCentricQueryBuilder constraints2Indexes), capped so a huge
+        # IN-list degrades to the scan instead of exploding combinations
+        cands: Dict[str, list] = {}
+        for key, p in has_conditions:
+            if key is None:
+                continue
+            if p.eq_value is not None:
+                # an eq ALWAYS narrows: it overrides a within() on the
+                # same key (their conjunction is at most that one value)
+                cands[key] = [p.eq_value]
+            elif p.in_values is not None and key not in cands:
+                cands[key] = list(p.in_values)
         # label equality (if any) gates label-constrained indexes
         label_eq = None
         for key, p in has_conditions:
             if key is None and p.eq_value is not None:
                 label_eq = p.eq_value
-        idx = _select_index(self.source.graph, eqs, label_eq)
-        if idx is not None:
-            self.plan = {"access": "composite-index", "index": idx.name}
+        for idx in _covered_indexes(self.source.graph, cands, label_eq):
             names = [
                 self.source.graph.schema_cache.get_by_id(k).name
                 for k in idx.key_ids
             ]
-            vids = self.source.graph.index_lookup(
-                tx, idx.name, [eqs[n] for n in names]
-            )
+            # cap decided ARITHMETICALLY (materializing a huge cartesian
+            # just to reject it would be the blowup the cap prevents);
+            # over-cap: try the next (narrower) covered index
+            n_combos = 1
+            for n in names:
+                n_combos *= len(cands[n])
+            if n_combos > 64:
+                continue
+            import itertools
+
+            combos = itertools.product(*[cands[n] for n in names])
+            self.plan = {
+                "access": (
+                    "composite-index" if n_combos == 1
+                    else "composite-index-union"
+                ),
+                "index": idx.name,
+            }
+            if n_combos > 1:
+                self.plan["point_lookups"] = n_combos
+            seen = set()
+            vids = []
+            for combo in combos:
+                for vid in self.source.graph.index_lookup(
+                    tx, idx.name, list(combo)
+                ):
+                    if vid not in seen:
+                        seen.add(vid)
+                        vids.append(vid)
             return _index_hits_with_tx_overlay(tx, vids, has_conditions)
         # mixed-index folding: push supported predicate conditions down to an
         # IndexProvider (reference: GraphCentricQueryBuilder index selection
@@ -789,8 +829,11 @@ def _select_mixed_index(graph, has_conditions, label_eq=None):
     return best
 
 
-def _select_index(graph, eqs: dict, label_eq=None) -> Optional[IndexDefinition]:
-    best = None
+def _covered_indexes(graph, eqs: dict, label_eq=None) -> list:
+    """Every ENABLED composite index whose keys the conditions cover,
+    WIDEST first (the caller may skip a wide index whose within-cartesian
+    exceeds the point-lookup cap in favor of a narrower covered one)."""
+    out = []
     for idx in graph.indexes.values():
         if idx.mixed or idx.status != "ENABLED":
             continue  # exact-row lookups on ENABLED composite indexes only
@@ -807,9 +850,14 @@ def _select_index(graph, eqs: dict, label_eq=None) -> Optional[IndexDefinition]:
         if len(names) != len(idx.key_ids):
             continue
         if all(n in eqs for n in names):
-            if best is None or len(idx.key_ids) > len(best.key_ids):
-                best = idx
-    return best
+            out.append(idx)
+    out.sort(key=lambda i: len(i.key_ids), reverse=True)
+    return out
+
+
+def _select_index(graph, eqs: dict, label_eq=None) -> Optional[IndexDefinition]:
+    covered = _covered_indexes(graph, eqs, label_eq)
+    return covered[0] if covered else None
 
 
 def _element_value(t: Traverser, key: str, tx):

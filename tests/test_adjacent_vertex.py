@@ -107,3 +107,112 @@ def test_both_direction_adjacency(g):
     )
     # jupiter-brother-neptune exists in both orientations
     assert len(edges) == 2
+
+
+# ----------------------------------------------- within() index-union fold
+def test_within_folds_to_index_union():
+    """P.within on composite-index keys folds to a UNION of point lookups
+    (the reference's Contain.IN handling) instead of a full scan —
+    including multi-key cartesians, tx-overlay visibility, the combo cap
+    degrading to a scan, and query.force-index acceptance."""
+    from janusgraph_tpu.core.graph import open_graph
+    from janusgraph_tpu.core.traversal import P
+
+    g = open_graph({"ids.authority-wait-ms": 0.0})
+    mgmt = g.management()
+    mgmt.make_property_key("city", str)
+    mgmt.make_property_key("tier", int)
+    mgmt.build_composite_index("byCityTier", ["city", "tier"])
+    t = g.traversal()
+    for city in ("sf", "nyc", "ber"):
+        for tier in (1, 2):
+            t.tx.add_vertex(city=city, tier=tier)
+    t.commit()
+
+    q = g.traversal().V().has("city", P.within("sf", "ber")).has("tier", 1)
+    got = {(v.value("city"), v.value("tier")) for v in q.to_list()}
+    assert got == {("sf", 1), ("ber", 1)}
+    prof = (
+        g.traversal().V()
+        .has("city", P.within("sf", "ber")).has("tier", 1).profile()
+    )
+    assert "composite-index-union" in str(prof)
+    assert "point_lookups=2" in str(prof)
+
+    # cartesian across two within conditions
+    q2 = (
+        g.traversal().V()
+        .has("city", P.within("sf", "nyc")).has("tier", P.within(1, 2))
+    )
+    assert len(q2.to_list()) == 4
+
+    # tx overlay: an uncommitted matching vertex appears in union results
+    t2 = g.traversal()
+    t2.tx.add_vertex(city="sf", tier=1)
+    assert len(
+        t2.V().has("city", P.within("sf")).has("tier", 1).to_list()
+    ) == 2
+
+    # a huge IN-list degrades to the scan path (combo cap), still correct
+    many = [f"c{i}" for i in range(100)] + ["sf"]
+    prof3 = g.traversal().V().has(
+        "city", P.within(*many)
+    ).has("tier", 1).profile()
+    assert "full-scan" in str(prof3)
+    assert len(
+        g.traversal().V().has("city", P.within(*many)).has("tier", 1)
+        .to_list()
+    ) == 1
+    g.close()
+
+    # review regressions: eq narrows a same-key within back to a single
+    # point lookup even past the combo cap
+    g3 = open_graph({"ids.authority-wait-ms": 0.0})
+    m3 = g3.management()
+    m3.make_property_key("city", str)
+    m3.build_composite_index("byCity", ["city"])
+    t3 = g3.traversal()
+    t3.tx.add_vertex(city="sf")
+    t3.commit()
+    many_c = [f"z{i}" for i in range(80)] + ["sf"]
+    prof_eq = g3.traversal().V().has(
+        "city", P.within(*many_c)
+    ).has("city", "sf").profile()
+    assert "access=composite-index," in str(prof_eq).replace("  ", " ")
+    # duplicates in within() dedup before planning
+    prof_dup = g3.traversal().V().has(
+        "city", P.within(*(["sf", "oak"] * 40))
+    ).profile()
+    assert "point_lookups=2" in str(prof_dup)
+    g3.close()
+
+    # over-cap on a WIDE index falls back to a narrower covered index
+    g4 = open_graph({"ids.authority-wait-ms": 0.0})
+    m4 = g4.management()
+    m4.make_property_key("a", str)
+    m4.make_property_key("b", int)
+    m4.build_composite_index("byAB", ["a", "b"])
+    m4.build_composite_index("byA", ["a"])
+    t4 = g4.traversal()
+    t4.tx.add_vertex(a="x", b=1)
+    t4.commit()
+    prof_n = g4.traversal().V().has("a", P.within("x", "y")).has(
+        "b", P.within(*range(60))
+    ).profile()
+    assert "index=byA" in str(prof_n)  # byAB would be 120 combos
+    g4.close()
+
+    # query.force-index accepts within-covered starts
+    g2 = open_graph({
+        "ids.authority-wait-ms": 0.0, "query.force-index": True,
+    })
+    m2 = g2.management()
+    m2.make_property_key("name", str)
+    m2.build_composite_index("byName", ["name"])
+    tt = g2.traversal()
+    tt.tx.add_vertex(name="x")
+    tt.commit()
+    assert len(
+        g2.traversal().V().has("name", P.within("x", "y")).to_list()
+    ) == 1
+    g2.close()
