@@ -22,6 +22,7 @@ batch thinking of the TPU OLAP path.
 from __future__ import annotations
 
 import enum
+import itertools
 from collections import Counter
 from typing import Callable, List, Optional, Sequence, Tuple
 
@@ -690,7 +691,10 @@ class _start_vertices:
         for key, p in has_conditions:
             if key is None and p.eq_value is not None:
                 label_eq = p.eq_value
-        for idx in _covered_indexes(self.source.graph, cands, label_eq):
+        covered = _covered_indexes(self.source.graph, cands, label_eq)
+        over_cap_best = None  # (n_combos, idx, names) fallback
+        chosen = None
+        for idx in covered:
             names = [
                 self.source.graph.schema_cache.get_by_id(k).name
                 for k in idx.key_ids
@@ -702,9 +706,20 @@ class _start_vertices:
             for n in names:
                 n_combos *= len(cands[n])
             if n_combos > 64:
+                if over_cap_best is None or n_combos < over_cap_best[0]:
+                    over_cap_best = (n_combos, idx, names)
                 continue
-            import itertools
-
+            chosen = (n_combos, idx, names)
+            break
+        if chosen is None and over_cap_best is not None and (
+            self.source.graph.config.get("query.force-index")
+        ):
+            # under query.force-index an over-cap union still beats the
+            # REFUSED scan: run the fewest-combo covered index uncapped
+            # (the product stays lazy; cost is the user's own IN-list)
+            chosen = over_cap_best
+        if chosen is not None:
+            n_combos, idx, names = chosen
             combos = itertools.product(*[cands[n] for n in names])
             self.plan = {
                 "access": (
@@ -855,10 +870,6 @@ def _covered_indexes(graph, eqs: dict, label_eq=None) -> list:
     return out
 
 
-def _select_index(graph, eqs: dict, label_eq=None) -> Optional[IndexDefinition]:
-    covered = _covered_indexes(graph, eqs, label_eq)
-    return covered[0] if covered else None
-
 
 def _element_value(t: Traverser, key: str, tx):
     obj = t.obj
@@ -996,6 +1007,20 @@ class GraphTraversal:
             adjacency._label = f"adjacentVertexHasId{tuple(sorted(idset))!r}"
             self._steps[-1] = adjacency
             return self
+        # START-position fold (reference: JanusGraphStep hasId folding):
+        # V().has_id(1, 2) becomes the V(1, 2) point-lookup start instead
+        # of a full scan + filter — vertex ids only (rids mean edges)
+        if (
+            self._folding
+            and idset
+            and not rid_set
+            and isinstance(self._start, _start_vertices)
+            and not self._start.ids
+            and not self._steps
+        ):
+            self._start.ids = tuple(idset)
+            return self
+
         def _id_hit(obj):
             if isinstance(obj, Edge) and obj.identifier in rid_set:
                 return True

@@ -215,4 +215,39 @@ def test_within_folds_to_index_union():
     assert len(
         g2.traversal().V().has("name", P.within("x", "y")).to_list()
     ) == 1
+    # force-index + over-cap IN-list: the covered index runs UNCAPPED
+    # (an index the user has must not produce 'no index' errors)
+    many2 = [f"q{i}" for i in range(80)] + ["x"]
+    prof_fi = g2.traversal().V().has("name", P.within(*many2)).profile()
+    assert "point_lookups=81" in str(prof_fi)
+    assert len(
+        g2.traversal().V().has("name", P.within(*many2)).to_list()
+    ) == 1
     g2.close()
+
+
+def test_has_id_start_fold():
+    """V().has_id(ids) folds into the point-lookup start (JanusGraphStep
+    hasId folding) — no full scan; composes with has() either side; the
+    empty and rid-carrying forms keep filter semantics."""
+    from janusgraph_tpu.core import gods
+    from janusgraph_tpu.core.graph import open_graph
+
+    g = open_graph({"ids.authority-wait-ms": 0.0})
+    gods.load(g)
+    t = g.traversal()
+    jid = t.V().has("name", "jupiter").next().id
+    prof = g.traversal().V().has_id(jid).profile()
+    assert "access=ids" in str(prof)
+    assert g.traversal().V().has_id(jid).has(
+        "name", "jupiter"
+    ).count() == 1
+    assert g.traversal().V().has("name", "jupiter").has_id(
+        jid
+    ).count() == 1
+    # empty has_id drops everything (must NOT fold into a full scan)
+    assert g.traversal().V().has_id().count() == 0
+    # a relation id can never match a vertex
+    e = t.V().has("name", "jupiter").out_e("brother").next()
+    assert g.traversal().V().has_id(e.identifier).count() == 0
+    g.close()
