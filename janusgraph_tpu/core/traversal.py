@@ -1020,6 +1020,17 @@ class GraphTraversal:
         ):
             self._start.ids = tuple(idset)
             return self
+        # symmetric fold for edges: E().has_id(rid, ...) -> E(rid, ...)
+        if (
+            self._folding
+            and rid_set
+            and not idset
+            and isinstance(self._start, _start_edges)
+            and not self._start.ids
+            and not self._steps
+        ):
+            self._start.ids = tuple(rid_set)
+            return self
 
         def _id_hit(obj):
             if isinstance(obj, Edge) and obj.identifier in rid_set:

@@ -250,4 +250,10 @@ def test_has_id_start_fold():
     # a relation id can never match a vertex
     e = t.V().has("name", "jupiter").out_e("brother").next()
     assert g.traversal().V().has_id(e.identifier).count() == 0
+    # symmetric edge fold: E().has_id(rid) point-looks (no scan)
+    eh = g.traversal().E().has_id(e.identifier).next()
+    assert eh.id == e.id
+    # mixed rid+int sets keep filter semantics (no fold fires); -1 can
+    # never be a relation id, so exactly the rid matches
+    assert g.traversal().E().has_id(e.identifier, -1).count() == 1
     g.close()
