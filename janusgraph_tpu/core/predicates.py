@@ -660,8 +660,31 @@ class Geo:
     CONTAINS = _GeoPredicate("geoContains", lambda v, c: c.within(v))
 
 
+class _ContainPredicate(Predicate):
+    def __init__(self, name, fn):
+        self.name = name
+        self._fn = fn
+
+    def evaluate(self, value, condition) -> bool:
+        if value is None:
+            return False
+        return self._fn(value, condition)
+
+    def is_valid_condition(self, condition) -> bool:
+        return isinstance(condition, (tuple, list, set, frozenset))
+
+
+class Contain:
+    """Membership predicates (reference: attribute/Contain.java — the
+    Contain.IN/NOT_IN that back Gremlin's P.within/P.without): condition
+    is a finite value collection."""
+
+    IN = _ContainPredicate("within", lambda v, c: v in c)
+    NOT_IN = _ContainPredicate("without", lambda v, c: v not in c)
+
+
 _BY_NAME = {}
-for _cls in (Cmp, Text, Geo):
+for _cls in (Cmp, Text, Geo, Contain):
     for _attr in vars(_cls).values():
         if isinstance(_attr, Predicate):
             _BY_NAME[_attr.name] = _attr

@@ -28,7 +28,7 @@ from typing import Callable, List, Optional, Sequence, Tuple
 
 from janusgraph_tpu.core.codecs import Direction
 from janusgraph_tpu.core.elements import Edge, Vertex, VertexProperty
-from janusgraph_tpu.core.predicates import Cmp, Geo, Text
+from janusgraph_tpu.core.predicates import Cmp, Contain, Geo, Text
 from janusgraph_tpu.core.schema import IndexDefinition
 from janusgraph_tpu.exceptions import QueryError
 
@@ -80,15 +80,11 @@ class P:
         eq_value=None,
         predicate=None,
         condition=None,
-        in_values=None,
     ):
         self.test = test
         self.label = label
         #: set when the predicate is a plain equality — index-foldable
         self.eq_value = eq_value
-        #: set for within(): the finite value set — index-foldable as a
-        #: UNION of point lookups (the reference's Contain.IN handling)
-        self.in_values = in_values
         #: structured predicate for mixed-index pushdown (None = opaque)
         self.predicate = predicate
         self.condition = condition
@@ -156,16 +152,21 @@ class P:
 
     @staticmethod
     def within(*vs) -> "P":
-        s = set(vs)
+        vals = tuple(dict.fromkeys(vs))  # deduped, order kept
+        s = set(vals)
         return P(
             lambda x: x in s, f"within{tuple(vs)!r}",
-            in_values=tuple(dict.fromkeys(vs)),  # deduped, order kept
+            predicate=Contain.IN, condition=vals,
         )
 
     @staticmethod
     def without(*vs) -> "P":
         s = set(vs)
-        return P(lambda x: x not in s, f"without{tuple(vs)!r}")
+        vals = tuple(dict.fromkeys(vs))
+        return P(
+            lambda x: x not in s, f"without{tuple(vs)!r}",
+            predicate=Contain.NOT_IN, condition=vals,
+        )
 
     @staticmethod
     def between(lo, hi) -> "P":
@@ -684,8 +685,8 @@ class _start_vertices:
                 # an eq ALWAYS narrows: it overrides a within() on the
                 # same key (their conjunction is at most that one value)
                 cands[key] = [p.eq_value]
-            elif p.in_values is not None and key not in cands:
-                cands[key] = list(p.in_values)
+            elif p.predicate is Contain.IN and key not in cands:
+                cands[key] = list(p.condition)
         # label equality (if any) gates label-constrained indexes
         label_eq = None
         for key, p in has_conditions:
@@ -2373,13 +2374,21 @@ class GraphTraversal:
                 out = []
                 for t in ts:
                     tags = t.tags or {}
-                    if p.condition not in tags:
-                        continue
-                    ref = tags[p.condition]
-                    if p.predicate is not None:
-                        keep = p.predicate.evaluate(t.obj, ref)
+                    if isinstance(p.condition, tuple):
+                        # within('a','b'): every name is a TAG whose
+                        # bound object joins the membership set
+                        if any(n not in tags for n in p.condition):
+                            continue
+                        refs = [tags[n] for n in p.condition]
+                        keep = p.predicate.evaluate(t.obj, refs)
+                    elif p.condition in tags:
+                        ref = tags[p.condition]
+                        if p.predicate is not None:
+                            keep = p.predicate.evaluate(t.obj, ref)
+                        else:
+                            keep = p.test(t.obj)
                     else:
-                        keep = p.test(t.obj)
+                        continue
                     if keep:
                         out.append(t)
                 return out

@@ -37,6 +37,7 @@ from collections import defaultdict
 from typing import Dict, Iterator, List, Optional, Set, Tuple
 
 from janusgraph_tpu.core.predicates import (
+    Contain,
     Cmp,
     Geo,
     Geoshape,
@@ -66,7 +67,8 @@ _TEXT_PREDICATES = {
     Text.CONTAINS, Text.CONTAINS_PREFIX, Text.CONTAINS_REGEX,
     Text.CONTAINS_FUZZY, Text.CONTAINS_PHRASE,
 }
-_STRING_PREDICATES = {Cmp.EQUAL, Text.PREFIX, Text.REGEX, Text.FUZZY}
+# Contain.NOT_IN excluded like NOT_EQUAL (matches docs lacking the field)
+_STRING_PREDICATES = {Cmp.EQUAL, Contain.IN, Text.PREFIX, Text.REGEX, Text.FUZZY}
 _ORDER_PREDICATES = {
     Cmp.LESS_THAN, Cmp.LESS_THAN_EQUAL,
     Cmp.GREATER_THAN, Cmp.GREATER_THAN_EQUAL,
@@ -417,6 +419,11 @@ class LocalIndexProvider(IndexProvider):
 
     def _field_query(self, store: str, field: str, predicate, cond) -> Set[str]:
         info = self._info(store, field)
+        if predicate is Contain.IN:
+            out: Set[str] = set()
+            for v in cond:
+                out |= self._field_query(store, field, Cmp.EQUAL, v)
+            return out
         if predicate is Cmp.EQUAL:
             if isinstance(cond, Geoshape):
                 return {
@@ -607,7 +614,7 @@ class LocalIndexProvider(IndexProvider):
         if info.data_type is Geoshape:
             return predicate in (
                 Geo.INTERSECT, Geo.DISJOINT, Geo.WITHIN, Geo.CONTAINS,
-                Cmp.EQUAL,
+                Cmp.EQUAL, Contain.IN,
             )
         return predicate in _STRING_PREDICATES | _ORDER_PREDICATES
 

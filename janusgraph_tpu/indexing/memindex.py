@@ -24,6 +24,7 @@ from collections import defaultdict
 from typing import Dict, List, Optional, Set, Tuple
 
 from janusgraph_tpu.core.predicates import (
+    Contain,
     Cmp,
     Geo,
     Geoshape,
@@ -60,8 +61,12 @@ _TEXT_PREDICATES = {
 # documents that HAVE the field, while neq over the graph also matches
 # vertices lacking the property — pushdown would silently drop those
 # (the in-memory filter path keeps the full-scan semantics)
+# Contain.NOT_IN is excluded for the same reason as NOT_EQUAL: `without`
+# over the graph matches vertices LACKING the property, which no provider
+# document represents. Contain.IN is a union of equality lookups.
 _STRING_PREDICATES = {
     Cmp.EQUAL,
+    Contain.IN,
     Text.PREFIX,
     Text.REGEX,
     Text.FUZZY,
@@ -177,6 +182,11 @@ class _FieldIndex:
         return {d for _, d in sel}
 
     def query(self, predicate, cond) -> Set[str]:
+        if predicate is Contain.IN:
+            out: Set[str] = set()
+            for v in cond:
+                out |= self.query(Cmp.EQUAL, v)
+            return out
         if predicate is Cmp.EQUAL:
             if isinstance(cond, Geoshape):
                 return {
@@ -449,6 +459,7 @@ class InMemoryIndexProvider(IndexProvider):
                 Geo.WITHIN,
                 Geo.CONTAINS,
                 Cmp.EQUAL,
+                Contain.IN,
             )
         return predicate in _STRING_PREDICATES | _ORDER_PREDICATES
 

@@ -816,3 +816,51 @@ def test_remote_index_retry_on_transient_failure(tmp_path):
     finally:
         p.close()
         server.stop()
+
+
+def test_within_pushdown(provider):
+    """Contain.IN pushes down to every provider as a union of equality
+    lookups; NOT_IN is NOT pushable (matches docs lacking the field,
+    same rationale as NOT_EQUAL)."""
+    from janusgraph_tpu.core.predicates import Contain
+
+    store = "wd"
+    infos = {"city": KeyInformation(str, Mapping.STRING),
+             "n": KeyInformation(int)}
+    for k, i in infos.items():
+        provider.register(store, k, i)
+    muts = {store: {}}
+    for d, (city, n) in {
+        "d1": ("sf", 1), "d2": ("nyc", 2), "d3": ("ber", 3),
+    }.items():
+        m = IndexMutation(is_new=True)
+        m.add("city", city)
+        m.add("n", n)
+        muts[store][d] = m
+    provider.mutate(muts, {})
+
+    assert provider.supports(infos["city"], Contain.IN)
+    assert provider.supports(infos["n"], Contain.IN)
+    assert not provider.supports(infos["city"], Contain.NOT_IN)
+    hits = provider.query(store, IndexQuery(
+        PredicateCondition("city", Contain.IN, ("sf", "ber", "nope"))
+    ))
+    assert sorted(hits) == ["d1", "d3"]
+    hits = provider.query(store, IndexQuery(
+        PredicateCondition("n", Contain.IN, (2, 3))
+    ))
+    assert sorted(hits) == ["d2", "d3"]
+
+
+def test_within_pushdown_traversal(graph):
+    """g.V().has(key, P.within(...)) over a MIXED-indexed key pushes to
+    the provider instead of scanning."""
+    a, b, c = _load_people(graph)
+    g = graph.traversal()
+    hits = g.V().has("age", P.within(30, 100)).to_list()
+    assert {v.id for v in hits} == {a, c}
+    prof = graph.traversal().V().has("age", P.within(30, 100)).profile()
+    assert "mixed-index" in str(prof)
+    # without() stays host-evaluated (correct, just not pushed)
+    hits2 = g.V().has("age", P.without(30, 100)).to_list()
+    assert {v.id for v in hits2} == {b}

@@ -420,3 +420,26 @@ def test_aggregate_does_not_accumulate_across_runs(g):
     t2 = g.V().has_label("god").values("name").aggregate("x").cap("x")
     again = t2.next()
     assert len(first) == 3 and len(again) == 3
+
+
+def test_where_within_tag_membership(g):
+    """where(P.within/without(tags...)): each name is an as_() tag; the
+    current object is tested against the BOUND objects (TinkerPop
+    where-predicate semantics; was silently empty before)."""
+    from janusgraph_tpu.core.traversal import P
+
+    t = g  # the fixture IS the traversal source
+    # jupiter's brothers joined with jupiter's father: father is NOT a
+    # brother, so within('f') keeps nothing, without('f') keeps both
+    got = (
+        t.V().has("name", "jupiter").out("father").as_("f")
+        .in_("father").out("brother")
+        .where(P.without("f")).dedup().values("name").to_list()
+    )
+    assert sorted(got) == ["neptune", "pluto"]
+    same = (
+        t.V().has("name", "jupiter").as_("j").out("brother")
+        .out("brother").where(P.within("j")).dedup()
+        .values("name").to_list()
+    )
+    assert same == ["jupiter"]  # brother-of-brother includes jupiter
