@@ -1,0 +1,20 @@
+#!/usr/bin/env bash
+# graphlint wrapper: trace-safety / lock-discipline / padding-invariant
+# analysis for janusgraph_tpu. Exits nonzero on error findings.
+#
+# Usage:
+#   bin/graphlint.sh                      # full package scan
+#   bin/graphlint.sh --changed-only       # only git-changed .py files
+#   bin/graphlint.sh --json               # machine-readable report
+#   bin/graphlint.sh --check-imports      # + syntax/import sweep
+#   bin/graphlint.sh janusgraph_tpu/olap  # scoped scan
+#
+# All flags pass through to `python -m janusgraph_tpu.analysis`
+# (see --help / --list-rules). Suppress a finding in code with
+#   # graphlint: disable=JGnnn -- <why>
+set -euo pipefail
+
+REPO_ROOT="$(cd "$(dirname "${BASH_SOURCE[0]}")/.." && pwd)"
+cd "$REPO_ROOT"
+
+exec python -m janusgraph_tpu.analysis "$@"

@@ -1,3 +1,11 @@
+"""`python -m janusgraph_tpu` entry point.
+
+The __name__ guard matters: without it, merely *importing*
+``janusgraph_tpu.__main__`` (pkgutil walkers, the graphlint import sweep,
+doc generators) executes the CLI against the importer's argv.
+"""
+
 from janusgraph_tpu.cli import main
 
-raise SystemExit(main())
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,54 @@
+"""graphlint: repo-native static analysis for the TPU graph framework.
+
+Three rule families guard the invariants the runtime cannot check for us:
+
+* **Trace safety** (JG1xx) — the OLAP/parallel layers compile supersteps
+  with ``jax.jit``/``shard_map``; a Python-side coercion of a traced value,
+  a stray ``numpy`` call inside a jit body, or a reused donated buffer is a
+  silent host sync or retrace that erases the kernel wins (ELL packing,
+  fused while_loop) this repo is built around.
+* **Lock discipline** (JG2xx) — the OLTP storage stack (lockers, caches,
+  logs, managers) is lock-based; inconsistent acquisition order or blocking
+  I/O under a lock is a latent deadlock at the million-user traffic goal.
+* **Padding/shape invariants** (JG3xx) — kernels rely on power-of-two
+  capacity tiers and sentinel-padded fixed shapes; a non-power-of-two tier
+  or a literal fill that drifts from the documented sentinel silently
+  corrupts results or blows up padding.
+
+Everything here is stdlib-only (``ast`` + ``tokenize``): importing this
+package never imports jax/numpy, so the analyzer runs fast anywhere.
+
+Usage::
+
+    python -m janusgraph_tpu.analysis [paths ...] [--json] [--check-imports]
+    bin/graphlint.sh --changed-only
+
+Suppression: append ``# graphlint: disable=JG101`` to the flagged line (or
+put it on a comment line directly above); ``# graphlint: disable-file=JG203``
+anywhere in a file disables a rule file-wide. Mark a helper that is only
+ever called under a jit trace with ``# graphlint: traced`` on (or above) its
+``def`` line to opt it into the traced-context rules.
+"""
+
+from janusgraph_tpu.analysis.core import (  # noqa: F401
+    Analyzer,
+    Finding,
+    RULES,
+    Rule,
+    SEV_ERROR,
+    SEV_WARNING,
+    analyze_paths,
+)
+from janusgraph_tpu.analysis.reporting import to_json, to_text  # noqa: F401
+
+__all__ = [
+    "Analyzer",
+    "Finding",
+    "Rule",
+    "RULES",
+    "SEV_ERROR",
+    "SEV_WARNING",
+    "analyze_paths",
+    "to_json",
+    "to_text",
+]

@@ -1,0 +1,8 @@
+"""`python -m janusgraph_tpu.analysis` entry point."""
+
+import sys
+
+from janusgraph_tpu.analysis.cli import main
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,163 @@
+"""graphlint CLI: `python -m janusgraph_tpu.analysis [paths ...]`.
+
+Exit codes: 0 clean, 1 error findings (or warnings with --strict), 2 usage
+error. Stdlib-only — never imports jax/numpy, so it is safe in any hook.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import subprocess
+import sys
+from typing import List, Optional, Sequence
+
+from janusgraph_tpu.analysis.core import Analyzer
+from janusgraph_tpu.analysis.reporting import (
+    list_rules_text,
+    summarize,
+    to_json,
+    to_text,
+)
+
+
+def _default_target() -> str:
+    """The janusgraph_tpu package directory itself."""
+    return os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def changed_python_files(repo_root: Optional[str] = None) -> Optional[List[str]]:
+    """Changed (staged + unstaged + untracked) .py files per git, or None
+    when git is unavailable (caller falls back to a full run)."""
+    try:
+        out = subprocess.run(
+            # -uall: list files inside untracked directories individually
+            ["git", "status", "--porcelain", "-uall"],
+            cwd=repo_root or os.getcwd(),
+            capture_output=True, text=True, timeout=30, check=True,
+        ).stdout
+    except (OSError, subprocess.SubprocessError):
+        return None
+    files = []
+    for line in out.splitlines():
+        if len(line) < 4:
+            continue
+        path = line[3:].strip()
+        if " -> " in path:  # rename: lint the new side
+            path = path.split(" -> ", 1)[1]
+        path = path.strip('"')
+        if path.endswith(".py") and line[:2].strip() != "D":
+            files.append(path)
+    return files
+
+
+def filter_changed(paths: Sequence[str], changed: Sequence[str]) -> List[str]:
+    """Changed files that fall under any of the requested paths."""
+    roots = [os.path.abspath(p) for p in paths]
+    out = []
+    for c in changed:
+        ac = os.path.abspath(c)
+        if not os.path.exists(ac):
+            continue
+        for r in roots:
+            if ac == r or ac.startswith(r.rstrip(os.sep) + os.sep):
+                out.append(c)
+                break
+    return sorted(set(out))
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="python -m janusgraph_tpu.analysis",
+        description="graphlint: trace-safety, lock-discipline, and "
+        "padding-invariant analysis for janusgraph_tpu",
+    )
+    p.add_argument(
+        "paths", nargs="*",
+        help="files/directories to analyze (default: the janusgraph_tpu "
+        "package)",
+    )
+    p.add_argument("--json", action="store_true", help="JSON report on stdout")
+    p.add_argument(
+        "--check-imports", action="store_true",
+        help="also py_compile every file and import every package module "
+        "(catches syntax errors and circular imports in rarely-run "
+        "modules)",
+    )
+    p.add_argument(
+        "--changed-only", action="store_true",
+        help="only lint .py files git reports as changed (incremental "
+        "builder loop)",
+    )
+    p.add_argument(
+        "--select", default=None,
+        help="comma-separated rule-id prefixes to enable (e.g. JG1,JG203)",
+    )
+    p.add_argument(
+        "--ignore", default=None,
+        help="comma-separated rule-id prefixes to disable",
+    )
+    p.add_argument(
+        "--strict", action="store_true",
+        help="exit nonzero on warnings too",
+    )
+    p.add_argument(
+        "--show-suppressed", action="store_true",
+        help="include suppressed findings (marked) in the report",
+    )
+    p.add_argument("--list-rules", action="store_true")
+    return p
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    args = build_parser().parse_args(argv)
+    if args.list_rules:
+        print(list_rules_text())
+        return 0
+
+    paths = list(args.paths) or [_default_target()]
+    for p in paths:
+        if not os.path.exists(p):
+            print(f"graphlint: path does not exist: {p}", file=sys.stderr)
+            return 2
+
+    if args.changed_only:
+        changed = changed_python_files()
+        if changed is None:
+            print(
+                "graphlint: --changed-only needs git; running full scan",
+                file=sys.stderr,
+            )
+        else:
+            paths = filter_changed(paths, changed)
+            if not paths:
+                print("graphlint: no changed python files under the "
+                      "requested paths")
+                return 0
+
+    analyzer = Analyzer(
+        select=args.select.split(",") if args.select else None,
+        ignore=args.ignore.split(",") if args.ignore else None,
+    )
+    findings, files_scanned = analyzer.analyze_paths(
+        paths, keep_suppressed=args.show_suppressed
+    )
+    if args.check_imports:
+        from janusgraph_tpu.analysis.imports_check import check_imports
+
+        findings.extend(check_imports(paths))
+        findings.sort(key=lambda f: f.sort_key())
+
+    print(to_json(findings, files_scanned) if args.json
+          else to_text(findings, files_scanned))
+
+    counts = summarize(findings)
+    if counts["errors"]:
+        return 1
+    if args.strict and counts["warnings"]:
+        return 1
+    return 0
+
+
+if __name__ == "__main__":  # pragma: no cover
+    sys.exit(main())

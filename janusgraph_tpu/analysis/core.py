@@ -1,0 +1,313 @@
+"""graphlint core: rule registry, findings, suppressions, and the driver.
+
+The analyzer is one pass per file (parse + per-module rule visitors) plus
+one cross-file pass (the lock-order graph, which only becomes a finding
+once every module's acquisition edges are known).
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Tuple
+
+SEV_ERROR = "error"
+SEV_WARNING = "warning"
+
+
+@dataclass(frozen=True)
+class Rule:
+    id: str
+    severity: str
+    summary: str
+
+
+#: Rule registry. Severity here is the default; findings carry their own so
+#: a rule can downgrade heuristic hits (e.g. transitive blocking calls).
+RULES: Dict[str, Rule] = {
+    r.id: r
+    for r in [
+        # -- import/syntax sweep (--check-imports) --------------------------
+        Rule("JG001", SEV_ERROR, "file does not compile (syntax error)"),
+        Rule("JG002", SEV_ERROR, "module fails to import"),
+        # -- trace safety ---------------------------------------------------
+        Rule("JG101", SEV_ERROR,
+             "Python coercion or branch on a traced value inside a jit "
+             "context (host sync / TracerBoolConversionError)"),
+        Rule("JG102", SEV_ERROR,
+             "numpy call inside a jit/pmap/shard_map body (host transfer; "
+             "breaks tracing)"),
+        Rule("JG103", SEV_ERROR,
+             "retrace hazard: non-constant static_argnums/static_argnames, "
+             "or jit called inside a loop body"),
+        Rule("JG104", SEV_ERROR,
+             "donated buffer reused after a donate_argnums call"),
+        Rule("JG105", SEV_ERROR,
+             "host sync inside a jit context (.item()/.tolist()/"
+             ".block_until_ready()/device_get)"),
+        # -- lock discipline ------------------------------------------------
+        Rule("JG201", SEV_ERROR,
+             "lock.acquire() without with/try-finally release on all paths"),
+        Rule("JG202", SEV_ERROR,
+             "inconsistent lock acquisition order (deadlock risk)"),
+        Rule("JG203", SEV_ERROR,
+             "blocking call (sleep / socket / RPC) while holding a lock"),
+        # -- padding / shape invariants -------------------------------------
+        Rule("JG301", SEV_ERROR,
+             "capacity tier constant is not a power of two (ELL/frontier "
+             "tiers must stay power-of-two for bounded padding and "
+             "executable reuse)"),
+        Rule("JG302", SEV_ERROR,
+             "integer padding fill uses a bare literal instead of the "
+             "documented sentinel name"),
+        Rule("JG303", SEV_ERROR,
+             "data-dependent output shape inside a jit context "
+             "(nonzero/unique/1-arg where without size=)"),
+    ]
+}
+
+
+@dataclass
+class Finding:
+    rule_id: str
+    severity: str
+    path: str  # repo-relative (or as-given) path
+    line: int
+    col: int
+    message: str
+    suppressed: bool = False
+
+    def sort_key(self):
+        return (self.path, self.line, self.col, self.rule_id)
+
+    def to_dict(self) -> dict:
+        return {
+            "rule": self.rule_id,
+            "severity": self.severity,
+            "path": self.path,
+            "line": self.line,
+            "col": self.col,
+            "message": self.message,
+            "suppressed": self.suppressed,
+        }
+
+
+# ---------------------------------------------------------------- suppression
+_DISABLE_RE = re.compile(
+    r"#\s*graphlint:\s*disable=([A-Za-z0-9_,\s]+?)(?:\s+--|\s*$|#)"
+)
+_DISABLE_FILE_RE = re.compile(
+    r"#\s*graphlint:\s*disable-file=([A-Za-z0-9_,\s]+?)(?:\s+--|\s*$|#)"
+)
+_TRACED_RE = re.compile(r"#\s*graphlint:\s*traced\b")
+_HOST_RE = re.compile(r"#\s*graphlint:\s*host\b")
+
+
+def _parse_ids(blob: str) -> set:
+    return {p.strip().upper() for p in blob.split(",") if p.strip()}
+
+
+class Suppressions:
+    """Per-file suppression state parsed from comments.
+
+    ``# graphlint: disable=JG101`` on the flagged line or on a comment line
+    directly above suppresses that line; ``disable-file=`` anywhere in the
+    file suppresses the rule file-wide. ``disable=all`` works for both.
+    """
+
+    def __init__(self, source: str):
+        self.line_rules: Dict[int, set] = {}
+        self.file_rules: set = set()
+        self.traced_lines: set = set()
+        #: defs here compute HOST constants even when called from a traced
+        #: body (e.g. lru-cached numpy masks) — propagation skips them
+        self.host_lines: set = set()
+        for i, line in enumerate(source.splitlines(), start=1):
+            if "graphlint" not in line:
+                continue
+            m = _DISABLE_FILE_RE.search(line)
+            if m:
+                self.file_rules |= _parse_ids(m.group(1))
+                continue
+            m = _DISABLE_RE.search(line)
+            if m:
+                ids = _parse_ids(m.group(1))
+                self.line_rules.setdefault(i, set()).update(ids)
+                if line.lstrip().startswith("#"):
+                    # comment-only line: also covers the line below
+                    self.line_rules.setdefault(i + 1, set()).update(ids)
+            if _TRACED_RE.search(line):
+                self.traced_lines.add(i)
+                if line.lstrip().startswith("#"):
+                    self.traced_lines.add(i + 1)
+            if _HOST_RE.search(line):
+                self.host_lines.add(i)
+                if line.lstrip().startswith("#"):
+                    self.host_lines.add(i + 1)
+
+    def is_suppressed(self, rule_id: str, line: int) -> bool:
+        if "ALL" in self.file_rules or rule_id in self.file_rules:
+            return True
+        ids = self.line_rules.get(line)
+        return ids is not None and (rule_id in ids or "ALL" in ids)
+
+
+# -------------------------------------------------------------------- modules
+@dataclass
+class ModuleInfo:
+    """One parsed source file plus everything rule visitors need."""
+
+    path: str  # display path (repo-relative when possible)
+    abspath: str
+    source: str
+    tree: ast.Module
+    suppressions: Suppressions
+    #: names bound to the numpy module (``np``/``numpy``) at module level
+    numpy_names: set = field(default_factory=set)
+
+    @property
+    def rel_segments(self) -> Tuple[str, ...]:
+        return tuple(self.path.replace(os.sep, "/").split("/"))
+
+
+def _collect_numpy_names(tree: ast.Module) -> set:
+    names = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for alias in node.names:
+                if alias.name.split(".")[0] == "numpy":
+                    names.add(alias.asname or alias.name.split(".")[0])
+        elif isinstance(node, ast.ImportFrom):
+            if node.module and node.module.split(".")[0] == "numpy":
+                for alias in node.names:
+                    names.add(alias.asname or alias.name)
+    return names
+
+
+def load_module(abspath: str, display: Optional[str] = None) -> Tuple[Optional[ModuleInfo], Optional[Finding]]:
+    """Parse one file. Returns (module, None) or (None, JG001 finding)."""
+    display = display or abspath
+    with open(abspath, "rb") as f:
+        raw = f.read()
+    try:
+        source = raw.decode("utf-8")
+    except UnicodeDecodeError:
+        source = raw.decode("utf-8", errors="replace")
+    try:
+        tree = ast.parse(source, filename=abspath)
+    except SyntaxError as e:
+        return None, Finding(
+            "JG001", SEV_ERROR, display, e.lineno or 1, e.offset or 0,
+            f"syntax error: {e.msg}",
+        )
+    mod = ModuleInfo(
+        path=display,
+        abspath=abspath,
+        source=source,
+        tree=tree,
+        suppressions=Suppressions(source),
+    )
+    mod.numpy_names = _collect_numpy_names(tree)
+    return mod, None
+
+
+def discover_files(paths: Sequence[str]) -> List[Tuple[str, str]]:
+    """Expand files/directories into sorted (abspath, display) pairs."""
+    out = []
+    cwd = os.getcwd()
+    for p in paths:
+        ap = os.path.abspath(p)
+        if os.path.isfile(ap):
+            out.append(ap)
+        else:
+            for root, dirs, files in os.walk(ap):
+                dirs[:] = sorted(
+                    d for d in dirs
+                    if not d.startswith(".") and d != "__pycache__"
+                )
+                for fn in sorted(files):
+                    if fn.endswith(".py"):
+                        out.append(os.path.join(root, fn))
+    uniq = sorted(set(out))
+    pairs = []
+    for ap in uniq:
+        disp = os.path.relpath(ap, cwd)
+        if disp.startswith(".."):
+            disp = ap
+        pairs.append((ap, disp))
+    return pairs
+
+
+# --------------------------------------------------------------------- driver
+class Analyzer:
+    """Runs every rule family over a set of paths and filters findings."""
+
+    def __init__(
+        self,
+        select: Optional[Sequence[str]] = None,
+        ignore: Optional[Sequence[str]] = None,
+    ):
+        self.select = [s.upper() for s in select] if select else None
+        self.ignore = [s.upper() for s in ignore] if ignore else []
+
+    def _wanted(self, rule_id: str) -> bool:
+        if any(rule_id.startswith(p) for p in self.ignore):
+            return False
+        if self.select is not None:
+            return any(rule_id.startswith(p) for p in self.select)
+        return True
+
+    def analyze_paths(
+        self, paths: Sequence[str], keep_suppressed: bool = False
+    ) -> Tuple[List[Finding], int]:
+        """Returns (findings, files_scanned). Suppressed findings are kept
+        (marked) only when `keep_suppressed`."""
+        from janusgraph_tpu.analysis import lock_rules, shape_rules, trace_rules
+
+        findings: List[Finding] = []
+        modules: List[ModuleInfo] = []
+        pairs = discover_files(paths)
+        for ap, disp in pairs:
+            mod, err = load_module(ap, disp)
+            if err is not None:
+                findings.append(err)
+                continue
+            modules.append(mod)
+
+        lock_graph = lock_rules.LockGraph()
+        for mod in modules:
+            findings.extend(trace_rules.check_module(mod))
+            findings.extend(shape_rules.check_module(mod))
+            findings.extend(lock_rules.check_module(mod, lock_graph))
+        findings.extend(lock_graph.order_findings())
+
+        out = []
+        seen = set()
+        for f in findings:
+            if not self._wanted(f.rule_id):
+                continue
+            # a node inside a nested traced def is walked by both the inner
+            # and outer context — report it once
+            key = (f.rule_id, f.path, f.line, f.col)
+            if key in seen:
+                continue
+            seen.add(key)
+            mod = next((m for m in modules if m.path == f.path), None)
+            if mod is not None and mod.suppressions.is_suppressed(
+                f.rule_id, f.line
+            ):
+                if keep_suppressed:
+                    f.suppressed = True
+                    out.append(f)
+                continue
+            out.append(f)
+        out.sort(key=Finding.sort_key)
+        return out, len(pairs)
+
+
+def analyze_paths(paths: Sequence[str], **kw) -> List[Finding]:
+    """Convenience: default analyzer, non-suppressed findings only."""
+    findings, _ = Analyzer(**kw).analyze_paths(paths)
+    return findings

@@ -1,0 +1,179 @@
+"""JG3xx padding/shape-invariant rules for the kernel layers.
+
+JG301  capacity tiers (`E_cap`/`F_cap`/`*_capacity`/`E_MIN`/`F_MIN`/
+       `MAX_EDGES`) must be power-of-two integer literals. The ELL packer
+       buckets by next-pow2 degree (bounded <2x padding) and the frontier
+       engine's tier ladder reuses one executable per power tier — a
+       non-pow2 literal breaks both contracts silently.
+JG302  integer-dtype `full(...)` padding with a bare literal fill (other
+       than 0/1/-1): padded slots must read the *documented sentinel* (a
+       named constant like `pack.sentinel` or `INF`), otherwise a sentinel
+       drift between packer and kernel reads garbage neighbors.
+JG303  data-dependent output shapes inside a jit context: `nonzero`/
+       `unique`/`argwhere`/`flatnonzero` without `size=`, or one-argument
+       `where` — all fail under jit or force a host round-trip; fixed-shape
+       kernels must take a static capacity and pad.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import List, Optional
+
+from janusgraph_tpu.analysis.core import Finding, RULES
+from janusgraph_tpu.analysis.tracing import find_traced_defs, terminal_name
+
+_CAP_NAME_RE = re.compile(
+    r"^[ef]_?(cap|min)$|_cap$|_capacity$|^max_edges$|^max_capacity$",
+    re.IGNORECASE,
+)
+
+_SHAPE_ESCAPE_FNS = {"nonzero", "unique", "argwhere", "flatnonzero"}
+
+
+def _finding(rule: str, mod, node, message: str) -> Finding:
+    return Finding(
+        rule, RULES[rule].severity, mod.path,
+        getattr(node, "lineno", 1), getattr(node, "col_offset", 0), message,
+    )
+
+
+def _const_int(node: ast.AST) -> Optional[int]:
+    """Fold the literal int forms tiers are written in: 123, 1 << 14,
+    2 ** 10, 4 * 1024, -(-x // y) is NOT folded (non-literal)."""
+    if isinstance(node, ast.Constant) and isinstance(node.value, int) and not isinstance(node.value, bool):
+        return node.value
+    if isinstance(node, ast.UnaryOp) and isinstance(node.op, ast.USub):
+        v = _const_int(node.operand)
+        return -v if v is not None else None
+    if isinstance(node, ast.BinOp):
+        left, right = _const_int(node.left), _const_int(node.right)
+        if left is None or right is None:
+            return None
+        if isinstance(node.op, ast.LShift):
+            return left << right
+        if isinstance(node.op, ast.Mult):
+            return left * right
+        if isinstance(node.op, ast.Pow):
+            return left ** right
+        if isinstance(node.op, ast.Add):
+            return left + right
+    return None
+
+
+def _is_pow2(v: int) -> bool:
+    return v > 0 and (v & (v - 1)) == 0
+
+
+def _check_capacity_tiers(mod) -> List[Finding]:
+    out: List[Finding] = []
+
+    def check(name: str, value_node: ast.AST, where: ast.AST):
+        if not _CAP_NAME_RE.search(name):
+            return
+        v = _const_int(value_node)
+        if v is None or _is_pow2(v):
+            return
+        out.append(_finding(
+            "JG301", mod, where,
+            f"capacity tier `{name}` = {v} is not a power of two — ELL "
+            f"bucketing and frontier-tier executable reuse require "
+            f"power-of-two capacities",
+        ))
+
+    for node in ast.walk(mod.tree):
+        if isinstance(node, ast.Assign):
+            for t in node.targets:
+                if isinstance(t, ast.Name):
+                    check(t.id, node.value, node)
+        elif isinstance(node, ast.AnnAssign) and node.value is not None:
+            if isinstance(node.target, ast.Name):
+                check(node.target.id, node.value, node)
+        elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            args = node.args
+            pos = args.posonlyargs + args.args
+            for arg, default in zip(pos[len(pos) - len(args.defaults):], args.defaults):
+                check(arg.arg, default, default)
+            for arg, default in zip(args.kwonlyargs, args.kw_defaults):
+                if default is not None:
+                    check(arg.arg, default, default)
+    return out
+
+
+def _dtype_is_int(call: ast.Call) -> Optional[bool]:
+    """True/False when the `full` call's dtype is recognizably int/float;
+    None when absent or unrecognizable."""
+    dtype = None
+    if len(call.args) >= 3:
+        dtype = call.args[2]
+    for kw in call.keywords:
+        if kw.arg == "dtype":
+            dtype = kw.value
+    if dtype is None:
+        return None
+    t = terminal_name(dtype)
+    if t is None:
+        return None
+    if "int" in t.lower():
+        return True
+    if "float" in t.lower() or "bfloat" in t.lower() or "complex" in t.lower():
+        return False
+    return None
+
+
+def _check_sentinel_fills(mod) -> List[Finding]:
+    out: List[Finding] = []
+    for node in ast.walk(mod.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        if terminal_name(node.func) != "full" or len(node.args) < 2:
+            continue
+        fill = node.args[1]
+        v = _const_int(fill)
+        if v is None or v in (0, 1, -1):
+            continue
+        if _dtype_is_int(node) is False:
+            continue  # float-dtype fills are not index padding
+        out.append(_finding(
+            "JG302", mod, node,
+            f"integer padding fill uses bare literal {v} — use the "
+            f"documented sentinel name (e.g. `pack.sentinel`, the "
+            f"one-past-the-end identity slot) so packer and kernel can "
+            f"never drift",
+        ))
+    return out
+
+
+def _check_dynamic_shapes(mod) -> List[Finding]:
+    out: List[Finding] = []
+    for td in find_traced_defs(mod).values():
+        name = getattr(td.node, "name", "<lambda>")
+        for sub in ast.walk(td.node):
+            if not isinstance(sub, ast.Call):
+                continue
+            t = terminal_name(sub.func)
+            if t in _SHAPE_ESCAPE_FNS:
+                if any(kw.arg == "size" for kw in sub.keywords):
+                    continue
+                out.append(_finding(
+                    "JG303", mod, sub,
+                    f"`{t}` without size= in jit context `{name}` — the "
+                    f"output shape is data-dependent; pass size= (with "
+                    f"fill_value) to keep the kernel fixed-shape",
+                ))
+            elif t == "where" and len(sub.args) == 1 and not sub.keywords:
+                out.append(_finding(
+                    "JG303", mod, sub,
+                    f"one-argument `where` in jit context `{name}` — "
+                    f"data-dependent shape; use the three-argument form "
+                    f"or nonzero(size=...)",
+                ))
+    return out
+
+
+def check_module(mod) -> List[Finding]:
+    out = _check_capacity_tiers(mod)
+    out.extend(_check_sentinel_fills(mod))
+    out.extend(_check_dynamic_shapes(mod))
+    return out

@@ -1,0 +1,216 @@
+"""JG1xx trace-safety rules for the OLAP/parallel compiled paths.
+
+JG101  Python coercion (`float()/int()/bool()`) of, or `if`/`while`/`assert`
+       branching on, a traced value inside a jit context. Coercion forces a
+       device->host sync per call; branching raises
+       TracerBoolConversionError at trace time or, worse, bakes one branch
+       into the executable.
+JG102  numpy call inside a jit/pmap/shard_map body: numpy pulls the traced
+       value to host (ConcretizationTypeError) or silently constant-folds.
+JG103  retrace hazards: `static_argnums`/`static_argnames`/`donate_argnums`
+       given a non-constant expression (per-call variation = one executable
+       per call), and jit-like wrapping inside a loop body (a fresh
+       callable each iteration defeats the compile cache).
+JG104  donated buffer reuse: an argument passed at a donate_argnums
+       position is dead after the call — its HBM was handed to the output.
+JG105  host sync in a jit context: `.item()`, `.tolist()`,
+       `.block_until_ready()`, `jax.device_get` on traced values.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List, Set
+
+from janusgraph_tpu.analysis.core import Finding, RULES
+from janusgraph_tpu.analysis.tracing import (
+    TaintWalker,
+    find_traced_defs,
+    terminal_name,
+)
+
+_JIT_ENTRY_NAMES = {"jit", "pjit", "pmap"}  # wrappers that take argnums kws
+
+
+def _finding(rule: str, mod, node: ast.AST, message: str) -> Finding:
+    return Finding(
+        rule, RULES[rule].severity, mod.path,
+        getattr(node, "lineno", 1), getattr(node, "col_offset", 0), message,
+    )
+
+
+def _is_constant_expr(node: ast.AST) -> bool:
+    if isinstance(node, ast.Constant):
+        return True
+    if isinstance(node, (ast.Tuple, ast.List, ast.Set)):
+        return all(_is_constant_expr(e) for e in node.elts)
+    if isinstance(node, ast.UnaryOp):
+        return _is_constant_expr(node.operand)
+    return False
+
+
+def _check_traced_bodies(mod) -> List[Finding]:
+    out: List[Finding] = []
+    for td in find_traced_defs(mod).values():
+        if isinstance(td.node, ast.Lambda):
+            continue
+        walker = TaintWalker(td, mod)
+        walker.run()
+        name = getattr(td.node, "name", "<lambda>")
+        for kind, node, detail in walker.events:
+            if kind == "coerce":
+                out.append(_finding(
+                    "JG101", mod, node,
+                    f"`{detail}()` applied to a traced value in jit "
+                    f"context `{name}` — forces a host sync (or fails "
+                    f"under jit); keep it on device or hoist to host code",
+                ))
+            elif kind == "branch":
+                out.append(_finding(
+                    "JG101", mod, node,
+                    f"branch on a traced value in jit context `{name}` — "
+                    f"use jnp.where / lax.cond instead of Python control "
+                    f"flow",
+                ))
+            elif kind == "hostsync":
+                out.append(_finding(
+                    "JG105", mod, node,
+                    f"`{detail}` on a traced value in jit context "
+                    f"`{name}` — host sync inside a compiled body",
+                ))
+        # numpy calls anywhere in the traced body (taint-independent: numpy
+        # output is a host constant even when the inputs are static)
+        for sub in ast.walk(td.node):
+            if not isinstance(sub, ast.Call):
+                continue
+            root = sub.func
+            while isinstance(root, ast.Attribute):
+                root = root.value
+            if isinstance(root, ast.Name) and root.id in mod.numpy_names:
+                out.append(_finding(
+                    "JG102", mod, sub,
+                    f"numpy call `{ast.unparse(sub.func)}` inside jit "
+                    f"context `{name}` — use jnp (numpy breaks tracing "
+                    f"or constant-folds host-side)",
+                ))
+    return out
+
+
+def _check_jit_callsites(mod) -> List[Finding]:
+    """JG103: non-constant argnums + jit-in-loop."""
+    out: List[Finding] = []
+
+    class V(ast.NodeVisitor):
+        def __init__(self):
+            self.loop_depth = 0
+
+        def _loop(self, node):
+            self.loop_depth += 1
+            self.generic_visit(node)
+            self.loop_depth -= 1
+
+        visit_For = _loop
+        visit_While = _loop
+
+        def visit_FunctionDef(self, node):
+            # a def inside a loop resets loop context for its body
+            saved, self.loop_depth = self.loop_depth, 0
+            self.generic_visit(node)
+            self.loop_depth = saved
+
+        visit_AsyncFunctionDef = visit_FunctionDef
+
+        def visit_Call(self, node):
+            t = terminal_name(node.func)
+            if t in _JIT_ENTRY_NAMES:
+                for kw in node.keywords:
+                    if kw.arg in (
+                        "static_argnums", "static_argnames", "donate_argnums"
+                    ) and not _is_constant_expr(kw.value):
+                        out.append(_finding(
+                            "JG103", mod, node,
+                            f"`{kw.arg}` is not a constant literal — a "
+                            f"per-call value retraces on every invocation",
+                        ))
+                if self.loop_depth > 0:
+                    out.append(_finding(
+                        "JG103", mod, node,
+                        f"`{ast.unparse(node.func)}` called inside a loop "
+                        f"body — each iteration builds a fresh executable "
+                        f"(retrace); hoist and cache the jitted callable",
+                    ))
+            self.generic_visit(node)
+
+    V().visit(mod.tree)
+    return out
+
+
+def _check_donated_reuse(mod) -> List[Finding]:
+    """JG104: best-effort, function-scope-local. Tracks
+    `f = jax.jit(g, donate_argnums=(i,))` then `f(x, ...)` then a later
+    read of `x`."""
+    out: List[Finding] = []
+
+    def donated_positions(call: ast.Call) -> Set[int]:
+        pos: Set[int] = set()
+        for kw in call.keywords:
+            if kw.arg == "donate_argnums":
+                for n in ast.walk(kw.value):
+                    if isinstance(n, ast.Constant) and isinstance(n.value, int):
+                        pos.add(n.value)
+        return pos
+
+    def scan_scope(body: List[ast.stmt]):
+        jitted: dict = {}  # fn name -> donated positions
+        dead: dict = {}  # var name -> line donated at
+        for stmt in body:
+            for sub in ast.walk(stmt):
+                if isinstance(sub, ast.Name) and isinstance(
+                    sub.ctx, ast.Load
+                ) and sub.id in dead:
+                    out.append(_finding(
+                        "JG104", mod, sub,
+                        f"`{sub.id}` was donated to a jit call on line "
+                        f"{dead[sub.id]} — its buffer no longer holds the "
+                        f"value (donated HBM is reused for the output)",
+                    ))
+                    del dead[sub.id]  # one report per variable
+            if isinstance(stmt, ast.Assign) and isinstance(
+                stmt.value, ast.Call
+            ):
+                call = stmt.value
+                if terminal_name(call.func) in _JIT_ENTRY_NAMES:
+                    pos = donated_positions(call)
+                    if pos:
+                        for t in stmt.targets:
+                            if isinstance(t, ast.Name):
+                                jitted[t.id] = pos
+            for sub in ast.walk(stmt):
+                if not isinstance(sub, ast.Call):
+                    continue
+                fname = sub.func.id if isinstance(sub.func, ast.Name) else None
+                if fname in jitted:
+                    for i in jitted[fname]:
+                        if i < len(sub.args) and isinstance(
+                            sub.args[i], ast.Name
+                        ):
+                            dead[sub.args[i].id] = sub.lineno
+            if isinstance(stmt, ast.Assign):
+                # rebinding AFTER the call registration: `x = step(x, ...)`
+                # rebinds x to the jit OUTPUT, which is a live buffer
+                for t in stmt.targets:
+                    if isinstance(t, ast.Name):
+                        dead.pop(t.id, None)
+
+    for node in ast.walk(mod.tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            scan_scope(node.body)
+    scan_scope(mod.tree.body)
+    return out
+
+
+def check_module(mod) -> List[Finding]:
+    out = _check_traced_bodies(mod)
+    out.extend(_check_jit_callsites(mod))
+    out.extend(_check_donated_reuse(mod))
+    return out

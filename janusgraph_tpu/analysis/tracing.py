@@ -1,0 +1,477 @@
+"""Traced-context discovery + tracer-value taint for the JG1xx/JG3xx rules.
+
+A function body is a *traced context* when jax traces it: decorated with
+``@jax.jit``/``@partial(jax.jit, ...)``, passed by name to a jit-like call
+(``self.jax.jit(step)``, ``shard_map(body, ...)``, ``pl.pallas_call(kernel,
+...)``, ``lax.while_loop(cond, loop, ...)``), returned by a "jit factory"
+(``jax.jit(self._superstep_body(...))`` marks the inner def that
+``_superstep_body`` returns), called from another traced def in the same
+module, or explicitly marked with ``# graphlint: traced``.
+
+Inside a traced context, *tainted* names approximate traced values: the
+function's parameters (for directly-jitted defs), plus anything assigned
+from an expression involving a tainted name. Static metadata attributes
+(``.shape``/``.ndim``/``.dtype``) do not propagate taint — ``if m.ndim ==
+3:`` is legal and common. Helpers called from a traced def are analyzed
+with only the parameter positions that actually receive tainted arguments
+tainted, so closure-carried static config (combiner ops, flags) never
+false-positives the branch rule.
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Set, Tuple
+
+#: call names that trace their function-valued arguments
+JIT_CALL_NAMES = {
+    "jit", "pjit", "pmap", "vmap", "shard_map", "pallas_call",
+    "while_loop", "scan", "cond", "fori_loop", "switch", "remat",
+    "checkpoint", "custom_vjp", "custom_jvp", "grad", "value_and_grad",
+    "when",  # pl.when decorator bodies trace like any kernel code
+}
+
+#: attributes that are static under tracing (reading them breaks no rule
+#: and yields a host value)
+STATIC_ATTRS = {"shape", "ndim", "dtype", "size", "sharding", "aval"}
+
+
+def terminal_name(node: ast.AST) -> Optional[str]:
+    """`jax.jit` -> 'jit', `jit` -> 'jit', `self.jax.jit` -> 'jit'."""
+    if isinstance(node, ast.Attribute):
+        return node.attr
+    if isinstance(node, ast.Name):
+        return node.id
+    return None
+
+
+def _is_jit_call(call: ast.Call) -> bool:
+    return terminal_name(call.func) in JIT_CALL_NAMES
+
+
+@dataclass
+class TracedDef:
+    node: ast.AST  # FunctionDef | Lambda
+    #: None = taint every parameter (directly jitted); otherwise the set of
+    #: parameter indices that receive tainted arguments at call sites
+    tainted_params: Optional[Set[int]] = None
+    reason: str = "jit"
+
+
+class _ScopeIndex(ast.NodeVisitor):
+    """Index every FunctionDef by name within its lexical scope chain, so a
+    Name reference at a call site resolves to the nearest enclosing-scope
+    def of that name (good enough for the jit-by-name idiom)."""
+
+    def __init__(self):
+        self.defs_in_scope: Dict[int, Dict[str, ast.AST]] = {}
+        self.parent_scope: Dict[int, Optional[ast.AST]] = {}
+        self.scope_of: Dict[int, ast.AST] = {}  # node id -> enclosing scope
+        self._stack: List[ast.AST] = []
+
+    def visit(self, node):
+        if self._stack:
+            self.scope_of[id(node)] = self._stack[-1]
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            scope = self._stack[-1] if self._stack else None
+            self.defs_in_scope.setdefault(id(scope), {})[node.name] = node
+            self.parent_scope[id(node)] = scope
+        if isinstance(
+            node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda, ast.Module)
+        ):
+            self._stack.append(node)
+            self.generic_visit(node)
+            self._stack.pop()
+        else:
+            self.generic_visit(node)
+
+    def resolve(self, at: ast.AST, name: str) -> Optional[ast.AST]:
+        scope = self.scope_of.get(id(at))
+        seen = set()
+        while id(scope) not in seen:
+            seen.add(id(scope))
+            hit = self.defs_in_scope.get(id(scope), {}).get(name)
+            if hit is not None:
+                return hit
+            scope = self.parent_scope.get(id(scope)) if not isinstance(
+                scope, ast.Module
+            ) else None
+            if scope is None:
+                hit = self.defs_in_scope.get(id(None), {}).get(name)
+                return hit
+
+
+def _decorated_jit(fn: ast.AST) -> bool:
+    for dec in getattr(fn, "decorator_list", ()):
+        if terminal_name(dec) in JIT_CALL_NAMES:
+            return True
+        if isinstance(dec, ast.Call):
+            if terminal_name(dec.func) in JIT_CALL_NAMES:
+                return True
+            # @partial(jax.jit, ...) / @functools.partial(jit, ...)
+            if terminal_name(dec.func) == "partial" and dec.args:
+                if terminal_name(dec.args[0]) in JIT_CALL_NAMES:
+                    return True
+    return False
+
+
+def _candidate_fn_names(arg: ast.AST) -> List[Tuple[ast.AST, str]]:
+    """Function-name candidates referenced by one argument of a jit call:
+    a bare Name, `partial(name, ...)`, or a nested jit-like call's args."""
+    out = []
+    if isinstance(arg, ast.Name):
+        out.append((arg, arg.id))
+    elif isinstance(arg, ast.Call):
+        t = terminal_name(arg.func)
+        if t == "partial" and arg.args:
+            out.extend(_candidate_fn_names(arg.args[0]))
+        elif t in JIT_CALL_NAMES:
+            for a in arg.args:
+                out.extend(_candidate_fn_names(a))
+    return out
+
+
+def find_traced_defs(mod) -> Dict[int, TracedDef]:
+    """All traced contexts of a module: {id(def_node): TracedDef}."""
+    index = _ScopeIndex()
+    index.visit(mod.tree)
+    traced: Dict[int, TracedDef] = {}
+    factories: Set[str] = set()  # method/function names whose RESULT is jitted
+
+    # name -> Call it was assigned from (module-wide, simple single-target
+    # assignments): lets `body = self._shard_body(...); shard_map(body, ...)`
+    # resolve _shard_body as a factory
+    assigned_calls: Dict[str, ast.Call] = {}
+    for node in ast.walk(mod.tree):
+        if (
+            isinstance(node, ast.Assign)
+            and len(node.targets) == 1
+            and isinstance(node.targets[0], ast.Name)
+            and isinstance(node.value, ast.Call)
+        ):
+            assigned_calls[node.targets[0].id] = node.value
+
+    def mark(node, tainted: Optional[Set[int]], reason: str):
+        cur = traced.get(id(node))
+        if cur is None:
+            traced[id(node)] = TracedDef(node, tainted, reason)
+        elif cur.tainted_params is not None:
+            if tainted is None:
+                cur.tainted_params = None
+            else:
+                cur.tainted_params |= tainted
+
+    for node in ast.walk(mod.tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            if _decorated_jit(node):
+                mark(node, None, "decorator")
+            elif node.lineno in mod.suppressions.traced_lines:
+                # explicit marker: traced context, but taint no params —
+                # marked helpers usually mix traced arrays with static
+                # config arguments
+                mark(node, set(), "marker")
+        elif isinstance(node, ast.Call) and _is_jit_call(node):
+            for arg in node.args:
+                if isinstance(arg, ast.Lambda):
+                    mark(arg, None, "lambda")
+                    continue
+                for ref, name in _candidate_fn_names(arg):
+                    fn = index.resolve(ref, name)
+                    if fn is not None:
+                        mark(fn, None, "jit-by-name")
+                    elif name in assigned_calls:
+                        # jitted name is a variable bound to a call result:
+                        # treat the producing call as the traced argument
+                        arg = assigned_calls[name]
+                # factory pattern: jit(X.method(...)) — the returned inner
+                # def of `method` is the traced function
+                if isinstance(arg, ast.Call):
+                    fname = terminal_name(arg.func)
+                    if fname and fname not in JIT_CALL_NAMES and fname != "partial":
+                        factories.add(fname)
+
+    # resolve factories: a def whose name was jitted-by-result and which
+    # returns an inner def by name -> that inner def is traced
+    for node in ast.walk(mod.tree):
+        if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        if node.name not in factories:
+            continue
+        inner = {
+            n.name: n for n in ast.walk(node)
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+            and n is not node
+        }
+        for ret in ast.walk(node):
+            if isinstance(ret, ast.Return) and isinstance(ret.value, ast.Name):
+                fn = inner.get(ret.value.id)
+                if fn is not None:
+                    mark(fn, None, "factory")
+
+    # propagate: a traced def calling a same-module def by bare Name makes
+    # the callee traced too, tainting only the argument positions that are
+    # tainted at the call site. Fixpoint over the (small) traced set.
+    changed = True
+    passes = 0
+    while changed and passes < 20:
+        changed = False
+        passes += 1
+        for td in list(traced.values()):
+            if isinstance(td.node, ast.Lambda):
+                continue
+            taint = TaintWalker(td, mod)
+            taint.run()
+            for call, tainted_idx in taint.local_calls:
+                fname = terminal_name(call.func)
+                if fname is None:
+                    continue
+                fn = index.resolve(call, fname)
+                if fn is None or not isinstance(
+                    fn, (ast.FunctionDef, ast.AsyncFunctionDef)
+                ):
+                    continue
+                if fn.lineno in mod.suppressions.host_lines:
+                    continue  # explicit host helper: no traced propagation
+                prev = traced.get(id(fn))
+                before = (
+                    None if prev is None
+                    else (None if prev.tainted_params is None
+                          else frozenset(prev.tainted_params))
+                )
+                mark(fn, set(tainted_idx), "called-from-traced")
+                after = traced[id(fn)].tainted_params
+                after_k = None if after is None else frozenset(after)
+                if prev is None or before != after_k:
+                    changed = True
+    return traced
+
+
+class TaintWalker:
+    """Single forward pass over one traced def's body, tracking tainted
+    names and recording (a) rule-relevant events for trace_rules/shape_rules
+    and (b) calls to same-scope defs with their tainted arg positions."""
+
+    def __init__(self, td: TracedDef, mod):
+        self.td = td
+        self.mod = mod
+        self.tainted: Set[str] = set()
+        fn = td.node
+        args = fn.args
+        params = (
+            [a.arg for a in args.posonlyargs]
+            + [a.arg for a in args.args]
+            + ([args.vararg.arg] if args.vararg else [])
+            + [a.arg for a in args.kwonlyargs]
+            + ([args.kwarg.arg] if args.kwarg else [])
+        )
+        static = self._static_params(fn)
+        if td.tainted_params is None:
+            self.tainted = {p for i, p in enumerate(params) if i not in static}
+        else:
+            self.tainted = {
+                p for i, p in enumerate(params) if i in td.tainted_params
+            }
+        #: (Name call node, tainted positional indices) for local-def calls
+        self.local_calls: List[Tuple[ast.Call, Set[int]]] = []
+        #: events: ("coerce"|"branch"|"hostsync", node, detail)
+        self.events: List[Tuple[str, ast.AST, str]] = []
+
+    @staticmethod
+    def _static_params(fn) -> Set[int]:
+        """Indices named by static_argnums in a jit decorator, best-effort."""
+        out: Set[int] = set()
+        for dec in getattr(fn, "decorator_list", ()):
+            if not isinstance(dec, ast.Call):
+                continue
+            for kw in dec.keywords:
+                if kw.arg == "static_argnums":
+                    for n in ast.walk(kw.value):
+                        if isinstance(n, ast.Constant) and isinstance(
+                            n.value, int
+                        ):
+                            out.add(n.value)
+        return out
+
+    # ------------------------------------------------------------ expression
+    def is_tainted(self, node: ast.AST) -> bool:
+        if isinstance(node, ast.Name):
+            return node.id in self.tainted
+        if isinstance(node, ast.Attribute):
+            if node.attr in STATIC_ATTRS:
+                return False
+            return self.is_tainted(node.value)
+        if isinstance(node, ast.Subscript):
+            return self.is_tainted(node.value) or self.is_tainted(node.slice)
+        if isinstance(node, ast.Call):
+            if isinstance(node.func, ast.Attribute) and self.is_tainted(
+                node.func.value
+            ):
+                return True
+            return any(self.is_tainted(a) for a in node.args) or any(
+                self.is_tainted(kw.value) for kw in node.keywords
+            )
+        if isinstance(node, (ast.BinOp,)):
+            return self.is_tainted(node.left) or self.is_tainted(node.right)
+        if isinstance(node, ast.UnaryOp):
+            return self.is_tainted(node.operand)
+        if isinstance(node, ast.BoolOp):
+            return any(self.is_tainted(v) for v in node.values)
+        if isinstance(node, ast.Compare):
+            return self.is_tainted(node.left) or any(
+                self.is_tainted(c) for c in node.comparators
+            )
+        if isinstance(node, ast.IfExp):
+            return any(
+                self.is_tainted(n) for n in (node.test, node.body, node.orelse)
+            )
+        if isinstance(node, (ast.Tuple, ast.List, ast.Set)):
+            return any(self.is_tainted(e) for e in node.elts)
+        if isinstance(node, ast.Dict):
+            return any(
+                v is not None and self.is_tainted(v)
+                for v in list(node.keys) + list(node.values)
+            )
+        if isinstance(node, ast.Starred):
+            return self.is_tainted(node.value)
+        if isinstance(node, (ast.ListComp, ast.SetComp, ast.DictComp, ast.GeneratorExp)):
+            return any(self.is_tainted(g.iter) for g in node.generators)
+        if isinstance(node, ast.Slice):
+            return any(
+                p is not None and self.is_tainted(p)
+                for p in (node.lower, node.upper, node.step)
+            )
+        return False
+
+    def _branch_test_tainted(self, test: ast.AST) -> bool:
+        """Is a branch test tainted, ignoring identity checks (`x is None`)
+        and isinstance — both are static under tracing."""
+        if isinstance(test, ast.Compare) and all(
+            isinstance(op, (ast.Is, ast.IsNot)) for op in test.ops
+        ):
+            return False
+        if isinstance(test, ast.Call) and terminal_name(test.func) in (
+            "isinstance", "hasattr", "callable", "len",
+        ):
+            return False
+        if isinstance(test, ast.BoolOp):
+            return any(self._branch_test_tainted(v) for v in test.values)
+        if isinstance(test, ast.UnaryOp) and isinstance(test.op, ast.Not):
+            return self._branch_test_tainted(test.operand)
+        return self.is_tainted(test)
+
+    # ------------------------------------------------------------- statements
+    def run(self):
+        fn = self.td.node
+        for stmt in getattr(fn, "body", []):
+            self._stmt(stmt)
+
+    def _assign_target(self, target: ast.AST, tainted: bool):
+        if isinstance(target, ast.Name):
+            if tainted:
+                self.tainted.add(target.id)
+            else:
+                self.tainted.discard(target.id)
+        elif isinstance(target, (ast.Tuple, ast.List)):
+            for e in target.elts:
+                self._assign_target(e, tainted)
+        elif isinstance(target, ast.Starred):
+            self._assign_target(target.value, tainted)
+        # attribute/subscript stores don't change name taint
+
+    def _scan_expr(self, node: ast.AST):
+        """Record rule events inside one expression tree."""
+        for sub in ast.walk(node):
+            if not isinstance(sub, ast.Call):
+                continue
+            t = terminal_name(sub.func)
+            if t in ("float", "int", "bool", "complex") and isinstance(
+                sub.func, ast.Name
+            ):
+                if any(self.is_tainted(a) for a in sub.args):
+                    self.events.append(("coerce", sub, t))
+            elif t in ("item", "tolist", "block_until_ready") and isinstance(
+                sub.func, ast.Attribute
+            ):
+                if self.is_tainted(sub.func.value):
+                    self.events.append(("hostsync", sub, t))
+            elif t == "device_get":
+                if any(self.is_tainted(a) for a in sub.args):
+                    self.events.append(("hostsync", sub, t))
+            # same-scope local call: record tainted arg positions so the
+            # module fixpoint can propagate traced context into helpers
+            if isinstance(sub.func, ast.Name):
+                idx = {
+                    i for i, a in enumerate(sub.args) if self.is_tainted(a)
+                }
+                self.local_calls.append((sub, idx))
+
+    def _stmt(self, stmt: ast.AST):
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            return  # nested defs analyzed via their own traced entries
+        if isinstance(stmt, ast.Assign):
+            self._scan_expr(stmt.value)
+            tainted = self.is_tainted(stmt.value)
+            for t in stmt.targets:
+                self._assign_target(t, tainted)
+            return
+        if isinstance(stmt, ast.AnnAssign):
+            if stmt.value is not None:
+                self._scan_expr(stmt.value)
+                self._assign_target(stmt.target, self.is_tainted(stmt.value))
+            return
+        if isinstance(stmt, ast.AugAssign):
+            self._scan_expr(stmt.value)
+            if self.is_tainted(stmt.value):
+                self._assign_target(stmt.target, True)
+            return
+        if isinstance(stmt, (ast.If, ast.While)):
+            self._scan_expr(stmt.test)
+            if self._branch_test_tainted(stmt.test):
+                self.events.append(
+                    ("branch", stmt, ast.dump(stmt.test)[:40])
+                )
+            for s in stmt.body:
+                self._stmt(s)
+            for s in stmt.orelse:
+                self._stmt(s)
+            return
+        if isinstance(stmt, ast.Assert):
+            self._scan_expr(stmt.test)
+            if self._branch_test_tainted(stmt.test):
+                self.events.append(("branch", stmt, "assert"))
+            return
+        if isinstance(stmt, ast.For):
+            self._scan_expr(stmt.iter)
+            # iterating a traced ARRAY unrolls (or fails) under jit, but
+            # iterating a metrics/pytree dict is idiomatic in every executor
+            # here (`for k, (op, v) in metrics.items()`), and the two are
+            # indistinguishable statically — so loop targets stay untainted
+            self._assign_target(stmt.target, False)
+            for s in stmt.body:
+                self._stmt(s)
+            for s in stmt.orelse:
+                self._stmt(s)
+            return
+        if isinstance(stmt, (ast.Return, ast.Expr)):
+            if getattr(stmt, "value", None) is not None:
+                self._scan_expr(stmt.value)
+            return
+        if isinstance(stmt, ast.With):
+            for item in stmt.items:
+                self._scan_expr(item.context_expr)
+            for s in stmt.body:
+                self._stmt(s)
+            return
+        if isinstance(stmt, ast.Try):
+            for part in (stmt.body, stmt.orelse, stmt.finalbody):
+                for s in part:
+                    self._stmt(s)
+            for h in stmt.handlers:
+                for s in h.body:
+                    self._stmt(s)
+            return
+        # fallback: scan any expressions hanging off the statement
+        for sub in ast.iter_child_nodes(stmt):
+            if isinstance(sub, ast.expr):
+                self._scan_expr(sub)

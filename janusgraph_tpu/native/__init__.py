@@ -50,6 +50,10 @@ def _load() -> Optional[ctypes.CDLL]:
             # os.replace makes whoever finishes last win atomically
             tmp = f"{so}.{os.getpid()}.tmp"
             try:
+                # one-time g++ compile deliberately holds _lock: concurrent
+                # callers should wait for the native library rather than
+                # silently falling back to numpy for the whole process life
+                # graphlint: disable=JG203 -- intentional: first-use compile gate; waiting beats losing the native path
                 subprocess.run(
                     [
                         "g++", "-O3", "-std=c++17", "-shared", "-fPIC",

@@ -53,6 +53,7 @@ def _tier(need: int, lo: int, hi: int, growth: int = 4) -> int:
     return min(c, hi)
 
 
+# graphlint: traced -- shared by the single-chip and sharded frontier steps
 def capped_expand(jnp, idx, indptr, dst, E_cap, sentinel):
     """Capped frontier expansion: frontier rows -> (owner slot, edge pos,
     neighbor, valid) buffers of static length E_cap. Shared by the

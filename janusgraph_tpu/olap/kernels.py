@@ -209,6 +209,7 @@ class ELLPack:
         return self
 
 
+# graphlint: traced -- called from every compiled superstep body
 def flat_take(jnp, tab, idx):
     """Gather rows/values of `tab` by a 2-D index matrix via a FLAT 1-D
     take + reshape. Identical semantics to tab[idx], but the (rows, 1) 2-D
@@ -221,6 +222,7 @@ def flat_take(jnp, tab, idx):
     return jnp.take(tab, flat, axis=0).reshape(idx.shape + tab.shape[1:])
 
 
+# graphlint: traced -- the ELL aggregation body of every compiled superstep
 def ell_aggregate(
     jnp,
     pack: ELLPack,

@@ -80,6 +80,7 @@ def check_weighted_transforms(program, csr) -> None:
 
 
 @lru_cache(maxsize=64)
+# graphlint: host -- cached NUMPY constants by design; caching xp arrays would leak tracers
 def _col_masks(cols):
     """Per-column {0,1} transform masks, cached as NUMPY — the CPU oracle
     calls the transform once per edge delivery, and caching xp arrays
@@ -97,6 +98,7 @@ def _col_masks(cols):
     return mul, add
 
 
+# graphlint: traced -- routed into every executor's compiled body (xp=jnp)
 def apply_edge_transform(xp, msgs, w, transform, cols=None):
     """Apply a program's in-flight edge transform — THE one shared
     implementation (cpu/tpu-segment/ELL/sharded bodies all route here so

@@ -333,6 +333,10 @@ class StandardIDPool:
                         err, self._prefetch_error = self._prefetch_error, None
                         raise err
                     continue
+                # synchronous fallback: the double-buffer missed, so there
+                # are NO ids to hand out until the claim round-trip (incl.
+                # its propagation wait) completes — contenders must block
+                # graphlint: disable=JG203 -- intentional: empty pool, callers must wait for the block claim
                 self._current = self._fetch()
 
     def next_ids(self, count: int):
@@ -348,6 +352,8 @@ class StandardIDPool:
                     if self._next_block is not None:
                         self._current, self._next_block = self._next_block, None
                     else:
+                        # same synchronous-fallback contract as next_id
+                        # graphlint: disable=JG203 -- intentional: empty pool, callers must wait for the block claim
                         self._current = self._fetch()
                 start, taken = self._current.next_span(remaining)
                 if taken:

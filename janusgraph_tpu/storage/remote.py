@@ -531,6 +531,10 @@ class RemoteStoreManager(KeyColumnValueStoreManager):
         def attempt() -> bytes:
             conn = self._acquire()
             with conn.lock:
+                # the per-connection lock EXISTS to serialize request/
+                # response framing on one socket; holding it across the
+                # round-trip is the design (the pool provides parallelism)
+                # graphlint: disable=JG203 -- intentional: conn.lock serializes framing on this socket; concurrency comes from the pool
                 status, payload, _sock = conn.request(op, body)
             if status != _STATUS_OK:
                 _raise_status(status, payload)

@@ -1,0 +1,35 @@
+"""JG203 fixture: blocking calls while holding a lock (parse-only)."""
+import socket
+import threading
+import time
+
+
+class Client:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.sock = None
+
+    def direct(self):
+        with self._lock:
+            time.sleep(0.5)  # expect: JG203
+
+    def rpc_under_lock(self, payload):
+        with self._lock:
+            self.sock.sendall(payload)  # expect: JG203
+
+    def transitive(self):
+        with self._lock:
+            return self._slow_io()  # expect: JG203
+
+    def _slow_io(self):
+        time.sleep(1.0)
+        return socket.create_connection(("localhost", 1))
+
+    def fine(self):
+        with self._lock:
+            value = self._fast()
+        time.sleep(0.01)  # after release: must NOT fire
+        return value
+
+    def _fast(self):
+        return 1

@@ -1,0 +1,23 @@
+"""JG202 fixture: inconsistent lock acquisition order (parse-only).
+
+The cycle closes across two methods, so the exact report line depends on
+edge ordering — the test asserts at file granularity (expect-file).
+"""
+# expect-file: JG202
+import threading
+
+
+class TwoLocks:
+    def __init__(self):
+        self._alpha_lock = threading.Lock()
+        self._beta_lock = threading.Lock()
+
+    def forward(self):
+        with self._alpha_lock:
+            with self._beta_lock:
+                return 1
+
+    def backward(self):
+        with self._beta_lock:
+            with self._alpha_lock:
+                return 2

@@ -1,0 +1,34 @@
+"""JG201 fixture: bare acquire without guaranteed release (parse-only)."""
+import threading
+
+
+class Pool:
+    def __init__(self):
+        self._lock = threading.Lock()
+
+    def leaky(self):
+        self._lock.acquire()  # expect: JG201
+        self.do_work()
+        self._lock.release()
+
+    def fine_with(self):
+        with self._lock:
+            self.do_work()
+
+    def fine_try_finally(self):
+        self._lock.acquire()
+        try:
+            self.do_work()
+        finally:
+            self._lock.release()
+
+    def fine_reacquire(self):
+        with self._lock:
+            self._lock.release()
+            try:
+                self.do_work()
+            finally:
+                self._lock.acquire()
+
+    def do_work(self):
+        pass

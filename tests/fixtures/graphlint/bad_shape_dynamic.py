@@ -1,0 +1,19 @@
+"""JG303 fixture: data-dependent shapes inside jit bodies (parse-only)."""
+import jax
+import jax.numpy as jnp
+
+
+@jax.jit
+def dynamic(mask, x):
+    idx = jnp.nonzero(mask)  # expect: JG303
+    hits = jnp.where(mask)  # expect: JG303
+    labels = jnp.unique(x)  # expect: JG303
+    return idx, hits, labels
+
+
+@jax.jit
+def fixed(mask, x):
+    # static-size forms: must NOT fire
+    idx = jnp.nonzero(mask, size=128, fill_value=0)[0]
+    sel = jnp.where(mask, x, 0.0)
+    return idx, sel

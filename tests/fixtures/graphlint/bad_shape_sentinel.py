@@ -1,0 +1,12 @@
+"""JG302 fixture: literal padding fills instead of the sentinel (parse-only)."""
+import numpy as np
+
+
+def pad_indices(rows, cap, sentinel):
+    bad = np.full((rows, cap), 999, dtype=np.int32)  # expect: JG302
+    also_bad = np.full((rows, cap), 1 << 20)  # expect: JG302
+    good = np.full((rows, cap), sentinel, dtype=np.int32)
+    zeros = np.full((rows, cap), 0, dtype=np.int32)  # identity: fine
+    minus = np.full((rows, cap), -1, dtype=np.int32)  # conventional: fine
+    floats = np.full((rows, cap), 3.5, dtype=np.float32)  # float: fine
+    return bad, also_bad, good, zeros, minus, floats

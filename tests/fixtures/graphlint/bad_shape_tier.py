@@ -1,0 +1,18 @@
+"""JG301 fixture: non-power-of-two capacity tiers (parse-only)."""
+
+E_CAP = 3000  # expect: JG301
+F_MIN = 1000  # expect: JG301
+MAX_EDGES = 1 << 30  # pow2: must NOT fire
+
+
+class Engine:
+    E_MIN = 1 << 13  # pow2: must NOT fire
+    ROW_CAP = 24  # expect: JG301
+
+
+def pack(edges, max_capacity=10000):  # expect: JG301
+    return edges[:max_capacity]
+
+
+def expand(idx, E_cap=1 << 14):  # pow2 default: must NOT fire
+    return idx[:E_cap]

@@ -1,0 +1,44 @@
+"""JG101 fixture: Python coercion of / branching on traced values.
+
+Never imported — parsed by graphlint only (tests/test_static_analysis.py).
+"""
+import jax
+import jax.numpy as jnp
+
+
+@jax.jit
+def coerces(x, y):
+    a = float(x)  # expect: JG101
+    b = int(x + y)  # expect: JG101
+    c = bool(y)  # expect: JG101
+    return a + b + c
+
+
+@jax.jit
+def branches(x, flag):
+    if x > 0:  # expect: JG101
+        return x
+    while flag:  # expect: JG101
+        x = x - 1
+    assert x >= 0  # expect: JG101
+    return x
+
+
+@jax.jit
+def clean(x, w):
+    # none of these may fire: static attrs, is-checks, identity on host vals
+    if x.ndim == 3:
+        x = x.sum(axis=-1)
+    if w is not None:
+        x = x * w
+    return jnp.where(x > 0, x, 0.0)
+
+
+def step(state, k):
+    y = state * k
+    if y.sum() > 0:  # expect: JG101
+        return y
+    return -y
+
+
+_compiled = jax.jit(step)

@@ -1,0 +1,15 @@
+"""JG104 fixture: donated buffer reused after the call (parse-only)."""
+import jax
+
+
+def run(step_fn, state, other):
+    step = jax.jit(step_fn, donate_argnums=(0,))
+    new_state = step(state, other)
+    stale = state + 1  # expect: JG104
+    return new_state, stale
+
+
+def fine(step_fn, state, other):
+    step = jax.jit(step_fn, donate_argnums=(0,))
+    state = step(state, other)  # rebinding the name: not a reuse
+    return state + other

@@ -1,0 +1,16 @@
+"""JG105 fixture: host syncs inside jit bodies (parse-only fixture)."""
+import jax
+
+
+@jax.jit
+def syncs(x, y):
+    a = x.item()  # expect: JG105
+    b = y.tolist()  # expect: JG105
+    x.block_until_ready()  # expect: JG105
+    c = jax.device_get(x)  # expect: JG105
+    return a, b, c
+
+
+def host(x):
+    # host-side sync is fine: must NOT fire
+    return x.item()

@@ -1,0 +1,21 @@
+"""Marker fixture: `# graphlint: traced` opts helpers into the trace rules,
+`# graphlint: host` opts helpers out of traced propagation (parse-only)."""
+import jax
+import numpy as np
+
+
+# graphlint: traced
+def marked_helper(xp, msgs):
+    pad = np.zeros(4)  # expect: JG102
+    return xp.asarray(pad) + msgs
+
+
+# graphlint: host -- builds static numpy constants on purpose
+def host_constants(k):
+    return np.arange(k)  # must NOT fire: host-marked, numpy is the point
+
+
+@jax.jit
+def body(x):
+    masks = host_constants(4)
+    return x * masks

@@ -1,0 +1,23 @@
+"""JG102 fixture: numpy calls inside jit bodies (parse-only fixture)."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@jax.jit
+def uses_numpy(x):
+    y = np.asarray(x)  # expect: JG102
+    z = np.concatenate([y, y])  # expect: JG102
+    return jnp.asarray(z)
+
+
+def host_side(x):
+    # numpy on host (not a traced context): must NOT fire
+    return np.asarray(x).sum()
+
+
+def kernel_body(a, b):
+    return a + np.float32(b)  # expect: JG102
+
+
+_fn = jax.jit(kernel_body)

@@ -1,0 +1,20 @@
+"""JG103 fixture: retrace hazards (parse-only fixture)."""
+import jax
+
+
+def make(fn, axes):
+    # non-constant static_argnums: retraces per distinct value
+    return jax.jit(fn, static_argnums=axes)  # expect: JG103
+
+
+def per_item(fns, xs):
+    out = []
+    for f, x in zip(fns, xs):
+        g = jax.jit(f)  # expect: JG103
+        out.append(g(x))
+    return out
+
+
+def fine(fn):
+    # constant literal argnums: must NOT fire
+    return jax.jit(fn, static_argnums=(0, 2))

@@ -1,0 +1,17 @@
+"""Suppression fixture: every violation here is disabled (parse-only).
+
+The test asserts graphlint reports ZERO live findings for this file, and
+that --show-suppressed surfaces them as suppressed.
+"""
+import threading
+import time
+
+E_CAP = 3000  # graphlint: disable=JG301 -- test fixture: tier chosen by hardware table
+
+_lock = threading.Lock()
+
+
+def poll():
+    with _lock:
+        # graphlint: disable=JG203 -- test fixture: bounded 1ms wait by design
+        time.sleep(0.001)
