@@ -434,6 +434,15 @@ def _bench_scale(
     pr_eps = pr_iters * csr.num_edges / pr_s
     _hb(f"s{scale}: pagerank {pr_s:.3f}s ({pr_eps:.3e} edges/s)", t0)
 
+    # telemetry snapshot rides the artifact so BENCH_r*.json lines are
+    # self-explaining: per-superstep records (wall, frontier, pad,
+    # transfer, compile flags) from the registry-published run record
+    run_rec = dict(ex.last_run_info)
+    telemetry = {
+        "superstep_records": run_rec.pop("superstep_records", [])[:32],
+        "run": {k: v for k, v in run_rec.items() if k != "tiers"},
+    }
+
     base_iters = 3 if scale >= 20 else 5
     base_eps = host_pagerank_edges_per_sec(csr, iters=base_iters)
 
@@ -487,6 +496,7 @@ def _bench_scale(
                               "transfer once per executor",
         "ell_bytes": ell_fp["bytes"],
         "ell_pad_ratio": round(ell_fp["pad_ratio"], 3),
+        "telemetry": telemetry,
     })
 
     # BFS both ways: frontier-compacted (the default; olap/frontier.py) and
@@ -1022,6 +1032,12 @@ def _oltp_stage(t0):
     dst = csr.out_dst[:edge_cap]
 
     def _measure(backend_name, cfg):
+        # per-backend store latency histograms attach to the stage line
+        # (reset between backends so the snapshots don't mix)
+        from janusgraph_tpu.util.metrics import metrics as _reg
+
+        _reg.reset()
+        cfg = dict(cfg, **{"metrics.enabled": True})
         g = open_graph(cfg)
         g.management().make_edge_label("knows")
         v0 = time.perf_counter()
@@ -1065,6 +1081,17 @@ def _oltp_stage(t0):
         query_s = time.perf_counter() - q0
         tx.rollback()
         g.close()
+        store_hists = {
+            name: {
+                "count": m["count"],
+                "p50_ms": round(m["p50_ms"], 4),
+                "p95_ms": round(m["p95_ms"], 4),
+                "p99_ms": round(m["p99_ms"], 4),
+                "total_ms": round(m["total_ms"], 2),
+            }
+            for name, m in _reg.snapshot().items()
+            if m["type"] == "timer" and name.startswith(("storage.", "tx."))
+        }
         line = {
             "stage": "oltp", "backend": backend_name, "scale": scale,
             "vertices": csr.num_vertices, "edges_written": len(src),
@@ -1074,6 +1101,7 @@ def _oltp_stage(t0):
             "commits_per_s": round(commits / edge_s, 2),
             "multiquery_vertices_per_s": round(len(vs) / query_s, 1),
             "multiquery_edges_read": edges_read,
+            "telemetry": {"store_histograms": store_hists},
         }
         _hb(
             f"oltp[{backend_name}]: {line['add_edge_per_s']:.0f} addEdge/s "

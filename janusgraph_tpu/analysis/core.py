@@ -47,6 +47,11 @@ RULES: Dict[str, Rule] = {
         Rule("JG105", SEV_ERROR,
              "host sync inside a jit context (.item()/.tolist()/"
              ".block_until_ready()/device_get)"),
+        Rule("JG106", SEV_ERROR,
+             "metric/span recording call inside a jit-traced context "
+             "(records once per COMPILE, not per execution; coercing a "
+             "traced attribute value forces a host sync — record from "
+             "host code after the dispatch)"),
         # -- lock discipline ------------------------------------------------
         Rule("JG201", SEV_ERROR,
              "lock.acquire() without with/try-finally release on all paths"),

@@ -15,6 +15,13 @@ JG104  donated buffer reuse: an argument passed at a donate_argnums
        position is dead after the call — its HBM was handed to the output.
 JG105  host sync in a jit context: `.item()`, `.tolist()`,
        `.block_until_ready()`, `jax.device_get` on traced values.
+JG106  telemetry recording inside a jit context: a metric/span call on
+       the observability registry/tracer (`metrics.counter(...).inc()`,
+       `with span("...")`, `registry.time(...)`, ...) in a traced body
+       runs at TRACE time — it records once per compile, not per
+       execution, and any traced attribute value is a host-sync hazard.
+       Record from host code after the dispatch (see
+       TPUExecutor._finish_run for the sanctioned pattern).
 """
 
 from __future__ import annotations
@@ -145,6 +152,66 @@ def _check_jit_callsites(mod) -> List[Finding]:
     return out
 
 
+#: receiver names that identify the telemetry layer (the observability
+#: singletons and their conventional aliases)
+_TELEMETRY_ROOTS = {"metrics", "registry", "tracer", "telemetry"}
+#: method names that record into that layer
+_TELEMETRY_RECORDERS = {
+    "counter", "timer", "histogram", "gauge", "time", "span",
+    "record_span", "record_run", "inc", "update", "observe", "set_gauge",
+    "annotate",
+}
+#: bare-name calls from `from janusgraph_tpu.observability import span`
+_SPAN_BARE_NAMES = {"span", "record_span"}
+
+
+def _chain_names(node: ast.AST) -> Set[str]:
+    """Every Name id and Attribute attr along a call/attribute chain:
+    `metrics.counter("x").inc` -> {"metrics", "counter", "inc"}."""
+    out: Set[str] = set()
+    while node is not None:
+        if isinstance(node, ast.Attribute):
+            out.add(node.attr)
+            node = node.value
+        elif isinstance(node, ast.Call):
+            node = node.func
+        elif isinstance(node, ast.Name):
+            out.add(node.id)
+            return out
+        else:
+            return out
+    return out
+
+
+def _check_telemetry_in_trace(mod) -> List[Finding]:
+    """JG106: metric/span recording calls inside traced bodies. The
+    receiver chain must touch a telemetry root name — `.update()` on a
+    dict or `x.at[i].set(v)` never match."""
+    out: List[Finding] = []
+    for td in find_traced_defs(mod).values():
+        name = getattr(td.node, "name", "<lambda>")
+        for sub in ast.walk(td.node):
+            if not isinstance(sub, ast.Call):
+                continue
+            t = terminal_name(sub.func)
+            hit = isinstance(sub.func, ast.Name) and t in _SPAN_BARE_NAMES
+            if (
+                not hit
+                and isinstance(sub.func, ast.Attribute)
+                and t in _TELEMETRY_RECORDERS
+            ):
+                hit = bool(_chain_names(sub.func.value) & _TELEMETRY_ROOTS)
+            if hit:
+                out.append(_finding(
+                    "JG106", mod, sub,
+                    f"telemetry call `{ast.unparse(sub.func)}` inside jit "
+                    f"context `{name}` — it records once per compile (not "
+                    f"per execution) and traced attribute values force a "
+                    f"host sync; record host-side after the dispatch",
+                ))
+    return out
+
+
 def _check_donated_reuse(mod) -> List[Finding]:
     """JG104: best-effort, function-scope-local. Tracks
     `f = jax.jit(g, donate_argnums=(i,))` then `f(x, ...)` then a later
@@ -213,4 +280,5 @@ def check_module(mod) -> List[Finding]:
     out = _check_traced_bodies(mod)
     out.extend(_check_jit_callsites(mod))
     out.extend(_check_donated_reuse(mod))
+    out.extend(_check_telemetry_in_trace(mod))
     return out

@@ -179,6 +179,36 @@ def cmd_storage_server(args) -> int:
     return 0
 
 
+def cmd_telemetry(args) -> int:
+    """Dump telemetry: Prometheus text (default) or the JSON snapshot.
+    With --url, scrape a RUNNING server's /metrics (or /telemetry with
+    --json); without, render this process's registry — useful from
+    scripts/consoles that imported the package and did work."""
+    if args.url:
+        import urllib.request
+
+        base = args.url.rstrip("/")
+        if not base.startswith("http"):
+            base = "http://" + base
+        path = "/telemetry" if args.json else "/metrics"
+        with urllib.request.urlopen(base + path, timeout=10) as resp:
+            sys.stdout.write(resp.read().decode("utf-8"))
+        return 0
+    from janusgraph_tpu.observability import (
+        json_snapshot,
+        prometheus_text,
+        registry,
+        tracer,
+    )
+
+    if args.json:
+        print(json.dumps(json_snapshot(registry, tracer), indent=2,
+                         default=str))
+    else:
+        sys.stdout.write(prometheus_text(registry))
+    return 0
+
+
 def cmd_config_docs(args) -> int:
     from janusgraph_tpu.core.config import describe_options
 
@@ -268,6 +298,18 @@ def main(argv=None) -> int:
         help="serve an N-node sharded composite (N >= 1)",
     )
     pss.set_defaults(fn=cmd_storage_server)
+
+    pt = sub.add_parser(
+        "telemetry",
+        help="dump telemetry (Prometheus text, or JSON with --json)",
+    )
+    pt.add_argument(
+        "--url", help="scrape a running server (host:port or http URL) "
+        "instead of this process's registry",
+    )
+    pt.add_argument("--json", action="store_true",
+                    help="JSON snapshot (metrics + spans + slow ops)")
+    pt.set_defaults(fn=cmd_telemetry)
 
     pd = sub.add_parser("config-docs", help="render the config reference")
     pd.add_argument("--out", help="write to this file instead of stdout")

@@ -711,6 +711,22 @@ METRICS_NS.option(
 METRICS_NS.option(
     "csv-directory", str, "directory the CSV reporter writes into", "",
 )
+METRICS_NS.option(
+    "slow-op-threshold-ms", float,
+    "spans slower than this land in the always-on slow-op ring buffer "
+    "(0 = off; observability/spans.py — surfaced at GET /telemetry)",
+    100.0, Mutability.MASKABLE, lambda v: v >= 0,
+)
+METRICS_NS.option(
+    "span-buffer", int,
+    "completed root-span trees retained for GET /telemetry",
+    256, Mutability.LOCAL, lambda v: v > 0,
+)
+METRICS_NS.option(
+    "slow-op-buffer", int,
+    "slow-op events retained in the ring buffer",
+    128, Mutability.LOCAL, lambda v: v > 0,
+)
 
 
 def describe_options() -> str:

@@ -326,6 +326,15 @@ class JanusGraphTPU:
         self._query_batch = cfg.get("query.batch")
         self._max_traversers = cfg.get("query.max-traversers")
         self._metric_reporters = []
+        # span tracer sizing + the always-on slow-op log threshold
+        # (observability/spans.py; GET /telemetry serves both buffers)
+        from janusgraph_tpu.observability import tracer as _tracer
+
+        _tracer.configure(
+            slow_threshold_ms=cfg.get("metrics.slow-op-threshold-ms"),
+            max_roots=cfg.get("metrics.span-buffer"),
+            slow_buffer=cfg.get("metrics.slow-op-buffer"),
+        )
         self.instance_registry = InstanceRegistry(self.backend)
         if not self.backend.read_only:
             if cfg.get("graph.replace-instance-if-exists"):
@@ -1393,7 +1402,12 @@ class JanusGraphTPU:
             offset,
         )
         provider = self.index_providers[idx.backing]
-        return [int(d) for d in provider.query(idx.name, q)]
+        from janusgraph_tpu.observability import registry, span as _span
+
+        with _span("index.mixed-query", index=idx.name,
+                   conditions=len(conditions)):
+            with registry.time("query.index.mixed"):
+                return [int(d) for d in provider.query(idx.name, q)]
 
     def _clamp_index_limit(self, limit):
         """index.search.max-result-set-size + query.hard-max-limit: every
@@ -1435,4 +1449,8 @@ class JanusGraphTPU:
         idx = self.indexes.get(index_name)
         if idx is None:
             raise SchemaViolationError(f"unknown index {index_name}")
-        return self.index_serializer.query(idx, values, tx.backend_tx)
+        from janusgraph_tpu.observability import registry, span as _span
+
+        with _span("index.lookup", index=index_name):
+            with registry.time("query.index.composite"):
+                return self.index_serializer.query(idx, values, tx.backend_tx)

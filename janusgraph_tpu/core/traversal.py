@@ -3117,14 +3117,24 @@ class GraphTraversal:
         Gremlin .profile() → QueryProfiler via TP3ProfileWrapper.java;
         annotations mirror SimpleQueryProfiler's condition/index notes)."""
         from janusgraph_tpu.core.profile import QueryProfiler, TraversalMetrics
+        from janusgraph_tpu.observability import tracer
 
         root = QueryProfiler("traversal")
 
         def observe(label, fn, ts):
             p = root.add_nested(label)
-            with p:
+            # each stage runs inside a span too, so storage/index spans
+            # (store.getSlice, index.lookup, ...) nest under the step —
+            # their counts feed back into the profiler annotations
+            with p, tracer.span(f"oltp.step.{label}") as stage:
                 out = fn(ts)
             p.annotate("traversers", len(out))
+            if stage.children:
+                p.annotate("store_ops", len(stage.children))
+                p.annotate(
+                    "store_ms",
+                    round(sum(c.duration_ms for c in stage.children), 3),
+                )
             if label == "start":
                 if self._pre_has:
                     p.annotate(
@@ -3135,7 +3145,7 @@ class GraphTraversal:
                     p.annotate(k, v)
             return out
 
-        with root:
+        with root, tracer.span("oltp.traversal"):
             ts = self._execute(observe)
         return TraversalMetrics(root, [t.obj for t in ts])
 

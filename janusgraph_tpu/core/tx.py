@@ -18,6 +18,7 @@ batched backend transaction.
 from __future__ import annotations
 
 import threading
+import time as _time
 from collections import defaultdict
 from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
 
@@ -66,6 +67,10 @@ class Transaction:
             self._metric = lambda op: _mm.counter(
                 f"{metrics_group}.{op}"
             ).inc()
+        from janusgraph_tpu.observability import registry as _registry
+
+        _registry.counter("tx.begin").inc()
+        self._t0_ns = _time.perf_counter_ns()
         self.backend_tx = graph.backend.begin_transaction()
         self._vertex_cache: Dict[int, Vertex] = {}
         # vid -> list of added relations incident to it (edges appear under
@@ -966,19 +971,43 @@ class Transaction:
             return
         if self._metric is not None:
             self._metric("commit")
-        try:
-            if self.has_mutations():
-                self.graph.commit_tx(self)
-            self.backend_tx.commit()
-        except BaseException:
-            # release buffered mutations AND any held lock claims
-            self.backend_tx.rollback()
-            raise
-        finally:
-            self._open = False
+        from janusgraph_tpu.observability import registry as _reg, span
+
+        with self._lock:
+            added = sum(len(v) for v in self._added.values())
+            deleted = len(self._deleted)
+        with span(
+            "tx.commit",
+            added=added,
+            deleted=deleted,
+            lifetime_ms=round(
+                (_time.perf_counter_ns() - self._t0_ns) / 1e6, 3
+            ),
+            group=self.metrics_group,
+        ):
+            with _reg.time("tx.commit"):
+                try:
+                    if self.has_mutations():
+                        self.graph.commit_tx(self)
+                    self.backend_tx.commit()
+                except BaseException:
+                    # release buffered mutations AND any held lock claims
+                    self.backend_tx.rollback()
+                    raise
+                finally:
+                    self._open = False
 
     def rollback(self) -> None:
-        self.backend_tx.rollback()
+        from janusgraph_tpu.observability import registry as _reg, span
+
+        _reg.counter("tx.rollback").inc()
+        with span(
+            "tx.rollback",
+            lifetime_ms=round(
+                (_time.perf_counter_ns() - self._t0_ns) / 1e6, 3
+            ),
+        ):
+            self.backend_tx.rollback()
         self._open = False
 
     def has_mutations(self) -> bool:

@@ -1,0 +1,67 @@
+"""Unified telemetry: histogram metrics, structured spans, exposition.
+
+The subsystem the reference spreads across its Dropwizard stack
+(reference: util/stats/MetricManager.java:36 registry singleton,
+MetricInstrumentedStore.java per-store timers, per-tx metric groups
+StandardJanusGraphTx.java:258-262, reporters
+GraphDatabaseConfiguration.java:1012-1094) plus what it does NOT have —
+a span tracer and OLAP superstep telemetry for the TPU path (compile vs
+execute split, retraces, transfer bytes, frontier occupancy, ELL pad
+waste), the quantities that actually dominate graph-engine performance
+(PAPERS.md: arxiv 2011.08451 propagation blocking, 2108.11521 on-chip
+communication for graph analytics).
+
+Layout:
+
+- ``metrics_core``: :class:`Counter`, :class:`Timer`, :class:`Histogram`,
+  :class:`Gauge`, and :class:`TelemetryRegistry` — the registry that
+  ``janusgraph_tpu.util.metrics`` re-exports as its ``metrics`` singleton
+  (absorbed from the old ``MetricManager``).
+- ``spans``: context-var tracer with parent/child nesting and the
+  always-on slow-op ring buffer.
+- ``exposition``: Prometheus-text and JSON snapshot renderers served at
+  ``GET /metrics`` / ``GET /telemetry`` and by
+  ``python -m janusgraph_tpu telemetry``.
+
+Recording is HOST-ONLY by contract: no metric or span call may run inside
+jit-traced code (it would record once per compile, not per execution, and
+coercing tracer attribute values forces a device sync). graphlint rule
+JG106 enforces this mechanically.
+"""
+
+from janusgraph_tpu.observability.exposition import (
+    json_snapshot,
+    prometheus_text,
+)
+from janusgraph_tpu.observability.metrics_core import (
+    BUCKET_BOUNDS,
+    Counter,
+    Gauge,
+    Histogram,
+    TelemetryRegistry,
+    Timer,
+)
+from janusgraph_tpu.observability.spans import Span, Tracer, tracer
+
+#: process-wide registry (reference: MetricManager.INSTANCE);
+#: `janusgraph_tpu.util.metrics.metrics` is THIS object
+registry = TelemetryRegistry()
+
+#: convenience alias: `with span("name", attr=...):` on the global tracer
+span = tracer.span
+
+__all__ = [
+    "BUCKET_BOUNDS",
+    "Counter",
+    "Gauge",
+    "Histogram",
+    "Span",
+    "TelemetryRegistry",
+    "Timer",
+    "Tracer",
+    "json_snapshot",
+    "prometheus_text",
+    "registry",
+    "span",
+    "tracer",
+]

@@ -1,0 +1,196 @@
+"""Structured spans: a context-var tracer with parent/child nesting.
+
+The reference has Gremlin ``.profile()`` for one traversal at a time;
+spans generalize that to every subsystem: the OLTP tx lifecycle
+(commit/rollback, lock acquisition, index queries), the storage backend
+(instrumented ``get_slice``/``mutate``, scan jobs) and the OLAP
+``GraphComputer.submit()`` path down to per-superstep children.
+
+Design:
+
+- ``contextvars`` carry the current span, so nesting follows Python's
+  call/async structure per thread with zero plumbing; a thread (or
+  context) always builds its own tree.
+- finished ROOT spans land in a bounded ring buffer (``recent()``); the
+  process never accumulates unbounded trees.
+- every finished span — root or child — whose duration crosses the
+  configured threshold is ALSO appended to the slow-op ring buffer
+  (``slow_ops()``), the always-on flight recorder for outliers
+  (threshold via ``metrics.slow-op-threshold-ms`` in core/config.py).
+- pre-timed children (``record_span``) let host-resident measurements —
+  e.g. per-superstep records reduced on device and fetched once — appear
+  in the tree without ever recording from traced code (graphlint JG106).
+"""
+
+from __future__ import annotations
+
+import contextvars
+import threading
+import time
+from collections import deque
+from contextlib import contextmanager
+from typing import Dict, List, Optional
+
+_CURRENT: "contextvars.ContextVar[Optional[Span]]" = contextvars.ContextVar(
+    "janusgraph_tpu_current_span", default=None
+)
+
+
+def _plain(value):
+    """Attribute values must be JSON-friendly host scalars — coercing a
+    traced/device value here would be a hidden sync, so only coerce known
+    host types and stringify the rest."""
+    if isinstance(value, (str, bool, int, float)) or value is None:
+        return value
+    try:
+        import numpy as np
+
+        if isinstance(value, np.integer):
+            return int(value)
+        if isinstance(value, np.floating):
+            return float(value)
+    except ImportError:  # numpy is always present here, but be safe
+        pass
+    return str(value)
+
+
+class Span:
+    """One timed node: name, attributes, children (cf. the profiler's
+    QueryProfiler group, but subsystem-agnostic and context-propagated)."""
+
+    __slots__ = ("name", "attrs", "children", "start_ns", "end_ns", "wall_t")
+
+    def __init__(self, name: str, attrs: Optional[dict] = None):
+        self.name = name
+        self.attrs: Dict[str, object] = (
+            {k: _plain(v) for k, v in attrs.items()} if attrs else {}
+        )
+        self.children: List["Span"] = []
+        self.start_ns = 0
+        self.end_ns = 0
+        self.wall_t = 0.0  # epoch seconds at start (for the slow-op log)
+
+    @property
+    def duration_ms(self) -> float:
+        return (self.end_ns - self.start_ns) / 1e6
+
+    def annotate(self, **attrs) -> "Span":
+        for k, v in attrs.items():
+            self.attrs[k] = _plain(v)
+        return self
+
+    def to_dict(self) -> dict:
+        return {
+            "name": self.name,
+            "duration_ms": round(self.duration_ms, 4),
+            "attrs": dict(self.attrs),
+            "children": [c.to_dict() for c in self.children],
+        }
+
+    def find(self, name: str) -> List["Span"]:
+        """All descendants (and self) with this name, depth-first."""
+        out = [self] if self.name == name else []
+        for c in self.children:
+            out.extend(c.find(name))
+        return out
+
+
+class Tracer:
+    """Owns the current-span context plus the two ring buffers."""
+
+    def __init__(
+        self,
+        max_roots: int = 256,
+        slow_threshold_ms: float = 100.0,
+        slow_buffer: int = 128,
+    ):
+        self.slow_threshold_ms = slow_threshold_ms
+        self._roots: deque = deque(maxlen=max_roots)
+        self._slow: deque = deque(maxlen=slow_buffer)
+        self._lock = threading.Lock()
+
+    def configure(
+        self,
+        max_roots: Optional[int] = None,
+        slow_threshold_ms: Optional[float] = None,
+        slow_buffer: Optional[int] = None,
+    ) -> None:
+        with self._lock:
+            if slow_threshold_ms is not None:
+                self.slow_threshold_ms = slow_threshold_ms
+            if max_roots is not None and max_roots != self._roots.maxlen:
+                self._roots = deque(self._roots, maxlen=max_roots)
+            if slow_buffer is not None and slow_buffer != self._slow.maxlen:
+                self._slow = deque(self._slow, maxlen=slow_buffer)
+
+    # -------------------------------------------------------------- recording
+    @contextmanager
+    def span(self, name: str, **attrs):
+        parent = _CURRENT.get()
+        s = Span(name, attrs)
+        if parent is not None:
+            parent.children.append(s)
+        token = _CURRENT.set(s)
+        s.wall_t = time.time()
+        s.start_ns = time.perf_counter_ns()
+        try:
+            yield s
+        finally:
+            s.end_ns = time.perf_counter_ns()
+            _CURRENT.reset(token)
+            self._finished(s, root=parent is None)
+
+    def record_span(self, name: str, duration_ms: float, **attrs) -> Span:
+        """Attach a pre-timed span under the current span (or as a root).
+        For measurements taken elsewhere — per-superstep records pulled
+        from host-resident reduced metrics, never from traced code."""
+        parent = _CURRENT.get()
+        s = Span(name, attrs)
+        now = time.perf_counter_ns()
+        s.wall_t = time.time() - duration_ms / 1e3
+        s.start_ns = now - int(duration_ms * 1e6)
+        s.end_ns = now
+        if parent is not None:
+            parent.children.append(s)
+        self._finished(s, root=parent is None)
+        return s
+
+    def _finished(self, s: Span, root: bool) -> None:
+        thr = self.slow_threshold_ms
+        if thr > 0 and s.duration_ms >= thr:
+            with self._lock:
+                self._slow.append({
+                    "name": s.name,
+                    "ms": round(s.duration_ms, 3),
+                    "time": s.wall_t,
+                    "attrs": dict(s.attrs),
+                })
+        if root:
+            with self._lock:
+                self._roots.append(s)
+
+    # -------------------------------------------------------------- querying
+    def current(self) -> Optional[Span]:
+        return _CURRENT.get()
+
+    def recent(self, name: Optional[str] = None) -> List[Span]:
+        """Completed root spans, oldest first (optionally name-filtered)."""
+        with self._lock:
+            roots = list(self._roots)
+        if name is not None:
+            roots = [r for r in roots if r.name == name]
+        return roots
+
+    def slow_ops(self) -> List[dict]:
+        with self._lock:
+            return [dict(e) for e in self._slow]
+
+    def reset(self) -> None:
+        with self._lock:
+            self._roots.clear()
+            self._slow.clear()
+
+
+#: process-wide tracer; `janusgraph_tpu.observability.span` is its
+#: `span` method
+tracer = Tracer()

@@ -177,6 +177,18 @@ class GraphComputer:
         return self
 
     def submit(self) -> ComputerResult:
+        """Load the CSR snapshot, run the program, wrap the result — the
+        whole pipeline under an `olap.submit` span (children: the
+        `olap.load_csr` snapshot load, the executor's `olap.run` with its
+        per-superstep spans, and one `olap.map_reduce` per job)."""
+        from janusgraph_tpu.observability import tracer
+
+        with tracer.span("olap.submit", executor=self.executor_kind) as sp:
+            return self._submit(sp)
+
+    def _submit(self, sp) -> ComputerResult:
+        from janusgraph_tpu.observability import tracer
+
         property_keys = self._property_keys
         traverse_args = getattr(self, "_traverse_args", None)
         if traverse_args is not None:
@@ -195,13 +207,17 @@ class GraphComputer:
         assert (
             self._program is not None or traverse_args is not None
         ), "program() not set"
-        csr = load_csr(
-            self.graph,
-            edge_labels=self._edge_labels,
-            vertex_labels=self._vertex_labels,
-            property_keys=property_keys,
-            weight_key=self._weight_key,
-        )
+        with tracer.span("olap.load_csr") as ls:
+            csr = load_csr(
+                self.graph,
+                edge_labels=self._edge_labels,
+                vertex_labels=self._vertex_labels,
+                property_keys=property_keys,
+                weight_key=self._weight_key,
+            )
+            ls.annotate(
+                num_vertices=csr.num_vertices, num_edges=csr.num_edges
+            )
         if traverse_args is not None:
             from janusgraph_tpu.olap.programs.olap_traversal import (
                 build_olap_traversal,
@@ -248,13 +264,18 @@ class GraphComputer:
                     "computer.frontier-tier-growth"
                 ),
             }
+        sp.annotate(program=type(self._program).__name__)
         states = run_on(csr, self._program, self.executor_kind, **run_kwargs)
         memory = {}
         if self._map_reduces:
             from janusgraph_tpu.olap.mapreduce import run_map_reduce
 
             for mr in self._map_reduces:
-                memory[mr.memory_key] = run_map_reduce(mr, states, csr)
+                with tracer.span(
+                    "olap.map_reduce", job=type(mr).__name__,
+                    key=mr.memory_key,
+                ):
+                    memory[mr.memory_key] = run_map_reduce(mr, states, csr)
         return ComputerResult(
             states=states, csr=csr, graph=self.graph, memory=memory,
             program=self._program,

@@ -14,11 +14,13 @@ handful of scalars.
 
 from __future__ import annotations
 
+import time
 from functools import partial
 from typing import Dict, Tuple
 
 import numpy as np
 
+from janusgraph_tpu.observability import registry, tracer
 from janusgraph_tpu.olap.csr import CSRGraph
 from janusgraph_tpu.olap.vertex_program import (
     Combiner,
@@ -161,6 +163,16 @@ def _segment_ids(indptr: np.ndarray, m: int) -> np.ndarray:
     return native.segment_ids(indptr, m)
 
 
+def _pytree_nbytes(tree) -> int:
+    """Total bytes of the array leaves of a dict/list pytree. Shape
+    arithmetic only (`.nbytes` is static metadata) — no device sync."""
+    if isinstance(tree, dict):
+        return sum(_pytree_nbytes(v) for v in tree.values())
+    if isinstance(tree, (list, tuple)):
+        return sum(_pytree_nbytes(v) for v in tree)
+    return int(getattr(tree, "nbytes", 0) or 0)
+
+
 def _segment_reduce(jnp, op: str, data, segment_ids, num_segments: int):
     import jax
 
@@ -246,8 +258,14 @@ class TPUExecutor:
         from collections import OrderedDict
 
         #: per-run execution record ({"path", "supersteps", "wall_s", ...});
-        #: the executor-level analogue of the OLTP .profile() tree
+        #: the executor-level analogue of the OLTP .profile() tree. Also
+        #: published through the telemetry registry after every run:
+        #: `registry.last_run("olap")` (observability/metrics_core.py)
         self.last_run_info: Dict[str, object] = {}
+        #: bytes of the graph-argument pytree shipped to the last compiled
+        #: dispatch (view fields + ELL buckets) — host-side arithmetic on
+        #: static shapes, no device sync
+        self._last_arg_bytes = 0
         self._compiled: Dict[str, object] = {}
         # view-field access sets per compiled variant (discovery trace);
         # None record = not discovering
@@ -517,6 +535,7 @@ class TPUExecutor:
         if strategy == "ell":
             args["ell"] = self._pack_args(pack)
             args["unpermute"] = pack.unpermute
+        self._last_arg_bytes = _pytree_nbytes(args)
         return args
 
     def _resolve_pack(self, program: VertexProgram, op: str, channel: str = None):
@@ -719,6 +738,7 @@ class TPUExecutor:
         if frontier not in (None, "auto", "off", "always"):
             raise ValueError(f"unknown frontier mode: {frontier!r}")
         mode = frontier or self._frontier_cfg
+        use_frontier = False
         if mode != "off" and self._frontier_family(program):
             if checkpoint_path:
                 # the frontier loop has no checkpoint support; "always"
@@ -732,7 +752,7 @@ class TPUExecutor:
                         "frontier='auto'"
                     )
             elif self._frontier_eligible(program, mode):
-                return self._run_frontier(program)
+                use_frontier = True
             elif mode == "always":
                 # surface WHY the guards refused instead of silently
                 # timing the dense path under a frontier label
@@ -745,13 +765,123 @@ class TPUExecutor:
                 )
         if fused is None:
             fused = program.fused_eligible()
-        if fused and type(program).combiner_for is VertexProgram.combiner_for:
-            return self._run_fused(
-                program, checkpoint_path, checkpoint_every, resume
-            )
-        return self._run_host_loop(
-            program, sync_every, checkpoint_path, checkpoint_every, resume
+        use_fused = (
+            not use_frontier
+            and fused
+            and type(program).combiner_for is VertexProgram.combiner_for
         )
+        # telemetry around the whole run: walls/sizes/compile counts are
+        # all host-resident — nothing here records from traced code
+        compiled_before = len(self._compiled)
+        self._last_arg_bytes = 0  # a path that skips _graph_args (the
+        # frontier engine ships its own tiers) must not report stale bytes
+        t0 = time.perf_counter()
+        with tracer.span(
+            "olap.run",
+            program=type(program).__name__,
+            executor="tpu",
+            strategy=self._strategy_cfg,
+        ) as sp:
+            if use_frontier:
+                out = self._run_frontier(program)
+            elif use_fused:
+                out = self._run_fused(
+                    program, checkpoint_path, checkpoint_every, resume
+                )
+            else:
+                out = self._run_host_loop(
+                    program, sync_every, checkpoint_path, checkpoint_every,
+                    resume,
+                )
+            self._finish_run(
+                sp, program, out,
+                time.perf_counter() - t0,
+                len(self._compiled) - compiled_before,
+            )
+        return out
+
+    # ------------------------------------------------------------ telemetry
+    def _finish_run(self, sp, program, result, wall_s, new_execs) -> None:
+        """Publish the finished run: enrich `last_run_info` with retrace/
+        transfer/pad numbers, attach per-superstep child spans, set the
+        OLAP gauges, and hand the record to the telemetry registry
+        (`registry.last_run("olap")`). Everything consumed here is already
+        host-resident (walls, static shapes, reduced scalars the run loop
+        fetched anyway) — the compiled superstep body stays sync-free and
+        graphlint JG106 keeps it that way."""
+        info = self.last_run_info
+        info["wall_s"] = round(wall_s, 4)
+        info["retraces"] = new_execs
+        info["h2d_arg_bytes"] = int(self._last_arg_bytes)
+        info["d2h_bytes"] = int(
+            sum(np.asarray(v).nbytes for v in result.values())
+        )
+        undirected = bool(getattr(program, "undirected", False))
+        pad_ratio = None
+        pack = self._ell_packs.get(undirected)
+        if pack is not None:
+            slots = sum(int(b[0].size) for b in pack.buckets)
+            edges = self.csr.num_edges * (2 if undirected else 1)
+            pad_ratio = round(slots / max(1, edges), 4)
+        info["ell_pad_ratio"] = pad_ratio
+
+        records = info.get("superstep_records")
+        if records is None:
+            # frontier path: the tier trace IS the per-superstep record
+            records = [
+                {
+                    "step": int(t.get("hop", i)),
+                    "frontier": int(t.get("frontier", 0)),
+                    "edges": int(t.get("edges", 0)),
+                    "e_cap": int(t.get("E_cap", 0)),
+                }
+                for i, t in enumerate(info.get("tiers", []))
+            ]
+        n = self.g.num_vertices
+        for i, r in enumerate(records):
+            # dense BSP touches every vertex each superstep; the frontier
+            # path records its true (compacted) sizes above
+            r.setdefault("frontier", n)
+            if pad_ratio is not None:
+                r.setdefault("pad_ratio", pad_ratio)
+            r.setdefault("h2d_bytes", info["h2d_arg_bytes"] if i == 0 else 0)
+        info["superstep_records"] = records
+
+        for r in records[:128]:
+            tracer.record_span(
+                "superstep", float(r.get("wall_ms", 0.0)),
+                **{k: v for k, v in r.items() if k != "wall_ms"},
+            )
+        sp.annotate(
+            path=info.get("path"),
+            supersteps=info.get("supersteps"),
+            wall_s=info["wall_s"],
+            retraces=new_execs,
+            ell_pad_ratio=pad_ratio,
+            h2d_arg_bytes=info["h2d_arg_bytes"],
+            d2h_bytes=info["d2h_bytes"],
+        )
+
+        registry.counter("olap.runs").inc()
+        registry.timer("olap.run").update(int(wall_s * 1e9))
+        registry.set_gauge(
+            "olap.superstep.count", float(info.get("supersteps", 0) or 0)
+        )
+        registry.set_gauge("olap.run.wall_ms", round(wall_s * 1000.0, 3))
+        registry.set_gauge(
+            "olap.transfer.h2d_bytes", float(info["h2d_arg_bytes"])
+        )
+        registry.set_gauge("olap.transfer.d2h_bytes", float(info["d2h_bytes"]))
+        if pad_ratio is not None:
+            registry.set_gauge("olap.ell.pad_ratio", pad_ratio)
+        if records:
+            registry.set_gauge(
+                "olap.frontier.last", float(records[-1].get("frontier", n))
+            )
+            registry.histogram("olap.frontier.size").observe(
+                float(records[-1].get("frontier", n))
+            )
+        registry.record_run("olap", info)
 
     #: graphs below this edge count run CC through the fused dense path
     #: under frontier="auto": the frontier loop pays ~2 host round trips
@@ -882,12 +1012,17 @@ class TPUExecutor:
             }
             steps_done = 0
 
+        fused_key = ("fused", program.cache_key(), op, self._strategy_cfg)
+        cold = fused_key not in self._compiled
         fn = self._fused_fn(program, op)
         gargs = self._graph_args(program, op)
+        records = []
+        first_dispatch_s = None
         while steps_done < max_iter:
             limit = max_iter
             if checkpoint_every:
                 limit = min(steps_done + checkpoint_every, max_iter)
+            c0 = time.perf_counter()
             state, mem, steps_dev = fn(
                 state,
                 mem,
@@ -895,7 +1030,22 @@ class TPUExecutor:
                 jnp.asarray(limit, jnp.int32),
                 gargs,
             )
-            new_steps = int(steps_dev)
+            new_steps = int(steps_dev)  # the per-chunk host sync (existing)
+            chunk_s = time.perf_counter() - c0
+            if first_dispatch_s is None:
+                first_dispatch_s = chunk_s
+            # one executable covers the whole chunk: per-superstep wall is
+            # the amortized share (flagged approx=True); the first chunk of
+            # a cold executable carries the compile
+            ran = max(1, new_steps - steps_done)
+            per_ms = round(chunk_s * 1000.0 / ran, 3)
+            for s in range(steps_done, max(new_steps, steps_done)):
+                records.append({
+                    "step": s,
+                    "wall_ms": per_ms,
+                    "approx": True,
+                    "compiled": cold and not records,
+                })
             terminated = new_steps < limit or new_steps == steps_done
             steps_done = max(new_steps, steps_done)
             if checkpoint_path and checkpoint_every:
@@ -909,7 +1059,15 @@ class TPUExecutor:
                 )
             if terminated:
                 break
-        self.last_run_info = {"path": "fused", "supersteps": steps_done}
+        self.last_run_info = {
+            "path": "fused",
+            "supersteps": steps_done,
+            "superstep_records": records,
+            # compile rides the first dispatch of a cold executable; the
+            # split is only separable when later dispatches exist
+            "first_dispatch_s": round(first_dispatch_s or 0.0, 4),
+            "compile_in_first_dispatch": cold,
+        }
         return {k: np.asarray(v) for k, v in state.items()}
 
     def _run_host_loop(
@@ -943,9 +1101,12 @@ class TPUExecutor:
             k: jnp.asarray(v, dtype=jnp.float32) for k, v in memory.values.items()
         }
         steps_done = start_step
+        records = []
         for step in range(start_step, program.max_iterations):
             op = program.combiner_for(step)
             ch = program.channel_for(step)
+            s0 = time.perf_counter()
+            compiled_before = len(self._compiled)
             # seed view-usage discovery with this run's live pytrees so the
             # cache-miss path never re-runs program.setup
             self._used_view_keys(
@@ -962,6 +1123,16 @@ class TPUExecutor:
                 k: metrics.get(k, device_memory.get(k)) for k in
                 set(device_memory) | set(metrics)
             }
+            # host-side dispatch wall (async enqueue unless the cadence
+            # below syncs) + whether this step built a fresh executable —
+            # the compile-vs-execute split at superstep granularity
+            records.append({
+                "step": step,
+                "wall_ms": round((time.perf_counter() - s0) * 1000.0, 3),
+                "combiner": op,
+                "channel": ch,
+                "compiled": len(self._compiled) > compiled_before,
+            })
             steps_done += 1
             last = step == program.max_iterations - 1
             if steps_done % sync_every == 0 or last:
@@ -981,7 +1152,11 @@ class TPUExecutor:
                     )
                 if program.terminate(memory):
                     break
-        self.last_run_info = {"path": "host-loop", "supersteps": steps_done}
+        self.last_run_info = {
+            "path": "host-loop",
+            "supersteps": steps_done,
+            "superstep_records": records,
+        }
         return {k: np.asarray(v) for k, v in state.items()}
 
     # ------------------------------------------------------------ write-back

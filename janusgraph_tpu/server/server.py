@@ -323,6 +323,38 @@ class _Handler(BaseHTTPRequestHandler):
         if self.path == "/health":
             self._send_json(200, {"status": "ok"})
             return
+        if self.path == "/metrics":
+            # Prometheus text exposition of the process registry. Like
+            # /health, unauthenticated: scrapers don't carry credentials
+            # and nothing here includes query or data content.
+            from janusgraph_tpu.observability import (
+                prometheus_text,
+                registry,
+            )
+
+            body = prometheus_text(registry).encode("utf-8")
+            self.send_response(200)
+            self.send_header(
+                "Content-Type", "text/plain; version=0.0.4; charset=utf-8"
+            )
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+            return
+        if self.path == "/telemetry" or self.path.startswith("/telemetry?"):
+            # JSON snapshot: metrics + recent span trees + slow-op log +
+            # structured run records (e.g. OLAP per-superstep telemetry)
+            from janusgraph_tpu.observability import (
+                json_snapshot,
+                registry,
+                tracer,
+            )
+
+            body = json.dumps(
+                json_snapshot(registry, tracer), default=str
+            ).encode("utf-8")
+            self._send_json(200, body)
+            return
         if self.path == "/graphs":
             if not self._auth():
                 return

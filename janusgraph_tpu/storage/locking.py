@@ -153,6 +153,14 @@ class ConsistentKeyLocker:
                 if expected is not None and held[target].expected is None:
                     held[target].expected = expected
                 return
+        from janusgraph_tpu.observability import registry, span
+
+        with span("lock.acquire"), registry.time("locks.write_lock"):
+            self._write_claim(target, tx, expected)
+
+    def _write_claim(
+        self, target: KeyColumn, tx: object, expected: Optional[list]
+    ) -> None:
         if not self.mediator.claim(
             target, tx, time.monotonic() + self.expiry_ms / 1000.0
         ):

@@ -113,30 +113,40 @@ class StandardScanner:
         queries = job.get_queries()
         if not queries:
             raise ValueError("ScanJob declared no queries")
-        job.setup(metrics)
-        try:
-            if key_ranges is None:
-                self._scan_range(job, queries, None, metrics, batch_size)
-            elif not self.ordered_scan:
-                # unordered backend: ONE full scan routed against the union
-                # of ranges (a per-range scan would re-read the whole store
-                # P times)
-                self._scan_unordered(job, queries, key_ranges, metrics, batch_size)
-            elif num_workers <= 1 or len(key_ranges) <= 1:
-                for rng in key_ranges:
-                    self._scan_range(job, queries, rng, metrics, batch_size)
-            else:
-                with ThreadPoolExecutor(max_workers=num_workers) as pool:
-                    futs = [
-                        pool.submit(
-                            self._scan_range, job, queries, rng, metrics, batch_size
-                        )
-                        for rng in key_ranges
-                    ]
-                    for f in futs:
-                        f.result()
-        finally:
-            job.teardown(metrics)
+        from janusgraph_tpu.observability import registry, span
+
+        with span(
+            "store.scan", job=type(job).__name__, store=self.store.name,
+            workers=num_workers,
+        ) as sp, registry.time("storage.scan"):
+            job.setup(metrics)
+            try:
+                if key_ranges is None:
+                    self._scan_range(job, queries, None, metrics, batch_size)
+                elif not self.ordered_scan:
+                    # unordered backend: ONE full scan routed against the
+                    # union of ranges (a per-range scan would re-read the
+                    # whole store P times)
+                    self._scan_unordered(
+                        job, queries, key_ranges, metrics, batch_size
+                    )
+                elif num_workers <= 1 or len(key_ranges) <= 1:
+                    for rng in key_ranges:
+                        self._scan_range(job, queries, rng, metrics, batch_size)
+                else:
+                    with ThreadPoolExecutor(max_workers=num_workers) as pool:
+                        futs = [
+                            pool.submit(
+                                self._scan_range, job, queries, rng, metrics,
+                                batch_size,
+                            )
+                            for rng in key_ranges
+                        ]
+                        for f in futs:
+                            f.result()
+            finally:
+                job.teardown(metrics)
+                sp.annotate(rows=metrics.rows_processed)
         return metrics
 
     def _scan_unordered(

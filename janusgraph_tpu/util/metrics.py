@@ -1,4 +1,4 @@
-"""Operation metrics: counters + timers and the instrumented-store wrapper.
+"""Operation metrics: the registry facade + the instrumented-store wrapper.
 
 Capability parity with the reference's metrics layer
 (reference: util/stats/MetricManager.java:36 — Dropwizard registry
@@ -7,139 +7,54 @@ counter+timer around every KCVS call, wrapped at Backend.java:184-188;
 per-tx metric groups StandardJanusGraphTx.java:258-262; reporters
 GraphDatabaseConfiguration.java:1012-1094).
 
-TPU-build shape: a thread-safe in-process registry of counters and
-nanosecond timers keyed by dotted names, a console/dict reporter, and a
+The registry itself now lives in ``janusgraph_tpu/observability/`` (this
+module re-exports it, so every historical import keeps working): counters
+and nanosecond timers keyed by dotted names — timers carry log-scale
+bucket reservoirs, so p50/p95/p99 report uniformly — plus value
+histograms and gauges. This module keeps the storage-facing pieces: the
 KCVS decorator timing get_slice/get_slice_multi/mutate/get_keys/
-acquire_lock. Backend wraps raw stores BEFORE the cache layer, like the
-reference, so cache hits are visible as the difference between tx-level
-and store-level call counts (the property JanusGraphOperationCountingTest
-asserts)."""
+acquire_lock (now also emitting ``store.<op>`` spans), and the periodic
+console/CSV reporters. Backend wraps raw stores BEFORE the cache layer,
+like the reference, so cache hits are visible as the difference between
+tx-level and store-level call counts (the property
+JanusGraphOperationCountingTest asserts)."""
 
 from __future__ import annotations
 
 import threading
 import time
-from contextlib import contextmanager
-from typing import Dict, Optional, Tuple
+from typing import Optional
 
+from janusgraph_tpu.observability import registry as metrics, span
+from janusgraph_tpu.observability.metrics_core import (
+    BUCKET_BOUNDS,
+    Counter,
+    Gauge,
+    Histogram,
+    TelemetryRegistry,
+    Timer,
+)
 from janusgraph_tpu.storage.kcvs import (
     KeyColumnValueStore,
     StoreTransaction,
 )
 
+#: historical name for the registry class (graphs can still carry private
+#: managers; per-tx groups use name prefixes instead)
+MetricManager = TelemetryRegistry
 
-class Counter:
-    __slots__ = ("count", "_lock")
-
-    def __init__(self):
-        self.count = 0
-        self._lock = threading.Lock()
-
-    def inc(self, delta: int = 1) -> None:
-        with self._lock:
-            self.count += delta
-
-
-class Timer:
-    __slots__ = ("count", "total_ns", "max_ns", "_lock")
-
-    def __init__(self):
-        self.count = 0
-        self.total_ns = 0
-        self.max_ns = 0
-        self._lock = threading.Lock()
-
-    def update(self, elapsed_ns: int) -> None:
-        with self._lock:
-            self.count += 1
-            self.total_ns += elapsed_ns
-            if elapsed_ns > self.max_ns:
-                self.max_ns = elapsed_ns
-
-    @property
-    def mean_ms(self) -> float:
-        return (self.total_ns / self.count) / 1e6 if self.count else 0.0
-
-
-class MetricManager:
-    """The registry (reference: MetricManager.java:36). One process-wide
-    instance lives at `janusgraph_tpu.util.metrics`; graphs can also carry
-    private managers (per-tx groups use name prefixes instead)."""
-
-    def __init__(self):
-        self._counters: Dict[str, Counter] = {}
-        self._timers: Dict[str, Timer] = {}
-        self._lock = threading.Lock()
-
-    def counter(self, name: str) -> Counter:
-        c = self._counters.get(name)
-        if c is None:
-            with self._lock:
-                c = self._counters.setdefault(name, Counter())
-        return c
-
-    def timer(self, name: str) -> Timer:
-        t = self._timers.get(name)
-        if t is None:
-            with self._lock:
-                t = self._timers.setdefault(name, Timer())
-        return t
-
-    @contextmanager
-    def time(self, name: str):
-        t0 = time.perf_counter_ns()
-        try:
-            yield
-        finally:
-            self.timer(name).update(time.perf_counter_ns() - t0)
-
-    # ------------------------------------------------------------- reporting
-    def snapshot(self) -> Dict[str, dict]:
-        with self._lock:  # stable view while writers insert first-seen names
-            counters = dict(self._counters)
-            timers = dict(self._timers)
-        out: Dict[str, dict] = {}
-        for name, c in sorted(counters.items()):
-            out[name] = {"type": "counter", "count": c.count}
-        for name, t in sorted(timers.items()):
-            out[name] = {
-                "type": "timer",
-                "count": t.count,
-                "total_ms": t.total_ns / 1e6,
-                "mean_ms": t.mean_ms,
-                "max_ms": t.max_ns / 1e6,
-            }
-        return out
-
-    def report(self) -> str:
-        """Console reporter (reference: console reporter config
-        GraphDatabaseConfiguration.java:1012)."""
-        lines = [f"{'name':50} {'count':>10} {'mean_ms':>10} {'total_ms':>10}"]
-        for name, m in self.snapshot().items():
-            if m["type"] == "counter":
-                lines.append(f"{name:50} {m['count']:>10}")
-            else:
-                lines.append(
-                    f"{name:50} {m['count']:>10} {m['mean_ms']:>10.3f} "
-                    f"{m['total_ms']:>10.2f}"
-                )
-        return "\n".join(lines)
-
-    def get_count(self, name: str) -> int:
-        c = self._counters.get(name)
-        if c is not None:
-            return c.count
-        t = self._timers.get(name)
-        return t.count if t is not None else 0
-
-    def reset(self) -> None:
-        with self._lock:
-            self._counters.clear()
-            self._timers.clear()
-
-
-#: process-wide registry (reference: MetricManager.INSTANCE)
-metrics = MetricManager()
+__all__ = [
+    "BUCKET_BOUNDS",
+    "Counter",
+    "Gauge",
+    "Histogram",
+    "MetricInstrumentedStore",
+    "MetricManager",
+    "PeriodicReporter",
+    "TelemetryRegistry",
+    "Timer",
+    "metrics",
+]
 
 
 class PeriodicReporter:
@@ -209,12 +124,29 @@ class PeriodicReporter:
                     if new:
                         f.write("t,count\n")
                     f.write(f"{now:.3f},{m['count']}\n")
+                elif m["type"] == "gauge":
+                    if new:
+                        f.write("t,value\n")
+                    f.write(f"{now:.3f},{m['value']:.6g}\n")
+                elif m["type"] == "histogram":
+                    if new:
+                        f.write("t,count,sum,p50,p95,p99,max\n")
+                    f.write(
+                        f"{now:.3f},{m['count']},{m['sum']:.6g},"
+                        f"{m['p50']:.6g},{m['p95']:.6g},{m['p99']:.6g},"
+                        f"{m['max']:.6g}\n"
+                    )
                 else:
                     if new:
-                        f.write("t,count,mean_ms,total_ms,max_ms\n")
+                        f.write(
+                            "t,count,mean_ms,total_ms,max_ms,"
+                            "p50_ms,p95_ms,p99_ms\n"
+                        )
                     f.write(
                         f"{now:.3f},{m['count']},{m['mean_ms']:.3f},"
-                        f"{m['total_ms']:.2f},{m['max_ms']:.3f}\n"
+                        f"{m['total_ms']:.2f},{m['max_ms']:.3f},"
+                        f"{m['p50_ms']:.3f},{m['p95_ms']:.3f},"
+                        f"{m['p99_ms']:.3f}\n"
                     )
 
     def stop(self, final_flush: bool = True) -> None:
@@ -228,7 +160,10 @@ class PeriodicReporter:
 class MetricInstrumentedStore(KeyColumnValueStore):
     """Times + counts every store operation (reference:
     MetricInstrumentedStore.java — M_GET_SLICE/M_MUTATE/... around each
-    call). Metric names: `<prefix>.<store>.<op>`."""
+    call). Metric names: `<prefix>.<store>.<op>` — now histogram-backed
+    timers (p50/p95/p99) — and each call runs inside a `store.<op>` span
+    so storage work nests under whatever tx/traversal/scan span is
+    current."""
 
     def __init__(
         self,
@@ -256,20 +191,26 @@ class MetricInstrumentedStore(KeyColumnValueStore):
         return self._m.time(f"{self._prefix}.{op}")
 
     def get_slice(self, query, txh: StoreTransaction):
-        with self._timed("getSlice"):
-            return self._store.get_slice(query, txh)
+        with span("store.getSlice", store=self._store.name):
+            with self._timed("getSlice"):
+                return self._store.get_slice(query, txh)
 
     def get_slice_multi(self, keys, query, txh: StoreTransaction):
-        with self._timed("getSliceMulti"):
-            return self._store.get_slice_multi(keys, query, txh)
+        with span("store.getSliceMulti", store=self._store.name,
+                  keys=len(keys)):
+            with self._timed("getSliceMulti"):
+                return self._store.get_slice_multi(keys, query, txh)
 
     def mutate(self, key, additions, deletions, txh: StoreTransaction):
-        with self._timed("mutate"):
-            return self._store.mutate(key, additions, deletions, txh)
+        with span("store.mutate", store=self._store.name,
+                  additions=len(additions), deletions=len(deletions)):
+            with self._timed("mutate"):
+                return self._store.mutate(key, additions, deletions, txh)
 
     def acquire_lock(self, key, column, expected, txh: StoreTransaction):
-        with self._timed("acquireLock"):
-            return self._store.acquire_lock(key, column, expected, txh)
+        with span("store.acquireLock", store=self._store.name):
+            with self._timed("acquireLock"):
+                return self._store.acquire_lock(key, column, expected, txh)
 
     def get_keys(self, query, txh: StoreTransaction):
         # time only the store's own fetch work (per-next), not the consumer's

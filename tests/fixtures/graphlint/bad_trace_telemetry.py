@@ -1,0 +1,26 @@
+"""JG106: telemetry recording inside jit-traced code. Every call below
+runs at TRACE time — the counter bumps once per compile instead of once
+per superstep, and a span timing a traced body measures tracing, not
+execution."""
+
+import jax
+
+from janusgraph_tpu.observability import span
+from janusgraph_tpu.util.metrics import metrics
+
+
+@jax.jit
+def superstep(state):
+    metrics.counter("olap.superstep").inc()  # expect: JG106
+    with span("olap.superstep.body", step=0):  # expect: JG106
+        out = state * 2.0
+    metrics.timer("olap.superstep.wall").update(3)  # expect: JG106
+    return out
+
+
+def body(state):
+    with metrics.time("olap.agg"):  # expect: JG106
+        return state + 1.0
+
+
+fn = jax.jit(body)

@@ -37,6 +37,55 @@ def test_metric_manager_counters_timers():
     assert m.get_count("a.b") == 0
 
 
+def test_timer_percentiles_uniform_in_reporters():
+    """Satellite (ISSUE 2): timers expose p50/p95/p99 + counts uniformly
+    in the dict and console reporters — no more flat mean/max-only
+    asymmetry — and snapshots stay dotted-name sorted across metric
+    kinds so diffs are deterministic."""
+    m = MetricManager()
+    m.counter("z.last").inc()
+    for ns in (10_000, 20_000, 40_000, 5_000_000):
+        m.timer("a.first").update(ns)
+    m.set_gauge("m.middle", 2.5)
+    snap = m.snapshot()
+    assert list(snap) == ["a.first", "m.middle", "z.last"]
+    t = snap["a.first"]
+    assert t["count"] == 4
+    assert 0 < t["p50_ms"] <= t["p95_ms"] <= t["p99_ms"]
+    assert t["p99_ms"] <= 2 * t["max_ms"]  # log-bucket upper bound
+    console = m.report()
+    assert "p50_ms" in console and "p99_ms" in console
+    assert console.index("a.first") < console.index("z.last")
+
+
+def test_olap_run_record_surfaced_through_registry():
+    """Satellite (ISSUE 2): the executor's per-run record ("path",
+    "supersteps", "wall_s", per-superstep records) is published through
+    the registry, not just the `last_run_info` attribute."""
+    import numpy as np
+
+    from janusgraph_tpu.olap import csr_from_edges, run_on
+    from janusgraph_tpu.olap.programs import PageRankProgram
+
+    rng = np.random.default_rng(3)
+    n, m_edges = 50, 200
+    csr = csr_from_edges(
+        n,
+        rng.integers(0, n, m_edges).astype(np.int32),
+        rng.integers(0, n, m_edges).astype(np.int32),
+    )
+    run_on(csr, PageRankProgram(max_iterations=3, tol=0.0), executor="tpu")
+    rec = metrics.last_run("olap")
+    assert rec is not None
+    assert rec["path"] in ("fused", "host-loop")
+    assert rec["supersteps"] == 3
+    assert rec["wall_s"] > 0
+    assert len(rec["superstep_records"]) == 3
+    first = rec["superstep_records"][0]
+    assert first["frontier"] == n and first["h2d_bytes"] > 0
+    assert metrics.snapshot()["olap.superstep.count"]["value"] == 3.0
+
+
 def test_instrumented_store_counts_ops():
     g = open_graph({"schema.default": "auto", "metrics.enabled": True})
     tx = g.new_transaction()

@@ -130,6 +130,21 @@ def test_fixture_fires_exactly_where_expected(fixture):
     assert not unexpected, f"unexpected findings: {sorted(unexpected)}"
 
 
+def test_jg106_flags_telemetry_recording_in_traced_code():
+    """ISSUE 2 satellite: metric/span calls inside jit context are a host
+    sync hazard (and record once per compile) — JG106 fires on the
+    fixture and ONLY JG106."""
+    assert "JG106" in RULES
+    path = os.path.join(FIXTURES, "bad_trace_telemetry.py")
+    findings = analyze_paths([path])
+    assert findings, "JG106 fixture produced no findings"
+    assert {f.rule_id for f in findings} == {"JG106"}
+    # the observability package itself records host-side only
+    assert analyze_paths(
+        [os.path.join(PACKAGE, "observability")]
+    ) == []
+
+
 def test_suppression_comments_silence_findings():
     path = os.path.join(FIXTURES, "suppressed_ok.py")
     assert analyze_paths([path]) == []
