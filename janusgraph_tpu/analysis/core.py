@@ -59,6 +59,10 @@ RULES: Dict[str, Rule] = {
              "inconsistent lock acquisition order (deadlock risk)"),
         Rule("JG203", SEV_ERROR,
              "blocking call (sleep / socket / RPC) while holding a lock"),
+        Rule("JG204", SEV_ERROR,
+             "except clause swallows BackendError/TemporaryBackendError "
+             "without re-raising or routing through backend_op.execute "
+             "(a dropped temporary failure loses the retry/recovery path)"),
         # -- padding / shape invariants -------------------------------------
         Rule("JG301", SEV_ERROR,
              "capacity tier constant is not a power of two (ELL/frontier "
@@ -269,7 +273,12 @@ class Analyzer:
     ) -> Tuple[List[Finding], int]:
         """Returns (findings, files_scanned). Suppressed findings are kept
         (marked) only when `keep_suppressed`."""
-        from janusgraph_tpu.analysis import lock_rules, shape_rules, trace_rules
+        from janusgraph_tpu.analysis import (
+            lock_rules,
+            robustness_rules,
+            shape_rules,
+            trace_rules,
+        )
 
         findings: List[Finding] = []
         modules: List[ModuleInfo] = []
@@ -286,6 +295,7 @@ class Analyzer:
             findings.extend(trace_rules.check_module(mod))
             findings.extend(shape_rules.check_module(mod))
             findings.extend(lock_rules.check_module(mod, lock_graph))
+            findings.extend(robustness_rules.check_module(mod))
         findings.extend(lock_graph.order_findings())
 
         out = []

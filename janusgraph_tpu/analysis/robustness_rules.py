@@ -1,0 +1,85 @@
+"""JG204 — swallowed backend errors.
+
+The exception taxonomy (janusgraph_tpu/exceptions.py) splits backend
+failures into temporary (retriable) and permanent; the whole self-healing
+stack — backend_op retries, circuit breaking, torn-commit recovery — hangs
+off that split. An ``except`` clause that catches ``BackendError`` /
+``TemporaryBackendError`` (or their locking subclasses) and neither
+re-raises nor routes the operation back through ``backend_op.execute``
+silently deletes a failure the recovery machinery was built to absorb: the
+caller sees success, the data may be gone.
+
+A handler passes when its body contains a ``raise`` on some path or a call
+to ``backend_op.execute`` / bare ``execute``. Protocol boundaries that
+serialize the error to a peer instead should carry a justified
+``# graphlint: disable=JG204 -- why`` suppression.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List, Set
+
+from janusgraph_tpu.analysis.core import Finding, RULES
+from janusgraph_tpu.analysis.tracing import terminal_name
+
+#: exception names whose swallowing loses a retriable/recoverable failure
+BACKEND_ERROR_NAMES = {
+    "BackendError",
+    "TemporaryBackendError",
+    "TemporaryLockingError",
+}
+
+
+def _caught_names(type_node) -> Set[str]:
+    """Terminal names of the exception classes an except clause catches."""
+    if type_node is None:
+        return set()
+    nodes = (
+        list(type_node.elts) if isinstance(type_node, ast.Tuple) else [type_node]
+    )
+    out = set()
+    for n in nodes:
+        t = terminal_name(n)
+        if t:
+            out.add(t)
+    return out
+
+
+def _handler_routes_or_reraises(handler: ast.ExceptHandler) -> bool:
+    for node in ast.walk(handler):
+        if isinstance(node, ast.Raise):
+            return True
+        if isinstance(node, ast.Call):
+            t = terminal_name(node.func)
+            if t == "execute":
+                f = node.func
+                if isinstance(f, ast.Name):
+                    return True  # bare execute(...) import style
+                if isinstance(f, ast.Attribute) and (
+                    terminal_name(f.value) == "backend_op"
+                ):
+                    return True
+    return False
+
+
+def check_module(mod) -> List[Finding]:
+    findings: List[Finding] = []
+    for node in ast.walk(mod.tree):
+        if not isinstance(node, ast.ExceptHandler):
+            continue
+        caught = _caught_names(node.type) & BACKEND_ERROR_NAMES
+        if not caught:
+            continue
+        if _handler_routes_or_reraises(node):
+            continue
+        names = "/".join(sorted(caught))
+        findings.append(Finding(
+            "JG204", RULES["JG204"].severity, mod.path,
+            node.lineno, node.col_offset,
+            f"except clause swallows {names} without re-raising or routing "
+            "through backend_op.execute — a dropped temporary failure "
+            "silently loses the retry/recovery path (the caller sees "
+            "success, the operation did not happen)",
+        ))
+    return findings

@@ -209,6 +209,112 @@ def cmd_telemetry(args) -> int:
     return 0
 
 
+def cmd_chaos(args) -> int:
+    """Seeded chaos soak on an inmemory graph: drive an OLTP workload (and
+    optionally PageRank) through injected faults including a torn batch,
+    then reopen, run torn-commit recovery, and print a JSON report. The
+    operator-facing smoke test for the self-healing paths
+    (docs/robustness.md has the full recipe)."""
+    import tempfile
+    import time as _t
+
+    from janusgraph_tpu.core.graph import JanusGraphTPU
+    from janusgraph_tpu.exceptions import (
+        InjectedCrashError,
+        TemporaryBackendError,
+    )
+    from janusgraph_tpu.observability import registry
+    from janusgraph_tpu.storage.inmemory import InMemoryStoreManager
+
+    base = {
+        "ids.authority-wait-ms": 0.0,
+        "locks.wait-ms": 0.0,
+        "tx.log-tx": True,
+        "tx.max-commit-time-ms": 0.0,
+        "storage.scan-parallelism": 1,
+        "storage.backoff-base-ms": 1.0,
+        "storage.backoff-max-ms": 4.0,
+        "computer.executor": "cpu",
+        "computer.checkpoint-every": 2,
+        "computer.checkpoint-path": tempfile.mktemp(suffix=".npz"),
+    }
+    torn_at = max(8, args.txs // 2)
+    chaos = {
+        **base,
+        "storage.faults.enabled": True,
+        "storage.faults.seed": args.seed,
+        "storage.faults.read-error-rate": args.error_rate,
+        "storage.faults.write-error-rate": args.error_rate,
+        "storage.faults.torn-mutation-at": torn_at,
+        "storage.faults.lock-expiry-at": max(2, args.txs // 3),
+        "storage.faults.preempt-superstep": 3,
+    }
+    mgr = InMemoryStoreManager()
+    t0 = _t.monotonic()
+    graph = JanusGraphTPU(chaos, store_manager=mgr)
+    plan = graph.fault_plan
+    mgmt = graph.management()
+    mgmt.make_property_key("uid", int)
+    mgmt.build_composite_index("byUid", ["uid"], unique=True)
+
+    def write(i):
+        retries = 12
+        for attempt in range(retries):
+            tx = graph.new_transaction()
+            try:
+                tx.add_vertex(uid=i)
+                tx.commit()
+                return
+            except TemporaryBackendError:
+                if tx.is_open:
+                    tx.rollback()
+                if attempt == retries - 1:
+                    raise
+
+    crashed_at = None
+    for i in range(args.txs):
+        try:
+            write(i)
+        except InjectedCrashError:
+            crashed_at = i
+            break
+    # "crash": abandon the graph un-closed, reopen, self-heal
+    t_rec = _t.monotonic()
+    graph2 = JanusGraphTPU(base, store_manager=mgr)
+    recovery_ms = (_t.monotonic() - t_rec) * 1000.0
+    if crashed_at is not None:
+        for i in range(crashed_at + 1, args.txs):
+            write_tx = graph2.new_transaction()
+            write_tx.add_vertex(uid=i)
+            write_tx.commit()
+    tx = graph2.new_transaction(read_only=True)
+    present = sum(
+        1 for i in range(args.txs)
+        if graph2.index_lookup(tx, "byUid", (i,))
+    )
+    tx.rollback()
+    snap = registry.snapshot()
+    injected: dict = {}
+    for e in plan.journal:
+        injected[e["kind"]] = injected.get(e["kind"], 0) + 1
+    report = {
+        "seed": args.seed,
+        "txs": args.txs,
+        "crashed_at": crashed_at,
+        "vertices_present": present,
+        "torn_recovery": graph2.last_torn_recovery,
+        "injected": injected,
+        "ops_observed": plan.counters(),
+        "journal": plan.journal[:64],
+        "retries": snap.get("storage.backend_op.retries", {}).get("count", 0),
+        "recovery_open_ms": round(recovery_ms, 2),
+        "wall_s": round(_t.monotonic() - t0, 3),
+    }
+    print(json.dumps(report, indent=None if args.compact else 2))
+    graph2.close()
+    return 0 if present == args.txs else 1
+
+
 def cmd_config_docs(args) -> int:
     from janusgraph_tpu.core.config import describe_options
 
@@ -310,6 +416,18 @@ def main(argv=None) -> int:
     pt.add_argument("--json", action="store_true",
                     help="JSON snapshot (metrics + spans + slow ops)")
     pt.set_defaults(fn=cmd_telemetry)
+
+    pch = sub.add_parser(
+        "chaos",
+        help="seeded chaos soak: inject faults, crash, self-heal, report",
+    )
+    pch.add_argument("--seed", type=int, default=42)
+    pch.add_argument("--txs", type=int, default=120)
+    pch.add_argument("--error-rate", type=float, default=0.01,
+                     help="per-op probability of injected temporary faults")
+    pch.add_argument("--compact", action="store_true",
+                     help="one-line JSON report")
+    pch.set_defaults(fn=cmd_chaos)
 
     pd = sub.add_parser("config-docs", help="render the config reference")
     pd.add_argument("--out", help="write to this file instead of stdout")

@@ -570,6 +570,122 @@ QUERY_NS.option(
     Mutability.MASKABLE, lambda v: v > 0,
 )
 
+# ---- robustness: chaos engine, circuit breaker, self-healing paths ------
+STORAGE.option(
+    "faults.enabled", bool,
+    "wrap the data-plane stores in the seeded deterministic fault "
+    "injector (storage/faults.py FaultInjectingStoreManager); the plan "
+    "is exposed as graph.fault_plan", False,
+)
+STORAGE.option(
+    "faults.seed", int,
+    "chaos seed: every fault decision is a pure function of "
+    "(seed, kind, op index), so one seed reproduces one fault sequence",
+    0, Mutability.LOCAL, lambda v: v >= 0,
+)
+STORAGE.option(
+    "faults.read-error-rate", float,
+    "probability of an injected TemporaryBackendError per data-plane "
+    "read (absorbed by the backend_op retry guard)", 0.0,
+    Mutability.LOCAL, lambda v: 0.0 <= v <= 1.0,
+)
+STORAGE.option(
+    "faults.write-error-rate", float,
+    "probability of an injected TemporaryBackendError per data-plane "
+    "mutation (raised BEFORE anything applies, so retries are safe)",
+    0.0, Mutability.LOCAL, lambda v: 0.0 <= v <= 1.0,
+)
+STORAGE.option(
+    "faults.latency-ms", float,
+    "injected latency spike length for reads the latency-rate selects",
+    0.0, Mutability.LOCAL, lambda v: v >= 0,
+)
+STORAGE.option(
+    "faults.latency-rate", float,
+    "probability of a latency spike per data-plane read", 0.0,
+    Mutability.LOCAL, lambda v: 0.0 <= v <= 1.0,
+)
+STORAGE.option(
+    "faults.torn-mutation-at", int,
+    "mutate_many call index at which to CRASH after applying a prefix of "
+    "the batch (-1 = off) — the torn-commit case TornCommitRecovery "
+    "heals on reopen", -1, Mutability.LOCAL, lambda v: v >= -1,
+)
+STORAGE.option(
+    "faults.lock-expiry-at", int,
+    "lock-check index at which the locker's clock is skewed so the "
+    "holder's lease reads as expired (-1 = off)", -1,
+    Mutability.LOCAL, lambda v: v >= -1,
+)
+STORAGE.option(
+    "faults.scan-kill-at", int,
+    "row-scan index at which the stream is killed mid-flight (-1 = off) "
+    "— absorbed by StandardScanner's per-partition retry + resume", -1,
+    Mutability.LOCAL, lambda v: v >= -1,
+)
+STORAGE.option(
+    "faults.scan-kill-after-rows", int,
+    "rows the killed scan yields before dying", 8,
+    Mutability.LOCAL, lambda v: v >= 0,
+)
+STORAGE.option(
+    "faults.preempt-superstep", int,
+    "OLAP superstep at which SuperstepPreempted is raised once (-1 = "
+    "off) — absorbed by the executors' checkpoint auto-resume", -1,
+    Mutability.LOCAL, lambda v: v >= -1,
+)
+STORAGE.option(
+    "faults.stores", str,
+    "comma-separated store names the injector targets (empty = the "
+    "data plane: edgestore,graphindex). System stores stay exempt so "
+    "chaos never corrupts the recovery machinery itself",
+    "edgestore,graphindex", Mutability.LOCAL,
+)
+STORAGE.option(
+    "breaker.enabled", bool,
+    "circuit breaker on the remote store client and remote index "
+    "provider (storage/circuit.py): consecutive temporary failures trip "
+    "it open and callers fail fast instead of burning retry budget "
+    "against a dead endpoint", False, Mutability.MASKABLE,
+)
+STORAGE.option(
+    "breaker.failure-threshold", int,
+    "consecutive temporary failures that trip the breaker open", 5,
+    Mutability.MASKABLE, lambda v: v > 0,
+)
+STORAGE.option(
+    "breaker.reset-ms", float,
+    "open-state dwell time before the breaker half-opens for probes",
+    1000.0, Mutability.MASKABLE, lambda v: v > 0,
+)
+STORAGE.option(
+    "breaker.half-open-probes", int,
+    "concurrent probe calls admitted while half-open; one success "
+    "closes, one failure re-opens", 1,
+    Mutability.MASKABLE, lambda v: v > 0,
+)
+STORAGE.option(
+    "scan-retries", int,
+    "per-partition retry budget of StandardScanner for temporary "
+    "failures mid-scan (resume from the last fully processed batch)", 3,
+    Mutability.MASKABLE, lambda v: v >= 0,
+)
+TX_NS.option(
+    "recover-on-open", bool,
+    "run torn-commit recovery at graph open when the WAL is enabled: "
+    "PREFLUSH-without-PRIMARY_SUCCESS transactions older than "
+    "tx.max-commit-time-ms are rolled forward, PRECOMMIT-only ones "
+    "rolled back (core/txlog.py TornCommitRecovery)", True,
+    Mutability.MASKABLE,
+)
+COMPUTER_NS.option(
+    "resume-attempts", int,
+    "checkpoint auto-resume budget per OLAP run: how many "
+    "SuperstepPreempted events the executors absorb by reloading the "
+    "last checkpoint before giving up", 3,
+    Mutability.MASKABLE, lambda v: v >= 0,
+)
+
 STORAGE.option(
     "fsync", bool,
     "fsync WAL appends on the persistent local backend (localstore). "

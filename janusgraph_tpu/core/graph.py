@@ -90,6 +90,14 @@ def _open_remote(cfg):
         parallel_slice_factor=cfg.get(
             "storage.remote.parallel-slice-factor"
         ),
+        breaker_enabled=cfg.get("storage.breaker.enabled"),
+        breaker_failure_threshold=cfg.get(
+            "storage.breaker.failure-threshold"
+        ),
+        breaker_reset_ms=cfg.get("storage.breaker.reset-ms"),
+        breaker_half_open_probes=cfg.get(
+            "storage.breaker.half-open-probes"
+        ),
     )
 
 
@@ -260,6 +268,20 @@ class JanusGraphTPU:
             except (TypeError, ValueError):
                 pass
             store_manager = factory(cfg) if takes_cfg else factory()
+        # chaos engine (storage.faults.*): wrap the data-plane stores in the
+        # seeded fault injector; the plan rides on the graph so the OLAP
+        # computer and lockers can consult it too
+        self.fault_plan = None
+        if cfg.get("storage.faults.enabled"):
+            from janusgraph_tpu.storage.faults import (
+                FaultInjectingStoreManager,
+                FaultPlan,
+            )
+
+            self.fault_plan = FaultPlan.from_config(cfg)
+            store_manager = FaultInjectingStoreManager(
+                store_manager, self.fault_plan
+            )
         pickle_mode = cfg.get("attributes.allow-pickle")
         if pickle_mode == "auto":
             # a network-attached KCVS store is a trust boundary: any
@@ -299,6 +321,10 @@ class JanusGraphTPU:
             metrics_enabled=cfg.get("metrics.enabled"),
             metrics_merge_stores=cfg.get("metrics.merge-stores"),
             edgestore_cache_fraction=cfg.get("cache.edgestore-fraction"),
+            retry_time_s=cfg.get("storage.retry-time-ms") / 1000.0,
+            backoff_base_s=cfg.get("storage.backoff-base-ms") / 1000.0,
+            backoff_max_s=cfg.get("storage.backoff-max-ms") / 1000.0,
+            retry_attempts=cfg.get("storage.write-attempts"),
         )
         self.idm = IDManager(partition_bits=cfg.get("ids.partition-bits"))
         self.edge_serializer = EdgeSerializer(self.serializer, self.idm)
@@ -310,6 +336,13 @@ class JanusGraphTPU:
             retries=cfg.get("locks.retries"),
             clean_expired=cfg.get("locks.clean-expired"),
         )
+        if self.fault_plan is not None:
+            # lease-expiry fault: the scheduled lock check reads a skewed
+            # clock, so the holder's claim looks expired
+            for locker in (
+                self.backend.edge_locker, self.backend.index_locker,
+            ):
+                locker.clock_ns = self.fault_plan.lock_clock_ns
         self.instance_id = (
             cfg.get("graph.unique-instance-id") or generate_instance_id(
                 suffix=cfg.get("graph.unique-instance-id-suffix"),
@@ -395,6 +428,14 @@ class JanusGraphTPU:
                 pool_size=cfg.get("index.search.pool-size"),
                 retry_time_s=cfg.get("index.search.retry-time-ms") / 1000.0,
                 scroll_page_size=cfg.get("index.search.scroll-page-size"),
+                breaker_enabled=cfg.get("storage.breaker.enabled"),
+                breaker_failure_threshold=cfg.get(
+                    "storage.breaker.failure-threshold"
+                ),
+                breaker_reset_ms=cfg.get("storage.breaker.reset-ms"),
+                breaker_half_open_probes=cfg.get(
+                    "storage.breaker.half-open-probes"
+                ),
             )
         self.index_providers: Dict[str, object] = shared
         # {index_name: {field: KeyInformation}} for provider.mutate calls
@@ -417,6 +458,19 @@ class JanusGraphTPU:
         # (reference: StandardJanusGraph.java:187-189 ManagementLogger on
         # systemlog)
         _ = self.management_logger
+        # torn-commit recovery: replay/roll back txlog entries a crashed
+        # instance left in PREFLUSH/PRECOMMIT state (abandoned past
+        # tx.max-commit-time-ms). Self-healing on open — the counterpart of
+        # start_transaction_recovery's secondary healing.
+        self.last_torn_recovery = None
+        if (
+            self._wal_enabled
+            and cfg.get("tx.recover-on-open")
+            and not self.backend.read_only
+        ):
+            from janusgraph_tpu.core.txlog import TornCommitRecovery
+
+            self.last_torn_recovery = TornCommitRecovery(self).run()
         # multi-host runtime from config (cluster.* — the config-file
         # deployment shape; env vars win inside init_multihost). Guarded so
         # single-process opens never touch jax.distributed.
@@ -555,6 +609,175 @@ class JanusGraphTPU:
                 self.index_providers[backing].restore(
                     documents, self._mixed_key_infos
                 )
+        finally:
+            tx.rollback()
+
+    # ------------------------------------------------- torn-commit replay
+    def replay_torn_changes(self, changes) -> None:
+        """Idempotently re-apply a torn transaction's WAL change records to
+        primary storage (TornCommitRecovery roll-forward).
+
+        Torn-batch repair is cell-exact where a surviving twin exists: an
+        edge with one of its two cells present gets the missing cell
+        re-serialized from the surviving copy (sort key and inline
+        properties included, via parse_relation). Relations with no
+        surviving cell replay from the record itself — identity, value and
+        endpoints are recorded; inline edge properties/sort keys are not
+        part of the WAL payload and are not resurrected in that case.
+        Composite-index entries for replayed property values are re-added
+        afterwards; a full reindex remains the recovery path for indexes
+        that must be exact after deletions."""
+        es = self.edge_serializer
+        idm = self.idm
+        btx = self.backend.begin_transaction()
+        tx = self.new_transaction(read_only=True)
+        touched_props: Dict[int, set] = {}
+        exists_vids = set()
+        try:
+            for c in changes:
+                if c.kind == "edge":
+                    self._replay_edge(tx, btx, c)
+                    if c.added:
+                        exists_vids.update((c.vertex_id, c.other_id))
+                else:
+                    self._replay_property(tx, btx, c)
+                    if c.added:
+                        touched_props.setdefault(
+                            c.vertex_id, set()
+                        ).add(c.type_id)
+                        exists_vids.add(c.vertex_id)
+            # the torn batch may have dropped a new vertex's existence cell
+            # (system cells are not change records): restore it, with the
+            # default label — the label edge's identity is not recorded
+            st = self.system_types
+            exists_q = es.get_type_slice(st.EXISTS, False)
+            for vid in sorted(exists_vids):
+                key = idm.get_key(vid)
+                if btx.edge_store_query(KeySliceQuery(key, exists_q)):
+                    continue
+                btx.mutate_edges(
+                    key,
+                    [es.write_property(
+                        st.EXISTS, self.id_assigner.assign_relation_id(), True
+                    )],
+                    [],
+                )
+            btx.commit()
+        finally:
+            tx.rollback()
+        self._replay_index_entries(touched_props)
+
+    def _find_relation_cell(self, tx, btx, vid: int, type_id: int,
+                            rel_id: int, is_edge: bool, direction=None):
+        """Locate the stored cell of one relation on one row; returns
+        (entry, parsed) or (None, None)."""
+        es = self.edge_serializer
+        q = es.get_type_slice(type_id, is_edge)
+        key = self.idm.get_key(vid)
+        for entry in btx.edge_store_query(KeySliceQuery(key, q)):
+            rc = es.parse_relation(entry, tx._codec_schema)
+            if rc.relation_id != rel_id:
+                continue
+            if direction is not None and rc.direction != direction:
+                continue
+            return entry, rc
+        return None, None
+
+    def _replay_edge(self, tx, btx, c) -> None:
+        es = self.edge_serializer
+        idm = self.idm
+        out_cell, out_rc = self._find_relation_cell(
+            tx, btx, c.vertex_id, c.type_id, c.relation_id, True,
+            Direction.OUT,
+        )
+        in_cell, in_rc = self._find_relation_cell(
+            tx, btx, c.other_id, c.type_id, c.relation_id, True,
+            Direction.IN,
+        )
+        if not c.added:
+            if out_cell is not None:
+                btx.mutate_edges(idm.get_key(c.vertex_id), [], [out_cell[0]])
+            if in_cell is not None:
+                btx.mutate_edges(idm.get_key(c.other_id), [], [in_cell[0]])
+            return
+        label = tx.schema_by_id(c.type_id)
+        unidirected = getattr(label, "unidirected", False)
+        survivor = out_rc or in_rc
+        sort_key = survivor.sort_key if survivor is not None else b""
+        props = (survivor.properties or None) if survivor is not None else None
+        if out_cell is None:
+            btx.mutate_edges(
+                idm.get_key(c.vertex_id),
+                [es.write_edge(
+                    c.type_id, Direction.OUT, c.other_id, c.relation_id,
+                    sort_key, props,
+                )],
+                [],
+            )
+        if in_cell is None and not unidirected:
+            btx.mutate_edges(
+                idm.get_key(c.other_id),
+                [es.write_edge(
+                    c.type_id, Direction.IN, c.vertex_id, c.relation_id,
+                    sort_key, props,
+                )],
+                [],
+            )
+
+    def _replay_property(self, tx, btx, c) -> None:
+        es = self.edge_serializer
+        cell, _rc = self._find_relation_cell(
+            tx, btx, c.vertex_id, c.type_id, c.relation_id, False
+        )
+        if not c.added:
+            if cell is not None:
+                btx.mutate_edges(
+                    self.idm.get_key(c.vertex_id), [], [cell[0]]
+                )
+            return
+        if cell is not None:
+            return  # this cell survived the tear
+        pk = tx.schema_by_id(c.type_id)
+        card = (
+            pk.cardinality if isinstance(pk, PropertyKey) else Cardinality.SINGLE
+        )
+        value, _ = self.serializer.read_object(c.value_enc)
+        btx.mutate_edges(
+            self.idm.get_key(c.vertex_id),
+            [es.write_property(c.type_id, c.relation_id, value, card)],
+            [],
+        )
+
+    def _replay_index_entries(self, touched: Dict[int, set]) -> None:
+        """Re-add composite-index rows for replayed property values (the
+        graphindex half of a torn batch; additions only — stale entries
+        from replayed deletions are healed by reindex, as in the
+        reference)."""
+        if not touched:
+            return
+        tx = self.new_transaction(read_only=True)
+        btx = self.backend.begin_transaction()
+        try:
+            for idx in self.indexes.values():
+                if idx.mixed or idx.status in ("DISABLED", "INSTALLED"):
+                    continue
+                kid_set = set(idx.key_ids)
+                for vid, kids in sorted(touched.items()):
+                    if not (kid_set & kids):
+                        continue
+                    if idx.label_constraint is not None and not (
+                        self._matches_label(tx, idx, vid)
+                    ):
+                        continue
+                    after = self._index_values_committed(tx, idx, vid)
+                    if after is None:
+                        continue
+                    for row, adds, _dels in self.index_serializer.index_updates(
+                        idx, vid, None, after
+                    ):
+                        if adds:
+                            btx.mutate_index(row, adds, [])
+            btx.commit()
         finally:
             tx.rollback()
 
@@ -890,8 +1113,20 @@ class JanusGraphTPU:
             # order storage-then-indexes :759-766)
             index_tx = self._prepare_mixed_index_updates(tx)
 
-            # -- 6. flush while still holding the lock (unique-index safety)
-            btx.commit()
+            # -- 6. flush while still holding the lock (unique-index
+            # safety). The WAL PREFLUSH marker is written INSIDE commit,
+            # after the lock checks pass and immediately before the batch
+            # hits storage: a crash past the marker may leave a TORN batch
+            # (per-row atomic, batch not) that TornCommitRecovery rolls
+            # forward on reopen; any failure before it (lost lock race,
+            # expired lease) provably left storage untouched — roll back.
+            btx.commit(
+                preflush=(
+                    (lambda: self.tx_log.preflush(tx_id))
+                    if wal_enabled
+                    else None
+                )
+            )
 
         # -- 6.5 mixed-index documents: secondary persistence; a failure
         # never unwinds the durably-committed primary (healed by recovery

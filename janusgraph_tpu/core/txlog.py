@@ -36,6 +36,14 @@ class LogTxStatus(IntEnum):
     PRIMARY_SUCCESS = 2
     SECONDARY_SUCCESS = 3
     SECONDARY_FAILURE = 4
+    #: written immediately before the primary storage flush — the point
+    #: past which a crash can leave a TORN batch (some rows applied, some
+    #: not). PREFLUSH without PRIMARY_SUCCESS is the roll-forward case
+    #: TornCommitRecovery replays; PRECOMMIT without PREFLUSH means the
+    #: flush never started and the tx rolls back to "never happened".
+    PREFLUSH = 5
+    #: recovery marker: a PRECOMMIT-only tx was confirmed rolled back
+    ROLLED_BACK = 6
 
 
 @dataclass(frozen=True)
@@ -142,6 +150,13 @@ class TransactionLog:
             encode_tx_entry(
                 TxLogEntry(tx_id, LogTxStatus.PRECOMMIT, changes, user_log)
             )
+        )
+
+    def preflush(self, tx_id: int) -> None:
+        """Mark the flush point: storage writes begin NOW. A crash between
+        this entry and primary_success may have torn the batch."""
+        self.log.add_now(
+            encode_tx_entry(TxLogEntry(tx_id, LogTxStatus.PREFLUSH))
         )
 
     def primary_success(self, tx_id: int) -> None:
@@ -278,6 +293,124 @@ class TransactionRecovery:
                 )
             )
         )
+
+
+# ---------------------------------------------------------------------------
+# Torn-commit recovery (primary storage)
+
+
+class TornCommitRecovery:
+    """Heal transactions whose PRIMARY flush may have torn.
+
+    The companion to :class:`TransactionRecovery` (which only heals
+    *secondary* persistence): this one repairs primary storage itself,
+    using the PREFLUSH marker to split abandoned transactions into two
+    cases —
+
+    * ``PREFLUSH`` present, ``PRIMARY_SUCCESS`` absent: the flush started
+      and may have applied a prefix of the batch (non-transactional
+      backends apply per-row atomically, never per-batch). The WAL's
+      change records are self-contained, so the tx is **rolled forward**:
+      every recorded cell is re-derived and written idempotently
+      (``graph.replay_torn_changes``), then a ``PRIMARY_SUCCESS`` entry
+      with a ``healed-primary:<sender>`` marker closes the tx.
+    * ``PRECOMMIT`` only: the flush never began, nothing reached storage —
+      the tx **rolls back** to "never happened" and a ``ROLLED_BACK``
+      marker stops future recoveries from re-reporting it.
+
+    Entries younger than ``tx.max-commit-time-ms`` are skipped (they may
+    still be in flight on another instance). Runs automatically at graph
+    open when the WAL is enabled (``tx.recover-on-open``).
+    """
+
+    def __init__(self, graph):
+        self.graph = graph
+        self.replayed: List[int] = []
+        self.rolled_back: List[int] = []
+
+    def run(self, max_commit_time_ms: Optional[float] = None) -> dict:
+        if max_commit_time_ms is None:
+            max_commit_time_ms = self.graph.config.get("tx.max-commit-time-ms")
+        txlog = self.graph.log_manager.open_log("txlog")
+        cutoff = time.time_ns() - int(max_commit_time_ms * 1e6)
+        by_tx: Dict[tuple, Dict[LogTxStatus, TxLogEntry]] = {}
+        handled = set()
+        for msg in txlog.read_range(0):
+            entry = decode_tx_entry(msg.content, msg.timestamp_ns)
+            marker = entry.user_log
+            if entry.status == LogTxStatus.PRIMARY_SUCCESS and (
+                marker.startswith("healed-primary:")
+            ):
+                handled.add((bytes.fromhex(marker[15:]), entry.tx_id))
+                continue
+            if entry.status == LogTxStatus.ROLLED_BACK:
+                if marker.startswith("rolledback:"):
+                    handled.add((bytes.fromhex(marker[11:]), entry.tx_id))
+                continue
+            by_tx.setdefault((msg.sender, entry.tx_id), {})[entry.status] = entry
+        for (sender, tx_id), entries in sorted(by_tx.items()):
+            pre = entries.get(LogTxStatus.PRECOMMIT)
+            if pre is None or LogTxStatus.PRIMARY_SUCCESS in entries:
+                continue  # unknown origin, or committed cleanly
+            if (sender, tx_id) in handled:
+                continue
+            newest = max(e.timestamp_ns for e in entries.values())
+            if newest > cutoff:
+                continue  # may still be in flight
+            if LogTxStatus.PREFLUSH in entries:
+                self._roll_forward(sender, tx_id, pre)
+            else:
+                self._roll_back(sender, tx_id)
+        from janusgraph_tpu.observability import registry
+
+        if self.replayed:
+            registry.counter("txlog.torn.replayed").inc(len(self.replayed))
+        if self.rolled_back:
+            registry.counter("txlog.torn.rolled_back").inc(
+                len(self.rolled_back)
+            )
+        return {"replayed": self.replayed, "rolled_back": self.rolled_back}
+
+    def _roll_forward(self, sender: bytes, tx_id: int, pre: TxLogEntry) -> None:
+        graph = self.graph
+        graph.replay_torn_changes(pre.changes)
+        # secondary persistence of the healed tx: mixed-index documents are
+        # re-derived from (now repaired) primary storage, and the user-log
+        # delivery replays — same healing the secondary recovery applies
+        graph.restore_mixed_indexes(pre.changes)
+        if pre.user_log:
+            ulog = graph.log_manager.open_log("ulog_" + pre.user_log)
+            ulog.add_now(
+                encode_tx_entry(
+                    TxLogEntry(
+                        tx_id, LogTxStatus.PRECOMMIT, pre.changes, pre.user_log
+                    )
+                )
+            )
+        graph.tx_log.log.add_now(
+            encode_tx_entry(
+                TxLogEntry(
+                    tx_id,
+                    LogTxStatus.PRIMARY_SUCCESS,
+                    user_log="healed-primary:" + sender.hex(),
+                )
+            )
+        )
+        self.replayed.append(tx_id)
+
+    def _roll_back(self, sender: bytes, tx_id: int) -> None:
+        # PRECOMMIT without PREFLUSH: nothing reached storage, the tx never
+        # happened — record that verdict so later recoveries skip it
+        self.graph.tx_log.log.add_now(
+            encode_tx_entry(
+                TxLogEntry(
+                    tx_id,
+                    LogTxStatus.ROLLED_BACK,
+                    user_log="rolledback:" + sender.hex(),
+                )
+            )
+        )
+        self.rolled_back.append(tx_id)
 
 
 # ---------------------------------------------------------------------------

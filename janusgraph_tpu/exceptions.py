@@ -32,6 +32,29 @@ class PermanentLockingError(PermanentBackendError):
     """Lock protocol failure (e.g. expectation check failed)."""
 
 
+class CircuitOpenError(PermanentBackendError):
+    """A circuit breaker is open: the call failed fast without touching the
+    backend. Permanent from the retry guard's point of view (replaying an
+    open circuit inside one operation is pointless spin); the breaker itself
+    recovers independently via its half-open probe cycle."""
+
+
+class InjectedFaultError(TemporaryBackendError):
+    """A fault deliberately injected by the chaos engine (storage/faults.py).
+    Temporary: the retry/recovery machinery is expected to absorb it."""
+
+
+class InjectedCrashError(PermanentBackendError):
+    """A chaos-engine crash point: the batch was deliberately torn mid-flight
+    (some rows applied, some not). Permanent so no retry guard papers over
+    it — torn-commit recovery on reopen is the path under test."""
+
+
+class SuperstepPreempted(JanusGraphTPUError):
+    """An OLAP superstep was preempted (injected or real). Executors with
+    checkpointing enabled auto-resume from the last checkpoint."""
+
+
 class IDPoolExhaustedError(JanusGraphTPUError):
     """No more IDs available in the allocation namespace."""
 

@@ -211,6 +211,7 @@ class _IndexHandler(socketserver.BaseRequestHandler):
                 body = _recv_exact(sock, body_len) if body_len else b""
                 try:
                     self._dispatch(provider, sock, op, body)
+                # graphlint: disable=JG204 -- protocol boundary: the error is serialized to the client as a temporary status frame, and the CLIENT retries
                 except (TemporaryBackendError, ConnectionError) as e:
                     self._reply(sock, _STATUS_TEMP, str(e).encode())
                 except Exception as e:  # noqa: BLE001 - protocol boundary
@@ -362,7 +363,12 @@ class RemoteIndexProvider(IndexProvider):
 
     def __init__(self, hostname: str = "127.0.0.1", port: int = 0,
                  pool_size: int = 4, retry_time_s: float = 10.0,
-                 directory: str = None, **_ignored):
+                 directory: str = None,
+                 breaker_enabled: bool = False,
+                 breaker_failure_threshold: int = 5,
+                 breaker_reset_ms: float = 1000.0,
+                 breaker_half_open_probes: int = 1,
+                 **_ignored):
         # `directory` accepted-and-ignored: open_index_provider passes the
         # local providers' kwargs through one call site (core/graph.py)
         if not hostname or int(port) <= 0:
@@ -379,6 +385,19 @@ class RemoteIndexProvider(IndexProvider):
         self._pool_idx = 0
         self._features: Optional[IndexFeatures] = None
         self._supports_memo: Dict[Tuple, bool] = {}
+        # same storage.breaker.* machinery as the remote KCVS client: a
+        # down index tier fails fast instead of serializing every commit
+        # behind a full retry budget
+        self.breaker = None
+        if breaker_enabled:
+            from janusgraph_tpu.storage.circuit import CircuitBreaker
+
+            self.breaker = CircuitBreaker(
+                "index.remote",
+                failure_threshold=breaker_failure_threshold,
+                reset_timeout_s=breaker_reset_ms / 1000.0,
+                half_open_probes=breaker_half_open_probes,
+            )
 
     def _call(self, op: int, body: bytes, idempotent: bool = True) -> bytes:
         """One wire call under the retry guard. Non-idempotent ops (mutate/
@@ -422,7 +441,10 @@ class RemoteIndexProvider(IndexProvider):
                 _raise_status(status, payload)
             return payload
 
-        return backend_op.execute(attempt, max_time_s=self.retry_time_s)
+        guarded = attempt
+        if self.breaker is not None:
+            guarded = lambda: self.breaker.call(attempt)  # noqa: E731
+        return backend_op.execute(guarded, max_time_s=self.retry_time_s)
 
     def features(self) -> IndexFeatures:
         if self._features is None:

@@ -264,6 +264,22 @@ class GraphComputer:
                     "computer.frontier-tier-growth"
                 ),
             }
+        if cfg is not None and self.executor_kind == "cpu":
+            run_kwargs = {
+                "checkpoint_every": cfg.get("computer.checkpoint-every"),
+                "checkpoint_path": cfg.get("computer.checkpoint-path") or None,
+            }
+        # chaos wiring: a graph opened with storage.faults.enabled carries
+        # a FaultPlan; its superstep-preemption hook rides into the
+        # executors, where checkpoint auto-resume absorbs it
+        plan = getattr(self.graph, "fault_plan", None)
+        if self.executor_kind in ("tpu", "cpu"):
+            if plan is not None:
+                run_kwargs["fault_hook"] = plan.olap_hook
+            if cfg is not None:
+                run_kwargs["resume_attempts"] = cfg.get(
+                    "computer.resume-attempts"
+                )
         sp.annotate(program=type(self._program).__name__)
         states = run_on(csr, self._program, self.executor_kind, **run_kwargs)
         memory = {}
@@ -304,11 +320,19 @@ def run_on(
     frontier_tier_growth: int = None,
     exchange: str = "a2a",
     agg: str = "ell",
+    fault_hook=None,
+    resume_attempts: int = 3,
 ):
     if executor == "cpu":
         from janusgraph_tpu.olap.cpu_executor import CPUExecutor
 
-        return CPUExecutor(csr).run(program)
+        return CPUExecutor(csr).run(
+            program,
+            checkpoint_path=checkpoint_path,
+            checkpoint_every=checkpoint_every,
+            fault_hook=fault_hook,
+            resume_attempts=resume_attempts,
+        )
     if executor == "sharded":
         from janusgraph_tpu.parallel import ShardedExecutor
 
@@ -342,5 +366,7 @@ def run_on(
             sync_every=sync_every,
             checkpoint_every=checkpoint_every,
             checkpoint_path=checkpoint_path,
+            fault_hook=fault_hook,
+            resume_attempts=resume_attempts,
         )
     raise ValueError(f"unknown executor {executor!r}")

@@ -38,7 +38,51 @@ class CPUExecutor:
     def __init__(self, graph: CSRGraph):
         self.graph = graph
 
-    def run(self, program: VertexProgram) -> Dict[str, np.ndarray]:
+    def run(
+        self,
+        program: VertexProgram,
+        checkpoint_path: str = None,
+        checkpoint_every: int = 0,
+        resume: bool = False,
+        fault_hook=None,
+        resume_attempts: int = 3,
+    ) -> Dict[str, np.ndarray]:
+        """Run to termination. Same checkpoint/auto-resume contract as
+        TPUExecutor.run: save every `checkpoint_every` supersteps, and a
+        SuperstepPreempted raised mid-run (the `fault_hook` consulted each
+        superstep — e.g. FaultPlan.olap_hook) reloads the last checkpoint
+        and replays, up to `resume_attempts` times. The replay recomputes
+        the exact same numpy arithmetic from the saved arrays, so the
+        final state is bitwise-identical to a fault-free run."""
+        from janusgraph_tpu.exceptions import SuperstepPreempted
+
+        attempts = 0
+        while True:
+            try:
+                return self._run(
+                    program, checkpoint_path, checkpoint_every, resume,
+                    fault_hook,
+                )
+            except SuperstepPreempted:
+                from janusgraph_tpu.observability import registry
+
+                registry.counter("olap.preemptions").inc()
+                if not (checkpoint_path and checkpoint_every) or (
+                    attempts >= resume_attempts
+                ):
+                    raise
+                attempts += 1
+                resume = True
+                registry.counter("olap.resumes").inc()
+
+    def _run(
+        self,
+        program: VertexProgram,
+        checkpoint_path: str,
+        checkpoint_every: int,
+        resume: bool,
+        fault_hook,
+    ) -> Dict[str, np.ndarray]:
         from janusgraph_tpu.olap.vertex_program import (
             check_weighted_transforms,
         )
@@ -47,11 +91,26 @@ class CPUExecutor:
         g = self.graph
         n = g.num_vertices
         memory = Memory()
-        state, init_metrics = program.setup(g, np)
-        memory.reduce_in(init_metrics)
-        memory.superstep = 0
+        state = None
+        start_step = 0
+        if resume and checkpoint_path:
+            from janusgraph_tpu.olap.checkpoint import load_checkpoint
 
-        for step in range(program.max_iterations):
+            ck = load_checkpoint(checkpoint_path)
+            if ck is not None:
+                ck_state, ck_mem, start_step = ck
+                state = {k: np.asarray(v) for k, v in ck_state.items()}
+                memory.values = {k: float(v) for k, v in ck_mem.items()}
+                memory.superstep = start_step
+        if state is None:
+            state, init_metrics = program.setup(g, np)
+            memory.reduce_in(init_metrics)
+            memory.superstep = 0
+            start_step = 0
+
+        for step in range(start_step, program.max_iterations):
+            if fault_hook is not None:
+                fault_hook(step)
             op = program.combiner_for(step)
             identity = Combiner.IDENTITY[op]
             outgoing = np.asarray(
@@ -101,6 +160,19 @@ class CPUExecutor:
                 state, aggregated, step, memory_in, g, np
             )
             memory.reduce_in(metrics)
+            steps_done = step + 1
+            if checkpoint_path and checkpoint_every and (
+                steps_done % checkpoint_every == 0
+                or steps_done == program.max_iterations
+            ):
+                from janusgraph_tpu.olap.checkpoint import save_checkpoint
+
+                save_checkpoint(
+                    checkpoint_path,
+                    {k: np.asarray(v) for k, v in state.items()},
+                    memory.values,
+                    steps_done,
+                )
             if program.terminate(memory):
                 break
         return {k: np.asarray(v) for k, v in state.items()}

@@ -202,10 +202,34 @@ def _scan_raw(
 
     def _scan_rows():
         if ordered:
+            # per-range retry + resume (same contract as StandardScanner):
+            # a TemporaryBackendError mid-stream re-issues the range from
+            # just past the last yielded key, so a killed scan worker (or
+            # injected chaos) costs a reconnect, not the whole load
+            from janusgraph_tpu.exceptions import TemporaryBackendError
+
+            retries = 3
+            cfg = getattr(graph, "config", None)
+            if cfg is not None:
+                retries = cfg.get("storage.scan-retries")
             for start, end in ranges:
-                yield from store.get_keys(
-                    KeyRangeQuery(start, end, full_q), store_tx
-                )
+                cursor = start
+                attempt = 0
+                while True:
+                    try:
+                        for key, entries in store.get_keys(
+                            KeyRangeQuery(cursor, end, full_q), store_tx
+                        ):
+                            yield key, entries
+                            cursor = key + b"\x00"
+                        break
+                    except TemporaryBackendError:
+                        attempt += 1
+                        if attempt > retries:
+                            raise
+                        from janusgraph_tpu.observability import registry
+
+                        registry.counter("storage.scan.retries").inc()
         else:
             # unordered backends (sharded/CQL-analogue): one full scan,
             # key-range filtering client-side (reference: token-range

@@ -250,6 +250,7 @@ def run_scan_job(
         graph.backend.edgestore,
         btx.store_tx,
         ordered_scan=graph.backend.manager.features.ordered_scan,
+        retries=cfg.get("storage.scan-retries") if cfg else 3,
     )
     ranges = [
         graph.idm.partition_key_range(p)

@@ -706,6 +706,8 @@ class TPUExecutor:
         checkpoint_every: int = 0,
         resume: bool = False,
         frontier: str = None,
+        fault_hook=None,
+        resume_attempts: int = 3,
     ) -> Dict[str, np.ndarray]:
         """Run to termination.
 
@@ -728,6 +730,13 @@ class TPUExecutor:
         N-step chunks reusing ONE executable); `resume=True` continues from
         the checkpoint if present. Exceeds reference parity (SURVEY.md §5.4:
         a failed Fulgora iteration aborts outright).
+
+        `fault_hook` (e.g. FaultPlan.olap_hook) is consulted with the
+        current superstep at each host-visible boundary and may raise
+        SuperstepPreempted; with checkpointing enabled the run AUTO-RESUMES
+        from the last checkpoint (up to `resume_attempts` times) and the
+        replay produces bitwise-identical final state — the saved arrays
+        are exact, and XLA recomputes the same program over them.
         """
         jnp = self.jnp
         from janusgraph_tpu.olap.vertex_program import (
@@ -782,17 +791,39 @@ class TPUExecutor:
             executor="tpu",
             strategy=self._strategy_cfg,
         ) as sp:
-            if use_frontier:
-                out = self._run_frontier(program)
-            elif use_fused:
-                out = self._run_fused(
-                    program, checkpoint_path, checkpoint_every, resume
-                )
-            else:
-                out = self._run_host_loop(
-                    program, sync_every, checkpoint_path, checkpoint_every,
-                    resume,
-                )
+            from janusgraph_tpu.exceptions import SuperstepPreempted
+
+            resumes = 0
+            while True:
+                try:
+                    if use_frontier:
+                        out = self._run_frontier(program)
+                    elif use_fused:
+                        out = self._run_fused(
+                            program, checkpoint_path, checkpoint_every,
+                            resume, fault_hook,
+                        )
+                    else:
+                        out = self._run_host_loop(
+                            program, sync_every, checkpoint_path,
+                            checkpoint_every, resume, fault_hook,
+                        )
+                    break
+                except SuperstepPreempted:
+                    registry.counter("olap.preemptions").inc()
+                    if not (checkpoint_path and checkpoint_every) or (
+                        resumes >= resume_attempts
+                    ):
+                        raise
+                    # auto-resume: reload the last checkpoint and replay —
+                    # the preempted span of supersteps is recomputed from
+                    # exact saved arrays, so the final state is identical
+                    resumes += 1
+                    resume = True
+                    registry.counter("olap.resumes").inc()
+            if resumes:
+                self.last_run_info["resumes"] = resumes
+                sp.annotate(resumes=resumes)
             self._finish_run(
                 sp, program, out,
                 time.perf_counter() - t0,
@@ -962,6 +993,7 @@ class TPUExecutor:
         checkpoint_path: str,
         checkpoint_every: int,
         resume: bool,
+        fault_hook=None,
     ) -> Dict[str, np.ndarray]:
         jnp = self.jnp
         op = program.combiner
@@ -1019,6 +1051,11 @@ class TPUExecutor:
         records = []
         first_dispatch_s = None
         while steps_done < max_iter:
+            if fault_hook is not None:
+                # the fused executable is opaque between chunk boundaries:
+                # preemption lands at the superstep granularity the
+                # checkpoint cadence exposes
+                fault_hook(steps_done)
             limit = max_iter
             if checkpoint_every:
                 limit = min(steps_done + checkpoint_every, max_iter)
@@ -1077,6 +1114,7 @@ class TPUExecutor:
         checkpoint_path: str = None,
         checkpoint_every: int = 0,
         resume: bool = False,
+        fault_hook=None,
     ) -> Dict[str, np.ndarray]:
         jnp = self.jnp
         memory = Memory()
@@ -1103,6 +1141,8 @@ class TPUExecutor:
         steps_done = start_step
         records = []
         for step in range(start_step, program.max_iterations):
+            if fault_hook is not None:
+                fault_hook(step)
             op = program.combiner_for(step)
             ch = program.channel_for(step)
             s0 = time.perf_counter()

@@ -88,6 +88,43 @@ def _evaluate(query: str, namespace: dict):
     return result
 
 
+def healthz_snapshot() -> dict:
+    """The /healthz payload: ok/degraded from the process registry.
+
+    Degraded when any circuit breaker is not CLOSED (state gauge != 0) —
+    the storage or index tier is failing over RIGHT NOW. Injected-fault,
+    retry, and recovery counters ride along as context: high retry counts
+    with ok status mean the self-healing paths are absorbing trouble.
+    """
+    from janusgraph_tpu.observability import registry
+
+    snap = registry.snapshot()
+    breakers = {
+        name: m["value"]
+        for name, m in snap.items()
+        if name.startswith("breaker.") and name.endswith(".state")
+        and m["type"] == "gauge"
+    }
+    degraded = any(v != 0.0 for v in breakers.values())
+    counters = {
+        name: m["count"]
+        for name, m in snap.items()
+        if m["type"] == "counter" and (
+            name.startswith("chaos.injected.")
+            or name.startswith("storage.backend_op.")
+            or name.startswith("storage.scan.")
+            or name.startswith("txlog.torn.")
+            or name in ("olap.preemptions", "olap.resumes")
+            or (name.startswith("breaker.") and not name.endswith(".state"))
+        )
+    }
+    return {
+        "status": "degraded" if degraded else "ok",
+        "breakers": breakers,
+        "counters": counters,
+    }
+
+
 class JanusGraphServer:
     """HTTP + WS query server over a JanusGraphManager registry."""
 
@@ -322,6 +359,15 @@ class _Handler(BaseHTTPRequestHandler):
     def do_GET(self):
         if self.path == "/health":
             self._send_json(200, {"status": "ok"})
+            return
+        if self.path == "/healthz":
+            # ok/degraded from breaker states + fault/recovery counters:
+            # "am I serving, and is anything currently failing over"
+            # (unauthenticated like /health — liveness probes carry no
+            # credentials, and nothing here includes data content)
+            payload = healthz_snapshot()
+            code = 200 if payload["status"] == "ok" else 503
+            self._send_json(code, payload)
             return
         if self.path == "/metrics":
             # Prometheus text exposition of the process registry. Like

@@ -108,11 +108,24 @@ class Backend:
         metrics_merge_stores: bool = False,
         edgestore_cache_fraction: float = 0.8,
         read_only: bool = False,
+        retry_time_s: float = 10.0,
+        backoff_base_s: Optional[float] = None,
+        backoff_max_s: Optional[float] = None,
+        retry_attempts: int = 0,
     ):
         self.manager = manager
         self.metrics_enabled = metrics_enabled
         #: storage.read-only: every mutation through this backend raises
         self.read_only = read_only
+        #: universal retry-guard shape for this backend's read/flush paths
+        #: (storage.retry-time-ms / backoff-base-ms / backoff-max-ms /
+        #: write-attempts) — every BackendTransaction operation replays
+        #: TemporaryBackendErrors through backend_op.execute, so a flaking
+        #: store (or the chaos injector) is absorbed below the tx layer
+        self.retry_time_s = retry_time_s
+        self.backoff_base_s = backoff_base_s
+        self.backoff_max_s = backoff_max_s
+        self.retry_attempts = retry_attempts
         self._base_tx = manager.begin_transaction()
         edgestore = manager.open_database(EDGESTORE_NAME)
         indexstore = manager.open_database(INDEXSTORE_NAME)
@@ -251,6 +264,19 @@ class Backend:
     def list_global_config(self, prefix: str = "") -> List[str]:
         return self.global_config.list_global_config(prefix)
 
+    def guard(self, op):
+        """Run one backend operation under the configured retry guard
+        (reference: BackendOperation.execute wrapping every storage call)."""
+        from janusgraph_tpu.storage import backend_op
+
+        return backend_op.execute(
+            op,
+            max_time_s=self.retry_time_s,
+            base_delay_s=self.backoff_base_s,
+            max_delay_s=self.backoff_max_s,
+            max_attempts=self.retry_attempts,
+        )
+
     def close(self) -> None:
         self.edgestore.close()
         self.indexstore.close()
@@ -273,16 +299,27 @@ class BackendTransaction:
         self._open = True
 
     # ----------------------------------------------------------------- reads
+    # (each read rides Backend.guard — the reference wraps EVERY storage
+    # call in BackendOperation.execute; temporary failures replay with
+    # jittered backoff instead of surfacing into the transaction layer)
     def edge_store_query(self, query: KeySliceQuery) -> EntryList:
-        return self.backend.edgestore.get_slice(query, self.store_tx)
+        return self.backend.guard(
+            lambda: self.backend.edgestore.get_slice(query, self.store_tx)
+        )
 
     def edge_store_multi_query(
         self, keys: Sequence[bytes], slice_query: SliceQuery
     ) -> Dict[bytes, EntryList]:
-        return self.backend.edgestore.get_slice_multi(keys, slice_query, self.store_tx)
+        return self.backend.guard(
+            lambda: self.backend.edgestore.get_slice_multi(
+                keys, slice_query, self.store_tx
+            )
+        )
 
     def index_query(self, query: KeySliceQuery) -> EntryList:
-        return self.backend.indexstore.get_slice(query, self.store_tx)
+        return self.backend.guard(
+            lambda: self.backend.indexstore.get_slice(query, self.store_tx)
+        )
 
     def index_query_uncached(self, query: KeySliceQuery) -> EntryList:
         """Bypass the per-instance slice cache — claim-time reads backing
@@ -290,7 +327,9 @@ class BackendTransaction:
         store = self.backend.indexstore
         if isinstance(store, ExpirationCacheStore):
             store = store.wrapped
-        return store.get_slice(query, self.store_tx)
+        return self.backend.guard(
+            lambda: store.get_slice(query, self.store_tx)
+        )
 
     # ---------------------------------------------------------------- writes
     def _buffer(self, store: str, key: bytes, additions: EntryList, deletions: Sequence[bytes]):
@@ -354,11 +393,14 @@ class BackendTransaction:
                     locker.check_locks(self)
                     locker.check_expected_values(
                         self,
-                        lambda t, _s=store: _s.get_slice(
-                            KeySliceQuery(
-                                t.key, SliceQuery(t.column, t.column + b"\x00")
-                            ),
-                            self.store_tx,
+                        lambda t, _s=store: be.guard(
+                            lambda: _s.get_slice(
+                                KeySliceQuery(
+                                    t.key,
+                                    SliceQuery(t.column, t.column + b"\x00"),
+                                ),
+                                self.store_tx,
+                            )
                         ),
                     )
         except Exception:
@@ -367,11 +409,16 @@ class BackendTransaction:
             raise
 
     # ---------------------------------------------------------------- commit
-    def commit(self) -> None:
+    def commit(self, preflush=None) -> None:
+        """`preflush`: WAL hook invoked after the lock checks pass and
+        immediately before the batched flush — the point past which a crash
+        can tear the batch (core/graph.py commit_tx step 6)."""
         if not self._open:
             return
         try:
             self._check_and_release_locks(commit=True)
+            if preflush is not None and self.has_mutations():
+                preflush()
             if self._mutations:
                 if self.backend.metrics_enabled:
                     # batched writes bypass the per-store wrapper, so they
@@ -380,8 +427,10 @@ class BackendTransaction:
                     from janusgraph_tpu.util.metrics import metrics as _m
 
                     with _m.time("storage.mutateMany"):
-                        self.backend.manager.mutate_many(
-                            self._mutations, self.store_tx
+                        self.backend.guard(
+                            lambda: self.backend.manager.mutate_many(
+                                self._mutations, self.store_tx
+                            )
                         )
                     for store_name, rows in self._mutations.items():
                         # '.rows' suffix: distinct from the per-call 'mutate'
@@ -390,8 +439,10 @@ class BackendTransaction:
                             len(rows)
                         )
                 else:
-                    self.backend.manager.mutate_many(
-                        self._mutations, self.store_tx
+                    self.backend.guard(
+                        lambda: self.backend.manager.mutate_many(
+                            self._mutations, self.store_tx
+                        )
                     )
                 # mutation-epoch bump for touched edgestore rows
                 edge_rows = self._mutations.get(EDGESTORE_NAME)

@@ -4,14 +4,28 @@ Capability parity with the reference's universal backend-call guard
 (reference: diskstorage/util/BackendOperation.java — every storage call is
 wrapped in execute(), which retries TemporaryBackendExceptions with
 exponential backoff up to a time budget and lets PermanentBackendExceptions
-fail fast). Used by the remote store client; available to any caller
-touching a backend that can flake (network partitions, failing shards).
+fail fast). Used by the remote store client, the remote index provider, and
+the buffered backend transaction's read/flush paths; available to any
+caller touching a backend that can flake (network partitions, failing
+shards, injected chaos).
+
+Backoff shape: exponential base with DECORRELATED JITTER — each delay is
+drawn uniformly from [base, prev * 3], capped at the ceiling. Pure
+exponential backoff synchronizes every client that failed at the same
+instant into retry convoys that re-stampede the recovering backend on the
+same schedule (the thundering herd); decorrelated jitter spreads them.
+
+Telemetry: ``storage.backend_op.retries`` counts every replayed attempt,
+``storage.backend_op.exhausted`` every guard that gave up (budget or
+attempt cap spent) — the recovered-vs-lost split the chaos engine asserts
+on.
 """
 
 from __future__ import annotations
 
+import random
 import time
-from typing import Callable, TypeVar
+from typing import Callable, Optional, TypeVar
 
 from janusgraph_tpu.exceptions import (
     PermanentBackendError,
@@ -31,20 +45,23 @@ MAX_DELAY_S = 2.0
 def execute(
     op: Callable[[], T],
     max_time_s: float = 10.0,
-    base_delay_s: float = None,
-    max_delay_s: float = None,
+    base_delay_s: Optional[float] = None,
+    max_delay_s: Optional[float] = None,
     max_attempts: int = 0,
 ) -> T:
-    """Run `op`, replaying temporary failures with exponential backoff until
-    the time budget is spent; the last temporary error is then re-raised.
-    Permanent failures propagate immediately (reference:
+    """Run `op`, replaying temporary failures with jittered exponential
+    backoff until the time budget is spent; the last temporary error is
+    then re-raised. Permanent failures propagate immediately (reference:
     BackendOperation.executeDirect semantics). `max_attempts` (> 0) caps
     the replay COUNT as well as the time budget — whichever trips first
     (reference: storage.write-attempts / read-attempts)."""
+    from janusgraph_tpu.observability import registry
+
     deadline = time.monotonic() + max_time_s
-    delay = BASE_DELAY_S if base_delay_s is None else base_delay_s
+    base = BASE_DELAY_S if base_delay_s is None else base_delay_s
     if max_delay_s is None:
         max_delay_s = MAX_DELAY_S
+    delay = base
     attempt = 0
     while True:
         try:
@@ -55,6 +72,11 @@ def execute(
             attempt += 1
             now = time.monotonic()
             if now >= deadline or (max_attempts and attempt >= max_attempts):
+                registry.counter("storage.backend_op.exhausted").inc()
                 raise
+            registry.counter("storage.backend_op.retries").inc()
             time.sleep(min(delay, max_delay_s, max(0.0, deadline - now)))
-            delay *= 2
+            # decorrelated jitter (not part of the fault-plan determinism
+            # contract: fault DECISIONS are hash-scheduled, only the retry
+            # pacing is randomized)
+            delay = min(max_delay_s, random.uniform(base, delay * 3))

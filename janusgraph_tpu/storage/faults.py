@@ -1,0 +1,381 @@
+"""Chaos engine: seeded, deterministic fault injection at the KCVS seam.
+
+The reference wraps every storage call in a retrying guard
+(reference: diskstorage/util/BackendOperation.java) and recovers torn
+commits from its write-ahead tx log, but nothing in either codebase ever
+*exercises* a failure — so none of the recovery paths are proven. This
+module makes failures injectable, survivable, and observable:
+
+- :class:`FaultPlan` — a seeded plan of fault decisions. Every decision is
+  a pure function of ``(seed, fault kind, per-kind operation index)``
+  (a stable CRC hash, not a shared RNG stream), so the same seed over the
+  same workload reproduces the exact same fault sequence — including under
+  partial replays, which a shared RNG cursor cannot do. Every injected
+  fault is appended to a bounded ``journal`` for assertions and reports.
+- :class:`FaultInjectingStoreManager` / :class:`FaultInjectingStore` —
+  wrap any :class:`KeyColumnValueStoreManager` and execute the plan on the
+  data path. System stores (ids, config, logs, locks) are exempt by
+  default: chaos targets the data plane, never the recovery machinery
+  that must repair it.
+
+Fault kinds (all off by default):
+
+===================  =====================================================
+``read`` / ``write`` probabilistic :class:`InjectedFaultError`
+                     (a ``TemporaryBackendError``) on slice reads and
+                     mutations — absorbed by the backend_op retry guard
+``latency``          injected latency spikes on reads
+``torn``             crash after applying a PREFIX of a ``mutate_many``
+                     batch (:class:`InjectedCrashError`) — the torn-commit
+                     case healed by ``TornCommitRecovery`` on reopen
+``lock``             lease expiry: the Nth lock check sees a skewed clock,
+                     so the holder's claim reads as expired
+                     (``TemporaryLockingError``; re-acquirable after)
+``scan``             kill a row scan mid-stream — absorbed by
+                     StandardScanner's per-partition retry + resume
+``superstep``        preempt an OLAP superstep
+                     (:class:`SuperstepPreempted`) — absorbed by the
+                     executors' checkpoint auto-resume
+===================  =====================================================
+
+Wiring: ``storage.faults.enabled=true`` makes ``open_graph`` wrap its
+store manager and expose the plan as ``graph.fault_plan``; the OLAP
+computer forwards ``plan.olap_hook`` into the executors. See
+docs/robustness.md for the chaos-test recipe.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+import zlib
+from typing import Dict, Iterator, List, Optional, Sequence, Tuple
+
+from janusgraph_tpu.exceptions import (
+    InjectedCrashError,
+    InjectedFaultError,
+    SuperstepPreempted,
+)
+from janusgraph_tpu.storage.kcvs import (
+    EntryList,
+    KCVMutation,
+    KeyColumnValueStore,
+    KeyColumnValueStoreManager,
+    KeySliceQuery,
+    SliceQuery,
+    StoreFeatures,
+    StoreTransaction,
+)
+
+#: stores the injector touches by default — the data plane only. The id
+#: authority, global config, durable logs, and lock stores stay clean so
+#: recovery can always run (chaos that corrupts the repair path proves
+#: nothing).
+DEFAULT_FAULT_STORES = ("edgestore", "graphindex")
+
+#: clock skew applied to a lock check chosen for lease expiry: one hour,
+#: far past any sane locks.expiry-ms, so the holder's claim always reads
+#: as expired regardless of tuning
+LOCK_EXPIRY_SKEW_NS = 3_600 * 1_000_000_000
+
+
+class FaultPlan:
+    """A deterministic, seeded schedule of faults.
+
+    Probabilistic kinds (read/write/latency) fire when
+    ``hash(seed, kind, n) < rate`` for the kind's n-th operation; scheduled
+    kinds (torn/lock/scan/superstep) fire at an exact per-kind operation
+    index. Counters are per kind, so interleaving between kinds (e.g. a
+    cache absorbing reads) never shifts another kind's schedule.
+    """
+
+    def __init__(
+        self,
+        seed: int = 0,
+        read_error_rate: float = 0.0,
+        write_error_rate: float = 0.0,
+        latency_ms: float = 0.0,
+        latency_rate: float = 0.0,
+        torn_mutation_at: int = -1,
+        lock_expiry_at: int = -1,
+        scan_kill_at: int = -1,
+        scan_kill_after_rows: int = 8,
+        preempt_superstep: int = -1,
+        stores: Sequence[str] = DEFAULT_FAULT_STORES,
+        journal_limit: int = 4096,
+    ):
+        self.seed = int(seed)
+        self.read_error_rate = read_error_rate
+        self.write_error_rate = write_error_rate
+        self.latency_ms = latency_ms
+        self.latency_rate = latency_rate
+        self.torn_mutation_at = torn_mutation_at
+        self.lock_expiry_at = lock_expiry_at
+        self.scan_kill_at = scan_kill_at
+        self.scan_kill_after_rows = scan_kill_after_rows
+        self.preempt_superstep = preempt_superstep
+        self.stores = tuple(stores)
+        self.journal_limit = journal_limit
+        #: injected-fault record: [{"kind", "n", ...}] — deterministic
+        #: content only (no wall-clock), so two runs with one seed compare
+        #: journal-equal
+        self.journal: List[dict] = []
+        self._counters: Dict[str, int] = {}
+        self._preempted = False
+        self._lock = threading.Lock()
+
+    @classmethod
+    def from_config(cls, cfg) -> "FaultPlan":
+        """Build from the ``storage.faults.*`` option family."""
+        stores = [
+            s.strip()
+            for s in cfg.get("storage.faults.stores").split(",")
+            if s.strip()
+        ] or list(DEFAULT_FAULT_STORES)
+        return cls(
+            seed=cfg.get("storage.faults.seed"),
+            read_error_rate=cfg.get("storage.faults.read-error-rate"),
+            write_error_rate=cfg.get("storage.faults.write-error-rate"),
+            latency_ms=cfg.get("storage.faults.latency-ms"),
+            latency_rate=cfg.get("storage.faults.latency-rate"),
+            torn_mutation_at=cfg.get("storage.faults.torn-mutation-at"),
+            lock_expiry_at=cfg.get("storage.faults.lock-expiry-at"),
+            scan_kill_at=cfg.get("storage.faults.scan-kill-at"),
+            scan_kill_after_rows=cfg.get(
+                "storage.faults.scan-kill-after-rows"
+            ),
+            preempt_superstep=cfg.get("storage.faults.preempt-superstep"),
+            stores=stores,
+        )
+
+    # ------------------------------------------------------------- decisions
+    def _tick(self, kind: str) -> int:
+        with self._lock:
+            n = self._counters.get(kind, 0)
+            self._counters[kind] = n + 1
+            return n
+
+    def _chance(self, kind: str, n: int, rate: float) -> bool:
+        if rate <= 0.0:
+            return False
+        h = zlib.crc32(f"{self.seed}:{kind}:{n}".encode())
+        return (h / 0xFFFFFFFF) < rate
+
+    def _record(self, kind: str, n: int, **detail) -> None:
+        from janusgraph_tpu.observability import registry
+
+        registry.counter(f"chaos.injected.{kind}").inc()
+        registry.counter("chaos.injected.total").inc()
+        with self._lock:
+            if len(self.journal) < self.journal_limit:
+                self.journal.append({"kind": kind, "n": n, **detail})
+
+    def counters(self) -> Dict[str, int]:
+        with self._lock:
+            return dict(self._counters)
+
+    # ----------------------------------------------------------- store hooks
+    def before_read(self, store: str) -> None:
+        n = self._tick("read")
+        if self._chance("latency", n, self.latency_rate) and self.latency_ms:
+            self._record("latency", n, store=store, ms=self.latency_ms)
+            time.sleep(self.latency_ms / 1000.0)
+        if self._chance("read", n, self.read_error_rate):
+            self._record("read", n, store=store)
+            raise InjectedFaultError(
+                f"injected read fault #{n} on {store} (seed {self.seed})"
+            )
+
+    def before_write(self, store: str) -> None:
+        n = self._tick("write")
+        if self._chance("write", n, self.write_error_rate):
+            self._record("write", n, store=store)
+            raise InjectedFaultError(
+                f"injected write fault #{n} on {store} (seed {self.seed})"
+            )
+
+    def mutate_many_decision(self) -> Tuple[int, bool]:
+        """(op index, tear this batch?) for one mutate_many call. Write-rate
+        faults for the batch path are drawn here too (before anything is
+        applied, so a retry is safe). The scheduled tear takes precedence —
+        a probabilistic fault on the same index must not consume it."""
+        n = self._tick("mutate_many")
+        if n == self.torn_mutation_at:
+            return n, True
+        if self._chance("write", n, self.write_error_rate):
+            self._record("write", n, store="mutate_many")
+            raise InjectedFaultError(
+                f"injected batch-write fault #{n} (seed {self.seed})"
+            )
+        return n, False
+
+    def record_torn(self, n: int, applied_rows: int, total_rows: int) -> None:
+        self._record(
+            "torn", n, applied_rows=applied_rows, total_rows=total_rows
+        )
+
+    def scan_decision(self) -> Tuple[int, bool]:
+        """(scan index, kill this scan mid-stream?)."""
+        n = self._tick("scan")
+        return n, n == self.scan_kill_at
+
+    def record_scan_kill(self, n: int, store: str, rows: int) -> None:
+        self._record("scan", n, store=store, after_rows=rows)
+
+    # ------------------------------------------------------------- lock hook
+    def lock_clock_ns(self) -> int:
+        """Clock source for ConsistentKeyLocker checks: the scheduled check
+        sees a one-hour-skewed clock, so every live claim (the holder's
+        included) reads as expired — the lock-lease-expiry fault."""
+        n = self._tick("lock_check")
+        if n == self.lock_expiry_at:
+            self._record("lock", n, skew_ns=LOCK_EXPIRY_SKEW_NS)
+            return time.time_ns() + LOCK_EXPIRY_SKEW_NS
+        return time.time_ns()
+
+    # ------------------------------------------------------------- OLAP hook
+    def olap_hook(self, step: int) -> None:
+        """Executor fault hook: raises SuperstepPreempted ONCE when the run
+        reaches the scheduled superstep; the auto-resume replay passes."""
+        if self.preempt_superstep < 0 or self._preempted:
+            return
+        if step >= self.preempt_superstep:
+            self._preempted = True
+            self._record("superstep", self._tick("superstep"), step=step)
+            raise SuperstepPreempted(
+                f"injected preemption at superstep {step} "
+                f"(seed {self.seed})"
+            )
+
+
+# ---------------------------------------------------------------------------
+# store wrappers
+
+
+class FaultInjectingStore(KeyColumnValueStore):
+    """Executes a FaultPlan in front of one wrapped store."""
+
+    def __init__(self, wrapped: KeyColumnValueStore, plan: FaultPlan):
+        self.wrapped = wrapped
+        self.plan = plan
+
+    @property
+    def name(self) -> str:
+        return self.wrapped.name
+
+    def get_slice(self, query: KeySliceQuery, txh) -> EntryList:
+        self.plan.before_read(self.name)
+        return self.wrapped.get_slice(query, txh)
+
+    def get_slice_multi(
+        self, keys: Sequence[bytes], slice_query: SliceQuery, txh
+    ) -> Dict[bytes, EntryList]:
+        # one decision per batched call — a multi-slice is one backend op
+        self.plan.before_read(self.name)
+        return self.wrapped.get_slice_multi(keys, slice_query, txh)
+
+    def mutate(self, key, additions, deletions, txh) -> None:
+        self.plan.before_write(self.name)
+        self.wrapped.mutate(key, additions, deletions, txh)
+
+    def acquire_lock(self, key, column, expected_value, txh) -> None:
+        self.wrapped.acquire_lock(key, column, expected_value, txh)
+
+    def get_keys(self, query, txh) -> Iterator[Tuple[bytes, EntryList]]:
+        n, kill = self.plan.scan_decision()
+        rows = 0
+        for key, entries in self.wrapped.get_keys(query, txh):
+            if kill and rows >= self.plan.scan_kill_after_rows:
+                self.plan.record_scan_kill(n, self.name, rows)
+                raise InjectedFaultError(
+                    f"injected scan kill #{n} on {self.name} after "
+                    f"{rows} rows (seed {self.plan.seed})"
+                )
+            rows += 1
+            yield key, entries
+
+    def close(self) -> None:
+        self.wrapped.close()
+
+
+class FaultInjectingStoreManager(KeyColumnValueStoreManager):
+    """Wraps a KeyColumnValueStoreManager; data-plane stores named in the
+    plan get a FaultInjectingStore, everything else passes through."""
+
+    def __init__(self, wrapped: KeyColumnValueStoreManager, plan: FaultPlan):
+        self.wrapped = wrapped
+        self.plan = plan
+        self._stores: Dict[str, KeyColumnValueStore] = {}
+
+    @property
+    def features(self) -> StoreFeatures:
+        return self.wrapped.features
+
+    @property
+    def name(self) -> str:
+        return f"faulty({self.wrapped.name})"
+
+    def open_database(self, name: str) -> KeyColumnValueStore:
+        store = self._stores.get(name)
+        if store is None:
+            store = self.wrapped.open_database(name)
+            if name in self.plan.stores:
+                store = FaultInjectingStore(store, self.plan)
+            self._stores[name] = store
+        return store
+
+    def begin_transaction(self, config: Optional[dict] = None) -> StoreTransaction:
+        return self.wrapped.begin_transaction(config)
+
+    def mutate_many(
+        self,
+        mutations: Dict[str, Dict[bytes, KCVMutation]],
+        txh: StoreTransaction,
+    ) -> None:
+        faulted = {s: rows for s, rows in mutations.items()
+                   if s in self.plan.stores and rows}
+        if faulted:
+            n, tear = self.plan.mutate_many_decision()
+            if tear:
+                self._tear(mutations, txh, n)
+                return  # unreachable: _tear always raises
+        self.wrapped.mutate_many(mutations, txh)
+
+    def _tear(self, mutations, txh, n: int) -> None:
+        """Apply a deterministic PREFIX of the batch row-by-row (per-row
+        application is atomic, the batch is not — exactly the guarantee a
+        non-transactional backend gives), then crash. The suffix is lost:
+        the torn-commit case."""
+        rows = [
+            (store_name, key, m)
+            for store_name in sorted(mutations)
+            for key, m in sorted(mutations[store_name].items())
+            if not m.is_empty()
+        ]
+        applied = max(1, len(rows) // 2) if rows else 0
+        for store_name, key, m in rows[:applied]:
+            self.wrapped.open_database(store_name).mutate(
+                key, m.additions, m.deletions, txh
+            )
+        self.plan.record_torn(n, applied, len(rows))
+        raise InjectedCrashError(
+            f"injected crash: batch torn after {applied}/{len(rows)} rows "
+            f"(mutate_many #{n}, seed {self.plan.seed})"
+        )
+
+    def get_local_key_partition(self):
+        return self.wrapped.get_local_key_partition()
+
+    def close(self) -> None:
+        self.wrapped.close()
+
+    def clear_storage(self) -> None:
+        self.wrapped.clear_storage()
+
+    def exists(self) -> bool:
+        return self.wrapped.exists()
+
+    def __getattr__(self, item):
+        # adapter-specific extras (shared index providers, host/port, ...)
+        # resolve against the wrapped manager
+        return getattr(self.wrapped, item)

@@ -24,21 +24,20 @@ import weakref
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Tuple
 
-from janusgraph_tpu.exceptions import BackendError
+# the canonical locking errors: TemporaryLockingError IS a
+# TemporaryBackendError, so workload-level retry loops written against the
+# backend taxonomy ('except TemporaryBackendError: retry the tx') absorb
+# lock contention and lease expiry without special-casing
+from janusgraph_tpu.exceptions import (
+    PermanentLockingError,
+    TemporaryLockingError,
+)
 from janusgraph_tpu.storage.kcvs import (
     KeyColumnValueStore,
     KeySliceQuery,
     SliceQuery,
     StoreTransaction,
 )
-
-
-class PermanentLockingError(BackendError):
-    pass
-
-
-class TemporaryLockingError(BackendError):
-    pass
 
 
 @dataclass(frozen=True)
@@ -90,6 +89,11 @@ _MEDIATORS_LOCK = threading.Lock()
 
 
 def mediator_for(manager) -> LocalLockMediator:
+    # injector/decorator managers (FaultInjectingStoreManager) expose the
+    # real manager as .wrapped — mediation must key on the SHARED backend,
+    # or two graphs over one store would stop mediating in-process
+    while hasattr(manager, "wrapped"):
+        manager = manager.wrapped
     with _MEDIATORS_LOCK:
         med = _MEDIATORS.get(manager)
         if med is None:
@@ -124,6 +128,7 @@ class ConsistentKeyLocker:
         expiry_ms: float = 10_000.0,
         retries: int = 3,
         clean_expired: bool = False,
+        clock_ns=None,
     ):
         self.store = lock_store
         self._tx_factory = store_tx_factory
@@ -132,6 +137,13 @@ class ConsistentKeyLocker:
         self.wait_ms = wait_ms
         self.expiry_ms = expiry_ms
         self.retries = retries
+        #: lease-expiry clock used by check_locks. Injectable so tests and
+        #: the chaos engine (FaultPlan.lock_clock_ns) can skew it — an
+        #: expired lease must raise TemporaryLockingError and be
+        #: re-acquirable, and that path needs to be exercisable without
+        #: real 10s waits. Claim WRITE timestamps stay on the real clock:
+        #: skewing only the check models a holder whose lease ran out.
+        self.clock_ns = clock_ns or time.time_ns
         #: locks.clean-expired: delete expired claim columns encountered
         #: during checks (dead holders' claims otherwise linger until a
         #: compaction; reference: ConsistentKeyLocker CLEAN_EXPIRED)
@@ -205,12 +217,22 @@ class ConsistentKeyLocker:
         if elapsed_ms < self.wait_ms:
             time.sleep((self.wait_ms - elapsed_ms) / 1000.0)
         stx = self._tx_factory()
-        now_ns = time.time_ns()
+        now_ns = self.clock_ns()
         cutoff_ns = now_ns - int(self.expiry_ms * 1e6)
         for target, status in held.items():
             if status.checked:
                 continue
             row = lock_row_key(target)
+            if status.write_timestamp_ns < cutoff_ns:
+                # the holder's OWN lease ran out (slow tx, GC pause, clock
+                # skew): surface it as the retriable lease-expiry error and
+                # release so the target is immediately re-acquirable
+                self._release_target(target, status, tx, stx)
+                raise TemporaryLockingError(
+                    f"lock lease expired on {target.key!r}/"
+                    f"{target.column!r} (claim age exceeds locks.expiry-ms="
+                    f"{self.expiry_ms}) — re-acquire and retry"
+                )
             entries = self.store.get_slice(
                 KeySliceQuery(row, SliceQuery()), stx
             )
@@ -264,6 +286,13 @@ class ConsistentKeyLocker:
             )
         finally:
             self.mediator.release(target, tx)
+            with self._guard:
+                # drop the registration: a released (lost/expired) target
+                # must be re-acquirable with a FRESH claim, not re-entered
+                # on the stale timestamp
+                held = self._locks.get(tx)
+                if held is not None:
+                    held.pop(target, None)
 
     def delete_locks(self, tx: object) -> None:
         with self._guard:

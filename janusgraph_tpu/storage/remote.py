@@ -180,6 +180,7 @@ class _Handler(socketserver.BaseRequestHandler):
                 body = _recv_exact(sock, body_len) if body_len else b""
                 try:
                     self._dispatch(mgr, sock, op, body)
+                # graphlint: disable=JG204 -- protocol boundary: the error is serialized to the client as a temporary status frame, and the CLIENT retries
                 except (TemporaryBackendError, ConnectionError) as e:
                     self._reply(sock, _STATUS_TEMP, str(e).encode())
                 except Exception as e:  # noqa: BLE001 - protocol boundary
@@ -478,11 +479,16 @@ class RemoteStoreManager(KeyColumnValueStoreManager):
 
     def __init__(self, host: str, port: int, pool_size: int = 4,
                  retry_time_s: float = 10.0,
-                 backoff_base_s: float = None, backoff_max_s: float = None,
+                 backoff_base_s: Optional[float] = None,
+                 backoff_max_s: Optional[float] = None,
                  parallel_ops: bool = True,
                  connect_timeout_s: float = 30.0,
                  max_attempts: int = 0,
-                 parallel_slice_factor: int = 2):
+                 parallel_slice_factor: int = 2,
+                 breaker_enabled: bool = False,
+                 breaker_failure_threshold: int = 5,
+                 breaker_reset_ms: float = 1000.0,
+                 breaker_half_open_probes: int = 1):
         self.host, self.port = host, port
         self.retry_time_s = retry_time_s
         self.connect_timeout_s = connect_timeout_s
@@ -505,6 +511,19 @@ class RemoteStoreManager(KeyColumnValueStoreManager):
         self._pool_idx = 0
         self._stores: Dict[str, RemoteKCVStore] = {}
         self._features: Optional[StoreFeatures] = None
+        # circuit breaker (storage.breaker.*): a DOWN server makes every
+        # attempt fail fast after the threshold instead of each caller
+        # burning its full retry budget against a dead endpoint
+        self.breaker = None
+        if breaker_enabled:
+            from janusgraph_tpu.storage.circuit import CircuitBreaker
+
+            self.breaker = CircuitBreaker(
+                "storage.remote",
+                failure_threshold=breaker_failure_threshold,
+                reset_timeout_s=breaker_reset_ms / 1000.0,
+                half_open_probes=breaker_half_open_probes,
+            )
 
     def _executor(self):
         """Persistent fan-out pool for parallel multi-slice reads — per-call
@@ -540,8 +559,15 @@ class RemoteStoreManager(KeyColumnValueStoreManager):
                 _raise_status(status, payload)
             return payload
 
+        guarded = attempt
+        if self.breaker is not None:
+            # breaker INSIDE the retry guard: each network attempt is one
+            # breaker event, and an open circuit raises CircuitOpenError
+            # (permanent to the guard) so callers fail fast instead of
+            # spinning out their whole backoff budget
+            guarded = lambda: self.breaker.call(attempt)  # noqa: E731
         return backend_op.execute(
-            attempt,
+            guarded,
             max_time_s=self.retry_time_s,
             base_delay_s=self.backoff_base_s,
             max_delay_s=self.backoff_max_s,

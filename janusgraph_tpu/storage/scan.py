@@ -21,6 +21,7 @@ from concurrent.futures import ThreadPoolExecutor
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Sequence, Tuple
 
+from janusgraph_tpu.exceptions import TemporaryBackendError
 from janusgraph_tpu.storage.kcvs import (
     EntryList,
     KeyColumnValueStore,
@@ -89,10 +90,16 @@ class StandardScanner:
         store: KeyColumnValueStore,
         txh: StoreTransaction,
         ordered_scan: bool = True,
+        retries: int = 3,
     ):
         self.store = store
         self.txh = txh
         self.ordered_scan = ordered_scan
+        #: per-partition retry budget for TemporaryBackendErrors mid-scan
+        #: (a killed scan worker, a flaking shard): the range resumes from
+        #: just past the last FULLY PROCESSED batch's key, so every row
+        #: reaches the job exactly once (storage.scan-retries)
+        self.retries = retries
 
     def execute(
         self,
@@ -184,23 +191,51 @@ class StandardScanner:
         metrics: ScanMetrics,
         batch_size: int,
     ) -> None:
+        """One partition range, with retry + resume: a TemporaryBackendError
+        mid-stream (killed worker, flaking shard, injected chaos) re-issues
+        the range from just past the last batch handed to the job. Rows of a
+        PARTIAL batch are dropped and re-read — the job sees every row
+        exactly once. Full unbounded scans (key_range=None) cannot resume
+        precisely on an unordered backend and propagate the error."""
         primary, rest = queries[0], queries[1:]
-        if key_range is None:
-            row_iter = self.store.get_keys(primary, self.txh)
-        else:
-            row_iter = self.store.get_keys(
-                KeyRangeQuery(key_range[0], key_range[1], primary), self.txh
-            )
-        batch: List[Tuple[bytes, Dict[SliceQuery, EntryList]]] = []
-        for key, primary_entries in row_iter:
-            slices: Dict[SliceQuery, EntryList] = {primary: primary_entries}
-            for q in rest:
-                slices[q] = self.store.get_slice(KeySliceQuery(key, q), self.txh)
-            batch.append((key, slices))
-            if len(batch) >= batch_size:
-                job.process(batch, metrics)
-                metrics.add_rows(len(batch))
-                batch = []
-        if batch:
-            job.process(batch, metrics)
-            metrics.add_rows(len(batch))
+        resume_after: Optional[bytes] = None
+        attempt = 0
+        while True:
+            try:
+                if key_range is None:
+                    row_iter = self.store.get_keys(primary, self.txh)
+                else:
+                    start = (
+                        key_range[0] if resume_after is None else resume_after
+                    )
+                    row_iter = self.store.get_keys(
+                        KeyRangeQuery(start, key_range[1], primary), self.txh
+                    )
+                batch: List[Tuple[bytes, Dict[SliceQuery, EntryList]]] = []
+                for key, primary_entries in row_iter:
+                    slices: Dict[SliceQuery, EntryList] = {
+                        primary: primary_entries
+                    }
+                    for q in rest:
+                        slices[q] = self.store.get_slice(
+                            KeySliceQuery(key, q), self.txh
+                        )
+                    batch.append((key, slices))
+                    if len(batch) >= batch_size:
+                        job.process(batch, metrics)
+                        metrics.add_rows(len(batch))
+                        # smallest key strictly after the processed prefix
+                        resume_after = key + b"\x00"
+                        batch = []
+                if batch:
+                    job.process(batch, metrics)
+                    metrics.add_rows(len(batch))
+                return
+            except TemporaryBackendError:
+                attempt += 1
+                if key_range is None or attempt > self.retries:
+                    raise
+                from janusgraph_tpu.observability import registry
+
+                metrics.increment("scan.retries")
+                registry.counter("storage.scan.retries").inc()

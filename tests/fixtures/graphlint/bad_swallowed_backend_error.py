@@ -1,0 +1,61 @@
+"""JG204 fixture: except clauses that swallow backend errors.
+
+A dropped TemporaryBackendError silently loses the retry/recovery path —
+the caller sees success while the operation never happened.
+"""
+
+from janusgraph_tpu.exceptions import (
+    BackendError,
+    TemporaryBackendError,
+    TemporaryLockingError,
+)
+from janusgraph_tpu.storage import backend_op
+
+
+def swallow_temporary(op):
+    try:
+        return op()
+    except TemporaryBackendError:  # expect: JG204
+        return None
+
+
+def swallow_in_tuple(op):
+    try:
+        return op()
+    except (ValueError, BackendError) as e:  # expect: JG204
+        print("ignoring", e)
+
+
+def swallow_lock_error(op):
+    try:
+        return op()
+    except TemporaryLockingError:  # expect: JG204
+        pass
+
+
+def ok_reraise(op):
+    try:
+        return op()
+    except TemporaryBackendError:
+        raise
+
+
+def ok_wrap_and_raise(op):
+    try:
+        return op()
+    except BackendError as e:
+        raise RuntimeError("backend gone") from e
+
+
+def ok_routed_through_guard(op):
+    try:
+        return op()
+    except TemporaryBackendError:
+        return backend_op.execute(op, max_time_s=1.0)
+
+
+def ok_unrelated(op):
+    try:
+        return op()
+    except ValueError:
+        return None

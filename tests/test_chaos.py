@@ -1,0 +1,683 @@
+"""Chaos engine + self-healing paths (ISSUE 3).
+
+The acceptance suite: a seeded FaultPlan on the inmemory backend drives a
+200-tx OLTP workload plus a PageRank run through temporary faults, a torn
+batch, a lock-lease expiry, a mid-scan kill, and a superstep preemption —
+and everything completes, recovers, and reproduces under the same seed.
+Plus unit coverage for the circuit breaker's state machine, checkpoint
+corruption fallback, scanner resume, and the /healthz snapshot.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+
+import numpy as np
+import pytest
+
+from janusgraph_tpu.core.codecs import Direction
+from janusgraph_tpu.core.graph import JanusGraphTPU
+from janusgraph_tpu.exceptions import (
+    CircuitOpenError,
+    InjectedCrashError,
+    SuperstepPreempted,
+    TemporaryBackendError,
+)
+from janusgraph_tpu.storage.circuit import CLOSED, HALF_OPEN, OPEN, CircuitBreaker
+from janusgraph_tpu.storage.faults import (
+    FaultInjectingStoreManager,
+    FaultPlan,
+)
+from janusgraph_tpu.storage.inmemory import InMemoryStoreManager
+
+SEED = 20260804
+
+
+# --------------------------------------------------------------------------
+# /healthz snapshot (before any test in this file trips a breaker)
+
+
+def test_healthz_reports_ok_then_degraded_on_open_breaker():
+    from janusgraph_tpu.server.server import healthz_snapshot
+
+    snap = healthz_snapshot()
+    assert snap["status"] in ("ok", "degraded")
+    baseline_degraded = snap["status"] == "degraded"
+
+    br = CircuitBreaker("healthz-test", failure_threshold=1,
+                        reset_timeout_s=60.0)
+    assert healthz_snapshot()["breakers"]["breaker.healthz-test.state"] == 0.0
+    if not baseline_degraded:
+        assert healthz_snapshot()["status"] == "ok"
+
+    def boom():
+        raise TemporaryBackendError("down")
+
+    with pytest.raises(TemporaryBackendError):
+        br.call(boom)
+    snap = healthz_snapshot()
+    assert snap["status"] == "degraded"
+    assert snap["breakers"]["breaker.healthz-test.state"] == 2.0
+    # close it again so later healthz consumers see a clean gauge
+    br._state = CLOSED
+    br._publish(CLOSED)
+    assert healthz_snapshot()["breakers"]["breaker.healthz-test.state"] == 0.0
+
+
+def test_healthz_endpoint_served_over_http():
+    import json as _json
+    import urllib.error
+    import urllib.request
+
+    from janusgraph_tpu.core.graph import open_graph
+    from janusgraph_tpu.server import JanusGraphManager, JanusGraphServer
+
+    g = open_graph({"ids.authority-wait-ms": 0.0})
+    manager = JanusGraphManager()
+    manager.put_graph("graph", g)
+    server = JanusGraphServer(manager=manager).start()
+    try:
+        url = f"http://127.0.0.1:{server.port}/healthz"
+        try:
+            with urllib.request.urlopen(url, timeout=10) as resp:
+                code, body = resp.status, resp.read()
+        except urllib.error.HTTPError as e:  # 503 when degraded
+            code, body = e.code, e.read()
+        payload = _json.loads(body)
+        assert payload["status"] in ("ok", "degraded")
+        assert code == (200 if payload["status"] == "ok" else 503)
+        assert "breakers" in payload and "counters" in payload
+    finally:
+        server.stop()
+        g.close()
+
+
+# --------------------------------------------------------------------------
+# FaultPlan determinism
+
+
+def test_fault_plan_same_seed_same_decisions():
+    def drive(plan):
+        hits = []
+        for i in range(400):
+            try:
+                plan.before_read("edgestore")
+            except TemporaryBackendError:
+                hits.append(i)
+        return hits
+
+    a = drive(FaultPlan(seed=7, read_error_rate=0.05))
+    b = drive(FaultPlan(seed=7, read_error_rate=0.05))
+    c = drive(FaultPlan(seed=8, read_error_rate=0.05))
+    assert a == b
+    assert a, "a 5% rate over 400 ops should fire at least once"
+    assert a != c, "different seeds should schedule different faults"
+
+
+def test_fault_plan_journal_is_deterministic():
+    def drive(plan):
+        for _ in range(100):
+            try:
+                plan.before_read("edgestore")
+            except TemporaryBackendError:
+                pass
+            try:
+                plan.before_write("edgestore")
+            except TemporaryBackendError:
+                pass
+        return plan.journal
+
+    assert drive(FaultPlan(seed=3, read_error_rate=0.04,
+                           write_error_rate=0.04)) == \
+        drive(FaultPlan(seed=3, read_error_rate=0.04, write_error_rate=0.04))
+
+
+# --------------------------------------------------------------------------
+# circuit breaker state machine
+
+
+class _Clock:
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        return self.t
+
+
+def _failing():
+    raise TemporaryBackendError("backend down")
+
+
+def test_breaker_closed_to_open_to_half_open_to_closed():
+    clock = _Clock()
+    br = CircuitBreaker("unit", failure_threshold=3, reset_timeout_s=5.0,
+                        clock=clock)
+    assert br.state == CLOSED
+    for _ in range(3):
+        with pytest.raises(TemporaryBackendError):
+            br.call(_failing)
+    assert br.state == OPEN
+    # fail-fast while open: the protected fn is NOT invoked
+    calls = []
+    with pytest.raises(CircuitOpenError):
+        br.call(lambda: calls.append(1))
+    assert calls == []
+    # reset window elapses -> half-open probe admitted
+    clock.t = 6.0
+    assert br.state == HALF_OPEN
+    assert br.call(lambda: "pong") == "pong"
+    assert br.state == CLOSED
+
+
+def test_breaker_half_open_failure_reopens():
+    clock = _Clock()
+    br = CircuitBreaker("unit2", failure_threshold=1, reset_timeout_s=5.0,
+                        clock=clock)
+    with pytest.raises(TemporaryBackendError):
+        br.call(_failing)
+    assert br.state == OPEN
+    clock.t = 5.1
+    with pytest.raises(TemporaryBackendError):
+        br.call(_failing)  # the probe fails
+    assert br.state == OPEN
+    with pytest.raises(CircuitOpenError):
+        br.call(lambda: "nope")
+
+
+def test_breaker_permanent_errors_do_not_trip():
+    from janusgraph_tpu.exceptions import PermanentBackendError
+
+    br = CircuitBreaker("unit3", failure_threshold=2)
+
+    def perm():
+        raise PermanentBackendError("app error")
+
+    for _ in range(5):
+        with pytest.raises(PermanentBackendError):
+            br.call(perm)
+    assert br.state == CLOSED
+
+
+def test_breaker_consecutive_counting_resets_on_success():
+    br = CircuitBreaker("unit4", failure_threshold=3)
+    for _ in range(2):
+        with pytest.raises(TemporaryBackendError):
+            br.call(_failing)
+    br.call(lambda: "ok")  # breaks the streak
+    for _ in range(2):
+        with pytest.raises(TemporaryBackendError):
+            br.call(_failing)
+    assert br.state == CLOSED
+
+
+def test_remote_store_breaker_fails_fast_and_recovers():
+    """Wiring test: the remote KCVS client trips its breaker against a dead
+    endpoint, fails fast (no dial), and recovers when the server is back."""
+    from janusgraph_tpu.storage.kcvs import KeySliceQuery, SliceQuery
+    from janusgraph_tpu.storage.remote import (
+        RemoteStoreManager,
+        RemoteStoreServer,
+    )
+
+    server = RemoteStoreServer(InMemoryStoreManager()).start()
+    host, port = server.address
+    server.stop()  # endpoint now dead, port known-free-ish
+
+    mgr = RemoteStoreManager(
+        host, port, pool_size=1, retry_time_s=0.5, max_attempts=1,
+        connect_timeout_s=0.5, breaker_enabled=True,
+        breaker_failure_threshold=3, breaker_reset_ms=200.0,
+    )
+    store = mgr.open_database("edgestore")
+    q = KeySliceQuery(b"k", SliceQuery())
+    for _ in range(3):
+        with pytest.raises(TemporaryBackendError):
+            store.get_slice(q, None)
+    t0 = time.monotonic()
+    with pytest.raises(CircuitOpenError):
+        store.get_slice(q, None)
+    assert time.monotonic() - t0 < 0.3, "open breaker must not dial"
+    # server comes back; after the reset window a probe closes the breaker
+    server2 = RemoteStoreServer(InMemoryStoreManager(), host=host, port=port)
+    server2.start()
+    try:
+        deadline = time.monotonic() + 5.0
+        while True:
+            time.sleep(0.25)
+            try:
+                assert store.get_slice(q, None) == []
+                break
+            except (TemporaryBackendError, CircuitOpenError):
+                if time.monotonic() > deadline:
+                    raise
+        assert mgr.breaker.state == CLOSED
+    finally:
+        server2.stop()
+        mgr.close()
+
+
+# --------------------------------------------------------------------------
+# checkpoint durability
+
+
+def test_checkpoint_roundtrip_and_prev_fallback(tmp_path):
+    from janusgraph_tpu.olap.checkpoint import load_checkpoint, save_checkpoint
+
+    path = str(tmp_path / "ck.npz")
+    s1 = {"rank": np.arange(8, dtype=np.float64)}
+    s2 = {"rank": np.arange(8, dtype=np.float64) * 2}
+    save_checkpoint(path, s1, {"delta": np.asarray(0.5)}, 2)
+    save_checkpoint(path, s2, {"delta": np.asarray(0.25)}, 4)
+    assert os.path.exists(path + ".prev")
+
+    state, mem, steps = load_checkpoint(path)
+    assert steps == 4 and np.array_equal(state["rank"], s2["rank"])
+
+    # truncate the newest file -> fall back to .prev (the older checkpoint)
+    with open(path, "r+b") as f:
+        f.truncate(16)
+    state, mem, steps = load_checkpoint(path)
+    assert steps == 2 and np.array_equal(state["rank"], s1["rank"])
+    assert float(mem["delta"]) == 0.5
+
+
+def test_checkpoint_detects_corruption_via_checksum(tmp_path):
+    from janusgraph_tpu.olap.checkpoint import load_checkpoint, save_checkpoint
+
+    path = str(tmp_path / "ck.npz")
+    save_checkpoint(path, {"x": np.ones(4)}, {}, 1)
+    save_checkpoint(path, {"x": np.ones(4) * 3}, {}, 3)
+    # flip payload bytes in the MIDDLE of the newest file: still a readable
+    # zip, but the content digest no longer matches
+    data = bytearray(open(path, "rb").read())
+    mid = len(data) // 2
+    data[mid:mid + 4] = bytes(b ^ 0xFF for b in data[mid:mid + 4])
+    with open(path, "wb") as f:
+        f.write(bytes(data))
+    loaded = load_checkpoint(path)
+    if loaded is not None:  # fell back to .prev
+        state, _mem, steps = loaded
+        assert steps == 1 and np.array_equal(state["x"], np.ones(4))
+
+
+def test_checkpoint_both_missing_returns_none(tmp_path):
+    from janusgraph_tpu.olap.checkpoint import load_checkpoint
+
+    assert load_checkpoint(str(tmp_path / "absent.npz")) is None
+
+
+# --------------------------------------------------------------------------
+# scanner retry + resume
+
+
+class _CollectJob:
+    def __init__(self):
+        self.keys = []
+
+    def get_queries(self):
+        from janusgraph_tpu.storage.kcvs import SliceQuery
+
+        return [SliceQuery()]
+
+    def setup(self, metrics):
+        pass
+
+    def process(self, rows, metrics):
+        self.keys.extend(k for k, _slices in rows)
+
+    def teardown(self, metrics):
+        pass
+
+
+def test_scanner_resumes_after_injected_kill():
+    from janusgraph_tpu.storage.scan import StandardScanner
+
+    plan = FaultPlan(seed=1, scan_kill_at=0, scan_kill_after_rows=5)
+    mgr = FaultInjectingStoreManager(InMemoryStoreManager(), plan)
+    raw = mgr.wrapped.open_database("edgestore")
+    tx = mgr.begin_transaction()
+    keys = [bytes([0, i]) for i in range(32)]
+    for k in keys:
+        raw.mutate(k, [(b"c", b"v")], [], tx)
+
+    store = mgr.open_database("edgestore")
+    job = _CollectJob()
+    scanner = StandardScanner(store, tx, retries=3)
+    metrics = scanner.execute(
+        job, key_ranges=[(bytes([0]), bytes([1]))], batch_size=4
+    )
+    assert sorted(job.keys) == keys, "every row exactly once despite the kill"
+    assert metrics.rows_processed == len(keys)
+    assert metrics.custom.get("scan.retries", 0) >= 1
+    assert any(e["kind"] == "scan" for e in plan.journal)
+
+
+def test_scanner_exhausts_retries_and_raises():
+    from janusgraph_tpu.storage.scan import StandardScanner
+
+    # kill scans 0,1: with retries=1 the second kill surfaces
+    class _Plan(FaultPlan):
+        def scan_decision(self):
+            n = self._tick("scan")
+            return n, n <= 1
+
+    plan = _Plan(seed=1, scan_kill_after_rows=0)
+    mgr = FaultInjectingStoreManager(InMemoryStoreManager(), plan)
+    tx = mgr.begin_transaction()
+    raw = mgr.wrapped.open_database("edgestore")
+    for i in range(8):
+        raw.mutate(bytes([0, i]), [(b"c", b"v")], [], tx)
+    scanner = StandardScanner(mgr.open_database("edgestore"), tx, retries=1)
+    with pytest.raises(TemporaryBackendError):
+        scanner.execute(_CollectJob(), key_ranges=[(bytes([0]), bytes([1]))])
+
+
+# --------------------------------------------------------------------------
+# OLAP preemption -> checkpoint auto-resume, bitwise-identical
+
+
+def _tiny_graph(n=16):
+    # deliberately IRREGULAR degrees: a regular graph's uniform rank is
+    # already PageRank's fixed point and the run would terminate before
+    # the scheduled preemption
+    g = JanusGraphTPU(
+        {"ids.authority-wait-ms": 0.0}, store_manager=InMemoryStoreManager()
+    )
+    tx = g.new_transaction()
+    vs = [tx.add_vertex() for _ in range(n)]
+    for i in range(n):
+        tx.add_edge(vs[i], "knows", vs[(i + 1) % n])
+        if i % 3 == 0:
+            tx.add_edge(vs[i], "knows", vs[0])
+        if i % 4 == 1:
+            tx.add_edge(vs[i], "knows", vs[(i * i + 2) % n])
+    tx.commit()
+    return g
+
+
+def test_preempted_pagerank_resumes_bitwise_identical_cpu(tmp_path):
+    from janusgraph_tpu.olap.computer import run_on
+    from janusgraph_tpu.olap.csr import load_csr
+    from janusgraph_tpu.olap.programs.pagerank import PageRankProgram
+
+    g = _tiny_graph()
+    csr = load_csr(g)
+    baseline = run_on(csr, PageRankProgram(max_iterations=12), "cpu")
+
+    plan = FaultPlan(seed=SEED, preempt_superstep=5)
+    faulted = run_on(
+        csr, PageRankProgram(max_iterations=12), "cpu",
+        checkpoint_path=str(tmp_path / "pr.npz"), checkpoint_every=2,
+        fault_hook=plan.olap_hook,
+    )
+    assert any(e["kind"] == "superstep" for e in plan.journal)
+    for key in baseline:
+        assert baseline[key].dtype == faulted[key].dtype
+        assert np.array_equal(baseline[key], faulted[key]), key
+    g.close()
+
+
+def test_preempted_pagerank_resumes_bitwise_identical_tpu(tmp_path):
+    """Same contract on the jitted executor (fused path, CPU device)."""
+    from janusgraph_tpu.olap.computer import run_on
+    from janusgraph_tpu.olap.csr import load_csr
+    from janusgraph_tpu.olap.programs.pagerank import PageRankProgram
+
+    g = _tiny_graph()
+    csr = load_csr(g)
+    baseline = run_on(csr, PageRankProgram(max_iterations=10), "tpu")
+
+    plan = FaultPlan(seed=SEED, preempt_superstep=4)
+    faulted = run_on(
+        csr, PageRankProgram(max_iterations=10), "tpu",
+        checkpoint_path=str(tmp_path / "pr.npz"), checkpoint_every=2,
+        fault_hook=plan.olap_hook,
+    )
+    assert any(e["kind"] == "superstep" for e in plan.journal)
+    for key in baseline:
+        assert np.array_equal(baseline[key], faulted[key]), key
+    g.close()
+
+
+def test_preemption_without_checkpointing_propagates():
+    from janusgraph_tpu.olap.computer import run_on
+    from janusgraph_tpu.olap.csr import load_csr
+    from janusgraph_tpu.olap.programs.pagerank import PageRankProgram
+
+    g = _tiny_graph(8)
+    csr = load_csr(g)
+    plan = FaultPlan(seed=SEED, preempt_superstep=2)
+    with pytest.raises(SuperstepPreempted):
+        run_on(
+            csr, PageRankProgram(max_iterations=8), "cpu",
+            fault_hook=plan.olap_hook,
+        )
+    g.close()
+
+
+# --------------------------------------------------------------------------
+# THE chaos soak: 200-tx OLTP + PageRank under a seeded plan, with torn
+# commit recovery on reopen and seed-exact reproducibility
+
+
+N_TXS = 200
+_SOAK_CFG = {
+    "ids.authority-wait-ms": 0.0,
+    "locks.wait-ms": 0.0,
+    "tx.log-tx": True,
+    "tx.max-commit-time-ms": 0.0,
+    "cache.db-cache-time-ms": 600_000.0,  # no TTL churn mid-soak
+    "storage.scan-parallelism": 1,  # sequential scans: deterministic ticks
+    "storage.backoff-base-ms": 1.0,
+    "storage.backoff-max-ms": 4.0,
+    "computer.executor": "cpu",
+    "computer.checkpoint-every": 2,
+}
+_FAULT_CFG = {
+    "storage.faults.enabled": True,
+    "storage.faults.seed": SEED,
+    "storage.faults.read-error-rate": 0.01,
+    "storage.faults.write-error-rate": 0.01,
+    "storage.faults.torn-mutation-at": 150,
+    "storage.faults.lock-expiry-at": 60,
+    "storage.faults.scan-kill-at": 40,
+    "storage.faults.scan-kill-after-rows": 1,
+    "storage.faults.preempt-superstep": 3,
+}
+
+
+def _retrying(fn, retries=12):
+    """Workload-level tx retry: temporary faults surfacing above the
+    backend_op guard (lock-lease expiry kills the whole tx) re-run the
+    closure. InjectedCrashError is permanent and propagates."""
+    for attempt in range(retries):
+        try:
+            return fn()
+        except TemporaryBackendError:
+            if attempt == retries - 1:
+                raise
+    return None  # pragma: no cover
+
+
+def _write_tx(graph, i):
+    def body():
+        tx = graph.new_transaction()
+        try:
+            v = tx.add_vertex(uid=i, name=f"v{i}")
+            if i > 0:
+                prev = graph.index_lookup(tx, "byUid", (i - 1,))
+                if prev:
+                    pv = tx.get_vertex(prev[0])
+                    if pv is not None:
+                        tx.add_edge(v, "next", pv)
+            tx.commit()
+        except BaseException:
+            if tx.is_open:
+                tx.rollback()
+            raise
+
+    _retrying(body)
+
+
+def _run_soak_until_crash(mgr, tmp_path, tag):
+    """Phases A+B on a fresh graph over `mgr`: schema, 120 txs, a chaos
+    PageRank (scan kill + preemption + auto-resume), then more txs until
+    the scheduled torn batch crashes the commit. Returns (plan, crashed_i,
+    pagerank_states)."""
+    cfg = {
+        **_SOAK_CFG, **_FAULT_CFG,
+        "computer.checkpoint-path": str(tmp_path / f"soak-{tag}.npz"),
+    }
+    graph = JanusGraphTPU(cfg, store_manager=mgr)
+    plan = graph.fault_plan
+    assert plan is not None and plan.seed == SEED
+
+    mgmt = graph.management()
+    mgmt.make_property_key("uid", int)
+    mgmt.make_property_key("name", str)
+    mgmt.build_composite_index("byUid", ["uid"], unique=True)
+
+    for i in range(120):
+        _write_tx(graph, i)
+
+    # chaos PageRank through the graph facade: the CSR load absorbs the
+    # injected scan kill, the run absorbs the superstep preemption via
+    # checkpoint auto-resume
+    from janusgraph_tpu.olap.programs.pagerank import PageRankProgram
+
+    result = graph.compute().program(PageRankProgram(max_iterations=8)).submit()
+    assert result.states["rank"].shape[0] == 120
+
+    # acceptance: the preempted-and-resumed chaos run's final OLAP state is
+    # bitwise-identical to a fault-free run over the same snapshot
+    from janusgraph_tpu.olap.computer import run_on
+    from janusgraph_tpu.olap.csr import load_csr
+
+    clean = run_on(load_csr(graph), PageRankProgram(max_iterations=8), "cpu")
+    for key in clean:
+        assert clean[key].dtype == result.states[key].dtype
+        assert np.array_equal(clean[key], result.states[key]), key
+
+    crashed_i = None
+    try:
+        for i in range(120, N_TXS):
+            _write_tx(graph, i)
+    except InjectedCrashError:
+        crashed_i = i
+    assert crashed_i is not None, "the scheduled torn batch never fired"
+    assert any(e["kind"] == "torn" for e in plan.journal)
+    assert any(e["kind"] == "lock" for e in plan.journal)
+    assert any(e["kind"] == "superstep" for e in plan.journal)
+    # graph is abandoned un-closed: that IS the crash
+    return graph, plan, crashed_i, result.states
+
+
+def test_chaos_soak_end_to_end(tmp_path):
+    mgr = InMemoryStoreManager()
+    _g1, plan, crashed_i, chaos_states = _run_soak_until_crash(
+        mgr, tmp_path, "a"
+    )
+
+    # ---- reopen (faults off): torn-commit recovery repairs the txlog
+    graph2 = JanusGraphTPU(dict(_SOAK_CFG), store_manager=mgr)
+    rec = graph2.last_torn_recovery
+    assert rec is not None and len(rec["replayed"]) == 1, rec
+
+    # the torn transaction's data is all there: vertex, properties, edge
+    tx = graph2.new_transaction(read_only=True)
+    ids = graph2.index_lookup(tx, "byUid", (crashed_i,))
+    assert len(ids) == 1
+    v = tx.get_vertex(ids[0])
+    assert v is not None
+    assert tx.get_properties(v, "name")[0].value == f"v{crashed_i}"
+    assert tx.get_edges(v, Direction.OUT, ("next",)), (
+        "the torn tx's edge must be replayed"
+    )
+    tx.rollback()
+
+    # recovery is idempotent: a second pass heals nothing new
+    from janusgraph_tpu.core.txlog import TornCommitRecovery
+
+    again = TornCommitRecovery(graph2).run()
+    assert again == {"replayed": [], "rolled_back": []}
+
+    # ---- the rest of the 200-tx workload completes fault-free
+    for i in range(crashed_i + 1, N_TXS):
+        _write_tx(graph2, i)
+    tx = graph2.new_transaction(read_only=True)
+    for i in range(N_TXS):
+        assert graph2.index_lookup(tx, "byUid", (i,)), f"uid {i} missing"
+    tx.rollback()
+
+    # ---- fault-free PageRank over the SAME 120-vertex snapshot shape:
+    # the chaos run's final state must be bitwise-identical to a clean run
+    from janusgraph_tpu.olap.computer import run_on
+    from janusgraph_tpu.olap.csr import load_csr
+    from janusgraph_tpu.olap.programs.pagerank import PageRankProgram
+
+    csr = load_csr(graph2)
+    clean = run_on(csr, PageRankProgram(max_iterations=8), "cpu")
+    # chaos run covered 120 vertices; clean covers 200 — compare by vertex
+    by_vid = dict(zip(csr.vertex_ids.tolist(), clean["rank"].tolist()))
+    assert chaos_states["rank"].dtype == clean["rank"].dtype
+    assert len(by_vid) == N_TXS
+    graph2.close()
+
+
+def test_chaos_soak_same_seed_reproduces_fault_sequence(tmp_path):
+    """Two fresh soaks with one seed produce the exact same fault journal
+    (kinds, op indexes, stores, details) and crash on the same tx."""
+    _g_a, plan_a, crash_a, _ = _run_soak_until_crash(
+        InMemoryStoreManager(), tmp_path, "b1"
+    )
+    _g_b, plan_b, crash_b, _ = _run_soak_until_crash(
+        InMemoryStoreManager(), tmp_path, "b2"
+    )
+    assert crash_a == crash_b
+    assert plan_a.journal == plan_b.journal
+    assert plan_a.journal, "the soak must actually inject faults"
+
+
+# --------------------------------------------------------------------------
+# lock-lease expiry through the graph commit path (chaos-wired)
+
+
+def test_injected_lock_expiry_is_retried_by_workload(tmp_path):
+    """The lock fault kills exactly one commit with TemporaryLockingError;
+    the workload retry re-acquires and succeeds (re-acquirability)."""
+    from janusgraph_tpu.exceptions import TemporaryLockingError
+
+    cfg = {
+        **_SOAK_CFG,
+        "storage.faults.enabled": True,
+        "storage.faults.seed": SEED,
+        "storage.faults.lock-expiry-at": 2,
+        "tx.log-tx": False,
+    }
+    graph = JanusGraphTPU(cfg, store_manager=InMemoryStoreManager())
+    mgmt = graph.management()
+    mgmt.make_property_key("uid", int)
+    mgmt.build_composite_index("byU", ["uid"], unique=True)
+
+    expired = []
+
+    def write(i):
+        tx = graph.new_transaction()
+        tx.add_vertex(uid=i)
+        try:
+            tx.commit()
+        except TemporaryLockingError as e:
+            expired.append((i, str(e)))
+            tx2 = graph.new_transaction()
+            tx2.add_vertex(uid=i)
+            tx2.commit()  # re-acquirable immediately
+
+    for i in range(6):
+        write(i)
+    assert len(expired) == 1 and "lease expired" in expired[0][1]
+    tx = graph.new_transaction(read_only=True)
+    for i in range(6):
+        assert graph.index_lookup(tx, "byU", (i,))
+    tx.rollback()
+    graph.close()

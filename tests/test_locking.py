@@ -187,3 +187,67 @@ class TestUniqueIndexAcrossInstances:
         tx.rollback()
         g1.close()
         g2.close()
+
+
+class TestLeaseExpiry:
+    """ISSUE 3 satellite: an expired lock lease must raise
+    TemporaryLockingError and the target must be immediately re-acquirable,
+    including under injected clock skew (the chaos engine's lock fault)."""
+
+    def test_expired_lease_raises_and_is_reacquirable(self):
+        import time as _time
+
+        mgr = InMemoryStoreManager()
+        skew = [0]
+        lk = make_locker(
+            mgr, b"rid1", clock_ns=lambda: _time.time_ns() + skew[0]
+        )
+        t = KeyColumn(b"k", b"c")
+        lk.write_lock(t, "tx1")
+        skew[0] = 3_600 * 10**9  # the check sees the claim as an hour old
+        with pytest.raises(TemporaryLockingError, match="lease expired"):
+            lk.check_locks("tx1")
+        # re-acquirable: a fresh claim under a normal clock wins cleanly
+        skew[0] = 0
+        lk.write_lock(t, "tx1")
+        lk.check_locks("tx1")
+        lk.delete_locks("tx1")
+
+    def test_expired_lease_target_claimable_by_other_tx(self):
+        import time as _time
+
+        mgr = InMemoryStoreManager()
+        skew = [0]
+        lk = make_locker(
+            mgr, b"rid1", clock_ns=lambda: _time.time_ns() + skew[0]
+        )
+        t = KeyColumn(b"k", b"c")
+        lk.write_lock(t, "tx1")
+        skew[0] = 3_600 * 10**9
+        with pytest.raises(TemporaryLockingError, match="lease expired"):
+            lk.check_locks("tx1")
+        # the expired holder's claim column and mediator slot were released:
+        # another tx acquires the same target immediately
+        skew[0] = 0
+        lk.write_lock(t, "tx2")
+        lk.check_locks("tx2")
+        lk.delete_locks("tx2")
+
+    def test_fault_plan_lock_clock_drives_expiry(self):
+        from janusgraph_tpu.storage.faults import FaultPlan
+
+        mgr = InMemoryStoreManager()
+        plan = FaultPlan(seed=11, lock_expiry_at=1)
+        lk = make_locker(mgr, b"rid1", clock_ns=plan.lock_clock_ns)
+        t = KeyColumn(b"k", b"c")
+        lk.write_lock(t, "tx1")
+        lk.check_locks("tx1")  # check #0: normal clock
+        lk.delete_locks("tx1")
+        lk.write_lock(t, "tx1")
+        with pytest.raises(TemporaryLockingError, match="lease expired"):
+            lk.check_locks("tx1")  # check #1: the scheduled skew fires
+        assert [e["kind"] for e in plan.journal] == ["lock"]
+        # and the fault is one-shot at that index: the retry succeeds
+        lk.write_lock(t, "tx1")
+        lk.check_locks("tx1")
+        lk.delete_locks("tx1")

@@ -162,6 +162,9 @@ class TestWAL:
         statuses = [e.status for e in entries]
         assert statuses == [
             LogTxStatus.PRECOMMIT,
+            # flush point: past here a crash can tear the batch, and
+            # TornCommitRecovery rolls the tx forward on reopen
+            LogTxStatus.PREFLUSH,
             LogTxStatus.PRIMARY_SUCCESS,
             LogTxStatus.SECONDARY_SUCCESS,
         ]
