@@ -299,8 +299,28 @@ def supervise() -> int:
 # --------------------------------------------------------------------------
 
 def _emit(obj: dict) -> None:
+    # every stage line carries the flight-recorder per-category counts at
+    # emit time plus the stage's root trace id (stages run under a
+    # bench.<stage> span — see _stage_span), so a BENCH_r*.json number
+    # correlates straight to the black-box timeline and the span tree
+    try:
+        from janusgraph_tpu.observability import flight_recorder, tracer
+
+        obj.setdefault("flight_counts", flight_recorder.counts())
+        span = tracer.current()
+        if span is not None:
+            obj.setdefault("trace_id", f"{span.trace_id:016x}")
+    except Exception:  # noqa: BLE001 - telemetry must never break the bench
+        pass
     print(json.dumps(obj))
     sys.stdout.flush()
+
+
+def _stage_span(name: str, **attrs):
+    """Root span for one bench stage; _emit picks its trace_id up."""
+    from janusgraph_tpu.observability import tracer
+
+    return tracer.span(f"bench.{name}", **attrs)
 
 
 #: last-progress timestamp for the stage watchdog (see worker()): _hb is
@@ -869,10 +889,11 @@ def worker() -> None:
 
     for scale in scales:
         try:
-            _bench_scale(
-                jax, platform, scale, edge_factor, pr_iters, strategy, t0,
-                extras_scale,
-            )
+            with _stage_span("rung", scale=scale):
+                _bench_scale(
+                    jax, platform, scale, edge_factor, pr_iters, strategy,
+                    t0, extras_scale,
+                )
         except Exception as e:  # report and stop climbing
             _hb(f"s{scale}: FAILED {type(e).__name__}: {e}", t0)
             _emit({
@@ -886,7 +907,8 @@ def worker() -> None:
     # BASELINE dataset-fidelity rows (configs #2/#4)
     if os.environ.get("BENCH_DATASETS", "1") != "0":
         try:
-            _datasets_stage(jax, platform, t0)
+            with _stage_span("datasets"):
+                _datasets_stage(jax, platform, t0)
         except Exception as e:
             _hb(f"datasets stage FAILED {type(e).__name__}: {e}", t0)
             _emit({
@@ -898,7 +920,8 @@ def worker() -> None:
     # edge cap (~10-20s for both backends)
     if os.environ.get("BENCH_OLTP", "1") != "0":
         try:
-            _oltp_stage(t0)
+            with _stage_span("oltp"):
+                _oltp_stage(t0)
         except Exception as e:
             _hb(f"oltp stage FAILED {type(e).__name__}: {e}", t0)
             _emit({
@@ -912,7 +935,8 @@ def worker() -> None:
     # artifacts track robustness cost over rounds
     if os.environ.get("BENCH_CHAOS", "0") == "1":
         try:
-            _chaos_stage(t0)
+            with _stage_span("chaos"):
+                _chaos_stage(t0)
         except Exception as e:
             _hb(f"chaos stage FAILED {type(e).__name__}: {e}", t0)
             _emit({
@@ -943,7 +967,8 @@ def worker() -> None:
 
         threading.Thread(target=_pallas_watchdog, daemon=True).start()
         try:
-            _pallas_stage(jax, pr_iters, t0)
+            with _stage_span("pallas"):
+                _pallas_stage(jax, pr_iters, t0)
         except Exception as e:
             _hb(f"pallas stage FAILED {type(e).__name__}: {e}", t0)
             _emit({
@@ -1047,9 +1072,29 @@ def _chaos_stage(t0):
         "torn_rolled_back": len(rec.get("rolled_back", ())),
         "recovery_open_ms": round(recovery_ms, 2),
         "wall_s": round(time.perf_counter() - w0, 3),
+        **_chaos_flight_dump(),
     })
     graph2.close()
     _hb(f"chaos stage ok ({present}/{n_txs} present)", t0)
+
+
+def _chaos_flight_dump() -> dict:
+    """BENCH_CHAOS extra: write a flight-recorder dump of the chaos run
+    and record its size + write latency, so the artifact tracks the cost
+    of the black box itself over rounds."""
+    from janusgraph_tpu.observability import flight_recorder
+
+    d0 = time.perf_counter()
+    path = flight_recorder.dump(reason="bench-chaos")
+    dump_ms = (time.perf_counter() - d0) * 1000.0
+    if path is None:
+        return {"flight_dump": None}
+    return {
+        "flight_dump": path,
+        "flight_dump_bytes": os.path.getsize(path),
+        "flight_dump_ms": round(dump_ms, 3),
+        "flight_dump_events": flight_recorder.occupancy,
+    }
 
 
 def _datasets_stage(jax, platform, t0):

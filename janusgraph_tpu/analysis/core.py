@@ -52,6 +52,11 @@ RULES: Dict[str, Rule] = {
              "(records once per COMPILE, not per execution; coercing a "
              "traced attribute value forces a host sync — record from "
              "host code after the dispatch)"),
+        Rule("JG107", SEV_ERROR,
+             "structured-log or flight-recorder call inside a jit-traced "
+             "context (the record is emitted once per COMPILE with "
+             "trace-time values, and coercing a traced field forces a "
+             "host sync — log/record from host code after the dispatch)"),
         # -- lock discipline ------------------------------------------------
         Rule("JG201", SEV_ERROR,
              "lock.acquire() without with/try-finally release on all paths"),

@@ -22,6 +22,12 @@ JG106  telemetry recording inside a jit context: a metric/span call on
        execution, and any traced attribute value is a host-sync hazard.
        Record from host code after the dispatch (see
        TPUExecutor._finish_run for the sanctioned pattern).
+JG107  structured-log / flight-recorder call inside a jit context:
+       `flight_recorder.record(...)`, `recorder.dump(...)`, or a
+       `logger.info/warning/error(...)` emitted from a traced body fires
+       once per COMPILE with trace-time values (and coercing a traced
+       field is a hidden sync). Same fix as JG106: emit from host code
+       after the dispatch.
 """
 
 from __future__ import annotations
@@ -212,6 +218,44 @@ def _check_telemetry_in_trace(mod) -> List[Finding]:
     return out
 
 
+#: receiver names identifying the flight recorder / structured-log layer
+_FLIGHT_ROOTS = {"flight", "recorder", "flight_recorder"}
+_FLIGHT_RECORDERS = {"record", "dump"}
+#: structured-logger receivers (observability.logging.get_logger naming
+#: conventions) and their emit methods
+_LOGGER_ROOTS = {"logger", "log", "slog", "structured_logger"}
+_LOGGER_EMITTERS = {"debug", "info", "warning", "error", "exception",
+                    "critical"}
+
+
+def _check_flight_in_trace(mod) -> List[Finding]:
+    """JG107: flight-recorder records / structured-log emits inside traced
+    bodies. Receiver-chain matched like JG106, so `math.log(x)` or a
+    dict's `.update()` never hit."""
+    out: List[Finding] = []
+    for td in find_traced_defs(mod).values():
+        name = getattr(td.node, "name", "<lambda>")
+        for sub in ast.walk(td.node):
+            if not isinstance(sub, ast.Call) or not isinstance(
+                sub.func, ast.Attribute
+            ):
+                continue
+            t = terminal_name(sub.func)
+            chain = _chain_names(sub.func.value)
+            hit = (
+                (t in _FLIGHT_RECORDERS and chain & _FLIGHT_ROOTS)
+                or (t in _LOGGER_EMITTERS and chain & _LOGGER_ROOTS)
+            )
+            if hit:
+                out.append(_finding(
+                    "JG107", mod, sub,
+                    f"flight/log call `{ast.unparse(sub.func)}` inside jit "
+                    f"context `{name}` — it fires once per compile with "
+                    f"trace-time values; emit host-side after the dispatch",
+                ))
+    return out
+
+
 def _check_donated_reuse(mod) -> List[Finding]:
     """JG104: best-effort, function-scope-local. Tracks
     `f = jax.jit(g, donate_argnums=(i,))` then `f(x, ...)` then a later
@@ -281,4 +325,5 @@ def check_module(mod) -> List[Finding]:
     out.extend(_check_jit_callsites(mod))
     out.extend(_check_donated_reuse(mod))
     out.extend(_check_telemetry_in_trace(mod))
+    out.extend(_check_flight_in_trace(mod))
     return out

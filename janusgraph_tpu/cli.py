@@ -209,6 +209,61 @@ def cmd_telemetry(args) -> int:
     return 0
 
 
+def cmd_trace(args) -> int:
+    """Print every retained span tree of one trace id (the stitched
+    cross-process view): local process registry by default, or a running
+    server's /telemetry snapshot with --url."""
+    try:
+        trace_id = f"{int(args.trace_id, 16):016x}"
+    except ValueError:
+        print(f"not a hex trace id: {args.trace_id!r}", file=sys.stderr)
+        return 2
+    if args.url:
+        import urllib.request
+
+        base = args.url.rstrip("/")
+        if not base.startswith("http"):
+            base = "http://" + base
+        with urllib.request.urlopen(base + "/telemetry", timeout=10) as resp:
+            payload = json.loads(resp.read().decode("utf-8"))
+        trees = [
+            s for s in payload.get("spans", [])
+            if s.get("trace_id") == trace_id
+        ]
+    else:
+        from janusgraph_tpu.observability import tracer
+
+        trees = [r.to_dict() for r in tracer.find_trace(trace_id)]
+    print(json.dumps({"trace_id": trace_id, "spans": trees}, indent=2,
+                     default=str))
+    return 0 if trees else 1
+
+
+def cmd_flight(args) -> int:
+    """Dump the black-box flight recorder: the bounded ring of salient
+    events (injected faults, breaker transitions, retry exhaustions, torn
+    recoveries, checkpoints, OLAP resumes, slow spans). --dump also
+    writes a JSON dump file; --url reads a running server's /flight."""
+    if args.url:
+        import urllib.request
+
+        base = args.url.rstrip("/")
+        if not base.startswith("http"):
+            base = "http://" + base
+        path = "/flight?dump=1" if args.dump else "/flight"
+        with urllib.request.urlopen(base + path, timeout=10) as resp:
+            sys.stdout.write(resp.read().decode("utf-8"))
+            sys.stdout.write("\n")
+        return 0
+    from janusgraph_tpu.observability import flight_recorder
+
+    if args.dump:
+        path = flight_recorder.dump(reason="cli")
+        print(f"dumped -> {path}", file=sys.stderr)
+    print(json.dumps(flight_recorder.snapshot(), indent=2, default=str))
+    return 0
+
+
 def cmd_chaos(args) -> int:
     """Seeded chaos soak on an inmemory graph: drive an OLTP workload (and
     optionally PageRank) through injected faults including a torn batch,
@@ -416,6 +471,29 @@ def main(argv=None) -> int:
     pt.add_argument("--json", action="store_true",
                     help="JSON snapshot (metrics + spans + slow ops)")
     pt.set_defaults(fn=cmd_telemetry)
+
+    ptr = sub.add_parser(
+        "trace",
+        help="print the span trees of one trace id (stitched view)",
+    )
+    ptr.add_argument("trace_id", help="16-hex-char trace id")
+    ptr.add_argument(
+        "--url", help="read a running server's /telemetry instead of "
+        "this process's tracer",
+    )
+    ptr.set_defaults(fn=cmd_trace)
+
+    pf = sub.add_parser(
+        "flight",
+        help="dump the black-box flight recorder (salient-event ring)",
+    )
+    pf.add_argument(
+        "--url", help="read a running server's /flight instead of this "
+        "process's recorder",
+    )
+    pf.add_argument("--dump", action="store_true",
+                    help="also write a JSON dump file")
+    pf.set_defaults(fn=cmd_flight)
 
     pch = sub.add_parser(
         "chaos",

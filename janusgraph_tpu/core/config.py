@@ -844,6 +844,35 @@ METRICS_NS.option(
     128, Mutability.LOCAL, lambda v: v > 0,
 )
 
+# ---- distributed tracing + flight recorder ------------------------------
+METRICS_NS.option(
+    "trace-propagation", bool,
+    "attach the ambient span's TraceContext to outbound remote-store and "
+    "remote-index op frames (gated on the peer's negotiated feature bit, "
+    "so mixed old/new deployments stay wire-compatible; read at graph "
+    "open into RemoteStoreManager/RemoteIndexProvider)", True,
+    Mutability.MASKABLE,
+)
+METRICS_NS.option(
+    "flight-buffer", int,
+    "events retained in the black-box flight recorder ring "
+    "(observability/flight.py; served at GET /flight and summarized in "
+    "GET /healthz)", 512, Mutability.LOCAL, lambda v: v > 0,
+)
+METRICS_NS.option(
+    "flight-dump-dir", str,
+    "directory flight-recorder dumps are written to on an unhandled "
+    "server error, the /healthz ok->degraded flip, or on demand "
+    "(empty = the system temp dir)", "", Mutability.LOCAL,
+)
+METRICS_NS.option(
+    "structured-logging", bool,
+    "emit one-line JSON log records (with ambient trace_id/span_id) to "
+    "stderr from the server, retry guard, circuit breaker, and chaos "
+    "sites (observability/logging.py; records always land in the "
+    "in-process ring regardless)", False, Mutability.LOCAL,
+)
+
 
 def describe_options() -> str:
     """Render the registry as a config-reference table (reference:

@@ -98,6 +98,7 @@ def _open_remote(cfg):
         breaker_half_open_probes=cfg.get(
             "storage.breaker.half-open-probes"
         ),
+        trace_propagation=cfg.get("metrics.trace-propagation"),
     )
 
 
@@ -368,6 +369,20 @@ class JanusGraphTPU:
             max_roots=cfg.get("metrics.span-buffer"),
             slow_buffer=cfg.get("metrics.slow-op-buffer"),
         )
+        # black-box flight recorder sizing/dump target + structured JSON
+        # logging (observability/flight.py, observability/logging.py)
+        from janusgraph_tpu.observability import flight_recorder as _flight
+
+        _flight.configure(
+            capacity=cfg.get("metrics.flight-buffer"),
+            dump_dir=cfg.get("metrics.flight-dump-dir"),
+        )
+        if cfg.get("metrics.structured-logging"):
+            import sys as _sys
+
+            from janusgraph_tpu.observability import logging as _slog
+
+            _slog.configure(stream=_sys.stderr)
         self.instance_registry = InstanceRegistry(self.backend)
         if not self.backend.read_only:
             if cfg.get("graph.replace-instance-if-exists"):
@@ -436,6 +451,7 @@ class JanusGraphTPU:
                 breaker_half_open_probes=cfg.get(
                     "storage.breaker.half-open-probes"
                 ),
+                trace_propagation=cfg.get("metrics.trace-propagation"),
             )
         self.index_providers: Dict[str, object] = shared
         # {index_name: {field: KeyInformation}} for provider.mutate calls

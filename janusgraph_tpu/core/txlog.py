@@ -371,8 +371,20 @@ class TornCommitRecovery:
             )
         return {"replayed": self.replayed, "rolled_back": self.rolled_back}
 
+    @staticmethod
+    def _flight(action: str, tx_id: int, **detail) -> None:
+        from janusgraph_tpu.observability import flight_recorder, get_logger
+
+        flight_recorder.record(
+            "torn_recovery", action=action, tx_id=tx_id, **detail
+        )
+        get_logger("core.txlog").warning(
+            "torn-recovery", action=action, tx_id=tx_id, **detail
+        )
+
     def _roll_forward(self, sender: bytes, tx_id: int, pre: TxLogEntry) -> None:
         graph = self.graph
+        self._flight("replayed", tx_id, changes=len(pre.changes))
         graph.replay_torn_changes(pre.changes)
         # secondary persistence of the healed tx: mixed-index documents are
         # re-derived from (now repaired) primary storage, and the user-log
@@ -399,6 +411,7 @@ class TornCommitRecovery:
         self.replayed.append(tx_id)
 
     def _roll_back(self, sender: bytes, tx_id: int) -> None:
+        self._flight("rolled_back", tx_id)
         # PRECOMMIT without PREFLUSH: nothing reached storage, the tx never
         # happened — record that verdict so later recoveries skip it
         self.graph.tx_log.log.add_now(

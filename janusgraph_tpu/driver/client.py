@@ -66,17 +66,33 @@ class JanusGraphClient:
 
     # ---------------------------------------------------------------- HTTP
     def submit(self, gremlin: str, graph: Optional[str] = None) -> Any:
-        body = json.dumps({"gremlin": gremlin, "graph": graph}).encode()
-        req = _urlreq.Request(
-            self.base + "/gremlin", data=body, method="POST",
-            headers={"Content-Type": "application/json", **self._auth_header()},
-        )
-        with _urlreq.urlopen(req) as resp:
-            payload = json.loads(resp.read())
-        status = payload.get("status", {})
-        if status.get("code") != 200:
-            raise RemoteError(status.get("code"), status.get("message"))
-        return _decode(payload["result"]["data"])
+        from janusgraph_tpu.observability import tracer
+
+        # the client-side root of the distributed trace: the request ships
+        # this span's context in X-Trace-Context, the server's spans (and
+        # the storage/index nodes' below it) join the same trace_id
+        with tracer.span(
+            "driver.submit", graph=graph or "", transport="http",
+        ) as sp:
+            ctx = sp.context()
+            body = json.dumps({"gremlin": gremlin, "graph": graph}).encode()
+            req = _urlreq.Request(
+                self.base + "/gremlin", data=body, method="POST",
+                headers={
+                    "Content-Type": "application/json",
+                    "X-Trace-Context": ctx.to_header(),
+                    **self._auth_header(),
+                },
+            )
+            with _urlreq.urlopen(req) as resp:
+                payload = json.loads(resp.read())
+            status = payload.get("status", {})
+            if "trace" in status:
+                sp.annotate(server_trace=status["trace"])
+            if status.get("code") != 200:
+                sp.annotate(code=status.get("code"))
+                raise RemoteError(status.get("code"), status.get("message"))
+            return _decode(payload["result"]["data"])
 
     def graphs(self) -> list:
         req = _urlreq.Request(
@@ -128,15 +144,26 @@ class WebSocketSession:
             raise ConnectionError(f"ws upgrade rejected: {status_line}")
 
     def submit(self, gremlin: str, graph: Optional[str] = None) -> Any:
-        req = {"gremlin": gremlin, "graph": graph}
-        if self.session:
-            req["session"] = True
-        self._send(json.dumps(req))
-        payload = json.loads(self._recv())
-        status = payload.get("status", {})
-        if status.get("code") != 200:
-            raise RemoteError(status.get("code"), status.get("message"))
-        return _decode(payload["result"]["data"])
+        from janusgraph_tpu.observability import tracer
+
+        with tracer.span(
+            "driver.submit", graph=graph or "", transport="ws",
+        ) as sp:
+            req = {
+                "gremlin": gremlin, "graph": graph,
+                # WS has no per-message headers; the trace context rides a
+                # reserved request field instead
+                "trace": sp.context().to_header(),
+            }
+            if self.session:
+                req["session"] = True
+            self._send(json.dumps(req))
+            payload = json.loads(self._recv())
+            status = payload.get("status", {})
+            if status.get("code") != 200:
+                sp.annotate(code=status.get("code"))
+                raise RemoteError(status.get("code"), status.get("message"))
+            return _decode(payload["result"]["data"])
 
     def close(self) -> None:
         try:

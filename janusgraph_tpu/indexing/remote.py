@@ -49,12 +49,15 @@ from janusgraph_tpu.indexing.provider import (
 )
 from janusgraph_tpu.storage import backend_op
 from janusgraph_tpu.storage.remote import (
+    _TRACE_FLAG,
     _Conn,
     _pb,
     _ps,
     _raise_status,
     _Reader,
     _recv_exact,
+    encode_trace_prefix,
+    split_trace_prefix,
 )
 
 _STATUS_OK = 0
@@ -71,6 +74,19 @@ _OP_SUPPORTS = 7
 _OP_EXISTS = 8
 _OP_CLEAR = 9
 _OP_FEATURES = 10
+
+_OP_NAMES = {
+    _OP_REGISTER: "register",
+    _OP_MUTATE: "mutate",
+    _OP_RESTORE: "restore",
+    _OP_QUERY: "query",
+    _OP_RAW_QUERY: "rawQuery",
+    _OP_TOTALS: "totals",
+    _OP_SUPPORTS: "supports",
+    _OP_EXISTS: "exists",
+    _OP_CLEAR: "clear",
+    _OP_FEATURES: "features",
+}
 
 #: one registry for the wire; user enums are not expected in index fields.
 #: allow_pickle=False: a network peer must never be able to ship a pickle
@@ -209,8 +225,21 @@ class _IndexHandler(socketserver.BaseRequestHandler):
                 (body_len,) = struct.unpack(">I", head[:4])
                 op = head[4]
                 body = _recv_exact(sock, body_len) if body_len else b""
+                ctx = None
+                if op & _TRACE_FLAG:
+                    op &= ~_TRACE_FLAG
+                    ctx, body = split_trace_prefix(body)
                 try:
-                    self._dispatch(provider, sock, op, body)
+                    if ctx is not None:
+                        from janusgraph_tpu.observability import tracer
+
+                        # the index node's op joins the caller's trace
+                        with tracer.child_span(
+                            ctx, f"index.remote.{_OP_NAMES.get(op, op)}"
+                        ):
+                            self._dispatch(provider, sock, op, body)
+                    else:
+                        self._dispatch(provider, sock, op, body)
                 # graphlint: disable=JG204 -- protocol boundary: the error is serialized to the client as a temporary status frame, and the CLIENT retries
                 except (TemporaryBackendError, ConnectionError) as e:
                     self._reply(sock, _STATUS_TEMP, str(e).encode())
@@ -318,22 +347,31 @@ class _IndexHandler(socketserver.BaseRequestHandler):
             ]
             for c in f.supports_cardinality:
                 _ps(out, c)
+            # trailing protocol-capability byte: trace-capable server.
+            # Old clients stop reading after the cardinalities, so the
+            # extra byte is invisible to them; old servers simply end the
+            # payload earlier and new clients negotiate tracing OFF.
+            if getattr(self.server, "trace_propagation", True):
+                out.append(b"\x01")
             self._reply(sock, _STATUS_OK, b"".join(out))
             return
         raise PermanentBackendError(f"unknown index op {op}")
 
 
 class RemoteIndexServer:
-    """Serve any IndexProvider over TCP (threaded; port 0 = ephemeral)."""
+    """Serve any IndexProvider over TCP (threaded; port 0 = ephemeral).
+    ``trace_propagation=False`` = the pre-trace features payload (an
+    "old-featured" index server for compatibility tests)."""
 
     def __init__(self, provider: IndexProvider, host: str = "127.0.0.1",
-                 port: int = 0):
+                 port: int = 0, trace_propagation: bool = True):
         class _Srv(socketserver.ThreadingTCPServer):
             allow_reuse_address = True
             daemon_threads = True
 
         self._srv = _Srv((host, port), _IndexHandler)
         self._srv.provider = provider  # type: ignore[attr-defined]
+        self._srv.trace_propagation = trace_propagation  # type: ignore[attr-defined]
         self.provider = provider
         self._thread: Optional[threading.Thread] = None
 
@@ -368,6 +406,7 @@ class RemoteIndexProvider(IndexProvider):
                  breaker_failure_threshold: int = 5,
                  breaker_reset_ms: float = 1000.0,
                  breaker_half_open_probes: int = 1,
+                 trace_propagation: bool = True,
                  **_ignored):
         # `directory` accepted-and-ignored: open_index_provider passes the
         # local providers' kwargs through one call site (core/graph.py)
@@ -380,6 +419,10 @@ class RemoteIndexProvider(IndexProvider):
             )
         self.host, self.port = hostname, int(port)
         self.retry_time_s = retry_time_s
+        #: metrics.trace-propagation, gated on the server's negotiated
+        #: capability byte (None = features not yet fetched)
+        self.trace_propagation = trace_propagation
+        self._remote_trace: Optional[bool] = None
         self._pool = [_Conn(self.host, self.port) for _ in range(pool_size)]
         self._pool_lock = threading.Lock()
         self._pool_idx = 0
@@ -399,12 +442,34 @@ class RemoteIndexProvider(IndexProvider):
                 half_open_probes=breaker_half_open_probes,
             )
 
+    def _trace_frame(self, op: int, body: bytes):
+        """Same negotiation as RemoteStoreManager._trace_frame: attach the
+        ambient context only once the server's features payload proved it
+        understands flagged frames."""
+        if op == _OP_FEATURES or not self.trace_propagation:
+            return op, body
+        from janusgraph_tpu.observability import tracer
+
+        ctx = tracer.current_context()
+        if ctx is None:
+            return op, body
+        if self._remote_trace is None:
+            try:
+                self.features()
+            # graphlint: disable=JG204 -- negotiation is best-effort: the frame just goes untraced, and the op itself will surface the failure through its own retry guard
+            except (TemporaryBackendError, PermanentBackendError):
+                return op, body
+        if not self._remote_trace:
+            return op, body
+        return op | _TRACE_FLAG, encode_trace_prefix(ctx) + body
+
     def _call(self, op: int, body: bytes, idempotent: bool = True) -> bytes:
         """One wire call under the retry guard. Non-idempotent ops (mutate/
         restore: LIST-cardinality additions are not replay-safe) retry only
         the DIAL — once the request may have reached the server, a dropped
         connection surfaces as a permanent 'outcome unknown' error instead
         of an at-least-once resend duplicating index entries."""
+        op, body = self._trace_frame(op, body)
 
         def attempt() -> bytes:
             with self._pool_lock:
@@ -451,6 +516,9 @@ class RemoteIndexProvider(IndexProvider):
             r = _Reader(self._call(_OP_FEATURES, b""))
             flags = [r.u8() for _ in range(4)]
             cards = tuple(r.str_() for _ in range(r.u32()))
+            # trailing capability byte = trace-capable server; an old
+            # server's payload ends here and tracing stays off
+            self._remote_trace = r.off < len(r.data) and r.u8() == 1
             self._features = IndexFeatures(
                 supports_document_ttl=bool(flags[0]),
                 supports_cardinality=cards,

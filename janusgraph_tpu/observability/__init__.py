@@ -33,6 +33,12 @@ from janusgraph_tpu.observability.exposition import (
     json_snapshot,
     prometheus_text,
 )
+from janusgraph_tpu.observability.flight import FlightRecorder
+from janusgraph_tpu.observability.flight import recorder as flight_recorder
+from janusgraph_tpu.observability.logging import (
+    StructuredLogger,
+    get_logger,
+)
 from janusgraph_tpu.observability.metrics_core import (
     BUCKET_BOUNDS,
     Counter,
@@ -41,7 +47,12 @@ from janusgraph_tpu.observability.metrics_core import (
     TelemetryRegistry,
     Timer,
 )
-from janusgraph_tpu.observability.spans import Span, Tracer, tracer
+from janusgraph_tpu.observability.spans import (
+    Span,
+    TraceContext,
+    Tracer,
+    tracer,
+)
 
 #: process-wide registry (reference: MetricManager.INSTANCE);
 #: `janusgraph_tpu.util.metrics.metrics` is THIS object
@@ -50,15 +61,34 @@ registry = TelemetryRegistry()
 #: convenience alias: `with span("name", attr=...):` on the global tracer
 span = tracer.span
 
+
+def _slow_span_to_flight(event: dict) -> None:
+    flight_recorder.record(
+        "slow_span",
+        name=event["name"],
+        ms=event["ms"],
+        trace_id=event.get("trace_id"),
+        span_id=event.get("span_id"),
+    )
+
+
+# every span crossing the slow-op threshold also lands in the black box
+tracer.on_slow = _slow_span_to_flight
+
 __all__ = [
     "BUCKET_BOUNDS",
     "Counter",
+    "FlightRecorder",
     "Gauge",
     "Histogram",
     "Span",
+    "StructuredLogger",
     "TelemetryRegistry",
     "Timer",
+    "TraceContext",
     "Tracer",
+    "flight_recorder",
+    "get_logger",
     "json_snapshot",
     "prometheus_text",
     "registry",

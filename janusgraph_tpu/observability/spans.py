@@ -20,11 +20,20 @@ Design:
 - pre-timed children (``record_span``) let host-resident measurements —
   e.g. per-superstep records reduced on device and fetched once — appear
   in the tree without ever recording from traced code (graphlint JG106).
+- every span carries a 64-bit ``trace_id``/``span_id``; a
+  :class:`TraceContext` serializes (trace_id, parent span_id, sampled)
+  compactly for process boundaries — the remote KCVS/index protocols
+  prepend it to op frames, the query server reads it from an
+  ``X-Trace-Context`` header — so one user query stitches into ONE trace
+  across client, server, and storage nodes (inspect via ``GET /telemetry``
+  or ``janusgraph_tpu trace <trace_id>``).
 """
 
 from __future__ import annotations
 
 import contextvars
+import random
+import struct
 import threading
 import time
 from collections import deque
@@ -34,6 +43,81 @@ from typing import Dict, List, Optional
 _CURRENT: "contextvars.ContextVar[Optional[Span]]" = contextvars.ContextVar(
     "janusgraph_tpu_current_span", default=None
 )
+
+
+def _new_id() -> int:
+    """Non-zero 64-bit id. `random` (not urandom syscalls): ids only need
+    collision resistance within a ring buffer, and spans sit on the tx
+    hot path."""
+    v = random.getrandbits(64)
+    return v or 1
+
+
+class TraceContext:
+    """The serializable slice of a span that crosses process boundaries:
+    (trace_id, parent span_id, sampled flag).
+
+    Two codecs, both versioned:
+
+    - ``to_bytes``/``from_bytes`` — compact binary for the length-prefixed
+      storage/index protocols: ``[ver:1][trace_id:8][span_id:8][flags:1]``.
+    - ``to_header``/``from_header`` — W3C-traceparent-shaped text for the
+      HTTP/WS query protocol: ``01-<trace:16hex>-<span:16hex>-<flags:2hex>``.
+
+    Decoders return ``None`` on anything malformed: a bad trace header
+    must never fail the request it rides on.
+    """
+
+    __slots__ = ("trace_id", "span_id", "sampled")
+
+    _VERSION = 1
+
+    def __init__(self, trace_id: int, span_id: int, sampled: bool = True):
+        self.trace_id = int(trace_id)
+        self.span_id = int(span_id)
+        self.sampled = bool(sampled)
+
+    def to_bytes(self) -> bytes:
+        return struct.pack(
+            ">BQQB", self._VERSION, self.trace_id, self.span_id,
+            1 if self.sampled else 0,
+        )
+
+    @classmethod
+    def from_bytes(cls, raw: bytes) -> Optional["TraceContext"]:
+        if len(raw) != 18:
+            return None
+        ver, trace_id, span_id, flags = struct.unpack(">BQQB", raw)
+        if ver != cls._VERSION or trace_id == 0:
+            return None
+        return cls(trace_id, span_id, sampled=bool(flags & 1))
+
+    def to_header(self) -> str:
+        return (
+            f"{self._VERSION:02d}-{self.trace_id:016x}-{self.span_id:016x}"
+            f"-{1 if self.sampled else 0:02x}"
+        )
+
+    @classmethod
+    def from_header(cls, text: str) -> Optional["TraceContext"]:
+        if not text:
+            return None
+        parts = text.strip().split("-")
+        if len(parts) != 4:
+            return None
+        try:
+            ver = int(parts[0], 10)
+            trace_id = int(parts[1], 16)
+            span_id = int(parts[2], 16)
+            flags = int(parts[3], 16)
+        except ValueError:
+            return None
+        if ver != cls._VERSION or trace_id == 0:
+            return None
+        return cls(trace_id, span_id, sampled=bool(flags & 1))
+
+    def __repr__(self) -> str:
+        return f"TraceContext({self.to_header()})"
 
 
 def _plain(value):
@@ -56,9 +140,15 @@ def _plain(value):
 
 class Span:
     """One timed node: name, attributes, children (cf. the profiler's
-    QueryProfiler group, but subsystem-agnostic and context-propagated)."""
+    QueryProfiler group, but subsystem-agnostic and context-propagated).
+    Carries trace identity: ``trace_id`` is shared by every span of one
+    logical operation (across processes when propagated),
+    ``parent_span_id`` links a local root under its remote parent."""
 
-    __slots__ = ("name", "attrs", "children", "start_ns", "end_ns", "wall_t")
+    __slots__ = (
+        "name", "attrs", "children", "start_ns", "end_ns", "wall_t",
+        "trace_id", "span_id", "parent_span_id", "sampled",
+    )
 
     def __init__(self, name: str, attrs: Optional[dict] = None):
         self.name = name
@@ -69,10 +159,18 @@ class Span:
         self.start_ns = 0
         self.end_ns = 0
         self.wall_t = 0.0  # epoch seconds at start (for the slow-op log)
+        self.span_id = _new_id()
+        self.trace_id = 0  # assigned at attach: inherited or fresh
+        self.parent_span_id = 0  # non-zero only for remote-parented roots
+        self.sampled = True
 
     @property
     def duration_ms(self) -> float:
         return (self.end_ns - self.start_ns) / 1e6
+
+    def context(self) -> TraceContext:
+        """This span's identity as a propagatable context."""
+        return TraceContext(self.trace_id, self.span_id, self.sampled)
 
     def annotate(self, **attrs) -> "Span":
         for k, v in attrs.items():
@@ -80,12 +178,17 @@ class Span:
         return self
 
     def to_dict(self) -> dict:
-        return {
+        d = {
             "name": self.name,
             "duration_ms": round(self.duration_ms, 4),
+            "trace_id": f"{self.trace_id:016x}",
+            "span_id": f"{self.span_id:016x}",
             "attrs": dict(self.attrs),
             "children": [c.to_dict() for c in self.children],
         }
+        if self.parent_span_id:
+            d["parent_span_id"] = f"{self.parent_span_id:016x}"
+        return d
 
     def find(self, name: str) -> List["Span"]:
         """All descendants (and self) with this name, depth-first."""
@@ -108,6 +211,9 @@ class Tracer:
         self._roots: deque = deque(maxlen=max_roots)
         self._slow: deque = deque(maxlen=slow_buffer)
         self._lock = threading.Lock()
+        #: optional sink fed every slow-op event (the flight recorder
+        #: registers here; observability/__init__.py wires it)
+        self.on_slow = None
 
     def configure(
         self,
@@ -129,6 +235,39 @@ class Tracer:
         parent = _CURRENT.get()
         s = Span(name, attrs)
         if parent is not None:
+            parent.children.append(s)
+            s.trace_id = parent.trace_id
+            s.sampled = parent.sampled
+        else:
+            s.trace_id = _new_id()
+        token = _CURRENT.set(s)
+        s.wall_t = time.time()
+        s.start_ns = time.perf_counter_ns()
+        try:
+            yield s
+        finally:
+            s.end_ns = time.perf_counter_ns()
+            _CURRENT.reset(token)
+            self._finished(s, root=parent is None)
+
+    @contextmanager
+    def child_span(self, ctx: Optional[TraceContext], name: str, **attrs):
+        """A span under a REMOTE parent: joins ctx's trace as a local root
+        (it lands in this process's root ring, linked by
+        ``parent_span_id``). With ``ctx=None`` this is a plain ``span`` —
+        receive sites never need to branch on whether a peer propagated."""
+        if ctx is None:
+            with self.span(name, **attrs) as s:
+                yield s
+            return
+        parent = _CURRENT.get()
+        s = Span(name, attrs)
+        s.trace_id = ctx.trace_id
+        s.parent_span_id = ctx.span_id
+        s.sampled = ctx.sampled
+        if parent is not None:
+            # a remote context wins over the ambient span: the handler
+            # thread's tree keeps its shape, the ids join the caller's trace
             parent.children.append(s)
         token = _CURRENT.set(s)
         s.wall_t = time.time()
@@ -152,26 +291,44 @@ class Tracer:
         s.end_ns = now
         if parent is not None:
             parent.children.append(s)
+            s.trace_id = parent.trace_id
+            s.sampled = parent.sampled
+        else:
+            s.trace_id = _new_id()
         self._finished(s, root=parent is None)
         return s
 
     def _finished(self, s: Span, root: bool) -> None:
         thr = self.slow_threshold_ms
         if thr > 0 and s.duration_ms >= thr:
+            event = {
+                "name": s.name,
+                "ms": round(s.duration_ms, 3),
+                "time": s.wall_t,
+                "trace_id": f"{s.trace_id:016x}",
+                "span_id": f"{s.span_id:016x}",
+                "attrs": dict(s.attrs),
+            }
             with self._lock:
-                self._slow.append({
-                    "name": s.name,
-                    "ms": round(s.duration_ms, 3),
-                    "time": s.wall_t,
-                    "attrs": dict(s.attrs),
-                })
-        if root:
+                self._slow.append(event)
+            sink = self.on_slow
+            if sink is not None:
+                try:
+                    sink(dict(event))
+                except Exception:  # noqa: BLE001 - telemetry must not break work
+                    pass
+        if root and s.sampled:
             with self._lock:
                 self._roots.append(s)
 
     # -------------------------------------------------------------- querying
     def current(self) -> Optional[Span]:
         return _CURRENT.get()
+
+    def current_context(self) -> Optional[TraceContext]:
+        """The ambient span's propagatable identity (None outside spans)."""
+        cur = _CURRENT.get()
+        return cur.context() if cur is not None else None
 
     def recent(self, name: Optional[str] = None) -> List[Span]:
         """Completed root spans, oldest first (optionally name-filtered)."""
@@ -180,6 +337,18 @@ class Tracer:
         if name is not None:
             roots = [r for r in roots if r.name == name]
         return roots
+
+    def find_trace(self, trace_id) -> List[Span]:
+        """Every retained root span belonging to one trace, oldest first.
+        Accepts an int or the 16-hex-char form the JSON surfaces use."""
+        if isinstance(trace_id, str):
+            try:
+                trace_id = int(trace_id, 16)
+            except ValueError:
+                return []
+        with self._lock:
+            roots = list(self._roots)
+        return [r for r in roots if r.trace_id == trace_id]
 
     def slow_ops(self) -> List[dict]:
         with self._lock:

@@ -73,6 +73,9 @@ def save_checkpoint(
         if os.path.exists(tmp):
             os.unlink(tmp)
         raise
+    from janusgraph_tpu.observability import flight_recorder
+
+    flight_recorder.record("checkpoint", action="save", steps=steps_done)
 
 
 def _load_verified(
@@ -114,7 +117,12 @@ def load_checkpoint(
         return loaded
     fallback = _load_verified(path + ".prev")
     if fallback is not None and os.path.exists(path):
-        from janusgraph_tpu.observability import registry
+        from janusgraph_tpu.observability import flight_recorder, registry
 
         registry.counter("olap.checkpoint.fallback").inc()
+        # the newest checkpoint was torn/corrupt and .prev saved the run —
+        # exactly the kind of event a post-mortem needs on the timeline
+        flight_recorder.record(
+            "checkpoint", action="fallback", steps=int(fallback[2]),
+        )
     return fallback

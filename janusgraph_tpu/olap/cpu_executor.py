@@ -64,7 +64,10 @@ class CPUExecutor:
                     fault_hook,
                 )
             except SuperstepPreempted:
-                from janusgraph_tpu.observability import registry
+                from janusgraph_tpu.observability import (
+                    flight_recorder,
+                    registry,
+                )
 
                 registry.counter("olap.preemptions").inc()
                 if not (checkpoint_path and checkpoint_every) or (
@@ -74,6 +77,10 @@ class CPUExecutor:
                 attempts += 1
                 resume = True
                 registry.counter("olap.resumes").inc()
+                flight_recorder.record(
+                    "olap_resume", executor="cpu", attempt=attempts,
+                    program=type(program).__name__,
+                )
 
     def _run(
         self,

@@ -821,6 +821,12 @@ class TPUExecutor:
                     resumes += 1
                     resume = True
                     registry.counter("olap.resumes").inc()
+                    from janusgraph_tpu.observability import flight_recorder
+
+                    flight_recorder.record(
+                        "olap_resume", executor="tpu", attempt=resumes,
+                        program=type(program).__name__,
+                    )
             if resumes:
                 self.last_run_info["resumes"] = resumes
                 sp.annotate(resumes=resumes)
@@ -878,11 +884,49 @@ class TPUExecutor:
             r.setdefault("h2d_bytes", info["h2d_arg_bytes"] if i == 0 else 0)
         info["superstep_records"] = records
 
+        # compile-cache economics per run: `new_execs` superstep dispatches
+        # paid a compile (misses), the rest reused an executable (hits) —
+        # the retrace-vs-reuse split the padding/tier design exists to win
+        dispatches = max(len(records), 1)
+        misses = min(new_execs, dispatches)
+        info["compile_cache"] = {
+            "hits": dispatches - misses,
+            "misses": misses,
+            "compiled_total": len(self._compiled),
+        }
+        registry.counter("olap.compile_cache.hits").inc(dispatches - misses)
+        registry.counter("olap.compile_cache.misses").inc(misses)
+
+        # device-memory gauges: real allocator stats where the backend
+        # exposes them, host-resident estimate otherwise (CPU/interpret)
+        info["device_memory"] = self._device_memory(info)
+        registry.set_gauge(
+            "olap.device.bytes_in_use",
+            float(info["device_memory"]["bytes_in_use"]),
+        )
+        if "peak_bytes_in_use" in info["device_memory"]:
+            registry.set_gauge(
+                "olap.device.peak_bytes_in_use",
+                float(info["device_memory"]["peak_bytes_in_use"]),
+            )
+
+        slowest = None
         for r in records[:128]:
-            tracer.record_span(
+            s = tracer.record_span(
                 "superstep", float(r.get("wall_ms", 0.0)),
                 **{k: v for k, v in r.items() if k != "wall_ms"},
             )
+            if slowest is None or s.duration_ms > slowest.duration_ms:
+                slowest = s
+        if slowest is not None:
+            # exemplar: the run record points at the slowest superstep's
+            # span so a dashboard number links to the concrete span tree
+            info["slowest_superstep"] = {
+                "step": slowest.attrs.get("step"),
+                "wall_ms": round(slowest.duration_ms, 4),
+                "span_id": f"{slowest.span_id:016x}",
+                "trace_id": f"{slowest.trace_id:016x}",
+            }
         sp.annotate(
             path=info.get("path"),
             supersteps=info.get("supersteps"),
@@ -913,6 +957,33 @@ class TPUExecutor:
                 float(records[-1].get("frontier", n))
             )
         registry.record_run("olap", info)
+
+    def _device_memory(self, info) -> dict:
+        """Device-memory occupancy for the run record: real allocator
+        stats where the backend exposes them (``Device.memory_stats`` on
+        TPU/GPU), else a host-resident static-shape estimate (CPU and
+        interpret mode report no allocator). Host-side only — asking the
+        allocator is not a device sync."""
+        stats = None
+        try:
+            stats = self.jax.local_devices()[0].memory_stats()
+        except Exception:  # noqa: BLE001 - backend-dependent API
+            stats = None
+        if stats and "bytes_in_use" in stats:
+            out = {
+                "source": "device",
+                "bytes_in_use": int(stats["bytes_in_use"]),
+            }
+            if "peak_bytes_in_use" in stats:
+                out["peak_bytes_in_use"] = int(stats["peak_bytes_in_use"])
+            if "bytes_limit" in stats:
+                out["bytes_limit"] = int(stats["bytes_limit"])
+            return out
+        return {
+            "source": "host-estimate",
+            "bytes_in_use": int(info.get("h2d_arg_bytes", 0))
+            + int(info.get("d2h_bytes", 0)),
+        }
 
     #: graphs below this edge count run CC through the fused dense path
     #: under frontier="auto": the frontier loop pays ~2 host round trips

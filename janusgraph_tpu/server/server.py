@@ -30,6 +30,7 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
 
 from janusgraph_tpu.driver.graphson import graphson_dumps
+from janusgraph_tpu.exceptions import QueryError
 from janusgraph_tpu.server.auth import AuthenticationError
 
 
@@ -88,6 +89,13 @@ def _evaluate(query: str, namespace: dict):
     return result
 
 
+#: last /healthz verdict, for edge-triggered flight dumps (the ok ->
+#: degraded FLIP is the incident boundary worth a black-box snapshot;
+#: staying degraded must not dump once per probe)
+_HEALTH_STATE = {"status": None}
+_HEALTH_LOCK = threading.Lock()
+
+
 def healthz_snapshot() -> dict:
     """The /healthz payload: ok/degraded from the process registry.
 
@@ -95,8 +103,12 @@ def healthz_snapshot() -> dict:
     the storage or index tier is failing over RIGHT NOW. Injected-fault,
     retry, and recovery counters ride along as context: high retry counts
     with ok status mean the self-healing paths are absorbing trouble.
+    The ``flight`` block summarizes the black-box recorder (occupancy,
+    per-category counts, last dump path); the ok->degraded flip itself
+    triggers a flight dump so the events leading up to the degradation
+    are on disk before anyone asks.
     """
-    from janusgraph_tpu.observability import registry
+    from janusgraph_tpu.observability import flight_recorder, registry
 
     snap = registry.snapshot()
     breakers = {
@@ -118,10 +130,21 @@ def healthz_snapshot() -> dict:
             or (name.startswith("breaker.") and not name.endswith(".state"))
         )
     }
+    status = "degraded" if degraded else "ok"
+    with _HEALTH_LOCK:
+        flipped = _HEALTH_STATE["status"] == "ok" and status == "degraded"
+        _HEALTH_STATE["status"] = status
+    if flipped:
+        flight_recorder.record(
+            "health", transition="ok->degraded",
+            breakers={k: v for k, v in breakers.items() if v != 0.0},
+        )
+        flight_recorder.dump(reason="healthz-degraded")
     return {
-        "status": "degraded" if degraded else "ok",
+        "status": status,
         "breakers": breakers,
         "counters": counters,
+        "flight": flight_recorder.health_block(),
     }
 
 
@@ -330,9 +353,34 @@ class _Handler(BaseHTTPRequestHandler):
             self._send_json(401, {"status": {"code": 401, "message": str(e)}})
             return False
 
-    def _run_request(self, req: dict, session: Optional[dict] = None) -> dict:
+    def _run_request(
+        self,
+        req: dict,
+        session: Optional[dict] = None,
+        trace_header: Optional[str] = None,
+    ) -> dict:
+        from janusgraph_tpu.observability import tracer
+        from janusgraph_tpu.observability.spans import TraceContext
+
         query = req.get("gremlin", "")
         graph = req.get("graph")
+        # the request runs under a server span; when the driver sent a
+        # trace header (X-Trace-Context / the WS "trace" field) the span
+        # joins the caller's trace, and everything below — store ops over
+        # the remote KCVS protocol included — stitches into ONE tree
+        ctx = TraceContext.from_header(trace_header) if trace_header else None
+        with tracer.child_span(
+            ctx, "server.request",
+            graph=graph or self.jg_server.default_graph,
+            session=session is not None,
+        ) as sp:
+            payload = self._execute_request(req, query, graph, session, sp)
+        # echo the trace id so the caller can pull the stitched trace from
+        # GET /telemetry or `janusgraph_tpu trace <id>`
+        payload["status"]["trace"] = f"{sp.trace_id:016x}"
+        return payload
+
+    def _execute_request(self, req, query, graph, session, sp) -> dict:
         try:
             if session is not None:
                 result = self.jg_server.execute_session(
@@ -341,15 +389,45 @@ class _Handler(BaseHTTPRequestHandler):
             else:
                 result = self.jg_server.execute(query, graph)
             data = json.loads(graphson_dumps(result))
+            sp.annotate(code=200)
             return {"result": {"data": data}, "status": {"code": 200}}
         except QueryTooLongError as e:
             # client error, like the 413 for max-request-bytes — a retry
             # of the identical oversized query can never succeed
+            sp.annotate(code=413)
             return {
                 "result": {"data": None},
                 "status": {"code": 413, "message": str(e)},
             }
+        except (QueryRejected, QueryError, KeyError, PermissionError,
+                AttributeError) as e:
+            # the request was WRONG (sandbox rejection, unknown graph,
+            # read-only endpoint): a client error, not an incident — no
+            # black-box dump, or every fuzzed bad query would write a file
+            sp.annotate(code=500, error=type(e).__name__)
+            return {
+                "result": {"data": None},
+                "status": {"code": 500, "message": f"{type(e).__name__}: {e}"},
+            }
         except Exception as e:  # noqa: BLE001 - surface to client
+            from janusgraph_tpu.observability import (
+                flight_recorder,
+                get_logger,
+            )
+
+            sp.annotate(code=500, error=type(e).__name__)
+            get_logger("server").error(
+                "request-failed",
+                error=type(e).__name__, message=str(e)[:500],
+                graph=graph or "", query_len=len(query),
+            )
+            # unhandled evaluation error: black-box the timeline that led
+            # here (one of the three dump triggers)
+            flight_recorder.record(
+                "server_error", error=type(e).__name__,
+                message=str(e)[:200], graph=graph or "",
+            )
+            flight_recorder.dump(reason="server-error")
             return {
                 "result": {"data": None},
                 "status": {"code": 500, "message": f"{type(e).__name__}: {e}"},
@@ -386,6 +464,22 @@ class _Handler(BaseHTTPRequestHandler):
             self.send_header("Content-Length", str(len(body)))
             self.end_headers()
             self.wfile.write(body)
+            return
+        if self.path == "/flight" or self.path.startswith("/flight?"):
+            # black-box flight recorder: the bounded event ring, counts,
+            # and last-dump pointer; ?dump=1 writes a dump file first and
+            # returns its path (unauthenticated like /metrics: events are
+            # operational, never query/data content)
+            from janusgraph_tpu.observability import flight_recorder
+
+            if "dump=1" in self.path:
+                flight_recorder.dump(reason="http-request")
+            self._send_json(
+                200,
+                json.dumps(
+                    flight_recorder.snapshot(), default=str
+                ).encode("utf-8"),
+            )
             return
         if self.path == "/telemetry" or self.path.startswith("/telemetry?"):
             # JSON snapshot: metrics + recent span trees + slow-op log +
@@ -446,7 +540,9 @@ class _Handler(BaseHTTPRequestHandler):
             except json.JSONDecodeError:
                 self._send_json(400, {"status": {"code": 400, "message": "bad json"}})
                 return
-            self._send_json(200, self._run_request(req))
+            self._send_json(200, self._run_request(
+                req, trace_header=self.headers.get("X-Trace-Context"),
+            ))
             return
         self._send_json(404, {"status": {"code": 404}})
 
@@ -484,7 +580,10 @@ class _Handler(BaseHTTPRequestHandler):
                 if req.get("session") and session is None:
                     session = self.jg_server.open_session()
                 _ws_send(sock, json.dumps(
-                    self._run_request(req, session=session)
+                    self._run_request(
+                        req, session=session,
+                        trace_header=req.get("trace"),
+                    )
                 ))
         except (ConnectionError, OSError):
             pass

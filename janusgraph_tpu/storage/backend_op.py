@@ -68,11 +68,28 @@ def execute(
             return op()
         except PermanentBackendError:
             raise
-        except TemporaryBackendError:
+        except TemporaryBackendError as e:
             attempt += 1
             now = time.monotonic()
             if now >= deadline or (max_attempts and attempt >= max_attempts):
                 registry.counter("storage.backend_op.exhausted").inc()
+                from janusgraph_tpu.observability import (
+                    flight_recorder,
+                    get_logger,
+                )
+
+                # a guard giving up is a salient incident event (absorbed
+                # retries are just counters; exhaustion loses work)
+                flight_recorder.record(
+                    "retry_exhausted",
+                    attempts=attempt, error=type(e).__name__,
+                    message=str(e)[:200],
+                )
+                get_logger("storage.backend_op").warning(
+                    "retry-exhausted",
+                    attempts=attempt, error=type(e).__name__,
+                    message=str(e)[:200],
+                )
                 raise
             registry.counter("storage.backend_op.retries").inc()
             time.sleep(min(delay, max_delay_s, max(0.0, deadline - now)))

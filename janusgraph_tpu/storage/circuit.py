@@ -71,15 +71,31 @@ class CircuitBreaker:
         self._open_until = 0.0
         self._probes_in_flight = 0
         self._lock = threading.Lock()
+        self._published: str = ""
         self._publish(CLOSED)
 
     # -------------------------------------------------------------- telemetry
     def _publish(self, state: str) -> None:
-        from janusgraph_tpu.observability import registry
+        from janusgraph_tpu.observability import (
+            flight_recorder,
+            get_logger,
+            registry,
+        )
 
         registry.set_gauge(
             f"breaker.{self.name}.state", STATE_VALUES[state]
         )
+        prev, self._published = self._published, state
+        if prev and prev != state:
+            # every state transition is a flight-recorder event: the
+            # reconstructable timeline of a failover, not just a gauge
+            flight_recorder.record(
+                "breaker", name=self.name, from_state=prev, to_state=state,
+            )
+            get_logger("storage.circuit").warning(
+                "breaker-transition",
+                breaker=self.name, from_state=prev, to_state=state,
+            )
 
     def _trip(self) -> None:
         from janusgraph_tpu.observability import registry

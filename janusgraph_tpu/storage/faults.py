@@ -162,10 +162,15 @@ class FaultPlan:
         return (h / 0xFFFFFFFF) < rate
 
     def _record(self, kind: str, n: int, **detail) -> None:
-        from janusgraph_tpu.observability import registry
+        from janusgraph_tpu.observability import flight_recorder, registry
 
         registry.counter(f"chaos.injected.{kind}").inc()
         registry.counter("chaos.injected.total").inc()
+        # the black box sees every injected fault (deterministic fields
+        # only, so seeded runs produce comparable event sequences)
+        flight_recorder.record(
+            "fault", kind=kind, n=n, seed=self.seed, **detail
+        )
         with self._lock:
             if len(self.journal) < self.journal_limit:
                 self.journal.append({"kind": kind, "n": n, **detail})

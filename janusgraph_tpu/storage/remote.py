@@ -25,6 +25,16 @@ Wire format (big-endian):
   response: [u32 body_len][u8 status][body]   status: 0 ok / 1 temp / 2 perm
   scan responses stream after the status frame: ([u8 1][row])* [u8 0]
 Strings/bytes are u32-length-prefixed; entry lists are u32-count prefixed.
+
+Trace propagation (negotiated, byte-compatible): the `_OP_FEATURES`
+payload of a trace-capable server carries a `"trace": true` key; only
+after seeing it does a client set the high bit of the op byte
+(op | 0x80) and prepend `[u8 hdr_len][TraceContext bytes]` to the body.
+Old servers never receive flagged frames (the bit is gated on
+negotiation), old clients never set it — mixed pairs speak the original
+protocol unchanged, they just don't stitch. The server opens a child
+span under the received context around each dispatched op, so one
+client query yields one cross-process trace.
 """
 
 from __future__ import annotations
@@ -63,9 +73,46 @@ _OP_SCAN_RANGE = 7
 _OP_CLEAR = 8
 _OP_EXISTS = 9
 
+#: high bit of the op byte: the body is prefixed with
+#: [u8 hdr_len][TraceContext bytes]. Sent only after the server's
+#: features payload negotiated `"trace": true`.
+_TRACE_FLAG = 0x80
+
+_OP_NAMES = {
+    _OP_FEATURES: "features",
+    _OP_GET_SLICE: "getSlice",
+    _OP_GET_SLICE_MULTI: "getSliceMulti",
+    _OP_MUTATE: "mutate",
+    _OP_MUTATE_MANY: "mutateMany",
+    _OP_SCAN_ALL: "scanAll",
+    _OP_SCAN_RANGE: "scanRange",
+    _OP_CLEAR: "clear",
+    _OP_EXISTS: "exists",
+}
+
 _STATUS_OK = 0
 _STATUS_TEMP = 1
 _STATUS_PERM = 2
+
+
+def encode_trace_prefix(ctx) -> bytes:
+    """[u8 hdr_len][ctx bytes] — length-prefixed so the header codec can
+    grow without another protocol negotiation."""
+    raw = ctx.to_bytes()
+    return bytes([len(raw)]) + raw
+
+
+def split_trace_prefix(body: bytes):
+    """Inverse of encode_trace_prefix: (TraceContext|None, rest-of-body).
+    A malformed header degrades to an untraced frame, never an error."""
+    from janusgraph_tpu.observability.spans import TraceContext
+
+    if not body:
+        return None, body
+    hlen = body[0]
+    if len(body) < 1 + hlen:
+        return None, body
+    return TraceContext.from_bytes(body[1:1 + hlen]), body[1 + hlen:]
 
 
 # ------------------------------------------------------------------ encoding
@@ -178,8 +225,24 @@ class _Handler(socketserver.BaseRequestHandler):
                 (body_len,) = struct.unpack(">I", head[:4])
                 op = head[4]
                 body = _recv_exact(sock, body_len) if body_len else b""
+                ctx = None
+                if op & _TRACE_FLAG:
+                    op &= ~_TRACE_FLAG
+                    ctx, body = split_trace_prefix(body)
                 try:
-                    self._dispatch(mgr, sock, op, body)
+                    if ctx is not None:
+                        from janusgraph_tpu.observability import tracer
+
+                        # child span under the client's context: the
+                        # storage node's ops join the caller's trace
+                        with tracer.child_span(
+                            ctx,
+                            f"store.remote.{_OP_NAMES.get(op, op)}",
+                            store_manager=getattr(mgr, "name", ""),
+                        ):
+                            self._dispatch(mgr, sock, op, body)
+                    else:
+                        self._dispatch(mgr, sock, op, body)
                 # graphlint: disable=JG204 -- protocol boundary: the error is serialized to the client as a temporary status frame, and the CLIENT retries
                 except (TemporaryBackendError, ConnectionError) as e:
                     self._reply(sock, _STATUS_TEMP, str(e).encode())
@@ -199,15 +262,20 @@ class _Handler(socketserver.BaseRequestHandler):
             f = mgr.features
             import json
 
-            payload = json.dumps({
+            feats = {
                 k: getattr(f, k)
                 for k in (
                     "ordered_scan", "unordered_scan", "multi_query",
                     "batch_mutation", "key_consistent", "persists",
                     "cell_ttl", "timestamps",
                 )
-            }).encode()
-            self._reply(sock, _STATUS_OK, payload)
+            }
+            # protocol feature bit: this server accepts 0x80-flagged
+            # frames carrying a trace header (absent on old servers, so
+            # new clients degrade to unstitched spans cleanly)
+            if getattr(self.server, "trace_propagation", True):
+                feats["trace"] = True
+            self._reply(sock, _STATUS_OK, json.dumps(feats).encode())
             return
         if op == _OP_GET_SLICE:
             store = mgr.open_database(r.str_())
@@ -290,15 +358,19 @@ class _Handler(socketserver.BaseRequestHandler):
 
 
 class RemoteStoreServer:
-    """Serve a KCVS manager over TCP (threaded; port 0 = ephemeral)."""
+    """Serve a KCVS manager over TCP (threaded; port 0 = ephemeral).
+    ``trace_propagation=False`` serves the pre-trace features payload —
+    an "old-featured" server for compatibility tests and staged rollouts."""
 
-    def __init__(self, manager, host: str = "127.0.0.1", port: int = 0):
+    def __init__(self, manager, host: str = "127.0.0.1", port: int = 0,
+                 trace_propagation: bool = True):
         class _Srv(socketserver.ThreadingTCPServer):
             allow_reuse_address = True
             daemon_threads = True
 
         self._srv = _Srv((host, port), _Handler)
         self._srv.manager = manager  # type: ignore[attr-defined]
+        self._srv.trace_propagation = trace_propagation  # type: ignore[attr-defined]
         self.manager = manager
         self._thread: Optional[threading.Thread] = None
 
@@ -439,9 +511,10 @@ class RemoteKCVStore(KeyColumnValueStore):
         # socket until exhausted, and a consumer abandoning the generator
         # mid-stream must not leave unread row bytes to desync a pooled
         # connection's next request — the private socket just closes
+        op, frame = self._manager._trace_frame(op, b"".join(out))
         conn = _Conn(self._manager.host, self._manager.port)
         try:
-            status, payload, sock = conn.request(op, b"".join(out))
+            status, payload, sock = conn.request(op, frame)
             if status != _STATUS_OK:
                 _raise_status(status, payload)
             while True:
@@ -488,8 +561,14 @@ class RemoteStoreManager(KeyColumnValueStoreManager):
                  breaker_enabled: bool = False,
                  breaker_failure_threshold: int = 5,
                  breaker_reset_ms: float = 1000.0,
-                 breaker_half_open_probes: int = 1):
+                 breaker_half_open_probes: int = 1,
+                 trace_propagation: bool = True):
         self.host, self.port = host, port
+        #: metrics.trace-propagation — attach the ambient TraceContext to
+        #: op frames, but ONLY once the server's features payload
+        #: negotiated the bit (None = not yet negotiated)
+        self.trace_propagation = trace_propagation
+        self._remote_trace: Optional[bool] = None
         self.retry_time_s = retry_time_s
         self.connect_timeout_s = connect_timeout_s
         self.max_attempts = max_attempts
@@ -546,7 +625,31 @@ class RemoteStoreManager(KeyColumnValueStoreManager):
             self._pool_idx += 1
             return conn
 
+    def _trace_frame(self, op: int, body: bytes) -> Tuple[int, bytes]:
+        """(op, body) with the ambient trace context prepended when there
+        is one AND the server negotiated the trace feature bit. The first
+        traced call triggers the (lazy) features negotiation; a server we
+        can't reach yet just stays un-negotiated for this frame."""
+        if op == _OP_FEATURES or not self.trace_propagation:
+            return op, body
+        from janusgraph_tpu.observability import tracer
+
+        ctx = tracer.current_context()
+        if ctx is None:
+            return op, body
+        if self._remote_trace is None:
+            try:
+                _ = self.features
+            # graphlint: disable=JG204 -- negotiation is best-effort: the frame just goes untraced, and the op itself will surface the failure through its own retry guard
+            except (TemporaryBackendError, PermanentBackendError):
+                return op, body
+        if not self._remote_trace:
+            return op, body
+        return op | _TRACE_FLAG, encode_trace_prefix(ctx) + body
+
     def _call(self, op: int, body: bytes) -> bytes:
+        op, body = self._trace_frame(op, body)
+
         def attempt() -> bytes:
             conn = self._acquire()
             with conn.lock:
@@ -580,6 +683,9 @@ class RemoteStoreManager(KeyColumnValueStoreManager):
             import json
 
             remote = json.loads(self._call(_OP_FEATURES, b"").decode())
+            # protocol capability, not a store feature: a missing key is
+            # an old server and trace headers are never sent to it
+            self._remote_trace = bool(remote.pop("trace", False))
             self._features = StoreFeatures(
                 distributed=True,
                 network_attached=True,  # peers beyond this process can write

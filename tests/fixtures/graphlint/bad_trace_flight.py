@@ -1,0 +1,26 @@
+"""JG107: structured-log / flight-recorder calls inside jit-traced code.
+Each call below fires at TRACE time — one flight event (or log record)
+per compile instead of per execution, stamped with trace-time values."""
+
+import jax
+
+from janusgraph_tpu.observability import flight_recorder, get_logger
+
+logger = get_logger("olap")
+
+
+@jax.jit
+def superstep(state):
+    flight_recorder.record("olap_resume", step=0)  # expect: JG107
+    logger.info("superstep-start", step=0)  # expect: JG107
+    return state * 2.0
+
+
+def body(state):
+    out = state + 1.0
+    flight_recorder.dump(reason="mid-superstep")  # expect: JG107
+    logger.error("superstep-failed")  # expect: JG107
+    return out
+
+
+fn = jax.jit(body)

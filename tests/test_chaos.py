@@ -88,6 +88,20 @@ def test_healthz_endpoint_served_over_http():
         assert payload["status"] in ("ok", "degraded")
         assert code == (200 if payload["status"] == "ok" else 503)
         assert "breakers" in payload and "counters" in payload
+        # flight-recorder summary rides every healthz payload (ISSUE 4)
+        assert "flight" in payload
+        assert payload["flight"]["capacity"] > 0
+        assert "counts" in payload["flight"]
+        # the sibling /metrics scrape must be VALID exposition, not just
+        # present (ISSUE 4 satellite: malformed exposition fails fast)
+        from janusgraph_tpu.observability.exposition import (
+            validate_prometheus_text,
+        )
+
+        murl = f"http://127.0.0.1:{server.port}/metrics"
+        with urllib.request.urlopen(murl, timeout=10) as resp:
+            text = resp.read().decode()
+        assert validate_prometheus_text(text) is None, text
     finally:
         server.stop()
         g.close()

@@ -212,6 +212,99 @@ def test_parallel_multi_slice_fanout():
         server.stop()
 
 
+def test_trace_stitches_across_remote_store(served):
+    """ISSUE 4: ops issued inside a client span produce server-side spans
+    sharing the client's trace_id, parented under the client span — one
+    stitched trace across the storage wire."""
+    from janusgraph_tpu.observability import tracer
+
+    _server, client = served
+    store = client.open_database("edgestore")
+    tx = client.begin_transaction()
+    with tracer.span("client.root") as root:
+        store.mutate(b"k", [(b"a", b"1")], [], tx)
+        store.get_slice(KeySliceQuery(b"k", SliceQuery(b"", None)), tx)
+        list(store.get_keys(SliceQuery(b"", None), tx))  # streamed scan too
+    deadline = time.monotonic() + 2.0
+    while time.monotonic() < deadline:
+        remote_spans = [
+            r for r in tracer.find_trace(root.trace_id)
+            if r.name.startswith("store.remote.")
+        ]
+        if len(remote_spans) >= 3:
+            break
+        time.sleep(0.01)
+    names = {s.name for s in remote_spans}
+    assert {"store.remote.mutate", "store.remote.getSlice",
+            "store.remote.scanAll"} <= names, names
+    # every server-side span is a child of the CLIENT's span, same trace
+    for s in remote_spans:
+        assert s.trace_id == root.trace_id
+        assert s.parent_span_id == root.span_id
+    # and the ids round-trip through the JSON exposition surface
+    d = remote_spans[0].to_dict()
+    assert d["trace_id"] == f"{root.trace_id:016x}"
+    assert d["parent_span_id"] == f"{root.span_id:016x}"
+
+
+def test_trace_degrades_against_old_featured_server():
+    """ISSUE 4 acceptance: a new client against an old-featured server
+    (no trace bit in _OP_FEATURES) interoperates byte-compatibly and
+    degrades to unstitched spans — no flagged frames are ever sent."""
+    from janusgraph_tpu.observability import tracer
+
+    server = RemoteStoreServer(
+        InMemoryStoreManager(), trace_propagation=False
+    ).start()
+    client = RemoteStoreManager(*server.address)
+    try:
+        store = client.open_database("edgestore")
+        tx = client.begin_transaction()
+        with tracer.span("client.old-server") as root:
+            store.mutate(b"k", [(b"a", b"1")], [], tx)
+            got = store.get_slice(
+                KeySliceQuery(b"k", SliceQuery(b"", None)), tx
+            )
+        assert got == [(b"a", b"1")]  # the op itself is unaffected
+        assert client._remote_trace is False  # negotiated OFF
+        assert not [
+            r for r in tracer.find_trace(root.trace_id)
+            if r.name.startswith("store.remote.")
+        ]
+    finally:
+        client.close()
+        server.stop()
+
+
+def test_old_client_against_new_server_interoperates(served):
+    """The other direction of the mixed pair: a client that never sets the
+    trace flag (trace_propagation=False — byte-identical frames to a
+    pre-trace client) against a new server."""
+    from janusgraph_tpu.observability import tracer
+
+    server, _ = served
+    host, port = server.address
+    old_client = RemoteStoreManager(host, port, trace_propagation=False)
+    try:
+        store = old_client.open_database("edgestore")
+        tx = old_client.begin_transaction()
+        with tracer.span("client.legacy") as root:
+            store.mutate(b"lk", [(b"a", b"1")], [], tx)
+            got = store.get_slice(
+                KeySliceQuery(b"lk", SliceQuery(b"", None)), tx
+            )
+        assert got == [(b"a", b"1")]
+        # the server saw unflagged frames: nothing joined the trace
+        assert not [
+            r for r in tracer.find_trace(root.trace_id)
+            if r.name.startswith("store.remote.")
+        ]
+        # the negotiated feature bit is still visible to capable clients
+        assert old_client.features.multi_query
+    finally:
+        old_client.close()
+
+
 def test_remote_graph_refuses_pickle_by_default():
     """attributes.allow-pickle=auto disables object-pickle frames over a
     remote store (a compromised peer must not execute code on read) but

@@ -808,3 +808,100 @@ def test_ws_session_merge_upsert_flow(server):
         ws.close()
     assert client.submit("g.V().hasLabel('god').has('age', 1).count()") == 2
     assert client.submit("g.V().has('name','minerva').count()") == 1
+
+
+# ------------------------------------------------- distributed tracing (ISSUE 4)
+def test_driver_query_yields_one_stitched_trace_over_remote_store():
+    """Acceptance: one OLTP query through the driver against a
+    remote-store-backed server yields ONE trace — client root span,
+    server span, and >=1 store-op span all sharing the same trace_id,
+    visible in the /telemetry snapshot."""
+    import time
+    import urllib.request
+
+    from janusgraph_tpu.observability import tracer
+    from janusgraph_tpu.storage.inmemory import InMemoryStoreManager
+    from janusgraph_tpu.storage.remote import RemoteStoreServer
+
+    store_server = RemoteStoreServer(InMemoryStoreManager()).start()
+    host, port = store_server.address
+    g = open_graph({
+        "storage.backend": "remote",
+        "storage.hostname": host,
+        "storage.port": port,
+        "ids.authority-wait-ms": 0.0,
+    })
+    m = JanusGraphManager()
+    m.put_graph("graph", g)
+    server = JanusGraphServer(manager=m).start()
+    client = JanusGraphClient(port=server.port)
+    try:
+        tx = g.new_transaction()
+        tx.add_vertex(name="stitched")
+        tx.commit()
+        assert client.submit("g.V().has('name','stitched').count()") == 1
+        roots = [r for r in tracer.recent() if r.name == "driver.submit"]
+        assert roots, "no client root span"
+        root = roots[-1]
+        # the storage-server handler finishes its span just after replying
+        deadline = time.monotonic() + 2.0
+        while time.monotonic() < deadline:
+            trace = tracer.find_trace(root.trace_id)
+            have_server = any(s.name == "server.request" for s in trace)
+            store_ops = [
+                s for s in trace if s.name.startswith("store.remote.")
+            ]
+            if have_server and store_ops:
+                break
+            time.sleep(0.01)
+        assert have_server, [s.name for s in trace]
+        assert store_ops, [s.name for s in trace]
+        for s in trace:
+            assert s.trace_id == root.trace_id
+        # the whole stitched trace is inspectable via GET /telemetry
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{server.port}/telemetry"
+        ) as resp:
+            payload = json.loads(resp.read().decode())
+        want = f"{root.trace_id:016x}"
+        names = {
+            s["name"] for s in payload["spans"]
+            if s.get("trace_id") == want
+        }
+        assert "driver.submit" in names
+        assert "server.request" in names
+        assert any(n.startswith("store.remote.") for n in names), names
+    finally:
+        server.stop()
+        g.close()
+        store_server.stop()
+
+
+def test_server_response_echoes_trace_id(server):
+    """The response status carries the trace id so callers can pull the
+    stitched trace by id (`janusgraph_tpu trace <id>`)."""
+    from janusgraph_tpu.observability import tracer
+
+    client = JanusGraphClient(port=server.port)
+    assert client.submit("g.V().count()") == 12
+    root = [r for r in tracer.recent() if r.name == "driver.submit"][-1]
+    assert root.attrs.get("server_trace") == f"{root.trace_id:016x}"
+
+
+def test_ws_session_trace_stitches(server):
+    from janusgraph_tpu.observability import tracer
+
+    client = JanusGraphClient(port=server.port)
+    ws = client.ws()
+    try:
+        assert ws.submit("g.V().count()") == 12
+    finally:
+        ws.close()
+    roots = [
+        r for r in tracer.recent()
+        if r.name == "driver.submit" and r.attrs.get("transport") == "ws"
+    ]
+    assert roots
+    trace = tracer.find_trace(roots[-1].trace_id)
+    servers = [s for s in trace if s.name == "server.request"]
+    assert servers and servers[-1].parent_span_id == roots[-1].span_id

@@ -421,3 +421,4 @@ def test_cli_telemetry_scrape_url(server, capsys):
     ) == 0
     out = capsys.readouterr().out
     assert "janusgraph_cli_scrape_total 1" in out
+    assert validate_prometheus_text(out) is None, out
