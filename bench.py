@@ -456,12 +456,33 @@ def _bench_scale(
 
     # telemetry snapshot rides the artifact so BENCH_r*.json lines are
     # self-explaining: per-superstep records (wall, frontier, pad,
-    # transfer, compile flags) from the registry-published run record
+    # transfer, compile flags — and since PR 5 flops, bytes_accessed,
+    # operational_intensity, roofline_utilization, cost_source per
+    # superstep) from the registry-published run record. `roofline`
+    # carries the device peaks + per-E_cap-tier aggregation the
+    # utilization figures are computed against.
     run_rec = dict(ex.last_run_info)
     telemetry = {
         "superstep_records": run_rec.pop("superstep_records", [])[:32],
         "run": {k: v for k, v in run_rec.items() if k != "tiers"},
     }
+    roofline = {
+        **run_rec.get("roofline", {}),
+        "by_tier": run_rec.get("roofline_by_tier", {}),
+    }
+    steps = telemetry["superstep_records"]
+    if steps:
+        utils = [
+            r["roofline_utilization"] for r in steps
+            if r.get("roofline_utilization") is not None
+        ]
+        roofline["operational_intensity"] = steps[-1].get(
+            "operational_intensity"
+        )
+        roofline["utilization_mean"] = (
+            round(sum(utils) / len(utils), 6) if utils else None
+        )
+        roofline["cost_source"] = steps[-1].get("cost_source")
 
     base_iters = 3 if scale >= 20 else 5
     base_eps = host_pagerank_edges_per_sec(csr, iters=base_iters)
@@ -516,6 +537,7 @@ def _bench_scale(
                               "transfer once per executor",
         "ell_bytes": ell_fp["bytes"],
         "ell_pad_ratio": round(ell_fp["pad_ratio"], 3),
+        "roofline": roofline,
         "telemetry": telemetry,
     })
 
@@ -1237,6 +1259,21 @@ def _oltp_stage(t0):
             )
         query_s = time.perf_counter() - q0
         tx.rollback()
+
+        # traversal burst through the DSL so the query-digest table has
+        # shapes to rank: three distinct shapes, many literals each — the
+        # top-3 digests attach to this stage's artifact line
+        from janusgraph_tpu.observability.profiler import digest_table
+
+        digest_table.reset()
+        src_g = g.traversal()
+        for vid in sample[:40]:
+            src_g.V(int(vid)).out("knows").count()
+        for vid in sample[:20]:
+            src_g.V(int(vid)).out("knows").out("knows").count()
+        for vid in sample[:10]:
+            src_g.V(int(vid)).both("knows").limit(5).to_list()
+        src_g.tx.rollback()
         g.close()
         store_hists = {
             name: {
@@ -1258,7 +1295,12 @@ def _oltp_stage(t0):
             "commits_per_s": round(commits / edge_s, 2),
             "multiquery_vertices_per_s": round(len(vs) / query_s, 1),
             "multiquery_edges_read": edges_read,
-            "telemetry": {"store_histograms": store_hists},
+            # top-3 query digests by total cost (shape, count, total/p50/
+            # p95 wall, cells) from the traversal burst above
+            "telemetry": {
+                "store_histograms": store_hists,
+                "query_digests": digest_table.top(3),
+            },
         }
         _hb(
             f"oltp[{backend_name}]: {line['add_edge_per_s']:.0f} addEdge/s "

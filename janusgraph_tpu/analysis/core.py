@@ -57,6 +57,12 @@ RULES: Dict[str, Rule] = {
              "context (the record is emitted once per COMPILE with "
              "trace-time values, and coercing a traced field forces a "
              "host sync — log/record from host code after the dispatch)"),
+        Rule("JG108", SEV_ERROR,
+             "profiler/ledger/cost-model call inside a jit-traced context "
+             "(ledger accruals and digest-table observations fire once "
+             "per COMPILE with trace-time values, and cost harvesting "
+             "re-enters tracing — accrue/observe/harvest from host code "
+             "after the dispatch)"),
         # -- lock discipline ------------------------------------------------
         Rule("JG201", SEV_ERROR,
              "lock.acquire() without with/try-finally release on all paths"),

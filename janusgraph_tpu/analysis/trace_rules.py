@@ -28,6 +28,14 @@ JG107  structured-log / flight-recorder call inside a jit context:
        once per COMPILE with trace-time values (and coercing a traced
        field is a hidden sync). Same fix as JG106: emit from host code
        after the dispatch.
+JG108  profiler / resource-ledger / cost-model call inside a jit context:
+       `accrue(...)`, `ledger.add(...)`, `digest_table.observe(...)`,
+       `harvest_cost(...)` / `estimate_superstep_cost(...)` from a traced
+       body accrues once per COMPILE with trace-time values (and cost
+       harvesting re-enters tracing). Same family as JG106/JG107: accrue
+       and harvest from host code after the dispatch (see
+       TPUExecutor._superstep_cost / _finish_run for the sanctioned
+       pattern).
 """
 
 from __future__ import annotations
@@ -256,6 +264,56 @@ def _check_flight_in_trace(mod) -> List[Finding]:
     return out
 
 
+#: receiver names identifying the profiler / resource-ledger layer
+#: (observability/profiler.py singletons and conventional aliases)
+_PROFILER_ROOTS = {"profiler", "ledger", "digest_table", "resource_ledger"}
+#: recording/harvest methods on those receivers
+_PROFILER_RECORDERS = {
+    "accrue", "accrue_wall", "add", "add_wall", "merge", "merge_echo",
+    "observe", "harvest_cost", "estimate_superstep_cost",
+    "attach_roofline",
+}
+#: bare-name calls from `from ...profiler import accrue` etc.
+_PROFILER_BARE_NAMES = {
+    "accrue", "accrue_wall", "ledger_scope", "current_ledger",
+    "merge_echo", "harvest_cost", "estimate_superstep_cost",
+    "attach_roofline",
+}
+
+
+def _check_profiler_in_trace(mod) -> List[Finding]:
+    """JG108: ledger/digest/cost-model calls inside traced bodies.
+    Receiver-chain matched like JG106 — a set's `.add()` or a dict's
+    `.merge()` never hit unless the chain touches a profiler root."""
+    out: List[Finding] = []
+    for td in find_traced_defs(mod).values():
+        name = getattr(td.node, "name", "<lambda>")
+        for sub in ast.walk(td.node):
+            if not isinstance(sub, ast.Call):
+                continue
+            t = terminal_name(sub.func)
+            hit = (
+                isinstance(sub.func, ast.Name)
+                and t in _PROFILER_BARE_NAMES
+            )
+            if (
+                not hit
+                and isinstance(sub.func, ast.Attribute)
+                and t in _PROFILER_RECORDERS
+            ):
+                hit = bool(_chain_names(sub.func.value) & _PROFILER_ROOTS)
+            if hit:
+                out.append(_finding(
+                    "JG108", mod, sub,
+                    f"profiler/ledger call `{ast.unparse(sub.func)}` "
+                    f"inside jit context `{name}` — it accrues once per "
+                    f"compile with trace-time values (and cost harvesting "
+                    f"re-enters tracing); accrue host-side after the "
+                    f"dispatch",
+                ))
+    return out
+
+
 def _check_donated_reuse(mod) -> List[Finding]:
     """JG104: best-effort, function-scope-local. Tracks
     `f = jax.jit(g, donate_argnums=(i,))` then `f(x, ...)` then a later
@@ -326,4 +384,5 @@ def check_module(mod) -> List[Finding]:
     out.extend(_check_donated_reuse(mod))
     out.extend(_check_telemetry_in_trace(mod))
     out.extend(_check_flight_in_trace(mod))
+    out.extend(_check_profiler_in_trace(mod))
     return out

@@ -264,6 +264,71 @@ def cmd_flight(args) -> int:
     return 0
 
 
+def cmd_top(args) -> int:
+    """Print the query-digest table: the top-K traversal shapes by total
+    cost (count, total/p50/p95 wall, cells). Local process table by
+    default, or a running server's GET /profile with --url."""
+    if args.url:
+        import urllib.request
+
+        base = args.url.rstrip("/")
+        if not base.startswith("http"):
+            base = "http://" + base
+        with urllib.request.urlopen(base + "/profile", timeout=10) as resp:
+            payload = json.loads(resp.read().decode("utf-8"))
+        digests = payload.get("digests", [])
+    else:
+        from janusgraph_tpu.observability.profiler import digest_table
+
+        digests = digest_table.top(args.k)
+    if args.json:
+        print(json.dumps({"digests": digests[: args.k]}, indent=2))
+        return 0
+    print(f"{'digest':10} {'count':>7} {'total_ms':>10} {'p50_ms':>8} "
+          f"{'p95_ms':>8} {'cells':>9}  shape")
+    for d in digests[: args.k]:
+        print(f"{d['digest']:10} {d['count']:>7} {d['total_ms']:>10.2f} "
+              f"{d['p50_ms']:>8.2f} {d['p95_ms']:>8.2f} "
+              f"{d['total_cells']:>9}  {d['shape']}")
+    return 0
+
+
+def cmd_flame(args) -> int:
+    """Render one stitched trace's span trees to collapsed-stack lines
+    (pipe into any flamegraph renderer). Local tracer by default, or a
+    running server's GET /profile/flame with --url."""
+    try:
+        trace_id = f"{int(args.trace_id, 16):016x}"
+    except ValueError:
+        print(f"not a hex trace id: {args.trace_id!r}", file=sys.stderr)
+        return 2
+    if args.url:
+        import urllib.error
+        import urllib.request
+
+        base = args.url.rstrip("/")
+        if not base.startswith("http"):
+            base = "http://" + base
+        try:
+            with urllib.request.urlopen(
+                base + f"/profile/flame?trace={trace_id}", timeout=10
+            ) as resp:
+                sys.stdout.write(resp.read().decode("utf-8"))
+            return 0
+        except urllib.error.HTTPError as e:
+            print(f"server: {e}", file=sys.stderr)
+            return 1
+    from janusgraph_tpu.observability import tracer
+    from janusgraph_tpu.observability.profiler import flame_text
+
+    text = flame_text(tracer, trace_id)
+    if not text:
+        print(f"trace {trace_id} not retained", file=sys.stderr)
+        return 1
+    print(text)
+    return 0
+
+
 def cmd_chaos(args) -> int:
     """Seeded chaos soak on an inmemory graph: drive an OLTP workload (and
     optionally PageRank) through injected faults including a torn batch,
@@ -494,6 +559,29 @@ def main(argv=None) -> int:
     pf.add_argument("--dump", action="store_true",
                     help="also write a JSON dump file")
     pf.set_defaults(fn=cmd_flight)
+
+    ptp = sub.add_parser(
+        "top",
+        help="print the query-digest table (top shapes by total cost)",
+    )
+    ptp.add_argument(
+        "--url", help="read a running server's /profile instead of this "
+        "process's table",
+    )
+    ptp.add_argument("-k", type=int, default=10, help="rows to print")
+    ptp.add_argument("--json", action="store_true")
+    ptp.set_defaults(fn=cmd_top)
+
+    pfl = sub.add_parser(
+        "flame",
+        help="render one trace to collapsed-stack flamegraph lines",
+    )
+    pfl.add_argument("trace_id", help="16-hex-char trace id")
+    pfl.add_argument(
+        "--url", help="read a running server's /profile/flame instead of "
+        "this process's tracer",
+    )
+    pfl.set_defaults(fn=cmd_flame)
 
     pch = sub.add_parser(
         "chaos",

@@ -865,6 +865,34 @@ METRICS_NS.option(
     "server error, the /healthz ok->degraded flip, or on demand "
     "(empty = the system temp dir)", "", Mutability.LOCAL,
 )
+# ---- profiling & cost attribution ---------------------------------------
+METRICS_NS.option(
+    "resource-ledger", bool,
+    "accrue per-query resource costs (cells read/written, bytes moved, "
+    "index hits, retries, wall by layer) into the ambient ResourceLedger "
+    "and propagate the ledger flag over the remote-store/index protocols "
+    "(gated on the peer's negotiated feature bit, so mixed old/new "
+    "deployments stay wire-compatible; observability/profiler.py)", True,
+    Mutability.MASKABLE,
+)
+METRICS_NS.option(
+    "digest-top-k", int,
+    "capacity of the bounded query-digest table (top-K shapes by total "
+    "cost with p50/p95 wall; served at GET /profile and "
+    "`janusgraph_tpu top`)", 128, Mutability.LOCAL, lambda v: v > 0,
+)
+METRICS_NS.option(
+    "roofline-peak-flops", float,
+    "peak device flops/s for the roofline model (0 = auto-detect from "
+    "the device kind; observability/profiler.py device table)", 0.0,
+    Mutability.LOCAL, lambda v: v >= 0,
+)
+METRICS_NS.option(
+    "roofline-peak-bytes-per-s", float,
+    "peak device memory bandwidth in bytes/s for the roofline model "
+    "(0 = auto-detect from the device kind)", 0.0,
+    Mutability.LOCAL, lambda v: v >= 0,
+)
 METRICS_NS.option(
     "structured-logging", bool,
     "emit one-line JSON log records (with ambient trace_id/span_id) to "

@@ -99,6 +99,7 @@ def _open_remote(cfg):
             "storage.breaker.half-open-probes"
         ),
         trace_propagation=cfg.get("metrics.trace-propagation"),
+        resource_ledger=cfg.get("metrics.resource-ledger"),
     )
 
 
@@ -377,6 +378,17 @@ class JanusGraphTPU:
             capacity=cfg.get("metrics.flight-buffer"),
             dump_dir=cfg.get("metrics.flight-dump-dir"),
         )
+        # profiler sizing: digest-table capacity + roofline peak overrides
+        # (observability/profiler.py; GET /profile serves the table)
+        from janusgraph_tpu.observability import profiler as _profiler
+
+        _profiler.digest_table.configure(
+            capacity=cfg.get("metrics.digest-top-k")
+        )
+        _profiler.configure_roofline(
+            peak_flops=cfg.get("metrics.roofline-peak-flops"),
+            peak_bytes_per_s=cfg.get("metrics.roofline-peak-bytes-per-s"),
+        )
         if cfg.get("metrics.structured-logging"):
             import sys as _sys
 
@@ -452,6 +464,7 @@ class JanusGraphTPU:
                     "storage.breaker.half-open-probes"
                 ),
                 trace_propagation=cfg.get("metrics.trace-propagation"),
+                resource_ledger=cfg.get("metrics.resource-ledger"),
             )
         self.index_providers: Dict[str, object] = shared
         # {index_name: {field: KeyInformation}} for provider.mutate calls
@@ -1654,11 +1667,17 @@ class JanusGraphTPU:
         )
         provider = self.index_providers[idx.backing]
         from janusgraph_tpu.observability import registry, span as _span
+        from janusgraph_tpu.observability.profiler import accrue
 
         with _span("index.mixed-query", index=idx.name,
                    conditions=len(conditions)):
             with registry.time("query.index.mixed"):
-                return [int(d) for d in provider.query(idx.name, q)]
+                hits = [int(d) for d in provider.query(idx.name, q)]
+            # remote providers account hits at the wire (echo/fallback);
+            # counting here too would double them
+            if not getattr(provider, "ledger_self_accounting", False):
+                accrue(index_hits=len(hits))
+            return hits
 
     def _clamp_index_limit(self, limit):
         """index.search.max-result-set-size + query.hard-max-limit: every
@@ -1701,7 +1720,12 @@ class JanusGraphTPU:
         if idx is None:
             raise SchemaViolationError(f"unknown index {index_name}")
         from janusgraph_tpu.observability import registry, span as _span
+        from janusgraph_tpu.observability.profiler import accrue
 
         with _span("index.lookup", index=index_name):
             with registry.time("query.index.composite"):
-                return self.index_serializer.query(idx, values, tx.backend_tx)
+                hits = self.index_serializer.query(
+                    idx, values, tx.backend_tx
+                )
+            accrue(index_hits=len(hits))
+            return hits

@@ -79,11 +79,19 @@ class QueryProfiler:
 
 class TraversalMetrics:
     """The object .profile() returns: the profiler tree plus traverser
-    counts (reference: TP3 TraversalMetrics via TP3ProfileWrapper)."""
+    counts (reference: TP3 TraversalMetrics via TP3ProfileWrapper), and
+    — beyond reference parity — a ``resources`` block fed by the
+    per-query ResourceLedger (cells read/written, bytes moved, index
+    hits, retries, wall by layer; observability/profiler.py), the same
+    cost vocabulary OLAP run records report."""
 
-    def __init__(self, profiler: QueryProfiler, result: list):
+    def __init__(
+        self, profiler: QueryProfiler, result: list,
+        resources: Optional[dict] = None,
+    ):
         self.profiler = profiler
         self.result = result
+        self.resources: dict = resources or {}
 
     @property
     def elapsed_ms(self) -> float:

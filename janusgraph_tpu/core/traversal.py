@@ -3082,6 +3082,10 @@ class GraphTraversal:
         run = observe if observe is not None else (lambda _label, fn, ts: fn(ts))
         import time as _time
 
+        from janusgraph_tpu.observability.profiler import current_ledger
+
+        _led = current_ledger()
+        _cells0 = _led.op_cells() if _led is not None else 0
         t0 = _time.perf_counter()
         ts = run("start", lambda _: self._start.run(self._pre_has), None)
         init = getattr(self.source, "_sack_init", None)
@@ -3105,19 +3109,50 @@ class GraphTraversal:
                 )
         # metrics.slow-query-threshold-ms: observability for outlier
         # traversals; resolved once at graph open (hot path)
+        elapsed_ms = (_time.perf_counter() - t0) * 1000.0
         thr = getattr(self.tx.graph, "_slow_query_threshold_ms", 0.0)
-        if thr > 0 and (_time.perf_counter() - t0) * 1000.0 > thr:
+        if thr > 0 and elapsed_ms > thr:
             from janusgraph_tpu.util.metrics import metrics as _mm
 
             _mm.counter("query.slow").inc()
+        self._observe_digest(
+            elapsed_ms,
+            (_led.op_cells() - _cells0) if _led is not None else 0,
+        )
         return ts
+
+    def _observe_digest(self, elapsed_ms: float, cells: int) -> None:
+        """Normalize this traversal to its shape digest (step vocabulary
+        + resolved index choice, literals stripped), feed the bounded
+        top-K digest table, and annotate the ambient span so slow-op and
+        flight `slow_span` events group recurring offenders by shape."""
+        from janusgraph_tpu.observability import tracer
+        from janusgraph_tpu.observability.profiler import (
+            digest_table,
+            shape_digest,
+            traversal_shape,
+        )
+
+        shape = traversal_shape(
+            [getattr(s, "_label", "step") for s in self._steps],
+            getattr(self._start, "plan", None),
+        )
+        digest = shape_digest(shape)
+        digest_table.observe(digest, shape, elapsed_ms, cells=cells)
+        sp = tracer.current()
+        if sp is not None:
+            sp.annotate(digest=digest)
 
     def profile(self):
         """Execute with per-step timing and plan annotations (reference:
         Gremlin .profile() → QueryProfiler via TP3ProfileWrapper.java;
-        annotations mirror SimpleQueryProfiler's condition/index notes)."""
+        annotations mirror SimpleQueryProfiler's condition/index notes).
+        The whole execution runs under a fresh ResourceLedger, so the
+        returned metrics carry a ``resources`` block (cells, bytes, index
+        hits — the same cost vocabulary OLAP run records use)."""
         from janusgraph_tpu.core.profile import QueryProfiler, TraversalMetrics
         from janusgraph_tpu.observability import tracer
+        from janusgraph_tpu.observability.profiler import ledger_scope
 
         root = QueryProfiler("traversal")
 
@@ -3145,9 +3180,13 @@ class GraphTraversal:
                     p.annotate(k, v)
             return out
 
-        with root, tracer.span("oltp.traversal"):
-            ts = self._execute(observe)
-        return TraversalMetrics(root, [t.obj for t in ts])
+        with ledger_scope() as led:
+            with root, tracer.span("oltp.traversal"):
+                ts = self._execute(observe)
+        resources = led.to_dict()
+        if resources:
+            root.annotate("resources", resources)
+        return TraversalMetrics(root, [t.obj for t in ts], resources)
 
     def to_list(self) -> List[object]:
         return [t.obj for t in self._execute()]

@@ -25,6 +25,28 @@ class RemoteError(Exception):
         self.code = code
 
 
+def _merge_status_ledger(status: dict) -> None:
+    """Fold the server's echoed per-request resource ledger
+    (``status.ledger``) into the caller's ambient ledger, so a driver-side
+    ``ledger_scope()`` sees the query's storage/index costs. Merged
+    WITHOUT span annotation: the server-side spans already carry the
+    fields (the trace-totals == span-sums invariant)."""
+    echoed = status.get("ledger")
+    if not isinstance(echoed, dict):
+        return
+    from janusgraph_tpu.observability.profiler import current_ledger
+
+    led = current_ledger()
+    if led is None:
+        return
+    led.add(**{
+        k: v for k, v in echoed.items()
+        if isinstance(v, (int, float)) and k != "wall_ms_by_layer"
+    })
+    for layer, ms in (echoed.get("wall_ms_by_layer") or {}).items():
+        led.add_wall(layer, float(ms))
+
+
 class JanusGraphClient:
     """HTTP client; `ws()` upgrades to a persistent WebSocket session."""
 
@@ -89,6 +111,7 @@ class JanusGraphClient:
             status = payload.get("status", {})
             if "trace" in status:
                 sp.annotate(server_trace=status["trace"])
+            _merge_status_ledger(status)
             if status.get("code") != 200:
                 sp.annotate(code=status.get("code"))
                 raise RemoteError(status.get("code"), status.get("message"))
@@ -160,6 +183,7 @@ class WebSocketSession:
             self._send(json.dumps(req))
             payload = json.loads(self._recv())
             status = payload.get("status", {})
+            _merge_status_ledger(status)
             if status.get("code") != 200:
                 sp.annotate(code=status.get("code"))
                 raise RemoteError(status.get("code"), status.get("message"))

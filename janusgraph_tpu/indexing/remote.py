@@ -49,6 +49,8 @@ from janusgraph_tpu.indexing.provider import (
 )
 from janusgraph_tpu.storage import backend_op
 from janusgraph_tpu.storage.remote import (
+    _FLAG_MASK,
+    _LEDGER_FLAG,
     _TRACE_FLAG,
     _Conn,
     _pb,
@@ -213,7 +215,13 @@ def _decode_raw(r: _Reader) -> RawQuery:
 
 # -------------------------------------------------------------------- server
 class _IndexHandler(socketserver.BaseRequestHandler):
+    #: per flagged request: measured costs, prepended to the OK reply
+    _led = None
+    _op_t0 = 0
+
     def handle(self):
+        import time as _time
+
         provider = self.server.provider  # type: ignore[attr-defined]
         sock = self.request
         try:
@@ -223,12 +231,14 @@ class _IndexHandler(socketserver.BaseRequestHandler):
                 except ConnectionError:
                     return
                 (body_len,) = struct.unpack(">I", head[:4])
-                op = head[4]
+                raw = head[4]
+                op = raw & ~_FLAG_MASK
                 body = _recv_exact(sock, body_len) if body_len else b""
                 ctx = None
-                if op & _TRACE_FLAG:
-                    op &= ~_TRACE_FLAG
+                if raw & _TRACE_FLAG:
                     ctx, body = split_trace_prefix(body)
+                self._led = {} if raw & _LEDGER_FLAG else None
+                self._op_t0 = _time.perf_counter_ns()
                 try:
                     if ctx is not None:
                         from janusgraph_tpu.observability import tracer
@@ -236,8 +246,16 @@ class _IndexHandler(socketserver.BaseRequestHandler):
                         # the index node's op joins the caller's trace
                         with tracer.child_span(
                             ctx, f"index.remote.{_OP_NAMES.get(op, op)}"
-                        ):
+                        ) as sp:
                             self._dispatch(provider, sock, op, body)
+                            if self._led:
+                                # index node owns these measurements (the
+                                # client merges the echo un-annotated)
+                                sp.annotate(**{
+                                    f"ledger.{k}": v
+                                    for k, v in self._led.items()
+                                    if k != "wall_ns"
+                                })
                     else:
                         self._dispatch(provider, sock, op, body)
                 # graphlint: disable=JG204 -- protocol boundary: the error is serialized to the client as a temporary status frame, and the CLIENT retries
@@ -248,11 +266,21 @@ class _IndexHandler(socketserver.BaseRequestHandler):
                         sock, _STATUS_PERM,
                         f"{type(e).__name__}: {e}".encode(),
                     )
+                finally:
+                    self._led = None
         except (ConnectionResetError, BrokenPipeError):
             return
 
-    @staticmethod
-    def _reply(sock, status: int, body: bytes) -> None:
+    def _reply(self, sock, status: int, body: bytes) -> None:
+        if self._led is not None and status == _STATUS_OK:
+            import time as _time
+
+            from janusgraph_tpu.observability.profiler import (
+                encode_ledger_block,
+            )
+
+            self._led["wall_ns"] = _time.perf_counter_ns() - self._op_t0
+            body = encode_ledger_block(self._led) + body
         sock.sendall(struct.pack(">IB", len(body), status) + body)
 
     def _dispatch(self, provider, sock, op: int, body: bytes) -> None:
@@ -276,6 +304,12 @@ class _IndexHandler(socketserver.BaseRequestHandler):
                     m.additions.extend(_decode_entries(r))
                     m.deletions.extend(_decode_entries(r))
                     per_doc[docid] = m
+            if self._led is not None:
+                self._led["cells_written"] = sum(
+                    len(m.additions) + len(m.deletions)
+                    for per_doc in muts.values()
+                    for m in per_doc.values()
+                )
             provider.mutate(muts, _decode_key_infos(r))
             self._reply(sock, _STATUS_OK, b"")
             return
@@ -302,6 +336,8 @@ class _IndexHandler(socketserver.BaseRequestHandler):
                 cond, orders, None if limit < 0 else limit, offset
             )
             hits = provider.query(store, q)
+            if self._led is not None:
+                self._led["index_hits"] = len(hits)
             out: List[bytes] = [struct.pack(">I", len(hits))]
             for h in hits:
                 _ps(out, h)
@@ -310,6 +346,8 @@ class _IndexHandler(socketserver.BaseRequestHandler):
         if op == _OP_RAW_QUERY:
             store = r.str_()
             hits = provider.raw_query(store, _decode_raw(r))
+            if self._led is not None:
+                self._led["index_hits"] = len(hits)
             out = [struct.pack(">I", len(hits))]
             for docid, score in hits:
                 _ps(out, docid)
@@ -347,11 +385,18 @@ class _IndexHandler(socketserver.BaseRequestHandler):
             ]
             for c in f.supports_cardinality:
                 _ps(out, c)
-            # trailing protocol-capability byte: trace-capable server.
-            # Old clients stop reading after the cardinalities, so the
-            # extra byte is invisible to them; old servers simply end the
-            # payload earlier and new clients negotiate tracing OFF.
-            if getattr(self.server, "trace_propagation", True):
+            # trailing protocol-capability bytes, positional: [trace]
+            # then [ledger]. Old clients stop reading after the
+            # cardinalities (or after the trace byte), so extra bytes are
+            # invisible to them; old servers simply end the payload
+            # earlier and new clients negotiate the capability OFF. The
+            # trace byte is always written when the ledger byte is, so
+            # the positions stay unambiguous.
+            trace_on = getattr(self.server, "trace_propagation", True)
+            ledger_on = getattr(self.server, "ledger_echo", True)
+            if trace_on or ledger_on:
+                out.append(b"\x01" if trace_on else b"\x00")
+            if ledger_on:
                 out.append(b"\x01")
             self._reply(sock, _STATUS_OK, b"".join(out))
             return
@@ -360,11 +405,13 @@ class _IndexHandler(socketserver.BaseRequestHandler):
 
 class RemoteIndexServer:
     """Serve any IndexProvider over TCP (threaded; port 0 = ephemeral).
-    ``trace_propagation=False`` = the pre-trace features payload (an
-    "old-featured" index server for compatibility tests)."""
+    ``trace_propagation=False`` = the pre-trace features payload,
+    ``ledger_echo=False`` the pre-ledger one ("old-featured" index
+    servers for compatibility tests)."""
 
     def __init__(self, provider: IndexProvider, host: str = "127.0.0.1",
-                 port: int = 0, trace_propagation: bool = True):
+                 port: int = 0, trace_propagation: bool = True,
+                 ledger_echo: bool = True):
         class _Srv(socketserver.ThreadingTCPServer):
             allow_reuse_address = True
             daemon_threads = True
@@ -372,6 +419,7 @@ class RemoteIndexServer:
         self._srv = _Srv((host, port), _IndexHandler)
         self._srv.provider = provider  # type: ignore[attr-defined]
         self._srv.trace_propagation = trace_propagation  # type: ignore[attr-defined]
+        self._srv.ledger_echo = ledger_echo  # type: ignore[attr-defined]
         self.provider = provider
         self._thread: Optional[threading.Thread] = None
 
@@ -407,6 +455,7 @@ class RemoteIndexProvider(IndexProvider):
                  breaker_reset_ms: float = 1000.0,
                  breaker_half_open_probes: int = 1,
                  trace_propagation: bool = True,
+                 resource_ledger: bool = True,
                  **_ignored):
         # `directory` accepted-and-ignored: open_index_provider passes the
         # local providers' kwargs through one call site (core/graph.py)
@@ -423,7 +472,16 @@ class RemoteIndexProvider(IndexProvider):
         #: capability byte (None = features not yet fetched)
         self.trace_propagation = trace_propagation
         self._remote_trace: Optional[bool] = None
+        #: metrics.resource-ledger, gated on the second capability byte
+        self.resource_ledger = resource_ledger
+        self._remote_ledger: Optional[bool] = None
+        #: the provider accounts index hits itself (echo or local
+        #: fallback), so graph.mixed_index_query must not count them again
+        self.ledger_self_accounting = True
         self._pool = [_Conn(self.host, self.port) for _ in range(pool_size)]
+        # whether this thread's last _call carried a ledger echo (drives
+        # the old-server fallback accounting in query/raw_query)
+        self._tls = threading.local()
         self._pool_lock = threading.Lock()
         self._pool_idx = 0
         self._features: Optional[IndexFeatures] = None
@@ -442,26 +500,33 @@ class RemoteIndexProvider(IndexProvider):
                 half_open_probes=breaker_half_open_probes,
             )
 
-    def _trace_frame(self, op: int, body: bytes):
-        """Same negotiation as RemoteStoreManager._trace_frame: attach the
-        ambient context only once the server's features payload proved it
-        understands flagged frames."""
-        if op == _OP_FEATURES or not self.trace_propagation:
-            return op, body
+    def _frame(self, op: int, body: bytes):
+        """Same negotiation as RemoteStoreManager._frame: attach the
+        ambient trace context / ledger flag only once the server's
+        features payload proved it understands flagged frames. Returns
+        (op, body, want_ledger)."""
+        if op == _OP_FEATURES:
+            return op, body, False
         from janusgraph_tpu.observability import tracer
+        from janusgraph_tpu.observability.profiler import current_ledger
 
-        ctx = tracer.current_context()
-        if ctx is None:
-            return op, body
-        if self._remote_trace is None:
+        ctx = tracer.current_context() if self.trace_propagation else None
+        led = current_ledger() if self.resource_ledger else None
+        if ctx is None and led is None:
+            return op, body, False
+        if self._remote_trace is None or self._remote_ledger is None:
             try:
                 self.features()
-            # graphlint: disable=JG204 -- negotiation is best-effort: the frame just goes untraced, and the op itself will surface the failure through its own retry guard
+            # graphlint: disable=JG204 -- negotiation is best-effort: the frame just goes unflagged, and the op itself will surface the failure through its own retry guard
             except (TemporaryBackendError, PermanentBackendError):
-                return op, body
-        if not self._remote_trace:
-            return op, body
-        return op | _TRACE_FLAG, encode_trace_prefix(ctx) + body
+                return op, body, False
+        want_ledger = bool(led is not None and self._remote_ledger)
+        if ctx is not None and self._remote_trace:
+            op |= _TRACE_FLAG
+            body = encode_trace_prefix(ctx) + body
+        if want_ledger:
+            op |= _LEDGER_FLAG
+        return op, body, want_ledger
 
     def _call(self, op: int, body: bytes, idempotent: bool = True) -> bytes:
         """One wire call under the retry guard. Non-idempotent ops (mutate/
@@ -469,7 +534,7 @@ class RemoteIndexProvider(IndexProvider):
         the DIAL — once the request may have reached the server, a dropped
         connection surfaces as a permanent 'outcome unknown' error instead
         of an at-least-once resend duplicating index entries."""
-        op, body = self._trace_frame(op, body)
+        op, body, want_ledger = self._frame(op, body)
 
         def attempt() -> bytes:
             with self._pool_lock:
@@ -509,16 +574,31 @@ class RemoteIndexProvider(IndexProvider):
         guarded = attempt
         if self.breaker is not None:
             guarded = lambda: self.breaker.call(attempt)  # noqa: E731
-        return backend_op.execute(guarded, max_time_s=self.retry_time_s)
+        payload = backend_op.execute(guarded, max_time_s=self.retry_time_s)
+        if want_ledger:
+            from janusgraph_tpu.observability.profiler import (
+                merge_echo,
+                split_ledger_block,
+            )
+
+            fields, payload = split_ledger_block(payload)
+            # index node measured + span-annotated; merge un-annotated
+            merge_echo(fields, layer="index.remote")
+            self._tls.echoed = fields is not None
+        else:
+            self._tls.echoed = False
+        return payload
 
     def features(self) -> IndexFeatures:
         if self._features is None:
             r = _Reader(self._call(_OP_FEATURES, b""))
             flags = [r.u8() for _ in range(4)]
             cards = tuple(r.str_() for _ in range(r.u32()))
-            # trailing capability byte = trace-capable server; an old
-            # server's payload ends here and tracing stays off
+            # trailing capability bytes, positional: [trace][ledger]; an
+            # old server's payload ends earlier and the capability stays
+            # off in whichever dimension is absent
             self._remote_trace = r.off < len(r.data) and r.u8() == 1
+            self._remote_ledger = r.off < len(r.data) and r.u8() == 1
             self._features = IndexFeatures(
                 supports_document_ttl=bool(flags[0]),
                 supports_cardinality=cards,
@@ -570,7 +650,24 @@ class RemoteIndexProvider(IndexProvider):
         out.append(struct.pack(">iI", -1 if q.limit is None else q.limit,
                                q.offset))
         r = _Reader(self._call(_OP_QUERY, b"".join(out)))
-        return [r.str_() for _ in range(r.u32())]
+        hits = [r.str_() for _ in range(r.u32())]
+        self._count_hits(hits)
+        return hits
+
+    def _count_hits(self, hits) -> None:
+        """Fallback accounting against an old (pre-ledger) index server:
+        no echo came back, so the decoded hit count is the PRIMARY accrual
+        (annotates the client-side span). A ledger-disabled client stays
+        entirely ledger-oblivious."""
+        if getattr(self._tls, "echoed", False) or not self.resource_ledger:
+            return
+        from janusgraph_tpu.observability.profiler import (
+            accrue,
+            current_ledger,
+        )
+
+        if current_ledger() is not None:
+            accrue(index_hits=len(hits))
 
     def raw_query(self, store: str, q: RawQuery) -> List[Tuple[str, float]]:
         out: List[bytes] = []
@@ -584,6 +681,7 @@ class RemoteIndexProvider(IndexProvider):
             (score,) = struct.unpack_from(">d", r.data, r.off)
             r.off += 8
             hits.append((docid, score))
+        self._count_hits(hits)
         return hits
 
     def totals(self, store: str, q: RawQuery) -> int:

@@ -47,6 +47,16 @@ from janusgraph_tpu.observability.metrics_core import (
     TelemetryRegistry,
     Timer,
 )
+from janusgraph_tpu.observability.profiler import (
+    DigestTable,
+    ResourceLedger,
+    accrue,
+    accrue_wall,
+    current_ledger,
+    digest_table,
+    flame_lines,
+    ledger_scope,
+)
 from janusgraph_tpu.observability.spans import (
     Span,
     TraceContext,
@@ -63,12 +73,16 @@ span = tracer.span
 
 
 def _slow_span_to_flight(event: dict) -> None:
+    # the query digest (annotated onto the span by traversal execution)
+    # rides along so recurring slow shapes group instead of appearing as
+    # one-off offenders
     flight_recorder.record(
         "slow_span",
         name=event["name"],
         ms=event["ms"],
         trace_id=event.get("trace_id"),
         span_id=event.get("span_id"),
+        digest=event.get("attrs", {}).get("digest"),
     )
 
 
@@ -78,18 +92,26 @@ tracer.on_slow = _slow_span_to_flight
 __all__ = [
     "BUCKET_BOUNDS",
     "Counter",
+    "DigestTable",
     "FlightRecorder",
     "Gauge",
     "Histogram",
+    "ResourceLedger",
     "Span",
     "StructuredLogger",
     "TelemetryRegistry",
     "Timer",
     "TraceContext",
     "Tracer",
+    "accrue",
+    "accrue_wall",
+    "current_ledger",
+    "digest_table",
+    "flame_lines",
     "flight_recorder",
     "get_logger",
     "json_snapshot",
+    "ledger_scope",
     "prometheus_text",
     "registry",
     "span",

@@ -37,6 +37,11 @@ class CPUExecutor:
 
     def __init__(self, graph: CSRGraph):
         self.graph = graph
+        #: per-run execution record, same shape as TPUExecutor's — the
+        #: CPU oracle reports the same roofline vocabulary (flops, bytes,
+        #: operational intensity, utilization) so cost comparisons read
+        #: uniformly; costs come from the host estimator (no XLA here)
+        self.last_run_info: Dict[str, object] = {}
 
     def run(
         self,
@@ -115,9 +120,13 @@ class CPUExecutor:
             memory.superstep = 0
             start_step = 0
 
+        import time as _time
+
+        records = []
         for step in range(start_step, program.max_iterations):
             if fault_hook is not None:
                 fault_hook(step)
+            _s0 = _time.perf_counter()
             op = program.combiner_for(step)
             identity = Combiner.IDENTITY[op]
             outgoing = np.asarray(
@@ -167,6 +176,11 @@ class CPUExecutor:
                 state, aggregated, step, memory_in, g, np
             )
             memory.reduce_in(metrics)
+            records.append({
+                "step": step,
+                "wall_ms": round((_time.perf_counter() - _s0) * 1000.0, 3),
+                "combiner": op,
+            })
             steps_done = step + 1
             if checkpoint_path and checkpoint_every and (
                 steps_done % checkpoint_every == 0
@@ -182,4 +196,47 @@ class CPUExecutor:
                 )
             if program.terminate(memory):
                 break
+        self._publish_run(program, records)
         return {k: np.asarray(v) for k, v in state.items()}
+
+    def _publish_run(self, program: VertexProgram, records) -> None:
+        """Run record with the SAME roofline vocabulary as TPUExecutor
+        (estimator costs: the scalar loop has no XLA to harvest). Host
+        code only — nothing here is traced."""
+        from janusgraph_tpu.observability import profiler, registry
+
+        g = self.graph
+        edges = g.num_edges * (2 if program.undirected else 1)
+        cost = profiler.estimate_superstep_cost(
+            g.num_vertices, edges,
+            weighted=g.in_edge_weight is not None,
+        )
+        peaks = profiler.device_peaks("cpu")
+        tiers = profiler.attach_roofline(records, cost, peaks)
+        info = {
+            "path": "cpu",
+            "supersteps": len(records),
+            "wall_s": round(
+                sum(r["wall_ms"] for r in records) / 1000.0, 4
+            ),
+            "superstep_records": records,
+            "roofline_by_tier": tiers,
+            "roofline": {
+                "peak_flops": peaks["peak_flops"],
+                "peak_bytes_per_s": peaks["peak_bytes_per_s"],
+                "device_kind": peaks["device_kind"],
+                "peaks_source": peaks["source"],
+            },
+            # same cost vocabulary as the OLTP profile resources block;
+            # the scalar loop moves no device bytes
+            "resources": {
+                "h2d_bytes": 0,
+                "d2h_bytes": 0,
+                "flops": sum(r.get("flops", 0.0) for r in records),
+                "bytes_accessed": sum(
+                    r.get("bytes_accessed", 0.0) for r in records
+                ),
+            },
+        }
+        self.last_run_info = info
+        registry.record_run("olap", info)

@@ -267,6 +267,11 @@ class TPUExecutor:
         #: static shapes, no device sync
         self._last_arg_bytes = 0
         self._compiled: Dict[str, object] = {}
+        # per-variant kernel cost records ({"flops", "bytes_accessed",
+        # "cost_source"}): harvested ONCE per compiled variant from the
+        # lowered module's XLA cost analysis, host estimator otherwise
+        # (observability/profiler.py roofline model)
+        self._kernel_costs: Dict[Tuple, dict] = {}
         # view-field access sets per compiled variant (discovery trace);
         # None record = not discovering
         self._viewkeys: Dict[Tuple, frozenset] = {}
@@ -652,6 +657,42 @@ class TPUExecutor:
             )
         return self._compiled[key]
 
+    def _superstep_cost(
+        self, program: VertexProgram, op: str, channel, state, mem, gargs
+    ) -> dict:
+        """One variant's {flops, bytes_accessed, cost_source}: lower the
+        superstep kernel once and harvest XLA's cost_analysis; fall back
+        to the host estimator when the backend exposes none. Host-side
+        only — lowering traces the body, it never dispatches or compiles."""
+        from janusgraph_tpu.observability import profiler
+
+        ch_val = program.edge_channels[channel] if channel is not None else None
+        key = ("cost", program.cache_key(), op, self._strategy_cfg, ch_val)
+        cost = self._kernel_costs.get(key)
+        if cost is not None:
+            return cost
+        cost = None
+        try:
+            # a throwaway jit wrapper: lowering only (traces the body, no
+            # compile, no dispatch) — and it must not touch _compiled,
+            # which doubles as the run's retrace/compile-cache counter
+            fn = self.jax.jit(self._superstep_body(program, op, channel))
+            lowered = fn.lower(
+                state, self.jnp.asarray(0, self.jnp.int32), mem, gargs
+            )
+            cost = profiler.harvest_cost(lowered)
+        except Exception:  # noqa: BLE001 - cost harvest must never fail a run
+            cost = None
+        if cost is None:
+            cost = profiler.estimate_superstep_cost(
+                self.csr.num_vertices,
+                self.csr.num_edges * (2 if program.undirected else 1),
+                weighted=self.csr.in_edge_weight is not None,
+                arg_bytes=self._last_arg_bytes,
+            )
+        self._kernel_costs[key] = cost
+        return cost
+
     def _fused_fn(self, program: VertexProgram, op: str):
         """A span of the BSP iteration as one compiled dispatch: a
         lax.while_loop over supersteps with `terminate_device` as the
@@ -883,6 +924,61 @@ class TPUExecutor:
                 r.setdefault("pad_ratio", pad_ratio)
             r.setdefault("h2d_bytes", info["h2d_arg_bytes"] if i == 0 else 0)
         info["superstep_records"] = records
+
+        # roofline: every superstep record reports flops, bytes accessed,
+        # operational intensity, and %-of-roofline utilization; frontier
+        # records (no lowered-kernel harvest — each tier is its own
+        # executable) estimate from their compacted tier sizes
+        from janusgraph_tpu.observability import profiler as _profiler
+
+        weighted = self.csr.in_edge_weight is not None
+        for r in records:
+            if "flops" not in r:
+                est = _profiler.estimate_superstep_cost(
+                    int(r.get("frontier", n)),
+                    int(r.get("edges", self.csr.num_edges)),
+                    weighted=weighted,
+                )
+                r.update(est)
+        peaks = _profiler.device_peaks(
+            getattr(self.jax.devices()[0], "device_kind", "cpu")
+        )
+        info["roofline_by_tier"] = _profiler.attach_roofline(
+            records, _profiler.estimate_superstep_cost(
+                n, self.csr.num_edges, weighted=weighted,
+                arg_bytes=info["h2d_arg_bytes"],
+            ), peaks,
+        )
+        info["roofline"] = {
+            "peak_flops": peaks["peak_flops"],
+            "peak_bytes_per_s": peaks["peak_bytes_per_s"],
+            "device_kind": peaks["device_kind"],
+            "peaks_source": peaks["source"],
+        }
+        if records:
+            registry.set_gauge(
+                "olap.roofline.operational_intensity",
+                float(records[-1].get("operational_intensity") or 0.0),
+            )
+            util = records[-1].get("roofline_utilization")
+            if util is not None:
+                registry.set_gauge("olap.roofline.utilization", float(util))
+
+        # run records and OLTP profile trees share one cost vocabulary:
+        # the `resources` block, accrued into the ambient ledger too (an
+        # olap.run inside a profiled request bills its transfer bytes)
+        info["resources"] = {
+            "h2d_bytes": info["h2d_arg_bytes"],
+            "d2h_bytes": info["d2h_bytes"],
+            "flops": sum(r.get("flops", 0.0) for r in records),
+            "bytes_accessed": sum(
+                r.get("bytes_accessed", 0.0) for r in records
+            ),
+        }
+        _profiler.accrue(
+            h2d_bytes=info["h2d_arg_bytes"], d2h_bytes=info["d2h_bytes"]
+        )
+        _profiler.accrue_wall("olap", wall_s * 1000.0)
 
         # compile-cache economics per run: `new_execs` superstep dispatches
         # paid a compile (misses), the rest reused an executable (hits) —
@@ -1119,6 +1215,10 @@ class TPUExecutor:
         cold = fused_key not in self._compiled
         fn = self._fused_fn(program, op)
         gargs = self._graph_args(program, op)
+        # per-superstep cost from the SINGLE-step kernel's lowering (the
+        # fused while_loop executable's analysis would mix in the loop
+        # plumbing; the step body is the dispatch-equivalent unit)
+        cost = self._superstep_cost(program, op, None, state, mem, gargs)
         records = []
         first_dispatch_s = None
         while steps_done < max_iter:
@@ -1153,6 +1253,7 @@ class TPUExecutor:
                     "wall_ms": per_ms,
                     "approx": True,
                     "compiled": cold and not records,
+                    **cost,
                 })
             terminated = new_steps < limit or new_steps == steps_done
             steps_done = max(new_steps, steps_done)
@@ -1224,11 +1325,17 @@ class TPUExecutor:
                 program, op, ch, state=state, mem0=device_memory
             )
             fn = self._superstep_fn(program, op, ch)
+            gargs = self._graph_args(program, op, ch)
+            # lower-once cost harvest (memoized per compiled variant):
+            # flops + bytes accessed feed the per-superstep roofline
+            cost = self._superstep_cost(
+                program, op, ch, state, device_memory, gargs
+            )
             state, metrics = fn(
                 state,
                 jnp.asarray(step, dtype=jnp.int32),
                 device_memory,
-                self._graph_args(program, op, ch),
+                gargs,
             )
             device_memory = {
                 k: metrics.get(k, device_memory.get(k)) for k in
@@ -1243,6 +1350,7 @@ class TPUExecutor:
                 "combiner": op,
                 "channel": ch,
                 "compiled": len(self._compiled) > compiled_before,
+                **cost,
             })
             steps_done += 1
             last = step == program.max_iterations - 1

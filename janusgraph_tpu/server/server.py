@@ -360,6 +360,7 @@ class _Handler(BaseHTTPRequestHandler):
         trace_header: Optional[str] = None,
     ) -> dict:
         from janusgraph_tpu.observability import tracer
+        from janusgraph_tpu.observability.profiler import ledger_scope
         from janusgraph_tpu.observability.spans import TraceContext
 
         query = req.get("gremlin", "")
@@ -367,17 +368,26 @@ class _Handler(BaseHTTPRequestHandler):
         # the request runs under a server span; when the driver sent a
         # trace header (X-Trace-Context / the WS "trace" field) the span
         # joins the caller's trace, and everything below — store ops over
-        # the remote KCVS protocol included — stitches into ONE tree
+        # the remote KCVS protocol included — stitches into ONE tree.
+        # It also runs under a fresh ResourceLedger: every instrumented
+        # layer (storage cells/bytes, index hits, retries) accrues into
+        # it, and the totals are echoed to the driver in status.ledger.
         ctx = TraceContext.from_header(trace_header) if trace_header else None
         with tracer.child_span(
             ctx, "server.request",
             graph=graph or self.jg_server.default_graph,
             session=session is not None,
         ) as sp:
-            payload = self._execute_request(req, query, graph, session, sp)
+            with ledger_scope() as led:
+                payload = self._execute_request(
+                    req, query, graph, session, sp
+                )
         # echo the trace id so the caller can pull the stitched trace from
         # GET /telemetry or `janusgraph_tpu trace <id>`
         payload["status"]["trace"] = f"{sp.trace_id:016x}"
+        resources = led.to_dict()
+        if resources:
+            payload["status"]["ledger"] = resources
         return payload
 
     def _execute_request(self, req, query, graph, session, sp) -> dict:
@@ -480,6 +490,44 @@ class _Handler(BaseHTTPRequestHandler):
                     flight_recorder.snapshot(), default=str
                 ).encode("utf-8"),
             )
+            return
+        if self.path == "/profile" or self.path.startswith("/profile?"):
+            # the query-digest table: top-K traversal shapes by total
+            # cost with p50/p95 wall (unauthenticated like /metrics:
+            # shapes are literal-stripped, never data content)
+            from janusgraph_tpu.observability.profiler import digest_table
+
+            self._send_json(200, {"digests": digest_table.top(32)})
+            return
+        if self.path.startswith("/profile/flame"):
+            # collapsed-stack rendering of one retained trace's span
+            # trees (with ledger annotations folded into frame names) —
+            # pipe into any flamegraph renderer
+            from urllib.parse import parse_qs, urlsplit
+
+            from janusgraph_tpu.observability import tracer
+            from janusgraph_tpu.observability.profiler import flame_text
+
+            qs = parse_qs(urlsplit(self.path).query)
+            trace_id = (qs.get("trace") or [""])[0]
+            if not trace_id:
+                self._send_json(400, {"status": {
+                    "code": 400, "message": "missing ?trace=<id>",
+                }})
+                return
+            text = flame_text(tracer, trace_id)
+            if not text:
+                self._send_json(404, {"status": {
+                    "code": 404,
+                    "message": f"trace {trace_id!r} not retained",
+                }})
+                return
+            body = (text + "\n").encode("utf-8")
+            self.send_response(200)
+            self.send_header("Content-Type", "text/plain; charset=utf-8")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
             return
         if self.path == "/telemetry" or self.path.startswith("/telemetry?"):
             # JSON snapshot: metrics + recent span trees + slow-op log +

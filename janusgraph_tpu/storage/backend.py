@@ -297,29 +297,52 @@ class BackendTransaction:
         self._mutations: Dict[str, Dict[bytes, KCVMutation]] = {}
         self._lock = threading.Lock()
         self._open = True
+        # per-query resource accounting happens HERE for backends whose
+        # manager does not account for itself (the remote KCVS client
+        # counts at the wire — echo or decode — and counting again at
+        # this layer would double every cell)
+        self._ledger_local = not getattr(
+            backend.manager, "ledger_self_accounting", False
+        )
+
+    def _accrue_read(self, entries: EntryList) -> EntryList:
+        if self._ledger_local:
+            from janusgraph_tpu.observability.profiler import (
+                accrue,
+                current_ledger,
+            )
+
+            if current_ledger() is not None:
+                accrue(
+                    cells_read=len(entries),
+                    bytes_read=sum(len(c) + len(v) for c, v in entries),
+                )
+        return entries
 
     # ----------------------------------------------------------------- reads
     # (each read rides Backend.guard — the reference wraps EVERY storage
     # call in BackendOperation.execute; temporary failures replay with
     # jittered backoff instead of surfacing into the transaction layer)
     def edge_store_query(self, query: KeySliceQuery) -> EntryList:
-        return self.backend.guard(
+        return self._accrue_read(self.backend.guard(
             lambda: self.backend.edgestore.get_slice(query, self.store_tx)
-        )
+        ))
 
     def edge_store_multi_query(
         self, keys: Sequence[bytes], slice_query: SliceQuery
     ) -> Dict[bytes, EntryList]:
-        return self.backend.guard(
+        res = self.backend.guard(
             lambda: self.backend.edgestore.get_slice_multi(
                 keys, slice_query, self.store_tx
             )
         )
+        self._accrue_read([e for entries in res.values() for e in entries])
+        return res
 
     def index_query(self, query: KeySliceQuery) -> EntryList:
-        return self.backend.guard(
+        return self._accrue_read(self.backend.guard(
             lambda: self.backend.indexstore.get_slice(query, self.store_tx)
-        )
+        ))
 
     def index_query_uncached(self, query: KeySliceQuery) -> EntryList:
         """Bypass the per-instance slice cache — claim-time reads backing
@@ -327,9 +350,9 @@ class BackendTransaction:
         store = self.backend.indexstore
         if isinstance(store, ExpirationCacheStore):
             store = store.wrapped
-        return self.backend.guard(
+        return self._accrue_read(self.backend.guard(
             lambda: store.get_slice(query, self.store_tx)
-        )
+        ))
 
     # ---------------------------------------------------------------- writes
     def _buffer(self, store: str, key: bytes, additions: EntryList, deletions: Sequence[bytes]):
@@ -419,6 +442,25 @@ class BackendTransaction:
             self._check_and_release_locks(commit=True)
             if preflush is not None and self.has_mutations():
                 preflush()
+            if self._mutations and self._ledger_local:
+                from janusgraph_tpu.observability.profiler import (
+                    accrue,
+                    current_ledger,
+                )
+
+                if current_ledger() is not None:
+                    accrue(
+                        cells_written=sum(
+                            len(m.additions) + len(m.deletions)
+                            for rows in self._mutations.values()
+                            for m in rows.values()
+                        ),
+                        bytes_written=sum(
+                            len(e[0]) + len(e[1])
+                            for rows in self._mutations.values()
+                            for m in rows.values() for e in m.additions
+                        ),
+                    )
             if self._mutations:
                 if self.backend.metrics_enabled:
                     # batched writes bypass the per-store wrapper, so they

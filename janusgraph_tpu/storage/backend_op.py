@@ -92,6 +92,11 @@ def execute(
                 )
                 raise
             registry.counter("storage.backend_op.retries").inc()
+            from janusgraph_tpu.observability.profiler import accrue
+
+            # replayed attempts are a per-query cost too: the ledger
+            # attributes retry burn to the query that paid it
+            accrue(retries=1)
             time.sleep(min(delay, max_delay_s, max(0.0, deadline - now)))
             # decorrelated jitter (not part of the fault-plan determinism
             # contract: fault DECISIONS are hash-scheduled, only the retry

@@ -317,6 +317,12 @@ class FaultInjectingStoreManager(KeyColumnValueStoreManager):
         return self.wrapped.features
 
     @property
+    def ledger_self_accounting(self) -> bool:
+        """Pass-through: a wrapped remote client accounts its own cells,
+        so BackendTransaction must not count them a second time."""
+        return getattr(self.wrapped, "ledger_self_accounting", False)
+
+    @property
     def name(self) -> str:
         return f"faulty({self.wrapped.name})"
 

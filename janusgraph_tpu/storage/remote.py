@@ -35,6 +35,19 @@ negotiation), old clients never set it — mixed pairs speak the original
 protocol unchanged, they just don't stitch. The server opens a child
 span under the received context around each dispatched op, so one
 client query yields one cross-process trace.
+
+Resource-ledger propagation rides the same negotiation scheme on its own
+bits: a ledger-capable server adds `"ledger": true` to the features
+payload, and only then does a client with an ambient
+:class:`~janusgraph_tpu.observability.profiler.ResourceLedger` set
+`op | 0x40` — "measure this op and echo the costs". The server prepends
+`[u8 len][ledger block]` (observability/profiler.py tag-value codec) to
+the OK response body of flagged ops and annotates its span with the same
+fields; the client merges the echo into the ambient ledger (without
+re-annotating — the server's span already carries the fields, keeping
+the trace-totals == span-sums invariant). Old peers in either direction
+never see (or send) flagged frames. Streaming scans are never flagged;
+the client counts the rows it decodes instead.
 """
 
 from __future__ import annotations
@@ -77,6 +90,11 @@ _OP_EXISTS = 9
 #: [u8 hdr_len][TraceContext bytes]. Sent only after the server's
 #: features payload negotiated `"trace": true`.
 _TRACE_FLAG = 0x80
+#: second flag bit: "measure this op's resource costs and prepend a
+#: ledger block to the OK response". Sent only after the server's
+#: features payload negotiated `"ledger": true`.
+_LEDGER_FLAG = 0x40
+_FLAG_MASK = _TRACE_FLAG | _LEDGER_FLAG
 
 _OP_NAMES = {
     _OP_FEATURES: "features",
@@ -213,7 +231,14 @@ def _recv_exact(sock: socket.socket, n: int) -> bytes:
 
 # -------------------------------------------------------------------- server
 class _Handler(socketserver.BaseRequestHandler):
+    #: populated per flagged request by handle(); branch code accrues
+    #: measured costs here and _reply prepends them to the OK frame
+    _led = None
+    _op_t0 = 0
+
     def handle(self):
+        import time as _time
+
         mgr = self.server.manager  # type: ignore[attr-defined]
         sock = self.request
         try:
@@ -223,12 +248,14 @@ class _Handler(socketserver.BaseRequestHandler):
                 except ConnectionError:
                     return
                 (body_len,) = struct.unpack(">I", head[:4])
-                op = head[4]
+                raw = head[4]
+                op = raw & ~_FLAG_MASK
                 body = _recv_exact(sock, body_len) if body_len else b""
                 ctx = None
-                if op & _TRACE_FLAG:
-                    op &= ~_TRACE_FLAG
+                if raw & _TRACE_FLAG:
                     ctx, body = split_trace_prefix(body)
+                self._led = {} if raw & _LEDGER_FLAG else None
+                self._op_t0 = _time.perf_counter_ns()
                 try:
                     if ctx is not None:
                         from janusgraph_tpu.observability import tracer
@@ -239,8 +266,17 @@ class _Handler(socketserver.BaseRequestHandler):
                             ctx,
                             f"store.remote.{_OP_NAMES.get(op, op)}",
                             store_manager=getattr(mgr, "name", ""),
-                        ):
+                        ) as sp:
                             self._dispatch(mgr, sock, op, body)
+                            if self._led:
+                                # the storage node OWNS these measurements:
+                                # it annotates its own span, the client
+                                # merges the echo without re-annotating
+                                sp.annotate(**{
+                                    f"ledger.{k}": v
+                                    for k, v in self._led.items()
+                                    if k != "wall_ns"
+                                })
                     else:
                         self._dispatch(mgr, sock, op, body)
                 # graphlint: disable=JG204 -- protocol boundary: the error is serialized to the client as a temporary status frame, and the CLIENT retries
@@ -248,11 +284,21 @@ class _Handler(socketserver.BaseRequestHandler):
                     self._reply(sock, _STATUS_TEMP, str(e).encode())
                 except Exception as e:  # noqa: BLE001 - protocol boundary
                     self._reply(sock, _STATUS_PERM, f"{type(e).__name__}: {e}".encode())
+                finally:
+                    self._led = None
         except (ConnectionResetError, BrokenPipeError):
             return
 
-    @staticmethod
-    def _reply(sock, status: int, body: bytes) -> None:
+    def _reply(self, sock, status: int, body: bytes) -> None:
+        if self._led is not None and status == _STATUS_OK:
+            import time as _time
+
+            from janusgraph_tpu.observability.profiler import (
+                encode_ledger_block,
+            )
+
+            self._led["wall_ns"] = _time.perf_counter_ns() - self._op_t0
+            body = encode_ledger_block(self._led) + body
         sock.sendall(struct.pack(">IB", len(body), status) + body)
 
     def _dispatch(self, mgr, sock, op: int, body: bytes) -> None:
@@ -270,18 +316,27 @@ class _Handler(socketserver.BaseRequestHandler):
                     "cell_ttl", "timestamps",
                 )
             }
-            # protocol feature bit: this server accepts 0x80-flagged
-            # frames carrying a trace header (absent on old servers, so
-            # new clients degrade to unstitched spans cleanly)
+            # protocol feature bits: this server accepts 0x80-flagged
+            # frames carrying a trace header, and 0x40-flagged frames
+            # asking for a resource-ledger echo (absent on old servers,
+            # so new clients degrade cleanly in both dimensions)
             if getattr(self.server, "trace_propagation", True):
                 feats["trace"] = True
+            if getattr(self.server, "ledger_echo", True):
+                feats["ledger"] = True
             self._reply(sock, _STATUS_OK, json.dumps(feats).encode())
             return
+        led = self._led
         if op == _OP_GET_SLICE:
             store = mgr.open_database(r.str_())
             key = r.bytes_()
             sq = _decode_slice(r)
             entries = store.get_slice(KeySliceQuery(key, sq), txh)
+            if led is not None:
+                led["cells_read"] = len(entries)
+                led["bytes_read"] = sum(
+                    len(c) + len(v) for c, v in entries
+                )
             out: List[bytes] = []
             _encode_entries(out, entries)
             self._reply(sock, _STATUS_OK, b"".join(out))
@@ -292,6 +347,12 @@ class _Handler(socketserver.BaseRequestHandler):
             keys = [r.bytes_() for _ in range(nkeys)]
             sq = _decode_slice(r)
             res = store.get_slice_multi(keys, sq, txh)
+            if led is not None:
+                led["cells_read"] = sum(len(e) for e in res.values())
+                led["bytes_read"] = sum(
+                    len(c) + len(v)
+                    for e in res.values() for c, v in e
+                )
             out = [struct.pack(">I", len(keys))]
             for k in keys:
                 _pb(out, k)
@@ -304,6 +365,11 @@ class _Handler(socketserver.BaseRequestHandler):
             adds = _decode_additions(r)
             ndels = r.u32()
             dels = [r.bytes_() for _ in range(ndels)]
+            if led is not None:
+                led["cells_written"] = len(adds) + ndels
+                led["bytes_written"] = sum(
+                    len(e[0]) + len(e[1]) for e in adds
+                )
             store.mutate(key, adds, dels, txh)
             txh.commit()
             self._reply(sock, _STATUS_OK, b"")
@@ -325,6 +391,16 @@ class _Handler(socketserver.BaseRequestHandler):
                     m.deletions.extend(dels)
                     rows[key] = m
                 muts[sname] = rows
+            if led is not None:
+                led["cells_written"] = sum(
+                    len(m.additions) + len(m.deletions)
+                    for rows in muts.values() for m in rows.values()
+                )
+                led["bytes_written"] = sum(
+                    len(e[0]) + len(e[1])
+                    for rows in muts.values()
+                    for m in rows.values() for e in m.additions
+                )
             mgr.mutate_many(muts, txh)
             txh.commit()
             self._reply(sock, _STATUS_OK, b"")
@@ -359,11 +435,12 @@ class _Handler(socketserver.BaseRequestHandler):
 
 class RemoteStoreServer:
     """Serve a KCVS manager over TCP (threaded; port 0 = ephemeral).
-    ``trace_propagation=False`` serves the pre-trace features payload —
-    an "old-featured" server for compatibility tests and staged rollouts."""
+    ``trace_propagation=False`` serves the pre-trace features payload,
+    ``ledger_echo=False`` the pre-ledger one — "old-featured" servers for
+    compatibility tests and staged rollouts."""
 
     def __init__(self, manager, host: str = "127.0.0.1", port: int = 0,
-                 trace_propagation: bool = True):
+                 trace_propagation: bool = True, ledger_echo: bool = True):
         class _Srv(socketserver.ThreadingTCPServer):
             allow_reuse_address = True
             daemon_threads = True
@@ -371,6 +448,7 @@ class RemoteStoreServer:
         self._srv = _Srv((host, port), _Handler)
         self._srv.manager = manager  # type: ignore[attr-defined]
         self._srv.trace_propagation = trace_propagation  # type: ignore[attr-defined]
+        self._srv.ledger_echo = ledger_echo  # type: ignore[attr-defined]
         self.manager = manager
         self._thread: Optional[threading.Thread] = None
 
@@ -441,13 +519,36 @@ class RemoteKCVStore(KeyColumnValueStore):
     def name(self) -> str:
         return self._name
 
+    def _count_read(self, fields, entries) -> None:
+        """Fallback accounting against an old (pre-ledger) server: no echo
+        came back, so count the decoded entries locally as the PRIMARY
+        accrual (annotates the client-side span). A ledger-disabled client
+        (resource_ledger=False — the "old client" compatibility mode)
+        stays entirely ledger-oblivious."""
+        if fields is not None or not self._manager.resource_ledger:
+            return
+        from janusgraph_tpu.observability.profiler import (
+            accrue,
+            current_ledger,
+        )
+
+        if current_ledger() is not None:
+            accrue(
+                cells_read=len(entries),
+                bytes_read=sum(len(c) + len(v) for c, v in entries),
+            )
+
     def get_slice(self, query: KeySliceQuery, txh) -> EntryList:
         out: List[bytes] = []
         _ps(out, self._name)
         _pb(out, query.key)
         _encode_slice(out, query.slice)
-        payload = self._manager._call(_OP_GET_SLICE, b"".join(out))
-        return _decode_entries(_Reader(payload))
+        payload, fields = self._manager._call_ledger(
+            _OP_GET_SLICE, b"".join(out)
+        )
+        entries = _decode_entries(_Reader(payload))
+        self._count_read(fields, entries)
+        return entries
 
     def get_slice_multi(self, keys, slice_query, txh):
         mgr = self._manager
@@ -477,13 +578,18 @@ class RemoteKCVStore(KeyColumnValueStore):
         for k in keys:
             _pb(out, k)
         _encode_slice(out, slice_query)
-        payload = self._manager._call(_OP_GET_SLICE_MULTI, b"".join(out))
+        payload, fields = self._manager._call_ledger(
+            _OP_GET_SLICE_MULTI, b"".join(out)
+        )
         r = _Reader(payload)
         n = r.u32()
         res = {}
         for _ in range(n):
             key = r.bytes_()
             res[key] = _decode_entries(r)
+        self._count_read(
+            fields, [e for entries in res.values() for e in entries]
+        )
         return res
 
     def mutate(self, key, additions, deletions, txh) -> None:
@@ -494,7 +600,22 @@ class RemoteKCVStore(KeyColumnValueStore):
         out.append(struct.pack(">I", len(deletions)))
         for col in deletions:
             _pb(out, col)
-        self._manager._call(_OP_MUTATE, b"".join(out))
+        _payload, fields = self._manager._call_ledger(
+            _OP_MUTATE, b"".join(out)
+        )
+        if fields is None and self._manager.resource_ledger:
+            from janusgraph_tpu.observability.profiler import (
+                accrue,
+                current_ledger,
+            )
+
+            if current_ledger() is not None:
+                accrue(
+                    cells_written=len(additions) + len(deletions),
+                    bytes_written=sum(
+                        len(e[0]) + len(e[1]) for e in additions
+                    ),
+                )
 
     def get_keys(self, query, txh) -> Iterator[Tuple[bytes, EntryList]]:
         out: List[bytes] = []
@@ -510,9 +631,14 @@ class RemoteKCVStore(KeyColumnValueStore):
         # each scan gets a DEDICATED connection: the row stream occupies the
         # socket until exhausted, and a consumer abandoning the generator
         # mid-stream must not leave unread row bytes to desync a pooled
-        # connection's next request — the private socket just closes
-        op, frame = self._manager._trace_frame(op, b"".join(out))
+        # connection's next request — the private socket just closes.
+        # Scans are never ledger-flagged (the row stream can't carry an
+        # echo block); the client counts what it decodes instead.
+        op, frame, _ = self._manager._frame(
+            op, b"".join(out), allow_ledger=False
+        )
         conn = _Conn(self._manager.host, self._manager.port)
+        cells = scanned_bytes = 0
         try:
             status, payload, sock = conn.request(op, frame)
             if status != _STATUS_OK:
@@ -531,6 +657,10 @@ class RemoteKCVStore(KeyColumnValueStore):
                     (vl,) = struct.unpack(">I", _recv_exact(sock, 4))
                     val = _recv_exact(sock, vl)
                     entries.append((col, val))
+                cells += n
+                scanned_bytes += len(key) + sum(
+                    len(c) + len(v) for c, v in entries
+                )
                 yield key, entries
         finally:
             if conn.sock is not None:
@@ -538,6 +668,10 @@ class RemoteKCVStore(KeyColumnValueStore):
                     conn.sock.close()
                 except OSError:
                     pass
+            if (cells or scanned_bytes) and self._manager.resource_ledger:
+                from janusgraph_tpu.observability.profiler import accrue
+
+                accrue(cells_read=cells, bytes_read=scanned_bytes)
 
 
 def _raise_status(status: int, payload: bytes):
@@ -562,13 +696,21 @@ class RemoteStoreManager(KeyColumnValueStoreManager):
                  breaker_failure_threshold: int = 5,
                  breaker_reset_ms: float = 1000.0,
                  breaker_half_open_probes: int = 1,
-                 trace_propagation: bool = True):
+                 trace_propagation: bool = True,
+                 resource_ledger: bool = True):
         self.host, self.port = host, port
         #: metrics.trace-propagation — attach the ambient TraceContext to
         #: op frames, but ONLY once the server's features payload
         #: negotiated the bit (None = not yet negotiated)
         self.trace_propagation = trace_propagation
         self._remote_trace: Optional[bool] = None
+        #: metrics.resource-ledger — flag ops for a server-side cost echo
+        #: (same negotiation discipline as tracing)
+        self.resource_ledger = resource_ledger
+        self._remote_ledger: Optional[bool] = None
+        #: the KCVS client accounts cells/bytes itself (echo or local
+        #: decode), so BackendTransaction must not count the same ops
+        self.ledger_self_accounting = True
         self.retry_time_s = retry_time_s
         self.connect_timeout_s = connect_timeout_s
         self.max_attempts = max_attempts
@@ -625,30 +767,55 @@ class RemoteStoreManager(KeyColumnValueStoreManager):
             self._pool_idx += 1
             return conn
 
-    def _trace_frame(self, op: int, body: bytes) -> Tuple[int, bytes]:
-        """(op, body) with the ambient trace context prepended when there
-        is one AND the server negotiated the trace feature bit. The first
-        traced call triggers the (lazy) features negotiation; a server we
-        can't reach yet just stays un-negotiated for this frame."""
-        if op == _OP_FEATURES or not self.trace_propagation:
-            return op, body
+    def _frame(
+        self, op: int, body: bytes, allow_ledger: bool = True
+    ) -> Tuple[int, bytes, bool]:
+        """(op, body, want_ledger): the ambient trace context is prepended
+        (trace flag) when there is one AND the server negotiated the trace
+        feature bit; the ledger flag is set when an ambient ResourceLedger
+        exists AND the server negotiated the ledger bit. The first
+        qualifying call triggers the (lazy) features negotiation; a server
+        we can't reach yet just stays un-negotiated for this frame.
+        ``allow_ledger=False`` for streaming ops (scans) — their response
+        cannot carry a block, the client counts decoded rows instead."""
+        if op == _OP_FEATURES:
+            return op, body, False
         from janusgraph_tpu.observability import tracer
+        from janusgraph_tpu.observability.profiler import current_ledger
 
-        ctx = tracer.current_context()
-        if ctx is None:
-            return op, body
-        if self._remote_trace is None:
+        ctx = tracer.current_context() if self.trace_propagation else None
+        led = (
+            current_ledger()
+            if (allow_ledger and self.resource_ledger)
+            else None
+        )
+        if ctx is None and led is None:
+            return op, body, False
+        if self._remote_trace is None or self._remote_ledger is None:
             try:
                 _ = self.features
-            # graphlint: disable=JG204 -- negotiation is best-effort: the frame just goes untraced, and the op itself will surface the failure through its own retry guard
+            # graphlint: disable=JG204 -- negotiation is best-effort: the frame just goes unflagged, and the op itself will surface the failure through its own retry guard
             except (TemporaryBackendError, PermanentBackendError):
-                return op, body
-        if not self._remote_trace:
-            return op, body
-        return op | _TRACE_FLAG, encode_trace_prefix(ctx) + body
+                return op, body, False
+        want_ledger = bool(led is not None and self._remote_ledger)
+        if ctx is not None and self._remote_trace:
+            op |= _TRACE_FLAG
+            body = encode_trace_prefix(ctx) + body
+        if want_ledger:
+            op |= _LEDGER_FLAG
+        return op, body, want_ledger
 
     def _call(self, op: int, body: bytes) -> bytes:
-        op, body = self._trace_frame(op, body)
+        """One wire call; a ledger echo on the response is merged into the
+        ambient ledger (see _call_ledger for callers that need to know
+        whether the echo happened)."""
+        payload, _ = self._call_ledger(op, body)
+        return payload
+
+    def _call_ledger(
+        self, op: int, body: bytes
+    ) -> Tuple[bytes, Optional[dict]]:
+        op, body, want_ledger = self._frame(op, body)
 
         def attempt() -> bytes:
             conn = self._acquire()
@@ -669,13 +836,25 @@ class RemoteStoreManager(KeyColumnValueStoreManager):
             # (permanent to the guard) so callers fail fast instead of
             # spinning out their whole backoff budget
             guarded = lambda: self.breaker.call(attempt)  # noqa: E731
-        return backend_op.execute(
+        payload = backend_op.execute(
             guarded,
             max_time_s=self.retry_time_s,
             base_delay_s=self.backoff_base_s,
             max_delay_s=self.backoff_max_s,
             max_attempts=self.max_attempts,
         )
+        fields = None
+        if want_ledger:
+            from janusgraph_tpu.observability.profiler import (
+                merge_echo,
+                split_ledger_block,
+            )
+
+            fields, payload = split_ledger_block(payload)
+            # the storage node measured (and span-annotated) these costs;
+            # merge them into the caller's ledger without re-annotating
+            merge_echo(fields, layer="store.remote")
+        return payload, fields
 
     @property
     def features(self) -> StoreFeatures:
@@ -683,9 +862,10 @@ class RemoteStoreManager(KeyColumnValueStoreManager):
             import json
 
             remote = json.loads(self._call(_OP_FEATURES, b"").decode())
-            # protocol capability, not a store feature: a missing key is
-            # an old server and trace headers are never sent to it
+            # protocol capabilities, not store features: a missing key is
+            # an old server — trace headers / ledger flags are never sent
             self._remote_trace = bool(remote.pop("trace", False))
+            self._remote_ledger = bool(remote.pop("ledger", False))
             self._features = StoreFeatures(
                 distributed=True,
                 network_attached=True,  # peers beyond this process can write
@@ -721,7 +901,26 @@ class RemoteStoreManager(KeyColumnValueStoreManager):
                 out.append(struct.pack(">I", len(m.deletions)))
                 for col in m.deletions:
                     _pb(out, col)
-        self._call(_OP_MUTATE_MANY, b"".join(out))
+        _payload, fields = self._call_ledger(_OP_MUTATE_MANY, b"".join(out))
+        if fields is None and self.resource_ledger:
+            from janusgraph_tpu.observability.profiler import (
+                accrue,
+                current_ledger,
+            )
+
+            if current_ledger() is not None:
+                accrue(
+                    cells_written=sum(
+                        len(m.additions) + len(m.deletions)
+                        for rows in mutations.values()
+                        for m in rows.values()
+                    ),
+                    bytes_written=sum(
+                        len(e[0]) + len(e[1])
+                        for rows in mutations.values()
+                        for m in rows.values() for e in m.additions
+                    ),
+                )
 
     def close(self) -> None:
         if self._pool_executor is not None:

@@ -132,6 +132,14 @@ class ShardedStoreManager(KeyColumnValueStoreManager):
     def name(self) -> str:
         return f"sharded({len(self.nodes)}x{type(self.nodes[0]).__name__})"
 
+    @property
+    def ledger_self_accounting(self) -> bool:
+        """A composite of remote clients accounts cells at the wire; only
+        when EVERY node does is BackendTransaction counting redundant."""
+        return all(
+            getattr(m, "ledger_self_accounting", False) for m in self.nodes
+        )
+
     def open_database(self, name: str) -> ShardedKCVStore:
         if name not in self._stores:
             self._stores[name] = ShardedKCVStore(self, name)

@@ -1,0 +1,30 @@
+"""JG108: profiler / resource-ledger / cost-model calls inside jit-traced
+code. Each call below fires at TRACE time — one ledger accrual (or digest
+observation, or cost harvest) per compile instead of per execution, with
+trace-time values."""
+
+import jax
+
+from janusgraph_tpu.observability.profiler import (
+    accrue,
+    current_ledger,
+    digest_table,
+    estimate_superstep_cost,
+)
+
+
+@jax.jit
+def superstep(state):
+    accrue(cells_read=1)  # expect: JG108
+    digest_table.observe("ab12cd34", "V>out>count", 1.0)  # expect: JG108
+    return state * 2.0
+
+
+def body(state):
+    ledger = current_ledger()  # expect: JG108
+    ledger.add(bytes_read=4)  # expect: JG108
+    cost = estimate_superstep_cost(8, 16)  # expect: JG108
+    return state + cost["flops"]
+
+
+fn = jax.jit(body)
