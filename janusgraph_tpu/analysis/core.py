@@ -77,8 +77,9 @@ RULES: Dict[str, Rule] = {
         # -- padding / shape invariants -------------------------------------
         Rule("JG301", SEV_ERROR,
              "capacity tier constant is not a power of two (ELL/frontier "
-             "tiers must stay power-of-two for bounded padding and "
-             "executable reuse)"),
+             "tiers and hybrid tail chunk widths must stay power-of-two "
+             "for bounded padding, executable reuse, and the hybrid "
+             "tail's aligned-subtree bitwise contract)"),
         Rule("JG302", SEV_ERROR,
              "integer padding fill uses a bare literal instead of the "
              "documented sentinel name"),

@@ -1,10 +1,15 @@
 """JG3xx padding/shape-invariant rules for the kernel layers.
 
 JG301  capacity tiers (`E_cap`/`F_cap`/`*_capacity`/`E_MIN`/`F_MIN`/
-       `MAX_EDGES`) must be power-of-two integer literals. The ELL packer
-       buckets by next-pow2 degree (bounded <2x padding) and the frontier
-       engine's tier ladder reuses one executable per power tier — a
-       non-pow2 literal breaks both contracts silently.
+       `MAX_EDGES`, and the hybrid tail's `tail_chunk`/`*_chunk`/
+       `chunk_width` static tail-capacity tiers) must be power-of-two
+       integer literals. The ELL packer buckets by next-pow2 degree
+       (bounded <2x padding), the frontier engine's tier ladder reuses one
+       executable per power tier, and the hybrid tail's chunk width must
+       divide every hub row's pow2 tree width so chunks stay aligned
+       subtrees (the bitwise-identity contract, olap/kernels.py
+       tree_reduce) — a non-pow2 literal breaks all three contracts
+       silently.
 JG302  integer-dtype `full(...)` padding with a bare literal fill (other
        than 0/1/-1): padded slots must read the *documented sentinel* (a
        named constant like `pack.sentinel` or `INF`), otherwise a sentinel
@@ -25,7 +30,8 @@ from janusgraph_tpu.analysis.core import Finding, RULES
 from janusgraph_tpu.analysis.tracing import find_traced_defs, terminal_name
 
 _CAP_NAME_RE = re.compile(
-    r"^[ef]_?(cap|min)$|_cap$|_capacity$|^max_edges$|^max_capacity$",
+    r"^[ef]_?(cap|min)$|_cap$|_capacity$|^max_edges$|^max_capacity$"
+    r"|_chunk$|^chunk_width$|^tail_chunk$",
     re.IGNORECASE,
 )
 

@@ -370,8 +370,48 @@ COMPUTER_NS.option(
 )
 COMPUTER_NS.option(
     "strategy", str,
-    "device aggregation kernel ('auto'|'ell'|'segment'|'pallas')", "auto",
-    Mutability.MASKABLE, lambda v: v in ("auto", "ell", "segment", "pallas"),
+    "device aggregation kernel ('auto'|'ell'|'hybrid'|'segment'|'pallas'); "
+    "'auto' consults the profiler-driven autotuner (olap/autotune.py, "
+    "gated by computer.autotune)", "auto",
+    Mutability.MASKABLE,
+    lambda v: v in ("auto", "ell", "hybrid", "segment", "pallas"),
+)
+COMPUTER_NS.option(
+    "autotune", bool,
+    "profiler-driven autotuning behind computer.strategy='auto': choose "
+    "ell/hybrid/segment, the hybrid hub cutoff, and the frontier tier "
+    "schedules from the degree histogram + device roofline peaks "
+    "(olap/autotune.decide; decision recorded in run_info['autotune']). "
+    "False falls back to the legacy ELL footprint-budget heuristic", True,
+    Mutability.MASKABLE,
+)
+COMPUTER_NS.option(
+    "autotune-hub-cutoff", int,
+    "hybrid-format degree cutoff between the exact-width ELL torso and "
+    "the chunked CSR tail (0 = let the tuner search the pow2 candidates; "
+    "read in TPUExecutor._autotune/_hybrid_pack)", 0,
+    Mutability.MASKABLE, lambda v: v >= 0,
+)
+COMPUTER_NS.option(
+    "autotune-tail-chunk", int,
+    "hybrid tail chunk width (power of two): hub edge ranges are gathered "
+    "in chunks of this many slots, so per-hub padding is bounded by one "
+    "chunk (olap/kernels.py HybridPack)", 256,
+    Mutability.MASKABLE, lambda v: v > 0 and (v & (v - 1)) == 0,
+)
+COMPUTER_NS.option(
+    "autotune-min-gain", float,
+    "fractional modeled superstep-time gain the hybrid layout must show "
+    "over pure ELL before the tuner picks it (hysteresis against churning "
+    "packs for marginal wins; olap/autotune.decide)", 0.05,
+    Mutability.MASKABLE, lambda v: 0.0 <= v < 1.0,
+)
+COMPUTER_NS.option(
+    "autotune-max-tiers", int,
+    "frontier tier-ladder length budget per cap axis — each tier is one "
+    "compiled executable; the tuner picks the smallest pow2 growth that "
+    "fits (olap/autotune.decide_tiers)", 8,
+    Mutability.MASKABLE, lambda v: v >= 2,
 )
 COMPUTER_NS.option(
     "ell-max-capacity", int,

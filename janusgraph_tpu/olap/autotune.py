@@ -1,0 +1,402 @@
+"""Profiler-driven autotuner: close the measurement -> kernel-choice loop.
+
+PR 5 made superstep cost visible (XLA ``cost_analysis`` flops/bytes,
+%-roofline per E_cap tier, pad ratios in every run record); this module
+CONSUMES it. Given a graph's degree statistics, the device kind's roofline
+peaks (observability/profiler.py), the ``computer.autotune-*`` config
+overrides, and optionally a prior run's measurements, it decides:
+
+  * the aggregation **strategy** — ``ell`` (pow2 degree buckets),
+    ``hybrid`` (exact-width torso + chunked CSR tail, olap/kernels.py
+    HybridPack), or ``segment`` (flat gather + segment reduce when any
+    packed layout blows the HBM budget);
+  * the hybrid **hub cutoff** and **tail chunk** (searched over pow2
+    candidates against a bytes/peak_bw + flops/peak_flops time model);
+  * the frontier **tier schedules** (F_cap/E_cap ladders) for the
+    ShortestPath/CC special case — sized from the degree histogram and a
+    tier-count budget instead of today's fixed power-of-two growth.
+
+Decisions are DETERMINISTIC: ``decide()`` is a pure function of
+(GraphStats, device_kind, overrides, measured) — same inputs, same
+AutotuneDecision, asserted by tests. The executor records the decision in
+``run_info["autotune"]`` and the bench artifact carries it per stage.
+
+The graph-kernel literature motivates both levers (PAPERS.md):
+arXiv:2011.08451 (propagation blocking) shows format/preprocessing choice
+dominates graph-kernel bandwidth; arXiv:2011.06391 (FusedMM) shows one
+tuned kernel shape serves many workloads once the layout is right.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, Optional, Tuple
+
+import numpy as np
+
+
+def _next_pow2(v: int) -> int:
+    return 1 << max(0, int(v) - 1).bit_length() if v > 1 else 1
+
+
+#: pow2 hub-cutoff candidates the model searches (bounded so stats stay
+#: small and the decision cheap)
+CUTOFF_CANDIDATES = tuple(1 << k for k in range(3, 11))  # 8 .. 1024
+
+
+@dataclass(frozen=True)
+class GraphStats:
+    """Degree-distribution summary the tuner decides from. Everything is
+    precomputed here (one numpy pass over the degree vector) so
+    ``decide()`` itself is pure integer/float arithmetic."""
+
+    num_vertices: int
+    num_edges: int          # per packed orientation (2x |E| when undirected)
+    weighted: bool
+    max_degree: int
+    mean_degree: float
+    #: log2-bucket in-degree histogram: hist[k] = #vertices with
+    #: 2^(k-1) < deg <= 2^k (hist[0] = deg 0 plus deg 1)
+    degree_hist: Tuple[int, ...]
+    #: pure-ELL slot count (pow2 bucket rounding, supernode row-split)
+    ell_slots: int
+    #: candidate hub cutoff -> (cutoff, hybrid gathered slots, hub count,
+    #: torso bucket count, tail chunk rows) — the closed-form HybridPack
+    #: footprint per cutoff; chunk rows price the tail's partial-table
+    #: scatter, the term that punishes small chunks (measured s18 sweep:
+    #: 132k chunks = 23.8 ms/superstep vs 6k chunks = 14.4 ms at equal pad)
+    hybrid_by_cutoff: Tuple[Tuple[int, int, int, int, int], ...]
+
+    @classmethod
+    def from_degrees(
+        cls, deg: np.ndarray, num_edges: int, weighted: bool,
+        max_capacity: int = 1 << 14, tail_chunk: int = 256,
+    ) -> "GraphStats":
+        deg = np.asarray(deg, dtype=np.int64)
+        n = len(deg)
+        maxd = int(deg.max()) if n else 0
+        caps = np.maximum(
+            1, 1 << np.ceil(np.log2(np.maximum(deg, 1))).astype(np.int64)
+        )
+        capped = np.minimum(caps, max_capacity)
+        ell_slots = int(capped.sum())
+        over = deg > max_capacity
+        if over.any():
+            ell_slots += int((deg[over] - max_capacity).sum())
+        hist_bins = np.zeros(36, dtype=np.int64)
+        if n:
+            k = np.ceil(np.log2(np.maximum(deg, 1))).astype(np.int64)
+            np.add.at(hist_bins, np.minimum(k, 35), 1)
+        hyb = []
+        for cutoff in CUTOFF_CANDIDATES:
+            torso = (deg >= 1) & (deg <= cutoff)
+            hub = deg > cutoff
+            t = min(tail_chunk, _next_pow2(cutoff + 1), max_capacity)
+            chunk_rows = int((-(-deg[hub] // t)).sum())
+            slots = int(deg[torso].sum()) + chunk_rows * t
+            torso_buckets = int(len(np.unique(deg[torso]))) if torso.any() else 0
+            hyb.append(
+                (cutoff, slots, int(hub.sum()), torso_buckets, chunk_rows)
+            )
+        return cls(
+            num_vertices=n,
+            num_edges=int(num_edges),
+            weighted=bool(weighted),
+            max_degree=maxd,
+            mean_degree=float(num_edges) / n if n else 0.0,
+            degree_hist=tuple(int(x) for x in np.trim_zeros(hist_bins, "b")),
+            ell_slots=ell_slots,
+            hybrid_by_cutoff=tuple(hyb),
+        )
+
+    @classmethod
+    def from_csr(cls, csr, undirected: bool = False, **kw) -> "GraphStats":
+        deg = np.diff(csr.in_indptr).astype(np.int64)
+        edges = csr.num_edges
+        if undirected:
+            deg = deg + np.diff(csr.out_indptr).astype(np.int64)
+            edges *= 2
+        return cls.from_degrees(
+            deg, edges, weighted=csr.in_edge_weight is not None, **kw
+        )
+
+
+@dataclass(frozen=True)
+class AutotuneDecision:
+    """One deterministic tuning decision. ``as_dict()`` is the record shape
+    stored in ``run_info["autotune"]`` and bench artifacts."""
+
+    strategy: str                     # ell | hybrid | segment
+    hub_cutoff: Optional[int]         # hybrid only
+    tail_chunk: Optional[int]         # hybrid only
+    pad_ratio_est: float              # chosen layout's modeled pad ratio
+    f_schedule: Tuple[int, ...]       # frontier F_cap ladder (pow2, asc)
+    e_schedule: Tuple[int, ...]       # frontier E_cap ladder (pow2, asc)
+    device_kind: str
+    source: str                       # model | config | measured+model
+    modeled_ms: Dict[str, float] = field(default_factory=dict)
+
+    def as_dict(self) -> dict:
+        return {
+            "strategy": self.strategy,
+            "hub_cutoff": self.hub_cutoff,
+            "tail_chunk": self.tail_chunk,
+            "pad_ratio_est": round(self.pad_ratio_est, 4),
+            "f_schedule": list(self.f_schedule),
+            "e_schedule": list(self.e_schedule),
+            "device_kind": self.device_kind,
+            "source": self.source,
+            "modeled_ms": {
+                k: round(v, 4) for k, v in sorted(self.modeled_ms.items())
+            },
+        }
+
+
+#: bytes gathered per slot: idx i32; weighted packs add weight+valid f32
+def _bytes_per_slot(weighted: bool) -> int:
+    return 12 if weighted else 4
+
+
+#: modeled fixed cost per distinct device kernel (gather+fold per bucket).
+#: Small: XLA fuses the per-bucket gathers into one program, so even
+#: hundreds of exact-width torso buckets barely register (measured s18:
+#: the 555-torso-bucket config was among the FASTEST)
+_BUCKET_OVERHEAD_S = 2e-7
+
+#: modeled cost per hybrid tail chunk row: each chunk pays a partial-table
+#: scatter element + fold slot on top of its gathered bytes. Calibrated
+#: from the s18 sweep (126k extra chunks cost ~9.4 ms => ~75 ns/chunk on
+#: host XLA; TPUs scatter relatively better)
+_TAIL_CHUNK_COST_S = {"cpu": 7.5e-8, "tpu": 3e-8}
+
+#: measured per-gathered-slot cost of the packed aggregation kernels —
+#: the gather unit is the binding resource, well below what the DRAM-peak
+#: bytes/bw term predicts. cpu: ~3.3 ns/slot (s18 sweep this round, both
+#: layouts); tpu: the ~140M gathered elem/s v5e gather wall
+#: (docs/tpu_notes.md) => ~7 ns/slot
+_GATHER_COST_S = {"cpu": 3.3e-9, "tpu": 7e-9}
+
+#: scatter (segment-reduce) effective-bandwidth derating vs the packed
+#: gather paths — the reason ELL exists at all (serialized scatter-add
+#: lowering on TPU; cache-hostile on CPU)
+_SEGMENT_PENALTY = {"tpu": 8.0, "cpu": 2.5}
+
+
+def _modeled_seconds(
+    slots: int, n: int, weighted: bool, buckets: int, peaks: dict,
+    penalty: float = 1.0, eff_bw: Optional[float] = None,
+    chunk_rows: int = 0, kind: str = "cpu",
+) -> float:
+    """Roofline time model for one superstep of a packed aggregation: the
+    binding constraint is max(bytes moved at peak-or-measured bandwidth,
+    slots through the gather unit) — the classic two-ceiling roof with the
+    gather wall as the second ceiling — plus per-bucket kernel overhead
+    and the tail's per-chunk scatter cost."""
+    bw = eff_bw or peaks["peak_bytes_per_s"]
+    byts = slots * _bytes_per_slot(weighted) + 4.0 * slots + 8.0 * n
+    t = max(
+        penalty * byts / max(bw, 1.0),
+        penalty * slots * _GATHER_COST_S[kind],
+    )
+    t += slots / max(peaks["peak_flops"], 1.0)
+    t += buckets * _BUCKET_OVERHEAD_S
+    t += chunk_rows * _TAIL_CHUNK_COST_S[kind]
+    return t
+
+
+def decide(
+    stats: GraphStats,
+    device_kind: str,
+    overrides: Optional[dict] = None,
+    measured: Optional[dict] = None,
+) -> AutotuneDecision:
+    """Pick (strategy, hub cutoff, tail chunk, tier schedules) for one
+    graph + device. Pure function of its arguments — identical inputs give
+    an identical decision (tested), so a recorded decision is reproducible
+    from its recorded inputs.
+
+    overrides (the ``computer.autotune-*`` / legacy budget keys):
+      strategy          force the strategy outright (source="config")
+      hub_cutoff        force the hybrid cutoff (0/None = search)
+      tail_chunk        tail chunk width (default 128)
+      min_gain          fractional modeled-time gain hybrid must show over
+                        ELL before it is chosen (default 0.05)
+      budget_bytes      HBM budget for packed layouts (default 6 GiB)
+      max_pad           pad-ratio ceiling for packed layouts (default 3.0)
+      f_min/e_min       smallest frontier tier capacities
+      max_tiers         frontier ladder length budget (default 8)
+      tier_growth       max ladder growth factor (pow2, default 16)
+
+    measured (a prior run's record — ``registry.last_run("olap")`` shape):
+      ``pad_ratio`` + ``superstep_ms`` of a run with ``strategy`` calibrate
+      the model's effective bandwidth (achieved bytes/s replaces the peak
+      table), folding real measurements into the next decision;
+      ``roofline_by_tier`` utilizations refine the frontier ladder (tiers
+      that measured near-zero utilization are pruned from the schedule).
+    """
+    ov = dict(overrides or {})
+    from janusgraph_tpu.observability import profiler
+
+    peaks = profiler.device_peaks(device_kind)
+    kind = "tpu" if "tpu" in (device_kind or "").lower() else "cpu"
+    budget = int(ov.get("budget_bytes") or (6 << 30))
+    max_pad = float(ov.get("max_pad") or 3.0)
+    min_gain = float(ov.get("min_gain") if ov.get("min_gain") is not None
+                     else 0.05)
+    tail_chunk = int(ov.get("tail_chunk") or 256)
+
+    n, m = stats.num_vertices, stats.num_edges
+    bps = _bytes_per_slot(stats.weighted)
+
+    # measured calibration: achieved bytes/s of the prior run's layout
+    eff_bw = None
+    source = "model"
+    if measured and measured.get("superstep_ms") and measured.get("pad_ratio"):
+        meas_slots = float(measured["pad_ratio"]) * m
+        meas_bytes = meas_slots * bps + 4.0 * meas_slots + 8.0 * n
+        eff_bw = meas_bytes / (float(measured["superstep_ms"]) / 1e3)
+        source = "measured+model"
+
+    # candidate models ----------------------------------------------------
+    modeled: Dict[str, float] = {}
+    modeled["segment"] = _modeled_seconds(
+        m, n, stats.weighted, 1, peaks,
+        penalty=_SEGMENT_PENALTY[kind], eff_bw=eff_bw,
+    )
+    ell_buckets = max(1, len(stats.degree_hist))
+    ell_pad = stats.ell_slots / max(1, m)
+    modeled["ell"] = _modeled_seconds(
+        stats.ell_slots, n, stats.weighted, ell_buckets, peaks, eff_bw=eff_bw,
+    )
+
+    forced_cutoff = int(ov.get("hub_cutoff") or 0) or None
+    best = None  # (modeled_s, cutoff, slots)
+    for cutoff, slots, hubs, torso_buckets, chunk_rows in (
+        stats.hybrid_by_cutoff
+    ):
+        if forced_cutoff is not None and cutoff != forced_cutoff:
+            continue
+        t = _modeled_seconds(
+            slots, n, stats.weighted,
+            torso_buckets + (1 if hubs else 0), peaks, eff_bw=eff_bw,
+            chunk_rows=chunk_rows, kind=kind,
+        )
+        if best is None or t < best[0]:
+            best = (t, cutoff, slots)
+    if best is not None:
+        modeled["hybrid"] = best[0]
+        hyb_cutoff, hyb_slots = best[1], best[2]
+        hyb_pad = hyb_slots / max(1, m)
+    else:
+        hyb_cutoff, hyb_slots, hyb_pad = None, stats.ell_slots, ell_pad
+
+    # strategy choice -----------------------------------------------------
+    forced = ov.get("strategy")
+    if forced and forced not in ("auto",):
+        strategy, source = forced, "config"
+    else:
+        strategy = "ell"
+        if "hybrid" in modeled and modeled["hybrid"] < modeled["ell"] * (
+            1.0 - min_gain
+        ):
+            strategy = "hybrid"
+        chosen_slots = hyb_slots if strategy == "hybrid" else stats.ell_slots
+        chosen_pad = hyb_pad if strategy == "hybrid" else ell_pad
+        if chosen_slots * bps > budget or chosen_pad > max_pad:
+            strategy = "segment"
+
+    pad_est = {
+        "ell": ell_pad, "hybrid": hyb_pad, "segment": 1.0, "pallas": 1.0,
+    }.get(strategy, ell_pad)
+
+    f_sched, e_sched = decide_tiers(stats, ov, measured)
+    return AutotuneDecision(
+        strategy=strategy,
+        hub_cutoff=hyb_cutoff if strategy == "hybrid" else None,
+        tail_chunk=(
+            min(tail_chunk, _next_pow2((hyb_cutoff or 0) + 1))
+            if strategy == "hybrid" and hyb_cutoff
+            else (tail_chunk if strategy == "hybrid" else None)
+        ),
+        pad_ratio_est=float(pad_est),
+        f_schedule=f_sched,
+        e_schedule=e_sched,
+        device_kind=device_kind or "cpu",
+        source=source,
+        modeled_ms={k: v * 1e3 for k, v in modeled.items()},
+    )
+
+
+def decide_tiers(
+    stats: GraphStats,
+    overrides: Optional[dict] = None,
+    measured: Optional[dict] = None,
+) -> Tuple[Tuple[int, ...], Tuple[int, ...]]:
+    """(F_cap ladder, E_cap ladder) for the frontier engine: pow2 tiers
+    from the configured floors up to (n, m), with the growth factor chosen
+    per graph so the ladder stays within the tier budget (each tier is one
+    compiled executable) — replacing the fixed x4 growth. The E floor is
+    raised to cover one mean-degree expansion of the smallest F tier, so
+    the first hops of a BFS never straddle two executables.
+
+    With ``measured`` (a prior frontier run's ``roofline_by_tier``), tiers
+    whose measured roofline utilization rounds to zero are dropped from
+    the MIDDLE of the ladder (floors and the dense top stay): a tier the
+    hardware cannot fill is a compile with no win."""
+    ov = dict(overrides or {})
+    n = max(1, stats.num_vertices)
+    m = max(1, stats.num_edges)
+    f_min = int(ov.get("f_min") or (1 << 10))
+    e_min = int(ov.get("e_min") or (1 << 13))
+    max_tiers = int(ov.get("max_tiers") or 8)
+    max_growth = int(ov.get("tier_growth") or 16)
+
+    e_floor = max(e_min, _next_pow2(int(f_min * max(stats.mean_degree, 1.0))))
+    e_floor = min(e_floor, _next_pow2(m))
+
+    def ladder(lo: int, hi: int) -> Tuple[int, ...]:
+        lo = _next_pow2(lo)
+        top = hi  # the top tier is the dense fallback, not rounded up
+        if lo >= top:
+            return (top,)  # floor covers the whole graph: dense only
+        growth = 2
+        while growth < max_growth:
+            count, c = 1, lo
+            while c < top:
+                c *= growth
+                count += 1
+            if count <= max_tiers:
+                break
+            growth *= 2
+        tiers, c = [lo], lo
+        while c < top:
+            c = min(c * growth, top)
+            tiers.append(c)
+        return tuple(tiers)
+
+    f_sched = ladder(f_min, n)
+    e_sched = ladder(e_floor, m)
+
+    if measured:
+        by_tier = measured.get("roofline_by_tier") or {}
+        dead = {
+            int(k) for k, v in by_tier.items()
+            if k.isdigit() and (v.get("roofline_utilization") or 0.0) < 1e-4
+        }
+        if dead:
+            kept = tuple(
+                t for i, t in enumerate(e_sched)
+                if i == 0 or i == len(e_sched) - 1 or t not in dead
+            )
+            if len(kept) >= 2:
+                e_sched = kept
+    return f_sched, e_sched
+
+
+def pick_tier(need: int, schedule: Tuple[int, ...], hi: int) -> int:
+    """Smallest scheduled tier >= need (clamped to hi); the top tier is
+    the dense fallback so nothing is ever dropped."""
+    for t in schedule:
+        if t >= need:
+            return min(t, hi)
+    return hi

@@ -263,6 +263,11 @@ class GraphComputer:
                 "frontier_tier_growth": cfg.get(
                     "computer.frontier-tier-growth"
                 ),
+                "autotune": cfg.get("computer.autotune"),
+                "hub_cutoff": cfg.get("computer.autotune-hub-cutoff"),
+                "tail_chunk": cfg.get("computer.autotune-tail-chunk"),
+                "autotune_min_gain": cfg.get("computer.autotune-min-gain"),
+                "autotune_max_tiers": cfg.get("computer.autotune-max-tiers"),
             }
         if cfg is not None and self.executor_kind == "cpu":
             run_kwargs = {
@@ -322,11 +327,17 @@ def run_on(
     agg: str = "ell",
     fault_hook=None,
     resume_attempts: int = 3,
+    autotune: bool = None,
+    hub_cutoff: int = None,
+    tail_chunk: int = None,
+    autotune_min_gain: float = None,
+    autotune_max_tiers: int = None,
+    cpu_strategy: str = "scalar",
 ):
     if executor == "cpu":
         from janusgraph_tpu.olap.cpu_executor import CPUExecutor
 
-        return CPUExecutor(csr).run(
+        return CPUExecutor(csr, strategy=cpu_strategy).run(
             program,
             checkpoint_path=checkpoint_path,
             checkpoint_every=checkpoint_every,
@@ -361,6 +372,11 @@ def run_on(
             frontier_f_min=frontier_f_min,
             frontier_e_min=frontier_e_min,
             frontier_tier_growth=frontier_tier_growth,
+            autotune=autotune,
+            hub_cutoff=hub_cutoff,
+            tail_chunk=tail_chunk,
+            autotune_min_gain=autotune_min_gain,
+            autotune_max_tiers=autotune_max_tiers,
         ).run(
             program,
             sync_every=sync_every,

@@ -33,9 +33,20 @@ def _combine(op: str, a, b):
 
 
 class CPUExecutor:
-    """Scalar-loop BSP executor (deliberately unvectorized)."""
+    """Scalar-loop BSP executor (deliberately unvectorized).
 
-    def __init__(self, graph: CSRGraph):
+    `strategy` (default "scalar") keeps the per-edge Python loop — the
+    oracle. "ell" / "hybrid" instead run the SAME pack aggregation the
+    device executors compile (olap/kernels.py is xp-generic), in numpy:
+    the oracle side of the hybrid-vs-ELL bitwise-identity contract, and a
+    vectorized host path when the scalar loop is too slow. Channel-switching
+    supersteps always fall back to scalar delivery."""
+
+    def __init__(self, graph: CSRGraph, strategy: str = "scalar"):
+        if strategy not in ("scalar", "ell", "hybrid"):
+            raise ValueError(f"unknown cpu strategy: {strategy!r}")
+        self.strategy = strategy
+        self._packs = {}
         self.graph = graph
         #: per-run execution record, same shape as TPUExecutor's — the
         #: CPU oracle reports the same roofline vocabulary (flops, bytes,
@@ -129,12 +140,25 @@ class CPUExecutor:
             _s0 = _time.perf_counter()
             op = program.combiner_for(step)
             identity = Combiner.IDENTITY[op]
+            ch_name = program.channel_for(step)
+            use_pack = self.strategy != "scalar" and ch_name is None
             outgoing = np.asarray(
-                program.message(state, step, g, np), dtype=np.float64
+                program.message(state, step, g, np),
+                # pack paths run float32 like the device executors (the
+                # bitwise-identity contract); the oracle loop keeps f64
+                dtype=np.float32 if use_pack else np.float64,
             )
+            if use_pack:
+                # the device executors' exact aggregation arithmetic
+                # replayed in numpy (the errstate guard silences the
+                # documented identity*0 transform noise the validity
+                # mask then repairs)
+                with np.errstate(invalid="ignore"):
+                    aggregated = self._pack_aggregate(program, op, outgoing)
             vec = outgoing.ndim == 2
-            agg_shape = (n, outgoing.shape[1]) if vec else (n,)
-            aggregated = np.full(agg_shape, identity, dtype=np.float64)
+            if not use_pack:
+                agg_shape = (n, outgoing.shape[1]) if vec else (n,)
+                aggregated = np.full(agg_shape, identity, dtype=np.float64)
 
             def deliver(dst: int, src: int, weight):
                 msg = apply_edge_transform(
@@ -143,8 +167,9 @@ class CPUExecutor:
                 )
                 aggregated[dst] = _combine(op, aggregated[dst], msg)
 
-            ch_name = program.channel_for(step)
-            if ch_name is not None:
+            if use_pack:
+                pass
+            elif ch_name is not None:
                 # typed edge view: deliver only along the channel's edges
                 # (reference: per-scope slice queries,
                 # VertexProgramScanJob.java:114-135)
@@ -198,6 +223,52 @@ class CPUExecutor:
                 break
         self._publish_run(program, records)
         return {k: np.asarray(v) for k, v in state.items()}
+
+    def _pack(self, undirected: bool):
+        """ELL/Hybrid pack over the CPU graph's edge view (same layout the
+        device executors build), cached per (strategy, orientation)."""
+        key = (self.strategy, undirected)
+        pack = self._packs.get(key)
+        if pack is None:
+            from janusgraph_tpu.olap.kernels import ELLPack, HybridPack
+
+            g = self.graph
+            n = g.num_vertices
+            src = g.in_src.astype(np.int64)
+            dst = np.repeat(
+                np.arange(n, dtype=np.int64), np.diff(g.in_indptr)
+            )
+            w = g.in_edge_weight
+            if undirected:
+                src = np.concatenate([src, g.out_dst.astype(np.int64)])
+                dst = np.concatenate([
+                    dst,
+                    np.repeat(
+                        np.arange(n, dtype=np.int64), np.diff(g.out_indptr)
+                    ),
+                ])
+                w = (
+                    np.concatenate([w, g.out_edge_weight])
+                    if w is not None
+                    else None
+                )
+            cls = ELLPack if self.strategy == "ell" else HybridPack
+            pack = cls(src, dst, w, n)
+            self._packs[key] = pack
+        return pack
+
+    def _pack_aggregate(self, program: VertexProgram, op: str, outgoing):
+        from janusgraph_tpu.olap.kernels import (
+            ell_aggregate,
+            hybrid_aggregate,
+        )
+
+        pack = self._pack(program.undirected)
+        agg_fn = ell_aggregate if self.strategy == "ell" else hybrid_aggregate
+        return agg_fn(
+            np, pack, outgoing, op, program.edge_transform,
+            program.edge_transform_cols,
+        )
 
     def _publish_run(self, program: VertexProgram, records) -> None:
         """Run record with the SAME roofline vocabulary as TPUExecutor

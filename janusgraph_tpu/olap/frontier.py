@@ -113,6 +113,16 @@ class FrontierEngine:
             self.E_MIN = executor._frontier_e_min
         if getattr(executor, "_frontier_tier_growth", None):
             self.GROWTH = executor._frontier_tier_growth
+        # autotuned tier ladders (olap/autotune.decide_tiers): explicit
+        # pow2 schedules sized from the degree histogram replace the fixed
+        # growth-factor ladder when the executor carries a decision
+        self.f_schedule = self.e_schedule = None
+        decision = getattr(executor, "_autotune_decisions", {}).get(False)
+        if decision is not None and getattr(
+            executor, "_autotune_enabled", False
+        ):
+            self.f_schedule = decision.f_schedule
+            self.e_schedule = decision.e_schedule
         csr = executor.csr
         jnp = self.jnp
         self.n = csr.num_vertices
@@ -266,14 +276,25 @@ class FrontierEngine:
             )
             if count == 0:
                 break
-            f_cap = _tier(count, self.F_MIN, self.n, self.GROWTH)
-            e_cap = _tier(
-                max(tot_out, tot_in, 1), self.E_MIN, self.m, self.GROWTH
-            )
+            if self.f_schedule and self.e_schedule:
+                from janusgraph_tpu.olap.autotune import pick_tier
+
+                f_cap = pick_tier(count, self.f_schedule, self.n)
+                e_cap = pick_tier(
+                    max(tot_out, tot_in, 1), self.e_schedule, self.m
+                )
+            else:
+                f_cap = _tier(count, self.F_MIN, self.n, self.GROWTH)
+                e_cap = _tier(
+                    max(tot_out, tot_in, 1), self.E_MIN, self.m, self.GROWTH
+                )
             trace.append(
                 {"hop": t, "frontier": count,
                  "edges": max(tot_out, tot_in), "F_cap": f_cap,
-                 "E_cap": e_cap}
+                 "E_cap": e_cap,
+                 "tier_source": (
+                     "autotune" if self.e_schedule else "static"
+                 )}
             )
             fn = self._step_fn(f_cap, e_cap, weighted, track, und)
             value, pred, mask, _ = fn(

@@ -12,7 +12,20 @@ poorly on TPU. Two TPU-native alternatives here:
    every monoid (sum/min/max) supported, padding overhead < 2× by the
    power-of-two bucketing. This is the default device strategy.
 
-2. **Pallas sorted-segment-sum** (`pallas_sorted_segment_sum`): edges are
+2. **Degree-bucketed HYBRID** (`HybridPack` / `hybrid_aggregate`): the
+   ELL pack's power-of-two bucket rounding moves 1.4-1.5x the edge count in
+   sentinel padding on heavy-tailed graphs (every bench round since r01).
+   The hybrid keeps an ELL-shaped torso packed at EXACT degree widths
+   (zero padding) for vertices at or below a degree cutoff, and routes hub
+   vertices through a chunked CSR tail: contiguous `tail_chunk`-wide slices
+   of the destination-sorted edge array, folded into per-row partial tables.
+   Results are BITWISE-IDENTICAL to the pure-ELL path because both reduce
+   through the same fixed adjacent-pair tree (`tree_reduce`): a width-2^k
+   ELL row's reduction tree decomposes exactly into the per-chunk subtrees
+   plus the partial-table fold, and in-kernel identity padding reproduces
+   the sentinel slots leaf-for-leaf.
+
+3. **Pallas sorted-segment-sum** (`pallas_sorted_segment_sum`): edges are
    already destination-sorted (CSR); host-side alignment pads each output
    tile's edge range to whole blocks, so each edge block accumulates into
    exactly one output tile. The kernel one-hot-expands local segment ids and
@@ -20,7 +33,10 @@ poorly on TPU. Two TPU-native alternatives here:
    steps (zeroed on first touch). SUM monoid; used for PageRank-shaped
    programs.
 
-Both are built once per (graph, orientation) and reused across supersteps.
+All are built once per (graph, orientation) and reused across supersteps.
+The aggregation entry points take the array module (`jnp` or plain numpy)
+as their first argument, so the CPU oracle can run the identical pack
+arithmetic for cross-executor bitwise checks.
 """
 
 from __future__ import annotations
@@ -222,6 +238,73 @@ def flat_take(jnp, tab, idx):
     return jnp.take(tab, flat, axis=0).reshape(idx.shape + tab.shape[1:])
 
 
+def _next_pow2(v: int) -> int:
+    return 1 << max(0, int(v) - 1).bit_length() if v > 1 else 1
+
+
+def _is_jax(xp) -> bool:
+    """jnp vs plain numpy — the aggregation bodies are xp-generic so the
+    CPU oracle can replay the exact pack arithmetic in numpy."""
+    return "jax" in getattr(xp, "__name__", "")
+
+
+# graphlint: traced -- the shared reduction tree of every compiled superstep
+def tree_reduce(xp, m, op: str):
+    """Reduce axis 1 of `m` (width MUST be a power of two) through a fixed
+    adjacent-pair halving tree: [a,b,c,d] -> [a+b, c+d] -> [(a+b)+(c+d)].
+
+    This tree — not the backend's reduce — is the strategies' bitwise
+    contract: any aligned power-of-two-sized contiguous sub-range of the
+    leaves is a complete subtree, so a row evaluated whole (ELL) and the
+    same row evaluated as chunk partials folded afterwards (hybrid tail)
+    produce identical bits, on any backend that preserves elementwise
+    float semantics (all of them)."""
+    width = m.shape[1]
+    if width & (width - 1):
+        raise ValueError(f"tree_reduce width {width} is not a power of two")
+    while m.shape[1] > 1:
+        a = m[:, 0::2]
+        b = m[:, 1::2]
+        if op == Combiner.SUM:
+            m = a + b
+        elif op == Combiner.MIN:
+            m = xp.minimum(a, b)
+        else:
+            m = xp.maximum(a, b)
+    return m[:, 0]
+
+
+def _segment_combine(xp, op: str, values, seg, num_segments: int):
+    """Per-slot monoid fold of row partials (rows-sized, not edges-sized).
+    jax path: XLA segment ops; numpy path: unbuffered ufunc.at — each
+    executor's two strategies share one implementation, so hybrid-vs-ELL
+    stays bitwise-identical within either executor."""
+    if _is_jax(xp):
+        import jax
+
+        seg_fn = {
+            Combiner.SUM: jax.ops.segment_sum,
+            Combiner.MIN: jax.ops.segment_min,
+            Combiner.MAX: jax.ops.segment_max,
+        }[op]
+        return seg_fn(values, seg, num_segments=num_segments)
+    return _segment_combine_host(xp, op, values, seg, num_segments)
+
+
+# graphlint: host -- numpy-only branch, unreachable from traced code
+def _segment_combine_host(xp, op: str, values, seg, num_segments: int):
+    out = xp.full(
+        (num_segments,) + values.shape[1:], Combiner.IDENTITY[op],
+        dtype=values.dtype,
+    )
+    ufunc = {
+        Combiner.SUM: xp.add, Combiner.MIN: xp.minimum,
+        Combiner.MAX: xp.maximum,
+    }[op]
+    ufunc.at(out, seg, values)
+    return out
+
+
 # graphlint: traced -- the ELL aggregation body of every compiled superstep
 def ell_aggregate(
     jnp,
@@ -269,28 +352,314 @@ def ell_aggregate(
             m = jnp.where(valid_ > 0, m, identity)
         # unweighted pack: padded slots index the sentinel, which already
         # reads the identity — no mask needed
-        if op == Combiner.SUM:
-            r = m.sum(axis=1)
-        elif op == Combiner.MIN:
-            r = m.min(axis=1)
-        else:
-            r = m.max(axis=1)
+        r = tree_reduce(jnp, m, op)
         if rowseg is not None:
             # fold supernode row partials into one slot per destination —
             # a rows-sized reduction, negligible next to the edge gather
-            import jax
-
-            seg_fn = {
-                Combiner.SUM: jax.ops.segment_sum,
-                Combiner.MIN: jax.ops.segment_min,
-                Combiner.MAX: jax.ops.segment_max,
-            }[op]
-            r = seg_fn(r, rowseg, num_segments=num_slots)
+            r = _segment_combine(jnp, op, r, rowseg, num_slots)
         parts.append(r)
     if not parts:
         out_shape = msgs.shape
         return jnp.full(out_shape, identity, dtype=msgs.dtype)
     stacked = jnp.concatenate(parts, axis=0)
+    return stacked[pack.unpermute]
+
+
+# --------------------------------------------------------------------------
+# Degree-bucketed hybrid: exact-width ELL torso + chunked CSR tail
+# --------------------------------------------------------------------------
+
+class HybridPack:
+    """Hybrid layout of an edge list grouped by destination degree.
+
+    Torso (in-degree 1..hub_cutoff): one bucket per EXACT degree d — a
+    (rows, d) source-index matrix with no padded slots at all; the
+    reduction pads to next-pow2(d) with the monoid identity *in-kernel*
+    (registers/VMEM, never gathered), reproducing the pure-ELL bucket's
+    leaves exactly. Zero-degree vertices contribute an identity constant
+    and zero slots.
+
+    Tail (hub vertices, in-degree > hub_cutoff): the hubs' destination-
+    sorted CSR edge ranges are cut into contiguous `tail_chunk`-wide
+    chunks (the last chunk of a row sentinel-padded — static tail capacity
+    tiers); chunk partials scatter into an identity-filled per-row partial
+    table of width cap/tail_chunk and fold down the remaining tree levels.
+    Degrees above `max_capacity` row-split first, exactly like ELLPack
+    (shared `split_rows`), so the final rows-sized segment fold sees the
+    same operand sequence.
+
+    Both `tail_chunk` and every tree width are powers of two, so every
+    vertex reduces through the identical `tree_reduce` tree the ELL path
+    uses — hybrid and ELL results are bitwise-equal by construction.
+    Slots actually gathered: m_torso exact + ceil-per-hub-row chunk
+    padding, i.e. pad_ratio ~ 1 + tail_chunk/(2*mean hub degree) instead
+    of ELL's 1.4-1.5x pow2 rounding.
+    """
+
+    def __init__(
+        self,
+        src: np.ndarray,
+        dst: np.ndarray,
+        weight: Optional[np.ndarray],
+        num_vertices: int,
+        hub_cutoff: int = 64,
+        tail_chunk: int = 256,
+        max_capacity: int = 1 << 14,
+    ):
+        n = num_vertices
+        self.num_vertices = n
+        self.sentinel = n
+        self.has_weight = weight is not None
+        self.hub_cutoff = int(hub_cutoff)
+        tail_chunk = int(tail_chunk)
+        if tail_chunk < 1 or tail_chunk & (tail_chunk - 1):
+            raise ValueError(
+                f"tail_chunk must be a power of two (got {tail_chunk})"
+            )
+        if self.hub_cutoff < 1:
+            raise ValueError(f"hub_cutoff must be >= 1 (got {hub_cutoff})")
+        # every hub's tree width is >= next_pow2(cutoff+1); the chunk must
+        # divide it so chunks stay aligned subtrees
+        self.tail_chunk = min(
+            tail_chunk, _next_pow2(self.hub_cutoff + 1), int(max_capacity)
+        )
+
+        order = np.argsort(dst, kind="stable")
+        src = np.asarray(src, dtype=np.int64)[order]
+        dst = np.asarray(dst, dtype=np.int64)[order]
+        w = (
+            np.asarray(weight, dtype=np.float32)[order]
+            if weight is not None
+            else None
+        )
+        deg = np.bincount(dst, minlength=n).astype(np.int64)
+        indptr = np.zeros(n + 1, dtype=np.int64)
+        np.cumsum(deg, out=indptr[1:])
+        src32 = np.ascontiguousarray(src, dtype=np.int32)
+        w32 = (
+            np.ascontiguousarray(w, dtype=np.float32) if w is not None else None
+        )
+
+        vertex_order_parts: List[np.ndarray] = []
+        #: torso buckets: array dicts ({"idx", "w"?}) + static (width, tree cap)
+        self.torso: List[dict] = []
+        self.torso_meta: List[Tuple[int, int]] = []
+        torso_degrees = np.unique(deg[(deg >= 1) & (deg <= self.hub_cutoff)])
+        for d in (int(x) for x in torso_degrees):
+            members = np.nonzero(deg == d)[0]
+            pos = indptr[members][:, None] + np.arange(d, dtype=np.int64)
+            entry = {"idx": src32[pos]}
+            if self.has_weight:
+                entry["w"] = w32[pos]
+            self.torso.append(entry)
+            self.torso_meta.append((d, _next_pow2(d)))
+            vertex_order_parts.append(members)
+
+        zero_members = np.nonzero(deg == 0)[0]
+        self.num_zero = len(zero_members)
+        if self.num_zero:
+            vertex_order_parts.append(zero_members)
+
+        #: tail buckets: array dicts ({"idx", "slot", "w"?, "valid"?,
+        #: "rowseg"?}) + static (tree cap, partials per row, rows, slots)
+        self.tail: List[dict] = []
+        self.tail_meta: List[Tuple[int, int, int, int]] = []
+        T = self.tail_chunk
+        hub = deg > self.hub_cutoff
+        if hub.any():
+            caps = np.minimum(
+                1 << np.ceil(
+                    np.log2(np.maximum(deg, 1))
+                ).astype(np.int64),
+                int(max_capacity),
+            )
+            for c in sorted(int(x) for x in np.unique(caps[hub])):
+                members = np.nonzero(hub & (caps == c))[0]
+                deg_m = deg[members]
+                starts_m = indptr[members]
+                if c == int(max_capacity) and int(deg_m.max()) > c:
+                    starts_r, degs_r, rowseg = split_rows(
+                        members, deg_m, starts_m, c
+                    )
+                else:
+                    starts_r, degs_r, rowseg = starts_m, deg_m, None
+                rows = len(starts_r)
+                ppr = c // T  # partial-table width per row
+                nch = -(-degs_r // T)  # real chunks per row (degs_r >= 1)
+                total = int(nch.sum())
+                row_of = np.repeat(np.arange(rows, dtype=np.int64), nch)
+                posr = (
+                    np.arange(total, dtype=np.int64)
+                    - np.repeat(np.cumsum(nch) - nch, nch)
+                )
+                ch_start = starts_r[row_of] + posr * T
+                ch_deg = np.minimum(T, degs_r[row_of] - posr * T)
+                idx = np.full((total, T), self.sentinel, dtype=np.int32)
+                if self.has_weight:
+                    wmat = np.zeros((total, T), dtype=np.float32)
+                    valid = np.zeros((total, T), dtype=np.float32)
+                else:
+                    wmat = valid = None
+                fill_ell_rows(T, ch_start, ch_deg, src32, w32, idx, wmat, valid)
+                entry = {
+                    "idx": idx,
+                    "slot": (row_of * ppr + posr).astype(np.int32),
+                }
+                if wmat is not None:
+                    entry["w"] = wmat
+                    entry["valid"] = valid
+                if rowseg is not None:
+                    entry["rowseg"] = rowseg.astype(np.int32)
+                self.tail.append(entry)
+                self.tail_meta.append((c, ppr, rows, len(members)))
+                vertex_order_parts.append(members)
+
+        vertex_order = (
+            np.concatenate(vertex_order_parts)
+            if vertex_order_parts
+            else np.zeros(0, dtype=np.int64)
+        )
+        pos = np.zeros(n, dtype=np.int64)
+        pos[vertex_order] = np.arange(len(vertex_order), dtype=np.int64)
+        self.unpermute = pos.astype(np.int32)
+        #: gathered slots (the bandwidth-proportional number the pad ratio
+        #: prices); partial tables are rows-sized and excluded
+        self.slots = sum(int(b["idx"].size) for b in self.torso) + sum(
+            int(b["idx"].size) for b in self.tail
+        )
+        self.pad_ratio = self.slots / max(1, len(src))
+
+    def device_put(self, jnp, sharding=None):
+        """Move index/weight/slot matrices to device once."""
+        put = (lambda a: a) if sharding is None else (
+            lambda a: __import__("jax").device_put(a, sharding)
+        )
+        self.torso = [
+            {k: put(jnp.asarray(v)) for k, v in b.items()} for b in self.torso
+        ]
+        self.tail = [
+            {k: put(jnp.asarray(v)) for k, v in b.items()} for b in self.tail
+        ]
+        self.unpermute = put(jnp.asarray(self.unpermute))
+        return self
+
+
+class HybridPackView:
+    """HybridPack-shaped facade over traced bucket arrays (duck-typed for
+    hybrid_aggregate), carrying the compiled variant's static metadata."""
+
+    __slots__ = (
+        "torso", "torso_meta", "tail", "tail_meta", "num_zero",
+        "unpermute", "has_weight",
+    )
+
+    def __init__(self, args, pack: HybridPack):
+        if len(args["torso"]) != len(pack.torso_meta) or len(
+            args["tail"]
+        ) != len(pack.tail_meta):
+            raise ValueError(
+                f"graph-args hybrid bucket counts "
+                f"({len(args['torso'])}/{len(args['tail'])}) != compiled "
+                f"metadata ({len(pack.torso_meta)}/{len(pack.tail_meta)}) "
+                f"(pack drift)"
+            )
+        self.torso = args["torso"]
+        self.tail = args["tail"]
+        self.unpermute = args["unpermute"]
+        self.torso_meta = pack.torso_meta
+        self.tail_meta = pack.tail_meta
+        self.num_zero = pack.num_zero
+        self.has_weight = pack.has_weight
+
+
+# graphlint: traced -- the hybrid aggregation body of compiled supersteps
+def hybrid_aggregate(
+    xp,
+    pack,
+    msgs,
+    op: str,
+    edge_transform: str = EdgeTransform.NONE,
+    edge_transform_cols=None,
+):
+    """Aggregate per-vertex messages over a HybridPack (or view).
+
+    Same contract as ell_aggregate — msgs (n,) or (n, k), returns the
+    per-destination monoid fold — and bitwise-identical results to it
+    (both reduce through tree_reduce's fixed adjacent-pair tree)."""
+    identity = Combiner.IDENTITY[op]
+    if not pack.has_weight:
+        edge_transform = EdgeTransform.NONE
+        edge_transform_cols = None
+    pad_shape = (1,) + tuple(msgs.shape[1:])
+    msgs_ext = xp.concatenate(
+        [msgs, xp.full(pad_shape, identity, dtype=msgs.dtype)], axis=0
+    )
+
+    def transform(m, w, valid):
+        # mirrors the ELL weighted path slot-for-slot: transform first,
+        # then force padded slots back to the identity (a transform can
+        # disturb it, e.g. identity*0 = nan for MIN's +inf)
+        if w is None:
+            return m
+        if edge_transform_cols is not None:
+            m = apply_edge_transform(
+                xp, m, w, edge_transform, edge_transform_cols
+            )
+        else:
+            w_ = w[:, :, None] if m.ndim == 3 else w
+            if edge_transform == EdgeTransform.MUL_WEIGHT:
+                m = m * w_
+            elif edge_transform == EdgeTransform.ADD_WEIGHT:
+                m = m + w_
+        if valid is not None:
+            valid_ = valid[:, :, None] if m.ndim == 3 else valid
+            m = xp.where(valid_ > 0, m, identity)
+        return m
+
+    parts = []
+    for entry, (d, cap) in zip(pack.torso, pack.torso_meta):
+        m = flat_take(xp, msgs_ext, entry["idx"])  # (rows, d[, k])
+        m = transform(m, entry.get("w"), None)
+        if cap > d:
+            # in-kernel identity pad up to the pow2 tree width: same
+            # leaves as the ELL bucket's sentinel slots, never gathered
+            fill = xp.full(
+                (m.shape[0], cap - d) + tuple(m.shape[2:]), identity,
+                dtype=m.dtype,
+            )
+            m = xp.concatenate([m, fill], axis=1)
+        parts.append(tree_reduce(xp, m, op))
+
+    if pack.num_zero:
+        parts.append(
+            xp.full(
+                (pack.num_zero,) + tuple(msgs.shape[1:]), identity,
+                dtype=msgs.dtype,
+            )
+        )
+
+    for entry, (cap, ppr, rows, num_slots) in zip(pack.tail, pack.tail_meta):
+        m = flat_take(xp, msgs_ext, entry["idx"])  # (chunks, T[, k])
+        m = transform(m, entry.get("w"), entry.get("valid"))
+        part = tree_reduce(xp, m, op)  # (chunks[, k]) — aligned subtrees
+        tab_shape = (rows * ppr,) + tuple(part.shape[1:])
+        if _is_jax(xp):
+            table = xp.full(tab_shape, identity, dtype=part.dtype)
+            table = table.at[entry["slot"]].set(part)
+        else:
+            table = xp.full(tab_shape, identity, dtype=part.dtype)
+            table[entry["slot"]] = part
+        # remaining upper tree levels: fold the per-row partial vector
+        table = table.reshape((rows, ppr) + tuple(part.shape[1:]))
+        r = tree_reduce(xp, table, op)
+        rowseg = entry.get("rowseg")
+        if rowseg is not None:
+            r = _segment_combine(xp, op, r, rowseg, num_slots)
+        parts.append(r)
+
+    if not parts:
+        return xp.full(msgs.shape, identity, dtype=msgs.dtype)
+    stacked = xp.concatenate(parts, axis=0)
     return stacked[pack.unpermute]
 
 

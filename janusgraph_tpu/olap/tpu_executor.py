@@ -188,11 +188,17 @@ class TPUExecutor:
     janusgraph_tpu/parallel/.
 
     `strategy` selects the aggregation kernel (janusgraph_tpu/olap/kernels.py):
-      - "ell"     degree-bucketed ELLPACK gather + dense reduce (default;
-                  scatter-free, all monoids)
+      - "ell"     degree-bucketed ELLPACK gather + dense reduce
+                  (scatter-free, all monoids)
+      - "hybrid"  exact-width ELL torso + chunked CSR tail for hubs
+                  (bitwise-equal to "ell", pad ratio ~1)
       - "segment" XLA gather + segment-reduce
       - "pallas"  Pallas sorted-segment-sum kernel (SUM monoid; other
                   monoids fall back to "ell")
+      - "auto"    (default) the profiler-driven autotuner picks among
+                  ell/hybrid/segment from the degree histogram + device
+                  roofline (olap/autotune.py; decision recorded in
+                  run_info["autotune"])
     """
 
     def __init__(
@@ -209,6 +215,11 @@ class TPUExecutor:
         frontier_f_min: int = None,
         frontier_e_min: int = None,
         frontier_tier_growth: int = None,
+        autotune: bool = None,
+        hub_cutoff: int = None,
+        tail_chunk: int = None,
+        autotune_min_gain: float = None,
+        autotune_max_tiers: int = None,
     ):
         import jax
         import jax.numpy as jnp
@@ -220,8 +231,17 @@ class TPUExecutor:
         self.g = _DeviceGraph(csr, jnp)
         if strategy == "auto" and use_pallas:
             strategy = "pallas"
-        if strategy not in ("auto", "ell", "segment", "pallas"):
+        if strategy not in ("auto", "ell", "hybrid", "segment", "pallas"):
             raise ValueError(f"unknown aggregation strategy: {strategy!r}")
+        # computer.autotune-* — the profiler-driven tuner behind "auto"
+        # (olap/autotune.py); explicit strategies bypass it but are still
+        # recorded as a source="config" decision
+        self._autotune_enabled = True if autotune is None else bool(autotune)
+        self._hub_cutoff_cfg = hub_cutoff or None
+        self._tail_chunk_cfg = tail_chunk or None
+        self._autotune_min_gain = autotune_min_gain
+        self._autotune_max_tiers = autotune_max_tiers
+        self._autotune_decisions: Dict[bool, object] = {}
         if frontier not in ("auto", "off", "always"):
             raise ValueError(f"unknown frontier mode: {frontier!r}")
         # Frontier-compacted SSSP/BFS/CC (olap/frontier.py): the program
@@ -282,6 +302,7 @@ class TPUExecutor:
         # + identities BEFORE the first compiled dispatch)
         self._metric_ops: Dict[Tuple, Dict[str, str]] = {}
         self._ell_packs: Dict[bool, object] = {}
+        self._hybrid_packs: Dict[bool, object] = {}
         self._channel_packs: "OrderedDict" = OrderedDict()
         self._segsum_plans: Dict[str, object] = {}
 
@@ -321,11 +342,55 @@ class TPUExecutor:
     ELL_AUTO_BYTES = 6 << 30
     ELL_AUTO_PAD = 3.0
 
+    def _device_kind(self) -> str:
+        return getattr(self.jax.devices()[0], "device_kind", "cpu")
+
+    def _autotune_overrides(self) -> dict:
+        """The computer.autotune-* / legacy-budget knobs, in the tuner's
+        override vocabulary (None entries mean 'search')."""
+        return {
+            "hub_cutoff": self._hub_cutoff_cfg,
+            "tail_chunk": self._tail_chunk_cfg,
+            "min_gain": self._autotune_min_gain,
+            "budget_bytes": self.ELL_AUTO_BYTES,
+            "max_pad": self.ELL_AUTO_PAD,
+            "f_min": self._frontier_f_min,
+            "e_min": self._frontier_e_min,
+            "max_tiers": self._autotune_max_tiers,
+            "tier_growth": self._frontier_tier_growth,
+        }
+
+    def _autotune(self, undirected: bool, measured: dict = None):
+        """The (cached) AutotuneDecision for one edge view. Deterministic
+        given (graph stats, device kind, config): olap/autotune.decide."""
+        decision = self._autotune_decisions.get(undirected)
+        if decision is not None and measured is None:
+            return decision
+        from janusgraph_tpu.olap import autotune
+
+        stats = autotune.GraphStats.from_csr(
+            self.csr, undirected=undirected,
+            max_capacity=self.ell_max_capacity or (1 << 14),
+            tail_chunk=self._tail_chunk_cfg or 256,
+        )
+        ov = self._autotune_overrides()
+        if self._strategy_cfg != "auto":
+            ov["strategy"] = self._strategy_cfg
+        decision = autotune.decide(
+            stats, self._device_kind(), overrides=ov, measured=measured
+        )
+        self._autotune_decisions[undirected] = decision
+        return decision
+
     def _auto_strategy(self, undirected: bool) -> str:
-        """ELL (scatter-free, fastest) while its padded footprint is within
-        budget; fall back to the flat segment-reduce path otherwise
-        (VERDICT r2: auto previously picked ELL unconditionally with no
-        HBM/size heuristic)."""
+        """'auto' resolution. With the tuner enabled (the default) this is
+        the autotune decision — strategy chosen against the device roofline
+        from the degree histogram (ISSUE 6 closes the PR 5 loop); the
+        legacy footprint-budget heuristic remains as the fallback when
+        computer.autotune=false (VERDICT r2 shape: ELL within budget,
+        segment otherwise)."""
+        if self._autotune_enabled:
+            return self._autotune(undirected).strategy
         fp = self.ell_footprint(
             self.csr, self.ell_max_capacity or (1 << 14), undirected
         )
@@ -375,6 +440,42 @@ class TPUExecutor:
             if self.ell_max_capacity
             else {}
         )
+
+    def _hybrid_pack(self, undirected: bool):
+        """HybridPack for one edge view, with the tuner's (or configured)
+        hub cutoff + tail chunk. Built and device-put once, like the ELL
+        pack."""
+        from janusgraph_tpu.olap.kernels import HybridPack
+
+        pack = self._hybrid_packs.get(undirected)
+        if pack is None:
+            d = self._autotune(undirected)
+            cutoff = self._hub_cutoff_cfg or d.hub_cutoff or 512
+            chunk = self._tail_chunk_cfg or d.tail_chunk or 256
+            csr = self.csr
+            src = csr.in_src.astype(np.int64)
+            dst = _segment_ids(csr.in_indptr, csr.num_edges).astype(np.int64)
+            w = csr.in_edge_weight
+            if undirected:
+                src = np.concatenate([src, csr.out_dst.astype(np.int64)])
+                dst = np.concatenate([
+                    dst,
+                    _segment_ids(csr.out_indptr, csr.num_edges).astype(
+                        np.int64
+                    ),
+                ])
+                w = (
+                    np.concatenate([w, csr.out_edge_weight])
+                    if w is not None
+                    else None
+                )
+            pack = HybridPack(
+                src, dst, w, csr.num_vertices,
+                hub_cutoff=cutoff, tail_chunk=chunk, **self._ell_kwargs(),
+            )
+            pack.device_put(self.jnp)
+            self._hybrid_packs[undirected] = pack
+        return pack
 
     #: distinct EdgeChannel views kept device-resident at once; a long-lived
     #: executor answering ad-hoc traverse() queries would otherwise
@@ -440,6 +541,8 @@ class TPUExecutor:
         )
         if strategy == "ell":
             self._ell_pack(program.undirected)
+        elif strategy == "hybrid":
+            self._hybrid_pack(program.undirected)
         elif strategy == "pallas":
             self._segsum_plan("in")
             if program.undirected:
@@ -478,6 +581,8 @@ class TPUExecutor:
         if strategy == "ell":
             args["ell"] = self._pack_args(pack)
             args["unpermute"] = pack.unpermute
+        elif strategy == "hybrid":
+            args["hyb"] = self._hybrid_args(pack)
         if state is None:
             # cold discovery (direct _graph_args call before any run):
             # setup just to learn the state/metric pytree shapes
@@ -520,6 +625,17 @@ class TPUExecutor:
             buckets.append(b)
         return buckets
 
+    @staticmethod
+    def _hybrid_args(pack):
+        """The hybrid pack's array pytree (shipped as jit arguments, like
+        _pack_args for ELL — closing over the arrays would constant-fold
+        them into the module)."""
+        return {
+            "torso": [dict(b) for b in pack.torso],
+            "tail": [dict(b) for b in pack.tail],
+            "unpermute": pack.unpermute,
+        }
+
     def _graph_args(self, program: VertexProgram, op: str, channel: str = None):
         """The device-array pytree a compiled superstep consumes as an
         ARGUMENT. Closing over device arrays would embed them as constants
@@ -540,6 +656,8 @@ class TPUExecutor:
         if strategy == "ell":
             args["ell"] = self._pack_args(pack)
             args["unpermute"] = pack.unpermute
+        elif strategy == "hybrid":
+            args["hyb"] = self._hybrid_args(pack)
         self._last_arg_bytes = _pytree_nbytes(args)
         return args
 
@@ -555,6 +673,8 @@ class TPUExecutor:
             pack = self._channel_pack(program, channel)
         elif strategy == "ell":
             pack = self._ell_pack(program.undirected)
+        elif strategy == "hybrid":
+            pack = self._hybrid_pack(program.undirected)
         return strategy, pack
 
     def _superstep_body(self, program: VertexProgram, op: str, channel: str = None):
@@ -576,6 +696,8 @@ class TPUExecutor:
         elif strategy == "ell":
             bucket_slots = [b[4] for b in pack_meta.buckets]
             has_weight = pack_meta.has_weight
+        # "hybrid": pack_meta (the HybridPack) is captured for its STATIC
+        # metadata only (bucket widths/rows); arrays arrive via gargs
 
         def aggregate(outgoing, src_idx, dst_seg, weight):
             msgs = apply_edge_transform(
@@ -617,6 +739,17 @@ class TPUExecutor:
                 )
                 agg = ell_aggregate(
                     jnp, pv, outgoing, op, program.edge_transform,
+                    program.edge_transform_cols,
+                )
+            elif strategy == "hybrid":
+                from janusgraph_tpu.olap.kernels import (
+                    HybridPackView,
+                    hybrid_aggregate,
+                )
+
+                hv = HybridPackView(gargs["hyb"], pack_meta)
+                agg = hybrid_aggregate(
+                    jnp, hv, outgoing, op, program.edge_transform,
                     program.edge_transform_cols,
                 )
             elif strategy == "pallas" and outgoing.ndim == 1:
@@ -896,12 +1029,34 @@ class TPUExecutor:
         )
         undirected = bool(getattr(program, "undirected", False))
         pad_ratio = None
+        strategy_resolved = None
+        hyb = self._hybrid_packs.get(undirected)
         pack = self._ell_packs.get(undirected)
-        if pack is not None:
+        edges = self.csr.num_edges * (2 if undirected else 1)
+        if hyb is not None:
+            pad_ratio = round(hyb.pad_ratio, 4)
+            strategy_resolved = "hybrid"
+        elif pack is not None:
             slots = sum(int(b[0].size) for b in pack.buckets)
-            edges = self.csr.num_edges * (2 if undirected else 1)
             pad_ratio = round(slots / max(1, edges), 4)
+            strategy_resolved = "ell"
+        # active pack's pad (legacy key name kept — every BENCH round since
+        # r01 tracks it); `pad_ratio` is the strategy-neutral alias
         info["ell_pad_ratio"] = pad_ratio
+        info["pad_ratio"] = pad_ratio
+        if strategy_resolved is not None:
+            info["strategy_resolved"] = strategy_resolved
+        # the tuner's decision travels with every run record (bench +
+        # /telemetry read it from here); explicit strategies still record
+        # a source="config" decision for provenance
+        decision = self._autotune_decisions.get(undirected)
+        if decision is None and self._autotune_enabled:
+            try:
+                decision = self._autotune(undirected)
+            except Exception:  # noqa: BLE001 - recording must not fail a run
+                decision = None
+        if decision is not None:
+            info["autotune"] = decision.as_dict()
 
         records = info.get("superstep_records")
         if records is None:
@@ -1139,6 +1294,10 @@ class TPUExecutor:
         )
 
         if self._frontier_engine is None:
+            if self._autotune_enabled:
+                # the tier-schedule half of the decision: computed before
+                # the engine snapshots it (aggregation half unused here)
+                self._autotune(False)
             self._frontier_engine = FrontierEngine(self)
         t0 = time.perf_counter()
         if type(program) is ConnectedComponentsProgram:

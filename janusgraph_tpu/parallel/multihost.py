@@ -77,7 +77,27 @@ def init_multihost(
             "multi-host run needs a coordinator address "
             "(JAX_COORDINATOR_ADDRESS or coordinator_address=)"
         )
+    # fail the init fast (and say which spelling resolved) if this jax has
+    # no usable shard_map — every sharded program compiled after distributed
+    # init goes through the compat shim, so a broken resolution should
+    # surface here, not at the first superstep compile on every host
+    from janusgraph_tpu.parallel.compat import resolve_shard_map
+
+    resolve_shard_map()
     import jax
+
+    # CPU multi-process needs an explicit cross-host collectives transport:
+    # without one, the first sharded device_put/psum dies with
+    # "Multiprocess computations aren't implemented on the CPU backend".
+    # Pick gloo (shipped in this jaxlib) unless the operator already chose;
+    # harmless on TPU runs, which ride ICI/DCN and ignore the CPU setting.
+    try:
+        if jax.config.values.get(
+            "jax_cpu_collectives_implementation"
+        ) in (None, "none"):
+            jax.config.update("jax_cpu_collectives_implementation", "gloo")
+    except Exception:  # unknown option on this jax: leave defaults alone
+        pass
 
     jax.distributed.initialize(
         coordinator_address=coordinator_address,

@@ -863,7 +863,7 @@ class ShardedExecutor:
             return self._compiled[key]
 
         import jax
-        from jax import shard_map
+        from janusgraph_tpu.parallel.compat import shard_map
 
         body = self._shard_body(program, op, sc)
         sharded_spec, rep = self._specs()
@@ -896,7 +896,7 @@ class ShardedExecutor:
 
         import jax
         import jax.numpy as jnp
-        from jax import shard_map
+        from janusgraph_tpu.parallel.compat import shard_map
 
         body = self._shard_body(program, op, sc)
 

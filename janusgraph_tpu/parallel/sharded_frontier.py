@@ -92,7 +92,7 @@ class ShardedFrontierEngine:
             return cache[key]
         import jax
         import jax.numpy as jnp
-        from jax import shard_map
+        from janusgraph_tpu.parallel.compat import shard_map
         from jax.sharding import PartitionSpec as P
 
         axis = self.axis
@@ -139,7 +139,7 @@ class ShardedFrontierEngine:
             return cache[key]
         import jax
         import jax.numpy as jnp
-        from jax import shard_map
+        from janusgraph_tpu.parallel.compat import shard_map
         from jax.sharding import PartitionSpec as P
 
         axis = self.axis

@@ -1,0 +1,20 @@
+"""JG301/JG302 fixture: hybrid-tail padding invariants (parse-only).
+
+The hybrid format's tail path cuts hub edge ranges into static
+`tail_chunk`-wide tiers; a non-pow2 chunk width breaks the aligned-subtree
+bitwise contract, and a bare-literal sentinel fill drifts from the packer.
+"""
+import numpy as np
+
+
+def build_tail(rows, degs, sentinel):
+    tail_chunk = 100  # expect: JG301
+    chunk_width = 3 * 64  # expect: JG301
+    good_chunk = 128
+    idx = np.full((rows, good_chunk), 4096, dtype=np.int32)  # expect: JG302
+    ok = np.full((rows, good_chunk), sentinel, dtype=np.int32)
+    return tail_chunk, chunk_width, idx, ok
+
+
+def split_tail(starts, degs, t_chunk=48):  # expect: JG301
+    return starts // t_chunk, degs % t_chunk

@@ -134,7 +134,7 @@ STRATEGY_PROGRAMS = [
 ]
 
 
-@pytest.mark.parametrize("strategy", ["ell", "pallas"])
+@pytest.mark.parametrize("strategy", ["ell", "hybrid", "pallas"])
 @pytest.mark.parametrize(
     "name,make", STRATEGY_PROGRAMS, ids=[p[0] for p in STRATEGY_PROGRAMS]
 )

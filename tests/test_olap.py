@@ -209,22 +209,29 @@ def test_compute_api_and_write_back(gods_graph):
 
 
 def test_ell_auto_strategy_budget():
-    """auto picks ELL within budget, segment when ELL padding blows up
-    (e.g. huge vertex sets with almost no edges: every empty row still
-    costs one ELL slot)."""
+    """auto resolution: the tuner picks a packed layout whose padding is
+    actually bounded (ELL on a uniform chain; HYBRID when ELL's empty-row
+    slots blow the pad up — zero-degree vertices cost hybrid nothing);
+    computer.autotune=false falls back to the legacy budget heuristic
+    (ELL within budget, segment past it)."""
     from janusgraph_tpu.olap import csr_from_edges
     from janusgraph_tpu.olap.tpu_executor import TPUExecutor
 
     dense = csr_from_edges(100, np.arange(99), np.arange(1, 100))
     fp = TPUExecutor.ell_footprint(dense)
     assert fp["pad_ratio"] <= 2.0
-    assert TPUExecutor(dense).strategy == "ell"
+    assert TPUExecutor(dense).strategy in ("ell", "hybrid")
 
     sparse = csr_from_edges(50_000, [0, 1], [1, 2])
     fp = TPUExecutor.ell_footprint(sparse)
     assert fp["pad_ratio"] > 3.0
-    assert TPUExecutor(sparse).strategy == "segment"
-    # explicit strategy always wins over the heuristic
+    ex = TPUExecutor(sparse)
+    assert ex.strategy == "hybrid"
+    assert ex._autotune(False).pad_ratio_est < 1.5
+    # the legacy heuristic (no tuner) keeps its old segment fallback
+    assert TPUExecutor(sparse, autotune=False).strategy == "segment"
+    assert TPUExecutor(dense, autotune=False).strategy == "ell"
+    # explicit strategy always wins over either heuristic
     assert TPUExecutor(sparse, strategy="ell").strategy == "ell"
 
 
