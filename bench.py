@@ -826,6 +826,70 @@ def _bench_scale(
             post=lambda res: {"paths": float(np.asarray(res["count"]).sum())},
         )
         del lex, lcsr
+
+    # dense-feature tier stage (ISSUE 7, optional: BENCH_DENSE=1): the
+    # 2-layer GCN forward — a fused gather->aggregate->matmul superstep —
+    # at the extras rung, with the per-superstep MXU accounting and a
+    # same-round ELL vs hybrid A/B so the artifact carries both layouts'
+    # measured pad + wall for the [n, d] message class
+    if scale == extras_scale and os.environ.get("BENCH_DENSE", "0") == "1":
+        from janusgraph_tpu.olap.programs import GCNForwardProgram
+
+        d_dim = int(os.environ.get("BENCH_DENSE_DIM", "32"))
+        layers = int(os.environ.get("BENCH_DENSE_LAYERS", "2"))
+        mk = lambda: GCNForwardProgram(  # noqa: E731
+            feature_dim=d_dim, hidden_dim=d_dim, out_dim=d_dim,
+            num_layers=layers,
+        )
+        dense_ab = {}
+        dense_mxu = {}
+        dense_steps = []
+        for strat in ("ell", "hybrid"):
+            ex_d = TPUExecutor(csr, strategy=strat)
+            ex_d.run(mk())  # compile + warm
+            d0 = time.perf_counter()
+            out_d = ex_d.run(mk(), sync_every=layers)
+            jax.block_until_ready(out_d["h"])
+            d_s = time.perf_counter() - d0
+            inf = ex_d.last_run_info
+            dense_ab[strat] = {
+                "superstep_ms": round(1000.0 * d_s / layers, 3),
+                "pad_ratio": inf.get("pad_ratio"),
+                "mxu_utilization_mean": (
+                    (inf.get("mxu") or {}).get("mean_utilization")
+                ),
+            }
+            if strat == "hybrid":
+                dense_mxu = inf.get("mxu") or {}
+                dense_steps = [
+                    {
+                        k: r.get(k)
+                        for k in ("step", "wall_ms", "mxu_flops",
+                                  "mxu_utilization",
+                                  "roofline_utilization")
+                    }
+                    for r in inf.get("superstep_records", [])[:16]
+                ]
+            _hb(f"s{scale}: dense-gcn {strat} {d_s:.3f}s "
+                f"(pad {dense_ab[strat]['pad_ratio']})", t0)
+            del ex_d, out_d
+        e_ms = dense_ab["ell"]["superstep_ms"]
+        h_ms = dense_ab["hybrid"]["superstep_ms"]
+        _emit({
+            "stage": "dense_gcn",
+            "platform": platform,
+            "scale": scale,
+            "feature_dim": d_dim,
+            "num_layers": layers,
+            "gcn_superstep_ms": h_ms,
+            "mxu": dense_mxu,
+            "superstep_records": dense_steps,
+            "ab": {
+                "ell": dense_ab["ell"],
+                "hybrid": dense_ab["hybrid"],
+                "hybrid_speedup": round(e_ms / max(h_ms, 1e-9), 3),
+            },
+        })
     del ex, csr
 
 

@@ -86,6 +86,11 @@ RULES: Dict[str, Rule] = {
         Rule("JG303", SEV_ERROR,
              "data-dependent output shape inside a jit context "
              "(nonzero/unique/1-arg where without size=)"),
+        Rule("JG304", SEV_ERROR,
+             "feature-dim padding tier is not a power of two (dense-tier "
+             "feature blocks pad to pow2 lane tiers so tree_dot/"
+             "tree_matmul contractions are complete trees and rows stay "
+             "VPU/MXU lane-aligned; 0 means auto-pick)"),
     ]
 }
 

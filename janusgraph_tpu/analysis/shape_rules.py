@@ -18,6 +18,14 @@ JG303  data-dependent output shapes inside a jit context: `nonzero`/
        `unique`/`argwhere`/`flatnonzero` without `size=`, or one-argument
        `where` — all fail under jit or force a host round-trip; fixed-shape
        kernels must take a static capacity and pad.
+JG304  feature-dim padding tiers (`d_pad`/`*_dim_pad`/`dim_tier`/
+       `*_dim_tier`/`feature_tier`/`lane_width`/`lane_tier`) must be
+       power-of-two integer literals (or 0 = auto-pick). The dense-feature
+       tier pads [n, d] blocks to pow2 lane tiers (FEATURE_TIERS) so the
+       SDDMM tree-dot and dense-transform tree-matmul contract over
+       complete adjacent-pair trees (the bitwise contract) and rows stay
+       VPU/MXU lane-aligned — a non-pow2 padded width raises at runtime in
+       tree_dot/tree_matmul and silently mis-tiles before it gets there.
 """
 
 from __future__ import annotations
@@ -32,6 +40,15 @@ from janusgraph_tpu.analysis.tracing import find_traced_defs, terminal_name
 _CAP_NAME_RE = re.compile(
     r"^[ef]_?(cap|min)$|_cap$|_capacity$|^max_edges$|^max_capacity$"
     r"|_chunk$|^chunk_width$|^tail_chunk$",
+    re.IGNORECASE,
+)
+
+#: dense-tier padded feature-dim names. The LOGICAL dim (feature_dim,
+#: hidden_dim, ...) may be any value — only the PADDED tier the kernels
+#: consume must be a lane-width pow2 (0 = auto-pick, allowed).
+_FEATURE_TIER_RE = re.compile(
+    r"^d_pad$|_dim_pad$|^dim_tier$|_dim_tier$|^feature_tier$"
+    r"|^lane_width$|^lane_tier$",
     re.IGNORECASE,
 )
 
@@ -76,6 +93,20 @@ def _check_capacity_tiers(mod) -> List[Finding]:
     out: List[Finding] = []
 
     def check(name: str, value_node: ast.AST, where: ast.AST):
+        if _FEATURE_TIER_RE.search(name):
+            v = _const_int(value_node)
+            # 0 = auto-pick (pick_feature_tier walks the FEATURE_TIERS
+            # ladder); only an explicit non-pow2 tier is the bug
+            if v is None or v == 0 or _is_pow2(v):
+                return
+            out.append(_finding(
+                "JG304", mod, where,
+                f"feature-dim padding tier `{name}` = {v} is not a power "
+                f"of two — dense-tier feature blocks pad to pow2 lane "
+                f"tiers so tree_dot/tree_matmul reduce complete trees "
+                f"(use 0 to auto-pick from FEATURE_TIERS)",
+            ))
+            return
         if not _CAP_NAME_RE.search(name):
             return
         v = _const_int(value_node)

@@ -414,6 +414,31 @@ COMPUTER_NS.option(
     Mutability.MASKABLE, lambda v: v >= 2,
 )
 COMPUTER_NS.option(
+    "autotune-persist", bool,
+    "serialize the last measured autotune record next to the checkpoint "
+    "file (<checkpoint-path>.autotune.json) and feed it back into "
+    "decide() on the next executor lifetime, so achieved-bandwidth "
+    "calibration survives process restarts (needs computer."
+    "checkpoint-path; olap/autotune.save_measured/load_measured)", True,
+    Mutability.MASKABLE,
+)
+COMPUTER_NS.option(
+    "features-dim-tier", int,
+    "forced padded feature-dim lane tier for dense-feature programs "
+    "(power of two >= the program's logical feature dim; 0 = pick the "
+    "smallest FEATURE_TIERS entry that fits; olap/features/kernels."
+    "pick_feature_tier)", 0,
+    Mutability.MASKABLE, lambda v: v >= 0 and (v & (v - 1)) == 0,
+)
+COMPUTER_NS.option(
+    "features-native-matmul", bool,
+    "use the backend's native dot (the MXU path) for dense-feature "
+    "programs' dense transforms instead of the deterministic tree "
+    "contraction — peak matmul throughput at the cost of the "
+    "cross-executor bitwise guarantee (olap/features/kernels."
+    "tree_matmul)", False, Mutability.MASKABLE,
+)
+COMPUTER_NS.option(
     "ell-max-capacity", int,
     "ELL bucket capacity cap; larger degrees row-split (supernode bound)",
     1 << 14, Mutability.MASKABLE, lambda v: v >= 8,
@@ -931,6 +956,13 @@ METRICS_NS.option(
     "roofline-peak-bytes-per-s", float,
     "peak device memory bandwidth in bytes/s for the roofline model "
     "(0 = auto-detect from the device kind)", 0.0,
+    Mutability.LOCAL, lambda v: v >= 0,
+)
+METRICS_NS.option(
+    "roofline-peak-mxu-flops", float,
+    "peak dense-matmul (MXU systolic array) flops/s — the denominator of "
+    "the dense-feature tier's per-superstep mxu_utilization (0 = "
+    "auto-detect from the device kind)", 0.0,
     Mutability.LOCAL, lambda v: v >= 0,
 )
 METRICS_NS.option(

@@ -388,6 +388,7 @@ class JanusGraphTPU:
         _profiler.configure_roofline(
             peak_flops=cfg.get("metrics.roofline-peak-flops"),
             peak_bytes_per_s=cfg.get("metrics.roofline-peak-bytes-per-s"),
+            peak_mxu_flops=cfg.get("metrics.roofline-peak-mxu-flops"),
         )
         if cfg.get("metrics.structured-logging"):
             import sys as _sys

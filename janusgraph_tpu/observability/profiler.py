@@ -353,37 +353,49 @@ digest_table = DigestTable()
 # Roofline cost model
 # --------------------------------------------------------------------------
 
-#: (device_kind substring, peak flops/s, peak HBM bytes/s). Order matters:
-#: first match wins. Conservative public figures; override exactly via
-#: metrics.roofline-peak-flops / metrics.roofline-peak-bytes-per-s.
-_DEVICE_PEAKS: Tuple[Tuple[str, float, float], ...] = (
-    ("v5e", 197e12, 819e9),
-    ("v5p", 459e12, 2765e9),
-    ("v4", 275e12, 1228e9),
-    ("v3", 123e12, 900e9),
-    ("v2", 45e12, 700e9),
+#: (device_kind substring, peak flops/s, peak HBM bytes/s, peak MXU
+#: flops/s). Order matters: first match wins. Conservative public figures;
+#: override exactly via metrics.roofline-peak-flops /
+#: metrics.roofline-peak-bytes-per-s / metrics.roofline-peak-mxu-flops.
+#: The MXU column is the dense-matmul (systolic-array) ceiling the
+#: dense-feature tier's `mxu_utilization` divides by — the TPU marketing
+#: numbers ARE the MXU peaks, so those columns coincide; CPU gets a
+#: modest BLAS-class figure so the ratio stays meaningful on every
+#: backend (relative shape, not absolute truth).
+_DEVICE_PEAKS: Tuple[Tuple[str, float, float, float], ...] = (
+    ("v5e", 197e12, 819e9, 197e12),
+    ("v5p", 459e12, 2765e9, 459e12),
+    ("v4", 275e12, 1228e9, 275e12),
+    ("v3", 123e12, 900e9, 123e12),
+    ("v2", 45e12, 700e9, 45e12),
     # CPU fallback: a generous server-class core count; the point on CPU
     # is the RELATIVE utilization shape, not absolute truth
-    ("cpu", 5e11, 5e10),
+    ("cpu", 5e11, 5e10, 1e11),
 )
 
-_ROOFLINE_OVERRIDE = {"peak_flops": 0.0, "peak_bytes_per_s": 0.0}
+_ROOFLINE_OVERRIDE = {
+    "peak_flops": 0.0, "peak_bytes_per_s": 0.0, "peak_mxu_flops": 0.0,
+}
 
 
 def configure_roofline(
     peak_flops: Optional[float] = None,
     peak_bytes_per_s: Optional[float] = None,
+    peak_mxu_flops: Optional[float] = None,
 ) -> None:
     """Operator override of the device-peak table (0 = auto-detect)."""
     if peak_flops is not None:
         _ROOFLINE_OVERRIDE["peak_flops"] = float(peak_flops)
     if peak_bytes_per_s is not None:
         _ROOFLINE_OVERRIDE["peak_bytes_per_s"] = float(peak_bytes_per_s)
+    if peak_mxu_flops is not None:
+        _ROOFLINE_OVERRIDE["peak_mxu_flops"] = float(peak_mxu_flops)
 
 
 def device_peaks(device_kind: Optional[str] = None) -> dict:
-    """{peak_flops, peak_bytes_per_s, device_kind, source} for the current
-    (or named) device. Host-side metadata only — no device sync."""
+    """{peak_flops, peak_bytes_per_s, peak_mxu_flops, device_kind, source}
+    for the current (or named) device. Host-side metadata only — no
+    device sync."""
     if device_kind is None:
         try:
             import jax
@@ -392,21 +404,27 @@ def device_peaks(device_kind: Optional[str] = None) -> dict:
         except Exception:  # noqa: BLE001 - jax may be absent/uninitialized
             device_kind = "cpu"
     kind = (device_kind or "cpu").lower()
-    flops, bw, source = 0.0, 0.0, "default"
-    for sub, pf, pb in _DEVICE_PEAKS:
+    flops, bw, mxu, source = 0.0, 0.0, 0.0, "default"
+    for sub, pf, pb, pm in _DEVICE_PEAKS:
         if sub in kind:
-            flops, bw, source = pf, pb, f"table:{sub}"
+            flops, bw, mxu, source = pf, pb, pm, f"table:{sub}"
             break
     if not flops:
-        flops, bw = _DEVICE_PEAKS[-1][1], _DEVICE_PEAKS[-1][2]
+        flops, bw, mxu = (
+            _DEVICE_PEAKS[-1][1], _DEVICE_PEAKS[-1][2], _DEVICE_PEAKS[-1][3]
+        )
     if _ROOFLINE_OVERRIDE["peak_flops"]:
         flops, source = _ROOFLINE_OVERRIDE["peak_flops"], "config"
     if _ROOFLINE_OVERRIDE["peak_bytes_per_s"]:
         bw = _ROOFLINE_OVERRIDE["peak_bytes_per_s"]
         source = "config"
+    if _ROOFLINE_OVERRIDE["peak_mxu_flops"]:
+        mxu = _ROOFLINE_OVERRIDE["peak_mxu_flops"]
+        source = "config"
     return {
         "peak_flops": flops,
         "peak_bytes_per_s": bw,
+        "peak_mxu_flops": mxu,
         "device_kind": device_kind,
         "source": source,
     }
@@ -514,6 +532,34 @@ def attach_roofline(records: List[dict], cost: dict, peaks: dict) -> dict:
             ),
         }
     return out
+
+
+def attach_mxu(records: List[dict], mxu_flops: float, peaks: dict) -> dict:
+    """Stamp per-superstep records with the dense tier's MXU accounting:
+    ``mxu_flops`` (matmul-attributable flops per superstep — dense layers
+    + sddmm dots, from the program's ``matmul_flops``) and
+    ``mxu_utilization`` (achieved matmul flops/s over the device's MXU
+    peak). Returns the run-level summary block (``run_info["mxu"]``)."""
+    peak = float(peaks.get("peak_mxu_flops") or 0.0)
+    utils = []
+    for r in records:
+        r["mxu_flops"] = mxu_flops
+        wall = r.get("wall_ms")
+        if not mxu_flops:
+            r["mxu_utilization"] = 0.0
+        elif wall and wall > 0 and peak > 0:
+            u = round((mxu_flops / (wall / 1e3)) / peak, 6)
+            r["mxu_utilization"] = u
+            utils.append(u)
+        else:
+            r["mxu_utilization"] = None
+    return {
+        "peak_mxu_flops": peak,
+        "per_superstep_flops": mxu_flops,
+        "mean_utilization": (
+            round(sum(utils) / len(utils), 6) if utils else None
+        ),
+    }
 
 
 # --------------------------------------------------------------------------

@@ -17,3 +17,7 @@ from janusgraph_tpu.olap.checkpoint import (  # noqa: F401
     load_checkpoint,
     save_checkpoint,
 )
+from janusgraph_tpu.olap.features import (  # noqa: F401
+    DenseVertexProgram,
+    MessageMode,
+)

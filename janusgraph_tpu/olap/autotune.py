@@ -135,6 +135,10 @@ class AutotuneDecision:
     device_kind: str
     source: str                       # model | config | measured+model
     modeled_ms: Dict[str, float] = field(default_factory=dict)
+    #: dense-feature tier input: the program's logical/padded feature dim
+    #: (0/None for scalar-message programs)
+    feature_dim: int = 0
+    feature_tier: Optional[int] = None
 
     def as_dict(self) -> dict:
         return {
@@ -146,6 +150,8 @@ class AutotuneDecision:
             "e_schedule": list(self.e_schedule),
             "device_kind": self.device_kind,
             "source": self.source,
+            "feature_dim": self.feature_dim,
+            "feature_tier": self.feature_tier,
             "modeled_ms": {
                 k: round(v, 4) for k, v in sorted(self.modeled_ms.items())
             },
@@ -185,22 +191,31 @@ _SEGMENT_PENALTY = {"tpu": 8.0, "cpu": 2.5}
 def _modeled_seconds(
     slots: int, n: int, weighted: bool, buckets: int, peaks: dict,
     penalty: float = 1.0, eff_bw: Optional[float] = None,
-    chunk_rows: int = 0, kind: str = "cpu",
+    chunk_rows: int = 0, kind: str = "cpu", cols: int = 1,
 ) -> float:
     """Roofline time model for one superstep of a packed aggregation: the
     binding constraint is max(bytes moved at peak-or-measured bandwidth,
     slots through the gather unit) — the classic two-ceiling roof with the
     gather wall as the second ceiling — plus per-bucket kernel overhead
-    and the tail's per-chunk scatter cost."""
+    and the tail's per-chunk scatter cost. ``cols`` is the message width
+    (1 for scalar programs; the padded feature tier for the dense tier —
+    each gathered slot moves a d-wide row and the output is (n, d))."""
+    cols = max(1, int(cols))
     bw = eff_bw or peaks["peak_bytes_per_s"]
-    byts = slots * _bytes_per_slot(weighted) + 4.0 * slots + 8.0 * n
+    byts = slots * _bytes_per_slot(weighted) + 4.0 * slots * cols + (
+        8.0 * n * cols
+    )
     t = max(
         penalty * byts / max(bw, 1.0),
         penalty * slots * _GATHER_COST_S[kind],
     )
-    t += slots / max(peaks["peak_flops"], 1.0)
+    t += slots * cols / max(peaks["peak_flops"], 1.0)
     t += buckets * _BUCKET_OVERHEAD_S
-    t += chunk_rows * _TAIL_CHUNK_COST_S[kind]
+    # the tail's partial-table scatter moves a cols-wide row per chunk, so
+    # its cost scales with the message width (measured r7: s16 d=32 GCN,
+    # hybrid 276.8 ms vs ELL 190.9 ms per superstep — the scatter term is
+    # what flips the winner for dense-feature runs)
+    t += chunk_rows * cols * _TAIL_CHUNK_COST_S[kind]
     return t
 
 
@@ -209,6 +224,7 @@ def decide(
     device_kind: str,
     overrides: Optional[dict] = None,
     measured: Optional[dict] = None,
+    feature_dim: int = 0,
 ) -> AutotuneDecision:
     """Pick (strategy, hub cutoff, tail chunk, tier schedules) for one
     graph + device. Pure function of its arguments — identical inputs give
@@ -233,6 +249,12 @@ def decide(
       table), folding real measurements into the next decision;
       ``roofline_by_tier`` utilizations refine the frontier ladder (tiers
       that measured near-zero utilization are pruned from the schedule).
+
+    feature_dim (the dense tier's input, 0 for scalar programs): the
+      padded lane tier (features/kernels.pick_feature_tier, or the
+      ``feature_dim_tier`` override) scales the modeled message traffic —
+      every gathered slot moves a d-wide row — and is recorded in the
+      decision as ``feature_tier``.
     """
     ov = dict(overrides or {})
     from janusgraph_tpu.observability import profiler
@@ -244,6 +266,16 @@ def decide(
     min_gain = float(ov.get("min_gain") if ov.get("min_gain") is not None
                      else 0.05)
     tail_chunk = int(ov.get("tail_chunk") or 256)
+    feature_dim = int(feature_dim or 0)
+    feature_tier = None
+    cols = 1
+    if feature_dim:
+        from janusgraph_tpu.olap.features.kernels import pick_feature_tier
+
+        feature_tier = pick_feature_tier(
+            feature_dim, int(ov.get("feature_dim_tier") or 0)
+        )
+        cols = feature_tier
 
     n, m = stats.num_vertices, stats.num_edges
     bps = _bytes_per_slot(stats.weighted)
@@ -253,7 +285,9 @@ def decide(
     source = "model"
     if measured and measured.get("superstep_ms") and measured.get("pad_ratio"):
         meas_slots = float(measured["pad_ratio"]) * m
-        meas_bytes = meas_slots * bps + 4.0 * meas_slots + 8.0 * n
+        meas_bytes = meas_slots * bps + 4.0 * meas_slots * cols + (
+            8.0 * n * cols
+        )
         eff_bw = meas_bytes / (float(measured["superstep_ms"]) / 1e3)
         source = "measured+model"
 
@@ -261,12 +295,13 @@ def decide(
     modeled: Dict[str, float] = {}
     modeled["segment"] = _modeled_seconds(
         m, n, stats.weighted, 1, peaks,
-        penalty=_SEGMENT_PENALTY[kind], eff_bw=eff_bw,
+        penalty=_SEGMENT_PENALTY[kind], eff_bw=eff_bw, cols=cols,
     )
     ell_buckets = max(1, len(stats.degree_hist))
     ell_pad = stats.ell_slots / max(1, m)
     modeled["ell"] = _modeled_seconds(
-        stats.ell_slots, n, stats.weighted, ell_buckets, peaks, eff_bw=eff_bw,
+        stats.ell_slots, n, stats.weighted, ell_buckets, peaks,
+        eff_bw=eff_bw, cols=cols,
     )
 
     forced_cutoff = int(ov.get("hub_cutoff") or 0) or None
@@ -279,7 +314,7 @@ def decide(
         t = _modeled_seconds(
             slots, n, stats.weighted,
             torso_buckets + (1 if hubs else 0), peaks, eff_bw=eff_bw,
-            chunk_rows=chunk_rows, kind=kind,
+            chunk_rows=chunk_rows, kind=kind, cols=cols,
         )
         if best is None or t < best[0]:
             best = (t, cutoff, slots)
@@ -323,6 +358,8 @@ def decide(
         e_schedule=e_sched,
         device_kind=device_kind or "cpu",
         source=source,
+        feature_dim=feature_dim,
+        feature_tier=feature_tier,
         modeled_ms={k: v * 1e3 for k, v in modeled.items()},
     )
 
@@ -400,3 +437,65 @@ def pick_tier(need: int, schedule: Tuple[int, ...], hi: int) -> int:
         if t >= need:
             return min(t, hi)
     return hi
+
+
+# --------------------------------------------------------------------------
+# Measured-record persistence (computer.autotune-persist)
+# --------------------------------------------------------------------------
+#
+# decide() accepts a prior run's `measured` record but nothing survived an
+# executor lifetime (ROADMAP #2 leftover). The executor now serializes the
+# last measured record next to the checkpoint file and loads it back on
+# the next run, so achieved-bandwidth calibration carries across process
+# restarts the same way checkpoints carry state.
+
+_MEASURED_VERSION = 1
+
+
+def save_measured(path: str, record: dict) -> None:
+    """Atomically persist one measured record (tmp + rename, like the
+    checkpoint writer). Persistence must never fail a run — any I/O error
+    is swallowed (the next run simply decides from the model alone)."""
+    import json
+    import os
+    import tempfile
+
+    payload = {"version": _MEASURED_VERSION}
+    payload.update({
+        k: record.get(k)
+        for k in ("strategy", "pad_ratio", "superstep_ms", "roofline_by_tier")
+    })
+    try:
+        d = os.path.dirname(os.path.abspath(path)) or "."
+        os.makedirs(d, exist_ok=True)
+        fd, tmp = tempfile.mkstemp(dir=d, suffix=".json.tmp")
+        try:
+            with os.fdopen(fd, "w") as f:
+                json.dump(payload, f)
+            os.replace(tmp, path)
+        except BaseException:
+            if os.path.exists(tmp):
+                os.unlink(tmp)
+            raise
+    except OSError:
+        return
+
+
+def load_measured(path: str) -> Optional[dict]:
+    """Load a persisted measured record; None when missing, unreadable,
+    from a different version, or not carrying the calibration fields."""
+    import json
+    import os
+
+    if not path or not os.path.exists(path):
+        return None
+    try:
+        with open(path) as f:
+            rec = json.load(f)
+    except (OSError, ValueError):
+        return None
+    if not isinstance(rec, dict) or rec.get("version") != _MEASURED_VERSION:
+        return None
+    if not rec.get("superstep_ms") or not rec.get("pad_ratio"):
+        return None
+    return rec

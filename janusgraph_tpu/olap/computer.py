@@ -268,11 +268,20 @@ class GraphComputer:
                 "tail_chunk": cfg.get("computer.autotune-tail-chunk"),
                 "autotune_min_gain": cfg.get("computer.autotune-min-gain"),
                 "autotune_max_tiers": cfg.get("computer.autotune-max-tiers"),
+                "autotune_persist": cfg.get("computer.autotune-persist"),
+                "features_dim_tier": cfg.get("computer.features-dim-tier"),
+                "features_native_matmul": cfg.get(
+                    "computer.features-native-matmul"
+                ),
             }
         if cfg is not None and self.executor_kind == "cpu":
             run_kwargs = {
                 "checkpoint_every": cfg.get("computer.checkpoint-every"),
                 "checkpoint_path": cfg.get("computer.checkpoint-path") or None,
+                "features_dim_tier": cfg.get("computer.features-dim-tier"),
+                "features_native_matmul": cfg.get(
+                    "computer.features-native-matmul"
+                ),
             }
         # chaos wiring: a graph opened with storage.faults.enabled carries
         # a FaultPlan; its superstep-preemption hook rides into the
@@ -332,8 +341,20 @@ def run_on(
     tail_chunk: int = None,
     autotune_min_gain: float = None,
     autotune_max_tiers: int = None,
+    autotune_persist: bool = None,
+    features_dim_tier: int = None,
+    features_native_matmul: bool = None,
     cpu_strategy: str = "scalar",
 ):
+    # dense-feature tier program configuration (computer.features-*):
+    # applied here so EVERY executor sees the same padded lane tier and
+    # matmul flavor (TPUExecutor re-applies the tier for direct callers)
+    if features_dim_tier and hasattr(program, "set_dim_tier"):
+        program.set_dim_tier(features_dim_tier)
+    if features_native_matmul is not None and hasattr(
+        program, "set_native_matmul"
+    ):
+        program.set_native_matmul(features_native_matmul)
     if executor == "cpu":
         from janusgraph_tpu.olap.cpu_executor import CPUExecutor
 
@@ -377,6 +398,8 @@ def run_on(
             tail_chunk=tail_chunk,
             autotune_min_gain=autotune_min_gain,
             autotune_max_tiers=autotune_max_tiers,
+            autotune_persist=autotune_persist,
+            features_dim_tier=features_dim_tier,
         ).run(
             program,
             sync_every=sync_every,

@@ -111,6 +111,15 @@ class CPUExecutor:
         )
 
         check_weighted_transforms(program, self.graph)
+        if getattr(program, "message_mode", None) == "sddmm" and (
+            program.undirected
+        ):
+            # mirror TPUExecutor: the sddmm row-dst builders cover the
+            # in-CSR orientation only
+            raise ValueError(
+                "sddmm message mode aggregates over the in-CSR only — "
+                "undirected dense programs are not supported"
+            )
         g = self.graph
         n = g.num_vertices
         memory = Memory()
@@ -160,11 +169,22 @@ class CPUExecutor:
                 agg_shape = (n, outgoing.shape[1]) if vec else (n,)
                 aggregated = np.full(agg_shape, identity, dtype=np.float64)
 
+            sddmm = getattr(program, "message_mode", None) == "sddmm"
+
             def deliver(dst: int, src: int, weight):
-                msg = apply_edge_transform(
-                    np, outgoing[src], weight,
-                    program.edge_transform, program.edge_transform_cols,
-                )
+                if sddmm:
+                    # dense-tier dot-attention oracle: the per-edge
+                    # coefficient is <h_src, h_dst> (f64 here — the scalar
+                    # loop is the semantic oracle; the PACK strategies are
+                    # the bitwise ones)
+                    msg = outgoing[src] * float(
+                        np.dot(outgoing[src], outgoing[dst])
+                    )
+                else:
+                    msg = apply_edge_transform(
+                        np, outgoing[src], weight,
+                        program.edge_transform, program.edge_transform_cols,
+                    )
                 aggregated[dst] = _combine(op, aggregated[dst], msg)
 
             if use_pack:
@@ -257,6 +277,31 @@ class CPUExecutor:
             self._packs[key] = pack
         return pack
 
+    def _sddmm_rows(self, undirected: bool):
+        """Row-destination vectors for the fused SDDMM pass, aligned with
+        `_pack`'s layout — the numpy twins of TPUExecutor._sddmm_rows."""
+        from janusgraph_tpu.olap.features import kernels as fkernels
+
+        key = ("sddmm", self.strategy, undirected)
+        rows = self._packs.get(key)
+        if rows is None:
+            g = self.graph
+            n = g.num_vertices
+            src = g.in_src.astype(np.int64)
+            dst = np.repeat(
+                np.arange(n, dtype=np.int64), np.diff(g.in_indptr)
+            )
+            if self.strategy == "ell":
+                rows = fkernels.ell_row_dsts(src, dst, n)
+            else:
+                pack = self._pack(undirected)
+                rows = fkernels.hybrid_row_dsts(
+                    src, dst, n,
+                    hub_cutoff=pack.hub_cutoff, tail_chunk=pack.tail_chunk,
+                )
+            self._packs[key] = rows
+        return rows
+
     def _pack_aggregate(self, program: VertexProgram, op: str, outgoing):
         from janusgraph_tpu.olap.kernels import (
             ell_aggregate,
@@ -264,6 +309,18 @@ class CPUExecutor:
         )
 
         pack = self._pack(program.undirected)
+        if getattr(program, "message_mode", None) == "sddmm":
+            # dense tier: the same fused SDDMM+SpMM arithmetic the device
+            # executor compiles, replayed in numpy (bitwise contract)
+            from janusgraph_tpu.olap.features.kernels import (
+                sddmm_ell_aggregate,
+                sddmm_hybrid_aggregate,
+            )
+
+            rows = self._sddmm_rows(program.undirected)
+            if self.strategy == "ell":
+                return sddmm_ell_aggregate(np, pack, rows, outgoing, op)
+            return sddmm_hybrid_aggregate(np, pack, rows, outgoing, op)
         agg_fn = ell_aggregate if self.strategy == "ell" else hybrid_aggregate
         return agg_fn(
             np, pack, outgoing, op, program.edge_transform,
@@ -280,6 +337,7 @@ class CPUExecutor:
         edges = g.num_edges * (2 if program.undirected else 1)
         cost = profiler.estimate_superstep_cost(
             g.num_vertices, edges,
+            msg_cols=getattr(program, "d_pad", 1) or 1,
             weighted=g.in_edge_weight is not None,
         )
         peaks = profiler.device_peaks("cpu")
@@ -309,5 +367,10 @@ class CPUExecutor:
                 ),
             },
         }
+        # dense tier: same per-superstep MXU accounting as the device
+        # executor, so utilization comparisons read uniformly
+        if callable(getattr(program, "matmul_flops", None)):
+            per_step = float(program.matmul_flops(g.num_vertices, edges))
+            info["mxu"] = profiler.attach_mxu(records, per_step, peaks)
         self.last_run_info = info
         registry.record_run("olap", info)

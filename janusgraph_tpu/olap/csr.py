@@ -78,6 +78,17 @@ class CSRGraph:
     def num_edges(self) -> int:
         return len(self.out_dst)
 
+    @property
+    def in_degree(self) -> np.ndarray:
+        """(n,) int32 in-degrees (derived from in_indptr, cached). The
+        dense-feature tier's mean-aggregation normalizer; the device view
+        exposes the same field as float32."""
+        cached = getattr(self, "_in_degree_cache", None)
+        if cached is None:
+            cached = np.diff(self.in_indptr).astype(np.int32)
+            object.__setattr__(self, "_in_degree_cache", cached)
+        return cached
+
     def index_of(self, vid: int) -> int:
         i = int(np.searchsorted(self.vertex_ids, vid))
         if i >= len(self.vertex_ids) or self.vertex_ids[i] != vid:

@@ -1,0 +1,124 @@
+"""DenseVertexProgram — the [N, d] feature-block vertex-program contract.
+
+Extends the array-BSP `VertexProgram` SPI (olap/vertex_program.py) with the
+dense-feature tier's vocabulary:
+
+  feature_keys    state entries that are (n, d_pad) feature blocks
+  feature_dim     the LOGICAL feature width d
+  d_pad           d padded to a power-of-two lane tier (FEATURE_TIERS);
+                  padded columns are zero and every kernel mode keeps them
+                  zero, so write-back/bitwise checks can slice [:, :d]
+  message_mode    copy | weighted | sddmm — how an edge transforms the
+                  source's feature row in flight (weighted rides the
+                  existing MUL_WEIGHT machinery; sddmm computes a per-edge
+                  dot-attention coefficient fused into the gather)
+  dense_layer()   the post-aggregate matmul+bias+activation helper
+                  (features/kernels.dense_transform) — the MXU op
+  matmul_flops()  per-superstep MXU-attributable flops, consumed by the
+                  executors' `mxu_utilization` run-record fields
+
+Combiner semantics lift unchanged to the feature axes: SUM/MIN/MAX apply
+elementwise over the d columns (the scalar tier's (n, k)-message support
+already provides this for copy/weighted; sddmm is SUM-only).
+
+Programs stay xp-generic and keep every state-feeding reduction on the
+fixed-tree kernels, so one definition runs bitwise-identically on the CPU
+oracle and the device executors.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import numpy as np
+
+from janusgraph_tpu.olap.features.kernels import (
+    dense_transform,
+    matmul_flops,
+    pad_features,
+    pick_feature_tier,
+    sddmm_flops,
+)
+from janusgraph_tpu.olap.vertex_program import (
+    Combiner,
+    EdgeTransform,
+    VertexProgram,
+)
+
+
+class MessageMode:
+    """How an edge transforms the source's feature row in flight."""
+
+    COPY = "copy"
+    WEIGHTED = "weighted"
+    SDDMM = "sddmm"
+
+    ALL = (COPY, WEIGHTED, SDDMM)
+
+
+class DenseVertexProgram(VertexProgram):
+    """Base class for dense-feature vertex programs. Subclasses set
+    `feature_keys`, pick a `message_mode`, and implement the usual
+    setup/message/apply hooks over (n, d_pad) blocks."""
+
+    feature_keys: Tuple[str, ...] = ()
+    message_mode: str = MessageMode.COPY
+    combiner = Combiner.SUM
+
+    def __init__(
+        self,
+        feature_dim: int,
+        dim_tier: int = 0,
+        native_matmul: bool = False,
+    ):
+        self.feature_dim = int(feature_dim)
+        self.dim_tier = int(dim_tier or 0)
+        self.native_matmul = bool(native_matmul)
+        if self.message_mode not in MessageMode.ALL:
+            raise ValueError(f"unknown message_mode {self.message_mode!r}")
+        if self.message_mode == MessageMode.WEIGHTED:
+            # ride the scalar tier's in-flight weight machinery; executors
+            # already guard weightless CSRs (check_weighted_transforms)
+            self.edge_transform = EdgeTransform.MUL_WEIGHT
+        if self.message_mode == MessageMode.SDDMM and (
+            self.combiner != Combiner.SUM
+        ):
+            raise ValueError("sddmm programs must use the SUM combiner")
+        self.d_pad = pick_feature_tier(self.feature_dim, self.dim_tier)
+
+    # ------------------------------------------------------- configuration
+    def set_dim_tier(self, tier: int) -> None:
+        """Apply computer.features-dim-tier: re-pick the padded lane tier
+        (run_on calls this before setup, so state shapes see it)."""
+        self.dim_tier = int(tier or 0)
+        self.d_pad = pick_feature_tier(self.feature_dim, self.dim_tier)
+
+    def set_native_matmul(self, native: bool) -> None:
+        """Apply computer.features-native-matmul: backend dot (MXU) instead
+        of the deterministic tree contraction."""
+        self.native_matmul = bool(native)
+
+    # ------------------------------------------------------------- helpers
+    def pad_block(self, h: np.ndarray) -> np.ndarray:
+        """Zero-pad an (n, feature_dim) host block to (n, d_pad)."""
+        return pad_features(h, self.d_pad)
+
+    def dense_layer(self, xp, h, w, b=None, activation: str = "identity"):
+        """The post-aggregate dense transform (matmul + bias + activation);
+        honors the program's native-matmul setting."""
+        return dense_transform(
+            xp, h, w, b, activation, native=self.native_matmul
+        )
+
+    # ---------------------------------------------------------------- cost
+    def matmul_flops(self, num_vertices: int, num_edges: int) -> float:
+        """Per-superstep MXU-attributable flops (dense layers + sddmm
+        dots). Subclasses with dense layers should extend this; the base
+        accounts the sddmm coefficient pass only."""
+        if self.message_mode == MessageMode.SDDMM:
+            return sddmm_flops(num_edges, self.d_pad)
+        return 0.0
+
+    @staticmethod
+    def layer_flops(n: int, d_in: int, d_out: int) -> float:
+        return matmul_flops(n, d_in, d_out)

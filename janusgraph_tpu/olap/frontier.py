@@ -117,7 +117,11 @@ class FrontierEngine:
         # pow2 schedules sized from the degree histogram replace the fixed
         # growth-factor ladder when the executor carries a decision
         self.f_schedule = self.e_schedule = None
-        decision = getattr(executor, "_autotune_decisions", {}).get(False)
+        # decisions are keyed (undirected, feature_dim); frontier programs
+        # are scalar-message in-CSR, so the (False, 0) decision applies
+        decision = getattr(executor, "_autotune_decisions", {}).get(
+            (False, 0)
+        )
         if decision is not None and getattr(
             executor, "_autotune_enabled", False
         ):

@@ -248,6 +248,29 @@ def _is_jax(xp) -> bool:
     return "jax" in getattr(xp, "__name__", "")
 
 
+# graphlint: traced -- the fp-contraction fence of product-fed reductions
+def fp_fence(xp, a):
+    """Add an optimizer-opaque zero to `a` — the fp-contraction fence.
+
+    LLVM's CPU backend may contract a float multiply into a following add
+    as one fused multiply-add (single rounding), silently changing bits vs
+    the numpy oracle's separately-rounded mul+add; HLO-level barriers and
+    bitcasts do not survive to the emitted loop, so the fence works
+    arithmetically instead: any contraction of a product into THIS add
+    computes round(a*b + 0) == round(a*b) — the plain multiply's bits —
+    and every downstream add sees a non-multiply operand, which cannot
+    contract. The zero rides through `optimization_barrier` so the HLO
+    simplifier can't fold the add away before the backend sees it. The
+    numpy path adds a real zero, so both sides also normalize -0.0 to
+    +0.0 identically."""
+    if _is_jax(xp):
+        import jax
+
+        z = jax.lax.optimization_barrier(xp.zeros((), dtype=a.dtype))
+        return a + z
+    return a + a.dtype.type(0.0)
+
+
 # graphlint: traced -- the shared reduction tree of every compiled superstep
 def tree_reduce(xp, m, op: str):
     """Reduce axis 1 of `m` (width MUST be a power of two) through a fixed
@@ -350,6 +373,10 @@ def ell_aggregate(
                 elif edge_transform == EdgeTransform.ADD_WEIGHT:
                     m = m + w_
             m = jnp.where(valid_ > 0, m, identity)
+            # fence the transformed leaves so no backend contracts the
+            # weight product into the reduction tree (and every layout
+            # normalizes -0.0 the same way)
+            m = fp_fence(jnp, m)
         # unweighted pack: padded slots index the sentinel, which already
         # reads the identity — no mask needed
         r = tree_reduce(jnp, m, op)
@@ -614,7 +641,9 @@ def hybrid_aggregate(
         if valid is not None:
             valid_ = valid[:, :, None] if m.ndim == 3 else valid
             m = xp.where(valid_ > 0, m, identity)
-        return m
+        # same fence as the ELL weighted branch: the torso's unmasked
+        # weight product would otherwise contract into the tree
+        return fp_fence(xp, m)
 
     parts = []
     for entry, (d, cap) in zip(pack.torso, pack.torso_meta):

@@ -13,3 +13,7 @@ from janusgraph_tpu.olap.programs.olap_traversal import (  # noqa: F401
     steps_from_spec,
 )
 from janusgraph_tpu.olap.programs.degree import DegreeCountProgram  # noqa: F401
+from janusgraph_tpu.olap.programs.gcn import GCNForwardProgram  # noqa: F401
+from janusgraph_tpu.olap.programs.embedding import (  # noqa: F401
+    EmbeddingUpdateProgram,
+)

@@ -1,0 +1,124 @@
+"""node2vec-style embedding update as a dense-feature vertex program.
+
+Each superstep is one SGD-flavored embedding sweep: gather neighbor
+embedding rows (gather–multiply–accumulate — optionally walk-weighted or
+dot-attention scored), mean-normalize into a positive pull, and push away
+from the mean of a **negative-sampling table passed as a dense side
+input** (the skip-gram negative term, pre-reduced host-side so the traced
+superstep consumes one (d_pad,) constant):
+
+    emb' = (1 - decay) * emb + lr * (pos_mean - neg_mean)
+
+Every state-feeding op is elementwise or rides the fixed-tree kernels, so
+the update is bitwise-identical across the CPU oracle and device
+executors on both packed formats.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+from janusgraph_tpu.olap.features.dense_program import (
+    DenseVertexProgram,
+    MessageMode,
+)
+from janusgraph_tpu.olap.features.kernels import pad_features
+from janusgraph_tpu.olap.kernels import fp_fence
+from janusgraph_tpu.olap.vertex_program import Combiner
+
+
+class EmbeddingUpdateProgram(DenseVertexProgram):
+    """Iterative embedding refinement (node2vec/DeepWalk-shaped).
+
+    State: ``emb`` — the (n, d_pad) embedding block. ``mode`` picks the
+    gather semantics: "copy" (uniform neighbors), "weighted" (walk
+    transition weights from the CSR weight column), or "sddmm"
+    (similarity-scored neighbors). ``neg_table`` is the (K, feature_dim)
+    negative-sample side input; omitted, it is seeded deterministically."""
+
+    feature_keys = ("emb",)
+
+    def __init__(
+        self,
+        feature_dim: int = 16,
+        lr: float = 0.05,
+        decay: float = 0.01,
+        negatives: int = 8,
+        seed: int = 11,
+        max_iterations: int = 5,
+        tol: float = 0.0,
+        mode: str = MessageMode.COPY,
+        neg_table: Optional[np.ndarray] = None,
+        dim_tier: int = 0,
+        native_matmul: bool = False,
+    ):
+        self.message_mode = mode
+        super().__init__(
+            feature_dim, dim_tier=dim_tier, native_matmul=native_matmul
+        )
+        self.lr = float(lr)
+        self.decay = float(decay)
+        self.negatives = int(negatives)
+        self.seed = int(seed)
+        self.max_iterations = int(max_iterations)
+        self.tol = float(tol)
+        if neg_table is None:
+            rng = np.random.default_rng(self.seed)
+            neg_table = (
+                rng.standard_normal((self.negatives, self.feature_dim)) * 0.1
+            )
+        neg_table = np.asarray(neg_table, dtype=np.float32)
+        if neg_table.shape[1] != self.feature_dim:
+            raise ValueError(
+                f"neg_table width {neg_table.shape[1]} != feature_dim "
+                f"{self.feature_dim}"
+            )
+        self._neg_table = neg_table
+        # the negative term is a constant of the run: pre-reduce the table
+        # host-side (f64 mean, f32 result) so both executors consume the
+        # exact same (feature_dim,) bits
+        self._neg_mean = np.mean(
+            neg_table.astype(np.float64), axis=0
+        ).astype(np.float32)
+
+    # ----------------------------------------------------------------- BSP
+    def setup(self, graph, xp):
+        n = graph.num_vertices
+        rng = np.random.default_rng(self.seed + 1)
+        emb = (
+            rng.standard_normal((n, self.feature_dim))
+            / np.sqrt(self.feature_dim)
+        ).astype(np.float32)
+        emb = pad_features(emb, self.d_pad)
+        return {"emb": xp.asarray(emb)}, {
+            "delta": (Combiner.SUM, float("inf")),
+        }
+
+    def message(self, state, superstep, graph, xp):
+        return state["emb"]
+
+    def apply(self, state, aggregated, superstep, memory_in, graph, xp):
+        emb = state["emb"]
+        indeg = xp.asarray(graph.in_degree, dtype=emb.dtype)
+        pos = aggregated / xp.maximum(indeg, 1.0)[:, None]
+        neg = xp.asarray(
+            pad_features(self._neg_mean[None, :], self.d_pad)[0],
+            dtype=emb.dtype,
+        )
+        # both products are fenced so the final add's bits match the
+        # numpy oracle's separately-rounded mul+add (no fused multiply-add)
+        keep = fp_fence(xp, (1.0 - self.decay) * emb)
+        push = fp_fence(xp, self.lr * (pos - neg[None, :]))
+        emb2 = keep + push
+        # convergence metric only (backend-ordered reduction, not part of
+        # the bitwise state contract); default tol=0.0 never triggers it
+        delta = xp.sum(xp.abs(emb2 - emb))
+        return {"emb": emb2}, {"delta": (Combiner.SUM, delta)}
+
+    def terminate(self, memory):
+        return memory.superstep >= 1 and memory.get("delta", 1.0) < self.tol
+
+    def terminate_device(self, values, steps_done, xp):
+        return xp.logical_and(steps_done >= 1, values["delta"] < self.tol)

@@ -46,6 +46,9 @@ class _DeviceGraph:
         "out_degree": lambda csr, jnp: jnp.asarray(
             csr.out_degree, dtype=jnp.float32
         ),
+        "in_degree": lambda csr, jnp: jnp.asarray(
+            csr.in_degree, dtype=jnp.float32
+        ),
         "in_src": lambda csr, jnp: jnp.asarray(csr.in_src),
         "in_dst_seg": lambda csr, jnp: jnp.asarray(
             _segment_ids(csr.in_indptr, csr.num_edges)
@@ -92,6 +95,7 @@ class _DeviceGraph:
         shapes = {
             "active": ((csr.num_vertices,), np_.float32),
             "out_degree": ((csr.num_vertices,), np_.float32),
+            "in_degree": ((csr.num_vertices,), np_.float32),
             "in_src": ((csr.num_edges,), csr.in_src.dtype),
             "in_dst_seg": ((csr.num_edges,), np_.int32),
             "out_dst": ((csr.num_edges,), csr.out_dst.dtype),
@@ -112,8 +116,8 @@ class _TracedView:
 
     _KEYMAP = {"in_edge_weight": "in_w", "out_edge_weight": "out_w"}
     _FIELDS = frozenset(
-        ("active", "out_degree", "in_src", "in_dst_seg", "out_dst",
-         "out_src_seg", "in_edge_weight", "out_edge_weight")
+        ("active", "out_degree", "in_degree", "in_src", "in_dst_seg",
+         "out_dst", "out_src_seg", "in_edge_weight", "out_edge_weight")
     )
 
     def __init__(self, tmpl, arrs, record=None):
@@ -220,6 +224,8 @@ class TPUExecutor:
         tail_chunk: int = None,
         autotune_min_gain: float = None,
         autotune_max_tiers: int = None,
+        autotune_persist: bool = None,
+        features_dim_tier: int = None,
     ):
         import jax
         import jax.numpy as jnp
@@ -241,7 +247,21 @@ class TPUExecutor:
         self._tail_chunk_cfg = tail_chunk or None
         self._autotune_min_gain = autotune_min_gain
         self._autotune_max_tiers = autotune_max_tiers
-        self._autotune_decisions: Dict[bool, object] = {}
+        # computer.autotune-persist: serialize the last measured record
+        # next to the checkpoint path and feed it back into decide() on
+        # the next executor lifetime (ROADMAP #2 leftover)
+        self._autotune_persist = (
+            True if autotune_persist is None else bool(autotune_persist)
+        )
+        self._measured_path = None
+        # computer.features-dim-tier: forced padded feature-dim lane tier
+        # for dense programs (0 = tier ladder); the current dense run's
+        # padded dim also feeds the tuner's feature-dim input
+        self._features_dim_tier = features_dim_tier or 0
+        self._feature_dim_run = 0
+        # decisions keyed (undirected, feature_dim) — a dense run's tier
+        # changes the modeled message bytes, so it is its own decision
+        self._autotune_decisions: Dict[Tuple, object] = {}
         if frontier not in ("auto", "off", "always"):
             raise ValueError(f"unknown frontier mode: {frontier!r}")
         # Frontier-compacted SSSP/BFS/CC (olap/frontier.py): the program
@@ -267,7 +287,7 @@ class TPUExecutor:
         # in+out edges (~2x footprint), so the budget check must see the
         # view it will actually ship
         self._strategy_cfg = strategy
-        self._auto_cache: Dict[bool, str] = {}
+        self._auto_cache: Dict[Tuple, str] = {}
         # Pallas kernels interpret on CPU/virtual devices, compile on real
         # TPU (platform may be a tunneled plugin name like "axon" whose
         # device_kind still identifies the TPU generation)
@@ -303,6 +323,9 @@ class TPUExecutor:
         self._metric_ops: Dict[Tuple, Dict[str, str]] = {}
         self._ell_packs: Dict[bool, object] = {}
         self._hybrid_packs: Dict[bool, object] = {}
+        # per-(strategy, orientation) row-destination vectors for the
+        # dense tier's fused SDDMM pass (features/kernels row-dst builders)
+        self._sddmm_rows_cache: Dict[Tuple, object] = {}
         self._channel_packs: "OrderedDict" = OrderedDict()
         self._segsum_plans: Dict[str, object] = {}
 
@@ -361,13 +384,19 @@ class TPUExecutor:
         }
 
     def _autotune(self, undirected: bool, measured: dict = None):
-        """The (cached) AutotuneDecision for one edge view. Deterministic
-        given (graph stats, device kind, config): olap/autotune.decide."""
-        decision = self._autotune_decisions.get(undirected)
+        """The (cached) AutotuneDecision for one edge view (and, for dense
+        runs, one feature tier). Deterministic given (graph stats, device
+        kind, config, persisted measurement): olap/autotune.decide."""
+        key = (undirected, self._feature_dim_run)
+        decision = self._autotune_decisions.get(key)
         if decision is not None and measured is None:
             return decision
         from janusgraph_tpu.olap import autotune
 
+        if measured is None and self._measured_path:
+            # a prior executor lifetime's persisted record (computer.
+            # autotune-persist): achieved bandwidth calibrates the model
+            measured = autotune.load_measured(self._measured_path)
         stats = autotune.GraphStats.from_csr(
             self.csr, undirected=undirected,
             max_capacity=self.ell_max_capacity or (1 << 14),
@@ -376,10 +405,13 @@ class TPUExecutor:
         ov = self._autotune_overrides()
         if self._strategy_cfg != "auto":
             ov["strategy"] = self._strategy_cfg
+        if self._features_dim_tier:
+            ov["feature_dim_tier"] = self._features_dim_tier
         decision = autotune.decide(
-            stats, self._device_kind(), overrides=ov, measured=measured
+            stats, self._device_kind(), overrides=ov, measured=measured,
+            feature_dim=self._feature_dim_run,
         )
-        self._autotune_decisions[undirected] = decision
+        self._autotune_decisions[key] = decision
         return decision
 
     def _auto_strategy(self, undirected: bool) -> str:
@@ -407,32 +439,77 @@ class TPUExecutor:
     def _base_strategy(self, undirected: bool) -> str:
         base = self._strategy_cfg
         if base == "auto":
-            base = self._auto_cache.get(undirected)
+            key = (undirected, self._feature_dim_run)
+            base = self._auto_cache.get(key)
             if base is None:
                 base = self._auto_strategy(undirected)
-                self._auto_cache[undirected] = base
+                self._auto_cache[key] = base
         return base
+
+    def _edge_view(self, undirected: bool):
+        """(src, dst, w) edge arrays for one orientation view — the single
+        assembly shared by the pack builders and the sddmm row-dst
+        builders, so their layouts can never disagree."""
+        csr = self.csr
+        src = csr.in_src.astype(np.int64)
+        dst = _segment_ids(csr.in_indptr, csr.num_edges).astype(np.int64)
+        w = csr.in_edge_weight
+        if undirected:
+            src = np.concatenate([src, csr.out_dst.astype(np.int64)])
+            dst = np.concatenate([
+                dst,
+                _segment_ids(csr.out_indptr, csr.num_edges).astype(np.int64),
+            ])
+            w = (
+                np.concatenate([w, csr.out_edge_weight])
+                if w is not None
+                else None
+            )
+        return src, dst, w
 
     def _ell_pack(self, undirected: bool):
         from janusgraph_tpu.olap.kernels import ELLPack
 
         pack = self._ell_packs.get(undirected)
         if pack is None:
-            csr = self.csr
-            src = csr.in_src.astype(np.int64)
-            dst = _segment_ids(csr.in_indptr, csr.num_edges).astype(np.int64)
-            w = csr.in_edge_weight
-            if undirected:
-                rsrc = csr.out_dst.astype(np.int64)
-                rdst = _segment_ids(csr.out_indptr, csr.num_edges).astype(np.int64)
-                rw = csr.out_edge_weight
-                src = np.concatenate([src, rsrc])
-                dst = np.concatenate([dst, rdst])
-                w = np.concatenate([w, rw]) if w is not None else None
-            pack = ELLPack(src, dst, w, csr.num_vertices, **self._ell_kwargs())
+            src, dst, w = self._edge_view(undirected)
+            pack = ELLPack(
+                src, dst, w, self.csr.num_vertices, **self._ell_kwargs()
+            )
             pack.device_put(self.jnp)
             self._ell_packs[undirected] = pack
         return pack
+
+    def _sddmm_rows(self, strategy: str, undirected: bool):
+        """Row-destination vectors for the fused SDDMM pass, aligned with
+        the strategy's pack layout (features/kernels builders); built once
+        per (strategy, orientation) and kept device-resident."""
+        from janusgraph_tpu.olap.features import kernels as fkernels
+
+        key = (strategy, undirected)
+        rows = self._sddmm_rows_cache.get(key)
+        if rows is not None:
+            return rows
+        src, dst, _w = self._edge_view(undirected)
+        cap = self.ell_max_capacity or (1 << 14)
+        if strategy == "ell":
+            host = fkernels.ell_row_dsts(
+                src, dst, self.csr.num_vertices, max_capacity=cap
+            )
+            rows = [self.jnp.asarray(r) for r in host]
+        else:
+            pack = self._hybrid_pack(undirected)
+            host = fkernels.hybrid_row_dsts(
+                src, dst, self.csr.num_vertices,
+                hub_cutoff=pack.hub_cutoff, tail_chunk=pack.tail_chunk,
+                max_capacity=cap,
+            )
+            rows = {
+                "torso": [self.jnp.asarray(r) for r in host["torso"]],
+                "tail": [self.jnp.asarray(r) for r in host["tail"]],
+            }
+        self._sddmm_rows_cache[key] = rows
+        return rows
 
     def _ell_kwargs(self):
         return (
@@ -452,25 +529,9 @@ class TPUExecutor:
             d = self._autotune(undirected)
             cutoff = self._hub_cutoff_cfg or d.hub_cutoff or 512
             chunk = self._tail_chunk_cfg or d.tail_chunk or 256
-            csr = self.csr
-            src = csr.in_src.astype(np.int64)
-            dst = _segment_ids(csr.in_indptr, csr.num_edges).astype(np.int64)
-            w = csr.in_edge_weight
-            if undirected:
-                src = np.concatenate([src, csr.out_dst.astype(np.int64)])
-                dst = np.concatenate([
-                    dst,
-                    _segment_ids(csr.out_indptr, csr.num_edges).astype(
-                        np.int64
-                    ),
-                ])
-                w = (
-                    np.concatenate([w, csr.out_edge_weight])
-                    if w is not None
-                    else None
-                )
+            src, dst, w = self._edge_view(undirected)
             pack = HybridPack(
-                src, dst, w, csr.num_vertices,
+                src, dst, w, self.csr.num_vertices,
                 hub_cutoff=cutoff, tail_chunk=chunk, **self._ell_kwargs(),
             )
             pack.device_put(self.jnp)
@@ -569,8 +630,8 @@ class TPUExecutor:
         g = self.g
         view = {
             k: g.spec(k)
-            for k in ("active", "out_degree", "in_src", "in_dst_seg",
-                      "out_dst", "out_src_seg")
+            for k in ("active", "out_degree", "in_degree", "in_src",
+                      "in_dst_seg", "out_dst", "out_src_seg")
         }
         if self.csr.in_edge_weight is not None:
             view["in_w"] = g.spec("in_w")
@@ -583,6 +644,10 @@ class TPUExecutor:
             args["unpermute"] = pack.unpermute
         elif strategy == "hybrid":
             args["hyb"] = self._hybrid_args(pack)
+        if getattr(program, "message_mode", None) == "sddmm" and strategy in (
+            "ell", "hybrid"
+        ):
+            args["sddmm"] = self._sddmm_rows(strategy, program.undirected)
         if state is None:
             # cold discovery (direct _graph_args call before any run):
             # setup just to learn the state/metric pytree shapes
@@ -658,6 +723,10 @@ class TPUExecutor:
             args["unpermute"] = pack.unpermute
         elif strategy == "hybrid":
             args["hyb"] = self._hybrid_args(pack)
+        if getattr(program, "message_mode", None) == "sddmm" and strategy in (
+            "ell", "hybrid"
+        ):
+            args["sddmm"] = self._sddmm_rows(strategy, program.undirected)
         self._last_arg_bytes = _pytree_nbytes(args)
         return args
 
@@ -733,7 +802,36 @@ class TPUExecutor:
             from janusgraph_tpu.olap.kernels import ell_aggregate
 
             outgoing = program.message(state, superstep_idx, gv, jnp)
-            if strategy == "ell":
+            mode = getattr(program, "message_mode", None)
+            if mode == "sddmm":
+                # dense tier: fused SDDMM+SpMM — per-edge dot-attention
+                # coefficients computed in the same gather pass
+                from janusgraph_tpu.olap.features.kernels import (
+                    sddmm_ell_aggregate,
+                    sddmm_hybrid_aggregate,
+                    sddmm_segment_aggregate,
+                )
+
+                if strategy == "ell":
+                    pv = _PackView(
+                        gargs["ell"], bucket_slots, gargs["unpermute"],
+                        has_weight,
+                    )
+                    agg = sddmm_ell_aggregate(
+                        jnp, pv, gargs["sddmm"], outgoing, op
+                    )
+                elif strategy == "hybrid":
+                    from janusgraph_tpu.olap.kernels import HybridPackView
+
+                    hv = HybridPackView(gargs["hyb"], pack_meta)
+                    agg = sddmm_hybrid_aggregate(
+                        jnp, hv, gargs["sddmm"], outgoing, op
+                    )
+                else:
+                    agg = sddmm_segment_aggregate(
+                        jnp, outgoing, gv.in_src, gv.in_dst_seg, n
+                    )
+            elif strategy == "ell":
                 pv = _PackView(
                     gargs["ell"], bucket_slots, gargs["unpermute"], has_weight
                 )
@@ -820,6 +918,7 @@ class TPUExecutor:
             cost = profiler.estimate_superstep_cost(
                 self.csr.num_vertices,
                 self.csr.num_edges * (2 if program.undirected else 1),
+                msg_cols=getattr(program, "d_pad", 1) or 1,
                 weighted=self.csr.in_edge_weight is not None,
                 arg_bytes=self._last_arg_bytes,
             )
@@ -918,6 +1017,29 @@ class TPUExecutor:
         )
 
         check_weighted_transforms(program, self.csr)
+        # dense-feature tier plumbing: forced lane tier, the tuner's
+        # feature-dim input, and the sddmm mode's support envelope
+        if self._features_dim_tier and hasattr(program, "set_dim_tier"):
+            if getattr(program, "dim_tier", 0) != self._features_dim_tier:
+                program.set_dim_tier(self._features_dim_tier)
+        self._feature_dim_run = int(getattr(program, "d_pad", 0) or 0)
+        if getattr(program, "message_mode", None) == "sddmm":
+            if program.undirected:
+                raise ValueError(
+                    "sddmm message mode aggregates over the in-CSR only — "
+                    "undirected dense programs are not supported"
+                )
+            if type(program).channel_for is not VertexProgram.channel_for:
+                raise ValueError(
+                    "sddmm message mode cannot ride typed edge channels"
+                )
+        # computer.autotune-persist: measured records ride next to the
+        # checkpoint file and calibrate the next lifetime's decide()
+        self._measured_path = (
+            checkpoint_path + ".autotune.json"
+            if (checkpoint_path and self._autotune_persist)
+            else None
+        )
         if frontier not in (None, "auto", "off", "always"):
             raise ValueError(f"unknown frontier mode: {frontier!r}")
         mode = frontier or self._frontier_cfg
@@ -1049,7 +1171,9 @@ class TPUExecutor:
         # the tuner's decision travels with every run record (bench +
         # /telemetry read it from here); explicit strategies still record
         # a source="config" decision for provenance
-        decision = self._autotune_decisions.get(undirected)
+        decision = self._autotune_decisions.get(
+            (undirected, self._feature_dim_run)
+        )
         if decision is None and self._autotune_enabled:
             try:
                 decision = self._autotune(undirected)
@@ -1087,12 +1211,13 @@ class TPUExecutor:
         from janusgraph_tpu.observability import profiler as _profiler
 
         weighted = self.csr.in_edge_weight is not None
+        cols = self._feature_dim_run or 1
         for r in records:
             if "flops" not in r:
                 est = _profiler.estimate_superstep_cost(
                     int(r.get("frontier", n)),
                     int(r.get("edges", self.csr.num_edges)),
-                    weighted=weighted,
+                    msg_cols=cols, weighted=weighted,
                 )
                 r.update(est)
         peaks = _profiler.device_peaks(
@@ -1100,7 +1225,7 @@ class TPUExecutor:
         )
         info["roofline_by_tier"] = _profiler.attach_roofline(
             records, _profiler.estimate_superstep_cost(
-                n, self.csr.num_edges, weighted=weighted,
+                n, self.csr.num_edges, msg_cols=cols, weighted=weighted,
                 arg_bytes=info["h2d_arg_bytes"],
             ), peaks,
         )
@@ -1110,6 +1235,14 @@ class TPUExecutor:
             "device_kind": peaks["device_kind"],
             "peaks_source": peaks["source"],
         }
+        # dense tier: per-superstep MXU utilization (matmul-attributable
+        # flops over the device's MXU peak) next to the VPU roofline
+        if callable(getattr(program, "matmul_flops", None)):
+            per_step = float(program.matmul_flops(n, edges))
+            info["mxu"] = _profiler.attach_mxu(records, per_step, peaks)
+            mean_util = info["mxu"].get("mean_utilization")
+            if mean_util is not None:
+                registry.set_gauge("olap.mxu.utilization", float(mean_util))
         if records:
             registry.set_gauge(
                 "olap.roofline.operational_intensity",
@@ -1207,6 +1340,18 @@ class TPUExecutor:
             registry.histogram("olap.frontier.size").observe(
                 float(records[-1].get("frontier", n))
             )
+        # computer.autotune-persist: the record the next executor lifetime
+        # feeds back into decide() as its `measured` calibration input
+        if self._measured_path and records and pad_ratio is not None:
+            from janusgraph_tpu.olap import autotune as _at
+
+            walls = sorted(float(r.get("wall_ms", 0.0)) for r in records)
+            _at.save_measured(self._measured_path, {
+                "strategy": strategy_resolved,
+                "pad_ratio": pad_ratio,
+                "superstep_ms": walls[len(walls) // 2],
+                "roofline_by_tier": info.get("roofline_by_tier"),
+            })
         registry.record_run("olap", info)
 
     def _device_memory(self, info) -> dict:

@@ -1,0 +1,29 @@
+"""JG304 fixture: dense-tier feature-dim padding tiers (parse-only).
+
+The dense-feature tier pads [n, d] blocks to power-of-two lane tiers so
+the SDDMM tree-dot and the dense transform's tree-matmul contract over
+complete adjacent-pair trees; a non-pow2 padded width breaks the bitwise
+contract and mis-tiles the VPU/MXU lanes. The LOGICAL feature dim may be
+anything — only the padded tier is constrained; 0 means auto-pick.
+"""
+import numpy as np
+
+
+def pad_block(h, feature_dim=12):  # logical dim: any value is fine
+    d_pad = 48  # expect: JG304
+    out = np.zeros((h.shape[0], d_pad), dtype=np.float32)
+    out[:, :feature_dim] = h
+    return out
+
+
+def build_program(feature_dim=100):
+    dim_tier = 96  # expect: JG304
+    feature_tier = 24  # expect: JG304
+    auto_tier = 0  # 0 = pick from FEATURE_TIERS, allowed
+    good_pad = 128
+    return dim_tier, feature_tier, auto_tier, good_pad
+
+
+def layer(h, w, gcn_dim_tier=20):  # expect: JG304
+    lane_width = 40  # expect: JG304
+    return h[:, :lane_width] @ w
