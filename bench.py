@@ -1076,6 +1076,23 @@ def worker() -> None:
                 "error": f"{type(e).__name__}: {e}"[:500],
             })
 
+    # multi-chip chaos stage (ISSUE 8, optional: MULTICHIP_CHAOS=1): the
+    # seeded 8-device-dryrun soak — shard preemption + collective timeout
+    # + one torn manifest write, completed via cross-shard auto-resume with
+    # bitwise-identical state — recorded into the MULTICHIP_r* artifact
+    # vocabulary (recovered_supersteps, resume_ms, shard_skew, per-shard
+    # ledger totals)
+    if os.environ.get("MULTICHIP_CHAOS", "0") == "1":
+        try:
+            with _stage_span("multichip_chaos"):
+                _multichip_chaos_stage(t0)
+        except Exception as e:
+            _hb(f"multichip_chaos stage FAILED {type(e).__name__}: {e}", t0)
+            _emit({
+                "stage": "multichip_chaos", "ok": False,
+                "error": f"{type(e).__name__}: {e}"[:500],
+            })
+
     # pallas kernel evidence (VERDICT r2 #5): compiled run at s16 with
     # parity vs the ell result; failure is recorded, not fatal. The stage
     # runs LAST and under a watchdog: a hung Mosaic compile through the
@@ -1208,6 +1225,57 @@ def _chaos_stage(t0):
     })
     graph2.close()
     _hb(f"chaos stage ok ({present}/{n_txs} present)", t0)
+
+
+def _multichip_chaos_stage(t0):
+    """8-virtual-device chaos soak via the hermetic dryrun subprocess
+    (__graft_entry__._chaos_multichip_inproc): injected shard preemption,
+    collective timeout, straggler skew, and a torn manifest write, all
+    absorbed by sharded-checkpoint auto-resume with bitwise-identical
+    final state on {sharded x ell/segment, cpu x ell/hybrid}. The
+    subprocess re-execs with the forced CPU mesh, so this stage is safe
+    to run from a TPU-configured bench process."""
+    import json
+    import subprocess
+    import sys
+    import tempfile
+
+    n_dev = int(os.environ.get("MULTICHIP_CHAOS_DEVICES", "8"))
+    repo = os.path.dirname(os.path.abspath(__file__))
+    with tempfile.TemporaryDirectory() as d:
+        out_path = os.path.join(d, "multichip_chaos.json")
+        env = dict(os.environ)
+        env["MULTICHIP_CHAOS"] = "1"
+        env["MULTICHIP_OUT"] = out_path
+        w0 = time.perf_counter()
+        res = subprocess.run(
+            [sys.executable, "-c",
+             f"import __graft_entry__ as ge; ge.dryrun_multichip({n_dev})"],
+            cwd=repo, env=env, capture_output=True, text=True,
+            timeout=float(os.environ.get("MULTICHIP_CHAOS_TIMEOUT_S", "600")),
+        )
+        wall_s = time.perf_counter() - w0
+        if res.returncode != 0 or not os.path.exists(out_path):
+            _emit({
+                "stage": "multichip_chaos", "ok": False,
+                "rc": res.returncode,
+                "error": (res.stderr or "")[-500:],
+            })
+            _hb(f"multichip_chaos FAILED rc={res.returncode}", t0)
+            return
+        with open(out_path) as f:
+            chaos = json.load(f)
+    _emit({
+        "stage": "multichip_chaos",
+        "ok": True,
+        "wall_s": round(wall_s, 3),
+        **chaos,
+    })
+    _hb(
+        f"multichip_chaos ok (recovered_supersteps="
+        f"{chaos['recovered_supersteps']}, skew={chaos['shard_skew']})",
+        t0,
+    )
 
 
 def _chaos_flight_dump() -> dict:

@@ -91,6 +91,13 @@ RULES: Dict[str, Rule] = {
              "feature blocks pad to pow2 lane tiers so tree_dot/"
              "tree_matmul contractions are complete trees and rows stay "
              "VPU/MXU lane-aligned; 0 means auto-pick)"),
+        Rule("JG305", SEV_ERROR,
+             "direct open-for-write on a checkpoint/manifest path: "
+             "durability files must go through atomic tmp + rename "
+             "(tempfile.mkstemp + os.replace, previous file demoted to "
+             ".prev) — a crash mid-open(path, 'w') leaves a torn file AT "
+             "THE COMMITTED NAME, exactly the loss the checkpoint format "
+             "exists to prevent"),
     ]
 }
 
@@ -291,6 +298,7 @@ class Analyzer:
         """Returns (findings, files_scanned). Suppressed findings are kept
         (marked) only when `keep_suppressed`."""
         from janusgraph_tpu.analysis import (
+            checkpoint_rules,
             lock_rules,
             robustness_rules,
             shape_rules,
@@ -313,6 +321,7 @@ class Analyzer:
             findings.extend(shape_rules.check_module(mod))
             findings.extend(lock_rules.check_module(mod, lock_graph))
             findings.extend(robustness_rules.check_module(mod))
+            findings.extend(checkpoint_rules.check_module(mod))
         findings.extend(lock_graph.order_findings())
 
         out = []

@@ -479,6 +479,26 @@ COMPUTER_NS.option(
 COMPUTER_NS.option(
     "checkpoint-path", str, "directory/file for OLAP superstep checkpoints", "",
 )
+COMPUTER_NS.option(
+    "shard-checkpoint-path", str,
+    "directory for SHARDED checkpoints (per-shard state slices + an "
+    "atomically committed manifest; olap/sharded_checkpoint.py) — the "
+    "multi-chip auto-resume consistency cut. Empty = fall back to the "
+    "single-file computer.checkpoint-path format", "",
+)
+COMPUTER_NS.option(
+    "shard-checkpoint-every", int,
+    "supersteps between sharded-checkpoint manifests (0 = use "
+    "computer.checkpoint-every; read in GraphComputer._submit)", 0,
+    Mutability.MASKABLE, lambda v: v >= 0,
+)
+COMPUTER_NS.option(
+    "shard-checkpoint-shards", int,
+    "state-slice count when a NON-mesh executor (the CPU oracle) writes "
+    "the sharded checkpoint format (0 = single-file format; the sharded "
+    "executor always slices by its mesh size)", 0,
+    Mutability.MASKABLE, lambda v: v >= 0,
+)
 STORAGE.option(
     "scan-batch-size", int, "rows per scan-framework batch", 4096,
     Mutability.MASKABLE, lambda v: v > 0,
@@ -698,6 +718,45 @@ STORAGE.option(
     "OLAP superstep at which SuperstepPreempted is raised once (-1 = "
     "off) — absorbed by the executors' checkpoint auto-resume", -1,
     Mutability.LOCAL, lambda v: v >= -1,
+)
+STORAGE.option(
+    "faults.shard-preempt-superstep", int,
+    "sharded-executor superstep at which ONE shard is preempted "
+    "mid-superstep (ShardPreempted; -1 = off) — absorbed by the "
+    "cross-shard auto-resume rolling every shard back to the last "
+    "complete manifest (the consistency cut)", -1,
+    Mutability.LOCAL, lambda v: v >= -1,
+)
+STORAGE.option(
+    "faults.shard-preempt-shard", int,
+    "which shard the scheduled shard preemption hits (-1 = pick "
+    "deterministically from the seed)", -1,
+    Mutability.LOCAL, lambda v: v >= -1,
+)
+STORAGE.option(
+    "faults.shard-collective-timeout-at", int,
+    "cross-shard collective index (one per superstep barrier) at which "
+    "CollectiveTimeout is raised once (-1 = off)", -1,
+    Mutability.LOCAL, lambda v: v >= -1,
+)
+STORAGE.option(
+    "faults.shard-halo-drop-at", int,
+    "halo-exchange index at which a destination-binned halo batch is "
+    "dropped (HaloDropped; -1 = off)", -1,
+    Mutability.LOCAL, lambda v: v >= -1,
+)
+STORAGE.option(
+    "faults.shard-straggler-ms", float,
+    "injected per-shard latency skew length (straggler simulation; "
+    "pairs with shard-straggler-rate)", 0.0,
+    Mutability.LOCAL, lambda v: v >= 0,
+)
+STORAGE.option(
+    "faults.shard-straggler-rate", float,
+    "probability a given (superstep, shard) pair runs shard-straggler-ms "
+    "late — decisions are pure in the absolute pair, so auto-resume "
+    "replays see identical skew", 0.0,
+    Mutability.LOCAL, lambda v: 0.0 <= v <= 1.0,
 )
 STORAGE.option(
     "faults.stores", str,

@@ -55,6 +55,26 @@ class SuperstepPreempted(JanusGraphTPUError):
     checkpointing enabled auto-resume from the last checkpoint."""
 
 
+class ShardPreempted(SuperstepPreempted):
+    """One shard of a multi-chip BSP run was preempted mid-superstep
+    (injected or real). The superstep's collective barrier means no shard
+    can commit the superstep alone, so ALL shards roll back to the last
+    complete sharded-checkpoint manifest (the consistency cut) and replay."""
+
+
+class CollectiveTimeout(SuperstepPreempted):
+    """A cross-shard collective (the halo all_to_all / ring ppermute / psum
+    barrier) timed out or failed. Recoverable exactly like a shard
+    preemption: the superstep never committed on any shard, so the run
+    rolls back to the last manifest and replays."""
+
+
+class HaloDropped(SuperstepPreempted):
+    """A destination-binned halo batch was dropped in flight. The receiving
+    shard cannot aggregate a complete superstep, so the run treats it as a
+    failed collective: roll back to the last manifest and replay."""
+
+
 class IDPoolExhaustedError(JanusGraphTPUError):
     """No more IDs available in the allocation namespace."""
 

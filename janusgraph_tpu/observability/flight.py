@@ -9,13 +9,29 @@ lock), and bounded (``metrics.flight-buffer``).
 Event taxonomy (the ``category`` field):
 
 ==================  =======================================================
-``fault``           an injected chaos fault fired (storage/faults.py)
+``fault``           an injected chaos fault fired (storage/faults.py) —
+                    the ``kind`` field includes the distributed kinds
+                    ``shard_preempt`` / ``collective`` / ``halo_drop`` /
+                    ``straggler``
 ``breaker``         a circuit breaker changed state (storage/circuit.py)
 ``retry_exhausted`` a backend_op retry guard gave up (storage/backend_op.py)
 ``torn_recovery``   TornCommitRecovery rolled a tx forward/back (core/txlog)
 ``checkpoint``      an OLAP checkpoint was written, or load fell back to
-                    ``.prev`` (olap/checkpoint.py)
+                    ``.prev`` (olap/checkpoint.py); sharded-format actions:
+                    ``shard_save`` (manifest committed), ``shard_fallback``
+                    (one slice restored from its ``.prev`` twin),
+                    ``manifest_fallback`` (the whole checkpoint rolled to
+                    ``manifest.json.prev`` — a torn write cost one
+                    interval; olap/sharded_checkpoint.py)
 ``olap_resume``     an executor auto-resumed a preempted superstep run
+                    (``executor`` field: tpu/cpu/sharded; sharded resumes
+                    carry the triggering ``fault`` class and checkpoint
+                    ``format``)
+``shard_skew``      the sharded executor's straggler detector: modeled
+                    slowest-shard/mean skew crossed the threshold or an
+                    injected straggler fired (parallel/sharded.py)
+``multihost``       jax.distributed cluster formation (init / init_ok /
+                    init_failed; parallel/multihost.py)
 ``slow_span``       a span crossed metrics.slow-op-threshold-ms (fed by the
                     tracer's ``on_slow`` hook)
 ``server_error``    the query server hit an unhandled evaluation error

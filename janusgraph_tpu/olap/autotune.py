@@ -448,23 +448,57 @@ def pick_tier(need: int, schedule: Tuple[int, ...], hi: int) -> int:
 # last measured record next to the checkpoint file and loads it back on
 # the next run, so achieved-bandwidth calibration carries across process
 # restarts the same way checkpoints carry state.
+#
+# v2 keys records by SHARD COUNT inside one file: a mesh superstep's
+# achieved bandwidth aggregates S chips' HBM plus the collective, which is
+# NOT the single-device calibration — an 8-chip run writing the same
+# record the 1-chip run reads would poison the next single-device
+# decide(). Each layout (shard count) now calibrates only itself; v1
+# files (one flat record) are read back as the shard_count=1 entry.
 
-_MEASURED_VERSION = 1
+_MEASURED_VERSION = 2
+
+_RECORD_FIELDS = ("strategy", "pad_ratio", "superstep_ms", "roofline_by_tier")
 
 
-def save_measured(path: str, record: dict) -> None:
-    """Atomically persist one measured record (tmp + rename, like the
-    checkpoint writer). Persistence must never fail a run — any I/O error
-    is swallowed (the next run simply decides from the model alone)."""
+def _read_measured_records(path: str) -> Optional[dict]:
+    """{shard_count(str): record} from a v1 or v2 file; None when missing
+    or unreadable."""
+    import json
+    import os
+
+    if not path or not os.path.exists(path):
+        return None
+    try:
+        with open(path) as f:
+            payload = json.load(f)
+    except (OSError, ValueError):
+        return None
+    if not isinstance(payload, dict):
+        return None
+    if payload.get("version") == 1:
+        return {"1": {k: payload.get(k) for k in _RECORD_FIELDS}}
+    if payload.get("version") == _MEASURED_VERSION:
+        records = payload.get("records")
+        return records if isinstance(records, dict) else None
+    return None
+
+
+def save_measured(path: str, record: dict, shard_count: int = 1) -> None:
+    """Atomically persist one measured record under its shard-count key
+    (tmp + rename, like the checkpoint writer), preserving every other
+    layout's record in the file. Persistence must never fail a run — any
+    I/O error is swallowed (the next run simply decides from the model
+    alone)."""
     import json
     import os
     import tempfile
 
-    payload = {"version": _MEASURED_VERSION}
-    payload.update({
-        k: record.get(k)
-        for k in ("strategy", "pad_ratio", "superstep_ms", "roofline_by_tier")
-    })
+    records = _read_measured_records(path) or {}
+    records[str(int(shard_count))] = {
+        k: record.get(k) for k in _RECORD_FIELDS
+    }
+    payload = {"version": _MEASURED_VERSION, "records": records}
     try:
         d = os.path.dirname(os.path.abspath(path)) or "."
         os.makedirs(d, exist_ok=True)
@@ -481,20 +515,15 @@ def save_measured(path: str, record: dict) -> None:
         return
 
 
-def load_measured(path: str) -> Optional[dict]:
-    """Load a persisted measured record; None when missing, unreadable,
-    from a different version, or not carrying the calibration fields."""
-    import json
-    import os
-
-    if not path or not os.path.exists(path):
+def load_measured(path: str, shard_count: int = 1) -> Optional[dict]:
+    """Load the persisted measured record for one shard count; None when
+    missing, unreadable, from an unknown version, or not carrying the
+    calibration fields. v1 files answer only shard_count=1."""
+    records = _read_measured_records(path)
+    if records is None:
         return None
-    try:
-        with open(path) as f:
-            rec = json.load(f)
-    except (OSError, ValueError):
-        return None
-    if not isinstance(rec, dict) or rec.get("version") != _MEASURED_VERSION:
+    rec = records.get(str(int(shard_count)))
+    if not isinstance(rec, dict):
         return None
     if not rec.get("superstep_ms") or not rec.get("pad_ratio"):
         return None

@@ -235,8 +235,14 @@ class GraphComputer:
         if cfg is not None and self.executor_kind == "sharded":
             run_kwargs = {
                 "sync_every": cfg.get("computer.sync-every"),
-                "checkpoint_every": cfg.get("computer.checkpoint-every"),
+                "checkpoint_every": (
+                    cfg.get("computer.shard-checkpoint-every")
+                    or cfg.get("computer.checkpoint-every")
+                ),
                 "checkpoint_path": cfg.get("computer.checkpoint-path") or None,
+                "shard_checkpoint_dir": (
+                    cfg.get("computer.shard-checkpoint-path") or None
+                ),
                 "frontier": cfg.get("computer.frontier"),
                 "exchange": cfg.get("computer.exchange"),
                 "agg": cfg.get("computer.agg"),
@@ -278,18 +284,36 @@ class GraphComputer:
             run_kwargs = {
                 "checkpoint_every": cfg.get("computer.checkpoint-every"),
                 "checkpoint_path": cfg.get("computer.checkpoint-path") or None,
+                "shard_checkpoint_dir": (
+                    cfg.get("computer.shard-checkpoint-path") or None
+                ),
+                "checkpoint_shards": cfg.get(
+                    "computer.shard-checkpoint-shards"
+                ),
                 "features_dim_tier": cfg.get("computer.features-dim-tier"),
                 "features_native_matmul": cfg.get(
                     "computer.features-native-matmul"
                 ),
             }
+            # the CPU oracle writes the sharded format only when a slice
+            # count is configured — a bare shard-checkpoint-path on a
+            # single-device run still means the single-file format
+            if not run_kwargs["checkpoint_shards"]:
+                run_kwargs["shard_checkpoint_dir"] = None
         # chaos wiring: a graph opened with storage.faults.enabled carries
         # a FaultPlan; its superstep-preemption hook rides into the
-        # executors, where checkpoint auto-resume absorbs it
+        # executors, where checkpoint auto-resume absorbs it. The sharded
+        # executor gets the mesh-aware hook (shard preemption, collective
+        # timeout, halo drop, straggler skew) — cross-shard auto-resume
+        # rolls every shard back to the last complete manifest.
         plan = getattr(self.graph, "fault_plan", None)
-        if self.executor_kind in ("tpu", "cpu"):
+        if self.executor_kind in ("tpu", "cpu", "sharded"):
             if plan is not None:
-                run_kwargs["fault_hook"] = plan.olap_hook
+                run_kwargs["fault_hook"] = (
+                    plan.sharded_hook
+                    if self.executor_kind == "sharded"
+                    else plan.olap_hook
+                )
             if cfg is not None:
                 run_kwargs["resume_attempts"] = cfg.get(
                     "computer.resume-attempts"
@@ -345,6 +369,8 @@ def run_on(
     features_dim_tier: int = None,
     features_native_matmul: bool = None,
     cpu_strategy: str = "scalar",
+    shard_checkpoint_dir: str = None,
+    checkpoint_shards: int = 0,
 ):
     # dense-feature tier program configuration (computer.features-*):
     # applied here so EVERY executor sees the same padded lane tier and
@@ -364,6 +390,8 @@ def run_on(
             checkpoint_every=checkpoint_every,
             fault_hook=fault_hook,
             resume_attempts=resume_attempts,
+            shard_checkpoint_dir=shard_checkpoint_dir,
+            checkpoint_shards=checkpoint_shards,
         )
     if executor == "sharded":
         from janusgraph_tpu.parallel import ShardedExecutor
@@ -377,6 +405,9 @@ def run_on(
             checkpoint_every=checkpoint_every,
             checkpoint_path=checkpoint_path,
             frontier=frontier,
+            fault_hook=fault_hook,
+            resume_attempts=resume_attempts,
+            shard_checkpoint_dir=shard_checkpoint_dir,
         )
     if executor == "tpu":
         from janusgraph_tpu.olap.tpu_executor import TPUExecutor

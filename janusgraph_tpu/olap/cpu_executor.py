@@ -62,6 +62,8 @@ class CPUExecutor:
         resume: bool = False,
         fault_hook=None,
         resume_attempts: int = 3,
+        shard_checkpoint_dir: str = None,
+        checkpoint_shards: int = 0,
     ) -> Dict[str, np.ndarray]:
         """Run to termination. Same checkpoint/auto-resume contract as
         TPUExecutor.run: save every `checkpoint_every` supersteps, and a
@@ -69,7 +71,13 @@ class CPUExecutor:
         superstep — e.g. FaultPlan.olap_hook) reloads the last checkpoint
         and replays, up to `resume_attempts` times. The replay recomputes
         the exact same numpy arithmetic from the saved arrays, so the
-        final state is bitwise-identical to a fault-free run."""
+        final state is bitwise-identical to a fault-free run.
+
+        `shard_checkpoint_dir` + `checkpoint_shards=S` write the SHARDED
+        checkpoint format instead (per-shard slices + atomic manifest;
+        olap/sharded_checkpoint.py) — the oracle side of the cross-shard
+        format's executor-portability contract: a checkpoint written by
+        the mesh executor restores here and vice versa."""
         from janusgraph_tpu.exceptions import SuperstepPreempted
 
         attempts = 0
@@ -77,7 +85,7 @@ class CPUExecutor:
             try:
                 return self._run(
                     program, checkpoint_path, checkpoint_every, resume,
-                    fault_hook,
+                    fault_hook, shard_checkpoint_dir, checkpoint_shards,
                 )
             except SuperstepPreempted:
                 from janusgraph_tpu.observability import (
@@ -86,9 +94,10 @@ class CPUExecutor:
                 )
 
                 registry.counter("olap.preemptions").inc()
-                if not (checkpoint_path and checkpoint_every) or (
-                    attempts >= resume_attempts
-                ):
+                if not (
+                    (checkpoint_path or shard_checkpoint_dir)
+                    and checkpoint_every
+                ) or (attempts >= resume_attempts):
                     raise
                 attempts += 1
                 resume = True
@@ -96,6 +105,7 @@ class CPUExecutor:
                 flight_recorder.record(
                     "olap_resume", executor="cpu", attempt=attempts,
                     program=type(program).__name__,
+                    format="sharded" if shard_checkpoint_dir else "single",
                 )
 
     def _run(
@@ -105,6 +115,8 @@ class CPUExecutor:
         checkpoint_every: int,
         resume: bool,
         fault_hook,
+        shard_checkpoint_dir: str = None,
+        checkpoint_shards: int = 0,
     ) -> Dict[str, np.ndarray]:
         from janusgraph_tpu.olap.vertex_program import (
             check_weighted_transforms,
@@ -125,10 +137,17 @@ class CPUExecutor:
         memory = Memory()
         state = None
         start_step = 0
-        if resume and checkpoint_path:
-            from janusgraph_tpu.olap.checkpoint import load_checkpoint
+        if resume and (checkpoint_path or shard_checkpoint_dir):
+            if shard_checkpoint_dir:
+                from janusgraph_tpu.olap.sharded_checkpoint import (
+                    load_sharded_checkpoint,
+                )
 
-            ck = load_checkpoint(checkpoint_path)
+                ck = load_sharded_checkpoint(shard_checkpoint_dir)
+            else:
+                from janusgraph_tpu.olap.checkpoint import load_checkpoint
+
+                ck = load_checkpoint(checkpoint_path)
             if ck is not None:
                 ck_state, ck_mem, start_step = ck
                 state = {k: np.asarray(v) for k, v in ck_state.items()}
@@ -227,18 +246,35 @@ class CPUExecutor:
                 "combiner": op,
             })
             steps_done = step + 1
-            if checkpoint_path and checkpoint_every and (
+            if (checkpoint_path or shard_checkpoint_dir) and (
+                checkpoint_every
+            ) and (
                 steps_done % checkpoint_every == 0
                 or steps_done == program.max_iterations
             ):
-                from janusgraph_tpu.olap.checkpoint import save_checkpoint
+                if shard_checkpoint_dir:
+                    from janusgraph_tpu.olap.sharded_checkpoint import (
+                        save_sharded_checkpoint,
+                    )
 
-                save_checkpoint(
-                    checkpoint_path,
-                    {k: np.asarray(v) for k, v in state.items()},
-                    memory.values,
-                    steps_done,
-                )
+                    save_sharded_checkpoint(
+                        shard_checkpoint_dir,
+                        {k: np.asarray(v) for k, v in state.items()},
+                        memory.values,
+                        steps_done,
+                        max(1, checkpoint_shards),
+                    )
+                else:
+                    from janusgraph_tpu.olap.checkpoint import (
+                        save_checkpoint,
+                    )
+
+                    save_checkpoint(
+                        checkpoint_path,
+                        {k: np.asarray(v) for k, v in state.items()},
+                        memory.values,
+                        steps_done,
+                    )
             if program.terminate(memory):
                 break
         self._publish_run(program, records)

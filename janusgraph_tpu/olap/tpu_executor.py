@@ -396,7 +396,9 @@ class TPUExecutor:
         if measured is None and self._measured_path:
             # a prior executor lifetime's persisted record (computer.
             # autotune-persist): achieved bandwidth calibrates the model
-            measured = autotune.load_measured(self._measured_path)
+            measured = autotune.load_measured(
+                self._measured_path, shard_count=1
+            )
         stats = autotune.GraphStats.from_csr(
             self.csr, undirected=undirected,
             max_capacity=self.ell_max_capacity or (1 << 14),
@@ -1346,12 +1348,15 @@ class TPUExecutor:
             from janusgraph_tpu.olap import autotune as _at
 
             walls = sorted(float(r.get("wall_ms", 0.0)) for r in records)
+            # single-device lifetime: the shard_count=1 slot (a multi-chip
+            # run records under its own mesh size — the layouts must not
+            # clobber each other's calibration)
             _at.save_measured(self._measured_path, {
                 "strategy": strategy_resolved,
                 "pad_ratio": pad_ratio,
                 "superstep_ms": walls[len(walls) // 2],
                 "roofline_by_tier": info.get("roofline_by_tier"),
-            })
+            }, shard_count=1)
         registry.record_run("olap", info)
 
     def _device_memory(self, info) -> dict:

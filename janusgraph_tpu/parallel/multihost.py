@@ -99,10 +99,31 @@ def init_multihost(
     except Exception:  # unknown option on this jax: leave defaults alone
         pass
 
-    jax.distributed.initialize(
-        coordinator_address=coordinator_address,
-        num_processes=num_processes,
-        process_id=process_id,
+    # the black box should carry the cluster-formation timeline: a wedged
+    # coordinator (or one host missing) is the first question an incident
+    # review asks, and by then the process that knows may be gone
+    from janusgraph_tpu.observability import flight_recorder
+
+    flight_recorder.record(
+        "multihost", action="init",
+        processes=int(num_processes), process_id=int(process_id),
+    )
+    try:
+        jax.distributed.initialize(
+            coordinator_address=coordinator_address,
+            num_processes=num_processes,
+            process_id=process_id,
+        )
+    except Exception as e:
+        flight_recorder.record(
+            "multihost", action="init_failed",
+            processes=int(num_processes), process_id=int(process_id),
+            error=f"{type(e).__name__}: {e}"[:200],
+        )
+        raise
+    flight_recorder.record(
+        "multihost", action="init_ok",
+        processes=int(num_processes), process_id=int(process_id),
     )
     return process_id
 

@@ -35,6 +35,9 @@ test technique.
 
 from __future__ import annotations
 
+import inspect
+import os
+import time
 from typing import Dict, List, Optional, Tuple
 
 import numpy as np
@@ -48,6 +51,11 @@ from janusgraph_tpu.olap.vertex_program import (
 )
 
 _ELL_MAX_CAPACITY = 1 << 14
+
+#: modeled per-shard skew (slowest/mean) above which the run leaves a
+#: ``shard_skew`` event on the flight-recorder timeline even without an
+#: injected straggler — a 2x-imbalanced mesh wastes half its silicon
+SKEW_FLIGHT_THRESHOLD = 2.0
 
 
 class ShardedCSR:
@@ -966,9 +974,9 @@ class ShardedExecutor:
             or self.csr.num_edges >= TPUExecutor.FRONTIER_CC_MIN_EDGES
         )
 
-    def _run_frontier(self, program: VertexProgram) -> Dict[str, np.ndarray]:
-        import time
-
+    def _run_frontier(
+        self, program: VertexProgram, fault_hook=None
+    ) -> Dict[str, np.ndarray]:
         from janusgraph_tpu.olap.programs.connected_components import (
             ConnectedComponentsProgram,
         )
@@ -980,9 +988,9 @@ class ShardedExecutor:
             self._frontier_engine = ShardedFrontierEngine(self)
         t0 = time.perf_counter()
         if type(program) is ConnectedComponentsProgram:
-            out = self._frontier_engine.run_cc(program)
+            out = self._frontier_engine.run_cc(program, fault_hook=fault_hook)
         else:
-            out = self._frontier_engine.run(program)
+            out = self._frontier_engine.run(program, fault_hook=fault_hook)
         trace = self._frontier_engine.last_trace
         self.last_run_info = {
             "path": "frontier",
@@ -991,6 +999,239 @@ class ShardedExecutor:
             "tiers": trace,
         }
         return out
+
+    # ------------------------------------------------- fault/checkpoint glue
+    def _bind_hook(self, fault_hook):
+        """Normalize a fault hook to hook(step) -> straggler events. Mesh-
+        aware hooks (FaultPlan.sharded_hook) take (step, num_shards) and
+        return straggler records; single-arg hooks (FaultPlan.olap_hook,
+        test lambdas) are called as-is."""
+        if fault_hook is None:
+            return None
+        try:
+            params = [
+                p for p in inspect.signature(fault_hook).parameters.values()
+                if p.kind in (
+                    inspect.Parameter.POSITIONAL_ONLY,
+                    inspect.Parameter.POSITIONAL_OR_KEYWORD,
+                    inspect.Parameter.VAR_POSITIONAL,
+                )
+            ]
+            mesh_aware = len(params) >= 2 or any(
+                p.kind is inspect.Parameter.VAR_POSITIONAL for p in params
+            )
+        except (TypeError, ValueError):
+            mesh_aware = False
+        S = self.num_shards
+        if mesh_aware:
+            return lambda step: fault_hook(step, S)
+        return fault_hook
+
+    def _consult(self, hook, step: int) -> None:
+        """One superstep-boundary fault consultation; straggler skew
+        records accumulate for the run report."""
+        if hook is None:
+            return
+        events = hook(step)
+        if events:
+            self._straggler_events.extend(events)
+
+    def _save_ck(
+        self, checkpoint_path, shard_dir, state_host, mem_values, steps
+    ) -> None:
+        if shard_dir:
+            from janusgraph_tpu.olap.sharded_checkpoint import (
+                save_sharded_checkpoint,
+            )
+
+            save_sharded_checkpoint(
+                shard_dir, state_host, mem_values, steps, self.num_shards
+            )
+        else:
+            from janusgraph_tpu.olap.checkpoint import save_checkpoint
+
+            save_checkpoint(checkpoint_path, state_host, mem_values, steps)
+        self._ck_saves += 1
+
+    def _load_ck(self, checkpoint_path, shard_dir):
+        if shard_dir:
+            from janusgraph_tpu.olap.sharded_checkpoint import (
+                load_sharded_checkpoint,
+            )
+
+            ck = load_sharded_checkpoint(shard_dir)
+        elif checkpoint_path:
+            from janusgraph_tpu.olap.checkpoint import load_checkpoint
+
+            ck = load_checkpoint(checkpoint_path)
+        else:
+            ck = None
+        if ck is not None and self._resume_t_catch is not None:
+            # catch -> state restored: the recovery latency an operator
+            # actually pays (the replay itself is forward progress)
+            self._resume_ms += (
+                time.perf_counter() - self._resume_t_catch
+            ) * 1000.0
+            self._resume_t_catch = None
+        return ck
+
+    def _device_kind(self) -> str:
+        try:
+            return str(np.asarray(self.mesh.devices).flat[0].platform)
+        except Exception:
+            return "cpu"
+
+    # -------------------------------------------------- per-shard reporting
+    def _shard_report(self, sc: ShardedCSR, records: List[dict]) -> None:
+        """Plan-derived per-shard ledger + roofline, straggler detection,
+        and the skew gauge. One SPMD dispatch runs every shard in lockstep
+        (the barrier hides individual shard walls), so per-shard cost is
+        priced from the shard plan — real edge/vertex counts per shard —
+        and the superstep wall is attributed to the modeled-slowest shard;
+        injected straggler skew (the chaos plan's records) adds on top.
+        Host code only; nothing here is traced."""
+        from janusgraph_tpu.observability import (
+            flight_recorder,
+            profiler,
+            registry,
+            tracer,
+        )
+
+        S = sc.num_shards
+        Np = sc.shard_size
+        offsets = getattr(sc, "_offsets", None)
+        edges = (
+            [int(offsets[s + 1] - offsets[s]) for s in range(S)]
+            if offsets is not None else [0] * S
+        )
+        n_steps = max(1, len(records))
+        mean_wall = (
+            sum(r.get("wall_ms", 0.0) for r in records) / n_steps
+            if records else 0.0
+        )
+        peaks = profiler.device_peaks(self._device_kind())
+        strag: Dict[int, float] = {}
+        for ev in self._straggler_events:
+            strag[ev["shard"]] = strag.get(ev["shard"], 0.0) + float(ev["ms"])
+        costs = []
+        for s in range(S):
+            verts = max(0, min(sc.real_n - s * Np, Np))
+            costs.append((
+                verts,
+                profiler.estimate_superstep_cost(
+                    max(verts, 1), max(edges[s], 1)
+                ),
+            ))
+        max_edges = max(max(edges), 1)
+        per = []
+        t_by_shard = []
+        for s in range(S):
+            verts, cost = costs[s]
+            # the barrier wall is set by the busiest shard: scale the
+            # measured mean superstep wall by relative modeled edge load
+            modeled_ms = mean_wall * edges[s] / max_edges
+            strag_ms = strag.get(s, 0.0)
+            t_by_shard.append(modeled_ms + strag_ms / n_steps)
+            point = profiler.roofline_point(
+                cost["flops"], cost["bytes_accessed"],
+                modeled_ms if modeled_ms > 0 else 0.0, peaks,
+            )
+            per.append({
+                "shard": s,
+                "vertices": verts,
+                "edges": edges[s],
+                "modeled_ms": round(modeled_ms, 4),
+                "straggler_ms": round(strag_ms, 3),
+                "ledger": {
+                    "cells_read": edges[s],
+                    "bytes_read": int(cost["bytes_accessed"]),
+                    "bytes_written": 8 * verts,
+                },
+                "roofline": {
+                    "flops": cost["flops"],
+                    "bytes_accessed": cost["bytes_accessed"],
+                    "cost_source": cost["cost_source"],
+                    **point,
+                },
+            })
+        mean_t = sum(t_by_shard) / S if S else 0.0
+        skew = (max(t_by_shard) / mean_t) if mean_t > 0 else 1.0
+        slowest = int(np.argmax(t_by_shard)) if t_by_shard else 0
+        block = {
+            "count": S,
+            "skew": round(skew, 4),
+            "slowest_shard": slowest,
+            "straggler_events": len(self._straggler_events),
+            "straggler_ms_total": round(sum(strag.values()), 3),
+            "boundary_elems": getattr(sc, "comm_a2a_elems", None),
+            "per_shard": per,
+        }
+        self.last_run_info["shards"] = block
+        registry.gauge("olap.shard.skew").set(skew)
+        registry.counter("olap.sharded.runs").inc()
+        # ambient resource ledger: the run's plan-derived totals (one
+        # message gather per edge + state write-back per vertex)
+        profiler.accrue(
+            cells_read=sum(edges),
+            bytes_read=sum(int(c["bytes_accessed"]) for _v, c in costs),
+            bytes_written=8 * sc.real_n,
+        )
+        # slowest-shard exemplar span: the flamegraph/trace hook for "which
+        # shard sets the barrier pace" — plus a flight event when skew is
+        # pathological or a straggler was injected
+        with tracer.span(
+            "olap.shard.slowest",
+            shard=slowest,
+            modeled_ms=round(t_by_shard[slowest], 4) if t_by_shard else 0.0,
+            skew=round(skew, 4),
+        ):
+            pass
+        if self._straggler_events or skew >= SKEW_FLIGHT_THRESHOLD:
+            flight_recorder.record(
+                "shard_skew",
+                skew=round(skew, 4),
+                slowest_shard=slowest,
+                straggler_events=len(self._straggler_events),
+                injected_ms=round(sum(strag.values()), 3),
+            )
+
+    def _persist_measured(
+        self, sc: ShardedCSR, checkpoint_path, shard_dir, records
+    ) -> None:
+        """Measured-record persistence for the mesh: keyed by SHARD COUNT
+        inside the shared .autotune.json, so an 8-chip run calibrates the
+        next 8-chip run without clobbering the single-device record
+        (olap/autotune.save_measured v2)."""
+        if not records:
+            return
+        path = (
+            os.path.join(shard_dir, "autotune.json") if shard_dir
+            else (checkpoint_path + ".autotune.json" if checkpoint_path
+                  else None)
+        )
+        if not path:
+            return
+        from janusgraph_tpu.olap import autotune
+
+        prior = autotune.load_measured(path, shard_count=self.num_shards)
+        mean_wall = sum(r.get("wall_ms", 0.0) for r in records) / max(
+            1, len(records)
+        )
+        autotune.save_measured(
+            path,
+            {
+                "strategy": f"sharded-{self.exchange}-{self.agg}",
+                "pad_ratio": round(sc.padded_n / max(1, sc.real_n), 4),
+                "superstep_ms": round(mean_wall, 3),
+                "roofline_by_tier": None,
+            },
+            shard_count=self.num_shards,
+        )
+        self.last_run_info["autotune_persist"] = {
+            "path": path,
+            "shard_count": self.num_shards,
+            "calibrated": prior is not None,
+        }
 
     def run(
         self,
@@ -1001,6 +1242,9 @@ class ShardedExecutor:
         checkpoint_every: int = 0,
         resume: bool = False,
         frontier: str = "auto",
+        fault_hook=None,
+        resume_attempts: int = 3,
+        shard_checkpoint_dir: str = None,
     ) -> Dict[str, np.ndarray]:
         """Run to termination. `fused` (default auto): constant-combiner
         programs with terminate_device compile spans of the run into one
@@ -1009,9 +1253,26 @@ class ShardedExecutor:
         aggregator fetches (see TPUExecutor.run). `frontier`:
         "auto"/"always"/"off" — the ShortestPath family runs per-shard
         frontier-compacted supersteps when eligible (checkpointing rides
-        the dense path: frontier runs are short)."""
-        import jax.numpy as jnp
+        the dense path: frontier runs are short).
 
+        `shard_checkpoint_dir` — save the SHARDED checkpoint format (per-
+        shard slices + atomic manifest; olap/sharded_checkpoint.py) every
+        `checkpoint_every` supersteps instead of the single-file
+        `checkpoint_path` format.
+
+        `fault_hook` (e.g. FaultPlan.sharded_hook) is consulted at every
+        host-visible superstep boundary — the fused path's granularity is
+        one checkpoint chunk — and may raise SuperstepPreempted (incl.
+        ShardPreempted / CollectiveTimeout / HaloDropped). With
+        checkpointing enabled, ALL shards roll back to the last complete
+        manifest (the BSP barrier's consistency cut) and replay, up to
+        `resume_attempts` times; the replay recomputes the identical SPMD
+        program over exact saved arrays, so the final state is bitwise-
+        identical to a fault-free run. Frontier runs carry no checkpoint
+        and simply restart from scratch (they are short and deterministic).
+        Mesh-aware hooks also return straggler skew records, which feed the
+        run's per-shard report and the `olap.shard.skew` gauge.
+        """
         from janusgraph_tpu.olap.vertex_program import (
             check_weighted_transforms,
         )
@@ -1021,8 +1282,9 @@ class ShardedExecutor:
             raise ValueError(f"unknown frontier mode: {frontier!r}")
         from janusgraph_tpu.olap.tpu_executor import TPUExecutor
 
+        use_frontier = False
         if frontier != "off" and TPUExecutor._frontier_family(program):
-            if checkpoint_path:
+            if checkpoint_path or shard_checkpoint_dir:
                 # "always" must never silently time the dense path under a
                 # frontier label (mirrors TPUExecutor.run)
                 if frontier == "always":
@@ -1033,7 +1295,7 @@ class ShardedExecutor:
                         "frontier='auto'"
                     )
             elif self._frontier_eligible(program, frontier):
-                return self._run_frontier(program)
+                use_frontier = True
             elif frontier == "always":
                 raise ValueError(
                     "frontier='always' but the graph exceeds the frontier "
@@ -1045,18 +1307,94 @@ class ShardedExecutor:
         sc = self._sharded(program.undirected)
         if fused is None:
             fused = program.fused_eligible()
-        if fused and type(program).combiner_for is VertexProgram.combiner_for:
-            return self._run_fused(
-                program, sc, checkpoint_path, checkpoint_every, resume
-            )
+        use_fused = (
+            not use_frontier
+            and fused
+            and type(program).combiner_for is VertexProgram.combiner_for
+        )
+
+        from janusgraph_tpu.exceptions import SuperstepPreempted
+        from janusgraph_tpu.observability import flight_recorder, registry
+
+        hook = self._bind_hook(fault_hook)
+        self._straggler_events: List[dict] = []
+        self._ck_saves = 0
+        self._resume_ms = 0.0
+        self._resume_t_catch = None
+        can_resume = bool(
+            (shard_checkpoint_dir or checkpoint_path) and checkpoint_every
+        )
+        resumes = 0
+        while True:
+            try:
+                if use_frontier:
+                    out = self._run_frontier(program, fault_hook=hook)
+                elif use_fused:
+                    out = self._run_fused(
+                        program, sc, checkpoint_path, checkpoint_every,
+                        resume, hook, shard_checkpoint_dir,
+                    )
+                else:
+                    out = self._run_host_loop(
+                        program, sc, sync_every, checkpoint_path,
+                        checkpoint_every, resume, hook,
+                        shard_checkpoint_dir,
+                    )
+                break
+            except SuperstepPreempted as e:
+                registry.counter("olap.preemptions").inc()
+                # frontier runs restart from scratch (deterministic and
+                # short); dense paths need a checkpoint to roll back to
+                if resumes >= resume_attempts or not (
+                    use_frontier or can_resume
+                ):
+                    raise
+                resumes += 1
+                resume = True
+                self._resume_t_catch = time.perf_counter()
+                registry.counter("olap.resumes").inc()
+                registry.counter("olap.sharded.resumes").inc()
+                flight_recorder.record(
+                    "olap_resume", executor="sharded", attempt=resumes,
+                    program=type(program).__name__,
+                    fault=type(e).__name__,
+                    format="sharded" if shard_checkpoint_dir else "single",
+                )
+                if use_frontier:
+                    # nothing to reload: the restart IS the recovery
+                    self._resume_ms += (
+                        time.perf_counter() - self._resume_t_catch
+                    ) * 1000.0
+                    self._resume_t_catch = None
+        if resumes:
+            self.last_run_info["resumes"] = resumes
+            self.last_run_info["resume_ms"] = round(self._resume_ms, 3)
+        if self._ck_saves or can_resume:
+            self.last_run_info["checkpoint"] = {
+                "format": "sharded" if shard_checkpoint_dir else "single",
+                "saves": self._ck_saves,
+                "location": shard_checkpoint_dir or checkpoint_path,
+            }
+        return out
+
+    def _run_host_loop(
+        self,
+        program: VertexProgram,
+        sc: ShardedCSR,
+        sync_every: int,
+        checkpoint_path: str,
+        checkpoint_every: int,
+        resume: bool,
+        hook,
+        shard_checkpoint_dir: str,
+    ) -> Dict[str, np.ndarray]:
+        import jax.numpy as jnp
 
         memory = Memory()
         state = None
         start_step = 0
-        if resume and checkpoint_path:
-            from janusgraph_tpu.olap.checkpoint import load_checkpoint
-
-            ck = load_checkpoint(checkpoint_path)
+        if resume and (checkpoint_path or shard_checkpoint_dir):
+            ck = self._load_ck(checkpoint_path, shard_checkpoint_dir)
             if ck is not None:
                 ck_state, ck_mem, start_step = ck
                 fresh, _m = program.setup(_GlobalView(sc), np)
@@ -1078,7 +1416,12 @@ class ShardedExecutor:
 
         gargs = self._graph_args(sc, program.undirected)
         steps_done = start_step
+        records: List[dict] = []
         for step in range(start_step, program.max_iterations):
+            # fault boundary: the barrier between supersteps — the one
+            # point where no shard holds partial superstep state
+            self._consult(hook, step)
+            t_step = time.perf_counter()
             op = program.combiner_for(step)
             ch = program.channel_for(step)
             if ch is not None:
@@ -1098,18 +1441,25 @@ class ShardedExecutor:
             }
             steps_done += 1
             last = step == program.max_iterations - 1
+            records.append({
+                "step": step,
+                "wall_ms": round(
+                    (time.perf_counter() - t_step) * 1000.0, 3
+                ),
+            })
             if steps_done % sync_every == 0 or last:
                 host_vals = self.jax.device_get(metrics)
                 memory.values = {k: float(v) for k, v in host_vals.items()}
                 memory.superstep = steps_done
-                if checkpoint_path and checkpoint_every and (
-                    steps_done % checkpoint_every == 0 or last
-                ):
-                    from janusgraph_tpu.olap.checkpoint import save_checkpoint
-
-                    save_checkpoint(
-                        checkpoint_path,
-                        {k: self._fetch(v)[: sc.real_n] for k, v in state.items()},
+                if checkpoint_every and (
+                    checkpoint_path or shard_checkpoint_dir
+                ) and (steps_done % checkpoint_every == 0 or last):
+                    self._save_ck(
+                        checkpoint_path, shard_checkpoint_dir,
+                        {
+                            k: self._fetch(v)[: sc.real_n]
+                            for k, v in state.items()
+                        },
                         memory.values,
                         steps_done,
                     )
@@ -1118,6 +1468,10 @@ class ShardedExecutor:
 
         # strip padding
         self.last_run_info = {"path": "dense", "supersteps": steps_done}
+        self._shard_report(sc, records)
+        self._persist_measured(
+            sc, checkpoint_path, shard_checkpoint_dir, records
+        )
         return {
             k: self._fetch(v)[: sc.real_n] for k, v in state.items()
         }
@@ -1129,6 +1483,8 @@ class ShardedExecutor:
         checkpoint_path: str,
         checkpoint_every: int,
         resume: bool,
+        hook=None,
+        shard_checkpoint_dir: str = None,
     ) -> Dict[str, np.ndarray]:
         import jax.numpy as jnp
 
@@ -1138,10 +1494,8 @@ class ShardedExecutor:
         steps_done = 0
         state = mem = None
 
-        if resume and checkpoint_path:
-            from janusgraph_tpu.olap.checkpoint import load_checkpoint
-
-            ck = load_checkpoint(checkpoint_path)
+        if resume and (checkpoint_path or shard_checkpoint_dir):
+            ck = self._load_ck(checkpoint_path, shard_checkpoint_dir)
             if ck is not None:
                 ck_state, ck_mem, steps_done = ck
                 # checkpoints store the real_n rows (portable across shard
@@ -1188,7 +1542,12 @@ class ShardedExecutor:
             steps_done = 0
 
         fn = self._fused_fn(program, op, sc)
+        records: List[dict] = []
         while steps_done < max_iter:
+            # fault boundary: once per dispatched chunk (the while_loop
+            # owns the intra-chunk superstep boundaries on device)
+            self._consult(hook, steps_done)
+            t_chunk = time.perf_counter()
             limit = max_iter
             if checkpoint_every:
                 limit = min(steps_done + checkpoint_every, max_iter)
@@ -1201,19 +1560,31 @@ class ShardedExecutor:
             )
             new_steps = int(steps_dev)
             terminated = new_steps < limit or new_steps == steps_done
+            chunk_steps = max(1, new_steps - steps_done)
+            chunk_ms = (time.perf_counter() - t_chunk) * 1000.0
+            for i in range(steps_done, max(new_steps, steps_done)):
+                records.append({
+                    "step": i,
+                    "wall_ms": round(chunk_ms / chunk_steps, 3),
+                })
             steps_done = max(new_steps, steps_done)
-            if checkpoint_path and checkpoint_every:
-                from janusgraph_tpu.olap.checkpoint import save_checkpoint
-
-                save_checkpoint(
-                    checkpoint_path,
-                    {k: self._fetch(v)[: sc.real_n] for k, v in state.items()},
-                    {k: np.asarray(v) for k, v in mem.items()},
+            if checkpoint_every and (checkpoint_path or shard_checkpoint_dir):
+                self._save_ck(
+                    checkpoint_path, shard_checkpoint_dir,
+                    {
+                        k: self._fetch(v)[: sc.real_n]
+                        for k, v in state.items()
+                    },
+                    {k: float(np.asarray(v)) for k, v in mem.items()},
                     steps_done,
                 )
             if terminated:
                 break
         self.last_run_info = {"path": "dense-fused", "supersteps": steps_done}
+        self._shard_report(sc, records)
+        self._persist_measured(
+            sc, checkpoint_path, shard_checkpoint_dir, records
+        )
         return {k: self._fetch(v)[: sc.real_n] for k, v in state.items()}
 
 

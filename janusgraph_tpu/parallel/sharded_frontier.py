@@ -202,11 +202,18 @@ class ShardedFrontierEngine:
     # ------------------------------------------------------------- host loop
     def _hop_loop(
         self, sc, view_key, value, pred, mask, weighted, track,
-        max_iterations, use_weights=None,
+        max_iterations, use_weights=None, fault_hook=None,
     ):
         """`use_weights` decouples value-message semantics (weighted=True)
         from edge-weight application: CC propagates labels as value
-        messages but must never add a weight (see run_cc)."""
+        messages but must never add a weight (see run_cc).
+
+        `fault_hook` is consulted once per hop (the host-visible
+        boundary). Frontier runs carry no checkpoint: a raised
+        SuperstepPreempted propagates to ShardedExecutor.run, whose
+        auto-resume RESTARTS the frontier run from scratch — hops are
+        short and the loop is deterministic, so the restart reproduces
+        the identical result."""
         import jax.numpy as jnp
 
         jax = self.jax
@@ -220,6 +227,8 @@ class ShardedFrontierEngine:
         plan = self._plan_fn(sc, view_key)
         trace = []
         for t in range(max_iterations):
+            if fault_hook is not None:
+                fault_hook(t)
             tab, cmax, emax, csum, esum = plan(value, mask, g)
             cmax, emax, csum, esum = (
                 int(x) for x in jax.device_get((cmax, emax, csum, esum))
@@ -252,7 +261,7 @@ class ShardedFrontierEngine:
         )
 
     # -------------------------------------------------------------- entry
-    def run(self, program) -> Dict[str, np.ndarray]:
+    def run(self, program, fault_hook=None) -> Dict[str, np.ndarray]:
         """SSSP/BFS (ShortestPathProgram) through the sharded hop loop."""
         sc = self.ex._sharded(program.undirected)
         view_key = program.undirected
@@ -272,14 +281,14 @@ class ShardedFrontierEngine:
         mask = self._device_put_sharded(idx0 == program.seed_index)
         value, pred = self._hop_loop(
             sc, view_key, value, pred, mask, program.weighted, track,
-            program.max_iterations,
+            program.max_iterations, fault_hook=fault_hook,
         )
         out = {"distance": self.ex._fetch(value)[: sc.real_n]}
         if track:
             out["predecessor"] = self.ex._fetch(pred)[: sc.real_n]
         return out
 
-    def run_cc(self, program) -> Dict[str, np.ndarray]:
+    def run_cc(self, program, fault_hook=None) -> Dict[str, np.ndarray]:
         """Frontier-compacted connected components on the mesh: min-label
         propagation with a changed-vertex frontier, value-messages through
         the weighted step with NO weight arrays (a label must never absorb
@@ -292,5 +301,6 @@ class ShardedFrontierEngine:
         labels, _ = self._hop_loop(
             sc, True, labels, None, mask, True, False,
             program.max_iterations, use_weights=False,
+            fault_hook=fault_hook,
         )
         return {"component": self.ex._fetch(labels)[: sc.real_n]}

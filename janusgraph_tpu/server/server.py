@@ -107,6 +107,12 @@ def healthz_snapshot() -> dict:
     per-category counts, last dump path); the ok->degraded flip itself
     triggers a flight dump so the events leading up to the degradation
     are on disk before anyone asks.
+
+    The ``sharded`` block covers the multi-chip plane: shard-level
+    injected faults (shard preemptions, collective timeouts, halo drops,
+    stragglers), checkpoint manifest/slice fallbacks, cross-shard
+    auto-resumes, and the last run's straggler skew gauge
+    (``olap.shard.skew`` — modeled slowest-shard/mean; 1.0 = balanced).
     """
     from janusgraph_tpu.observability import flight_recorder, registry
 
@@ -126,9 +132,29 @@ def healthz_snapshot() -> dict:
             or name.startswith("storage.backend_op.")
             or name.startswith("storage.scan.")
             or name.startswith("txlog.torn.")
+            or name.startswith("olap.checkpoint.")
+            or name.startswith("olap.sharded.")
             or name in ("olap.preemptions", "olap.resumes")
             or (name.startswith("breaker.") and not name.endswith(".state"))
         )
+    }
+    shard_fault_kinds = (
+        "shard_preempt", "collective", "halo_drop", "straggler"
+    )
+    skew = snap.get("olap.shard.skew")
+    sharded = {
+        "faults": {
+            k: counters.get(f"chaos.injected.{k}", 0)
+            for k in shard_fault_kinds
+        },
+        "manifest_fallbacks": counters.get(
+            "olap.checkpoint.manifest_fallback", 0
+        ),
+        "shard_fallbacks": counters.get("olap.checkpoint.shard_fallback", 0),
+        "resumes": counters.get("olap.sharded.resumes", 0),
+        "skew": (
+            skew["value"] if skew and skew["type"] == "gauge" else None
+        ),
     }
     status = "degraded" if degraded else "ok"
     with _HEALTH_LOCK:
@@ -144,6 +170,7 @@ def healthz_snapshot() -> dict:
         "status": status,
         "breakers": breakers,
         "counters": counters,
+        "sharded": sharded,
         "flight": flight_recorder.health_block(),
     }
 

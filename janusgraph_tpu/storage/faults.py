@@ -36,7 +36,26 @@ Fault kinds (all off by default):
 ``superstep``        preempt an OLAP superstep
                      (:class:`SuperstepPreempted`) — absorbed by the
                      executors' checkpoint auto-resume
+``shard_preempt``    preempt ONE shard of a multi-chip sharded run
+                     mid-superstep (:class:`ShardPreempted`) — absorbed by
+                     the sharded executor's cross-shard auto-resume (all
+                     shards roll back to the last complete manifest)
+``collective``       a cross-shard collective (halo all_to_all / psum
+                     barrier) times out (:class:`CollectiveTimeout`) —
+                     same roll-back-to-manifest recovery
+``halo_drop``        a destination-binned halo batch is dropped in flight
+                     (:class:`HaloDropped`) — same recovery
+``straggler``        per-(shard, superstep) latency skew: the chosen shard
+                     "runs late" by ``shard-straggler-ms`` (no exception;
+                     feeds straggler detection / the skew gauge)
 ===================  =====================================================
+
+The four ``shard-*`` kinds are scheduled/decided exactly like the
+single-device kinds — pure functions of ``(seed, kind, index)`` — so a
+seeded multi-chip chaos soak reproduces the identical fault sequence,
+including across auto-resume replays (straggler decisions key on the
+ABSOLUTE ``(superstep, shard)`` pair, not a shared cursor, so a replayed
+superstep sees the same skew it saw the first time).
 
 Wiring: ``storage.faults.enabled=true`` makes ``open_graph`` wrap its
 store manager and expose the plan as ``graph.fault_plan``; the OLAP
@@ -52,8 +71,11 @@ import zlib
 from typing import Dict, Iterator, List, Optional, Sequence, Tuple
 
 from janusgraph_tpu.exceptions import (
+    CollectiveTimeout,
+    HaloDropped,
     InjectedCrashError,
     InjectedFaultError,
+    ShardPreempted,
     SuperstepPreempted,
 )
 from janusgraph_tpu.storage.kcvs import (
@@ -101,6 +123,12 @@ class FaultPlan:
         scan_kill_at: int = -1,
         scan_kill_after_rows: int = 8,
         preempt_superstep: int = -1,
+        shard_preempt_superstep: int = -1,
+        shard_preempt_shard: int = -1,
+        collective_timeout_at: int = -1,
+        halo_drop_at: int = -1,
+        straggler_ms: float = 0.0,
+        straggler_rate: float = 0.0,
         stores: Sequence[str] = DEFAULT_FAULT_STORES,
         journal_limit: int = 4096,
     ):
@@ -114,6 +142,12 @@ class FaultPlan:
         self.scan_kill_at = scan_kill_at
         self.scan_kill_after_rows = scan_kill_after_rows
         self.preempt_superstep = preempt_superstep
+        self.shard_preempt_superstep = shard_preempt_superstep
+        self.shard_preempt_shard = shard_preempt_shard
+        self.collective_timeout_at = collective_timeout_at
+        self.halo_drop_at = halo_drop_at
+        self.straggler_ms = straggler_ms
+        self.straggler_rate = straggler_rate
         self.stores = tuple(stores)
         self.journal_limit = journal_limit
         #: injected-fault record: [{"kind", "n", ...}] — deterministic
@@ -122,6 +156,9 @@ class FaultPlan:
         self.journal: List[dict] = []
         self._counters: Dict[str, int] = {}
         self._preempted = False
+        self._shard_preempted = False
+        self._collective_fired = False
+        self._halo_dropped = False
         self._lock = threading.Lock()
 
     @classmethod
@@ -145,6 +182,18 @@ class FaultPlan:
                 "storage.faults.scan-kill-after-rows"
             ),
             preempt_superstep=cfg.get("storage.faults.preempt-superstep"),
+            shard_preempt_superstep=cfg.get(
+                "storage.faults.shard-preempt-superstep"
+            ),
+            shard_preempt_shard=cfg.get(
+                "storage.faults.shard-preempt-shard"
+            ),
+            collective_timeout_at=cfg.get(
+                "storage.faults.shard-collective-timeout-at"
+            ),
+            halo_drop_at=cfg.get("storage.faults.shard-halo-drop-at"),
+            straggler_ms=cfg.get("storage.faults.shard-straggler-ms"),
+            straggler_rate=cfg.get("storage.faults.shard-straggler-rate"),
             stores=stores,
         )
 
@@ -251,6 +300,92 @@ class FaultPlan:
                 f"injected preemption at superstep {step} "
                 f"(seed {self.seed})"
             )
+
+    # -------------------------------------------------------- sharded hooks
+    def straggler_decisions(
+        self, step: int, num_shards: int
+    ) -> List[Tuple[int, float]]:
+        """[(shard, ms)] latency-skew decisions for one superstep. Pure in
+        the ABSOLUTE (superstep, shard) pair — not a shared cursor — so a
+        replayed superstep (auto-resume) sees the same skew both times."""
+        if self.straggler_rate <= 0.0 or not self.straggler_ms:
+            return []
+        out = []
+        for shard in range(num_shards):
+            if self._chance(
+                "straggler", step * num_shards + shard, self.straggler_rate
+            ):
+                out.append((shard, self.straggler_ms))
+        return out
+
+    def sharded_hook(self, step: int, num_shards: int) -> List[dict]:
+        """Superstep-boundary hook for the sharded executor (consulted once
+        per host-visible superstep with the mesh size). Executes, in order:
+
+        1. straggler skew — sleeps once for the slowest selected shard
+           (the SPMD program runs at the pace of its slowest participant)
+           and returns the per-shard skew records for the executor's
+           straggler detector;
+        2. collective timeout — the scheduled collective index raises
+           :class:`CollectiveTimeout` (once);
+        3. halo drop — the scheduled exchange index raises
+           :class:`HaloDropped` (once);
+        4. shard preemption — reaching the scheduled superstep raises
+           :class:`ShardPreempted` (once) for a deterministically chosen
+           shard (``shard-preempt-shard``, or seed-hashed when -1).
+
+        All raised kinds are ``SuperstepPreempted`` subclasses, absorbed by
+        the cross-shard auto-resume (roll back to the last manifest).
+        """
+        stragglers = self.straggler_decisions(step, num_shards)
+        events: List[dict] = []
+        for shard, ms in stragglers:
+            self._record(
+                "straggler", step * num_shards + shard,
+                step=step, shard=shard, ms=ms,
+            )
+            events.append({"step": step, "shard": shard, "ms": ms})
+        if stragglers:
+            # one sleep at the barrier: every shard waits on the slowest
+            time.sleep(max(ms for _s, ms in stragglers) / 1000.0)
+        n = self._tick("collective")
+        if not self._collective_fired and n == self.collective_timeout_at:
+            self._collective_fired = True
+            self._record("collective", n, step=step)
+            raise CollectiveTimeout(
+                f"injected collective timeout at superstep {step} "
+                f"(collective #{n}, seed {self.seed})"
+            )
+        h = self._tick("halo")
+        if not self._halo_dropped and h == self.halo_drop_at:
+            self._halo_dropped = True
+            self._record("halo_drop", h, step=step)
+            raise HaloDropped(
+                f"injected dropped halo batch at superstep {step} "
+                f"(exchange #{h}, seed {self.seed})"
+            )
+        if (
+            not self._shard_preempted
+            and self.shard_preempt_superstep >= 0
+            and step >= self.shard_preempt_superstep
+        ):
+            self._shard_preempted = True
+            shard = self.shard_preempt_shard
+            if shard < 0:
+                shard = zlib.crc32(f"{self.seed}:shard".encode()) % max(
+                    1, num_shards
+                )
+            self._record(
+                "shard_preempt", self._tick("shard_preempt"),
+                step=step, shard=shard,
+            )
+            raise ShardPreempted(
+                f"injected preemption of shard {shard} at superstep "
+                f"{step} (seed {self.seed})"
+            )
+        # the single-device preemption schedule still applies on a mesh
+        self.olap_hook(step)
+        return events
 
 
 # ---------------------------------------------------------------------------

@@ -69,6 +69,14 @@ def _make_backend(kind: str, tmp_path):
     raise ValueError(kind)
 
 
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "slow: long-running soak/stress cases excluded from tier-1 "
+        "(-m 'not slow')",
+    )
+
+
 @pytest.fixture(params=["inmemory", "local", "sharded", "ttl", "remote"])
 def store_manager(request, tmp_path):
     """Parameterization point for backend-contract suites: every backend

@@ -1,0 +1,43 @@
+"""JG305 fixture: direct writes to checkpoint/manifest paths.
+
+The committed name must only ever receive a complete file via os.replace;
+open(path, "w") on it can tear mid-write.
+"""
+
+import json
+import os
+import tempfile
+
+
+def save_state_bad(checkpoint_path, payload):
+    with open(checkpoint_path, "w") as f:  # expect: JG305
+        json.dump(payload, f)
+
+
+def save_manifest_bad(run_dir, body):
+    f = open(run_dir + "/manifest.json", "w")  # expect: JG305
+    try:
+        json.dump(body, f)
+    finally:
+        f.close()
+
+
+def append_bad(path_to_ckpt_manifest, line):
+    with open(path_to_ckpt_manifest, "a") as f:  # expect: JG305
+        f.write(line)
+
+
+def save_state_good(checkpoint_path, payload):
+    # the atomic discipline: tmp sibling, then rename onto the committed
+    # name — the tmp-suffixed intermediate is exempt by design
+    d = os.path.dirname(os.path.abspath(checkpoint_path)) or "."
+    fd, tmp = tempfile.mkstemp(dir=d, suffix=".json.tmp")
+    with os.fdopen(fd, "w") as f:
+        json.dump(payload, f)
+    os.replace(tmp, checkpoint_path)
+
+
+def read_good(checkpoint_path):
+    # reads are harmless — only write modes commit torn bytes
+    with open(checkpoint_path) as f:
+        return json.load(f)

@@ -1,0 +1,449 @@
+"""Fault-tolerant multi-chip OLAP (ISSUE 8): sharded checkpoints,
+distributed chaos, cross-shard auto-resume.
+
+The acceptance contract: any injected shard-level failure — shard
+preemption mid-superstep, collective timeout, dropped halo batch, a torn
+manifest or slice write — costs at most one checkpoint interval, and the
+auto-resumed run finishes with final state BITWISE-identical to a
+fault-free run on the same executor/format. Fast cases here are tier-1;
+the full soak is marked ``slow``.
+"""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from janusgraph_tpu.olap import csr_from_edges
+from janusgraph_tpu.olap.cpu_executor import CPUExecutor
+from janusgraph_tpu.olap.programs import PageRankProgram, ShortestPathProgram
+from janusgraph_tpu.olap.sharded_checkpoint import (
+    load_sharded_checkpoint,
+    save_sharded_checkpoint,
+    shard_ranges,
+)
+from janusgraph_tpu.parallel import ShardedExecutor
+from janusgraph_tpu.storage.faults import FaultPlan
+
+
+def random_graph(n=150, m=600, seed=13):
+    rng = np.random.default_rng(seed)
+    src = rng.integers(0, n, m).astype(np.int32)
+    dst = rng.integers(0, n, m).astype(np.int32)
+    return csr_from_edges(n, src, dst)
+
+
+@pytest.fixture(scope="module")
+def mesh8():
+    import jax
+    from jax.sharding import Mesh
+
+    devices = np.array(jax.devices()[:8])
+    assert len(devices) == 8, "conftest must provide 8 virtual devices"
+    return Mesh(devices, ("p",))
+
+
+def _pagerank(iters=10):
+    return PageRankProgram(max_iterations=iters, tol=0.0)
+
+
+def _bitwise_equal(a, b):
+    assert set(a) == set(b)
+    for k in a:
+        assert np.array_equal(np.asarray(a[k]), np.asarray(b[k])), k
+
+
+# ---------------------------------------------------------------- format
+def test_sharded_checkpoint_roundtrip(tmp_path):
+    d = str(tmp_path / "ck")
+    state = {
+        "x": np.arange(23, dtype=np.float32),
+        "y": np.arange(23, dtype=np.float64) * 0.5,
+    }
+    save_sharded_checkpoint(d, state, {"m": 3.5}, 7, num_shards=4)
+    loaded = load_sharded_checkpoint(d)
+    assert loaded is not None
+    lstate, lmem, steps = loaded
+    assert steps == 7 and lmem == {"m": 3.5}
+    for k in state:
+        assert np.array_equal(lstate[k], state[k])
+        assert lstate[k].dtype == state[k].dtype
+    # slice layout on disk matches the contiguous-range convention
+    ranges = shard_ranges(23, 4)
+    assert ranges[0][0] == 0 and ranges[-1][1] == 23
+    assert all(
+        os.path.exists(os.path.join(d, f"shard-{s}.npz")) for s in range(4)
+    )
+
+
+def test_manifest_torn_write_falls_back_to_prev(tmp_path):
+    from janusgraph_tpu.observability import registry
+
+    d = str(tmp_path / "ck")
+    st1 = {"x": np.arange(10, dtype=np.float32)}
+    st2 = {"x": np.arange(10, dtype=np.float32) * 2}
+    save_sharded_checkpoint(d, st1, {"m": 1.0}, 2, num_shards=4)
+    save_sharded_checkpoint(d, st2, {"m": 2.0}, 4, num_shards=4)
+    before = registry.get_count("olap.checkpoint.manifest_fallback")
+    with open(os.path.join(d, "manifest.json"), "r+b") as f:
+        f.truncate(17)  # the torn write
+    lstate, lmem, steps = load_sharded_checkpoint(d)
+    assert steps == 2 and lmem == {"m": 1.0}
+    assert np.array_equal(lstate["x"], st1["x"])
+    assert registry.get_count("olap.checkpoint.manifest_fallback") == before + 1
+
+
+def test_torn_slice_write_falls_back(tmp_path):
+    d = str(tmp_path / "ck")
+    st1 = {"x": np.arange(12, dtype=np.float32)}
+    st2 = {"x": np.arange(12, dtype=np.float32) + 100.0}
+    save_sharded_checkpoint(d, st1, {}, 2, num_shards=3)
+    save_sharded_checkpoint(d, st2, {}, 4, num_shards=3)
+    # tear ONE slice of the newest checkpoint: its digest no longer
+    # matches the manifest, so the whole checkpoint must roll back one
+    # interval (slice .prev twins still carry the older manifest's bytes)
+    with open(os.path.join(d, "shard-1.npz"), "r+b") as f:
+        f.truncate(9)
+    lstate, _m, steps = load_sharded_checkpoint(d)
+    assert steps == 2
+    assert np.array_equal(lstate["x"], st1["x"])
+
+
+def test_manifest_digest_rejects_edit(tmp_path):
+    d = str(tmp_path / "ck")
+    save_sharded_checkpoint(
+        d, {"x": np.ones(4, np.float32)}, {}, 1, num_shards=2
+    )
+    mpath = os.path.join(d, "manifest.json")
+    with open(mpath) as f:
+        body = json.load(f)
+    body["steps"] = 999  # tampered field, stale digest
+    import tempfile
+
+    fd, tmp = tempfile.mkstemp(dir=d, suffix=".json.tmp")
+    with os.fdopen(fd, "w") as f:
+        json.dump(body, f)
+    os.replace(tmp, mpath)
+    assert load_sharded_checkpoint(d) is None  # no .prev exists either
+
+
+# ------------------------------------------------- cross-shard auto-resume
+@pytest.mark.parametrize("agg", ["ell", "segment"])
+def test_shard_preempt_resume_bitwise_sharded(mesh8, tmp_path, agg):
+    g = random_graph()
+    base_ex = ShardedExecutor(g, mesh=mesh8, agg=agg)
+    base = base_ex.run(
+        _pagerank(), fused=False, checkpoint_every=3,
+        shard_checkpoint_dir=str(tmp_path / "base"),
+    )
+    plan = FaultPlan(seed=21, shard_preempt_superstep=5)
+    ex = ShardedExecutor(g, mesh=mesh8, agg=agg)
+    out = ex.run(
+        _pagerank(), fused=False, checkpoint_every=3,
+        shard_checkpoint_dir=str(tmp_path / "chaos"),
+        fault_hook=plan.sharded_hook,
+    )
+    _bitwise_equal(base, out)
+    assert ex.last_run_info["resumes"] >= 1
+    assert ex.last_run_info["resume_ms"] > 0
+    assert ex.last_run_info["checkpoint"]["format"] == "sharded"
+    assert [e["kind"] for e in plan.journal] == ["shard_preempt"]
+    assert plan.journal[0]["shard"] < 8
+
+
+def test_collective_timeout_and_halo_drop_resume(mesh8, tmp_path):
+    g = random_graph(seed=17)
+    base = ShardedExecutor(g, mesh=mesh8).run(
+        _pagerank(), fused=False, checkpoint_every=2,
+        shard_checkpoint_dir=str(tmp_path / "base"),
+    )
+    plan = FaultPlan(seed=3, collective_timeout_at=4, halo_drop_at=7)
+    ex = ShardedExecutor(g, mesh=mesh8)
+    out = ex.run(
+        _pagerank(), fused=False, checkpoint_every=2,
+        shard_checkpoint_dir=str(tmp_path / "chaos"),
+        fault_hook=plan.sharded_hook,
+    )
+    _bitwise_equal(base, out)
+    kinds = [e["kind"] for e in plan.journal]
+    assert "collective" in kinds and "halo_drop" in kinds
+    assert ex.last_run_info["resumes"] == 2
+
+
+def test_fused_path_resumes_from_manifest(mesh8, tmp_path):
+    g = random_graph(seed=29)
+    base = ShardedExecutor(g, mesh=mesh8).run(
+        _pagerank(12), fused=True, checkpoint_every=4,
+        shard_checkpoint_dir=str(tmp_path / "base"),
+    )
+    plan = FaultPlan(seed=5, shard_preempt_superstep=6)
+    ex = ShardedExecutor(g, mesh=mesh8)
+    out = ex.run(
+        _pagerank(12), fused=True, checkpoint_every=4,
+        shard_checkpoint_dir=str(tmp_path / "chaos"),
+        fault_hook=plan.sharded_hook,
+    )
+    _bitwise_equal(base, out)
+    assert ex.last_run_info["path"] == "dense-fused"
+    assert ex.last_run_info["resumes"] >= 1
+
+
+@pytest.mark.parametrize("strategy", ["ell", "hybrid"])
+def test_cpu_executor_sharded_format_bitwise(tmp_path, strategy):
+    g = random_graph(n=70, m=280, seed=9)
+    base = CPUExecutor(g, strategy=strategy).run(
+        _pagerank(8), checkpoint_every=2,
+        shard_checkpoint_dir=str(tmp_path / "base"), checkpoint_shards=4,
+    )
+    plan = FaultPlan(seed=11, preempt_superstep=4)
+    out = CPUExecutor(g, strategy=strategy).run(
+        _pagerank(8), checkpoint_every=2,
+        shard_checkpoint_dir=str(tmp_path / "chaos"), checkpoint_shards=4,
+        fault_hook=plan.olap_hook,
+    )
+    _bitwise_equal(base, out)
+
+
+def test_checkpoint_portable_between_executors(mesh8, tmp_path):
+    """A manifest written by the mesh executor restores on the CPU oracle
+    (and the formats agree on the real-row convention)."""
+    g = random_graph(n=90, m=360, seed=31)
+    d = str(tmp_path / "ck")
+    ex = ShardedExecutor(g, mesh=mesh8)
+    ex.run(
+        _pagerank(6), fused=False, checkpoint_every=6,
+        shard_checkpoint_dir=d,
+    )
+    loaded = load_sharded_checkpoint(d)
+    assert loaded is not None
+    lstate, _m, steps = loaded
+    assert steps == 6
+    assert lstate["rank"].shape[0] == g.num_vertices
+    # CPU oracle resumes from the mesh-written manifest and just returns
+    # the restored state (max_iterations already reached)
+    out = CPUExecutor(g).run(
+        _pagerank(6), checkpoint_every=6, shard_checkpoint_dir=d,
+        resume=True,
+    )
+    assert np.array_equal(out["rank"], np.asarray(lstate["rank"], np.float64))
+
+
+def test_frontier_run_restarts_on_preemption(mesh8):
+    """Frontier-compacted runs carry no checkpoint: auto-resume restarts
+    the (short, deterministic) run from scratch."""
+    g = random_graph(seed=41)
+    prog = lambda: ShortestPathProgram(seed_index=0)  # noqa: E731
+    base = ShardedExecutor(g, mesh=mesh8).run(prog(), frontier="always")
+    fired = {"n": 0}
+
+    def hook(step):
+        if step == 1 and fired["n"] == 0:
+            fired["n"] += 1
+            from janusgraph_tpu.exceptions import ShardPreempted
+
+            raise ShardPreempted("injected")
+
+    ex = ShardedExecutor(g, mesh=mesh8)
+    out = ex.run(prog(), frontier="always", fault_hook=hook)
+    _bitwise_equal(base, out)
+    assert ex.last_run_info["resumes"] == 1
+
+
+# --------------------------------------------------- determinism + skew
+def test_distributed_journal_reproducibility(mesh8, tmp_path):
+    g = random_graph(seed=19)
+
+    def chaos_run(sub):
+        plan = FaultPlan(
+            seed=77, shard_preempt_superstep=4, collective_timeout_at=7,
+            straggler_ms=1.0, straggler_rate=0.3,
+        )
+        ex = ShardedExecutor(g, mesh=mesh8)
+        out = ex.run(
+            _pagerank(8), fused=False, checkpoint_every=2,
+            shard_checkpoint_dir=str(tmp_path / sub),
+            fault_hook=plan.sharded_hook,
+        )
+        return plan.journal, out
+
+    j1, o1 = chaos_run("a")
+    j2, o2 = chaos_run("b")
+    assert j1 == j2  # same seed -> byte-equal fault sequence
+    assert len(j1) > 0
+    _bitwise_equal(o1, o2)
+
+
+def test_straggler_skew_report_and_gauge(mesh8, tmp_path):
+    from janusgraph_tpu.observability import flight_recorder, registry
+
+    g = random_graph(seed=23)
+    plan = FaultPlan(seed=1, straggler_ms=2.0, straggler_rate=1.0)
+    ex = ShardedExecutor(g, mesh=mesh8)
+    ex.run(
+        _pagerank(4), fused=False,
+        fault_hook=plan.sharded_hook,
+    )
+    shards = ex.last_run_info["shards"]
+    assert shards["count"] == 8
+    assert shards["straggler_events"] > 0
+    assert shards["straggler_ms_total"] > 0
+    assert shards["skew"] >= 1.0
+    assert len(shards["per_shard"]) == 8
+    per = shards["per_shard"][shards["slowest_shard"]]
+    assert per["ledger"]["cells_read"] == per["edges"]
+    assert per["roofline"]["flops"] > 0
+    # the gauge + a shard_skew flight event are on the record
+    snap = registry.snapshot()
+    assert snap["olap.shard.skew"]["value"] >= 1.0
+    assert any(
+        e["category"] == "shard_skew" for e in flight_recorder.events()
+    )
+
+
+def test_per_shard_roofline_blocks_without_faults(mesh8):
+    g = random_graph(seed=37)
+    ex = ShardedExecutor(g, mesh=mesh8)
+    ex.run(_pagerank(4), fused=True)
+    shards = ex.last_run_info["shards"]
+    assert shards["straggler_events"] == 0
+    assert sum(p["edges"] for p in shards["per_shard"]) == g.num_edges
+    assert sum(p["vertices"] for p in shards["per_shard"]) == g.num_vertices
+    for p in shards["per_shard"]:
+        assert {"flops", "bytes_accessed", "operational_intensity"} <= set(
+            p["roofline"]
+        )
+
+
+def test_healthz_sharded_block(mesh8, tmp_path):
+    from janusgraph_tpu.server.server import healthz_snapshot
+
+    g = random_graph(seed=43)
+    plan = FaultPlan(seed=2, shard_preempt_superstep=3)
+    ex = ShardedExecutor(g, mesh=mesh8)
+    ex.run(
+        _pagerank(6), fused=False, checkpoint_every=2,
+        shard_checkpoint_dir=str(tmp_path / "ck"),
+        fault_hook=plan.sharded_hook,
+    )
+    snap = healthz_snapshot()
+    sharded = snap["sharded"]
+    assert sharded["faults"]["shard_preempt"] >= 1
+    assert sharded["resumes"] >= 1
+    assert sharded["skew"] is not None
+
+
+# -------------------------------------------- measured-record persistence
+def test_autotune_measured_keyed_by_shard_count(tmp_path):
+    from janusgraph_tpu.olap import autotune
+
+    path = str(tmp_path / "ck.autotune.json")
+    autotune.save_measured(
+        path, {"strategy": "hybrid", "pad_ratio": 1.01,
+               "superstep_ms": 75.0, "roofline_by_tier": None},
+        shard_count=1,
+    )
+    autotune.save_measured(
+        path, {"strategy": "sharded-a2a-ell", "pad_ratio": 1.2,
+               "superstep_ms": 12.0, "roofline_by_tier": None},
+        shard_count=8,
+    )
+    one = autotune.load_measured(path, shard_count=1)
+    eight = autotune.load_measured(path, shard_count=8)
+    assert one["superstep_ms"] == 75.0 and one["strategy"] == "hybrid"
+    assert eight["superstep_ms"] == 12.0
+    assert autotune.load_measured(path, shard_count=4) is None
+
+
+def test_autotune_measured_v1_backcompat(tmp_path):
+    from janusgraph_tpu.olap import autotune
+
+    path = str(tmp_path / "old.autotune.json")
+    with open(path, "w") as f:
+        json.dump({
+            "version": 1, "strategy": "ell", "pad_ratio": 1.4,
+            "superstep_ms": 88.0, "roofline_by_tier": None,
+        }, f)
+    rec = autotune.load_measured(path, shard_count=1)
+    assert rec["superstep_ms"] == 88.0
+    assert autotune.load_measured(path, shard_count=8) is None
+    # a multi-chip save upgrades the file WITHOUT clobbering the v1 record
+    autotune.save_measured(
+        path, {"strategy": "sharded-a2a-ell", "pad_ratio": 1.1,
+               "superstep_ms": 9.0, "roofline_by_tier": None},
+        shard_count=8,
+    )
+    assert autotune.load_measured(path, shard_count=1)["superstep_ms"] == 88.0
+    assert autotune.load_measured(path, shard_count=8)["superstep_ms"] == 9.0
+
+
+def test_sharded_run_persists_measured_record(mesh8, tmp_path):
+    from janusgraph_tpu.olap import autotune
+
+    g = random_graph(seed=47)
+    d = str(tmp_path / "ck")
+    ex = ShardedExecutor(g, mesh=mesh8)
+    ex.run(
+        _pagerank(4), fused=False, checkpoint_every=2,
+        shard_checkpoint_dir=d,
+    )
+    persisted = ex.last_run_info["autotune_persist"]
+    assert persisted["shard_count"] == 8
+    assert persisted["calibrated"] is False
+    rec = autotune.load_measured(persisted["path"], shard_count=8)
+    assert rec is not None and rec["strategy"] == "sharded-a2a-ell"
+    # single-device slot untouched
+    assert autotune.load_measured(persisted["path"], shard_count=1) is None
+    # a second lifetime sees its own layout's calibration
+    ex2 = ShardedExecutor(g, mesh=mesh8)
+    ex2.run(
+        _pagerank(4), fused=False, checkpoint_every=2,
+        shard_checkpoint_dir=d,
+    )
+    assert ex2.last_run_info["autotune_persist"]["calibrated"] is True
+
+
+# ----------------------------------------------------------------- soak
+@pytest.mark.slow
+def test_multichip_chaos_soak(mesh8, tmp_path):
+    """The full seeded soak: shard preemption + collective timeout + halo
+    drop + straggler skew + one torn manifest write mid-run, across both
+    agg formats, each bitwise-identical to its fault-free twin and
+    journal-reproducible."""
+    g = random_graph(n=200, m=900, seed=53)
+    for agg in ("ell", "segment"):
+        base = ShardedExecutor(g, mesh=mesh8, agg=agg).run(
+            _pagerank(16), fused=False, checkpoint_every=3,
+            shard_checkpoint_dir=str(tmp_path / f"{agg}-base"),
+        )
+        journals = []
+        for trial in range(2):
+            d = str(tmp_path / f"{agg}-t{trial}")
+            plan = FaultPlan(
+                seed=99, shard_preempt_superstep=5,
+                collective_timeout_at=9, halo_drop_at=13,
+                straggler_ms=1.0, straggler_rate=0.2,
+            )
+            saves = {"n": 0}
+            orig_hook = plan.sharded_hook
+
+            def hook(step, num_shards):
+                # tear the manifest once, after the first few saves — the
+                # next resume must land on .prev
+                if step == 8 and saves["n"] == 0:
+                    mpath = os.path.join(d, "manifest.json")
+                    if os.path.exists(mpath):
+                        saves["n"] += 1
+                        with open(mpath, "r+b") as f:
+                            f.truncate(11)
+                return orig_hook(step, num_shards)
+
+            ex = ShardedExecutor(g, mesh=mesh8, agg=agg)
+            out = ex.run(
+                _pagerank(16), fused=False, checkpoint_every=3,
+                shard_checkpoint_dir=d, fault_hook=hook,
+            )
+            _bitwise_equal(base, out)
+            assert ex.last_run_info["resumes"] >= 3
+            journals.append(plan.journal)
+        assert journals[0] == journals[1]
