@@ -1093,6 +1093,23 @@ def worker() -> None:
                 "error": f"{type(e).__name__}: {e}"[:500],
             })
 
+    # multi-chip exchange A/B (ISSUE 9, optional: MULTICHIP=1): eager
+    # (a2a boundary values / ring streaming) vs propagation-blocked halo
+    # exchange — superstep_ms, exchange bytes, batches per superstep per
+    # cell, blocked cells certified bitwise against the numpy replay
+    # oracle, dense-feature sharded numbers when BENCH_DENSE=1 — the
+    # MULTICHIP_r07 artifact vocabulary
+    if os.environ.get("MULTICHIP", "0") == "1":
+        try:
+            with _stage_span("multichip_ab"):
+                _multichip_ab_stage(t0)
+        except Exception as e:
+            _hb(f"multichip_ab stage FAILED {type(e).__name__}: {e}", t0)
+            _emit({
+                "stage": "multichip_ab", "ok": False,
+                "error": f"{type(e).__name__}: {e}"[:500],
+            })
+
     # pallas kernel evidence (VERDICT r2 #5): compiled run at s16 with
     # parity vs the ell result; failure is recorded, not fatal. The stage
     # runs LAST and under a watchdog: a hung Mosaic compile through the
@@ -1274,6 +1291,61 @@ def _multichip_chaos_stage(t0):
     _hb(
         f"multichip_chaos ok (recovered_supersteps="
         f"{chaos['recovered_supersteps']}, skew={chaos['shard_skew']})",
+        t0,
+    )
+
+
+def _multichip_ab_stage(t0):
+    """Eager-vs-blocked exchange A/B on the 8-virtual-device mesh via the
+    hermetic dryrun subprocess (__graft_entry__._ab_multichip_inproc):
+    per-cell superstep_ms + exchange elems/bytes/batches for
+    {a2a-ell, a2a-segment, ring-segment, blocked-ell, blocked-segment}
+    scalar PageRank cells, dense-feature GCN cells on the fan-in graph
+    when BENCH_DENSE=1, blocked cells certified bitwise against
+    halo.replay_superstep, BFS bitwise blocked-vs-eager."""
+    import json
+    import subprocess
+    import sys
+    import tempfile
+
+    n_dev = int(os.environ.get("MULTICHIP_DEVICES", "8"))
+    repo = os.path.dirname(os.path.abspath(__file__))
+    with tempfile.TemporaryDirectory() as d:
+        out_path = os.path.join(d, "multichip_ab.json")
+        env = dict(os.environ)
+        env["MULTICHIP_OUT"] = out_path
+        env.setdefault("BENCH_DENSE", "1")
+        w0 = time.perf_counter()
+        res = subprocess.run(
+            [sys.executable, "-c",
+             "import __graft_entry__ as ge; "
+             f"ge.dryrun_multichip_ab({n_dev})"],
+            cwd=repo, env=env, capture_output=True, text=True,
+            timeout=float(os.environ.get("MULTICHIP_AB_TIMEOUT_S", "900")),
+        )
+        wall_s = time.perf_counter() - w0
+        if res.returncode != 0 or not os.path.exists(out_path):
+            _emit({
+                "stage": "multichip_ab", "ok": False,
+                "rc": res.returncode,
+                "error": (res.stderr or "")[-500:],
+            })
+            _hb(f"multichip_ab FAILED rc={res.returncode}", t0)
+            return
+        with open(out_path) as f:
+            ab = json.load(f)
+    _emit({
+        "stage": "multichip_ab",
+        "ok": True,
+        "wall_s": round(wall_s, 3),
+        **ab,
+    })
+    hd = ab.get("headline", {})
+    _hb(
+        "multichip_ab ok (dense blocked-vs-eager "
+        f"{hd.get('dense_speedup_blocked_vs_eager')}x, "
+        f"batches {hd.get('batches_blocked')} vs ring "
+        f"{hd.get('batches_ring_eager')})",
         t0,
     )
 

@@ -43,6 +43,17 @@ _CAP_NAME_RE = re.compile(
     re.IGNORECASE,
 )
 
+#: propagation-blocked halo-exchange tiers (parallel/halo.py): per-pair
+#: merged-destination bins pad to one pow2 capacity tier so a single
+#: all_to_all split (and one compiled executable) serves every graph
+#: whose halo fits the tier — a non-pow2 literal silently breaks the
+#: uniform-split contract AND the tier-reuse economics. 0 = auto-pick
+#: (halo_tier derives the tier from the widest pair), allowed.
+_HALO_NAME_RE = re.compile(
+    r"_bin$|^halo_cap$|_halo_cap$|^exchange_tier$|_exchange_tier$",
+    re.IGNORECASE,
+)
+
 #: dense-tier padded feature-dim names. The LOGICAL dim (feature_dim,
 #: hidden_dim, ...) may be any value — only the PADDED tier the kernels
 #: consume must be a lane-width pow2 (0 = auto-pick, allowed).
@@ -105,6 +116,21 @@ def _check_capacity_tiers(mod) -> List[Finding]:
                 f"of two — dense-tier feature blocks pad to pow2 lane "
                 f"tiers so tree_dot/tree_matmul reduce complete trees "
                 f"(use 0 to auto-pick from FEATURE_TIERS)",
+            ))
+            return
+        if _HALO_NAME_RE.search(name):
+            v = _const_int(value_node)
+            # 0 = auto-pick (halo_tier sizes the bin from the widest
+            # cross-shard pair); only an explicit non-pow2 tier is the bug
+            if v is None or v == 0 or _is_pow2(v):
+                return
+            out.append(_finding(
+                "JG301", mod, where,
+                f"halo-bin capacity tier `{name}` = {v} is not a power "
+                f"of two — blocked-exchange bins pad to pow2 tiers so "
+                f"one all_to_all split (and one compiled executable) "
+                f"serves every graph whose halo fits the tier (use 0 to "
+                f"auto-pick via halo_tier)",
             ))
             return
         if not _CAP_NAME_RE.search(name):

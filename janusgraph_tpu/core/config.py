@@ -451,15 +451,37 @@ COMPUTER_NS.option(
 )
 COMPUTER_NS.option(
     "exchange", str,
-    "sharded-executor message exchange: boundary-bucket all_to_all, "
-    "ppermute ring streaming, or full all_gather (debug)", "a2a",
-    Mutability.MASKABLE, lambda v: v in ("a2a", "ring", "gather"),
+    "sharded-executor message exchange: 'blocked' (propagation-blocked "
+    "halo exchange — destination-binned combiner-merged bins in one "
+    "all_to_all, parallel/halo.py), 'a2a' (eager boundary-bucket "
+    "all_to_all of raw source values), 'ring' (ppermute streaming), "
+    "'gather' (full all_gather, debug), or 'auto' (olap/autotune."
+    "decide_sharded picks per shard count from boundary/halo widths)",
+    "auto", Mutability.MASKABLE,
+    lambda v: v in ("a2a", "ring", "gather", "blocked", "auto"),
 )
 COMPUTER_NS.option(
     "agg", str,
     "sharded-executor local aggregation: uniform degree-bucketed ELL or "
-    "flat segment reduction (ring/gather require 'segment')", "ell",
+    "flat segment reduction (ring/gather require 'segment'; "
+    "exchange='blocked' fuses binning into either form)", "ell",
     Mutability.MASKABLE, lambda v: v in ("ell", "segment"),
+)
+COMPUTER_NS.option(
+    "sharded-auto", bool,
+    "route graph.compute() submits from the default 'tpu' executor to "
+    "the sharded mesh executor whenever more than one device is visible "
+    "(multi-chip as the default fast path); a routed run that fails "
+    "falls back to the single-device executor and records the reason in "
+    "run_info['routing']", True, Mutability.MASKABLE,
+)
+COMPUTER_NS.option(
+    "shard-measure", bool,
+    "measure per-shard superstep walls with the host probe (each "
+    "shard's real aggregation workload timed shard-by-shard) and feed "
+    "them into the skew report and per-shard roofline as cost_source="
+    "'measured'; off = plan-derived estimates only", True,
+    Mutability.MASKABLE,
 )
 COMPUTER_NS.option(
     "write-back-batch", int,
@@ -501,6 +523,21 @@ COMPUTER_NS.option(
 )
 STORAGE.option(
     "scan-batch-size", int, "rows per scan-framework batch", 4096,
+    Mutability.MASKABLE, lambda v: v > 0,
+)
+STORAGE.option(
+    "distributed-load-workers", int,
+    "worker PROCESSES for distributed CSR loading at graph.compute() "
+    "(olap/distributed_load.py): each scans a disjoint storage-partition "
+    "range of a SHARED backend (storage.backend 'remote' or 'local') and "
+    "the parent merges once; 0/1 = in-process loader. Raw-scan loads "
+    "only — property/weight/label-filtered snapshots fall back",
+    0, Mutability.MASKABLE, lambda v: v >= 0,
+)
+STORAGE.option(
+    "distributed-load-timeout-s", float,
+    "shared deadline for the distributed-load worker pool (a hung worker "
+    "fails the load rather than leaking scanners past it)", 600.0,
     Mutability.MASKABLE, lambda v: v > 0,
 )
 STORAGE.option(

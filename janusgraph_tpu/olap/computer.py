@@ -26,6 +26,9 @@ class ComputerResult:
     #: map-reduce results keyed by each job's memory_key (reference:
     #: FulgoraMemory holding MapReduce side-effect keys)
     memory: Dict[str, object] = field(default_factory=dict)
+    #: the executor's run record (registry.last_run("olap") shape) plus
+    #: the submit() routing decision under "routing"
+    run_info: Dict[str, object] = field(default_factory=dict)
     #: the program that produced `states` (path()/select() terminals)
     program: object = None
     #: name of path position 0 for select() (compute().traverse(source_as=))
@@ -207,14 +210,39 @@ class GraphComputer:
         assert (
             self._program is not None or traverse_args is not None
         ), "program() not set"
+        cfg = getattr(self.graph, "config", None)
         with tracer.span("olap.load_csr") as ls:
-            csr = load_csr(
-                self.graph,
-                edge_labels=self._edge_labels,
-                vertex_labels=self._vertex_labels,
-                property_keys=property_keys,
-                weight_key=self._weight_key,
+            # distributed CSR loading (storage.distributed-load-workers):
+            # N worker processes scan disjoint storage-partition ranges of
+            # a SHARED backend and the parent merges once — the raw scan
+            # carries no property/weight/filter columns, so any of those
+            # falls back to the in-process loader
+            workers = int(cfg.get("storage.distributed-load-workers") or 0) if cfg else 0
+            plain = not (
+                property_keys or self._weight_key
+                or self._edge_labels or self._vertex_labels
             )
+            backend = cfg.get("storage.backend") if cfg else None
+            if workers > 1 and plain and backend in ("remote", "local"):
+                from janusgraph_tpu.olap.distributed_load import (
+                    distributed_load_csr,
+                )
+
+                csr = distributed_load_csr(
+                    dict(cfg.local), num_workers=workers,
+                    timeout_s=float(
+                        cfg.get("storage.distributed-load-timeout-s")
+                    ),
+                )
+                ls.annotate(distributed_workers=workers)
+            else:
+                csr = load_csr(
+                    self.graph,
+                    edge_labels=self._edge_labels,
+                    vertex_labels=self._vertex_labels,
+                    property_keys=property_keys,
+                    weight_key=self._weight_key,
+                )
             ls.annotate(
                 num_vertices=csr.num_vertices, num_edges=csr.num_edges
             )
@@ -230,9 +258,40 @@ class GraphComputer:
                 self.graph, csr, spec, seed_filters=seed_filters,
                 record_reach=want_paths, sack=sack, sack_init=sack_init,
             )
-        cfg = getattr(self.graph, "config", None)
+        # ---- executor routing (computer.sharded-auto, default on): with
+        # more than one visible device, the default 'tpu' submit routes to
+        # the sharded executor — multi-chip is the default fast path. The
+        # routing decision rides run_info["routing"]; a routed run that
+        # fails (e.g. collectives unavailable on this backend) falls back
+        # to the single-device executor instead of failing the submit.
+        executor_kind = self.executor_kind
+        routing = {"requested": self.executor_kind,
+                   "routed": self.executor_kind, "reason": "explicit"}
+        if self.executor_kind == "tpu" and not getattr(
+            self, "_no_autoroute", False
+        ) and (
+            cfg is None or cfg.get("computer.sharded-auto")
+        ):
+            try:
+                import jax
+
+                ndev = len(jax.devices())
+            except Exception:
+                ndev = 1
+            if ndev > 1 and getattr(
+                self._program, "sharded_compatible", True
+            ):
+                executor_kind = "sharded"
+                routing = {
+                    "requested": self.executor_kind, "routed": "sharded",
+                    "reason": f"sharded-auto: mesh of {ndev} devices",
+                }
+            else:
+                routing["reason"] = (
+                    "single device" if ndev <= 1 else "sddmm program"
+                )
         run_kwargs = {}
-        if cfg is not None and self.executor_kind == "sharded":
+        if cfg is not None and executor_kind == "sharded":
             run_kwargs = {
                 "sync_every": cfg.get("computer.sync-every"),
                 "checkpoint_every": (
@@ -249,8 +308,13 @@ class GraphComputer:
                 "frontier_tier_growth": cfg.get(
                     "computer.frontier-tier-growth"
                 ),
+                "shard_measure": cfg.get("computer.shard-measure"),
+                "features_dim_tier": cfg.get("computer.features-dim-tier"),
+                "features_native_matmul": cfg.get(
+                    "computer.features-native-matmul"
+                ),
             }
-        if cfg is not None and self.executor_kind == "tpu":
+        if cfg is not None and executor_kind == "tpu":
             run_kwargs = {
                 "strategy": cfg.get("computer.strategy"),
                 "ell_max_capacity": cfg.get("computer.ell-max-capacity"),
@@ -280,7 +344,7 @@ class GraphComputer:
                     "computer.features-native-matmul"
                 ),
             }
-        if cfg is not None and self.executor_kind == "cpu":
+        if cfg is not None and executor_kind == "cpu":
             run_kwargs = {
                 "checkpoint_every": cfg.get("computer.checkpoint-every"),
                 "checkpoint_path": cfg.get("computer.checkpoint-path") or None,
@@ -307,11 +371,11 @@ class GraphComputer:
         # timeout, halo drop, straggler skew) — cross-shard auto-resume
         # rolls every shard back to the last complete manifest.
         plan = getattr(self.graph, "fault_plan", None)
-        if self.executor_kind in ("tpu", "cpu", "sharded"):
+        if executor_kind in ("tpu", "cpu", "sharded"):
             if plan is not None:
                 run_kwargs["fault_hook"] = (
                     plan.sharded_hook
-                    if self.executor_kind == "sharded"
+                    if executor_kind == "sharded"
                     else plan.olap_hook
                 )
             if cfg is not None:
@@ -319,7 +383,38 @@ class GraphComputer:
                     "computer.resume-attempts"
                 )
         sp.annotate(program=type(self._program).__name__)
-        states = run_on(csr, self._program, self.executor_kind, **run_kwargs)
+        from janusgraph_tpu.observability import registry
+
+        try:
+            states = run_on(csr, self._program, executor_kind, **run_kwargs)
+        except Exception as e:
+            if routing["routed"] == executor_kind == "sharded" and (
+                self.executor_kind != "sharded"
+            ):
+                # auto-routing must never make a working submit fail:
+                # rebuild the single-device kwargs and retry there
+                from janusgraph_tpu.observability import flight_recorder
+
+                routing["routed"] = "tpu"
+                routing["fallback"] = f"{type(e).__name__}: {e}"[:200]
+                flight_recorder.record(
+                    "sharded_auto_fallback",
+                    error=f"{type(e).__name__}: {e}"[:200],
+                )
+                self._no_autoroute = True
+                try:
+                    result = self._submit(sp)
+                finally:
+                    self._no_autoroute = False
+                # preserve the fallback story for callers and dashboards
+                result.run_info["routing"] = routing
+                registry.record_run("olap.routing", routing)
+                return result
+            raise
+        routing["executor"] = executor_kind
+        registry.record_run("olap.routing", routing)
+        run_info = dict(registry.last_run("olap") or {})
+        run_info["routing"] = routing
         memory = {}
         if self._map_reduces:
             from janusgraph_tpu.olap.mapreduce import run_map_reduce
@@ -332,6 +427,7 @@ class GraphComputer:
                     memory[mr.memory_key] = run_map_reduce(mr, states, csr)
         return ComputerResult(
             states=states, csr=csr, graph=self.graph, memory=memory,
+            run_info=run_info,
             program=self._program,
             source_as=(
                 traverse_args[3] if traverse_args is not None else None
@@ -358,6 +454,7 @@ def run_on(
     frontier_tier_growth: int = None,
     exchange: str = "a2a",
     agg: str = "ell",
+    shard_measure: bool = None,
     fault_hook=None,
     resume_attempts: int = 3,
     autotune: bool = None,
@@ -399,6 +496,7 @@ def run_on(
         return ShardedExecutor(
             csr, exchange=exchange, agg=agg,
             frontier_tier_growth=frontier_tier_growth,
+            shard_measure=shard_measure,
         ).run(
             program,
             sync_every=sync_every,

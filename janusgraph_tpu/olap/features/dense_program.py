@@ -86,6 +86,15 @@ class DenseVertexProgram(VertexProgram):
             raise ValueError("sddmm programs must use the SUM combiner")
         self.d_pad = pick_feature_tier(self.feature_dim, self.dim_tier)
 
+    @property
+    def sharded_compatible(self) -> bool:
+        """Whether the mesh executor can run this program: the blocked /
+        a2a halo exchanges ship source-side rows only, and sddmm needs
+        both endpoints' features inside one kernel — so attention
+        programs stay single-device (GraphComputer routing and
+        ShardedExecutor.run both consult this)."""
+        return self.message_mode != MessageMode.SDDMM
+
     # ------------------------------------------------------- configuration
     def set_dim_tier(self, tier: int) -> None:
         """Apply computer.features-dim-tier: re-pick the padded lane tier

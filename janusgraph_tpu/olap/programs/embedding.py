@@ -92,6 +92,12 @@ class EmbeddingUpdateProgram(DenseVertexProgram):
             / np.sqrt(self.feature_dim)
         ).astype(np.float32)
         emb = pad_features(emb, self.d_pad)
+        # zero rows for mesh padding (see GCNForwardProgram.setup)
+        local = getattr(graph, "local_num_vertices", n)
+        if local > n:
+            emb = np.vstack(
+                [emb, np.zeros((local - n, emb.shape[1]), emb.dtype)]
+            )
         return {"emb": xp.asarray(emb)}, {
             "delta": (Combiner.SUM, float("inf")),
         }

@@ -121,6 +121,12 @@ class GCNForwardProgram(DenseVertexProgram):
         rng = np.random.default_rng(self.seed + 1)
         x = rng.standard_normal((n, self.feature_dim)).astype(np.float32)
         h = pad_features(x, self.d_pad)
+        # padded-vertex rows (sharded executor: local_num_vertices >= n)
+        # are zero and stay zero — drawn AFTER the real rows so the
+        # feature matrix is bit-identical across executors/mesh sizes
+        local = getattr(graph, "local_num_vertices", n)
+        if local > n:
+            h = np.vstack([h, np.zeros((local - n, h.shape[1]), h.dtype)])
         return {"h": xp.asarray(h)}, {
             "h_norm": (Combiner.SUM, float(np.abs(h).sum())),
         }

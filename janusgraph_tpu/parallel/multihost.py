@@ -160,3 +160,18 @@ def host_partition_range(
     lo = process_id * base + min(process_id, extra)
     hi = lo + base + (1 if process_id < extra else 0)
     return lo, hi
+
+
+def host_shard_range(
+    num_shards: int,
+    process_id: Optional[int] = None,
+    num_processes: Optional[int] = None,
+) -> Tuple[int, int]:
+    """[lo, hi) MESH SHARDS whose blocked-plan blocks this host builds
+    (parallel/halo.BlockedPlan.build_local). Deliberately the same
+    contiguous assignment as host_partition_range: a host's loaded
+    storage partitions are exactly the source-side edge sets of its
+    shards, so distributed CSR loading feeds the local plan build with
+    no edge redistribution — only the compact per-pair destination
+    lists (the halo index) are exchanged as metadata."""
+    return host_partition_range(num_shards, process_id, num_processes)

@@ -158,9 +158,18 @@ class ShardedCSR:
         out_degree[:n] = csr.out_degree
         active = np.zeros(S * Np, dtype=np.float32)
         active[:n] = 1.0
+        # padded per-vertex in-degree of THIS edge view (dense programs
+        # normalize by it — GCNForwardProgram's mean aggregation)
+        in_degree = np.zeros(S * Np, dtype=np.float32)
+        for s in range(S):
+            k = int(offsets[s + 1] - offsets[s])
+            np.add.at(
+                in_degree, s * Np + in_dst_loc[s * Em : s * Em + k], 1.0
+            )
 
         self.out_degree = out_degree
         self.active = active
+        self.in_degree = in_degree
         self.in_src_glob = in_src_glob
         self.in_dst_loc = in_dst_loc
         self.in_valid = in_valid
@@ -339,6 +348,115 @@ class ShardedCSR:
         self.ftr_deg = ftr_deg
         self.ftr_src_glob = ftr_src_glob
 
+    def ensure_blocked_plan(self) -> None:
+        """Build the propagation-blocked (source-partitioned) halo plan
+        once, on first use (parallel/halo.py): per-owner edge blocks whose
+        superstep kernel bins remote-bound messages by destination shard,
+        merges them locally, and exchanges pow2-tiered bins in ONE
+        all_to_all — the a2a boundary table is never materialized."""
+        if getattr(self, "_blocked_built", False):
+            return
+        self._blocked_built = True
+        from janusgraph_tpu.parallel import halo
+
+        src, dst, w = halo.edges_from_sharded(self)
+        plan = halo.BlockedPlan.build(
+            src, dst, w, self.num_shards, self.shard_size
+        )
+        self.blocked_plan = plan
+        self.blk_src_loc = plan.blk_src_loc
+        self._blocked_ell_built = False
+        self.blk_seg = plan.blk_seg
+        self.blk_bin_seg = plan.blk_bin_seg
+        self.blk_valid = plan.blk_valid
+        self.blk_weight = plan.blk_weight
+        self.recv_dst = plan.recv_dst
+        self.halo_cap = plan.halo_cap
+        self.edges_per_owner = plan.edges_per_owner
+        # per-superstep comm volume (elements/shard), blocked exchange
+        self.comm_blocked_elems = self.num_shards * plan.halo_cap
+
+    def ensure_frontier_plan_blocked(self) -> None:
+        """Frontier CSC over the BLOCKED message table [own Np ++ received
+        merged bins S*Hc]: local slots keep their intra-shard edges; each
+        used (q→s, j) bin slot collapses that pair's remote edges into ONE
+        edge to its destination (weight 0 — the sender already folded the
+        edge weight into the merged MIN), so remote expansion work shrinks
+        from per-edge to per-distinct-destination and each hop exchanges
+        S*Hc merged elements instead of the S*B boundary table."""
+        if getattr(self, "_frontier_blocked_built", False):
+            return
+        self.ensure_blocked_plan()
+        self._frontier_blocked_built = True
+        from janusgraph_tpu.parallel import halo
+
+        plan = self.blocked_plan
+        S, Np, Hc = self.num_shards, self.shard_size, self.halo_cap
+        T = Np + S * Hc
+        src, dst, w = halo.edges_from_sharded(self)
+        owner = src // Np
+        dshard = dst // Np
+
+        slot_parts: List[Tuple[np.ndarray, np.ndarray, np.ndarray]] = []
+        E2 = 1
+        for s in range(S):
+            loc = np.nonzero((owner == s) & (dshard == s))[0]
+            slots = [src[loc] - s * Np]
+            dsts = [dst[loc] - s * Np]
+            ws = [w[loc]]
+            for q in range(S):
+                u = plan.pair_lists.get((q, s))
+                if u is None:
+                    continue
+                slots.append(Np + q * Hc + np.arange(len(u)))
+                dsts.append(u - s * Np)
+                ws.append(np.zeros(len(u), dtype=np.float32))
+            sl = np.concatenate(slots).astype(np.int64)
+            dl = np.concatenate(dsts).astype(np.int64)
+            wl = np.concatenate(ws).astype(np.float32)
+            order = np.argsort(sl, kind="stable")
+            slot_parts.append((sl[order], dl[order], wl[order]))
+            E2 = max(E2, len(sl))
+        self.fblk_edges = E2
+        ftr_ip = np.zeros(S * (T + 2), dtype=np.int32)
+        ftr_dst = np.zeros(S * E2, dtype=np.int32)
+        ftr_w = np.ones(S * E2, dtype=np.float32)
+        ftr_deg = np.zeros(S * T, dtype=np.int32)
+        for s in range(S):
+            sl, dl, wl = slot_parts[s]
+            k = len(sl)
+            deg = np.bincount(sl, minlength=T)
+            ip = np.zeros(T + 2, dtype=np.int64)
+            np.cumsum(deg, out=ip[1 : T + 1])
+            ip[T + 1] = ip[T]
+            ftr_ip[s * (T + 2) : (s + 1) * (T + 2)] = ip
+            ftr_dst[s * E2 : s * E2 + k] = dl
+            ftr_w[s * E2 : s * E2 + k] = wl
+            ftr_deg[s * T : s * T + T] = deg
+        self.fblk_ip = ftr_ip
+        self.fblk_dst = ftr_dst
+        self.fblk_w = ftr_w
+        self.fblk_deg = ftr_deg
+
+    def ensure_blocked_ell(self) -> None:
+        """Build the packed aggregation for the blocked exchange once:
+        sender-side uniform ELL over [local destinations ++ outgoing
+        bins] + the receiver's width-R combine rows (halo.build_ell) —
+        gathers and adjacent-pair trees only, no scatter."""
+        self.ensure_blocked_plan()
+        if self._blocked_ell_built:
+            return
+        self._blocked_ell_built = True
+        from janusgraph_tpu.parallel import halo
+
+        halo.build_ell(self.blocked_plan, self.has_weight)
+        plan = self.blocked_plan
+        self.bell_buckets = plan.ell_buckets
+        self.bell_meta = plan.ell_meta
+        self.bell_unpermute = plan.ell_unpermute
+        self.bell_recv_idx = plan.recv_idx
+        self.bell_recv_width = plan.recv_width
+
     def ensure_ell(self) -> None:
         """Build the uniform ELL pack once, on first use (requires the
         exchange plan: ELL indices point into the a2a message table)."""
@@ -480,31 +598,51 @@ class _GlobalView:
         self.global_offset = 0
         self.out_degree = sharded.out_degree
         self.active = sharded.active
+        self.in_degree = sharded.in_degree
 
 
 class _ShardView:
     """Per-shard view inside shard_map (traced)."""
 
-    def __init__(self, num_vertices, shard_size, offset, out_degree, active):
+    def __init__(
+        self, num_vertices, shard_size, offset, out_degree, active,
+        in_degree=None,
+    ):
         self.num_vertices = num_vertices          # real global count (static)
         self.local_num_vertices = shard_size      # padded local (static)
         self.global_offset = offset               # traced scalar
         self.out_degree = out_degree
         self.active = active
+        self.in_degree = in_degree
 
 
 class ShardedExecutor:
     """BSP executor over a jax.sharding.Mesh (1-D axis 'p').
 
-    exchange: "a2a" (default) — boundary-bucket lax.all_to_all;
+    exchange: "blocked" — propagation-blocked halo exchange (the default
+              fast path, PAPERS.md arXiv:2011.08451): remote-bound
+              messages are binned by destination shard inside the
+              superstep kernel, combiner-merged locally, and the pow2-
+              tiered merged bins swap in ONE lax.all_to_all — comm volume
+              S*halo_cap elements (distinct remote DESTINATIONS), no
+              message-table concatenation, receiver work one S*halo_cap
+              scatter-combine;
+              "a2a" — eager boundary-bucket lax.all_to_all (ships raw
+              boundary SOURCE values, S*B elements, receiver aggregates
+              its remote edges);
               "ring" — S-step lax.ppermute rotation: each step one shard's
               outgoing block streams past and its contribution is folded in
               (the ring-attention pattern applied to message aggregation —
               peak comm memory O(Np) per step instead of the S*B bucket
               table; the right shape when boundary sets approach O(n));
-              "gather" — full-vector all_gather (debug/reference path).
+              "gather" — full-vector all_gather (debug/reference path);
+              "auto" — olap/autotune.decide_sharded picks from the graph's
+              boundary/halo widths + the device roofline, keyed by shard
+              count (decision recorded in run_info["autotune"]).
     agg:      "ell" (default; a2a only) — uniform degree-bucketed ELL;
-              "segment" — flat segment reduction (ring/gather use this).
+              "segment" — flat segment reduction (ring/gather use this);
+              "bin" — the blocked exchange's fused bin+local segment
+              reduction (implied by exchange='blocked').
     """
 
     def __init__(
@@ -515,6 +653,7 @@ class ShardedExecutor:
         exchange: str = "a2a",
         agg: str = "ell",
         frontier_tier_growth: int = None,
+        shard_measure: bool = None,
     ):
         import jax
         from jax.sharding import Mesh
@@ -527,7 +666,7 @@ class ShardedExecutor:
         self.mesh = mesh
         self.num_shards = mesh.devices.size
         self.csr = csr
-        if exchange not in ("a2a", "ring", "gather"):
+        if exchange not in ("a2a", "ring", "gather", "blocked", "auto"):
             raise ValueError(f"unknown exchange {exchange!r}")
         if exchange in ("gather", "ring") and agg == "ell":
             # the ELL pack indexes the a2a message table, which the other
@@ -537,8 +676,25 @@ class ShardedExecutor:
                 "into the all-to-all message table); use agg='segment' with "
                 f"exchange={exchange!r}"
             )
+        if exchange == "blocked" and agg not in ("ell", "segment"):
+            raise ValueError(
+                "exchange='blocked' aggregates via 'ell' (packed gather + "
+                f"tree) or 'segment' (fused scatter); got agg={agg!r}"
+            )
+        #: "auto" defers to olap/autotune.decide_sharded at first run
+        self.exchange_requested = exchange
         self.exchange = exchange
         self.agg = agg
+        #: measured per-shard superstep walls (host probe) feeding the
+        #: skew report; None/True = on, False = plan-derived costs only
+        self.shard_measure = True if shard_measure is None else shard_measure
+        #: autotune decision record for the most recent auto resolution
+        self._autotune_record = None
+        #: fresh compiles this run (the registry's retrace/compile-cache
+        #: economics; counted at every compiled-fn cache miss)
+        self._new_execs = 0
+        #: bytes device_put this run (h2d_arg_bytes in the run record)
+        self._h2d_bytes = 0
         from collections import OrderedDict
 
         self._compiled: Dict[Tuple, object] = {}
@@ -555,10 +711,11 @@ class ShardedExecutor:
         self.last_run_info: Dict[str, object] = {}
 
     def comm_stats(self, undirected: bool = False) -> Dict[str, object]:
-        """Per-superstep exchange volume in elements per shard. The a2a
-        boundary plan is only materialized for a2a-configured executors —
-        ring exists precisely for the regime where that O(S*S*B) table is
-        most expensive to build."""
+        """Per-superstep exchange volume in elements per shard. Each plan
+        (a2a boundary table / blocked halo bins) is only materialized for
+        executors configured to use it — ring exists precisely for the
+        regime where the O(S*S*B) table is most expensive to build."""
+        self._resolve_exchange(undirected)
         sc = self._sharded(undirected)
         stats: Dict[str, object] = {
             "gather_elems": sc.padded_n,
@@ -568,12 +725,68 @@ class ShardedExecutor:
             "ring_peak_elems": sc.shard_size,
             "a2a_elems": None,
             "boundary_width": None,
+            "blocked_elems": None,
+            "halo_cap": None,
+            #: collectives per superstep carrying message payload
+            "batches": self.num_shards - 1 if self.exchange == "ring" else 1,
         }
         if self.exchange == "a2a":
             sc.ensure_exchange_plan()
             stats["a2a_elems"] = sc.comm_a2a_elems
             stats["boundary_width"] = sc.boundary_width
+        if self.exchange == "blocked":
+            sc.ensure_blocked_plan()
+            stats["blocked_elems"] = sc.comm_blocked_elems
+            stats["halo_cap"] = sc.halo_cap
         return stats
+
+    def _exchange_info(self, sc: ShardedCSR) -> Dict[str, object]:
+        """run_info["exchange"]: what the configured exchange actually
+        ships per superstep and per shard — elements, f32 payload bytes,
+        and the number of message-carrying collectives (batches)."""
+        S = self.num_shards
+        if self.exchange == "blocked":
+            sc.ensure_blocked_plan()
+            elems, width = sc.comm_blocked_elems, sc.halo_cap
+        elif self.exchange == "a2a":
+            sc.ensure_exchange_plan()
+            elems, width = sc.comm_a2a_elems, sc.boundary_width
+        elif self.exchange == "ring":
+            elems, width = (S - 1) * sc.shard_size, sc.shard_size
+        else:
+            elems, width = sc.padded_n, sc.padded_n
+        return {
+            "mode": self.exchange,
+            "agg": self.agg,
+            "elems_per_superstep": int(elems),
+            "bytes_per_superstep": int(elems) * 4,
+            "batches_per_superstep": S - 1 if self.exchange == "ring" else 1,
+            "width": int(width),
+        }
+
+    def _resolve_exchange(self, undirected: bool = False) -> None:
+        """Resolve exchange='auto' into a concrete (exchange, agg) pair via
+        the shard-count-keyed tuner (olap/autotune.decide_sharded). Pure in
+        the graph + device kind, so the resolution is deterministic; the
+        decision is recorded for run_info["autotune"]."""
+        if self.exchange_requested != "auto" or self._autotune_record:
+            return
+        from janusgraph_tpu.olap import autotune
+        from janusgraph_tpu.parallel import halo
+
+        sc = self._sharded(undirected)
+        src, dst, _w = halo.edges_from_sharded(sc)
+        widths = halo.pair_widths(
+            src, dst, self.num_shards, sc.shard_size
+        )
+        stats = autotune.GraphStats.from_csr(self.csr, undirected=undirected)
+        decision = autotune.decide_sharded(
+            stats, self._device_kind(), self.num_shards, widths,
+            measured=getattr(self, "_measured_prior", None),
+        )
+        self.exchange = decision.exchange
+        self.agg = decision.agg
+        self._autotune_record = decision.as_dict()
 
     def _fetch(self, arr) -> np.ndarray:
         """Host copy of a mesh-sharded array. On a MULTI-PROCESS mesh each
@@ -642,7 +855,11 @@ class ShardedExecutor:
 
             sharding = NamedSharding(self.mesh, P(self.axis))
             host = getattr(sc, name)
-            if name == "ell_buckets":
+            if name in ("ell_buckets", "bell_buckets"):
+                self._h2d_bytes += sum(
+                    a.nbytes for b in host for a in b
+                    if a is not None and hasattr(a, "nbytes")
+                )
                 arr = tuple(
                     tuple(
                         self.jax.device_put(a, sharding)
@@ -652,6 +869,7 @@ class ShardedExecutor:
                     for bucket in host
                 )
             else:
+                self._h2d_bytes += host.nbytes
                 arr = self.jax.device_put(host, sharding)
             store[key] = arr
         return arr
@@ -661,7 +879,29 @@ class ShardedExecutor:
         g = {
             "out_degree": self._dev(sc, view_key, "out_degree", cache),
             "active": self._dev(sc, view_key, "active", cache),
+            "in_degree": self._dev(sc, view_key, "in_degree", cache),
         }
+        if self.exchange == "blocked":
+            sc.ensure_blocked_plan()
+            if self.agg == "ell":
+                sc.ensure_blocked_ell()
+                g["bell_buckets"] = self._dev(
+                    sc, view_key, "bell_buckets", cache
+                )
+                g["bell_unpermute"] = self._dev(
+                    sc, view_key, "bell_unpermute", cache
+                )
+                g["bell_recv_idx"] = self._dev(
+                    sc, view_key, "bell_recv_idx", cache
+                )
+                return g
+            g["blk_src"] = self._dev(sc, view_key, "blk_src_loc", cache)
+            g["blk_seg"] = self._dev(sc, view_key, "blk_seg", cache)
+            g["blk_valid"] = self._dev(sc, view_key, "blk_valid", cache)
+            if sc.has_weight:
+                g["blk_w"] = self._dev(sc, view_key, "blk_weight", cache)
+            g["recv_dst"] = self._dev(sc, view_key, "recv_dst", cache)
+            return g
         if self.exchange == "a2a":
             sc.ensure_exchange_plan()
             g["send_idx"] = self._dev(sc, view_key, "send_idx", cache)
@@ -698,6 +938,7 @@ class ShardedExecutor:
         identity = Combiner.IDENTITY[op]
         exchange, agg = self.exchange, self.agg
         B = sc.boundary_width if exchange == "a2a" else 0
+        Hc = sc.halo_cap if exchange == "blocked" else 0
 
         def seg_reduce_n(data, seg, n):
             if op == Combiner.SUM:
@@ -773,13 +1014,101 @@ class ShardedExecutor:
         def body(state, step, memory_in, g):
             offset = jax.lax.axis_index(axis) * Np
             view = _ShardView(
-                sc.real_n, Np, offset, g["out_degree"], g["active"]
+                sc.real_n, Np, offset, g["out_degree"], g["active"],
+                g.get("in_degree"),
             )
             outgoing = program.message(state, step, view, jnp)
             tail = tuple(outgoing.shape[1:])
 
             if exchange == "ring":
                 agg_v = ring_aggregate(g, outgoing)
+                return _apply_and_reduce(state, agg_v, step, memory_in, view)
+
+            if exchange == "blocked":
+                # propagation blocking: per-edge messages bin by destination
+                # shard and combiner-merge LOCALLY (local destinations
+                # [0, Np) + outgoing bins [Np, Np+S*Hc)); the pow2-tiered
+                # merged bins swap in ONE all_to_all and the receiver only
+                # combines S*Hc merged values — no message-table concat, no
+                # per-remote-edge work on the receiver. agg='ell' runs the
+                # fused merge as packed gather + adjacent-pair trees over
+                # the shard's own Np-row block; agg='segment' as one fused
+                # scatter reduction.
+                from janusgraph_tpu.olap.kernels import (
+                    flat_take,
+                    fp_fence,
+                    tree_reduce,
+                )
+
+                pad = jnp.full((1,) + tail, identity, dtype=outgoing.dtype)
+                if agg == "ell":
+                    out_ext = jnp.concatenate([outgoing, pad], axis=0)
+                    parts = []
+                    for bucket, n_slots in zip(
+                        g["bell_buckets"], sc.bell_meta
+                    ):
+                        idx, wm, va = bucket[0], bucket[1], bucket[2]
+                        m = flat_take(jnp, out_ext, idx)
+                        if wm is not None:
+                            m = apply_edge_transform(
+                                jnp, m, wm,
+                                program.edge_transform,
+                                program.edge_transform_cols,
+                            )
+                            va_ = va.reshape(
+                                va.shape + (1,) * (m.ndim - 2)
+                            )
+                            m = jnp.where(va_ > 0, m, identity)
+                            m = fp_fence(jnp, m)
+                        r = tree_reduce(jnp, m, op)
+                        if n_slots is not None:
+                            r = seg_reduce_n(
+                                r, bucket[3], n_slots + 1
+                            )[:n_slots]
+                        parts.append(r)
+                    stacked = jnp.concatenate(parts + [pad], axis=0)
+                    btab = stacked[g["bell_unpermute"]]
+                    local_part = btab[:Np]
+                    bins = btab[Np:].reshape((S, Hc) + tail)
+                    recv = jax.lax.all_to_all(
+                        bins, axis, split_axis=0, concat_axis=0
+                    )
+                    rtab = jnp.concatenate(
+                        [recv.reshape((S * Hc,) + tail), local_part, pad],
+                        axis=0,
+                    )
+                    m = flat_take(jnp, rtab, g["bell_recv_idx"])
+                    agg_v = tree_reduce(jnp, m, op)
+                    return _apply_and_reduce(
+                        state, agg_v, step, memory_in, view
+                    )
+                msgs = outgoing[g["blk_src"]]
+                msgs = apply_edge_transform(
+                    jnp, msgs, g["blk_w"] if sc.has_weight else None,
+                    program.edge_transform, program.edge_transform_cols,
+                )
+                valid = g["blk_valid"]
+                vmask = valid.reshape((-1,) + (1,) * (msgs.ndim - 1))
+                msgs = jnp.where(vmask > 0, msgs, identity)
+                # the weighted product would otherwise contract into the
+                # scatter-add as an FMA, breaking bitwise identity with
+                # the numpy replay oracle (halo.replay_superstep)
+                msgs = fp_fence(jnp, msgs)
+                seg_out = seg_reduce_n(msgs, g["blk_seg"], Np + S * Hc + 1)
+                local_part = seg_out[:Np]
+                bins = seg_out[Np : Np + S * Hc].reshape((S, Hc) + tail)
+                recv = jax.lax.all_to_all(
+                    bins, axis, split_axis=0, concat_axis=0
+                )
+                remote = seg_reduce_n(
+                    recv.reshape((S * Hc,) + tail), g["recv_dst"], Np + 1
+                )[:Np]
+                if op == Combiner.SUM:
+                    agg_v = local_part + remote
+                elif op == Combiner.MIN:
+                    agg_v = jnp.minimum(local_part, remote)
+                else:
+                    agg_v = jnp.maximum(local_part, remote)
                 return _apply_and_reduce(state, agg_v, step, memory_in, view)
 
             # ---- exchange: build the message table this shard reads from
@@ -869,6 +1198,7 @@ class ShardedExecutor:
         key = ("step", program.cache_key(), op, self.exchange, self.agg, ch_val)
         if key in self._compiled:
             return self._compiled[key]
+        self._new_execs += 1
 
         import jax
         from janusgraph_tpu.parallel.compat import shard_map
@@ -901,6 +1231,7 @@ class ShardedExecutor:
         key = ("fused", program.cache_key(), op, self.exchange, self.agg)
         if key in self._compiled:
             return self._compiled[key]
+        self._new_execs += 1
 
         import jax
         import jax.numpy as jnp
@@ -1082,14 +1413,60 @@ class ShardedExecutor:
             return "cpu"
 
     # -------------------------------------------------- per-shard reporting
+    #: skip the measured-wall probe past this many edges — the probe runs
+    #: every shard's aggregation once on the host, which must stay a
+    #: negligible fraction of the run it prices
+    MEASURE_MAX_EDGES = 20_000_000
+
+    def _measured_walls(self, sc: ShardedCSR) -> Optional[List[float]]:
+        """MEASURED per-shard superstep walls (ms): the SPMD barrier hides
+        per-shard time inside one dispatch, so run each shard's real
+        aggregation workload shard-by-shard on the host and time it
+        (min of 3 repeats). Cached per edge view — the probe prices the
+        layout, which does not change between runs."""
+        if not self.shard_measure or self.csr.num_edges > self.MEASURE_MAX_EDGES:
+            return None
+        cached = getattr(sc, "_measured_walls", None)
+        if cached is not None:
+            return cached
+        if self.exchange == "blocked":
+            from janusgraph_tpu.parallel import halo
+
+            sc.ensure_blocked_plan()
+            walls = halo.measure_shard_walls(sc.blocked_plan)
+        else:
+            # dst-partitioned probe: gather + scatter over each shard's
+            # real in-edge slice (the eager paths' per-shard work shape)
+            S, Np, Em = sc.num_shards, sc.shard_size, sc.edges_per_shard
+            offsets = sc._offsets
+            ramp = np.arange(sc.padded_n, dtype=np.float32) % 97 + 1.0
+            walls = []
+            for s in range(S):
+                k = max(1, int(offsets[s + 1] - offsets[s]))
+                src = sc.in_src_glob[s * Em : s * Em + k]
+                dst = sc.in_dst_loc[s * Em : s * Em + k]
+                w = sc.in_weight[s * Em : s * Em + k]
+                best = float("inf")
+                for _ in range(3):
+                    t0 = time.perf_counter()
+                    msgs = ramp[src] * w
+                    acc = np.zeros(Np, dtype=np.float32)
+                    np.add.at(acc, dst, msgs)
+                    best = min(best, time.perf_counter() - t0)
+                walls.append(best * 1000.0)
+        sc._measured_walls = walls
+        return walls
+
     def _shard_report(self, sc: ShardedCSR, records: List[dict]) -> None:
-        """Plan-derived per-shard ledger + roofline, straggler detection,
-        and the skew gauge. One SPMD dispatch runs every shard in lockstep
-        (the barrier hides individual shard walls), so per-shard cost is
-        priced from the shard plan — real edge/vertex counts per shard —
-        and the superstep wall is attributed to the modeled-slowest shard;
-        injected straggler skew (the chaos plan's records) adds on top.
-        Host code only; nothing here is traced."""
+        """Per-shard ledger + roofline, straggler detection, and the skew
+        gauge. One SPMD dispatch runs every shard in lockstep (the barrier
+        hides individual shard walls), so per-shard time comes from the
+        MEASURED host probe (_measured_walls — each shard's real
+        aggregation workload timed shard-by-shard, cost_source="measured")
+        when available, else from the shard plan's edge counts
+        (cost_source="plan"); the superstep wall is attributed by relative
+        per-shard cost, and injected straggler skew (the chaos plan's
+        records) adds on top. Host code only; nothing here is traced."""
         from janusgraph_tpu.observability import (
             flight_recorder,
             profiler,
@@ -1123,13 +1500,22 @@ class ShardedExecutor:
                 ),
             ))
         max_edges = max(max(edges), 1)
+        measured = self._measured_walls(sc)
+        cost_source = "measured" if measured else "plan"
+        max_meas = max(measured) if measured else 0.0
         per = []
         t_by_shard = []
         for s in range(S):
             verts, cost = costs[s]
             # the barrier wall is set by the busiest shard: scale the
-            # measured mean superstep wall by relative modeled edge load
-            modeled_ms = mean_wall * edges[s] / max_edges
+            # measured mean superstep wall by each shard's measured share
+            # of the slowest shard's probe wall (or, without the probe,
+            # by relative modeled edge load)
+            if measured and max_meas > 0:
+                share = measured[s] / max_meas
+            else:
+                share = edges[s] / max_edges
+            modeled_ms = mean_wall * share
             strag_ms = strag.get(s, 0.0)
             t_by_shard.append(modeled_ms + strag_ms / n_steps)
             point = profiler.roofline_point(
@@ -1141,6 +1527,10 @@ class ShardedExecutor:
                 "vertices": verts,
                 "edges": edges[s],
                 "modeled_ms": round(modeled_ms, 4),
+                "measured_ms": (
+                    round(measured[s], 4) if measured else None
+                ),
+                "cost_source": cost_source,
                 "straggler_ms": round(strag_ms, 3),
                 "ledger": {
                     "cells_read": edges[s],
@@ -1160,6 +1550,7 @@ class ShardedExecutor:
         block = {
             "count": S,
             "skew": round(skew, 4),
+            "cost_source": cost_source,
             "slowest_shard": slowest,
             "straggler_events": len(self._straggler_events),
             "straggler_ms_total": round(sum(strag.values()), 3),
@@ -1167,7 +1558,15 @@ class ShardedExecutor:
             "per_shard": per,
         }
         self.last_run_info["shards"] = block
+        self.last_run_info["exchange"] = self._exchange_info(sc)
+        if self._autotune_record is not None:
+            self.last_run_info["autotune"] = self._autotune_record
         registry.gauge("olap.shard.skew").set(skew)
+        # PR 8 dashboards read the skew gauge: publish whether it is now
+        # measured-wall-derived (1) or still plan-derived (0)
+        registry.gauge("olap.shard.skew.measured").set(
+            1.0 if cost_source == "measured" else 0.0
+        )
         registry.counter("olap.sharded.runs").inc()
         # ambient resource ledger: the run's plan-derived totals (one
         # message gather per edge + state write-back per vertex)
@@ -1224,6 +1623,12 @@ class ShardedExecutor:
                 "pad_ratio": round(sc.padded_n / max(1, sc.real_n), 4),
                 "superstep_ms": round(mean_wall, 3),
                 "roofline_by_tier": None,
+                # per-shard-layout fields (v2 records are keyed by shard
+                # count; these let the next lifetime's decide_sharded
+                # prefer the measured exchange layout)
+                "exchange": self.exchange,
+                "agg": self.agg,
+                "halo_cap": getattr(sc, "halo_cap", None),
             },
             shard_count=self.num_shards,
         )
@@ -1280,6 +1685,32 @@ class ShardedExecutor:
         check_weighted_transforms(program, self.csr)
         if frontier not in ("auto", "off", "always"):
             raise ValueError(f"unknown frontier mode: {frontier!r}")
+        if not getattr(program, "sharded_compatible", True):
+            # sddmm needs both endpoints' feature rows inside one kernel;
+            # the halo exchange ships only source-side data — refuse with
+            # the workaround instead of silently computing garbage
+            raise NotImplementedError(
+                "sddmm dense programs are not supported on the sharded "
+                "executor (the per-edge dot needs dst features on the "
+                "source side); run executor='tpu' or message_mode="
+                "'copy'/'weighted'"
+            )
+        if self.exchange_requested == "auto" and self._autotune_record is None:
+            # a persisted measured record for THIS shard count calibrates
+            # the layout decision across process lifetimes (autotune v2)
+            apath = (
+                os.path.join(shard_checkpoint_dir, "autotune.json")
+                if shard_checkpoint_dir
+                else (checkpoint_path + ".autotune.json"
+                      if checkpoint_path else None)
+            )
+            if apath:
+                from janusgraph_tpu.olap import autotune
+
+                self._measured_prior = autotune.load_measured(
+                    apath, shard_count=self.num_shards
+                )
+        self._resolve_exchange(program.undirected)
         from janusgraph_tpu.olap.tpu_executor import TPUExecutor
 
         use_frontier = False
@@ -1313,14 +1744,36 @@ class ShardedExecutor:
             and type(program).combiner_for is VertexProgram.combiner_for
         )
 
-        from janusgraph_tpu.exceptions import SuperstepPreempted
-        from janusgraph_tpu.observability import flight_recorder, registry
+        from janusgraph_tpu.observability import tracer
 
         hook = self._bind_hook(fault_hook)
         self._straggler_events: List[dict] = []
         self._ck_saves = 0
         self._resume_ms = 0.0
         self._resume_t_catch = None
+        self._new_execs = 0
+        self._h2d_bytes = 0
+        t_run = time.perf_counter()
+        with tracer.span(
+            "olap.run", executor="sharded", shards=self.num_shards,
+            exchange=self.exchange,
+        ) as sp:
+            out = self._run_guarded(
+                program, sc, sync_every, checkpoint_path, checkpoint_every,
+                resume, frontier, hook, resume_attempts,
+                shard_checkpoint_dir, use_frontier, use_fused,
+            )
+            self._publish_run(sp, program, out, time.perf_counter() - t_run)
+            return out
+
+    def _run_guarded(
+        self, program, sc, sync_every, checkpoint_path, checkpoint_every,
+        resume, frontier, hook, resume_attempts, shard_checkpoint_dir,
+        use_frontier, use_fused,
+    ):
+        from janusgraph_tpu.exceptions import SuperstepPreempted
+        from janusgraph_tpu.observability import flight_recorder, registry
+
         can_resume = bool(
             (shard_checkpoint_dir or checkpoint_path) and checkpoint_every
         )
@@ -1376,6 +1829,117 @@ class ShardedExecutor:
                 "location": shard_checkpoint_dir or checkpoint_path,
             }
         return out
+
+    def _publish_run(self, sp, program, result, wall_s) -> None:
+        """Publish the finished run in the SAME record vocabulary as
+        TPUExecutor._finish_run — path/supersteps/superstep_records,
+        transfer bytes, compile-cache economics, device memory, slowest-
+        superstep exemplar, and the olap.* gauges — so dashboards and
+        tests read one shape regardless of which executor a submit()
+        routed to. Host code only."""
+        from janusgraph_tpu.observability import registry, tracer
+
+        info = self.last_run_info
+        info["executor"] = "sharded"
+        info["wall_s"] = round(wall_s, 4)
+        info["retraces"] = self._new_execs
+        info["h2d_arg_bytes"] = int(self._h2d_bytes)
+        info["d2h_bytes"] = int(
+            sum(np.asarray(v).nbytes for v in result.values())
+        )
+        sc = self._sharded(bool(getattr(program, "undirected", False)))
+        pad_ratio = round(sc.padded_n / max(1, sc.real_n), 4)
+        info["pad_ratio"] = pad_ratio
+        info["ell_pad_ratio"] = pad_ratio
+        records = info.get("superstep_records")
+        if records is None:
+            # frontier path: the tier trace IS the per-superstep record
+            records = [
+                {
+                    "step": int(t.get("hop", i)),
+                    "frontier": int(t.get("frontier", 0)),
+                    "edges": int(t.get("edges", 0)),
+                    "e_cap": int(t.get("E_cap", 0)),
+                }
+                for i, t in enumerate(info.get("tiers", []))
+            ]
+        n = sc.real_n
+        for i, r in enumerate(records):
+            r.setdefault("frontier", n)
+            r.setdefault("pad_ratio", pad_ratio)
+            r.setdefault(
+                "h2d_bytes", info["h2d_arg_bytes"] if i == 0 else 0
+            )
+        info["superstep_records"] = records
+
+        dispatches = max(len(records), 1)
+        misses = min(self._new_execs, dispatches)
+        info["compile_cache"] = {
+            "hits": dispatches - misses,
+            "misses": misses,
+            "compiled_total": len(self._compiled),
+        }
+        registry.counter("olap.compile_cache.hits").inc(dispatches - misses)
+        registry.counter("olap.compile_cache.misses").inc(misses)
+
+        stats = None
+        try:
+            stats = np.asarray(self.mesh.devices).flat[0].memory_stats()
+        except Exception:  # noqa: BLE001 - backend-dependent API
+            stats = None
+        if stats and "bytes_in_use" in stats:
+            info["device_memory"] = {
+                "source": "device",
+                "bytes_in_use": int(stats["bytes_in_use"]),
+            }
+        else:
+            info["device_memory"] = {
+                "source": "host-estimate",
+                "bytes_in_use": int(info["h2d_arg_bytes"])
+                + int(info["d2h_bytes"]),
+            }
+        registry.set_gauge(
+            "olap.device.bytes_in_use",
+            float(info["device_memory"]["bytes_in_use"]),
+        )
+
+        slowest = None
+        for r in records[:128]:
+            s = tracer.record_span(
+                "superstep", float(r.get("wall_ms", 0.0)),
+                **{k: v for k, v in r.items() if k != "wall_ms"},
+            )
+            if slowest is None or s.duration_ms > slowest.duration_ms:
+                slowest = s
+        if slowest is not None:
+            info["slowest_superstep"] = {
+                "step": slowest.attrs.get("step"),
+                "wall_ms": round(slowest.duration_ms, 4),
+                "span_id": f"{slowest.span_id:016x}",
+                "trace_id": f"{slowest.trace_id:016x}",
+            }
+        sp.annotate(
+            path=info.get("path"),
+            supersteps=info.get("supersteps"),
+            wall_s=info["wall_s"],
+            retraces=self._new_execs,
+            ell_pad_ratio=pad_ratio,
+            h2d_arg_bytes=info["h2d_arg_bytes"],
+            d2h_bytes=info["d2h_bytes"],
+        )
+        registry.counter("olap.runs").inc()
+        registry.timer("olap.run").update(int(wall_s * 1e9))
+        registry.set_gauge(
+            "olap.superstep.count", float(info.get("supersteps", 0) or 0)
+        )
+        registry.set_gauge("olap.run.wall_ms", round(wall_s * 1000.0, 3))
+        registry.set_gauge(
+            "olap.transfer.h2d_bytes", float(info["h2d_arg_bytes"])
+        )
+        registry.set_gauge(
+            "olap.transfer.d2h_bytes", float(info["d2h_bytes"])
+        )
+        registry.record_run("olap", info)
 
     def _run_host_loop(
         self,
@@ -1467,7 +2031,10 @@ class ShardedExecutor:
                     break
 
         # strip padding
-        self.last_run_info = {"path": "dense", "supersteps": steps_done}
+        self.last_run_info = {
+            "path": "host-loop", "supersteps": steps_done,
+            "superstep_records": records,
+        }
         self._shard_report(sc, records)
         self._persist_measured(
             sc, checkpoint_path, shard_checkpoint_dir, records
@@ -1580,7 +2147,10 @@ class ShardedExecutor:
                 )
             if terminated:
                 break
-        self.last_run_info = {"path": "dense-fused", "supersteps": steps_done}
+        self.last_run_info = {
+            "path": "fused", "supersteps": steps_done,
+            "superstep_records": records,
+        }
         self._shard_report(sc, records)
         self._persist_measured(
             sc, checkpoint_path, shard_checkpoint_dir, records

@@ -62,11 +62,46 @@ class ShardedFrontierEngine:
         self.last_trace = []
 
     # ------------------------------------------------------------- graph args
-    def _gargs(self, sc, view_key, weighted: bool, track: bool):
+    def _mode(self, track: bool) -> str:
+        """The frontier exchange mode: 'blocked' merges remote relaxations
+        sender-side (min is exactly order-insensitive, so the hop is
+        bitwise-identical to the eager table) and collapses remote
+        expansion to one edge per used bin; predecessor tracking needs the
+        per-source identity that a merged bin discards, so track runs stay
+        on the eager boundary table."""
+        return (
+            "blocked"
+            if self.ex.exchange == "blocked" and not track
+            else "a2a"
+        )
+
+    def _table_len(self, sc, mode: str) -> int:
+        if mode == "blocked":
+            sc.ensure_blocked_plan()
+            return sc.shard_size + sc.num_shards * sc.halo_cap
+        sc.ensure_exchange_plan()
+        return sc.msg_table_len
+
+    def _gargs(self, sc, view_key, weighted: bool, track: bool,
+               mode: str = "a2a"):
         """Device-resident plan arrays for one edge view (reuses the
-        executor's sharded device cache — the a2a send_idx is shared with
-        the dense path)."""
+        executor's sharded device cache — the a2a send_idx / blocked bin
+        maps are shared with the dense path)."""
         ex = self.ex
+        if mode == "blocked":
+            sc.ensure_frontier_plan_blocked()
+            g = {
+                "blk_src": ex._dev(sc, view_key, "blk_src_loc"),
+                "blk_bin_seg": ex._dev(sc, view_key, "blk_bin_seg"),
+                "blk_valid": ex._dev(sc, view_key, "blk_valid"),
+                "ftr_ip": ex._dev(sc, view_key, "fblk_ip"),
+                "ftr_dst": ex._dev(sc, view_key, "fblk_dst"),
+                "ftr_deg": ex._dev(sc, view_key, "fblk_deg"),
+            }
+            if weighted:
+                g["blk_w"] = ex._dev(sc, view_key, "blk_weight")
+                g["ftr_w"] = ex._dev(sc, view_key, "fblk_w")
+            return g
         sc.ensure_frontier_plan()
         g = {
             "send_idx": ex._dev(sc, view_key, "send_idx"),
@@ -81,12 +116,18 @@ class ShardedFrontierEngine:
         return g
 
     # ------------------------------------------------------------------ plan
-    def _plan_fn(self, sc, view_key):
+    def _plan_fn(self, sc, view_key, mode: str = "a2a", has_w: bool = False):
         """(value, mask, g) -> (tab, count_max, edge_max, count_sum,
-        edge_sum): builds the frontier-masked message table (the a2a
-        exchange lives HERE, so the tier choice can follow it) and prices
-        the coming expansion."""
-        key = ("sfrontier-plan", view_key, sc.msg_table_len)
+        edge_sum): builds the frontier-masked message table (the exchange
+        lives HERE, so the tier choice can follow it) and prices the
+        coming expansion. mode='blocked' ships sender-merged relaxation
+        bins (propagation blocking: segment-min by destination bin, ONE
+        all_to_all of S*Hc merged elements) instead of the raw S*B
+        boundary values."""
+        key = (
+            "sfrontier-plan", view_key, mode, has_w,
+            self._table_len(sc, mode),
+        )
         cache = self.ex._compiled
         if key in cache:
             return cache[key]
@@ -97,26 +138,58 @@ class ShardedFrontierEngine:
 
         axis = self.axis
         S = sc.num_shards
-        B = sc.boundary_width
 
-        def plan_body(value, mask, g):
-            outgoing = jnp.where(mask, value, INF)
-            sends = outgoing[g["send_idx"]]                  # (S, B)
-            recv = jax.lax.all_to_all(
-                sends, axis, split_axis=0, concat_axis=0
-            )
-            tab = jnp.concatenate([outgoing, recv.reshape(S * B)])
-            fresh = tab < INF
-            zero = jnp.zeros((), jnp.int32)
-            count = jnp.sum(fresh.astype(jnp.int32))
-            edges = jnp.sum(jnp.where(fresh, g["ftr_deg"], zero))
-            return (
-                tab,
-                jax.lax.pmax(count, axis),
-                jax.lax.pmax(edges, axis),
-                jax.lax.psum(count, axis),
-                jax.lax.psum(edges, axis),
-            )
+        if mode == "blocked":
+            Hc = sc.halo_cap
+
+            def plan_body(value, mask, g):
+                outgoing = jnp.where(mask, value, INF)
+                msgs = outgoing[g["blk_src"]]
+                if has_w:
+                    # fold the edge weight into the merged candidate: the
+                    # receiver's bin edge carries weight 0
+                    msgs = msgs + g["blk_w"]
+                msgs = jnp.where(g["blk_valid"] > 0, msgs, INF)
+                bins = jax.ops.segment_min(
+                    msgs, g["blk_bin_seg"], num_segments=S * Hc + 1
+                )[: S * Hc]
+                recv = jax.lax.all_to_all(
+                    bins.reshape(S, Hc), axis,
+                    split_axis=0, concat_axis=0,
+                )
+                tab = jnp.concatenate([outgoing, recv.reshape(S * Hc)])
+                fresh = tab < INF
+                zero = jnp.zeros((), jnp.int32)
+                count = jnp.sum(fresh.astype(jnp.int32))
+                edges = jnp.sum(jnp.where(fresh, g["ftr_deg"], zero))
+                return (
+                    tab,
+                    jax.lax.pmax(count, axis),
+                    jax.lax.pmax(edges, axis),
+                    jax.lax.psum(count, axis),
+                    jax.lax.psum(edges, axis),
+                )
+        else:
+            B = sc.boundary_width
+
+            def plan_body(value, mask, g):
+                outgoing = jnp.where(mask, value, INF)
+                sends = outgoing[g["send_idx"]]              # (S, B)
+                recv = jax.lax.all_to_all(
+                    sends, axis, split_axis=0, concat_axis=0
+                )
+                tab = jnp.concatenate([outgoing, recv.reshape(S * B)])
+                fresh = tab < INF
+                zero = jnp.zeros((), jnp.int32)
+                count = jnp.sum(fresh.astype(jnp.int32))
+                edges = jnp.sum(jnp.where(fresh, g["ftr_deg"], zero))
+                return (
+                    tab,
+                    jax.lax.pmax(count, axis),
+                    jax.lax.pmax(edges, axis),
+                    jax.lax.psum(count, axis),
+                    jax.lax.psum(edges, axis),
+                )
 
         sh, rep = P(self.axis), P()
         fn = jax.jit(shard_map(
@@ -126,13 +199,17 @@ class ShardedFrontierEngine:
             out_specs=(sh, rep, rep, rep, rep),
             check_vma=False,
         ))
+        self.ex._new_execs = getattr(self.ex, "_new_execs", 0) + 1
         cache[key] = fn
         return fn
 
     # ------------------------------------------------------------------ step
-    def _step_fn(self, sc, view_key, F_cap, E_cap, weighted, track, has_w):
+    def _step_fn(
+        self, sc, view_key, F_cap, E_cap, weighted, track, has_w, T=None,
+    ):
         key = (
-            "sfrontier-step", view_key, F_cap, E_cap, weighted, track, has_w
+            "sfrontier-step", view_key, F_cap, E_cap, weighted, track,
+            has_w, T,
         )
         cache = self.ex._compiled
         if key in cache:
@@ -144,7 +221,8 @@ class ShardedFrontierEngine:
 
         axis = self.axis
         Np = sc.shard_size
-        T = sc.msg_table_len
+        if T is None:
+            T = sc.msg_table_len
 
         def step_body(value, pred, tab, t, g):
             fresh = tab < INF
@@ -196,6 +274,7 @@ class ShardedFrontierEngine:
             out_specs=out_specs,
             check_vma=False,
         ))
+        self.ex._new_execs = getattr(self.ex, "_new_execs", 0) + 1
         cache[key] = fn
         return fn
 
@@ -220,11 +299,19 @@ class ShardedFrontierEngine:
         has_w = (
             weighted if use_weights is None else use_weights
         ) and sc.has_weight
-        sc.ensure_frontier_plan()  # also builds the exchange plan
-        T = sc.msg_table_len
-        Em = sc.edges_per_shard
-        g = self._gargs(sc, view_key, has_w, track)
-        plan = self._plan_fn(sc, view_key)
+        mode = self._mode(track)
+        if mode == "blocked":
+            sc.ensure_frontier_plan_blocked()
+            T = self._table_len(sc, mode)
+            Em = sc.fblk_edges
+            exchange_elems = sc.num_shards * sc.halo_cap
+        else:
+            sc.ensure_frontier_plan()  # also builds the exchange plan
+            T = sc.msg_table_len
+            Em = sc.edges_per_shard
+            exchange_elems = sc.num_shards * sc.boundary_width
+        g = self._gargs(sc, view_key, has_w, track, mode)
+        plan = self._plan_fn(sc, view_key, mode, has_w)
         trace = []
         for t in range(max_iterations):
             if fault_hook is not None:
@@ -241,9 +328,10 @@ class ShardedFrontierEngine:
                 "hop": t, "frontier": csum, "edges": esum,
                 "shard_max_frontier": cmax, "shard_max_edges": emax,
                 "F_cap": f_cap, "E_cap": e_cap,
+                "exchange": mode, "exchange_elems": exchange_elems,
             })
             fn = self._step_fn(
-                sc, view_key, f_cap, e_cap, weighted, track, has_w
+                sc, view_key, f_cap, e_cap, weighted, track, has_w, T
             )
             tf = jnp.asarray(t, jnp.float32)
             if track:

@@ -1,0 +1,22 @@
+"""JG301 fixture: propagation-blocked halo-bin capacity tiers (parse-only).
+
+The blocked exchange pads every (src-shard → dst-shard) merged-destination
+bin to ONE pow2 capacity tier so a single lax.all_to_all split — and one
+compiled executable — serves the whole mesh; a non-pow2 literal silently
+breaks the uniform-split contract. 0 means auto-pick (halo_tier sizes the
+tier from the widest pair) and is allowed.
+"""
+import numpy as np
+
+
+def build_halo_plan(num_shards, widest):
+    halo_cap = 100  # expect: JG301
+    send_bin = 3 * 64  # expect: JG301
+    good_cap = 256
+    auto_halo_cap = 0  # auto-pick: allowed
+    bins = np.zeros((num_shards, good_cap), dtype=np.float32)
+    return halo_cap, send_bin, auto_halo_cap, bins
+
+
+def exchange_bins(bins, exchange_tier=48):  # expect: JG301
+    return bins[:, :exchange_tier]

@@ -129,16 +129,19 @@ def test_manifest_digest_rejects_edit(tmp_path):
 
 
 # ------------------------------------------------- cross-shard auto-resume
-@pytest.mark.parametrize("agg", ["ell", "segment"])
-def test_shard_preempt_resume_bitwise_sharded(mesh8, tmp_path, agg):
+@pytest.mark.parametrize("exchange,agg", [
+    ("a2a", "ell"), ("a2a", "segment"),
+    ("blocked", "ell"), ("blocked", "segment"),
+])
+def test_shard_preempt_resume_bitwise_sharded(mesh8, tmp_path, exchange, agg):
     g = random_graph()
-    base_ex = ShardedExecutor(g, mesh=mesh8, agg=agg)
+    base_ex = ShardedExecutor(g, mesh=mesh8, exchange=exchange, agg=agg)
     base = base_ex.run(
         _pagerank(), fused=False, checkpoint_every=3,
         shard_checkpoint_dir=str(tmp_path / "base"),
     )
     plan = FaultPlan(seed=21, shard_preempt_superstep=5)
-    ex = ShardedExecutor(g, mesh=mesh8, agg=agg)
+    ex = ShardedExecutor(g, mesh=mesh8, exchange=exchange, agg=agg)
     out = ex.run(
         _pagerank(), fused=False, checkpoint_every=3,
         shard_checkpoint_dir=str(tmp_path / "chaos"),
@@ -152,14 +155,15 @@ def test_shard_preempt_resume_bitwise_sharded(mesh8, tmp_path, agg):
     assert plan.journal[0]["shard"] < 8
 
 
-def test_collective_timeout_and_halo_drop_resume(mesh8, tmp_path):
+@pytest.mark.parametrize("exchange", ["a2a", "blocked"])
+def test_collective_timeout_and_halo_drop_resume(mesh8, tmp_path, exchange):
     g = random_graph(seed=17)
-    base = ShardedExecutor(g, mesh=mesh8).run(
+    base = ShardedExecutor(g, mesh=mesh8, exchange=exchange).run(
         _pagerank(), fused=False, checkpoint_every=2,
         shard_checkpoint_dir=str(tmp_path / "base"),
     )
     plan = FaultPlan(seed=3, collective_timeout_at=4, halo_drop_at=7)
-    ex = ShardedExecutor(g, mesh=mesh8)
+    ex = ShardedExecutor(g, mesh=mesh8, exchange=exchange)
     out = ex.run(
         _pagerank(), fused=False, checkpoint_every=2,
         shard_checkpoint_dir=str(tmp_path / "chaos"),
@@ -185,7 +189,7 @@ def test_fused_path_resumes_from_manifest(mesh8, tmp_path):
         fault_hook=plan.sharded_hook,
     )
     _bitwise_equal(base, out)
-    assert ex.last_run_info["path"] == "dense-fused"
+    assert ex.last_run_info["path"] == "fused"
     assert ex.last_run_info["resumes"] >= 1
 
 
