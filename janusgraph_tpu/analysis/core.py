@@ -74,6 +74,13 @@ RULES: Dict[str, Rule] = {
              "except clause swallows BackendError/TemporaryBackendError "
              "without re-raising or routing through backend_op.execute "
              "(a dropped temporary failure loses the retry/recovery path)"),
+        Rule("JG206", SEV_ERROR,
+             "unbounded queue: queue.Queue()/collections.deque() without "
+             "a maxsize/maxlen bound — under overload an unbounded "
+             "buffer converts backpressure into memory growth and "
+             "latency convoys (the serving path sheds load instead; "
+             "suppress with justification where a bound is structurally "
+             "guaranteed)"),
         # -- padding / shape invariants -------------------------------------
         Rule("JG301", SEV_ERROR,
              "capacity tier constant is not a power of two (ELL/frontier "

@@ -1,18 +1,29 @@
-"""JG204 — swallowed backend errors.
+"""JG204 — swallowed backend errors; JG206 — unbounded queues.
 
-The exception taxonomy (janusgraph_tpu/exceptions.py) splits backend
-failures into temporary (retriable) and permanent; the whole self-healing
-stack — backend_op retries, circuit breaking, torn-commit recovery — hangs
-off that split. An ``except`` clause that catches ``BackendError`` /
-``TemporaryBackendError`` (or their locking subclasses) and neither
-re-raises nor routes the operation back through ``backend_op.execute``
-silently deletes a failure the recovery machinery was built to absorb: the
-caller sees success, the data may be gone.
+JG204: the exception taxonomy (janusgraph_tpu/exceptions.py) splits
+backend failures into temporary (retriable) and permanent; the whole
+self-healing stack — backend_op retries, circuit breaking, torn-commit
+recovery — hangs off that split. An ``except`` clause that catches
+``BackendError`` / ``TemporaryBackendError`` (or their locking
+subclasses) and neither re-raises nor routes the operation back through
+``backend_op.execute`` silently deletes a failure the recovery machinery
+was built to absorb: the caller sees success, the data may be gone.
 
 A handler passes when its body contains a ``raise`` on some path or a call
 to ``backend_op.execute`` / bare ``execute``. Protocol boundaries that
 serialize the error to a peer instead should carry a justified
 ``# graphlint: disable=JG204 -- why`` suppression.
+
+JG206: a ``queue.Queue()`` / ``collections.deque()`` constructed without
+a ``maxsize`` / ``maxlen`` bound (absent, 0, or None) is an overload
+hazard: under sustained load an unbounded buffer converts backpressure
+into unbounded memory growth and latency convoys — exactly the collapse
+mode the admission controller's BOUNDED wait queue exists to prevent
+(server/admission.py; every in-tree ring — spans, flight recorder, logs —
+is a ``deque(maxlen=...)`` for the same reason). Where a bound is
+structurally guaranteed (e.g. a BFS work queue that enqueues each vertex
+at most once), carry a justified ``# graphlint: disable=JG206 -- why``
+suppression instead of a fake numeric bound.
 """
 
 from __future__ import annotations
@@ -63,9 +74,66 @@ def _handler_routes_or_reraises(handler: ast.ExceptHandler) -> bool:
     return False
 
 
+#: queue-constructor vocabulary: {callable name: bounding kwarg}. The
+#: deque bound may also ride as the SECOND positional argument; Queue's
+#: as the first.
+_QUEUE_CTORS = {
+    "Queue": ("maxsize", 0),
+    "LifoQueue": ("maxsize", 0),
+    "PriorityQueue": ("maxsize", 0),
+    "deque": ("maxlen", 1),
+}
+
+
+def _is_unbounded_literal(node) -> bool:
+    """True for the explicitly-unbounded spellings: 0 and None."""
+    return isinstance(node, ast.Constant) and node.value in (0, None)
+
+
+def _unbounded_queue_call(node: ast.Call):
+    """Return the flagged constructor name when this call builds an
+    unbounded queue/deque (bound absent, 0, or None); None otherwise."""
+    name = terminal_name(node.func)
+    spec = _QUEUE_CTORS.get(name or "")
+    if spec is None:
+        return None
+    kwarg, pos = spec
+    # qualified calls must come off the expected module to avoid flagging
+    # unrelated Queue classes (multiprocessing.Queue is bounded-ish but
+    # foreign; only queue.* / collections.* spellings are in scope here)
+    f = node.func
+    if isinstance(f, ast.Attribute):
+        owner = terminal_name(f.value)
+        if owner not in ("queue", "collections"):
+            return None
+    bound = None
+    if len(node.args) > pos:
+        bound = node.args[pos]
+    for kw in node.keywords:
+        if kw.arg == kwarg:
+            bound = kw.value
+    if bound is None or _is_unbounded_literal(bound):
+        return name
+    return None
+
+
 def check_module(mod) -> List[Finding]:
     findings: List[Finding] = []
     for node in ast.walk(mod.tree):
+        if isinstance(node, ast.Call):
+            name = _unbounded_queue_call(node)
+            if name is not None:
+                kwarg = _QUEUE_CTORS[name][0]
+                findings.append(Finding(
+                    "JG206", RULES["JG206"].severity, mod.path,
+                    node.lineno, node.col_offset,
+                    f"{name}() without a {kwarg} bound: an unbounded "
+                    "buffer turns overload backpressure into memory "
+                    "growth and latency convoys — size it, or suppress "
+                    "with a justification when a bound is structurally "
+                    "guaranteed",
+                ))
+            continue
         if not isinstance(node, ast.ExceptHandler):
             continue
         caught = _caught_names(node.type) & BACKEND_ERROR_NAMES

@@ -64,6 +64,11 @@ def cmd_server(args) -> int:
             ),
         )
 
+    admission = None
+    if graph.config.get("server.admission.enabled"):
+        from janusgraph_tpu.server.admission import AdmissionController
+
+        admission = AdmissionController.from_config(graph.config)
     server = JanusGraphServer(
         manager=manager,
         default_graph=args.graph_name,
@@ -74,6 +79,10 @@ def cmd_server(args) -> int:
         max_query_length=graph.config.get("server.max-query-length"),
         request_timeout_s=graph.config.get("server.request-timeout-s"),
         auto_commit=graph.config.get("server.auto-commit"),
+        admission=admission,
+        admission_enabled=graph.config.get("server.admission.enabled"),
+        default_deadline_ms=graph.config.get("server.deadline.default-ms"),
+        max_deadline_ms=graph.config.get("server.deadline.max-ms"),
     ).start()
     print(f"JanusGraph-TPU server listening on {args.host}:{server.port}")
     try:

@@ -602,8 +602,10 @@ SERVER_NS.option(
 )
 SERVER_NS.option(
     "request-timeout-s", float,
-    "per-connection socket timeout of the HTTP/WS handlers (0 = no "
-    "timeout: idle WebSocket sessions live indefinitely)", 120.0,
+    "per-connection socket timeout of the HTTP/WS handlers AND the "
+    "default wall-clock deadline on query evaluation when the client "
+    "sends no X-Deadline-Ms (overridable via server.deadline.default-ms; "
+    "0 = neither: idle WebSocket sessions live indefinitely)", 120.0,
     Mutability.MASKABLE, lambda v: v >= 0,
 )
 # ---- round-5 batch: remaining reference-vocabulary knobs that were
@@ -1067,6 +1069,148 @@ METRICS_NS.option(
     "stderr from the server, retry guard, circuit breaker, and chaos "
     "sites (observability/logging.py; records always land in the "
     "in-process ring regardless)", False, Mutability.LOCAL,
+)
+
+
+# ---- overload defense: admission control, deadlines, retry budgets ------
+DRIVER_NS = ConfigNamespace("driver", "remote driver client", ROOT)
+
+SERVER_NS.option(
+    "admission.enabled", bool,
+    "cost-aware admission control in front of every query request "
+    "(server/admission.py AdmissionController: adaptive AIMD concurrency "
+    "limit, bounded cost-priority wait queue, load shedding with "
+    "Retry-After, brownout ladder); observability endpoints always "
+    "bypass it", True, Mutability.LOCAL,
+)
+SERVER_NS.option(
+    "admission.initial-limit", int,
+    "starting concurrent-request limit of the AIMD controller", 8,
+    Mutability.LOCAL, lambda v: v > 0,
+)
+SERVER_NS.option(
+    "admission.min-limit", int,
+    "floor the multiplicative decrease never drops the limit below", 1,
+    Mutability.LOCAL, lambda v: v > 0,
+)
+SERVER_NS.option(
+    "admission.max-limit", int,
+    "ceiling the additive increase never raises the limit above", 64,
+    Mutability.LOCAL, lambda v: v > 0,
+)
+SERVER_NS.option(
+    "admission.queue-bound", int,
+    "bounded wait-queue depth; arrivals past it are shed with "
+    "429/503 + Retry-After (decorrelated jitter)", 32,
+    Mutability.LOCAL, lambda v: v >= 0,
+)
+SERVER_NS.option(
+    "admission.window", int,
+    "completed requests per AIMD decision window (the window's median "
+    "latency is compared against the baseline)", 32,
+    Mutability.LOCAL, lambda v: v > 0,
+)
+SERVER_NS.option(
+    "admission.latency-threshold", float,
+    "multiplicative-decrease trigger: window median latency above "
+    "threshold x baseline shrinks the limit; below it the limit grows "
+    "by one", 2.0, Mutability.LOCAL, lambda v: v > 1.0,
+)
+SERVER_NS.option(
+    "admission.default-cost-ms", float,
+    "wait-queue price of a query shape the digest price book has not "
+    "measured yet (unknown shapes are assumed mid-priced, not free)",
+    25.0, Mutability.LOCAL, lambda v: v > 0,
+)
+SERVER_NS.option(
+    "admission.cheap-cost-ms", float,
+    "known-cheap threshold: under brownout rung 3 only digests with a "
+    "measured mean cost at or below this are admitted", 5.0,
+    Mutability.LOCAL, lambda v: v > 0,
+)
+SERVER_NS.option(
+    "admission.brownout-window-s", float,
+    "sliding window over shed events that drives brownout escalation",
+    5.0, Mutability.LOCAL, lambda v: v > 0,
+)
+SERVER_NS.option(
+    "admission.brownout-enter-sheds", int,
+    "sheds within the brownout window that escalate the ladder one rung "
+    "(1: shed span retention, 2: refuse OLAP submits, 3: admit only "
+    "known-cheap digests)", 8, Mutability.LOCAL, lambda v: v > 0,
+)
+SERVER_NS.option(
+    "admission.brownout-exit-s", float,
+    "shed-free time that de-escalates the ladder one rung (hysteresis: "
+    "exiting is deliberately slower than entering)", 10.0,
+    Mutability.LOCAL, lambda v: v > 0,
+)
+SERVER_NS.option(
+    "admission.brownout-dwell-s", float,
+    "minimum time between rung transitions in either direction (keeps "
+    "the ladder from flapping)", 2.0, Mutability.LOCAL, lambda v: v >= 0,
+)
+SERVER_NS.option(
+    "admission.retry-after-base-s", float,
+    "base of the decorrelated-jitter Retry-After hint on shed "
+    "responses", 0.25, Mutability.LOCAL, lambda v: v > 0,
+)
+SERVER_NS.option(
+    "admission.retry-after-max-s", float,
+    "ceiling of the decorrelated-jitter Retry-After hint", 8.0,
+    Mutability.LOCAL, lambda v: v > 0,
+)
+SERVER_NS.option(
+    "deadline.propagation", bool,
+    "forward the ambient request deadline's remaining budget on "
+    "remote-store/index op frames (gated on the peer's negotiated "
+    "feature bit, so mixed old/new deployments stay wire-compatible; "
+    "read at graph open into RemoteStoreManager/RemoteIndexProvider)",
+    True, Mutability.MASKABLE,
+)
+SERVER_NS.option(
+    "deadline.default-ms", float,
+    "deadline applied to a request whose client sent no X-Deadline-Ms "
+    "header / WS deadline field (0 = derive from server.request-"
+    "timeout-s; read in server/server.py)", 0.0,
+    Mutability.LOCAL, lambda v: v >= 0,
+)
+SERVER_NS.option(
+    "deadline.max-ms", float,
+    "clamp on client-supplied deadlines — a client cannot buy more "
+    "server time than the operator allows (0 = no clamp)", 600_000.0,
+    Mutability.LOCAL, lambda v: v >= 0,
+)
+DRIVER_NS.option(
+    "retry-budget-capacity", float,
+    "token-bucket capacity of the driver's per-connection retry budget: "
+    "each retry of a shed (429/503) response spends one token, so "
+    "client retries cannot stampede a recovering server (0 = never "
+    "retry; read in driver/client.py)", 8.0,
+    Mutability.LOCAL, lambda v: v >= 0,
+)
+DRIVER_NS.option(
+    "retry-budget-refill-per-s", float,
+    "token refill rate of the driver retry budget", 0.5,
+    Mutability.LOCAL, lambda v: v >= 0,
+)
+STORAGE.option(
+    "faults.overload-at", int,
+    "data-plane read index at which an injected latency STORM begins "
+    "(-1 = off): the next faults.overload-ops reads each stall "
+    "faults.overload-latency-ms — the seeded saturation scenario the "
+    "admission controller is tested against", -1,
+    Mutability.LOCAL, lambda v: v >= -1,
+)
+STORAGE.option(
+    "faults.overload-ops", int,
+    "reads the overload storm covers once it begins", 0,
+    Mutability.LOCAL, lambda v: v >= 0,
+)
+STORAGE.option(
+    "faults.overload-latency-ms", float,
+    "per-read stall length inside the overload storm", 0.0,
+    Mutability.LOCAL, lambda v: v >= 0,
 )
 
 

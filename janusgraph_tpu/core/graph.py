@@ -100,6 +100,7 @@ def _open_remote(cfg):
         ),
         trace_propagation=cfg.get("metrics.trace-propagation"),
         resource_ledger=cfg.get("metrics.resource-ledger"),
+        deadline_propagation=cfg.get("server.deadline.propagation"),
     )
 
 
@@ -466,6 +467,7 @@ class JanusGraphTPU:
                 ),
                 trace_propagation=cfg.get("metrics.trace-propagation"),
                 resource_ledger=cfg.get("metrics.resource-ledger"),
+                deadline_propagation=cfg.get("server.deadline.propagation"),
             )
         self.index_providers: Dict[str, object] = shared
         # {index_name: {field: KeyInformation}} for provider.mutate calls

@@ -3099,7 +3099,14 @@ class GraphTraversal:
         # with evaluationTimeout; a Python thread cannot be interrupted,
         # so the budget is on SIZE, which is what actually explodes)
         cap = getattr(self.tx.graph, "_max_traversers", 0)
+        from janusgraph_tpu.core import deadline as _deadline
+
         for step in self._steps:
+            # wall-clock deadline on EVALUATION (core/deadline.py): a
+            # Python thread cannot be interrupted, so the budget is
+            # checked at every step boundary — a deep traversal whose
+            # caller gave up aborts between steps instead of walking on
+            _deadline.check("traversal step")
             ts = run(getattr(step, "_label", "step"), step, ts)
             if cap and len(ts) > cap:
                 raise QueryError(

@@ -3,6 +3,23 @@
 Capability parity with the reference's remote driver usage (gremlin-driver
 Cluster/Client against JanusGraphServer — here a dependency-free client
 speaking the server's JSON protocol with GraphSON-typed results).
+
+Overload cooperation (docs/robustness.md "Overload defense"): the client
+is the TOP of the retry stack, so it carries the two client-side halves
+of the defense —
+
+- **deadline propagation**: ``submit(..., deadline_ms=...)`` (or the
+  constructor's ``deadline_ms`` default) ships the remaining budget in an
+  ``X-Deadline-Ms`` header (WS ``deadline`` field). The server enforces
+  it as a wall-clock evaluation bound and forwards it into the storage
+  protocols, so abandoning callers stop burning server work.
+- **per-connection retry budget** (:class:`RetryBudget`): a token bucket
+  (``driver.retry-budget-capacity`` / ``-refill-per-s``). A shed response
+  (429/503 + Retry-After) is retried only while tokens remain, sleeping
+  the server's jittered Retry-After hint first — so a thousand shed
+  clients cannot re-stampede a recovering server on a synchronized
+  schedule, and a client out of tokens surfaces the 503 instead of
+  retrying forever.
 """
 
 from __future__ import annotations
@@ -13,16 +30,54 @@ import json
 import os
 import socket
 import struct
+import threading
+import time
 from typing import Any, Optional
+from urllib import error as _urlerr
 from urllib import request as _urlreq
 
 from janusgraph_tpu.driver.graphson import _decode  # typed-JSON reader
 
 
 class RemoteError(Exception):
-    def __init__(self, code, message):
+    def __init__(self, code, message, status=None, retry_after_s=None):
         super().__init__(f"[{code}] {message}")
         self.code = code
+        #: the server's status discriminator ("shed" / "timeout" / None)
+        self.status = status
+        #: the shed response's Retry-After hint, when one came back
+        self.retry_after_s = retry_after_s
+
+
+class RetryBudget:
+    """Token bucket bounding retries per client connection. ``take()``
+    spends one token when available; tokens refill continuously at
+    ``refill_per_s`` up to ``capacity``. Capacity 0 = never retry."""
+
+    def __init__(self, capacity: float = 8.0, refill_per_s: float = 0.5):
+        self.capacity = float(capacity)
+        self.refill_per_s = float(refill_per_s)
+        self._tokens = float(capacity)
+        self._last = time.monotonic()
+        self._lock = threading.Lock()
+
+    def take(self) -> bool:
+        with self._lock:
+            now = time.monotonic()
+            self._tokens = min(
+                self.capacity,
+                self._tokens + (now - self._last) * self.refill_per_s,
+            )
+            self._last = now
+            if self._tokens >= 1.0:
+                self._tokens -= 1.0
+                return True
+            return False
+
+    @property
+    def tokens(self) -> float:
+        with self._lock:
+            return self._tokens
 
 
 def _merge_status_ledger(status: dict) -> None:
@@ -57,11 +112,36 @@ class JanusGraphClient:
         username: Optional[str] = None,
         password: Optional[str] = None,
         token: Optional[str] = None,
+        deadline_ms: Optional[float] = None,
+        retry_budget_capacity: Optional[float] = None,
+        retry_budget_refill_per_s: Optional[float] = None,
     ):
+        from janusgraph_tpu.core.config import REGISTRY
+
         self.base = f"http://{host}:{port}"
         self.host, self.port = host, port
         self.username, self.password = username, password
         self.token = token
+        #: default per-submit deadline budget (None = let the server
+        #: apply its own default); overridable per call
+        self.deadline_ms = deadline_ms
+        # driver.retry-budget-* defaults come from the config registry so
+        # the documented keys and the constructor agree on one value
+        if retry_budget_capacity is None:
+            retry_budget_capacity = REGISTRY[
+                "driver.retry-budget-capacity"
+            ].default
+        if retry_budget_refill_per_s is None:
+            retry_budget_refill_per_s = REGISTRY[
+                "driver.retry-budget-refill-per-s"
+            ].default
+        #: one bucket per client CONNECTION (WS sessions opened from this
+        #: client share it): retries across every submit draw from the
+        #: same budget, so a burst of sheds cannot multiply into a
+        #: stampede
+        self.retry_budget = RetryBudget(
+            retry_budget_capacity, retry_budget_refill_per_s
+        )
 
     # ----------------------------------------------------------------- auth
     def _auth_header(self) -> dict:
@@ -87,9 +167,20 @@ class JanusGraphClient:
         return self.token
 
     # ---------------------------------------------------------------- HTTP
-    def submit(self, gremlin: str, graph: Optional[str] = None) -> Any:
+    def submit(
+        self,
+        gremlin: str,
+        graph: Optional[str] = None,
+        deadline_ms: Optional[float] = None,
+    ) -> Any:
         from janusgraph_tpu.observability import tracer
 
+        if deadline_ms is None:
+            deadline_ms = self.deadline_ms
+        give_up_at = (
+            time.monotonic() + deadline_ms / 1000.0
+            if deadline_ms else None
+        )
         # the client-side root of the distributed trace: the request ships
         # this span's context in X-Trace-Context, the server's spans (and
         # the storage/index nodes' below it) join the same trace_id
@@ -98,24 +189,79 @@ class JanusGraphClient:
         ) as sp:
             ctx = sp.context()
             body = json.dumps({"gremlin": gremlin, "graph": graph}).encode()
-            req = _urlreq.Request(
-                self.base + "/gremlin", data=body, method="POST",
-                headers={
+            while True:
+                headers = {
                     "Content-Type": "application/json",
                     "X-Trace-Context": ctx.to_header(),
                     **self._auth_header(),
-                },
-            )
-            with _urlreq.urlopen(req) as resp:
-                payload = json.loads(resp.read())
-            status = payload.get("status", {})
-            if "trace" in status:
-                sp.annotate(server_trace=status["trace"])
-            _merge_status_ledger(status)
-            if status.get("code") != 200:
+                }
+                if give_up_at is not None:
+                    # REMAINING budget at send time: retries shrink it
+                    headers["X-Deadline-Ms"] = str(
+                        max(0, int((give_up_at - time.monotonic()) * 1000))
+                    )
+                req = _urlreq.Request(
+                    self.base + "/gremlin", data=body, method="POST",
+                    headers=headers,
+                )
+                retry_after = None
+                try:
+                    with _urlreq.urlopen(req) as resp:
+                        payload = json.loads(resp.read())
+                except _urlerr.HTTPError as e:
+                    # shed (429/503 + Retry-After) and timeout (504)
+                    # responses ride real HTTP codes with a structured
+                    # JSON body; anything else (401, 404, ...) keeps the
+                    # stdlib behavior callers already handle
+                    if e.code not in (429, 503, 504):
+                        raise
+                    try:
+                        payload = json.loads(e.read())
+                    except Exception:  # noqa: BLE001 - non-JSON error body
+                        payload = {"status": {
+                            "code": e.code, "message": str(e),
+                        }}
+                    retry_after = e.headers.get("Retry-After")
+                status = payload.get("status", {})
+                if "trace" in status:
+                    sp.annotate(server_trace=status["trace"])
+                _merge_status_ledger(status)
+                if status.get("code") == 200:
+                    return _decode(payload["result"]["data"])
                 sp.annotate(code=status.get("code"))
-                raise RemoteError(status.get("code"), status.get("message"))
-            return _decode(payload["result"]["data"])
+                err = RemoteError(
+                    status.get("code"), status.get("message"),
+                    status=status.get("status"),
+                    retry_after_s=status.get("retry_after_s"),
+                )
+                if not self._should_retry(err, retry_after, give_up_at, sp):
+                    raise err
+
+    def _should_retry(self, err, retry_after_header, give_up_at, sp) -> bool:
+        """Shed-response retry policy: only 429/503 sheds are retriable,
+        only while the retry budget has tokens, and only after sleeping
+        the server's Retry-After hint (never past the caller's own
+        deadline). Everything else surfaces immediately."""
+        if err.code not in (429, 503) or err.status != "shed":
+            return False
+        wait_s = err.retry_after_s
+        if wait_s is None and retry_after_header:
+            try:
+                wait_s = float(retry_after_header)
+            except ValueError:
+                wait_s = None
+        if wait_s is None:
+            wait_s = 1.0
+        if give_up_at is not None and (
+            time.monotonic() + wait_s >= give_up_at
+        ):
+            return False  # honoring Retry-After would blow the deadline
+        if not self.retry_budget.take():
+            sp.annotate(retry_budget_exhausted=True)
+            return False
+        sp.annotate(retried_after_s=wait_s)
+        time.sleep(wait_s)
+        return True
 
     def graphs(self) -> list:
         req = _urlreq.Request(
@@ -166,28 +312,53 @@ class WebSocketSession:
         if " 101 " not in status_line:
             raise ConnectionError(f"ws upgrade rejected: {status_line}")
 
-    def submit(self, gremlin: str, graph: Optional[str] = None) -> Any:
+    def submit(
+        self,
+        gremlin: str,
+        graph: Optional[str] = None,
+        deadline_ms: Optional[float] = None,
+    ) -> Any:
         from janusgraph_tpu.observability import tracer
 
+        if deadline_ms is None:
+            deadline_ms = self.client.deadline_ms
+        give_up_at = (
+            time.monotonic() + deadline_ms / 1000.0
+            if deadline_ms else None
+        )
         with tracer.span(
             "driver.submit", graph=graph or "", transport="ws",
         ) as sp:
-            req = {
-                "gremlin": gremlin, "graph": graph,
-                # WS has no per-message headers; the trace context rides a
-                # reserved request field instead
-                "trace": sp.context().to_header(),
-            }
-            if self.session:
-                req["session"] = True
-            self._send(json.dumps(req))
-            payload = json.loads(self._recv())
-            status = payload.get("status", {})
-            _merge_status_ledger(status)
-            if status.get("code") != 200:
+            while True:
+                req = {
+                    "gremlin": gremlin, "graph": graph,
+                    # WS has no per-message headers; the trace context
+                    # (and the deadline budget) ride reserved request
+                    # fields instead
+                    "trace": sp.context().to_header(),
+                }
+                if give_up_at is not None:
+                    req["deadline"] = max(
+                        0, int((give_up_at - time.monotonic()) * 1000)
+                    )
+                if self.session:
+                    req["session"] = True
+                self._send(json.dumps(req))
+                payload = json.loads(self._recv())
+                status = payload.get("status", {})
+                _merge_status_ledger(status)
+                if status.get("code") == 200:
+                    return _decode(payload["result"]["data"])
                 sp.annotate(code=status.get("code"))
-                raise RemoteError(status.get("code"), status.get("message"))
-            return _decode(payload["result"]["data"])
+                err = RemoteError(
+                    status.get("code"), status.get("message"),
+                    status=status.get("status"),
+                    retry_after_s=status.get("retry_after_s"),
+                )
+                # shed retries draw from the OWNING client's budget: one
+                # connection, one bucket
+                if not self.client._should_retry(err, None, give_up_at, sp):
+                    raise err
 
     def close(self) -> None:
         try:

@@ -39,6 +39,24 @@ class CircuitOpenError(PermanentBackendError):
     recovers independently via its half-open probe cycle."""
 
 
+class DeadlineExceededError(PermanentBackendError):
+    """The caller's propagated deadline (core/deadline.py) is spent.
+    Permanent from the retry guard's point of view: replaying an operation
+    whose answer nobody will wait for is pure waste — backend_op raises
+    this BEFORE touching the backend (so circuit breakers never count the
+    aborted attempt), killing retry storms at the bottom of the stack."""
+
+
+class ServerOverloadedError(JanusGraphTPUError):
+    """The serving path refused work under overload (admission shed, or a
+    brownout rung refusing OLAP submits). Carries ``retry_after_s`` when
+    the refuser computed a backoff hint."""
+
+    def __init__(self, msg, retry_after_s=None):
+        super().__init__(msg)
+        self.retry_after_s = retry_after_s
+
+
 class InjectedFaultError(TemporaryBackendError):
     """A fault deliberately injected by the chaos engine (storage/faults.py).
     Temporary: the retry/recovery machinery is expected to absorb it."""

@@ -49,16 +49,20 @@ from janusgraph_tpu.indexing.provider import (
 )
 from janusgraph_tpu.storage import backend_op
 from janusgraph_tpu.storage.remote import (
+    _DEADLINE_FLAG,
     _FLAG_MASK,
     _LEDGER_FLAG,
     _TRACE_FLAG,
     _Conn,
+    _deadline_guard,
     _pb,
     _ps,
     _raise_status,
     _Reader,
     _recv_exact,
+    encode_deadline_prefix,
     encode_trace_prefix,
+    split_deadline_prefix,
     split_trace_prefix,
 )
 
@@ -237,27 +241,34 @@ class _IndexHandler(socketserver.BaseRequestHandler):
                 ctx = None
                 if raw & _TRACE_FLAG:
                     ctx, body = split_trace_prefix(body)
+                budget_ms = None
+                if raw & _DEADLINE_FLAG:
+                    budget_ms, body = split_deadline_prefix(body)
                 self._led = {} if raw & _LEDGER_FLAG else None
                 self._op_t0 = _time.perf_counter_ns()
                 try:
-                    if ctx is not None:
-                        from janusgraph_tpu.observability import tracer
+                    # inherit the caller's remaining budget (an op that
+                    # arrives already-expired is refused permanently)
+                    with _deadline_guard(budget_ms):
+                        if ctx is not None:
+                            from janusgraph_tpu.observability import tracer
 
-                        # the index node's op joins the caller's trace
-                        with tracer.child_span(
-                            ctx, f"index.remote.{_OP_NAMES.get(op, op)}"
-                        ) as sp:
+                            # the index node's op joins the caller's trace
+                            with tracer.child_span(
+                                ctx, f"index.remote.{_OP_NAMES.get(op, op)}"
+                            ) as sp:
+                                self._dispatch(provider, sock, op, body)
+                                if self._led:
+                                    # index node owns these measurements
+                                    # (the client merges the echo
+                                    # un-annotated)
+                                    sp.annotate(**{
+                                        f"ledger.{k}": v
+                                        for k, v in self._led.items()
+                                        if k != "wall_ns"
+                                    })
+                        else:
                             self._dispatch(provider, sock, op, body)
-                            if self._led:
-                                # index node owns these measurements (the
-                                # client merges the echo un-annotated)
-                                sp.annotate(**{
-                                    f"ledger.{k}": v
-                                    for k, v in self._led.items()
-                                    if k != "wall_ns"
-                                })
-                    else:
-                        self._dispatch(provider, sock, op, body)
                 # graphlint: disable=JG204 -- protocol boundary: the error is serialized to the client as a temporary status frame, and the CLIENT retries
                 except (TemporaryBackendError, ConnectionError) as e:
                     self._reply(sock, _STATUS_TEMP, str(e).encode())
@@ -386,17 +397,20 @@ class _IndexHandler(socketserver.BaseRequestHandler):
             for c in f.supports_cardinality:
                 _ps(out, c)
             # trailing protocol-capability bytes, positional: [trace]
-            # then [ledger]. Old clients stop reading after the
-            # cardinalities (or after the trace byte), so extra bytes are
-            # invisible to them; old servers simply end the payload
-            # earlier and new clients negotiate the capability OFF. The
-            # trace byte is always written when the ledger byte is, so
-            # the positions stay unambiguous.
+            # then [ledger] then [deadline]. Old clients stop reading
+            # after the cardinalities (or after however many capability
+            # bytes they know), so extra bytes are invisible to them; old
+            # servers simply end the payload earlier and new clients
+            # negotiate the capability OFF. Every earlier byte is always
+            # written when a later one is, so positions stay unambiguous.
             trace_on = getattr(self.server, "trace_propagation", True)
             ledger_on = getattr(self.server, "ledger_echo", True)
-            if trace_on or ledger_on:
+            deadline_on = getattr(self.server, "deadline_propagation", True)
+            if trace_on or ledger_on or deadline_on:
                 out.append(b"\x01" if trace_on else b"\x00")
-            if ledger_on:
+            if ledger_on or deadline_on:
+                out.append(b"\x01" if ledger_on else b"\x00")
+            if deadline_on:
                 out.append(b"\x01")
             self._reply(sock, _STATUS_OK, b"".join(out))
             return
@@ -406,12 +420,14 @@ class _IndexHandler(socketserver.BaseRequestHandler):
 class RemoteIndexServer:
     """Serve any IndexProvider over TCP (threaded; port 0 = ephemeral).
     ``trace_propagation=False`` = the pre-trace features payload,
-    ``ledger_echo=False`` the pre-ledger one ("old-featured" index
-    servers for compatibility tests)."""
+    ``ledger_echo=False`` the pre-ledger one, ``deadline_propagation=
+    False`` the pre-deadline one ("old-featured" index servers for
+    compatibility tests)."""
 
     def __init__(self, provider: IndexProvider, host: str = "127.0.0.1",
                  port: int = 0, trace_propagation: bool = True,
-                 ledger_echo: bool = True):
+                 ledger_echo: bool = True,
+                 deadline_propagation: bool = True):
         class _Srv(socketserver.ThreadingTCPServer):
             allow_reuse_address = True
             daemon_threads = True
@@ -420,6 +436,7 @@ class RemoteIndexServer:
         self._srv.provider = provider  # type: ignore[attr-defined]
         self._srv.trace_propagation = trace_propagation  # type: ignore[attr-defined]
         self._srv.ledger_echo = ledger_echo  # type: ignore[attr-defined]
+        self._srv.deadline_propagation = deadline_propagation  # type: ignore[attr-defined]
         self.provider = provider
         self._thread: Optional[threading.Thread] = None
 
@@ -456,6 +473,7 @@ class RemoteIndexProvider(IndexProvider):
                  breaker_half_open_probes: int = 1,
                  trace_propagation: bool = True,
                  resource_ledger: bool = True,
+                 deadline_propagation: bool = True,
                  **_ignored):
         # `directory` accepted-and-ignored: open_index_provider passes the
         # local providers' kwargs through one call site (core/graph.py)
@@ -475,6 +493,9 @@ class RemoteIndexProvider(IndexProvider):
         #: metrics.resource-ledger, gated on the second capability byte
         self.resource_ledger = resource_ledger
         self._remote_ledger: Optional[bool] = None
+        #: server.deadline.propagation, gated on the third capability byte
+        self.deadline_propagation = deadline_propagation
+        self._remote_deadline: Optional[bool] = None
         #: the provider accounts index hits itself (echo or local
         #: fallback), so graph.mixed_index_query must not count them again
         self.ledger_self_accounting = True
@@ -507,20 +528,28 @@ class RemoteIndexProvider(IndexProvider):
         (op, body, want_ledger)."""
         if op == _OP_FEATURES:
             return op, body, False
+        from janusgraph_tpu.core.deadline import remaining_ms
         from janusgraph_tpu.observability import tracer
         from janusgraph_tpu.observability.profiler import current_ledger
 
         ctx = tracer.current_context() if self.trace_propagation else None
         led = current_ledger() if self.resource_ledger else None
-        if ctx is None and led is None:
+        budget = remaining_ms() if self.deadline_propagation else None
+        if ctx is None and led is None and budget is None:
             return op, body, False
-        if self._remote_trace is None or self._remote_ledger is None:
+        if (self._remote_trace is None or self._remote_ledger is None
+                or self._remote_deadline is None):
             try:
                 self.features()
             # graphlint: disable=JG204 -- negotiation is best-effort: the frame just goes unflagged, and the op itself will surface the failure through its own retry guard
             except (TemporaryBackendError, PermanentBackendError):
                 return op, body, False
         want_ledger = bool(led is not None and self._remote_ledger)
+        if budget is not None and self._remote_deadline:
+            # deadline prefix inside the trace prefix (server strips
+            # trace first, then deadline)
+            op |= _DEADLINE_FLAG
+            body = encode_deadline_prefix(budget) + body
         if ctx is not None and self._remote_trace:
             op |= _TRACE_FLAG
             body = encode_trace_prefix(ctx) + body
@@ -599,6 +628,7 @@ class RemoteIndexProvider(IndexProvider):
             # off in whichever dimension is absent
             self._remote_trace = r.off < len(r.data) and r.u8() == 1
             self._remote_ledger = r.off < len(r.data) and r.u8() == 1
+            self._remote_deadline = r.off < len(r.data) and r.u8() == 1
             self._features = IndexFeatures(
                 supports_document_ttl=bool(flags[0]),
                 supports_cardinality=cards,

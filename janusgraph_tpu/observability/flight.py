@@ -36,6 +36,10 @@ Event taxonomy (the ``category`` field):
                     tracer's ``on_slow`` hook)
 ``server_error``    the query server hit an unhandled evaluation error
 ``health``          the /healthz status flipped ok -> degraded
+``brownout``        the admission controller's graded-degradation ladder
+                    changed rungs (server/admission.py BrownoutLadder;
+                    fields: ``rung`` after the transition, ``direction``
+                    enter/exit, ``reason``)
 ==================  =======================================================
 
 Dump triggers: an unhandled server error, the /healthz ok->degraded flip,

@@ -317,6 +317,16 @@ class DigestTable:
                 )
                 del self._entries[victim]
 
+    def mean_cost_ms(self, digest: str) -> Optional[float]:
+        """Measured mean wall of one digest (total/count), or None when
+        the table has never seen it — the admission controller's price
+        lookup (unknown shapes pay its default price instead)."""
+        with self._lock:
+            e = self._entries.get(digest)
+            if e is None or not e["count"]:
+                return None
+            return e["total_ms"] / e["count"]
+
     def top(self, k: int = 10) -> List[dict]:
         """The k digests with the largest total cost, descending."""
         with self._lock:

@@ -185,7 +185,13 @@ class GraphComputer:
         `olap.load_csr` snapshot load, the executor's `olap.run` with its
         per-superstep spans, and one `olap.map_reduce` per job)."""
         from janusgraph_tpu.observability import tracer
+        from janusgraph_tpu.server import admission as _admission
 
+        # brownout rung 2 (server/admission.py): when the serving path is
+        # under sustained overload, analytical jobs — the biggest cost
+        # multiplier a query can trigger — are refused so OLTP goodput
+        # survives; a no-op whenever no server runs in this process
+        _admission.check_olap_admission()
         with tracer.span("olap.submit", executor=self.executor_kind) as sp:
             return self._submit(sp)
 

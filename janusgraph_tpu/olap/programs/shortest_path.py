@@ -191,6 +191,7 @@ def weighted_predecessors(csr, result, seed_index: int):
         out_eq = defaultdict(list)  # u -> [v] over equality edges
         for i in eq_slots:
             out_eq[int(src[i])].append(int(dstv[i]))
+        # graphlint: disable=JG206 -- BFS work queue: each vertex enqueues at most once (pred guard), so the bound is the vertex count
         queue = deque(int(v) for v in np.nonzero(pred != -1)[0])
         while queue:
             u = queue.popleft()

@@ -16,3 +16,6 @@ from janusgraph_tpu.server.auth import (  # noqa: F401
     SaslAndHMACAuthenticator,
 )
 from janusgraph_tpu.server.server import JanusGraphServer  # noqa: F401
+from janusgraph_tpu.server.admission import (  # noqa: F401
+    AdmissionController,
+)

@@ -113,8 +113,18 @@ def healthz_snapshot() -> dict:
     stragglers), checkpoint manifest/slice fallbacks, cross-shard
     auto-resumes, and the last run's straggler skew gauge
     (``olap.shard.skew`` — modeled slowest-shard/mean; 1.0 = balanced).
+
+    The ``admission`` block covers the overload-defense front door
+    (server/admission.py): current AIMD limit and baseline, in-flight and
+    queued requests, brownout rung, and shed/admit/timeout counters. A
+    shed user request is a 503 whose body says ``"status": "shed"``; THIS
+    endpoint's 503 says ``"status": "degraded"`` — and /healthz (with
+    /metrics, /telemetry, /flight, /profile) BYPASSES admission entirely,
+    because a saturated server you cannot observe is the classic
+    outage-amplifier.
     """
     from janusgraph_tpu.observability import flight_recorder, registry
+    from janusgraph_tpu.server import admission as _admission
 
     snap = registry.snapshot()
     breakers = {
@@ -156,6 +166,24 @@ def healthz_snapshot() -> dict:
             skew["value"] if skew and skew["type"] == "gauge" else None
         ),
     }
+    ctl = _admission.active()
+    admission_block = ctl.snapshot() if ctl is not None else None
+    if admission_block is not None:
+        adm_counters = {
+            name: m["count"]
+            for name, m in snap.items()
+            if m["type"] == "counter"
+            and name.startswith("server.admission.")
+        }
+        admission_block["shed"] = adm_counters.get(
+            "server.admission.shed", 0
+        )
+        admission_block["admitted"] = adm_counters.get(
+            "server.admission.admitted", 0
+        )
+        admission_block["queue_timeouts"] = adm_counters.get(
+            "server.admission.queue_timeouts", 0
+        )
     status = "degraded" if degraded else "ok"
     with _HEALTH_LOCK:
         flipped = _HEALTH_STATE["status"] == "ok" and status == "degraded"
@@ -171,7 +199,20 @@ def healthz_snapshot() -> dict:
         "breakers": breakers,
         "counters": counters,
         "sharded": sharded,
+        "admission": admission_block,
         "flight": flight_recorder.health_block(),
+    }
+
+
+def _timeout_payload(e) -> dict:
+    """Structured evaluation-timeout response (the request's deadline was
+    spent — queued too long, or the evaluation/storage layers aborted)."""
+    return {
+        "result": {"data": None},
+        "status": {
+            "code": 504, "status": "timeout",
+            "message": f"{type(e).__name__}: {e}",
+        },
     }
 
 
@@ -189,6 +230,10 @@ class JanusGraphServer:
         max_query_length: int = 65536,
         request_timeout_s: float = 120.0,
         auto_commit: bool = True,
+        admission=None,
+        admission_enabled: bool = True,
+        default_deadline_ms: float = 0.0,
+        max_deadline_ms: float = 600_000.0,
     ):
         self.manager = manager or JanusGraphManager.get_instance()
         self.default_graph = default_graph
@@ -199,12 +244,46 @@ class JanusGraphServer:
         self.max_request_bytes = max_request_bytes
         #: server.max-query-length — bounds AST parse cost
         self.max_query_length = max_query_length
-        #: server.request-timeout-s — per-connection socket timeout
+        #: server.request-timeout-s — per-connection socket timeout AND
+        #: the default wall-clock evaluation deadline (see _deadline_ms)
         self.request_timeout_s = request_timeout_s
         #: server.auto-commit — sessionless per-request commit on success
         self.auto_commit = auto_commit
+        #: server.deadline.default-ms — deadline when the client sends
+        #: none (0 = derive from request_timeout_s)
+        self.default_deadline_ms = default_deadline_ms
+        #: server.deadline.max-ms — clamp on client-supplied deadlines
+        self.max_deadline_ms = max_deadline_ms
+        #: server.admission.* — the cost-aware front door (None = open)
+        if admission is None and admission_enabled:
+            from janusgraph_tpu.server.admission import AdmissionController
+
+            admission = AdmissionController()
+        self.admission = admission
         self._httpd: Optional[ThreadingHTTPServer] = None
         self._thread: Optional[threading.Thread] = None
+
+    def _deadline_ms(self, requested) -> Optional[float]:
+        """Effective deadline budget for one request: the client's
+        X-Deadline-Ms / WS ``deadline`` field (clamped to server.deadline.
+        max-ms), else server.deadline.default-ms, else server.request-
+        timeout-s — so the old socket timeout is also a wall-clock bound
+        on query EVALUATION, not just on reads. None = no deadline."""
+        budget = None
+        if requested is not None:
+            try:
+                budget = float(requested)
+            except (TypeError, ValueError):
+                budget = None
+        if budget is not None and budget > 0:
+            if self.max_deadline_ms > 0:
+                budget = min(budget, self.max_deadline_ms)
+            return budget
+        if self.default_deadline_ms > 0:
+            return self.default_deadline_ms
+        if self.request_timeout_s and self.request_timeout_s > 0:
+            return self.request_timeout_s * 1000.0
+        return None
 
     # ------------------------------------------------------------ lifecycle
     @property
@@ -219,11 +298,23 @@ class JanusGraphServer:
             # socket read timeout; 0 = disabled (None = stdlib no-timeout)
             timeout = server.request_timeout_s or None
 
-        self._httpd = ThreadingHTTPServer((self.host, self._port), Handler)
+        class _Httpd(ThreadingHTTPServer):
+            # a deep accept backlog: admission control (shed + Retry-After)
+            # is the designed overload response — kernel RSTs from a
+            # 5-deep listen queue must not preempt it
+            request_queue_size = 128
+
+        self._httpd = _Httpd((self.host, self._port), Handler)
         self._thread = threading.Thread(
             target=self._httpd.serve_forever, daemon=True
         )
         self._thread.start()
+        if self.admission is not None:
+            # register process-globally so other layers (the OLAP
+            # computer's brownout refusal) can consult the ladder
+            from janusgraph_tpu.server import admission as _admission
+
+            _admission.set_active(self.admission)
         return self
 
     def stop(self) -> None:
@@ -231,6 +322,11 @@ class JanusGraphServer:
             self._httpd.shutdown()
             self._httpd.server_close()
             self._httpd = None
+        if self.admission is not None:
+            from janusgraph_tpu.server import admission as _admission
+
+            if _admission.active() is self.admission:
+                _admission.set_active(None)
 
     # ------------------------------------------------------------ execution
     def _namespace(self, query: str, graph_name: Optional[str]) -> dict:
@@ -364,11 +460,15 @@ class _Handler(BaseHTTPRequestHandler):
         pass
 
     # --------------------------------------------------------------- helpers
-    def _send_json(self, code: int, payload: dict) -> None:
+    def _send_json(
+        self, code: int, payload: dict, extra_headers: Optional[dict] = None
+    ) -> None:
         body = payload if isinstance(payload, bytes) else json.dumps(payload).encode()
         self.send_response(code)
         self.send_header("Content-Type", "application/json")
         self.send_header("Content-Length", str(len(body)))
+        for k, v in (extra_headers or {}).items():
+            self.send_header(k, v)
         self.end_headers()
         self.wfile.write(body)
 
@@ -385,39 +485,109 @@ class _Handler(BaseHTTPRequestHandler):
         req: dict,
         session: Optional[dict] = None,
         trace_header: Optional[str] = None,
+        deadline_header: Optional[str] = None,
     ) -> dict:
+        import time as _time
+
+        from janusgraph_tpu.core.deadline import deadline_scope, remaining_ms
+        from janusgraph_tpu.exceptions import DeadlineExceededError
         from janusgraph_tpu.observability import tracer
         from janusgraph_tpu.observability.profiler import ledger_scope
         from janusgraph_tpu.observability.spans import TraceContext
+        from janusgraph_tpu.server.admission import ShedError
 
+        server = self.jg_server
         query = req.get("gremlin", "")
         graph = req.get("graph")
-        # the request runs under a server span; when the driver sent a
-        # trace header (X-Trace-Context / the WS "trace" field) the span
-        # joins the caller's trace, and everything below — store ops over
-        # the remote KCVS protocol included — stitches into ONE tree.
-        # It also runs under a fresh ResourceLedger: every instrumented
-        # layer (storage cells/bytes, index hits, retries) accrues into
-        # it, and the totals are echoed to the driver in status.ledger.
-        ctx = TraceContext.from_header(trace_header) if trace_header else None
-        with tracer.child_span(
-            ctx, "server.request",
-            graph=graph or self.jg_server.default_graph,
-            session=session is not None,
-        ) as sp:
-            with ledger_scope() as led:
-                payload = self._execute_request(
-                    req, query, graph, session, sp
+        # every request runs under a wall-clock deadline: the client's
+        # X-Deadline-Ms header (WS "deadline" field), else the server
+        # defaults (server.deadline.default-ms / request-timeout-s). The
+        # scope is ambient, so the traversal layer, backend_op retries,
+        # and the remote KCVS/index protocols (deadline feature bit) all
+        # inherit the same budget.
+        budget_ms = server._deadline_ms(
+            deadline_header if deadline_header is not None
+            else req.get("deadline")
+        )
+        with deadline_scope(budget_ms):
+            # admission BEFORE any work: price the query's shape from the
+            # measured price book, then admit / queue / shed
+            ctl = server.admission
+            ticket = None
+            digest = ""
+            if ctl is not None:
+                digest, price_ms, known = ctl.price(query)
+                try:
+                    rem = remaining_ms()
+                    ticket = ctl.acquire(
+                        price_ms=price_ms, known=known, digest=digest,
+                        timeout_s=(
+                            rem / 1000.0 if rem is not None else None
+                        ),
+                    )
+                except ShedError as e:
+                    # shed-503, distinguishable from a degraded /healthz
+                    # 503 by status "shed"; retry_after_s rides the body
+                    # and do_POST mirrors it into a Retry-After header
+                    return {
+                        "result": {"data": None},
+                        "status": {
+                            "code": 503, "status": "shed",
+                            "reason": e.reason,
+                            "retry_after_s": e.retry_after_s,
+                            "message": str(e),
+                        },
+                    }
+                except DeadlineExceededError as e:
+                    return _timeout_payload(e)
+            t0 = _time.perf_counter()
+            cells = 0
+            try:
+                # the request runs under a server span; when the driver
+                # sent a trace header the span joins the caller's trace,
+                # and everything below — store ops over the remote KCVS
+                # protocol included — stitches into ONE tree. It also
+                # runs under a fresh ResourceLedger whose totals are
+                # echoed to the driver in status.ledger.
+                ctx = (
+                    TraceContext.from_header(trace_header)
+                    if trace_header else None
                 )
-        # echo the trace id so the caller can pull the stitched trace from
-        # GET /telemetry or `janusgraph_tpu trace <id>`
-        payload["status"]["trace"] = f"{sp.trace_id:016x}"
-        resources = led.to_dict()
-        if resources:
-            payload["status"]["ledger"] = resources
+                with tracer.child_span(
+                    ctx, "server.request",
+                    graph=graph or server.default_graph,
+                    session=session is not None,
+                ) as sp:
+                    if ctl is not None and ctl.span_retention_shed():
+                        # brownout rung 1: run unsampled — the root ring
+                        # stops retaining trees for traffic the server is
+                        # actively shedding
+                        sp.sampled = False
+                    with ledger_scope() as led:
+                        payload = self._execute_request(
+                            req, query, graph, session, sp
+                        )
+                # echo the trace id so the caller can pull the stitched
+                # trace from GET /telemetry or `janusgraph_tpu trace <id>`
+                payload["status"]["trace"] = f"{sp.trace_id:016x}"
+                cells = led.op_cells()
+                resources = led.to_dict()
+                if resources:
+                    payload["status"]["ledger"] = resources
+            finally:
+                wall_ms = (_time.perf_counter() - t0) * 1000.0
+                if ctl is not None:
+                    if ticket is not None:
+                        ctl.release(ticket, wall_ms)
+                    # feed the measured cost back into the price book so
+                    # the NEXT request of this shape is priced by data
+                    ctl.observe_cost(digest, query, wall_ms, cells=cells)
         return payload
 
     def _execute_request(self, req, query, graph, session, sp) -> dict:
+        from janusgraph_tpu.core import deadline as _deadline
+        from janusgraph_tpu.exceptions import DeadlineExceededError
+
         try:
             if session is not None:
                 result = self.jg_server.execute_session(
@@ -425,9 +595,20 @@ class _Handler(BaseHTTPRequestHandler):
                 )
             else:
                 result = self.jg_server.execute(query, graph)
+            # wall-clock deadline on EVALUATION, not just on reads: an
+            # evaluation that ran past the budget returns a structured
+            # timeout (nobody is waiting for the late answer) instead of
+            # a success on a connection the client already abandoned
+            _deadline.check("query evaluation")
             data = json.loads(graphson_dumps(result))
             sp.annotate(code=200)
             return {"result": {"data": data}, "status": {"code": 200}}
+        except DeadlineExceededError as e:
+            # structured timeout, not a hung connection: the deadline
+            # machinery (traversal checks + backend_op + the remote
+            # protocols) aborted the evaluation mid-flight
+            sp.annotate(code=504, error=type(e).__name__)
+            return _timeout_payload(e)
         except QueryTooLongError as e:
             # client error, like the 413 for max-request-bytes — a retry
             # of the identical oversized query can never succeed
@@ -615,9 +796,27 @@ class _Handler(BaseHTTPRequestHandler):
             except json.JSONDecodeError:
                 self._send_json(400, {"status": {"code": 400, "message": "bad json"}})
                 return
-            self._send_json(200, self._run_request(
+            payload = self._run_request(
                 req, trace_header=self.headers.get("X-Trace-Context"),
-            ))
+                deadline_header=self.headers.get("X-Deadline-Ms"),
+            )
+            status = payload.get("status", {})
+            if status.get("status") == "shed":
+                # a REAL 503 (unlike embedded evaluation errors, which
+                # stay HTTP 200 for driver compat): load balancers and
+                # generic HTTP clients understand it, and EVERY shed
+                # response carries Retry-After (decorrelated jitter)
+                self._send_json(
+                    503, payload,
+                    extra_headers={
+                        "Retry-After": str(status.get("retry_after_s", 1)),
+                    },
+                )
+                return
+            if status.get("status") == "timeout":
+                self._send_json(504, payload)
+                return
+            self._send_json(200, payload)
             return
         self._send_json(404, {"status": {"code": 404}})
 

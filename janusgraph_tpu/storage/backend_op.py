@@ -19,6 +19,16 @@ Telemetry: ``storage.backend_op.retries`` counts every replayed attempt,
 ``storage.backend_op.exhausted`` every guard that gave up (budget or
 attempt cap spent) — the recovered-vs-lost split the chaos engine asserts
 on.
+
+Deadline awareness (core/deadline.py): when the ambient request deadline
+is spent, the guard raises ``DeadlineExceededError`` BEFORE dispatching
+the operation (zero attempts, zero retries, and the circuit breaker —
+which wraps the op inside this guard — never sees the aborted call), and
+it stops replaying temporary failures the moment the deadline expires
+mid-backoff. This is what keeps a saturated serving path from turning
+client timeouts into storage-layer retry storms: the caller gave up, so
+every layer below gives up too. ``storage.backend_op.deadline_expired``
+counts the refusals.
 """
 
 from __future__ import annotations
@@ -28,6 +38,7 @@ import time
 from typing import Callable, Optional, TypeVar
 
 from janusgraph_tpu.exceptions import (
+    DeadlineExceededError,
     PermanentBackendError,
     TemporaryBackendError,
 )
@@ -55,15 +66,29 @@ def execute(
     BackendOperation.executeDirect semantics). `max_attempts` (> 0) caps
     the replay COUNT as well as the time budget — whichever trips first
     (reference: storage.write-attempts / read-attempts)."""
+    from janusgraph_tpu.core import deadline as _deadline
     from janusgraph_tpu.observability import registry
 
     deadline = time.monotonic() + max_time_s
+    # the ambient request deadline (propagated from the caller, possibly
+    # across the wire) caps the retry budget too: whichever is tighter
+    caller_dl = _deadline.current_deadline()
+    if caller_dl is not None:
+        deadline = min(deadline, caller_dl)
     base = BASE_DELAY_S if base_delay_s is None else base_delay_s
     if max_delay_s is None:
         max_delay_s = MAX_DELAY_S
     delay = base
     attempt = 0
     while True:
+        if caller_dl is not None and time.monotonic() >= caller_dl:
+            # refuse BEFORE dispatch: no attempt, no retry, and the
+            # breaker (wrapped inside `op`) never counts the abort
+            registry.counter("storage.backend_op.deadline_expired").inc()
+            raise DeadlineExceededError(
+                f"caller deadline spent before attempt {attempt + 1} "
+                "(no storage dispatch performed)"
+            )
         try:
             return op()
         except PermanentBackendError:
@@ -71,6 +96,17 @@ def execute(
         except TemporaryBackendError as e:
             attempt += 1
             now = time.monotonic()
+            if caller_dl is not None and now >= caller_dl:
+                # the deadline (not the retry budget) ran out mid-replay:
+                # surface THAT, permanently — more backoff cannot help a
+                # caller who already gave up
+                registry.counter(
+                    "storage.backend_op.deadline_expired"
+                ).inc()
+                raise DeadlineExceededError(
+                    f"caller deadline spent after {attempt} attempt(s); "
+                    f"last temporary error: {e}"
+                ) from e
             if now >= deadline or (max_attempts and attempt >= max_attempts):
                 registry.counter("storage.backend_op.exhausted").inc()
                 from janusgraph_tpu.observability import (

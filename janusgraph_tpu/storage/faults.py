@@ -25,6 +25,11 @@ Fault kinds (all off by default):
                      (a ``TemporaryBackendError``) on slice reads and
                      mutations — absorbed by the backend_op retry guard
 ``latency``          injected latency spikes on reads
+``overload``         a seeded latency STORM: beginning at read index
+                     ``overload-at``, the next ``overload-ops`` reads
+                     each stall ``overload-latency-ms`` — the sustained
+                     saturation scenario the admission controller
+                     (server/admission.py) is tested against
 ``torn``             crash after applying a PREFIX of a ``mutate_many``
                      batch (:class:`InjectedCrashError`) — the torn-commit
                      case healed by ``TornCommitRecovery`` on reopen
@@ -122,6 +127,9 @@ class FaultPlan:
         lock_expiry_at: int = -1,
         scan_kill_at: int = -1,
         scan_kill_after_rows: int = 8,
+        overload_at: int = -1,
+        overload_ops: int = 0,
+        overload_latency_ms: float = 0.0,
         preempt_superstep: int = -1,
         shard_preempt_superstep: int = -1,
         shard_preempt_shard: int = -1,
@@ -137,6 +145,9 @@ class FaultPlan:
         self.write_error_rate = write_error_rate
         self.latency_ms = latency_ms
         self.latency_rate = latency_rate
+        self.overload_at = overload_at
+        self.overload_ops = overload_ops
+        self.overload_latency_ms = overload_latency_ms
         self.torn_mutation_at = torn_mutation_at
         self.lock_expiry_at = lock_expiry_at
         self.scan_kill_at = scan_kill_at
@@ -175,6 +186,11 @@ class FaultPlan:
             write_error_rate=cfg.get("storage.faults.write-error-rate"),
             latency_ms=cfg.get("storage.faults.latency-ms"),
             latency_rate=cfg.get("storage.faults.latency-rate"),
+            overload_at=cfg.get("storage.faults.overload-at"),
+            overload_ops=cfg.get("storage.faults.overload-ops"),
+            overload_latency_ms=cfg.get(
+                "storage.faults.overload-latency-ms"
+            ),
             torn_mutation_at=cfg.get("storage.faults.torn-mutation-at"),
             lock_expiry_at=cfg.get("storage.faults.lock-expiry-at"),
             scan_kill_at=cfg.get("storage.faults.scan-kill-at"),
@@ -231,6 +247,21 @@ class FaultPlan:
     # ----------------------------------------------------------- store hooks
     def before_read(self, store: str) -> None:
         n = self._tick("read")
+        if (
+            self.overload_at >= 0
+            and self.overload_latency_ms > 0
+            and self.overload_at <= n < self.overload_at + self.overload_ops
+        ):
+            # the STORM is index-scheduled like every other kind, so one
+            # seed reproduces one saturation window; journaled once at
+            # its leading edge (per-op records would flood the ring)
+            if n == self.overload_at:
+                self._record(
+                    "overload", n,
+                    store=store, ops=self.overload_ops,
+                    ms=self.overload_latency_ms,
+                )
+            time.sleep(self.overload_latency_ms / 1000.0)
         if self._chance("latency", n, self.latency_rate) and self.latency_ms:
             self._record("latency", n, store=store, ms=self.latency_ms)
             time.sleep(self.latency_ms / 1000.0)

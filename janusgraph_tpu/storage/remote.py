@@ -48,6 +48,17 @@ re-annotating — the server's span already carries the fields, keeping
 the trace-totals == span-sums invariant). Old peers in either direction
 never see (or send) flagged frames. Streaming scans are never flagged;
 the client counts the rows it decodes instead.
+
+Deadline propagation rides a third bit (`op | 0x20`, negotiated via
+`"deadline": true`): the client prepends `[u8 len][u32 remaining_ms]` —
+the ambient deadline's REMAINING budget (core/deadline.py; relative, so
+host clocks never need to agree) — and the server runs the dispatched op
+under a matching deadline scope. An op arriving with 0 budget is refused
+before touching the store (permanent status: the client never replays
+it), and the serving node's own downstream retries stop when the budget
+runs out — the mechanism that kills retry storms at the bottom of the
+stack instead of the top. Same compatibility discipline as the other
+two bits: mixed old/new pairs speak the original protocol unchanged.
 """
 
 from __future__ import annotations
@@ -56,6 +67,7 @@ import socket
 import socketserver
 import struct
 import threading
+from contextlib import contextmanager
 from typing import Dict, Iterator, List, Optional, Sequence, Tuple
 
 from janusgraph_tpu.exceptions import (
@@ -94,7 +106,14 @@ _TRACE_FLAG = 0x80
 #: ledger block to the OK response". Sent only after the server's
 #: features payload negotiated `"ledger": true`.
 _LEDGER_FLAG = 0x40
-_FLAG_MASK = _TRACE_FLAG | _LEDGER_FLAG
+#: third flag bit: the body carries a deadline prefix
+#: ([u8 len=4][u32 remaining_ms], after the trace prefix when both ride)
+#: — "stop working on this op once the caller's budget is spent". Sent
+#: only after the server's features payload negotiated
+#: `"deadline": true` (same old/new byte-compat discipline as the trace
+#: and ledger bits: un-negotiated peers never see a flagged frame).
+_DEADLINE_FLAG = 0x20
+_FLAG_MASK = _TRACE_FLAG | _LEDGER_FLAG | _DEADLINE_FLAG
 
 _OP_NAMES = {
     _OP_FEATURES: "features",
@@ -131,6 +150,49 @@ def split_trace_prefix(body: bytes):
     if len(body) < 1 + hlen:
         return None, body
     return TraceContext.from_bytes(body[1:1 + hlen]), body[1 + hlen:]
+
+
+def encode_deadline_prefix(remaining_ms: float) -> bytes:
+    """``[u8 len=4][u32 remaining_ms]`` — REMAINING budget, not an absolute
+    instant (clocks are not comparable across hosts). Length-prefixed like
+    the trace header so the codec can grow without a protocol bump; a
+    spent budget clamps to 0 rather than wrapping."""
+    from janusgraph_tpu.core.deadline import MAX_WIRE_MS
+
+    ms = max(0, min(int(remaining_ms), MAX_WIRE_MS))
+    return bytes([4]) + struct.pack(">I", ms)
+
+
+def split_deadline_prefix(body: bytes):
+    """Inverse of encode_deadline_prefix: (remaining_ms|None, rest).
+    Malformed prefixes degrade to an un-deadlined frame, never an error."""
+    if not body:
+        return None, body
+    hlen = body[0]
+    if hlen < 4 or len(body) < 1 + hlen:
+        return None, body
+    (ms,) = struct.unpack_from(">I", body, 1)
+    return float(ms), body[1 + hlen:]
+
+
+@contextmanager
+def _deadline_guard(budget_ms):
+    """Serve one dispatched op under the caller's remaining budget. A
+    frame that arrives with its budget already spent (0 on the wire) is
+    refused before touching the store — DeadlineExceededError serializes
+    as a PERMANENT status, so the client never replays it."""
+    if budget_ms is None:
+        yield
+        return
+    from janusgraph_tpu.core.deadline import deadline_scope
+    from janusgraph_tpu.exceptions import DeadlineExceededError
+
+    if budget_ms <= 0:
+        raise DeadlineExceededError(
+            "op arrived with its caller deadline already spent"
+        )
+    with deadline_scope(budget_ms):
+        yield
 
 
 # ------------------------------------------------------------------ encoding
@@ -254,31 +316,41 @@ class _Handler(socketserver.BaseRequestHandler):
                 ctx = None
                 if raw & _TRACE_FLAG:
                     ctx, body = split_trace_prefix(body)
+                budget_ms = None
+                if raw & _DEADLINE_FLAG:
+                    budget_ms, body = split_deadline_prefix(body)
                 self._led = {} if raw & _LEDGER_FLAG else None
                 self._op_t0 = _time.perf_counter_ns()
                 try:
-                    if ctx is not None:
-                        from janusgraph_tpu.observability import tracer
+                    # the serving node inherits the caller's remaining
+                    # budget: its own retries/backoff (e.g. a layered
+                    # remote behind this manager) stop when the budget is
+                    # spent, and an op arriving already-expired is refused
+                    # without touching the store
+                    with _deadline_guard(budget_ms):
+                        if ctx is not None:
+                            from janusgraph_tpu.observability import tracer
 
-                        # child span under the client's context: the
-                        # storage node's ops join the caller's trace
-                        with tracer.child_span(
-                            ctx,
-                            f"store.remote.{_OP_NAMES.get(op, op)}",
-                            store_manager=getattr(mgr, "name", ""),
-                        ) as sp:
+                            # child span under the client's context: the
+                            # storage node's ops join the caller's trace
+                            with tracer.child_span(
+                                ctx,
+                                f"store.remote.{_OP_NAMES.get(op, op)}",
+                                store_manager=getattr(mgr, "name", ""),
+                            ) as sp:
+                                self._dispatch(mgr, sock, op, body)
+                                if self._led:
+                                    # the storage node OWNS these
+                                    # measurements: it annotates its own
+                                    # span, the client merges the echo
+                                    # without re-annotating
+                                    sp.annotate(**{
+                                        f"ledger.{k}": v
+                                        for k, v in self._led.items()
+                                        if k != "wall_ns"
+                                    })
+                        else:
                             self._dispatch(mgr, sock, op, body)
-                            if self._led:
-                                # the storage node OWNS these measurements:
-                                # it annotates its own span, the client
-                                # merges the echo without re-annotating
-                                sp.annotate(**{
-                                    f"ledger.{k}": v
-                                    for k, v in self._led.items()
-                                    if k != "wall_ns"
-                                })
-                    else:
-                        self._dispatch(mgr, sock, op, body)
                 # graphlint: disable=JG204 -- protocol boundary: the error is serialized to the client as a temporary status frame, and the CLIENT retries
                 except (TemporaryBackendError, ConnectionError) as e:
                     self._reply(sock, _STATUS_TEMP, str(e).encode())
@@ -317,13 +389,16 @@ class _Handler(socketserver.BaseRequestHandler):
                 )
             }
             # protocol feature bits: this server accepts 0x80-flagged
-            # frames carrying a trace header, and 0x40-flagged frames
-            # asking for a resource-ledger echo (absent on old servers,
-            # so new clients degrade cleanly in both dimensions)
+            # frames carrying a trace header, 0x40-flagged frames asking
+            # for a resource-ledger echo, and 0x20-flagged frames carrying
+            # a deadline prefix (absent on old servers, so new clients
+            # degrade cleanly in every dimension)
             if getattr(self.server, "trace_propagation", True):
                 feats["trace"] = True
             if getattr(self.server, "ledger_echo", True):
                 feats["ledger"] = True
+            if getattr(self.server, "deadline_propagation", True):
+                feats["deadline"] = True
             self._reply(sock, _STATUS_OK, json.dumps(feats).encode())
             return
         led = self._led
@@ -436,11 +511,13 @@ class _Handler(socketserver.BaseRequestHandler):
 class RemoteStoreServer:
     """Serve a KCVS manager over TCP (threaded; port 0 = ephemeral).
     ``trace_propagation=False`` serves the pre-trace features payload,
-    ``ledger_echo=False`` the pre-ledger one — "old-featured" servers for
+    ``ledger_echo=False`` the pre-ledger one, ``deadline_propagation=
+    False`` the pre-deadline one — "old-featured" servers for
     compatibility tests and staged rollouts."""
 
     def __init__(self, manager, host: str = "127.0.0.1", port: int = 0,
-                 trace_propagation: bool = True, ledger_echo: bool = True):
+                 trace_propagation: bool = True, ledger_echo: bool = True,
+                 deadline_propagation: bool = True):
         class _Srv(socketserver.ThreadingTCPServer):
             allow_reuse_address = True
             daemon_threads = True
@@ -449,6 +526,7 @@ class RemoteStoreServer:
         self._srv.manager = manager  # type: ignore[attr-defined]
         self._srv.trace_propagation = trace_propagation  # type: ignore[attr-defined]
         self._srv.ledger_echo = ledger_echo  # type: ignore[attr-defined]
+        self._srv.deadline_propagation = deadline_propagation  # type: ignore[attr-defined]
         self.manager = manager
         self._thread: Optional[threading.Thread] = None
 
@@ -697,7 +775,8 @@ class RemoteStoreManager(KeyColumnValueStoreManager):
                  breaker_reset_ms: float = 1000.0,
                  breaker_half_open_probes: int = 1,
                  trace_propagation: bool = True,
-                 resource_ledger: bool = True):
+                 resource_ledger: bool = True,
+                 deadline_propagation: bool = True):
         self.host, self.port = host, port
         #: metrics.trace-propagation — attach the ambient TraceContext to
         #: op frames, but ONLY once the server's features payload
@@ -708,6 +787,10 @@ class RemoteStoreManager(KeyColumnValueStoreManager):
         #: (same negotiation discipline as tracing)
         self.resource_ledger = resource_ledger
         self._remote_ledger: Optional[bool] = None
+        #: server.deadline.propagation — forward the ambient deadline's
+        #: remaining budget on op frames (same negotiation discipline)
+        self.deadline_propagation = deadline_propagation
+        self._remote_deadline: Optional[bool] = None
         #: the KCVS client accounts cells/bytes itself (echo or local
         #: decode), so BackendTransaction must not count the same ops
         self.ledger_self_accounting = True
@@ -780,6 +863,7 @@ class RemoteStoreManager(KeyColumnValueStoreManager):
         cannot carry a block, the client counts decoded rows instead."""
         if op == _OP_FEATURES:
             return op, body, False
+        from janusgraph_tpu.core.deadline import remaining_ms
         from janusgraph_tpu.observability import tracer
         from janusgraph_tpu.observability.profiler import current_ledger
 
@@ -789,15 +873,22 @@ class RemoteStoreManager(KeyColumnValueStoreManager):
             if (allow_ledger and self.resource_ledger)
             else None
         )
-        if ctx is None and led is None:
+        budget = remaining_ms() if self.deadline_propagation else None
+        if ctx is None and led is None and budget is None:
             return op, body, False
-        if self._remote_trace is None or self._remote_ledger is None:
+        if (self._remote_trace is None or self._remote_ledger is None
+                or self._remote_deadline is None):
             try:
                 _ = self.features
             # graphlint: disable=JG204 -- negotiation is best-effort: the frame just goes unflagged, and the op itself will surface the failure through its own retry guard
             except (TemporaryBackendError, PermanentBackendError):
                 return op, body, False
         want_ledger = bool(led is not None and self._remote_ledger)
+        if budget is not None and self._remote_deadline:
+            # deadline prefix INSIDE the trace prefix: the server strips
+            # trace first, then deadline — both length-prefixed
+            op |= _DEADLINE_FLAG
+            body = encode_deadline_prefix(budget) + body
         if ctx is not None and self._remote_trace:
             op |= _TRACE_FLAG
             body = encode_trace_prefix(ctx) + body
@@ -863,9 +954,11 @@ class RemoteStoreManager(KeyColumnValueStoreManager):
 
             remote = json.loads(self._call(_OP_FEATURES, b"").decode())
             # protocol capabilities, not store features: a missing key is
-            # an old server — trace headers / ledger flags are never sent
+            # an old server — trace headers / ledger / deadline flags are
+            # never sent
             self._remote_trace = bool(remote.pop("trace", False))
             self._remote_ledger = bool(remote.pop("ledger", False))
+            self._remote_deadline = bool(remote.pop("deadline", False))
             self._features = StoreFeatures(
                 distributed=True,
                 network_attached=True,  # peers beyond this process can write

@@ -1,0 +1,58 @@
+"""JG206 fixture: unbounded queues/deques in overload-sensitive code.
+
+An unbounded buffer between a producer and a slower consumer converts
+backpressure into memory growth — the serving path bounds every queue
+(or sheds) instead.
+"""
+
+import collections
+import queue
+from collections import deque
+from queue import Queue
+
+
+def request_backlog_bad():
+    return Queue()  # expect: JG206
+
+
+def request_backlog_bad_qualified():
+    return queue.Queue()  # expect: JG206
+
+
+def backlog_explicitly_unbounded():
+    # maxsize=0 is the explicitly-unbounded spelling, not a bound
+    return Queue(maxsize=0)  # expect: JG206
+
+
+def event_ring_bad():
+    return deque()  # expect: JG206
+
+
+def event_ring_bad_qualified():
+    return collections.deque([1, 2, 3])  # expect: JG206
+
+
+def event_ring_bad_none():
+    return deque([], maxlen=None)  # expect: JG206
+
+
+def request_backlog_good():
+    # bounded: arrivals past the bound block (or the caller sheds)
+    return Queue(maxsize=64)
+
+
+def event_ring_good():
+    # bounded ring, the in-tree idiom for every telemetry buffer
+    return deque(maxlen=512)
+
+
+def event_ring_good_positional():
+    # deque's maxlen may ride as the second positional argument
+    return deque([], 256)
+
+
+def work_queue_structurally_bounded(n):
+    # a BFS frontier enqueues each vertex at most once: the bound is the
+    # vertex count itself — the justified-suppression case
+    # graphlint: disable=JG206 -- each vertex enqueued at most once; bounded by n
+    return deque(range(n))
