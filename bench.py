@@ -1061,6 +1061,21 @@ def worker() -> None:
                 "error": f"{type(e).__name__}: {e}"[:500],
             })
 
+    # pipelined wire-protocol A/B (ISSUE 11): remote multiquery
+    # throughput, synchronous vs pipelined framing, with a depth sweep
+    # and a simulated storage-node service time (loopback-zero-latency
+    # cells ride along for transparency)
+    if os.environ.get("BENCH_PIPELINE", "1") != "0":
+        try:
+            with _stage_span("oltp_pipeline"):
+                _oltp_pipeline_stage(t0)
+        except Exception as e:
+            _hb(f"oltp_pipeline stage FAILED {type(e).__name__}: {e}", t0)
+            _emit({
+                "stage": "oltp_pipeline", "ok": False,
+                "error": f"{type(e).__name__}: {e}"[:500],
+            })
+
     # chaos stage (ISSUE 3, optional: BENCH_CHAOS=1): seeded fault
     # injection over an OLTP workload with a torn commit + recovery,
     # recording recovered-op counts and recovery latency so BENCH_*.json
@@ -1484,11 +1499,22 @@ def _saturate_stage(t0):
     out_path = os.environ.get(
         "SATURATE_OUT", os.path.join(_REPO_DIR, "SATURATE_r01.json")
     )
+    # simulated per-op storage-node service time (SATURATE_STORE_LAT_US):
+    # with real storage latency the request handlers' concurrent reads
+    # cross the adaptive gate and ride the PIPELINED framing — the r02
+    # re-run proves the AIMD limiter and price book re-converge on
+    # pipelined latencies (0 = loopback, the r01 configuration)
+    store_lat_us = float(os.environ.get("SATURATE_STORE_LAT_US", "0"))
 
     # the serving path under test: remote KCVS backend (the r05 slowest
     # link) behind the query server, admission tuned for an early knee so
     # the ramp actually crosses saturation inside the level ladder
-    kcvs = RemoteStoreServer(InMemoryStoreManager()).start()
+    backing = InMemoryStoreManager()
+    kcvs = RemoteStoreServer(
+        _LatencyManager(backing, store_lat_us / 1e6)
+        if store_lat_us else backing,
+        pipeline_workers=32,
+    ).start()
     host, port = kcvs.address
     graph = open_graph({
         "ids.authority-wait-ms": 0.0,
@@ -1514,6 +1540,12 @@ def _saturate_stage(t0):
         retry_after_base_s=0.02, retry_after_max_s=0.5,
         brownout_window_s=2.0, brownout_enter_sheds=50,
         brownout_exit_s=4.0, brownout_dwell_s=1.0,
+    )
+    # latency-queueing service times (storage-latency dominated) need a
+    # tighter AIMD latency threshold than the CPU-bound r01 profile: the
+    # decrease must fire before queue growth doubles the median
+    ctl.limiter.threshold = float(
+        os.environ.get("SATURATE_AIMD_THRESHOLD", "2.0")
     )
     server = JanusGraphServer(
         manager=manager, admission=ctl, request_timeout_s=30.0,
@@ -1643,8 +1675,23 @@ def _saturate_stage(t0):
         {k: e[k] for k in ("rung", "direction", "reason", "seq")}
         for e in flight_recorder.events("brownout")
     ]
+    from janusgraph_tpu.storage.pipeline import pipeline_health_block
+
+    pipe_block = pipeline_health_block(registry.snapshot())
     report = {
         "stage": "saturate",
+        "store_latency_us": store_lat_us,
+        "scenario": {
+            "levels": levels, "level_s": level_s,
+            "vertices": n_vertices,
+            "limit_init": int(os.environ.get("SATURATE_LIMIT_INIT", "4")),
+            "aimd_threshold": float(
+                os.environ.get("SATURATE_AIMD_THRESHOLD", "2.0")
+            ),
+            "limit_max": int(os.environ.get("SATURATE_LIMIT_MAX", "8")),
+            "queue_bound": int(os.environ.get("SATURATE_QUEUE", "8")),
+        },
+        "pipeline": pipe_block,
         "levels": per_level,
         "peak_goodput_per_s": peak["goodput_per_s"],
         "peak_offered_concurrency": peak["offered_concurrency"],
@@ -1806,6 +1853,274 @@ def _oltp_stage(t0):
         })
     finally:
         server.stop()
+
+
+class _LatencyStore:
+    """Per-op simulated storage-node service time: every KCVS call pays
+    a fixed sleep (media + replication + fabric RTT of a REAL storage
+    node — the loopback in-process server otherwise answers in ~30 us,
+    which no deployed Cassandra/HBase-class backend does). The sleep
+    releases the GIL exactly like real socket/disk waits."""
+
+    def __init__(self, inner, lat_s):
+        self._inner = inner
+        self._lat_s = lat_s
+
+    def __getattr__(self, name):
+        return getattr(self._inner, name)
+
+    def get_slice(self, *a, **k):
+        time.sleep(self._lat_s)
+        return self._inner.get_slice(*a, **k)
+
+    def get_slice_multi(self, *a, **k):
+        time.sleep(self._lat_s)
+        return self._inner.get_slice_multi(*a, **k)
+
+    def mutate(self, *a, **k):
+        time.sleep(self._lat_s)
+        return self._inner.mutate(*a, **k)
+
+
+class _LatencyManager:
+    def __init__(self, inner, lat_s):
+        self._inner = inner
+        self._lat_s = lat_s
+
+    def __getattr__(self, name):
+        return getattr(self._inner, name)
+
+    def open_database(self, name):
+        return _LatencyStore(self._inner.open_database(name), self._lat_s)
+
+    def mutate_many(self, *a, **k):
+        time.sleep(self._lat_s)
+        return self._inner.mutate_many(*a, **k)
+
+
+def _oltp_pipeline_stage(t0):
+    """Pipelined-vs-synchronous wire framing A/B (ISSUE 11 acceptance):
+    a closed-loop multiquery workload (per iteration: one existence-
+    probe getSlice, one mutate, and every 8th iteration a 16-key
+    multi-slice prefetch) against a remote KCVS server, swept over
+    offered in-flight depth (worker threads) at a simulated storage-node
+    service time. The synchronous baseline is the PR 1 framing
+    (pipeline=False) at the default 4-connection pool; the pipelined
+    path multiplexes every in-flight op over 2 sockets. Each level
+    records achieved throughput, wire frames/op, coalesce ratio, and
+    in-flight depth. Zero-latency cells ride along for transparency:
+    in-process loopback on this host is GIL-bound, so the adaptive gate
+    keeps the sync path there (~1.0x by design)."""
+    import threading as _threading
+
+    from janusgraph_tpu.observability import registry
+    from janusgraph_tpu.storage.inmemory import InMemoryStoreManager
+    from janusgraph_tpu.storage.kcvs import KeySliceQuery, SliceQuery
+    from janusgraph_tpu.storage.remote import (
+        RemoteStoreManager,
+        RemoteStoreServer,
+    )
+
+    lat_us = float(os.environ.get("BENCH_PIPE_LAT_US", "2000"))
+    depths = [
+        int(x) for x in os.environ.get(
+            "BENCH_PIPE_DEPTHS", "1,8,16,32,64"
+        ).split(",")
+    ]
+    iters = int(os.environ.get("BENCH_PIPE_ITERS", "40"))
+
+    def _measure(pipeline, nthreads, lat_s, iters_n, with_multi=False):
+        registry.reset()
+        backing = InMemoryStoreManager()
+        server = RemoteStoreServer(
+            _LatencyManager(backing, lat_s) if lat_s else backing,
+            pipeline_workers=64,
+        ).start()
+        mgr = RemoteStoreManager(*server.address, pipeline=pipeline)
+        store = mgr.open_database("edgestore")
+        seed_keys = [f"seed{i:03d}".encode() for i in range(64)]
+        for k in seed_keys:
+            store.mutate(k, [(b"c", b"v")], [], None)
+        # warm-up outside the timed window: dials the sockets, settles
+        # the adaptive gate's service-time EWMA, and (pipelined) brings
+        # the mux out of its negotiation bootstrap — both paths equally
+        if nthreads > 1:
+            warm = [
+                _threading.Thread(
+                    target=lambda i=i: [
+                        store.get_slice(
+                            KeySliceQuery(
+                                seed_keys[i % 64], SliceQuery(b"", None)
+                            ), None,
+                        ) for _ in range(6)
+                    ],
+                )
+                for i in range(nthreads)
+            ]
+            for th in warm:
+                th.start()
+            for th in warm:
+                th.join()
+        errs = []
+        ops_done = [0]
+
+        def worker(i):
+            n = 0
+            try:
+                for j in range(iters_n):
+                    if with_multi:
+                        # prefetch shape: one 16-key multiQuery batch —
+                        # ALREADY amortized on the wire, so both framings
+                        # pay ~one service time per batch (recorded for
+                        # transparency; expect ~1x)
+                        res = store.get_slice_multi(
+                            seed_keys[:16], SliceQuery(b"", None), None
+                        )
+                        assert len(res) == 16
+                        n += 16
+                        continue
+                    # per-op stream: the existence-probe getSlice and
+                    # point mutate — the one-op-per-roundtrip traffic
+                    # the pipelined framing exists to batch
+                    k = f"w{i}-{j:03d}".encode()
+                    store.mutate(k, [(b"c", b"v")], [], None)
+                    got = store.get_slice(
+                        KeySliceQuery(k, SliceQuery(b"", None)), None
+                    )
+                    assert got == [(b"c", b"v")]
+                    n += 2
+            except Exception as e:  # noqa: BLE001 - surfaced in the line
+                errs.append(f"{type(e).__name__}: {e}")
+            ops_done[0] += n
+
+        threads = [
+            _threading.Thread(target=worker, args=(i,))
+            for i in range(nthreads)
+        ]
+        stop_sampler = _threading.Event()
+        inflight_samples = []
+
+        def _sampler():
+            while not stop_sampler.is_set():
+                mux = mgr._mux
+                if mux is not None:
+                    inflight_samples.append(mux.in_flight())
+                stop_sampler.wait(0.01)
+
+        sampler = _threading.Thread(target=_sampler, daemon=True)
+        w0 = time.perf_counter()
+        for th in threads:
+            th.start()
+        sampler.start()
+        for th in threads:
+            th.join()
+        stop_sampler.set()
+        sampler.join(timeout=1.0)
+        wall = time.perf_counter() - w0
+        if mgr._mux is not None:
+            mgr._mux.flush_stats()
+        snap = registry.snapshot()
+
+        def _cnt(name):
+            return snap.get(name, {}).get("count", 0)
+
+        p_ops = _cnt("storage.remote.pipeline.ops")
+        frames = _cnt("storage.remote.pipeline.wire_frames")
+        mgr.close()
+        server.stop()
+        return {
+            "ops_per_s": round(ops_done[0] / wall, 1),
+            "wall_s": round(wall, 3),
+            "ops": ops_done[0],
+            "pipelined_ops": p_ops,
+            "wire_frames": frames,
+            "frames_per_op": round(frames / p_ops, 3) if p_ops else None,
+            "coalesce_ratio": round(p_ops / frames, 3) if frames else None,
+            "in_flight_peak": max(inflight_samples, default=0),
+            "in_flight_mean": round(
+                sum(inflight_samples) / len(inflight_samples), 1
+            ) if inflight_samples else 0,
+            "errors": errs[:3],
+        }
+
+    levels = []
+    for depth in depths:
+        sync = _measure(False, depth, lat_us / 1e6, iters)
+        pipe = _measure(True, depth, lat_us / 1e6, iters)
+        if depth == depths[-1]:
+            # one repetition pass on the acceptance cell: medians, not
+            # single lucky runs (1-core host, noisy neighbors)
+            import statistics as _stats
+
+            sync_reps = [sync["ops_per_s"]] + [
+                _measure(False, depth, lat_us / 1e6, iters)["ops_per_s"]
+                for _ in range(2)
+            ]
+            pipe_reps = [pipe["ops_per_s"]] + [
+                _measure(True, depth, lat_us / 1e6, iters)["ops_per_s"]
+                for _ in range(2)
+            ]
+            sync["ops_per_s"] = round(_stats.median(sync_reps), 1)
+            pipe["ops_per_s"] = round(_stats.median(pipe_reps), 1)
+            sync["reps"] = [round(v, 1) for v in sync_reps]
+            pipe["reps"] = [round(v, 1) for v in pipe_reps]
+        speedup = (
+            pipe["ops_per_s"] / sync["ops_per_s"]
+            if sync["ops_per_s"] else None
+        )
+        levels.append({
+            "offered_depth": depth,
+            "sync": sync,
+            "pipelined": pipe,
+            "speedup": round(speedup, 3) if speedup else None,
+        })
+        _hb(
+            f"oltp_pipeline@depth={depth}: sync {sync['ops_per_s']:.0f} "
+            f"vs pipelined {pipe['ops_per_s']:.0f} ops/s "
+            f"({speedup:.2f}x, coalesce "
+            f"{pipe['coalesce_ratio']})", t0,
+        )
+    # transparency cells: (a) loopback zero latency — the adaptive gate
+    # keeps the sync path (ratio ~1.0 by design on a GIL-bound host);
+    # (b) the prefetch/multiQuery batch shape — already amortized on the
+    # wire, both framings pay ~one service time per 16-key batch
+    z_sync = _measure(False, 16, 0.0, iters)
+    z_pipe = _measure(True, 16, 0.0, iters)
+    m_sync = _measure(False, 16, lat_us / 1e6, 12, with_multi=True)
+    m_pipe = _measure(True, 16, lat_us / 1e6, 12, with_multi=True)
+    best = max(levels, key=lambda r: r["speedup"] or 0)
+    line = {
+        "stage": "oltp_pipeline",
+        "storage_latency_us": lat_us,
+        "iters_per_thread": iters,
+        "pipeline_defaults": {
+            "connections": 2, "depth": 128, "max_batch": 64,
+            "coalesce_us": 150.0, "sync_pool_size": 4,
+        },
+        "depth_sweep": levels,
+        "zero_latency": {
+            "sync": z_sync, "pipelined": z_pipe,
+            "ratio": round(
+                z_pipe["ops_per_s"] / z_sync["ops_per_s"], 3
+            ) if z_sync["ops_per_s"] else None,
+        },
+        "prefetch_batch_cell": {
+            "sync": m_sync, "pipelined": m_pipe,
+            "ratio": round(
+                m_pipe["ops_per_s"] / m_sync["ops_per_s"], 3
+            ) if m_sync["ops_per_s"] else None,
+            "note": "16-key multiQuery batches are already amortized "
+                    "on the wire; pipelining targets the per-op stream",
+        },
+        "peak_speedup": best["speedup"],
+        "peak_offered_depth": best["offered_depth"],
+        "accept_3x": bool(best["speedup"] and best["speedup"] >= 3.0),
+    }
+    _emit(line)
+    _hb(
+        f"oltp_pipeline: peak {best['speedup']:.2f}x at depth "
+        f"{best['offered_depth']} (>=3x: {line['accept_3x']})", t0,
+    )
 
 
 def _pallas_stage(jax, pr_iters, t0):

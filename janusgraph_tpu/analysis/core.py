@@ -81,6 +81,14 @@ RULES: Dict[str, Rule] = {
              "latency convoys (the serving path sheds load instead; "
              "suppress with justification where a bound is structurally "
              "guaranteed)"),
+        Rule("JG207", SEV_ERROR,
+             "synchronous remote round-trip inside a loop: a per-"
+             "iteration blocking wire call (conn.request / _call / "
+             "_call_ledger) pays one full RTT per element — batch the "
+             "ops (get_slice_multi / mutate_many) or gather them over "
+             "the pipelined mux (storage/pipeline.py) so fixed per-"
+             "message cost amortizes; suppress with justification on "
+             "cold paths where N is structurally tiny"),
         # -- padding / shape invariants -------------------------------------
         Rule("JG301", SEV_ERROR,
              "capacity tier constant is not a power of two (ELL/frontier "

@@ -1,4 +1,5 @@
-"""JG204 — swallowed backend errors; JG206 — unbounded queues.
+"""JG204 — swallowed backend errors; JG206 — unbounded queues;
+JG207 — synchronous remote round-trips in loops.
 
 JG204: the exception taxonomy (janusgraph_tpu/exceptions.py) splits
 backend failures into temporary (retriable) and permanent; the whole
@@ -24,6 +25,18 @@ is a ``deque(maxlen=...)`` for the same reason). Where a bound is
 structurally guaranteed (e.g. a BFS work queue that enqueues each vertex
 at most once), carry a justified ``# graphlint: disable=JG206 -- why``
 suppression instead of a fake numeric bound.
+
+JG207: a ``for``/``while`` loop whose body performs one synchronous
+remote round-trip per iteration (``conn.request(...)`` on a conn-named
+receiver, or the remote clients' ``_call``/``_call_ledger``) pays a full
+wire RTT per element — the one-op-per-round-trip shape the pipelined
+framing (storage/pipeline.py, ISSUE 11) exists to retire. Batch the ops
+(``get_slice_multi`` / ``mutate_many``), or submit them all and gather
+futures over the pipelined mux. Cold paths where the iteration count is
+structurally tiny (e.g. a fixed handful of schema registrations) carry a
+justified ``# graphlint: disable=JG207 -- why`` suppression. Calls
+inside a nested function/lambda defined in the loop body are NOT
+flagged — deferred submission is exactly the fix.
 """
 
 from __future__ import annotations
@@ -117,9 +130,51 @@ def _unbounded_queue_call(node: ast.Call):
     return None
 
 
+#: remote-client method names whose per-iteration use is one RTT each
+_ROUNDTRIP_METHODS = {"_call", "_call_ledger"}
+
+
+def _is_roundtrip_call(node: ast.Call) -> bool:
+    t = terminal_name(node.func)
+    if t in _ROUNDTRIP_METHODS:
+        return True
+    if t == "request" and isinstance(node.func, ast.Attribute):
+        recv = terminal_name(node.func.value)
+        return bool(recv) and "conn" in recv.lower()
+    return False
+
+
+def _loop_body_calls(loop) -> "list":
+    """Calls lexically inside the loop body, excluding nested function/
+    class scopes (a deferred call is the pipelined fix, not the bug)."""
+    out = []
+    stack = list(loop.body) + list(getattr(loop, "orelse", []))
+    while stack:
+        n = stack.pop()
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef,
+                          ast.ClassDef, ast.Lambda)):
+            continue
+        if isinstance(n, ast.Call):
+            out.append(n)
+        stack.extend(ast.iter_child_nodes(n))
+    return out
+
+
 def check_module(mod) -> List[Finding]:
     findings: List[Finding] = []
     for node in ast.walk(mod.tree):
+        if isinstance(node, (ast.For, ast.While)):
+            for call in _loop_body_calls(node):
+                if _is_roundtrip_call(call):
+                    findings.append(Finding(
+                        "JG207", RULES["JG207"].severity, mod.path,
+                        call.lineno, call.col_offset,
+                        "synchronous remote round-trip per loop "
+                        "iteration: one full wire RTT per element — "
+                        "batch (get_slice_multi/mutate_many) or gather "
+                        "over the pipelined mux; suppress with "
+                        "justification when N is structurally tiny",
+                    ))
         if isinstance(node, ast.Call):
             name = _unbounded_queue_call(node)
             if name is not None:

@@ -654,6 +654,56 @@ STORAGE.option(
     "factor x pool connections (read in RemoteStoreManager multi-slice)",
     2, Mutability.MASKABLE, lambda v: v >= 1,
 )
+STORAGE.option(
+    "remote.pipeline", bool,
+    "pipelined async wire framing against the remote KCVS server "
+    "(storage/pipeline.py): per-frame request ids, out-of-order "
+    "completion, op coalescing into batched wire frames, and few-socket "
+    "connection multiplexing — negotiated via the server's 'pipeline' "
+    "feature bit, so un-negotiated peers keep the synchronous framing "
+    "byte-for-byte. Routing is adaptive: a sequential caller or a "
+    "microsecond-fast backend stays on the sync pool; latency-dominated "
+    "concurrency beyond the pool size engages the mux", True,
+    Mutability.MASKABLE,
+)
+STORAGE.option(
+    "remote.pipeline-connections", int,
+    "pipelined sockets per remote store client — many in-flight ops "
+    "share these few connections (read in RemoteStoreManager)", 2,
+    Mutability.MASKABLE, lambda v: v >= 1,
+)
+STORAGE.option(
+    "remote.pipeline-depth", int,
+    "bound of the pipelined send queue per connection: submits past it "
+    "block (backpressure, counted as pipeline stalls) — the JG206 "
+    "bounded-buffer discipline on the wire path", 128,
+    Mutability.MASKABLE, lambda v: v >= 1,
+)
+STORAGE.option(
+    "remote.pipeline-max-batch", int,
+    "most ops coalesced into one pipelined wire frame (batch carrier / "
+    "merged multi)", 64, Mutability.MASKABLE, lambda v: v >= 1,
+)
+STORAGE.option(
+    "remote.pipeline-multi-chunk", int,
+    "pipelined multi-slice reads split into chunks of this many keys, "
+    "gathered concurrently as sibling sub-frames (server works them in "
+    "parallel)", 512, Mutability.MASKABLE, lambda v: v >= 1,
+)
+STORAGE.option(
+    "remote.pipeline-stall-ms", float,
+    "a submit blocked on the full pipeline queue past this long counts "
+    "as a pipeline stall (counter + flight event)", 200.0,
+    Mutability.MASKABLE, lambda v: v >= 0,
+)
+STORAGE.option(
+    "remote.pipeline-coalesce-us", float,
+    "group-commit window: with >=3 ops in flight the combiner holds a "
+    "frame open this long (once per response burst) so convoyed "
+    "resubmits seal into one coalesced carrier; 0 disables the window "
+    "(ops still batch when they queue naturally)", 150.0,
+    Mutability.MASKABLE, lambda v: v >= 0,
+)
 COMPUTER_NS.option(
     "frontier-tier-growth", int,
     "growth factor between frontier tier capacities — one compiled "
@@ -929,6 +979,15 @@ INDEX_NS.option(
     Mutability.MASKABLE, lambda v: v > 0,
 )
 INDEX_NS.option(
+    "search.pipeline", bool,
+    "pipelined async framing against the remote index server for "
+    "idempotent ops (query/rawQuery/totals/supports/exists/register), "
+    "negotiated via the fourth trailing capability byte; mutate and "
+    "restore keep the sync dial-only-retry discipline. Same adaptive "
+    "engagement rule as storage.remote.pipeline", True,
+    Mutability.MASKABLE,
+)
+INDEX_NS.option(
     "search.fsync", bool, "fsync the persistent local index provider", False,
 )
 INDEX_NS.option(
@@ -1193,6 +1252,14 @@ DRIVER_NS.option(
     "retry-budget-refill-per-s", float,
     "token refill rate of the driver retry budget", 0.5,
     Mutability.LOCAL, lambda v: v >= 0,
+)
+DRIVER_NS.option(
+    "ws-multiplex", bool,
+    "multiplex concurrent submits over one WebSocket connection: each "
+    "request carries a client-assigned id echoed in its response, so "
+    "many in-flight queries share the socket and complete out of order "
+    "(JanusGraphClient.ws; degrades to serial round-trips against an "
+    "old server that does not echo ids)", True, Mutability.LOCAL,
 )
 STORAGE.option(
     "faults.overload-at", int,

@@ -101,6 +101,15 @@ def _open_remote(cfg):
         trace_propagation=cfg.get("metrics.trace-propagation"),
         resource_ledger=cfg.get("metrics.resource-ledger"),
         deadline_propagation=cfg.get("server.deadline.propagation"),
+        pipeline=cfg.get("storage.remote.pipeline"),
+        pipeline_connections=cfg.get("storage.remote.pipeline-connections"),
+        pipeline_depth=cfg.get("storage.remote.pipeline-depth"),
+        pipeline_max_batch=cfg.get("storage.remote.pipeline-max-batch"),
+        pipeline_multi_chunk=cfg.get("storage.remote.pipeline-multi-chunk"),
+        pipeline_stall_ms=cfg.get("storage.remote.pipeline-stall-ms"),
+        pipeline_coalesce_us=cfg.get(
+            "storage.remote.pipeline-coalesce-us"
+        ),
     )
 
 
@@ -468,6 +477,7 @@ class JanusGraphTPU:
                 trace_propagation=cfg.get("metrics.trace-propagation"),
                 resource_ledger=cfg.get("metrics.resource-ledger"),
                 deadline_propagation=cfg.get("server.deadline.propagation"),
+                pipeline=cfg.get("index.search.pipeline"),
             )
         self.index_providers: Dict[str, object] = shared
         # {index_name: {field: KeyInformation}} for provider.mutate calls

@@ -275,20 +275,52 @@ class JanusGraphClient:
             return json.loads(resp.read()).get("status") == "ok"
 
     # ------------------------------------------------------------ WebSocket
-    def ws(self, session: bool = False) -> "WebSocketSession":
+    def ws(
+        self, session: bool = False, multiplex: Optional[bool] = None
+    ) -> "WebSocketSession":
         """Open a persistent WS connection; session=True switches it to
         the server's in-session mode (one transaction spans submits until
         the query commits — g.commit() — or the connection closes, which
-        rolls back)."""
-        return WebSocketSession(self, session=session)
+        rolls back). ``multiplex`` (default driver.ws-multiplex) lets
+        concurrent submits share this one socket: each request carries a
+        client id the server echoes, responses demux out of order."""
+        return WebSocketSession(self, session=session, multiplex=multiplex)
 
 
 class WebSocketSession:
-    """Persistent WS connection; submit() round-trips one JSON request."""
+    """Persistent WS connection; submit() round-trips one JSON request.
 
-    def __init__(self, client: JanusGraphClient, session: bool = False):
+    With multiplexing on, many threads may submit concurrently over the
+    ONE socket: requests carry a client-assigned ``id``, a send lock
+    serializes frames out, and whichever waiter holds the receive lock
+    demuxes responses (its own and its siblings') by the echoed id —
+    the same leader/follower discipline as the pipelined KCVS client.
+    Against an old server that does not echo ids, responses are matched
+    in request order (the server processes id-less and pre-multiplex
+    requests strictly serially), so mixed pairs stay compatible."""
+
+    def __init__(self, client: JanusGraphClient, session: bool = False,
+                 multiplex: Optional[bool] = None):
+        from janusgraph_tpu.core.config import REGISTRY
+
         self.client = client
         self.session = session
+        if multiplex is None:
+            multiplex = REGISTRY["driver.ws-multiplex"].default
+        self.multiplex = bool(multiplex)
+        self._next_id = 1
+        self._id_lock = threading.Lock()
+        self._send_lock = threading.Lock()
+        self._recv_lock = threading.Lock()
+        #: id -> [Event, payload|None, exc|None]; one entry per in-flight
+        #: submit — bounded by the caller thread count
+        self._waiters = {}
+        #: outstanding ids in request order, for old servers that do not
+        #: echo ids (their responses are strictly ordered)
+        import collections
+
+        # graphlint: disable=JG206 -- structurally bounded: one entry per in-flight submit (caller thread), popped on every response
+        self._order = collections.deque()
         self.sock = socket.create_connection((client.host, client.port))
         key = base64.b64encode(os.urandom(16)).decode()
         auth = client._auth_header()
@@ -343,8 +375,11 @@ class WebSocketSession:
                     )
                 if self.session:
                     req["session"] = True
-                self._send(json.dumps(req))
-                payload = json.loads(self._recv())
+                if self.multiplex:
+                    payload = self._submit_multiplexed(req)
+                else:
+                    self._send(json.dumps(req))
+                    payload = json.loads(self._recv())
                 status = payload.get("status", {})
                 _merge_status_ledger(status)
                 if status.get("code") == 200:
@@ -359,6 +394,63 @@ class WebSocketSession:
                 # connection, one bucket
                 if not self.client._should_retry(err, None, give_up_at, sp):
                     raise err
+
+    # ------------------------------------------------------- multiplexing
+    def _submit_multiplexed(self, req: dict) -> dict:
+        """One multiplexed round trip: send with a fresh id, then drive
+        the shared receive loop (leader) or wait for a leader to demux
+        our response (follower)."""
+        with self._id_lock:
+            rid = self._next_id
+            self._next_id += 1
+        req["id"] = rid
+        waiter = [threading.Event(), None, None]
+        self._waiters[rid] = waiter
+        self._order.append(rid)
+        with self._send_lock:
+            # graphlint: disable=JG203 -- intentional: the send lock serializes outbound WS frames on the shared socket (send half only; responses demux via the receive loop)
+            self._send(json.dumps(req))
+        ev = waiter[0]
+        while not ev.is_set():
+            # graphlint: disable=JG201 -- leader/follower try-acquire: the immediately following try/finally releases on every path
+            if self._recv_lock.acquire(timeout=0.02):
+                try:
+                    while not ev.is_set():
+                        self._route(json.loads(self._recv()))
+                except Exception as e:  # noqa: BLE001 - fail all waiters
+                    self._fail_waiters(e)
+                finally:
+                    self._recv_lock.release()
+            else:
+                ev.wait(0.05)
+        if waiter[2] is not None:
+            raise waiter[2]
+        return waiter[1]
+
+    def _route(self, payload: dict) -> None:
+        rid = payload.get("id")
+        if rid is None and self._order:
+            # old server: no echoed id — responses arrive in request
+            # order (the server serves id-less requests serially)
+            rid = self._order[0]
+        try:
+            self._order.remove(rid)
+        except ValueError:
+            pass
+        w = self._waiters.pop(rid, None)
+        if w is not None:
+            w[1] = payload
+            w[0].set()
+
+    def _fail_waiters(self, exc: Exception) -> None:
+        while self._waiters:
+            try:
+                _rid, w = self._waiters.popitem()
+            except KeyError:
+                break
+            w[2] = exc
+            w[0].set()
+        self._order.clear()
 
     def close(self) -> None:
         try:

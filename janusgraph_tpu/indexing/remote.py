@@ -50,6 +50,7 @@ from janusgraph_tpu.indexing.provider import (
 from janusgraph_tpu.storage import backend_op
 from janusgraph_tpu.storage.remote import (
     _DEADLINE_FLAG,
+    _PIPELINE_FLAG,
     _FLAG_MASK,
     _LEDGER_FLAG,
     _TRACE_FLAG,
@@ -80,6 +81,8 @@ _OP_SUPPORTS = 7
 _OP_EXISTS = 8
 _OP_CLEAR = 9
 _OP_FEATURES = 10
+#: batch carrier for pipelined framing (storage/pipeline.iter_batch)
+_OP_BATCH = 11
 
 _OP_NAMES = {
     _OP_REGISTER: "register",
@@ -92,7 +95,17 @@ _OP_NAMES = {
     _OP_EXISTS: "exists",
     _OP_CLEAR: "clear",
     _OP_FEATURES: "features",
+    _OP_BATCH: "pipelineBatch",
 }
+
+#: index ops that may ride pipelined frames: idempotent request/response
+#: ops only — mutate/restore keep the sync dial-only-retry discipline
+#: (their at-least-once hazards predate pipelining), and features is the
+#: negotiation itself
+_PIPELINEABLE_OPS = frozenset(
+    (_OP_REGISTER, _OP_QUERY, _OP_RAW_QUERY, _OP_TOTALS, _OP_SUPPORTS,
+     _OP_EXISTS)
+)
 
 #: one registry for the wire; user enums are not expected in index fields.
 #: allow_pickle=False: a network peer must never be able to ship a pickle
@@ -228,6 +241,7 @@ class _IndexHandler(socketserver.BaseRequestHandler):
 
         provider = self.server.provider  # type: ignore[attr-defined]
         sock = self.request
+        pipe = None
         try:
             while True:
                 try:
@@ -238,6 +252,45 @@ class _IndexHandler(socketserver.BaseRequestHandler):
                 raw = head[4]
                 op = raw & ~_FLAG_MASK
                 body = _recv_exact(sock, body_len) if body_len else b""
+                if raw & _PIPELINE_FLAG:
+                    if not getattr(self.server, "pipeline", True):
+                        # pre-pipeline server: the 0x10 bit stays in the
+                        # op byte -> unknown op (byte-identical old
+                        # behavior; compliant clients never send this)
+                        op = raw & ~(
+                            _TRACE_FLAG | _LEDGER_FLAG | _DEADLINE_FLAG
+                        )
+                    else:
+                        from janusgraph_tpu.storage.pipeline import (
+                            ServerPipeline,
+                            _InlineReply,
+                            iter_batch,
+                        )
+
+                        if pipe is None:
+                            pipe = ServerPipeline(sock, workers=getattr(
+                                self.server, "pipeline_workers", 4
+                            ))
+                        t_arr = _time.monotonic()
+                        if op != _OP_BATCH and pipe.serve_inline_ok():
+                            self._serve_pipelined(
+                                provider, _InlineReply(pipe), raw, body,
+                                t_arr,
+                            )
+                            pipe.note_duration(
+                                _time.monotonic() - t_arr
+                            )
+                            continue
+                        subs = (
+                            list(iter_batch(body))
+                            if op == _OP_BATCH else [(raw, body)]
+                        )
+                        for sub_raw, sub_body in subs:
+                            pipe.submit_op(
+                                self._serve_pipelined, provider,
+                                sub_raw, sub_body, t_arr,
+                            )
+                        continue
                 ctx = None
                 if raw & _TRACE_FLAG:
                     ctx, body = split_trace_prefix(body)
@@ -281,6 +334,63 @@ class _IndexHandler(socketserver.BaseRequestHandler):
                     self._led = None
         except (ConnectionResetError, BrokenPipeError):
             return
+        finally:
+            if pipe is not None:
+                pipe.close()
+
+    def _serve_pipelined(self, provider, out, raw, body, t_arrival) -> None:
+        """One pipelined index sub-op: per-op trace span, deadline
+        guard, and ledger echo, replied by request id. Runs on a pool
+        thread — all state local, never on the handler instance."""
+        import time as _time
+
+        op = raw & ~_FLAG_MASK
+        (req_id,) = struct.unpack_from(">I", body, 0)
+        body = body[4:]
+        ctx = None
+        if raw & _TRACE_FLAG:
+            ctx, body = split_trace_prefix(body)
+        budget_ms = None
+        if raw & _DEADLINE_FLAG:
+            budget_ms, body = split_deadline_prefix(body)
+            if budget_ms is not None:
+                # dispatch-queue dwell counts against the op's budget
+                budget_ms -= (_time.monotonic() - t_arrival) * 1000.0
+        led = {} if raw & _LEDGER_FLAG else None
+        t0 = _time.perf_counter_ns()
+        try:
+            with _deadline_guard(budget_ms):
+                if ctx is not None:
+                    from janusgraph_tpu.observability import tracer
+
+                    with tracer.child_span(
+                        ctx, f"index.remote.{_OP_NAMES.get(op, op)}",
+                        pipelined=True,
+                    ) as sp:
+                        payload = self._execute(provider, op, body, led)
+                        if led:
+                            sp.annotate(**{
+                                f"ledger.{k}": v
+                                for k, v in led.items()
+                                if k != "wall_ns"
+                            })
+                else:
+                    payload = self._execute(provider, op, body, led)
+            if led is not None:
+                from janusgraph_tpu.observability.profiler import (
+                    encode_ledger_block,
+                )
+
+                led["wall_ns"] = _time.perf_counter_ns() - t0
+                payload = encode_ledger_block(led) + payload
+            out.reply(req_id, _STATUS_OK, payload)
+        # graphlint: disable=JG204 -- protocol boundary: the error is serialized to the client as a temporary status frame addressed to this op's request id, and the CLIENT retries
+        except (TemporaryBackendError, ConnectionError) as e:
+            out.reply(req_id, _STATUS_TEMP, str(e).encode())
+        except Exception as e:  # noqa: BLE001 - protocol boundary
+            out.reply(
+                req_id, _STATUS_PERM, f"{type(e).__name__}: {e}".encode()
+            )
 
     def _reply(self, sock, status: int, body: bytes) -> None:
         if self._led is not None and status == _STATUS_OK:
@@ -295,12 +405,57 @@ class _IndexHandler(socketserver.BaseRequestHandler):
         sock.sendall(struct.pack(">IB", len(body), status) + body)
 
     def _dispatch(self, provider, sock, op: int, body: bytes) -> None:
+        if op == _OP_FEATURES:
+            self._reply(
+                sock, _STATUS_OK, self._features_payload(provider)
+            )
+            return
+        self._reply(
+            sock, _STATUS_OK,
+            self._execute(provider, op, body, self._led),
+        )
+
+    def _features_payload(self, provider) -> bytes:
+        f = provider.features()
+        out = [
+            bytes([int(f.supports_document_ttl),
+                   int(f.supports_custom_analyzer),
+                   int(f.supports_geo),
+                   int(f.supports_not_query_normal_form)]),
+            struct.pack(">I", len(f.supports_cardinality)),
+        ]
+        for c in f.supports_cardinality:
+            _ps(out, c)
+        # trailing protocol-capability bytes, positional: [trace] then
+        # [ledger] then [deadline] then [pipeline]. Old clients stop
+        # reading after the cardinalities (or after however many
+        # capability bytes they know), so extra bytes are invisible to
+        # them; old servers simply end the payload earlier and new
+        # clients negotiate the capability OFF. Every earlier byte is
+        # always written when a later one is, so positions stay
+        # unambiguous.
+        trace_on = getattr(self.server, "trace_propagation", True)
+        ledger_on = getattr(self.server, "ledger_echo", True)
+        deadline_on = getattr(self.server, "deadline_propagation", True)
+        pipeline_on = getattr(self.server, "pipeline", True)
+        if trace_on or ledger_on or deadline_on or pipeline_on:
+            out.append(b"\x01" if trace_on else b"\x00")
+        if ledger_on or deadline_on or pipeline_on:
+            out.append(b"\x01" if ledger_on else b"\x00")
+        if deadline_on or pipeline_on:
+            out.append(b"\x01" if deadline_on else b"\x00")
+        if pipeline_on:
+            out.append(b"\x01")
+        return b"".join(out)
+
+    def _execute(self, provider, op: int, body: bytes, led) -> bytes:
+        """One index op -> OK payload bytes (shared by the sync
+        dispatch and the pipelined per-sub-op path)."""
         r = _Reader(body)
         if op == _OP_REGISTER:
             store, key = r.str_(), r.str_()
             provider.register(store, key, _decode_keyinfo(r))
-            self._reply(sock, _STATUS_OK, b"")
-            return
+            return b""
         if op == _OP_MUTATE:
             muts: Dict[str, Dict[str, IndexMutation]] = {}
             for _ in range(r.u32()):
@@ -315,15 +470,14 @@ class _IndexHandler(socketserver.BaseRequestHandler):
                     m.additions.extend(_decode_entries(r))
                     m.deletions.extend(_decode_entries(r))
                     per_doc[docid] = m
-            if self._led is not None:
-                self._led["cells_written"] = sum(
+            if led is not None:
+                led["cells_written"] = sum(
                     len(m.additions) + len(m.deletions)
                     for per_doc in muts.values()
                     for m in per_doc.values()
                 )
             provider.mutate(muts, _decode_key_infos(r))
-            self._reply(sock, _STATUS_OK, b"")
-            return
+            return b""
         if op == _OP_RESTORE:
             docs: Dict[str, Dict[str, List[IndexEntry]]] = {}
             for _ in range(r.u32()):
@@ -333,8 +487,7 @@ class _IndexHandler(socketserver.BaseRequestHandler):
                     docid = r.str_()
                     per_doc[docid] = _decode_entries(r)
             provider.restore(docs, _decode_key_infos(r))
-            self._reply(sock, _STATUS_OK, b"")
-            return
+            return b""
         if op == _OP_QUERY:
             store = r.str_()
             cond = _decode_condition(r)
@@ -347,73 +500,40 @@ class _IndexHandler(socketserver.BaseRequestHandler):
                 cond, orders, None if limit < 0 else limit, offset
             )
             hits = provider.query(store, q)
-            if self._led is not None:
-                self._led["index_hits"] = len(hits)
+            if led is not None:
+                led["index_hits"] = len(hits)
             out: List[bytes] = [struct.pack(">I", len(hits))]
             for h in hits:
                 _ps(out, h)
-            self._reply(sock, _STATUS_OK, b"".join(out))
-            return
+            return b"".join(out)
         if op == _OP_RAW_QUERY:
             store = r.str_()
             hits = provider.raw_query(store, _decode_raw(r))
-            if self._led is not None:
-                self._led["index_hits"] = len(hits)
+            if led is not None:
+                led["index_hits"] = len(hits)
             out = [struct.pack(">I", len(hits))]
             for docid, score in hits:
                 _ps(out, docid)
                 out.append(struct.pack(">d", float(score)))
-            self._reply(sock, _STATUS_OK, b"".join(out))
-            return
+            return b"".join(out)
         if op == _OP_TOTALS:
             store = r.str_()
             n = provider.totals(store, _decode_raw(r))
-            self._reply(sock, _STATUS_OK, struct.pack(">Q", n))
-            return
+            return struct.pack(">Q", n)
         if op == _OP_SUPPORTS:
             info = _decode_keyinfo(r)
             pred = predicate_by_name(r.str_())
             ok = pred is not None and provider.supports(info, pred)
-            self._reply(sock, _STATUS_OK, b"\x01" if ok else b"\x00")
-            return
+            return b"\x01" if ok else b"\x00"
         if op == _OP_EXISTS:
-            self._reply(
-                sock, _STATUS_OK, b"\x01" if provider.exists() else b"\x00"
-            )
-            return
+            return b"\x01" if provider.exists() else b"\x00"
         if op == _OP_CLEAR:
             provider.clear_storage()
-            self._reply(sock, _STATUS_OK, b"")
-            return
-        if op == _OP_FEATURES:
-            f = provider.features()
-            out = [
-                bytes([int(f.supports_document_ttl),
-                       int(f.supports_custom_analyzer),
-                       int(f.supports_geo),
-                       int(f.supports_not_query_normal_form)]),
-                struct.pack(">I", len(f.supports_cardinality)),
-            ]
-            for c in f.supports_cardinality:
-                _ps(out, c)
-            # trailing protocol-capability bytes, positional: [trace]
-            # then [ledger] then [deadline]. Old clients stop reading
-            # after the cardinalities (or after however many capability
-            # bytes they know), so extra bytes are invisible to them; old
-            # servers simply end the payload earlier and new clients
-            # negotiate the capability OFF. Every earlier byte is always
-            # written when a later one is, so positions stay unambiguous.
-            trace_on = getattr(self.server, "trace_propagation", True)
-            ledger_on = getattr(self.server, "ledger_echo", True)
-            deadline_on = getattr(self.server, "deadline_propagation", True)
-            if trace_on or ledger_on or deadline_on:
-                out.append(b"\x01" if trace_on else b"\x00")
-            if ledger_on or deadline_on:
-                out.append(b"\x01" if ledger_on else b"\x00")
-            if deadline_on:
-                out.append(b"\x01")
-            self._reply(sock, _STATUS_OK, b"".join(out))
-            return
+            return b""
+        if op in (_OP_FEATURES, _OP_BATCH):
+            raise PermanentBackendError(
+                f"op {_OP_NAMES.get(op, op)} is not pipelineable"
+            )
         raise PermanentBackendError(f"unknown index op {op}")
 
 
@@ -427,7 +547,8 @@ class RemoteIndexServer:
     def __init__(self, provider: IndexProvider, host: str = "127.0.0.1",
                  port: int = 0, trace_propagation: bool = True,
                  ledger_echo: bool = True,
-                 deadline_propagation: bool = True):
+                 deadline_propagation: bool = True,
+                 pipeline: bool = True, pipeline_workers: int = 4):
         class _Srv(socketserver.ThreadingTCPServer):
             allow_reuse_address = True
             daemon_threads = True
@@ -437,6 +558,8 @@ class RemoteIndexServer:
         self._srv.trace_propagation = trace_propagation  # type: ignore[attr-defined]
         self._srv.ledger_echo = ledger_echo  # type: ignore[attr-defined]
         self._srv.deadline_propagation = deadline_propagation  # type: ignore[attr-defined]
+        self._srv.pipeline = pipeline  # type: ignore[attr-defined]
+        self._srv.pipeline_workers = pipeline_workers  # type: ignore[attr-defined]
         self.provider = provider
         self._thread: Optional[threading.Thread] = None
 
@@ -474,6 +597,11 @@ class RemoteIndexProvider(IndexProvider):
                  trace_propagation: bool = True,
                  resource_ledger: bool = True,
                  deadline_propagation: bool = True,
+                 pipeline: bool = True,
+                 pipeline_connections: int = 2,
+                 pipeline_depth: int = 128,
+                 pipeline_max_batch: int = 64,
+                 pipeline_coalesce_us: float = 150.0,
                  **_ignored):
         # `directory` accepted-and-ignored: open_index_provider passes the
         # local providers' kwargs through one call site (core/graph.py)
@@ -496,6 +624,20 @@ class RemoteIndexProvider(IndexProvider):
         #: server.deadline.propagation, gated on the third capability byte
         self.deadline_propagation = deadline_propagation
         self._remote_deadline: Optional[bool] = None
+        #: index.search.pipeline, gated on the fourth capability byte:
+        #: idempotent index ops (query/rawQuery/totals/supports/exists/
+        #: register) ride pipelined frames once engaged; mutate/restore
+        #: keep the sync dial-only-retry discipline
+        self.pipeline = pipeline
+        self.pipeline_connections = pipeline_connections
+        self.pipeline_depth = pipeline_depth
+        self.pipeline_max_batch = pipeline_max_batch
+        self.pipeline_coalesce_us = pipeline_coalesce_us
+        self._remote_pipeline: Optional[bool] = None
+        self._mux = None
+        self._mux_lock = threading.Lock()
+        self._calls_active = 0
+        self._op_ewma_s = 0.0
         #: the provider accounts index hits itself (echo or local
         #: fallback), so graph.mixed_index_query must not count them again
         self.ledger_self_accounting = True
@@ -521,13 +663,14 @@ class RemoteIndexProvider(IndexProvider):
                 half_open_probes=breaker_half_open_probes,
             )
 
-    def _frame(self, op: int, body: bytes):
-        """Same negotiation as RemoteStoreManager._frame: attach the
-        ambient trace context / ledger flag only once the server's
-        features payload proved it understands flagged frames. Returns
-        (op, body, want_ledger)."""
+    def _frame_parts(self, op: int):
+        """Same negotiation as RemoteStoreManager._frame_parts: returns
+        (flags, trace_prefix, want_ledger, expires_at); the deadline
+        prefix is encoded at send time from expires_at."""
         if op == _OP_FEATURES:
-            return op, body, False
+            return 0, b"", False, None
+        import time as _time
+
         from janusgraph_tpu.core.deadline import remaining_ms
         from janusgraph_tpu.observability import tracer
         from janusgraph_tpu.observability.profiler import current_ledger
@@ -536,26 +679,88 @@ class RemoteIndexProvider(IndexProvider):
         led = current_ledger() if self.resource_ledger else None
         budget = remaining_ms() if self.deadline_propagation else None
         if ctx is None and led is None and budget is None:
-            return op, body, False
+            return 0, b"", False, None
         if (self._remote_trace is None or self._remote_ledger is None
                 or self._remote_deadline is None):
             try:
                 self.features()
             # graphlint: disable=JG204 -- negotiation is best-effort: the frame just goes unflagged, and the op itself will surface the failure through its own retry guard
             except (TemporaryBackendError, PermanentBackendError):
-                return op, body, False
-        want_ledger = bool(led is not None and self._remote_ledger)
+                return 0, b"", False, None
+        flags = 0
+        prefix = b""
+        expires_at = None
         if budget is not None and self._remote_deadline:
-            # deadline prefix inside the trace prefix (server strips
-            # trace first, then deadline)
-            op |= _DEADLINE_FLAG
-            body = encode_deadline_prefix(budget) + body
+            flags |= _DEADLINE_FLAG
+            expires_at = _time.monotonic() + budget / 1000.0
         if ctx is not None and self._remote_trace:
-            op |= _TRACE_FLAG
-            body = encode_trace_prefix(ctx) + body
-        if want_ledger:
-            op |= _LEDGER_FLAG
-        return op, body, want_ledger
+            flags |= _TRACE_FLAG
+            prefix = encode_trace_prefix(ctx)
+        if led is not None and self._remote_ledger:
+            flags |= _LEDGER_FLAG
+        return flags, prefix, bool(flags & _LEDGER_FLAG), expires_at
+
+    def _frame(self, op: int, body: bytes):
+        """Synchronous-framing view: (op|flags, body with prefixes,
+        want_ledger) — trace prefix outside the deadline prefix."""
+        import time as _time
+
+        flags, prefix, want_ledger, expires_at = self._frame_parts(op)
+        if flags & _DEADLINE_FLAG:
+            prefix = prefix + encode_deadline_prefix(
+                max(0.0, (expires_at - _time.monotonic()) * 1000.0)
+            )
+        return op | flags, prefix + body, want_ledger
+
+    def _should_pipeline(self) -> bool:
+        """Same adaptive gate as the remote KCVS client: engage when
+        latency-dominated concurrency outgrows the pool, or while ops
+        are already in flight on the mux."""
+        if not self.pipeline:
+            return False
+        if self._mux is not None and self._mux.busy():
+            return True
+        from janusgraph_tpu.storage.remote import RemoteStoreManager
+
+        return (
+            self._calls_active > len(self._pool)
+            and self._op_ewma_s
+            > RemoteStoreManager._PIPELINE_LATENCY_GATE_S
+        )
+
+    def _mux_for(self, op: int):
+        """The pipeline mux when this op may ride pipelined framing
+        (negotiated + enabled + idempotent op); None = sync path."""
+        if not self.pipeline or op not in _PIPELINEABLE_OPS:
+            return None
+        if self._remote_pipeline is None:
+            try:
+                self.features()
+            # graphlint: disable=JG204 -- negotiation is best-effort: the op falls back to the sync path, whose own retry guard surfaces the failure
+            except (TemporaryBackendError, PermanentBackendError):
+                return None
+        if not self._remote_pipeline:
+            return None
+        if self._mux is None:
+            from janusgraph_tpu.storage.pipeline import PipelineMux
+
+            with self._mux_lock:
+                if self._mux is None:
+                    from janusgraph_tpu.observability.profiler import (
+                        split_ledger_block,
+                    )
+
+                    self._mux = PipelineMux(
+                        self.host, self.port,
+                        connections=self.pipeline_connections,
+                        depth=self.pipeline_depth,
+                        max_batch=self.pipeline_max_batch,
+                        coalesce_us=self.pipeline_coalesce_us,
+                        metric_prefix="index.remote",
+                        batch_op=_OP_BATCH,
+                        split_ledger=split_ledger_block,
+                    )
+        return self._mux
 
     def _call(self, op: int, body: bytes, idempotent: bool = True) -> bytes:
         """One wire call under the retry guard. Non-idempotent ops (mutate/
@@ -563,6 +768,48 @@ class RemoteIndexProvider(IndexProvider):
         the DIAL — once the request may have reached the server, a dropped
         connection surfaces as a permanent 'outcome unknown' error instead
         of an at-least-once resend duplicating index entries."""
+        self._calls_active += 1
+        try:
+            return self._call_inner(op, body, idempotent)
+        finally:
+            self._calls_active -= 1
+
+    def _call_inner(
+        self, op: int, body: bytes, idempotent: bool = True
+    ) -> bytes:
+        mux = (
+            self._mux_for(op)
+            if (idempotent and self._should_pipeline()) else None
+        )
+        if mux is not None:
+            from janusgraph_tpu.storage.pipeline import WireOp
+
+            flags, prefix, want_ledger, expires_at = self._frame_parts(op)
+            item = WireOp(
+                op, flags, prefix, body, want_ledger=want_ledger,
+                expires_at=expires_at,
+            )
+            timeout = 30.0 + self.retry_time_s
+
+            def pattempt():
+                # one submit+wait = one network attempt: a failed op
+                # fails only itself; siblings in flight complete
+                return mux.submit(item).result(timeout)
+
+            pguarded = pattempt
+            if self.breaker is not None:
+                pguarded = lambda: self.breaker.call(pattempt)  # noqa: E731
+            payload, fields = backend_op.execute(
+                pguarded, max_time_s=self.retry_time_s
+            )
+            if want_ledger:
+                from janusgraph_tpu.observability.profiler import (
+                    merge_echo,
+                )
+
+                merge_echo(fields, layer="index.remote")
+            self._tls.echoed = fields is not None
+            return payload
         op, body, want_ledger = self._frame(op, body)
 
         def attempt() -> bytes:
@@ -578,7 +825,15 @@ class RemoteIndexProvider(IndexProvider):
                             f"connect failed: {e}"
                         ) from e
                 try:
+                    import time as _time
+
+                    t0 = _time.monotonic()
                     status, payload, _sock = conn.request(op, body)
+                    # adaptive-gate latency signal (lock wait excluded)
+                    self._op_ewma_s = (
+                        0.9 * self._op_ewma_s
+                        + 0.1 * (_time.monotonic() - t0)
+                    )
                 except TemporaryBackendError:
                     if idempotent:
                         raise
@@ -629,6 +884,7 @@ class RemoteIndexProvider(IndexProvider):
             self._remote_trace = r.off < len(r.data) and r.u8() == 1
             self._remote_ledger = r.off < len(r.data) and r.u8() == 1
             self._remote_deadline = r.off < len(r.data) and r.u8() == 1
+            self._remote_pipeline = r.off < len(r.data) and r.u8() == 1
             self._features = IndexFeatures(
                 supports_document_ttl=bool(flags[0]),
                 supports_cardinality=cards,
@@ -740,6 +996,9 @@ class RemoteIndexProvider(IndexProvider):
         self._call(_OP_CLEAR, b"")
 
     def close(self) -> None:
+        if self._mux is not None:
+            self._mux.close()
+            self._mux = None
         for conn in self._pool:
             with conn.lock:
                 if conn.sock is not None:

@@ -111,6 +111,16 @@ class AIMDLimiter:
     slow EWMA (only while healthy, so an overloaded server cannot inflate
     its own notion of "normal")."""
 
+    #: the healthy-window baseline may never exceed this multiple of the
+    #: best (lowest) window median the server has demonstrated: under a
+    #: GRADUAL load ramp the plain EWMA is a boiling frog — each window's
+    #: queue-inflated median drags the baseline up just enough that the
+    #: next window still looks "healthy", and the decrease never fires
+    #: (observed re-tuning the limiter for pipelined storage latencies,
+    #: ISSUE 11). The floor itself decays upward 2% per window so a
+    #: genuinely slower regime re-anchors instead of pinning forever.
+    BASELINE_FLOOR_CAP = 1.25
+
     def __init__(
         self,
         initial: int = 8,
@@ -129,6 +139,8 @@ class AIMDLimiter:
             min(self.max_limit, max(self.min_limit, int(initial)))
         )
         self.baseline_ms: Optional[float] = None
+        #: best window median demonstrated (anchors the baseline)
+        self.floor_ms: Optional[float] = None
         self._samples: List[float] = []
 
     @property
@@ -144,6 +156,10 @@ class AIMDLimiter:
         samples = sorted(self._samples)
         self._samples = []
         median = samples[len(samples) // 2]
+        if self.floor_ms is None or median < self.floor_ms:
+            self.floor_ms = median
+        else:
+            self.floor_ms *= 1.02  # slow re-anchor toward a new regime
         if self.baseline_ms is None:
             self.baseline_ms = median
             return
@@ -153,9 +169,13 @@ class AIMDLimiter:
             )
         else:
             self._limit = min(float(self.max_limit), self._limit + 1.0)
-            # slow EWMA, healthy windows only: the baseline is what
-            # latency looks like when the server is NOT overloaded
-            self.baseline_ms = 0.9 * self.baseline_ms + 0.1 * median
+            # slow EWMA, healthy windows only — CLAMPED to the floor
+            # anchor: a gradual ramp must not ratchet "normal" upward
+            # window by window until overload reads as healthy
+            self.baseline_ms = min(
+                0.9 * self.baseline_ms + 0.1 * median,
+                self.floor_ms * self.BASELINE_FLOOR_CAP,
+            )
 
 
 class BrownoutLadder:

@@ -194,12 +194,19 @@ def healthz_snapshot() -> dict:
             breakers={k: v for k, v in breakers.items() if v != 0.0},
         )
         flight_recorder.dump(reason="healthz-degraded")
+    # the remote wire-protocol clients' pipelined-framing state: per
+    # protocol (storage.remote / index.remote) in-flight depth,
+    # coalescing ratio, stalls, and negotiation fallbacks (absent keys =
+    # the pipelined path has not engaged in this process)
+    from janusgraph_tpu.storage.pipeline import pipeline_health_block
+
     return {
         "status": status,
         "breakers": breakers,
         "counters": counters,
         "sharded": sharded,
         "admission": admission_block,
+        "pipeline": pipeline_health_block(snap),
         "flight": flight_recorder.health_block(),
     }
 
@@ -234,6 +241,7 @@ class JanusGraphServer:
         admission_enabled: bool = True,
         default_deadline_ms: float = 0.0,
         max_deadline_ms: float = 600_000.0,
+        ws_workers: int = 4,
     ):
         self.manager = manager or JanusGraphManager.get_instance()
         self.default_graph = default_graph
@@ -254,6 +262,9 @@ class JanusGraphServer:
         self.default_deadline_ms = default_deadline_ms
         #: server.deadline.max-ms — clamp on client-supplied deadlines
         self.max_deadline_ms = max_deadline_ms
+        #: per-connection worker pool size for id-tagged (multiplexed)
+        #: WS requests — id-less and in-session requests stay serial
+        self.ws_workers = ws_workers
         #: server.admission.* — the cost-aware front door (None = open)
         if admission is None and admission_enabled:
             from janusgraph_tpu.server.admission import AdmissionController
@@ -839,6 +850,33 @@ class _Handler(BaseHTTPRequestHandler):
         # shared-transaction session; the tx spans messages until the
         # query commits/rolls back, and a close without commit rolls back
         session = None
+        # WS multiplexing (driver.ws-multiplex): a request carrying an
+        # "id" field may run CONCURRENTLY with its siblings — the id is
+        # echoed in the response so the driver demuxes out-of-order
+        # completions. Requests WITHOUT ids (old drivers) and in-session
+        # requests (one shared transaction) stay strictly serial, so old
+        # clients see byte-identical ordered behavior.
+        ws_pool = None
+        send_lock = threading.Lock()
+
+        def _send_locked(payload: dict) -> None:
+            with send_lock:
+                # graphlint: disable=JG203 -- intentional: the send lock serializes response frames onto the shared WS socket (send half only)
+                _ws_send(sock, json.dumps(payload))
+
+        def _serve_tagged(req: dict) -> None:
+            rid = req.get("id")
+            try:
+                payload = self._run_request(
+                    req, session=None, trace_header=req.get("trace"),
+                )
+            except Exception as e:  # noqa: BLE001 - protocol boundary
+                payload = {"status": {"code": 500, "message": str(e)}}
+            payload["id"] = rid
+            try:
+                _send_locked(payload)
+            except (ConnectionError, OSError):
+                pass  # connection died mid-reply; the read loop notices
         try:
             while True:
                 msg = _ws_recv(sock, self.jg_server.max_request_bytes)
@@ -847,21 +885,37 @@ class _Handler(BaseHTTPRequestHandler):
                 try:
                     req = json.loads(msg)
                 except json.JSONDecodeError:
-                    _ws_send(sock, json.dumps(
+                    _send_locked(
                         {"status": {"code": 400, "message": "bad json"}}
-                    ))
+                    )
                     continue
                 if req.get("session") and session is None:
                     session = self.jg_server.open_session()
-                _ws_send(sock, json.dumps(
-                    self._run_request(
-                        req, session=session,
-                        trace_header=req.get("trace"),
-                    )
-                ))
+                if req.get("id") is not None and session is None:
+                    from concurrent.futures import ThreadPoolExecutor
+
+                    if ws_pool is None:
+                        ws_pool = ThreadPoolExecutor(
+                            max_workers=getattr(
+                                self.jg_server, "ws_workers", 4
+                            ),
+                            thread_name_prefix="ws-mux",
+                        )
+                    ws_pool.submit(_serve_tagged, req)
+                    continue
+                payload = self._run_request(
+                    req, session=session, trace_header=req.get("trace"),
+                )
+                if req.get("id") is not None:
+                    # in-session requests run serially but still echo
+                    # the id so a multiplexing driver can match them
+                    payload["id"] = req.get("id")
+                _send_locked(payload)
         except (ConnectionError, OSError):
             pass
         finally:
+            if ws_pool is not None:
+                ws_pool.shutdown(wait=False)
             if session is not None:
                 self.jg_server.close_session(session)
         self.close_connection = True

@@ -53,6 +53,27 @@ BASE_DELAY_S = 0.05
 MAX_DELAY_S = 2.0
 
 
+def check_deadline(stage: str = "dispatch") -> None:
+    """Refuse NOW when the ambient caller deadline is already spent:
+    counts ``storage.backend_op.deadline_expired`` and raises
+    ``DeadlineExceededError`` (permanent — never replayed). Shared by
+    ``execute``'s pre-dispatch check and the pipelined wire path's
+    pre-send check (storage/pipeline.py), so every layer refuses dead
+    work with the same counter and the same taxonomy."""
+    import time as _time
+
+    from janusgraph_tpu.core import deadline as _deadline
+    from janusgraph_tpu.observability import registry
+
+    caller_dl = _deadline.current_deadline()
+    if caller_dl is not None and _time.monotonic() >= caller_dl:
+        registry.counter("storage.backend_op.deadline_expired").inc()
+        raise DeadlineExceededError(
+            f"caller deadline spent before {stage} "
+            "(no storage dispatch performed)"
+        )
+
+
 def execute(
     op: Callable[[], T],
     max_time_s: float = 10.0,
@@ -81,14 +102,9 @@ def execute(
     delay = base
     attempt = 0
     while True:
-        if caller_dl is not None and time.monotonic() >= caller_dl:
-            # refuse BEFORE dispatch: no attempt, no retry, and the
-            # breaker (wrapped inside `op`) never counts the abort
-            registry.counter("storage.backend_op.deadline_expired").inc()
-            raise DeadlineExceededError(
-                f"caller deadline spent before attempt {attempt + 1} "
-                "(no storage dispatch performed)"
-            )
+        # refuse BEFORE dispatch: no attempt, no retry, and the breaker
+        # (wrapped inside `op`) never counts the abort
+        check_deadline(stage=f"attempt {attempt + 1}")
         try:
             return op()
         except PermanentBackendError:

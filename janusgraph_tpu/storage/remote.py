@@ -59,6 +59,20 @@ it), and the serving node's own downstream retries stop when the budget
 runs out — the mechanism that kills retry storms at the bottom of the
 stack instead of the top. Same compatibility discipline as the other
 two bits: mixed old/new pairs speak the original protocol unchanged.
+
+Pipelined async framing rides a fourth bit (`op | 0x10`, negotiated via
+`"pipeline": true` — storage/pipeline.py): instead of one synchronous
+op per round-trip under a per-connection lock, the client queues ops on
+a small set of pipelined sockets; a writer thread coalesces the queue
+into batched wire frames (same-store getSlice ops merge into one
+getSliceMulti, same-store mutates into one mutateMany, everything else
+rides a batch carrier), and responses carry per-frame request ids so
+they complete out of order. The server dispatches each sub-op on a
+per-connection worker pool — every op keeps its OWN trace context,
+ledger echo, deadline budget, breaker accounting, and fault-injection
+attribution; the carrier frame has no identity of its own. Old peers in
+either direction never see a flagged frame: the synchronous path
+remains byte-identical and is the negotiated fallback.
 """
 
 from __future__ import annotations
@@ -97,6 +111,9 @@ _OP_SCAN_ALL = 6
 _OP_SCAN_RANGE = 7
 _OP_CLEAR = 8
 _OP_EXISTS = 9
+#: batch carrier for pipelined framing: the body is [u32 nsub] followed
+#: by length-prefixed pipelined sub-frames (storage/pipeline.iter_batch)
+_OP_BATCH = 10
 
 #: high bit of the op byte: the body is prefixed with
 #: [u8 hdr_len][TraceContext bytes]. Sent only after the server's
@@ -113,7 +130,12 @@ _LEDGER_FLAG = 0x40
 #: `"deadline": true` (same old/new byte-compat discipline as the trace
 #: and ledger bits: un-negotiated peers never see a flagged frame).
 _DEADLINE_FLAG = 0x20
-_FLAG_MASK = _TRACE_FLAG | _LEDGER_FLAG | _DEADLINE_FLAG
+#: fourth flag bit: pipelined framing — [u32 req_id] leads the body and
+#: the response echoes it on status|0x10 (storage/pipeline.py). Sent
+#: only after the server's features payload negotiated
+#: `"pipeline": true` (same discipline as the other three bits).
+_PIPELINE_FLAG = 0x10
+_FLAG_MASK = _TRACE_FLAG | _LEDGER_FLAG | _DEADLINE_FLAG | _PIPELINE_FLAG
 
 _OP_NAMES = {
     _OP_FEATURES: "features",
@@ -125,6 +147,7 @@ _OP_NAMES = {
     _OP_SCAN_RANGE: "scanRange",
     _OP_CLEAR: "clear",
     _OP_EXISTS: "exists",
+    _OP_BATCH: "pipelineBatch",
 }
 
 _STATUS_OK = 0
@@ -303,6 +326,7 @@ class _Handler(socketserver.BaseRequestHandler):
 
         mgr = self.server.manager  # type: ignore[attr-defined]
         sock = self.request
+        pipe = None
         try:
             while True:
                 try:
@@ -313,6 +337,53 @@ class _Handler(socketserver.BaseRequestHandler):
                 raw = head[4]
                 op = raw & ~_FLAG_MASK
                 body = _recv_exact(sock, body_len) if body_len else b""
+                if raw & _PIPELINE_FLAG:
+                    if not getattr(self.server, "pipeline", True):
+                        # a pre-pipeline server never strips the 0x10
+                        # bit: the flagged op is simply unknown (byte-
+                        # identical to real old-server behavior; a
+                        # compliant client never sends this)
+                        op = raw & ~(
+                            _TRACE_FLAG | _LEDGER_FLAG | _DEADLINE_FLAG
+                        )
+                    else:
+                        # pipelined framing (negotiated): every wire
+                        # frame runs as one per-connection pool task —
+                        # frames complete out of order, each sub-op
+                        # replies with its own request id, and a
+                        # frame's replies flush in one write
+                        from janusgraph_tpu.storage.pipeline import (
+                            ServerPipeline,
+                            _InlineReply,
+                            iter_batch,
+                        )
+
+                        if pipe is None:
+                            pipe = ServerPipeline(sock, workers=getattr(
+                                self.server, "pipeline_workers", 4
+                            ))
+                        t_arr = _time.monotonic()
+                        if op != _OP_BATCH and pipe.serve_inline_ok():
+                            # sequential FAST traffic: serve on this
+                            # thread — no pool handoff; concurrency and
+                            # slow ops ride per-sub-op pool tasks below
+                            self._serve_pipelined(
+                                mgr, _InlineReply(pipe), raw, body, t_arr
+                            )
+                            pipe.note_duration(
+                                _time.monotonic() - t_arr
+                            )
+                            continue
+                        subs = (
+                            list(iter_batch(body))
+                            if op == _OP_BATCH else [(raw, body)]
+                        )
+                        for sub_raw, sub_body in subs:
+                            pipe.submit_op(
+                                self._serve_pipelined, mgr, sub_raw,
+                                sub_body, t_arr,
+                            )
+                        continue
                 ctx = None
                 if raw & _TRACE_FLAG:
                     ctx, body = split_trace_prefix(body)
@@ -360,6 +431,69 @@ class _Handler(socketserver.BaseRequestHandler):
                     self._led = None
         except (ConnectionResetError, BrokenPipeError):
             return
+        finally:
+            if pipe is not None:
+                pipe.close()
+
+    def _serve_pipelined(self, mgr, out, raw, body, t_arrival) -> None:
+        """One pipelined sub-op: same per-op machinery as the sync path
+        (trace child span, deadline guard, ledger echo) with the reply
+        addressed by request id into the frame's reply buffer. Runs on
+        a pool thread — all state is local, never on the handler
+        instance."""
+        import time as _time
+
+        op = raw & ~_FLAG_MASK
+        (req_id,) = struct.unpack_from(">I", body, 0)
+        body = body[4:]
+        ctx = None
+        if raw & _TRACE_FLAG:
+            ctx, body = split_trace_prefix(body)
+        budget_ms = None
+        if raw & _DEADLINE_FLAG:
+            budget_ms, body = split_deadline_prefix(body)
+            if budget_ms is not None:
+                # time spent queued behind sibling sub-ops counts
+                # against THIS op's budget (the sync path's dispatch
+                # queue time is ~0, the pipelined path's is not)
+                budget_ms -= (_time.monotonic() - t_arrival) * 1000.0
+        led = {} if raw & _LEDGER_FLAG else None
+        t0 = _time.perf_counter_ns()
+        try:
+            with _deadline_guard(budget_ms):
+                if ctx is not None:
+                    from janusgraph_tpu.observability import tracer
+
+                    with tracer.child_span(
+                        ctx,
+                        f"store.remote.{_OP_NAMES.get(op, op)}",
+                        store_manager=getattr(mgr, "name", ""),
+                        pipelined=True,
+                    ) as sp:
+                        payload = self._execute(mgr, op, body, led)
+                        if led:
+                            sp.annotate(**{
+                                f"ledger.{k}": v
+                                for k, v in led.items()
+                                if k != "wall_ns"
+                            })
+                else:
+                    payload = self._execute(mgr, op, body, led)
+            if led is not None:
+                from janusgraph_tpu.observability.profiler import (
+                    encode_ledger_block,
+                )
+
+                led["wall_ns"] = _time.perf_counter_ns() - t0
+                payload = encode_ledger_block(led) + payload
+            out.reply(req_id, _STATUS_OK, payload)
+        # graphlint: disable=JG204 -- protocol boundary: the error is serialized to the client as a temporary status frame addressed to this op's request id, and the CLIENT retries
+        except (TemporaryBackendError, ConnectionError) as e:
+            out.reply(req_id, _STATUS_TEMP, str(e).encode())
+        except Exception as e:  # noqa: BLE001 - protocol boundary
+            out.reply(
+                req_id, _STATUS_PERM, f"{type(e).__name__}: {e}".encode()
+            )
 
     def _reply(self, sock, status: int, body: bytes) -> None:
         if self._led is not None and status == _STATUS_OK:
@@ -375,7 +509,6 @@ class _Handler(socketserver.BaseRequestHandler):
 
     def _dispatch(self, mgr, sock, op: int, body: bytes) -> None:
         r = _Reader(body)
-        txh = mgr.begin_transaction()
         if op == _OP_FEATURES:
             f = mgr.features
             import json
@@ -390,18 +523,47 @@ class _Handler(socketserver.BaseRequestHandler):
             }
             # protocol feature bits: this server accepts 0x80-flagged
             # frames carrying a trace header, 0x40-flagged frames asking
-            # for a resource-ledger echo, and 0x20-flagged frames carrying
-            # a deadline prefix (absent on old servers, so new clients
-            # degrade cleanly in every dimension)
+            # for a resource-ledger echo, 0x20-flagged frames carrying
+            # a deadline prefix, and 0x10-flagged pipelined frames
+            # (absent on old servers, so new clients degrade cleanly in
+            # every dimension)
             if getattr(self.server, "trace_propagation", True):
                 feats["trace"] = True
             if getattr(self.server, "ledger_echo", True):
                 feats["ledger"] = True
             if getattr(self.server, "deadline_propagation", True):
                 feats["deadline"] = True
+            if getattr(self.server, "pipeline", True):
+                feats["pipeline"] = True
             self._reply(sock, _STATUS_OK, json.dumps(feats).encode())
             return
-        led = self._led
+        if op in (_OP_SCAN_ALL, _OP_SCAN_RANGE):
+            txh = mgr.begin_transaction()
+            store = mgr.open_database(r.str_())
+            if op == _OP_SCAN_RANGE:
+                key_start = r.bytes_()
+                key_end = r.bytes_()
+                sq = _decode_slice(r)
+                query = KeyRangeQuery(key_start, key_end, sq)
+            else:
+                query = _decode_slice(r)
+            # stream rows after an OK frame; [1][row]* then [0]
+            self._reply(sock, _STATUS_OK, b"")
+            for key, entries in store.get_keys(query, txh):
+                out = [b"\x01"]
+                _pb(out, key)
+                _encode_entries(out, entries)
+                sock.sendall(b"".join(out))
+            sock.sendall(b"\x00")
+            return
+        self._reply(sock, _STATUS_OK, self._execute(mgr, op, body, self._led))
+
+    def _execute(self, mgr, op: int, body: bytes, led) -> bytes:
+        """One non-streaming op -> OK payload bytes. Shared by the sync
+        dispatch and the pipelined per-sub-op path; raising serializes
+        as a status frame in either framing."""
+        r = _Reader(body)
+        txh = mgr.begin_transaction()
         if op == _OP_GET_SLICE:
             store = mgr.open_database(r.str_())
             key = r.bytes_()
@@ -414,8 +576,7 @@ class _Handler(socketserver.BaseRequestHandler):
                 )
             out: List[bytes] = []
             _encode_entries(out, entries)
-            self._reply(sock, _STATUS_OK, b"".join(out))
-            return
+            return b"".join(out)
         if op == _OP_GET_SLICE_MULTI:
             store = mgr.open_database(r.str_())
             nkeys = r.u32()
@@ -432,8 +593,7 @@ class _Handler(socketserver.BaseRequestHandler):
             for k in keys:
                 _pb(out, k)
                 _encode_entries(out, res.get(k, []))
-            self._reply(sock, _STATUS_OK, b"".join(out))
-            return
+            return b"".join(out)
         if op == _OP_MUTATE:
             store = mgr.open_database(r.str_())
             key = r.bytes_()
@@ -447,8 +607,7 @@ class _Handler(socketserver.BaseRequestHandler):
                 )
             store.mutate(key, adds, dels, txh)
             txh.commit()
-            self._reply(sock, _STATUS_OK, b"")
-            return
+            return b""
         if op == _OP_MUTATE_MANY:
             nstores = r.u32()
             muts: Dict[str, Dict[bytes, KCVMutation]] = {}
@@ -478,33 +637,17 @@ class _Handler(socketserver.BaseRequestHandler):
                 )
             mgr.mutate_many(muts, txh)
             txh.commit()
-            self._reply(sock, _STATUS_OK, b"")
-            return
-        if op in (_OP_SCAN_ALL, _OP_SCAN_RANGE):
-            store = mgr.open_database(r.str_())
-            if op == _OP_SCAN_RANGE:
-                key_start = r.bytes_()
-                key_end = r.bytes_()
-                sq = _decode_slice(r)
-                query = KeyRangeQuery(key_start, key_end, sq)
-            else:
-                query = _decode_slice(r)
-            # stream rows after an OK frame; [1][row]* then [0]
-            self._reply(sock, _STATUS_OK, b"")
-            for key, entries in store.get_keys(query, txh):
-                out = [b"\x01"]
-                _pb(out, key)
-                _encode_entries(out, entries)
-                sock.sendall(b"".join(out))
-            sock.sendall(b"\x00")
-            return
+            return b""
         if op == _OP_CLEAR:
             mgr.clear_storage()
-            self._reply(sock, _STATUS_OK, b"")
-            return
+            return b""
         if op == _OP_EXISTS:
-            self._reply(sock, _STATUS_OK, b"\x01" if mgr.exists() else b"\x00")
-            return
+            return b"\x01" if mgr.exists() else b"\x00"
+        if op in (_OP_FEATURES, _OP_SCAN_ALL, _OP_SCAN_RANGE):
+            # streaming/negotiation ops never ride pipelined frames
+            raise PermanentBackendError(
+                f"op {_OP_NAMES.get(op, op)} is not pipelineable"
+            )
         raise PermanentBackendError(f"unknown op {op}")
 
 
@@ -512,12 +655,15 @@ class RemoteStoreServer:
     """Serve a KCVS manager over TCP (threaded; port 0 = ephemeral).
     ``trace_propagation=False`` serves the pre-trace features payload,
     ``ledger_echo=False`` the pre-ledger one, ``deadline_propagation=
-    False`` the pre-deadline one — "old-featured" servers for
-    compatibility tests and staged rollouts."""
+    False`` the pre-deadline one, ``pipeline=False`` the pre-pipeline
+    one — "old-featured" servers for compatibility tests and staged
+    rollouts. ``pipeline_workers`` sizes the per-connection dispatch
+    pool for pipelined frames (out-of-order completion depth)."""
 
     def __init__(self, manager, host: str = "127.0.0.1", port: int = 0,
                  trace_propagation: bool = True, ledger_echo: bool = True,
-                 deadline_propagation: bool = True):
+                 deadline_propagation: bool = True, pipeline: bool = True,
+                 pipeline_workers: int = 4):
         class _Srv(socketserver.ThreadingTCPServer):
             allow_reuse_address = True
             daemon_threads = True
@@ -527,6 +673,8 @@ class RemoteStoreServer:
         self._srv.trace_propagation = trace_propagation  # type: ignore[attr-defined]
         self._srv.ledger_echo = ledger_echo  # type: ignore[attr-defined]
         self._srv.deadline_propagation = deadline_propagation  # type: ignore[attr-defined]
+        self._srv.pipeline = pipeline  # type: ignore[attr-defined]
+        self._srv.pipeline_workers = pipeline_workers  # type: ignore[attr-defined]
         self.manager = manager
         self._thread: Optional[threading.Thread] = None
 
@@ -588,6 +736,23 @@ class _Conn:
             raise TemporaryBackendError(f"request failed: {e}") from e
 
 
+# hot-path handles (resolved once; per-op `from x import y` contends on
+# the import lock across submitting threads)
+_DEADLINE_MOD = None
+_TRACER = None
+_PROFILER_MOD = None
+
+
+def _hot_mods():
+    global _DEADLINE_MOD, _TRACER, _PROFILER_MOD
+    if _DEADLINE_MOD is None:
+        from janusgraph_tpu.core import deadline as _d
+        from janusgraph_tpu.observability import tracer as _t
+        from janusgraph_tpu.observability import profiler as _p
+        _DEADLINE_MOD, _TRACER, _PROFILER_MOD = _d, _t, _p
+    return _DEADLINE_MOD, _TRACER, _PROFILER_MOD
+
+
 class RemoteKCVStore(KeyColumnValueStore):
     def __init__(self, manager: "RemoteStoreManager", name: str):
         self._manager = manager
@@ -617,12 +782,18 @@ class RemoteKCVStore(KeyColumnValueStore):
             )
 
     def get_slice(self, query: KeySliceQuery, txh) -> EntryList:
+        sl: List[bytes] = []
+        _encode_slice(sl, query.slice)
+        slice_bytes = b"".join(sl)
         out: List[bytes] = []
         _ps(out, self._name)
         _pb(out, query.key)
-        _encode_slice(out, query.slice)
+        # the merge hint lets the pipeline writer coalesce same-slice
+        # getSlice ops from concurrent callers into ONE getSliceMulti
+        # wire frame; the response demuxes back per key
         payload, fields = self._manager._call_ledger(
-            _OP_GET_SLICE, b"".join(out)
+            _OP_GET_SLICE, b"".join(out) + slice_bytes,
+            merge=("gs", self._name, query.key, slice_bytes),
         )
         entries = _decode_entries(_Reader(payload))
         self._count_read(fields, entries)
@@ -631,6 +802,18 @@ class RemoteKCVStore(KeyColumnValueStore):
     def get_slice_multi(self, keys, slice_query, txh):
         mgr = self._manager
         keys = list(keys)
+        mux = (
+            mgr._mux_for(_OP_GET_SLICE_MULTI)
+            if (len(keys) > mgr.pipeline_multi_chunk
+                and mgr._should_pipeline())
+            else None
+        )
+        if mux is not None:
+            # pipelined path under CONCURRENCY: chunk the key set into
+            # sibling sub-frames gathered over the shared pipelined
+            # sockets — in-flight chunks from many callers interleave
+            # on few connections instead of convoying on the pool locks
+            return self._slice_multi_pipelined(keys, slice_query)
         # client-side parallel multi-slice (reference: Backend.java:215-221
         # parallelizes multi-key reads on an executor; storage.
         # parallel-backend-ops): split the key set across the connection
@@ -649,37 +832,64 @@ class RemoteKCVStore(KeyColumnValueStore):
             return merged
         return self._slice_multi_call(keys, slice_query)
 
-    def _slice_multi_call(self, keys, slice_query):
+    def _multi_body(self, keys, slice_query) -> bytes:
         out: List[bytes] = []
         _ps(out, self._name)
         out.append(struct.pack(">I", len(keys)))
         for k in keys:
             _pb(out, k)
         _encode_slice(out, slice_query)
-        payload, fields = self._manager._call_ledger(
-            _OP_GET_SLICE_MULTI, b"".join(out)
+        return b"".join(out)
+
+    def _slice_multi_pipelined(self, keys, slice_query):
+        mgr = self._manager
+        chunk = mgr.pipeline_multi_chunk
+        parts = [keys[i:i + chunk] for i in range(0, len(keys), chunk)]
+        results = mgr._pipe_gather(
+            _OP_GET_SLICE_MULTI,
+            [self._multi_body(p, slice_query) for p in parts],
         )
-        r = _Reader(payload)
-        n = r.u32()
-        res = {}
-        for _ in range(n):
-            key = r.bytes_()
-            res[key] = _decode_entries(r)
+        merged = {}
+        uncounted: List = []
+        for payload, fields in results:
+            res = _decode_multi_payload(payload)
+            merged.update(res)
+            if fields is None:
+                # this chunk came back without a server echo: count its
+                # decoded entries locally (per-chunk attribution)
+                uncounted.extend(
+                    e for entries in res.values() for e in entries
+                )
+        if uncounted:
+            self._count_read(None, uncounted)
+        return merged
+
+    def _slice_multi_call(self, keys, slice_query):
+        payload, fields = self._manager._call_ledger(
+            _OP_GET_SLICE_MULTI, self._multi_body(keys, slice_query)
+        )
+        res = _decode_multi_payload(payload)
         self._count_read(
             fields, [e for entries in res.values() for e in entries]
         )
         return res
 
     def mutate(self, key, additions, deletions, txh) -> None:
+        row: List[bytes] = []
+        _pb(row, key)
+        _encode_additions(row, additions)
+        row.append(struct.pack(">I", len(deletions)))
+        for col in deletions:
+            _pb(row, col)
+        row_bytes = b"".join(row)
         out: List[bytes] = []
         _ps(out, self._name)
-        _pb(out, key)
-        _encode_additions(out, additions)
-        out.append(struct.pack(">I", len(deletions)))
-        for col in deletions:
-            _pb(out, col)
+        # the merge hint lets the writer fold same-store mutates from
+        # concurrent callers into ONE mutateMany wire frame (the row
+        # layout is shared between the two ops by construction)
         _payload, fields = self._manager._call_ledger(
-            _OP_MUTATE, b"".join(out)
+            _OP_MUTATE, b"".join(out) + row_bytes,
+            merge=("mu", self._name, key, row_bytes),
         )
         if fields is None and self._manager.resource_ledger:
             from janusgraph_tpu.observability.profiler import (
@@ -759,6 +969,26 @@ def _raise_status(status: int, payload: bytes):
     raise PermanentBackendError(msg)
 
 
+def _entries_payload(entries: EntryList) -> bytes:
+    """Entries -> a single-getSlice OK payload (the pipeline demuxes a
+    merged multi response into per-op payloads byte-identical to an
+    unmerged reply, so callers decode one way)."""
+    out: List[bytes] = []
+    _encode_entries(out, entries)
+    return b"".join(out)
+
+
+def _decode_multi_payload(payload: bytes) -> Dict[bytes, EntryList]:
+    """A getSliceMulti OK payload -> {key: entries}."""
+    r = _Reader(payload)
+    n = r.u32()
+    res: Dict[bytes, EntryList] = {}
+    for _ in range(n):
+        key = r.bytes_()
+        res[key] = _decode_entries(r)
+    return res
+
+
 class RemoteStoreManager(KeyColumnValueStoreManager):
     """Client-side manager speaking the remote KCVS protocol."""
 
@@ -776,7 +1006,14 @@ class RemoteStoreManager(KeyColumnValueStoreManager):
                  breaker_half_open_probes: int = 1,
                  trace_propagation: bool = True,
                  resource_ledger: bool = True,
-                 deadline_propagation: bool = True):
+                 deadline_propagation: bool = True,
+                 pipeline: bool = True,
+                 pipeline_connections: int = 2,
+                 pipeline_depth: int = 128,
+                 pipeline_max_batch: int = 64,
+                 pipeline_multi_chunk: int = 512,
+                 pipeline_stall_ms: float = 200.0,
+                 pipeline_coalesce_us: float = 150.0):
         self.host, self.port = host, port
         #: metrics.trace-propagation — attach the ambient TraceContext to
         #: op frames, but ONLY once the server's features payload
@@ -791,6 +1028,36 @@ class RemoteStoreManager(KeyColumnValueStoreManager):
         #: remaining budget on op frames (same negotiation discipline)
         self.deadline_propagation = deadline_propagation
         self._remote_deadline: Optional[bool] = None
+        #: storage.remote.pipeline — route ops over pipelined async
+        #: framing (storage/pipeline.py) once the server negotiates the
+        #: `pipeline` feature bit; the sync pool stays the fallback for
+        #: old servers, scans, and negotiation itself
+        self.pipeline = pipeline
+        self.pipeline_connections = pipeline_connections
+        self.pipeline_depth = pipeline_depth
+        self.pipeline_max_batch = pipeline_max_batch
+        #: keys-per-sub-frame chunk for pipelined multi-slice reads:
+        #: big prefetch batches split into chunks served concurrently
+        #: by the server's per-connection pool
+        self.pipeline_multi_chunk = pipeline_multi_chunk
+        self.pipeline_stall_ms = pipeline_stall_ms
+        self.pipeline_coalesce_us = pipeline_coalesce_us
+        self._remote_pipeline: Optional[bool] = None
+        self._mux = None
+        self._mux_lock = threading.Lock()
+        self._pipeline_fallback_noted = False
+        #: concurrent _call_ledger calls right now (GIL-atomic += is
+        #: fidelity enough): the ADAPTIVE routing signal — a manager
+        #: with a single sequential caller takes the sync fast path
+        #: (identical cost to the pre-pipeline client), and the
+        #: pipelined mux engages the moment callers overlap
+        self._calls_active = 0
+        #: EWMA of recent op service time: pipelining pays when per-op
+        #: LATENCY dominates (in-flight demand beyond the connection
+        #: budget would otherwise convoy on the pool locks); against a
+        #: fast backend the sync pool already schedules optimally and
+        #: the mux machinery would only add CPU
+        self._op_ewma_s = 0.0
         #: the KCVS client accounts cells/bytes itself (echo or local
         #: decode), so BackendTransaction must not count the same ops
         self.ledger_self_accounting = True
@@ -850,51 +1117,73 @@ class RemoteStoreManager(KeyColumnValueStoreManager):
             self._pool_idx += 1
             return conn
 
-    def _frame(
-        self, op: int, body: bytes, allow_ledger: bool = True
-    ) -> Tuple[int, bytes, bool]:
-        """(op, body, want_ledger): the ambient trace context is prepended
-        (trace flag) when there is one AND the server negotiated the trace
-        feature bit; the ledger flag is set when an ambient ResourceLedger
-        exists AND the server negotiated the ledger bit. The first
-        qualifying call triggers the (lazy) features negotiation; a server
-        we can't reach yet just stays un-negotiated for this frame.
-        ``allow_ledger=False`` for streaming ops (scans) — their response
-        cannot carry a block, the client counts decoded rows instead."""
+    def _frame_parts(
+        self, op: int, allow_ledger: bool = True
+    ) -> Tuple[int, bytes, bool, Optional[float]]:
+        """(flags, trace_prefix, want_ledger, expires_at): the ambient
+        trace context is encoded (trace flag) when there is one AND the
+        server negotiated the trace feature bit; the ledger flag is set
+        when an ambient ResourceLedger exists AND the server negotiated
+        the ledger bit; the deadline flag when an ambient deadline exists
+        AND the server negotiated it — the REMAINING budget is carried as
+        ``expires_at`` (monotonic) and encoded at SEND time, so queue
+        dwell in the pipelined path keeps charging the op. The first
+        qualifying call triggers the (lazy) features negotiation; a
+        server we can't reach yet just stays un-negotiated for this
+        frame. ``allow_ledger=False`` for streaming ops (scans) — their
+        response cannot carry a block, the client counts decoded rows
+        instead."""
         if op == _OP_FEATURES:
-            return op, body, False
-        from janusgraph_tpu.core.deadline import remaining_ms
-        from janusgraph_tpu.observability import tracer
-        from janusgraph_tpu.observability.profiler import current_ledger
+            return 0, b"", False, None
+        import time as _time
 
+        _dl, tracer, _prof = _hot_mods()
         ctx = tracer.current_context() if self.trace_propagation else None
         led = (
-            current_ledger()
+            _prof.current_ledger()
             if (allow_ledger and self.resource_ledger)
             else None
         )
-        budget = remaining_ms() if self.deadline_propagation else None
+        budget = _dl.remaining_ms() if self.deadline_propagation else None
         if ctx is None and led is None and budget is None:
-            return op, body, False
+            return 0, b"", False, None
         if (self._remote_trace is None or self._remote_ledger is None
                 or self._remote_deadline is None):
             try:
                 _ = self.features
             # graphlint: disable=JG204 -- negotiation is best-effort: the frame just goes unflagged, and the op itself will surface the failure through its own retry guard
             except (TemporaryBackendError, PermanentBackendError):
-                return op, body, False
-        want_ledger = bool(led is not None and self._remote_ledger)
+                return 0, b"", False, None
+        flags = 0
+        prefix = b""
+        expires_at = None
         if budget is not None and self._remote_deadline:
-            # deadline prefix INSIDE the trace prefix: the server strips
-            # trace first, then deadline — both length-prefixed
-            op |= _DEADLINE_FLAG
-            body = encode_deadline_prefix(budget) + body
+            flags |= _DEADLINE_FLAG
+            expires_at = _time.monotonic() + budget / 1000.0
         if ctx is not None and self._remote_trace:
-            op |= _TRACE_FLAG
-            body = encode_trace_prefix(ctx) + body
-        if want_ledger:
-            op |= _LEDGER_FLAG
-        return op, body, want_ledger
+            flags |= _TRACE_FLAG
+            prefix = encode_trace_prefix(ctx)
+        if led is not None and self._remote_ledger:
+            flags |= _LEDGER_FLAG
+        return flags, prefix, bool(flags & _LEDGER_FLAG), expires_at
+
+    def _frame(
+        self, op: int, body: bytes, allow_ledger: bool = True
+    ) -> Tuple[int, bytes, bool]:
+        """Synchronous-framing view of _frame_parts: (op|flags, body with
+        prefixes prepended, want_ledger). The deadline prefix is encoded
+        now — the sync path sends immediately. Trace prefix OUTSIDE the
+        deadline prefix (the server strips trace first)."""
+        import time as _time
+
+        flags, prefix, want_ledger, expires_at = self._frame_parts(
+            op, allow_ledger
+        )
+        if flags & _DEADLINE_FLAG:
+            prefix = prefix + encode_deadline_prefix(
+                max(0.0, (expires_at - _time.monotonic()) * 1000.0)
+            )
+        return op | flags, prefix + body, want_ledger
 
     def _call(self, op: int, body: bytes) -> bytes:
         """One wire call; a ledger echo on the response is merged into the
@@ -903,19 +1192,160 @@ class RemoteStoreManager(KeyColumnValueStoreManager):
         payload, _ = self._call_ledger(op, body)
         return payload
 
+    def _mux_for(self, op: int):
+        """The pipeline mux when this op should ride pipelined framing:
+        enabled, negotiated, and not a negotiation/streaming op. Returns
+        None on the sync path. A server that did NOT negotiate the bit
+        notes a one-time negotiation fallback (counter + flight)."""
+        if not self.pipeline or op == _OP_FEATURES:
+            return None
+        if self._remote_pipeline is None:
+            try:
+                _ = self.features
+            # graphlint: disable=JG204 -- negotiation is best-effort: the op falls back to the sync path, whose own retry guard surfaces the failure
+            except (TemporaryBackendError, PermanentBackendError):
+                return None
+        if not self._remote_pipeline:
+            if not self._pipeline_fallback_noted:
+                self._pipeline_fallback_noted = True
+                from janusgraph_tpu.observability import (
+                    flight_recorder,
+                    registry,
+                )
+
+                registry.counter(
+                    "storage.remote.pipeline.fallbacks"
+                ).inc()
+                flight_recorder.record(
+                    "pipeline_fallback",
+                    endpoint=f"{self.host}:{self.port}",
+                    protocol="storage.remote",
+                    reason="server did not negotiate the pipeline bit",
+                )
+            return None
+        if self._mux is None:
+            from janusgraph_tpu.storage.pipeline import PipelineMux
+
+            with self._mux_lock:
+                if self._mux is None:
+                    from janusgraph_tpu.observability.profiler import (
+                        split_ledger_block,
+                    )
+
+                    self._mux = PipelineMux(
+                        self.host, self.port,
+                        connections=self.pipeline_connections,
+                        connect_timeout_s=self.connect_timeout_s,
+                        depth=self.pipeline_depth,
+                        max_batch=self.pipeline_max_batch,
+                        stall_ms=self.pipeline_stall_ms,
+                        coalesce_us=self.pipeline_coalesce_us,
+                        metric_prefix="storage.remote",
+                        batch_op=_OP_BATCH,
+                        split_ledger=split_ledger_block,
+                        encode_entries=_entries_payload,
+                        decode_multi=_decode_multi_payload,
+                    )
+        return self._mux
+
+    def _result_timeout(self) -> float:
+        """Belt-and-suspenders bound on waiting for a pipelined response:
+        the reader's socket timeout tears the connection down first in
+        any real hang, failing the future with a temporary error."""
+        return self.connect_timeout_s + self.retry_time_s
+
+    #: ops slower than this engage pipelined routing under concurrency —
+    #: a real storage node's service time (media + fabric RTT), not a
+    #: loopback echo: against a microsecond backend the sync pool
+    #: already schedules optimally and the mux would only add CPU
+    _PIPELINE_LATENCY_GATE_S = 0.0006
+
     def _call_ledger(
-        self, op: int, body: bytes
+        self, op: int, body: bytes, merge: Optional[tuple] = None
     ) -> Tuple[bytes, Optional[dict]]:
+        self._calls_active += 1
+        try:
+            return self._call_ledger_inner(op, body, merge)
+        finally:
+            self._calls_active -= 1
+
+    def _should_pipeline(self) -> bool:
+        """Adaptive routing: pipelined framing engages when (a) callers
+        overlap beyond what the sync pool can serve one-op-per-lock AND
+        (b) per-op service time is latency-dominated — or when the mux
+        already has ops in flight (stay engaged through a burst). A
+        sequential caller, or a microsecond-fast backend, keeps the sync
+        fast path and its exact pre-pipeline cost. Checked BEFORE any
+        negotiation, so an idle/sequential manager performs no extra
+        wire attempts (breaker accounting stays one event per op)."""
+        if not self.pipeline:
+            return False
+        if self._mux is not None and self._mux.busy():
+            return True
+        return (
+            self._calls_active > len(self._pool)
+            and self._op_ewma_s > self._PIPELINE_LATENCY_GATE_S
+        )
+
+    def _call_ledger_inner(
+        self, op: int, body: bytes, merge: Optional[tuple] = None
+    ) -> Tuple[bytes, Optional[dict]]:
+        mux = self._mux_for(op) if self._should_pipeline() else None
+        if mux is not None:
+            from janusgraph_tpu.storage.pipeline import WireOp
+
+            flags, prefix, want_ledger, expires_at = self._frame_parts(op)
+            item = WireOp(
+                op, flags, prefix, body, want_ledger=want_ledger,
+                merge=merge, expires_at=expires_at,
+            )
+            timeout = self._result_timeout()
+
+            def attempt():
+                # one submit+wait is one network attempt: a per-op
+                # failure (connection loss, temp status, injected fault)
+                # fails THIS op's future only — sibling in-flight ops
+                # complete, and the breaker counts exactly this op
+                return mux.submit(item).result(timeout)
+
+            guarded = attempt
+            if self.breaker is not None:
+                guarded = lambda: self.breaker.call(attempt)  # noqa: E731
+            payload, fields = backend_op.execute(
+                guarded,
+                max_time_s=self.retry_time_s,
+                base_delay_s=self.backoff_base_s,
+                max_delay_s=self.backoff_max_s,
+                max_attempts=self.max_attempts,
+            )
+            if want_ledger and fields is not None:
+                from janusgraph_tpu.observability.profiler import merge_echo
+
+                # the reader thread split the echo; the MERGE happens
+                # here on the caller's thread, inside its ambient ledger
+                merge_echo(fields, layer="store.remote")
+            return payload, fields
         op, body, want_ledger = self._frame(op, body)
 
         def attempt() -> bytes:
+            import time as _time
+
             conn = self._acquire()
             with conn.lock:
-                # the per-connection lock EXISTS to serialize request/
-                # response framing on one socket; holding it across the
-                # round-trip is the design (the pool provides parallelism)
-                # graphlint: disable=JG203 -- intentional: conn.lock serializes framing on this socket; concurrency comes from the pool
+                # the per-connection lock serializes request/response
+                # framing on one socket — the SYNC path for sequential
+                # callers, fast backends, old servers, and disabled
+                # pipelining; the pipelined mux above engages when
+                # latency-dominated concurrency outgrows the pool
+                t0 = _time.monotonic()
+                # graphlint: disable=JG203 -- re-scoped (ISSUE 11): adaptive/negotiated fallback only — conn.lock serializes sync framing on this socket; latency-dominated concurrency rides the pipelined mux instead
                 status, payload, _sock = conn.request(op, body)
+                # the true round-trip service time (lock wait excluded):
+                # the adaptive gate's latency signal
+                self._op_ewma_s = (
+                    0.9 * self._op_ewma_s
+                    + 0.1 * (_time.monotonic() - t0)
+                )
             if status != _STATUS_OK:
                 _raise_status(status, payload)
             return payload
@@ -947,6 +1377,48 @@ class RemoteStoreManager(KeyColumnValueStoreManager):
             merge_echo(fields, layer="store.remote")
         return payload, fields
 
+    def _pipe_gather(
+        self, op: int, bodies: List[bytes]
+    ) -> List[Tuple[bytes, Optional[dict]]]:
+        """Submit many sibling ops concurrently over the mux and gather
+        (payload, fields) per op. With the breaker enabled the ops run
+        through the standard guarded path serially instead, so every
+        network attempt stays one breaker event."""
+        mux = self._mux_for(op)
+        if mux is None or self.breaker is not None:
+            return [self._call_ledger(op, b) for b in bodies]
+        from janusgraph_tpu.storage.pipeline import WireOp
+
+        flags, prefix, want_ledger, expires_at = self._frame_parts(op)
+        items = [
+            WireOp(op, flags, prefix, b, want_ledger=want_ledger,
+                   expires_at=expires_at)
+            for b in bodies
+        ]
+        futs = [mux.submit(it) for it in items]
+        timeout = self._result_timeout()
+        out: List[Tuple[bytes, Optional[dict]]] = []
+        for it, fut in zip(items, futs):
+            try:
+                out.append(fut.result(timeout))
+            except TemporaryBackendError:
+                # replay just this op through the retry guard; siblings
+                # already in flight are unaffected
+                out.append(backend_op.execute(
+                    lambda it=it: mux.submit(it).result(timeout),
+                    max_time_s=self.retry_time_s,
+                    base_delay_s=self.backoff_base_s,
+                    max_delay_s=self.backoff_max_s,
+                    max_attempts=self.max_attempts,
+                ))
+        if want_ledger:
+            from janusgraph_tpu.observability.profiler import merge_echo
+
+            for _payload, fields in out:
+                if fields is not None:
+                    merge_echo(fields, layer="store.remote")
+        return out
+
     @property
     def features(self) -> StoreFeatures:
         if self._features is None:
@@ -954,11 +1426,12 @@ class RemoteStoreManager(KeyColumnValueStoreManager):
 
             remote = json.loads(self._call(_OP_FEATURES, b"").decode())
             # protocol capabilities, not store features: a missing key is
-            # an old server — trace headers / ledger / deadline flags are
-            # never sent
+            # an old server — trace headers / ledger / deadline /
+            # pipeline flags are never sent
             self._remote_trace = bool(remote.pop("trace", False))
             self._remote_ledger = bool(remote.pop("ledger", False))
             self._remote_deadline = bool(remote.pop("deadline", False))
+            self._remote_pipeline = bool(remote.pop("pipeline", False))
             self._features = StoreFeatures(
                 distributed=True,
                 network_attached=True,  # peers beyond this process can write
@@ -1016,6 +1489,9 @@ class RemoteStoreManager(KeyColumnValueStoreManager):
                 )
 
     def close(self) -> None:
+        if self._mux is not None:
+            self._mux.close()
+            self._mux = None
         if self._pool_executor is not None:
             self._pool_executor.shutdown(wait=False)
             self._pool_executor = None

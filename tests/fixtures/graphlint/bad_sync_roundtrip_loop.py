@@ -1,0 +1,41 @@
+"""JG207 fixture: synchronous remote round-trips inside loops.
+
+The one-op-per-round-trip shape over a remote store: every iteration
+pays a full wire RTT (the PR 1 framing the pipelined mux retires).
+"""
+import struct
+
+
+def fetch_all_sequentially(store_client, keys):
+    results = {}
+    for key in keys:
+        payload, _fields = store_client._call_ledger(2, key)  # expect: JG207
+        results[key] = payload
+    return results
+
+
+def probe_until_ready(conn):
+    ready = False
+    while not ready:
+        status, payload, _sock = conn.request(9, b"")  # expect: JG207
+        ready = payload == b"\x01"
+    return ready
+
+
+def write_rows(client, rows):
+    for key, value in rows:
+        client._call(4, struct.pack(">I", len(key)) + key + value)  # expect: JG207
+
+
+def batched_is_fine(store, keys, slice_query):
+    # the fix: ONE batched wire op for the whole key set
+    return store.get_slice_multi(keys, slice_query, None)
+
+
+def deferred_submission_is_fine(mux, items):
+    futures = []
+    for item in items:
+        # deferred/pipelined submission: the call below returns a future,
+        # no round-trip blocks the loop body
+        futures.append(mux.submit(item))
+    return [f.result() for f in futures]

@@ -768,3 +768,38 @@ def test_saturated_server_keeps_goodput_and_never_hangs(small_graph):
         assert results["shed"] > 0  # offered load really exceeded capacity
     finally:
         server.stop()
+
+
+def test_aimd_baseline_floor_anchor_under_gradual_ramp():
+    """ISSUE 11 regression: a gradual latency ramp must NOT ratchet the
+    healthy-window baseline upward until overload reads as normal (the
+    boiling-frog hole found re-tuning the limiter for pipelined storage
+    latencies). The baseline stays anchored to the best demonstrated
+    window median, so the multiplicative decrease eventually fires."""
+    from janusgraph_tpu.server.admission import AIMDLimiter
+
+    lim = AIMDLimiter(initial=8, max_limit=64, window=4, threshold=2.0)
+    # healthy start: ~10 ms medians seed floor and baseline
+    for _ in range(3):
+        for _ in range(4):
+            lim.observe(10.0)
+    assert lim.baseline_ms is not None and lim.baseline_ms <= 12.6
+    start_limit = lim.limit
+    # creeping congestion: +15% latency per window for 20 windows —
+    # each window looks "almost healthy" vs the previous one
+    latency = 10.0
+    decreased = False
+    for _ in range(20):
+        latency *= 1.15
+        before = lim.limit
+        for _ in range(4):
+            lim.observe(latency)
+        if lim.limit < before:
+            decreased = True
+    assert decreased, (
+        f"limit never decreased on a gradual ramp (baseline inflated to "
+        f"{lim.baseline_ms:.1f} ms)"
+    )
+    # the anchor held: baseline stays within the floor cap of the best
+    # median (floor decays 2%/window — bounded, not unbounded EWMA drift)
+    assert lim.baseline_ms <= lim.floor_ms * AIMDLimiter.BASELINE_FLOOR_CAP
