@@ -1076,6 +1076,21 @@ def worker() -> None:
                 "error": f"{type(e).__name__}: {e}"[:500],
             })
 
+    # spillover stage (ISSUE 12, optional: BENCH_SPILLOVER=1): step-walk
+    # vs spilled A/B of 2/3/4-hop traversal bursts at s16 with per-shape
+    # wall + promotion trace; results asserted set-equal in-stage and the
+    # cells written to bench_artifacts/r9_spillover_ab_*.jsonl
+    if os.environ.get("BENCH_SPILLOVER", "0") == "1":
+        try:
+            with _stage_span("oltp_spillover"):
+                _oltp_spillover_stage(t0)
+        except Exception as e:
+            _hb(f"oltp_spillover stage FAILED {type(e).__name__}: {e}", t0)
+            _emit({
+                "stage": "oltp_spillover", "ok": False,
+                "error": f"{type(e).__name__}: {e}"[:500],
+            })
+
     # chaos stage (ISSUE 3, optional: BENCH_CHAOS=1): seeded fault
     # injection over an OLTP workload with a torn commit + recovery,
     # recording recovered-op counts and recovery latency so BENCH_*.json
@@ -1896,6 +1911,221 @@ class _LatencyManager:
     def mutate_many(self, *a, **k):
         time.sleep(self._lat_s)
         return self._inner.mutate_many(*a, **k)
+
+
+def _oltp_spillover_stage(t0):
+    """OLTP->OLAP spillover A/B (ISSUE 12 acceptance): a burst of 2/3/4-hop
+    ``g.V(seeds).out('knows')^h.count()`` traversals at s16, step-walk
+    (planner disabled) vs spilled (promoted onto the OLAP executor over
+    the cached CSR snapshot), median of 3 timed runs each after warmup.
+    Results are asserted set-equal in-stage (count AND the dedup'd
+    endpoint-id set), the promotion trace rides the artifact line, and
+    every cell appends to bench_artifacts/r9_spillover_ab_<ts>.jsonl."""
+    import statistics as _stats
+
+    import numpy as np
+
+    from janusgraph_tpu.core.graph import open_graph
+    from janusgraph_tpu.observability import registry
+    from janusgraph_tpu.observability.profiler import digest_table
+    from janusgraph_tpu.olap.generators import rmat_csr
+
+    scale = int(os.environ.get("BENCH_SPILLOVER_SCALE", "16"))
+    edge_cap = int(os.environ.get("BENCH_SPILLOVER_EDGES", "400000"))
+    n_seeds = int(os.environ.get("BENCH_SPILLOVER_SEEDS", "24"))
+    batch = 10_000
+    csr = rmat_csr(scale, 16)
+    src = np.repeat(
+        np.arange(csr.num_vertices), np.diff(csr.out_indptr)
+    )[:edge_cap]
+    dst = csr.out_dst[:edge_cap]
+
+    digest_table.reset()
+    g = open_graph({
+        "storage.backend": "inmemory",
+        "computer.spillover": True,
+        "computer.spillover-min-cost-ms": float(
+            os.environ.get("BENCH_SPILLOVER_MIN_COST_MS", "5")
+        ),
+        "computer.spillover-min-seen": 2,
+    })
+    g.management().make_edge_label("knows")
+    b0 = time.perf_counter()
+    tx = g.new_transaction()
+    ids = [tx.add_vertex().id for _ in range(csr.num_vertices)]
+    tx.commit()
+    tx = g.new_transaction()
+    pending = 0
+    for i in range(len(src)):
+        sv = tx.get_vertex(ids[src[i]])
+        dv = tx.get_vertex(ids[dst[i]])
+        tx.add_edge(sv, "knows", dv)
+        pending += 1
+        if pending == batch:
+            tx.commit()
+            pending = 0
+            tx = g.new_transaction()
+    if pending:
+        tx.commit()
+    else:
+        tx.rollback()
+    build_s = time.perf_counter() - b0
+    _hb(
+        f"oltp_spillover: built s{scale} graph ({csr.num_vertices} v, "
+        f"{len(src)} e) in {build_s:.1f}s", t0,
+    )
+
+    rng = np.random.default_rng(7)
+    # seed selection: moderate-fanout vertices whose 4-hop traverser
+    # total (computed host-side with the same count recurrence the
+    # spilled program runs) stays within the per-query traverser budget
+    # — RMAT hubs explode a 2-hop walk past query.max-traversers
+    deg = np.bincount(src, minlength=csr.num_vertices)
+    candidates = rng.permutation(
+        np.nonzero((deg >= 2) & (deg <= 32))[0]
+    )
+    seeds = []
+    budget4 = 0.0
+    for v in candidates:
+        c = np.zeros(csr.num_vertices)
+        c[int(v)] = 1.0
+        totals = []
+        for _ in range(4):
+            c = np.bincount(
+                dst, weights=c[src], minlength=csr.num_vertices
+            )
+            totals.append(c.sum())
+        # per-seed AND whole-burst 4-hop budget: the step walk
+        # materializes every traverser, and the burst must stay inside
+        # query.max-traversers at the deepest cell
+        if totals[2] >= 200 and totals[3] <= 120_000 and (
+            budget4 + totals[3] <= 800_000
+        ):
+            seeds.append(ids[int(v)])
+            budget4 += totals[3]
+        if len(seeds) >= n_seeds:
+            break
+    planner = g.spillover_planner
+
+    # the burst: the recurring multi-seed shape — re-running it is what
+    # gives the digest table the repetitions the promotion policy needs
+    def _burst_count(hops):
+        t = g.traversal().V(*seeds)
+        for _ in range(hops):
+            t = t.out("knows")
+        return t.count()
+
+    def _burst_ids(hops):
+        t = g.traversal().V(*seeds)
+        for _ in range(hops):
+            t = t.out("knows")
+        return sorted(t.dedup().id_().to_list())
+
+    def _spill_count():
+        return registry.snapshot().get(
+            "olap.spillover.spilled", {}
+        ).get("count", 0)
+
+    ts = time.strftime("%Y%m%d-%H%M%S")
+    art_dir = os.path.join(_REPO_DIR, "bench_artifacts")
+    os.makedirs(art_dir, exist_ok=True)
+    art_path = os.path.join(art_dir, f"r9_spillover_ab_{ts}.jsonl")
+    cells = []
+    promotion_trace = []
+    with open(art_path, "a") as art:
+        for hops in (2, 3, 4):
+            # A: the step-by-step walk (planner off). These runs also
+            # feed the digest table the measured mean cost the promotion
+            # policy prices the shape from.
+            planner.enabled = False
+            _burst_count(hops)  # warm row caches
+            walk_walls = []
+            for _ in range(3):
+                w0 = time.perf_counter()
+                walk_total = _burst_count(hops)
+                walk_walls.append((time.perf_counter() - w0) * 1e3)
+            walk_ids = _burst_ids(hops)
+            # B: spilled. The first promoted run pays the one-time CSR
+            # pack + compile (recorded as warmup), steady-state timed.
+            planner.enabled = True
+            before = _spill_count()
+            p0 = time.perf_counter()
+            _burst_count(hops)  # promotion run (count >= min-seen now)
+            warm_ms = (time.perf_counter() - p0) * 1e3
+            spilled_engaged = _spill_count() > before
+            spill_walls = []
+            for _ in range(3):
+                w0 = time.perf_counter()
+                spill_total = _burst_count(hops)
+                spill_walls.append((time.perf_counter() - w0) * 1e3)
+            _burst_ids(hops)  # brings the id-shape past min-seen
+            spill_ids = _burst_ids(hops)
+            promotion_trace = [
+                {"digest": d, **s}
+                for d, s in sorted(planner.promotion_snapshot().items())
+            ]
+            walk_ms = _stats.median(walk_walls)
+            spill_ms = _stats.median(spill_walls)
+            set_equal = (
+                walk_total == spill_total and walk_ids == spill_ids
+            )
+            assert set_equal, (
+                f"spillover A/B mismatch at {hops} hops: "
+                f"walk {walk_total}/{len(walk_ids)} distinct vs "
+                f"spilled {spill_total}/{len(spill_ids)} distinct"
+            )
+            cell = {
+                "hops": hops,
+                "seeds": len(seeds),
+                "traversers": walk_total,
+                "distinct_endpoints": len(walk_ids),
+                "walk_ms": [round(w, 2) for w in walk_walls],
+                "walk_median_ms": round(walk_ms, 2),
+                "spill_warmup_ms": round(warm_ms, 2),
+                "spill_ms": [round(w, 2) for w in spill_walls],
+                "spill_median_ms": round(spill_ms, 2),
+                "speedup": round(walk_ms / spill_ms, 2) if spill_ms else None,
+                "spilled_engaged": spilled_engaged,
+                "set_equal": set_equal,
+            }
+            cells.append(cell)
+            art.write(json.dumps({
+                "stage": "oltp_spillover", "scale": scale, **cell,
+            }) + "\n")
+            art.flush()
+            _hb(
+                f"oltp_spillover@{hops}hop: walk {walk_ms:.0f}ms vs "
+                f"spilled {spill_ms:.1f}ms ({cell['speedup']}x, "
+                f"{walk_total} traversers)", t0,
+            )
+    three = next(c for c in cells if c["hops"] == 3)
+    line = {
+        "stage": "oltp_spillover",
+        "scale": scale,
+        "vertices": csr.num_vertices,
+        "edges": len(src),
+        "build_s": round(build_s, 1),
+        "cells": cells,
+        "promotion_trace": promotion_trace,
+        "spillover_counters": {
+            name[len("olap.spillover."):]: m["count"]
+            for name, m in registry.snapshot().items()
+            if m["type"] == "counter"
+            and name.startswith("olap.spillover.")
+            and "." not in name[len("olap.spillover."):]
+        },
+        "artifact": os.path.relpath(art_path, _REPO_DIR),
+        "accept_3x": bool(
+            three["speedup"] and three["speedup"] >= 3.0
+            and three["set_equal"] and three["spilled_engaged"]
+        ),
+    }
+    g.close()
+    _emit(line)
+    _hb(
+        f"oltp_spillover: 3-hop {three['speedup']}x "
+        f"(>=3x: {line['accept_3x']})", t0,
+    )
 
 
 def _oltp_pipeline_stage(t0):

@@ -89,6 +89,17 @@ RULES: Dict[str, Rule] = {
              "the pipelined mux (storage/pipeline.py) so fixed per-"
              "message cost amortizes; suppress with justification on "
              "cold paths where N is structurally tiny"),
+        Rule("JG209", SEV_ERROR,
+             "multi-hop adjacency expansion as a Python loop over "
+             "per-vertex store reads: an adjacency read (get_edges / "
+             "adjacency_edges) inside a loop that itself iterates an "
+             "adjacency read pays one store round per NEIGHBOR per hop "
+             "— use the multiquery prefetch batch (tx.prefetch before "
+             "the expansion, the traversal engine's own path) or the "
+             "OLAP spillover planner (olap/spillover.py), which executes "
+             "the whole chain as frontier supersteps over the CSR "
+             "snapshot; suppress with justification where the fan-out "
+             "is structurally tiny"),
         # -- padding / shape invariants -------------------------------------
         Rule("JG301", SEV_ERROR,
              "capacity tier constant is not a power of two (ELL/frontier "

@@ -1,5 +1,6 @@
 """JG204 — swallowed backend errors; JG206 — unbounded queues;
-JG207 — synchronous remote round-trips in loops.
+JG207 — synchronous remote round-trips in loops; JG209 — row-wise
+multi-hop adjacency expansion.
 
 JG204: the exception taxonomy (janusgraph_tpu/exceptions.py) splits
 backend failures into temporary (retriable) and permanent; the whole
@@ -37,6 +38,16 @@ structurally tiny (e.g. a fixed handful of schema registrations) carry a
 justified ``# graphlint: disable=JG207 -- why`` suppression. Calls
 inside a nested function/lambda defined in the loop body are NOT
 flagged — deferred submission is exactly the fix.
+
+JG209: a ``for`` loop that iterates an adjacency read (``get_edges`` /
+``adjacency_edges``) and performs FURTHER per-vertex adjacency reads in
+its body is the row-wise multi-hop expansion shape — one store round per
+neighbor per hop, when a batched path exists (the traversal engine's
+multiquery ``tx.prefetch`` before each expansion) and recurring hot
+chains spill to frontier supersteps over the CSR snapshot entirely
+(olap/spillover.py). Single-level per-vertex enumeration (exports, a
+one-hop scan) is NOT flagged; structurally tiny fan-outs carry a
+justified ``# graphlint: disable=JG209 -- why`` suppression.
 """
 
 from __future__ import annotations
@@ -133,6 +144,21 @@ def _unbounded_queue_call(node: ast.Call):
 #: remote-client method names whose per-iteration use is one RTT each
 _ROUNDTRIP_METHODS = {"_call", "_call_ledger"}
 
+#: per-vertex adjacency-read vocabulary (JG209): the store reads a
+#: row-by-row multi-hop expansion pays once per neighbor per hop
+_ADJACENCY_METHODS = {"get_edges", "adjacency_edges"}
+
+
+def _is_adjacency_call(node: ast.Call) -> bool:
+    return terminal_name(node.func) in _ADJACENCY_METHODS
+
+
+def _contains_adjacency_call(node) -> bool:
+    for n in ast.walk(node):
+        if isinstance(n, ast.Call) and _is_adjacency_call(n):
+            return True
+    return False
+
 
 def _is_roundtrip_call(node: ast.Call) -> bool:
     t = terminal_name(node.func)
@@ -163,6 +189,24 @@ def _loop_body_calls(loop) -> "list":
 def check_module(mod) -> List[Finding]:
     findings: List[Finding] = []
     for node in ast.walk(mod.tree):
+        if isinstance(node, ast.For) and _contains_adjacency_call(
+            node.iter
+        ):
+            # JG209: the row-wise multi-hop shape — expanding the
+            # NEIGHBORS of an adjacency read with further per-vertex
+            # adjacency reads (one store round per neighbor per hop)
+            for call in _loop_body_calls(node):
+                if _is_adjacency_call(call):
+                    findings.append(Finding(
+                        "JG209", RULES["JG209"].severity, mod.path,
+                        call.lineno, call.col_offset,
+                        "per-neighbor adjacency read inside an "
+                        "adjacency-expansion loop: a row-wise multi-hop "
+                        "walk — batch with the multiquery prefetch, or "
+                        "let the spillover planner (olap/spillover.py) "
+                        "run the chain as frontier supersteps over the "
+                        "CSR snapshot",
+                    ))
         if isinstance(node, (ast.For, ast.While)):
             for call in _loop_body_calls(node):
                 if _is_roundtrip_call(call):

@@ -288,15 +288,23 @@ def cmd_top(args) -> int:
         digests = payload.get("digests", [])
     else:
         from janusgraph_tpu.observability.profiler import digest_table
+        from janusgraph_tpu.olap.spillover import promoted_digests
 
+        promoted = promoted_digests()
         digests = digest_table.top(args.k)
+        for d in digests:
+            d["promoted"] = d["digest"] in promoted
     if args.json:
         print(json.dumps({"digests": digests[: args.k]}, indent=2))
         return 0
     print(f"{'digest':10} {'count':>7} {'total_ms':>10} {'p50_ms':>8} "
           f"{'p95_ms':>8} {'cells':>9}  shape")
     for d in digests[: args.k]:
-        print(f"{d['digest']:10} {d['count']:>7} {d['total_ms']:>10.2f} "
+        # spillover-promoted shapes (running on the OLAP executor) are
+        # marked like GET /profile marks them
+        mark = "*" if d.get("promoted") else " "
+        print(f"{d['digest']:9}{mark} {d['count']:>7} "
+              f"{d['total_ms']:>10.2f} "
               f"{d['p50_ms']:>8.2f} {d['p95_ms']:>8.2f} "
               f"{d['total_cells']:>9}  {d['shape']}")
     return 0

@@ -515,6 +515,64 @@ COMPUTER_NS.option(
     Mutability.MASKABLE, lambda v: v >= 0,
 )
 COMPUTER_NS.option(
+    "spillover", bool,
+    "OLTP->OLAP spillover: recurring expensive multi-hop traversal shapes "
+    "(promoted from the digest table's measured mean cost) compile to "
+    "frontier-expansion/SpGEMM supersteps over a cached CSR snapshot, with "
+    "tx-overlay reconciliation for read-your-writes (olap/spillover.py; "
+    "hook: GraphTraversal._execute). Any unsupported step, overlay "
+    "overflow, staleness breach, or rung-2 brownout falls back to the "
+    "row-by-row walk with a spillover_fallback flight event", True,
+    Mutability.MASKABLE,
+)
+COMPUTER_NS.option(
+    "spillover-min-cost-ms", float,
+    "measured mean wall (digest table) a traversal shape must exceed "
+    "before the spillover planner promotes it to the OLAP executor "
+    "(olap/spillover.SpilloverPlanner)", 25.0,
+    Mutability.MASKABLE, lambda v: v >= 0,
+)
+COMPUTER_NS.option(
+    "spillover-min-seen", int,
+    "executions of a shape the digest table must have observed before the "
+    "spillover planner considers promotion — one slow outlier is not a "
+    "recurring shape (olap/spillover.SpilloverPlanner)", 3,
+    Mutability.MASKABLE, lambda v: v >= 1,
+)
+COMPUTER_NS.option(
+    "spillover-min-hops", int,
+    "expansion steps a chain needs before spillover is even considered; "
+    "single-hop traversals stay on the multiquery-batched row path "
+    "(olap/spillover.py eligibility precheck)", 2,
+    Mutability.MASKABLE, lambda v: v >= 1,
+)
+COMPUTER_NS.option(
+    "spillover-max-overlay", int,
+    "uncommitted tx mutations (added/deleted edges, new/removed vertices) "
+    "beyond which spillover falls back to the row walk instead of patching "
+    "the snapshot — overlay reconciliation cost must stay small relative "
+    "to the spilled run (olap/spillover.py)", 4096,
+    Mutability.MASKABLE, lambda v: v >= 0,
+)
+COMPUTER_NS.option(
+    "spillover-max-staleness", int,
+    "committed writes since the CSR snapshot was packed beyond which "
+    "spillover refuses (falls back, counter olap.spillover.stale, snapshot "
+    "dropped for repack); within the bound the snapshot is incrementally "
+    "refreshed via the mutation-epoch tracker (olap/spillover.py; "
+    "groundwork for streaming delta-CSR freshness)", 4096,
+    Mutability.MASKABLE, lambda v: v >= 0,
+)
+COMPUTER_NS.option(
+    "price-book-path", str,
+    "file for persisting the digest-table price books (tmp+rename JSON, "
+    "same discipline as the autotune record) so spillover promotion and "
+    "admission pricing warm-start across restarts; empty = derive "
+    "<computer.checkpoint-path>.pricebook.json when a checkpoint path is "
+    "set, else no persistence (observability/profiler.save_price_book, "
+    "loaded at graph open)", "",
+)
+COMPUTER_NS.option(
     "shard-checkpoint-shards", int,
     "state-slice count when a NON-mesh executor (the CPU oracle) writes "
     "the sharded checkpoint format (0 = single-file format; the sharded "

@@ -400,6 +400,27 @@ class JanusGraphTPU:
             peak_bytes_per_s=cfg.get("metrics.roofline-peak-bytes-per-s"),
             peak_mxu_flops=cfg.get("metrics.roofline-peak-mxu-flops"),
         )
+        # price-book persistence (computer.price-book-path, defaulting
+        # next to the autotune record): warm-start the OLTP shape table
+        # so spillover promotion and admission pricing survive restarts
+        self._price_book_path = cfg.get("computer.price-book-path") or (
+            cfg.get("computer.checkpoint-path") + ".pricebook.json"
+            if cfg.get("computer.checkpoint-path")
+            else ""
+        )
+        if self._price_book_path:
+            _profiler.restore_digest_records(
+                _profiler.digest_table,
+                _profiler.load_price_book(self._price_book_path).get("oltp"),
+            )
+        # OLTP->OLAP spillover planner (computer.spillover; olap/
+        # spillover.py): promoted hot multi-hop traversal shapes run as
+        # frontier supersteps over a cached CSR snapshot
+        self.spillover_planner = None
+        if cfg.get("computer.spillover"):
+            from janusgraph_tpu.olap.spillover import SpilloverPlanner
+
+            self.spillover_planner = SpilloverPlanner(self)
         if cfg.get("metrics.structured-logging"):
             import sys as _sys
 
@@ -902,6 +923,13 @@ class JanusGraphTPU:
                     r.stop(final_flush=r.mode == "csv")
                 except OSError:
                     pass  # reporting must never block deregister/close
+            if getattr(self, "_price_book_path", ""):
+                from janusgraph_tpu.observability import profiler as _profiler
+
+                _profiler.save_price_book(
+                    self._price_book_path,
+                    {"oltp": _profiler.digest_table},
+                )
             if not self.backend.read_only:
                 self.instance_registry.deregister(self.instance_id)
             self.log_manager.close()

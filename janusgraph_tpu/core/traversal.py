@@ -964,10 +964,11 @@ class GraphTraversal:
         if self._folding:
             self._pre_has.append((None, p))
         else:
-            self._add(
-                lambda ts: [t for t in ts if p.test(_label_of(t.obj))],
-                name="hasLabel",
-            )
+            step = lambda ts: [t for t in ts if p.test(_label_of(t.obj))]
+            self._add(step, name="hasLabel")
+            # spillover planner metadata (olap/spillover.py): a mid-chain
+            # label filter compiles to a device-side step mask
+            step._spill_meta = ("hasLabel", tuple(labels))
         return self
 
     def has_id(self, *ids) -> "GraphTraversal":
@@ -1823,12 +1824,14 @@ class GraphTraversal:
             )
             for t in ts
         ])
+        self._steps[-1]._spill_meta = ("id",)
         return self
 
 
     # -- collection/order/slicing -------------------------------------------
     def dedup(self) -> "GraphTraversal":
         self._add(_dedup)
+        self._steps[-1]._spill_meta = ("dedup",)
         return self
 
     def limit(self, n: int) -> "GraphTraversal":
@@ -2891,6 +2894,7 @@ class GraphTraversal:
         """count as a STEP (for use inside bodies / by() modulators, like
         TinkerPop's mid-traversal count()); the terminal form is count()."""
         self._add(lambda ts: [Traverser(len(ts))], name="count")
+        self._steps[-1]._spill_meta = ("count",)
         return self
 
     def unfold(self) -> "GraphTraversal":
@@ -3045,6 +3049,15 @@ class GraphTraversal:
 
     # -- aggregation ---------------------------------------------------------
     def count(self) -> int:
+        # OLTP->OLAP spillover (olap/spillover.py): a promoted multi-hop
+        # count never materializes its traverser multiset — the planner
+        # reduces the device-side count vector directly
+        total = self._try_spillover(terminal="count")
+        if total is not None:
+            return total
+        # one planner decision per query: the row walk below must not
+        # re-attempt (a stale-snapshot refusal would repack mid-query)
+        self._spill_skip_once = True
         return len(self._execute())
 
     def sum_(self):
@@ -3069,6 +3082,19 @@ class GraphTraversal:
         return dict(Counter(self._elem_val(t, key) for t in ts))
 
     # -- terminals -----------------------------------------------------------
+    def _try_spillover(self, terminal=None):
+        """OLTP->OLAP spillover planner hook (olap/spillover.py): a
+        promoted hot multi-hop shape executes as frontier-expansion
+        supersteps over the cached CSR snapshot (tx overlay reconciled
+        for read-your-writes); None = run row by row. The planner feeds
+        the digest table itself, so the caller skips _observe_digest on
+        a spilled run."""
+        if self._start is None:
+            return None
+        from janusgraph_tpu.olap.spillover import try_spill
+
+        return try_spill(self, terminal=terminal)
+
     def _execute(self, observe=None) -> List[Traverser]:
         """One execution path for plain runs and .profile(): `observe` wraps
         every stage invocation (label, fn, input) -> output."""
@@ -3079,6 +3105,16 @@ class GraphTraversal:
         # fresh side-effect buckets per execution: re-running a traversal
         # must not accumulate aggregate()/store() contents across runs
         self._side_effects.clear()
+        if observe is None:
+            # .profile() wants the real per-step walk — spillover only
+            # intercepts plain executions (and count() consumes its own
+            # attempt before delegating here)
+            if getattr(self, "_spill_skip_once", False):
+                self._spill_skip_once = False
+            else:
+                spilled = self._try_spillover()
+                if spilled is not None:
+                    return spilled
         run = observe if observe is not None else (lambda _label, fn, ts: fn(ts))
         import time as _time
 

@@ -40,6 +40,16 @@ Event taxonomy (the ``category`` field):
                     changed rungs (server/admission.py BrownoutLadder;
                     fields: ``rung`` after the transition, ``direction``
                     enter/exit, ``reason``)
+``spillover``       the OLTP->OLAP spillover planner acted
+                    (olap/spillover.py; ``action``: ``promoted`` — a hot
+                    digest crossed the promotion policy — or ``spilled``
+                    — one traversal executed on the OLAP engine, with
+                    ``digest``/``hops``/``overlay``/``wall_ms``/``total``)
+``spillover_fallback``  a PROMOTED shape fell back to the row-by-row walk
+                    (``digest`` + ``reason``: unsupported step, overlay
+                    overflow, staleness breach, brownout refusal, count
+                    overflow, or an internal error — fallback keeps the
+                    query correct, the event keeps it visible)
 ==================  =======================================================
 
 Dump triggers: an unhandled server error, the /healthz ok->degraded flip,

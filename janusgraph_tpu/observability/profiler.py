@@ -359,6 +359,131 @@ class DigestTable:
 digest_table = DigestTable()
 
 
+# ------------------------------------------------------------- price book
+# Persistence for DigestTable contents (the "price book"): the OLTP shape
+# table above and the admission controller's server-side table serialize
+# to ONE JSON file next to the autotune record (computer.price-book-path,
+# default <computer.checkpoint-path>.pricebook.json), written tmp+rename
+# and loaded at graph open / server start — spillover promotion and
+# admission pricing warm-start instead of re-learning every process
+# lifetime.
+
+_PRICE_BOOK_VERSION = 1
+
+
+def digest_records(table: DigestTable) -> List[dict]:
+    """Serialize a table's entries (histogram bucket counts included, so
+    restored p50/p95 match the live table's log-bucket resolution)."""
+    with table._lock:
+        entries = [dict(e) for e in table._entries.values()]
+    out = []
+    for e in entries:
+        h = e["hist"]
+        with h._lock:
+            counts = list(h._counts)
+            hcount, htotal, hmax = h.count, h.total, h.max
+        out.append({
+            "digest": e["digest"],
+            "shape": e["shape"],
+            "count": e["count"],
+            "total_ms": e["total_ms"],
+            "total_cells": e["total_cells"],
+            "hist": {
+                "counts": counts, "count": hcount,
+                "total": htotal, "max": hmax,
+            },
+        })
+    return out
+
+
+def restore_digest_records(table: DigestTable, records) -> int:
+    """Merge persisted records into a live table (existing entries win —
+    fresh in-process measurements outrank a stale file). Malformed
+    records are skipped; returns how many were loaded."""
+    from janusgraph_tpu.observability.metrics_core import Histogram
+
+    loaded = 0
+    for r in records or ():
+        try:
+            digest = str(r["digest"])
+            hist = Histogram()
+            hd = r.get("hist") or {}
+            counts = list(hd.get("counts") or ())
+            if len(counts) == len(hist._counts):
+                hist._counts = [int(c) for c in counts]
+            hist.count = int(hd.get("count", r["count"]))
+            hist.total = float(hd.get("total", r["total_ms"]))
+            hist.max = float(hd.get("max", 0.0))
+            entry = {
+                "digest": digest,
+                "shape": str(r.get("shape", "")),
+                "count": int(r["count"]),
+                "total_ms": float(r["total_ms"]),
+                "total_cells": int(r.get("total_cells", 0)),
+                "hist": hist,
+            }
+        except (KeyError, TypeError, ValueError):
+            continue
+        with table._lock:
+            if digest in table._entries:
+                continue
+            table._entries[digest] = entry
+            loaded += 1
+            if len(table._entries) > table.capacity:
+                victim = min(
+                    table._entries,
+                    key=lambda d: table._entries[d]["total_ms"],
+                )
+                del table._entries[victim]
+    return loaded
+
+
+def save_price_book(path: str, tables: Dict[str, DigestTable]) -> None:
+    """Atomically persist the named tables (tmp + rename, the autotune
+    record's discipline), preserving any OTHER table already in the file.
+    Persistence must never fail the caller — I/O errors are swallowed."""
+    import json
+    import os
+    import tempfile
+
+    try:
+        payload_tables = dict(load_price_book(path))
+        for name, table in tables.items():
+            payload_tables[name] = digest_records(table)
+        payload = {"version": _PRICE_BOOK_VERSION, "tables": payload_tables}
+        d = os.path.dirname(os.path.abspath(path)) or "."
+        os.makedirs(d, exist_ok=True)
+        fd, tmp = tempfile.mkstemp(dir=d, suffix=".json.tmp")
+        try:
+            with os.fdopen(fd, "w") as f:
+                json.dump(payload, f)
+            os.replace(tmp, path)
+        except BaseException:
+            if os.path.exists(tmp):
+                os.unlink(tmp)
+            raise
+    except OSError:
+        return
+
+
+def load_price_book(path: str) -> Dict[str, List[dict]]:
+    """{table name: [records]} from a persisted price book; {} when the
+    file is missing, unreadable, or from an unknown version."""
+    import json
+
+    try:
+        with open(path, encoding="utf-8") as f:
+            payload = json.load(f)
+    except (OSError, ValueError):
+        return {}
+    if not isinstance(payload, dict) or payload.get(
+        "version"
+    ) != _PRICE_BOOK_VERSION:
+        return {}
+    tables = payload.get("tables")
+    return tables if isinstance(tables, dict) else {}
+
+
 # --------------------------------------------------------------------------
 # Roofline cost model
 # --------------------------------------------------------------------------

@@ -21,3 +21,8 @@ from janusgraph_tpu.olap.features import (  # noqa: F401
     DenseVertexProgram,
     MessageMode,
 )
+from janusgraph_tpu.olap.spillover import (  # noqa: F401
+    SpilloverPlan,
+    SpilloverPlanner,
+    promoted_digests,
+)

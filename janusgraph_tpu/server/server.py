@@ -200,12 +200,44 @@ def healthz_snapshot() -> dict:
     # the pipelined path has not engaged in this process)
     from janusgraph_tpu.storage.pipeline import pipeline_health_block
 
+    # the OLTP->OLAP spillover plane (olap/spillover.py): spilled/
+    # fallback/staleness counters and the promoted-digest census, so an
+    # operator can see whether the optimizer is engaging — and why not
+    spill_counters = {
+        name: m["count"]
+        for name, m in snap.items()
+        if m["type"] == "counter" and name.startswith("olap.spillover.")
+    }
+    promoted_gauge = snap.get("olap.spillover.promoted_digests")
+    from janusgraph_tpu.olap.spillover import promoted_digests
+
+    spillover_block = {
+        "spilled": spill_counters.get("olap.spillover.spilled", 0),
+        "fallbacks": spill_counters.get("olap.spillover.fallback", 0),
+        "stale": spill_counters.get("olap.spillover.stale", 0),
+        "packs": spill_counters.get("olap.spillover.packs", 0),
+        "refreshes": spill_counters.get("olap.spillover.refreshes", 0),
+        "promotions": spill_counters.get("olap.spillover.promotions", 0),
+        "promoted_digests": sorted(promoted_digests()),
+        "promoted_count": (
+            promoted_gauge["value"]
+            if promoted_gauge and promoted_gauge["type"] == "gauge"
+            else 0.0
+        ),
+        "fallback_reasons": {
+            name[len("olap.spillover.fallback."):]: count
+            for name, count in spill_counters.items()
+            if name.startswith("olap.spillover.fallback.")
+        },
+    }
+
     return {
         "status": status,
         "breakers": breakers,
         "counters": counters,
         "sharded": sharded,
         "admission": admission_block,
+        "spillover": spillover_block,
         "pipeline": pipeline_health_block(snap),
         "flight": flight_recorder.health_block(),
     }
@@ -326,7 +358,26 @@ class JanusGraphServer:
             from janusgraph_tpu.server import admission as _admission
 
             _admission.set_active(self.admission)
+            # price-book warm-start: the persisted server-side table
+            # (computer.price-book-path, shared with the OLTP table's
+            # file) prices known shapes correctly from request one
+            path = self._price_book_path()
+            if path:
+                from janusgraph_tpu.observability import profiler as _prof
+
+                _prof.restore_digest_records(
+                    self.admission.price_book,
+                    _prof.load_price_book(path).get("server"),
+                )
         return self
+
+    def _price_book_path(self) -> str:
+        """The default graph's resolved price-book path ('' = none)."""
+        try:
+            g = self.manager.get_graph(self.default_graph)
+        except Exception:  # noqa: BLE001 - no default graph registered
+            return ""
+        return getattr(g, "_price_book_path", "") or ""
 
     def stop(self) -> None:
         if self._httpd is not None:
@@ -338,6 +389,13 @@ class JanusGraphServer:
 
             if _admission.active() is self.admission:
                 _admission.set_active(None)
+            path = self._price_book_path()
+            if path:
+                from janusgraph_tpu.observability import profiler as _prof
+
+                _prof.save_price_book(
+                    path, {"server": self.admission.price_book}
+                )
 
     # ------------------------------------------------------------ execution
     def _namespace(self, query: str, graph_name: Optional[str]) -> dict:
@@ -713,10 +771,17 @@ class _Handler(BaseHTTPRequestHandler):
         if self.path == "/profile" or self.path.startswith("/profile?"):
             # the query-digest table: top-K traversal shapes by total
             # cost with p50/p95 wall (unauthenticated like /metrics:
-            # shapes are literal-stripped, never data content)
+            # shapes are literal-stripped, never data content). Digests
+            # the spillover planner promoted onto the OLAP executor are
+            # marked so a dashboard can tell optimized shapes apart.
             from janusgraph_tpu.observability.profiler import digest_table
+            from janusgraph_tpu.olap.spillover import promoted_digests
 
-            self._send_json(200, {"digests": digest_table.top(32)})
+            promoted = promoted_digests()
+            digests = digest_table.top(32)
+            for d in digests:
+                d["promoted"] = d["digest"] in promoted
+            self._send_json(200, {"digests": digests})
             return
         if self.path.startswith("/profile/flame"):
             # collapsed-stack rendering of one retained trace's span

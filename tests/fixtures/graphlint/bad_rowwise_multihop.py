@@ -1,0 +1,47 @@
+"""JG209 fixture: multi-hop adjacency expansion as nested per-vertex
+store reads.
+
+The row-wise 2-hop walk: the inner expansion issues one store round per
+NEIGHBOR of the outer expansion — the shape the multiquery prefetch
+batch and the OLAP spillover planner (olap/spillover.py) both exist to
+retire.
+"""
+from janusgraph_tpu.core.codecs import Direction
+
+
+def two_hop_rowwise(tx, vertices):
+    out = []
+    for v in vertices:
+        for e in tx.get_edges(v, Direction.OUT, ("knows",)):
+            w = e.other(v)
+            for e2 in tx.get_edges(w, Direction.OUT, ("knows",)):  # expect: JG209
+                out.append(e2.other(w))
+    return out
+
+
+def friends_of_friends(tx, seed):
+    hits = []
+    for e in tx.get_edges(seed, Direction.BOTH, ()):
+        friend = e.other(seed)
+        hits.extend(tx.adjacency_edges(friend, Direction.OUT, ("knows",), {seed.id}))  # expect: JG209
+    return hits
+
+
+def one_hop_is_fine(tx, vertices):
+    # single-level per-vertex enumeration (the export shape): no nested
+    # adjacency read, not flagged
+    out = []
+    for v in vertices:
+        for e in tx.get_edges(v, Direction.OUT, ()):
+            out.append(e)
+    return out
+
+
+def batched_is_fine(tx, vertices):
+    # the engine's own path: ONE multiquery prefetch batch, then the
+    # per-vertex reads hit the warmed row cache
+    tx.prefetch(vertices, Direction.OUT, ("knows",))
+    out = []
+    for v in vertices:
+        out.extend(tx.get_edges(v, Direction.OUT, ("knows",)))
+    return out
