@@ -298,6 +298,36 @@ def supervise() -> int:
 # worker (the actual benchmark; this half imports jax)
 # --------------------------------------------------------------------------
 
+#: prior-artifact index for the regression sentinel (built lazily once):
+#: BENCH_BASELINE_DIR overrides where prior artifacts are searched;
+#: BENCH_REGRESSION=0 disables the compare step entirely
+_BASELINE_INDEX = []
+
+
+def _regression_sentinel(obj: dict) -> None:
+    """Attach the `regression` verdict block to one emitted stage: deltas
+    vs the best prior artifact for the same (stage, scale, platform,
+    host-fallback) cell, or a no-op note when no prior cell matches
+    (observability/benchdiff.py — `janusgraph_tpu benchdiff` is the same
+    comparison as a CI gate)."""
+    if os.environ.get("BENCH_REGRESSION", "1") == "0":
+        return
+    from janusgraph_tpu.observability.benchdiff import BaselineIndex
+
+    if not _BASELINE_INDEX:
+        root = os.path.dirname(os.path.abspath(__file__))
+        dirs = [
+            d for d in os.environ.get(
+                "BENCH_BASELINE_DIR",
+                os.pathsep.join(
+                    [root, os.path.join(root, "bench_artifacts")]
+                ),
+            ).split(os.pathsep) if d
+        ]
+        _BASELINE_INDEX.append(BaselineIndex(dirs))
+    _BASELINE_INDEX[0].attach_regression(obj)
+
+
 def _emit(obj: dict) -> None:
     # every stage line carries the flight-recorder per-category counts at
     # emit time plus the stage's root trace id (stages run under a
@@ -311,6 +341,11 @@ def _emit(obj: dict) -> None:
         if span is not None:
             obj.setdefault("trace_id", f"{span.trace_id:016x}")
     except Exception:  # noqa: BLE001 - telemetry must never break the bench
+        pass
+    try:
+        if "stage" in obj and "regression" not in obj:
+            _regression_sentinel(obj)
+    except Exception:  # noqa: BLE001 - the sentinel must never break the bench
         pass
     print(json.dumps(obj))
     sys.stdout.flush()
@@ -1562,6 +1597,14 @@ def _saturate_stage(t0):
     ctl.limiter.threshold = float(
         os.environ.get("SATURATE_AIMD_THRESHOLD", "2.0")
     )
+    # the observability plane rides the ramp: a 1 s sampling cadence puts
+    # several history windows inside each level, the SLO engine evaluates
+    # per window, and the sampler's measured self-overhead
+    # (observability.history.overhead_ms) becomes an acceptance number
+    from janusgraph_tpu.observability import history, slo_engine
+
+    history.reset()
+    history.configure(interval_s=1.0)
     server = JanusGraphServer(
         manager=manager, admission=ctl, request_timeout_s=30.0,
     ).start()
@@ -1692,7 +1735,36 @@ def _saturate_stage(t0):
     ]
     from janusgraph_tpu.storage.pipeline import pipeline_health_block
 
-    pipe_block = pipeline_health_block(registry.snapshot())
+    snap = registry.snapshot()
+    pipe_block = pipeline_health_block(snap)
+    # history-sampler self-overhead acceptance: the TOTAL wall the
+    # sampler spent across the ramp must stay under 1% of the TOTAL
+    # request wall the replica served in the same span — observability
+    # whose cost is a visible fraction of the serving work has no place
+    # on a serving replica (ISSUE 13 acceptance)
+    sample_t = snap.get("observability.history.sample", {})
+    req_t = snap.get("server.request.wall", {})
+    total_sample_ms = float(sample_t.get("total_ms", 0.0) or 0.0)
+    total_req_ms = float(req_t.get("total_ms", 0.0) or 0.0)
+    overhead_ratio = (
+        total_sample_ms / total_req_ms if total_req_ms > 0 else 0.0
+    )
+    history_block = {
+        "samples": int(sample_t.get("count", 0) or 0),
+        "windows_retained": len(history.windows()),
+        "mean_sample_ms": round(
+            float(sample_t.get("mean_ms", 0.0) or 0.0), 4
+        ),
+        "total_sample_ms": round(total_sample_ms, 3),
+        "last_overhead_ms": float(
+            snap.get("observability.history.overhead_ms", {})
+            .get("value", 0.0)
+        ),
+        "total_request_ms": round(total_req_ms, 1),
+        "overhead_over_request_wall": round(overhead_ratio, 6),
+        "ok": bool(overhead_ratio < 0.01),
+    }
+    slo_block = slo_engine.snapshot()
     report = {
         "stage": "saturate",
         "store_latency_us": store_lat_us,
@@ -1707,6 +1779,8 @@ def _saturate_stage(t0):
             "queue_bound": int(os.environ.get("SATURATE_QUEUE", "8")),
         },
         "pipeline": pipe_block,
+        "history": history_block,
+        "slo": slo_block,
         "levels": per_level,
         "peak_goodput_per_s": peak["goodput_per_s"],
         "peak_offered_concurrency": peak["offered_concurrency"],
@@ -1722,6 +1796,7 @@ def _saturate_stage(t0):
             ratio >= 0.9
             and sheds_missing_retry_after == 0
             and hung_total == 0
+            and history_block["ok"]
         ),
     }
     with open(out_path + ".tmp", "w") as f:

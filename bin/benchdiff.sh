@@ -1,0 +1,21 @@
+#!/usr/bin/env bash
+# benchdiff wrapper: the bench regression sentinel as a CI gate.
+# Compares two bench artifacts cell-by-cell (stage, scale, platform,
+# host-fallback) and exits nonzero on any regressed headline metric.
+#
+# Usage:
+#   bin/benchdiff.sh OLD.json NEW.json              # report only
+#   bin/benchdiff.sh OLD.json NEW.json --fail-on-regress   # CI gate
+#   bin/benchdiff.sh OLD.jsonl NEW.jsonl --threshold 15    # 15% noise band
+#
+# Accepts every artifact shape the bench has written: single stage
+# dicts (SATURATE_r*.json), supervisor wrappers with embedded stage
+# lines (BENCH_r*.json), and per-stage JSONL (bench_artifacts/*.jsonl).
+# Exit codes: 0 ok / 1 regression (with --fail-on-regress) /
+# 2 bad arguments / 3 no comparable cells.
+set -euo pipefail
+
+REPO_ROOT="$(cd "$(dirname "${BASH_SOURCE[0]}")/.." && pwd)"
+cd "$REPO_ROOT"
+
+exec python -m janusgraph_tpu benchdiff "$@"

@@ -63,6 +63,13 @@ RULES: Dict[str, Rule] = {
              "per COMPILE with trace-time values, and cost harvesting "
              "re-enters tracing — accrue/observe/harvest from host code "
              "after the dispatch)"),
+        Rule("JG110", SEV_ERROR,
+             "metric/series name built from non-literal parts (f-string "
+             "interpolation or + concatenation): the registry never "
+             "evicts, so an unbounded value domain in a metric name is "
+             "unbounded memory and exposition growth — use literal "
+             "names, or carry a justified suppression naming the bound "
+             "(e.g. digests from the top-K-evicted price book)"),
         # -- lock discipline ------------------------------------------------
         Rule("JG201", SEV_ERROR,
              "lock.acquire() without with/try-finally release on all paths"),
@@ -326,6 +333,7 @@ class Analyzer:
         from janusgraph_tpu.analysis import (
             checkpoint_rules,
             lock_rules,
+            metric_rules,
             robustness_rules,
             shape_rules,
             trace_rules,
@@ -348,6 +356,7 @@ class Analyzer:
             findings.extend(lock_rules.check_module(mod, lock_graph))
             findings.extend(robustness_rules.check_module(mod))
             findings.extend(checkpoint_rules.check_module(mod))
+            findings.extend(metric_rules.check_module(mod))
         findings.extend(lock_graph.order_findings())
 
         out = []

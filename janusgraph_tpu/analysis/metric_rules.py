@@ -1,0 +1,91 @@
+"""JG110 — metric/series names built from non-literal parts.
+
+The telemetry registry (observability/metrics_core.py) never evicts: a
+metric name, once created, lives for the process. A name built with an
+f-string interpolation or ``+`` concatenation over a NON-LITERAL part
+(``f"query.{digest}"``, ``"latency." + user_key``) therefore turns any
+unbounded value domain into unbounded registry growth — memory that
+never comes back, ``/metrics`` exposition that grows without bound, and
+a history ring (observability/timeseries.py) whose every window pays for
+every name ever seen. This is the classic label-cardinality explosion,
+enforced at the construction site.
+
+Bounded derived names are legitimate and carry a justified
+``# graphlint: disable=JG110 -- why`` suppression: query digests (the
+top-K-evicted price book bounds them — metrics.digest-top-k), breaker /
+store / fault-kind / shed-reason names (small declared sets), per-
+connection indices (bounded by the pool size). The suppression's WHY
+must name the bound.
+
+Flagged: calls to ``counter`` / ``timer`` / ``histogram`` / ``gauge`` /
+``set_gauge`` whose name argument is an f-string containing a
+non-constant interpolation, or a ``+`` concatenation with a non-constant
+operand (recursively). A name passed through a bare variable is NOT
+flagged — the rule targets the construction idiom the issue names, and
+taint-tracking every string variable would drown the signal in noise.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List
+
+from janusgraph_tpu.analysis.core import RULES, Finding
+
+#: registry accessor methods whose FIRST argument is a metric name
+_METRIC_METHODS = {"counter", "timer", "histogram", "gauge", "set_gauge"}
+
+
+def _dynamic_name_expr(node) -> bool:
+    """True when this expression BUILDS a string from non-literal parts:
+    an f-string with a real interpolation, or a ``+`` chain with any
+    non-constant operand."""
+    if isinstance(node, ast.JoinedStr):
+        return any(
+            isinstance(v, ast.FormattedValue)
+            and not isinstance(v.value, ast.Constant)
+            for v in node.values
+        )
+    if isinstance(node, ast.BinOp) and isinstance(node.op, ast.Add):
+        return _nonliteral_part(node.left) or _nonliteral_part(node.right)
+    return False
+
+
+def _nonliteral_part(node) -> bool:
+    """A ``+`` operand that is not (recursively) constant-string."""
+    if isinstance(node, ast.Constant):
+        return False
+    if isinstance(node, ast.JoinedStr):
+        return _dynamic_name_expr(node)
+    if isinstance(node, ast.BinOp) and isinstance(node.op, ast.Add):
+        return _nonliteral_part(node.left) or _nonliteral_part(node.right)
+    return True
+
+
+def check_module(mod) -> List[Finding]:
+    findings: List[Finding] = []
+    for node in ast.walk(mod.tree):
+        if not isinstance(node, ast.Call) or not node.args:
+            continue
+        if not isinstance(node.func, ast.Attribute):
+            continue
+        if node.func.attr not in _METRIC_METHODS:
+            continue
+        # receiver-agnostic on purpose: the method-name set is specific
+        # enough, and registry handles travel under many local names
+        name_arg = node.args[0]
+        if _dynamic_name_expr(name_arg):
+            # anchor at the CALL, so a suppression comment directly above
+            # the call line covers multi-line argument layouts too
+            findings.append(Finding(
+                "JG110", RULES["JG110"].severity, mod.path,
+                node.lineno, node.col_offset,
+                f"metric name passed to .{node.func.attr}() is built "
+                "from non-literal parts (f-string interpolation or + "
+                "concatenation): the registry never evicts, so an "
+                "unbounded value domain here is unbounded memory and "
+                "exposition growth — use a literal name, or suppress "
+                "with the bound that makes the label set finite "
+                "(e.g. the top-K-evicted digest table)",
+            ))
+    return findings

@@ -15,6 +15,7 @@ from __future__ import annotations
 import argparse
 import code
 import json
+import os
 import sys
 from typing import Optional
 
@@ -83,6 +84,9 @@ def cmd_server(args) -> int:
         admission_enabled=graph.config.get("server.admission.enabled"),
         default_deadline_ms=graph.config.get("server.deadline.default-ms"),
         max_deadline_ms=graph.config.get("server.deadline.max-ms"),
+        history_enabled=graph.config.get("metrics.history-enabled"),
+        slo_enabled=graph.config.get("metrics.slo-enabled"),
+        slo_specs=_slo_specs_from_config(graph.config),
     ).start()
     print(f"JanusGraph-TPU server listening on {args.host}:{server.port}")
     try:
@@ -95,6 +99,24 @@ def cmd_server(args) -> int:
         server.stop()
         graph.close()
     return 0
+
+
+def _slo_specs_from_config(cfg):
+    """The stock SLO spec set sized from the metrics.slo-* keys."""
+    from janusgraph_tpu.observability.slo import default_specs
+
+    return default_specs(
+        availability_objective=cfg.get("metrics.slo-availability-objective"),
+        latency_objective=cfg.get("metrics.slo-latency-objective"),
+        latency_threshold_ms=cfg.get("metrics.slo-latency-threshold-ms"),
+        freshness_max_staleness=cfg.get(
+            "metrics.slo-freshness-max-staleness"
+        ),
+        fast_windows=cfg.get("metrics.slo-fast-windows"),
+        slow_windows=cfg.get("metrics.slo-slow-windows"),
+        page_burn=cfg.get("metrics.slo-page-burn"),
+        ticket_burn=cfg.get("metrics.slo-ticket-burn"),
+    )
 
 
 def cmd_console(args) -> int:
@@ -343,6 +365,105 @@ def cmd_flame(args) -> int:
         print(f"trace {trace_id} not retained", file=sys.stderr)
         return 1
     print(text)
+    return 0
+
+
+def cmd_timeseries(args) -> int:
+    """Query the metrics history ring: per-window counter/timer deltas
+    with window percentiles. Local process ring by default, a running
+    server's GET /timeseries with --url; --export writes the retained
+    windows as JSONL for offline analysis."""
+    if args.url:
+        import urllib.parse
+        import urllib.request
+
+        base = args.url.rstrip("/")
+        if not base.startswith("http"):
+            base = "http://" + base
+        qs = urllib.parse.urlencode(
+            {"name": args.name, "window": args.window}
+        )
+        with urllib.request.urlopen(
+            base + "/timeseries?" + qs, timeout=10
+        ) as resp:
+            payload = json.loads(resp.read().decode("utf-8"))
+    else:
+        from janusgraph_tpu.observability import history
+
+        if args.export:
+            n = history.export_jsonl(args.export, last=args.window)
+            print(f"exported {n} windows -> {args.export}", file=sys.stderr)
+        payload = history.query(name=args.name, window=args.window)
+    print(json.dumps(payload, indent=2, default=str))
+    return 0
+
+
+def cmd_timeline(args) -> int:
+    """Render one retained OLAP run to Chrome-trace (catapult) JSON —
+    load the output in chrome://tracing or ui.perfetto.dev to see
+    exchange/compute/checkpoint overlap per superstep per shard. Local
+    run records by default, a server's GET /profile/timeline with
+    --url."""
+    if args.url:
+        import urllib.error
+        import urllib.request
+
+        base = args.url.rstrip("/")
+        if not base.startswith("http"):
+            base = "http://" + base
+        try:
+            with urllib.request.urlopen(
+                base + f"/profile/timeline?run={args.run}", timeout=10
+            ) as resp:
+                doc = json.loads(resp.read().decode("utf-8"))
+        except urllib.error.HTTPError as e:
+            print(f"server: {e}", file=sys.stderr)
+            return 1
+    else:
+        from janusgraph_tpu.observability import registry, render_run
+
+        doc = render_run(registry, run=args.run)
+        if doc is None:
+            print(f"no retained OLAP run at index {args.run}",
+                  file=sys.stderr)
+            return 1
+    text = json.dumps(doc, indent=None if args.out else 2)
+    if args.out:
+        with open(args.out, "w") as f:
+            f.write(text)
+        print(f"wrote {args.out}", file=sys.stderr)
+    else:
+        print(text)
+    return 0
+
+
+def cmd_benchdiff(args) -> int:
+    """Compare two bench artifacts cell-by-cell (stage, scale, platform,
+    host-fallback): per-metric deltas with improve/regress/noise
+    verdicts. With --fail-on-regress, exit non-zero when any cell
+    regressed — the CI gate (bin/benchdiff.sh wraps this)."""
+    from janusgraph_tpu.observability.benchdiff import diff_artifacts
+
+    for p in (args.old, args.new):
+        if not os.path.isfile(p):
+            print(f"no such artifact: {p}", file=sys.stderr)
+            return 2
+    report = diff_artifacts(
+        args.old, args.new, threshold=args.threshold / 100.0
+    )
+    print(json.dumps(report, indent=None if args.compact else 2))
+    if report["cells_compared"] == 0:
+        print("benchdiff: no comparable cells (stage/scale/platform "
+              "mismatch?)", file=sys.stderr)
+        return 3
+    if args.fail_on_regress and report["regressed"]:
+        regressed = [
+            c["cell"] for c in report["comparisons"]
+            if c["verdict"] == "regress"
+        ]
+        print(f"benchdiff: REGRESSION in cells {regressed}",
+              file=sys.stderr)
+        return 1
     return 0
 
 
@@ -599,6 +720,53 @@ def main(argv=None) -> int:
         "this process's tracer",
     )
     pfl.set_defaults(fn=cmd_flame)
+
+    pts = sub.add_parser(
+        "timeseries",
+        help="query the metrics history (per-window deltas/percentiles)",
+    )
+    pts.add_argument(
+        "--url", help="read a running server's /timeseries instead of "
+        "this process's history ring",
+    )
+    pts.add_argument("--name", default="",
+                     help="metric-name prefix filter")
+    pts.add_argument("--window", type=int, default=0,
+                     help="last N windows only (0 = all retained)")
+    pts.add_argument("--export",
+                     help="also write retained windows to this JSONL file")
+    pts.set_defaults(fn=cmd_timeseries)
+
+    ptl = sub.add_parser(
+        "timeline",
+        help="render one OLAP run to Chrome-trace (catapult) JSON",
+    )
+    ptl.add_argument(
+        "--url", help="read a running server's /profile/timeline instead "
+        "of this process's run records",
+    )
+    ptl.add_argument("--run", type=int, default=-1,
+                     help="run record index (negative = from the end)")
+    ptl.add_argument("--out", help="write the trace JSON to this file")
+    ptl.set_defaults(fn=cmd_timeline)
+
+    pbd = sub.add_parser(
+        "benchdiff",
+        help="compare two bench artifacts (improve/regress/noise verdicts)",
+    )
+    pbd.add_argument("old", help="prior artifact (JSON or JSONL)")
+    pbd.add_argument("new", help="new artifact (JSON or JSONL)")
+    pbd.add_argument(
+        "--threshold", type=float, default=10.0,
+        help="relative noise threshold in percent (default 10)",
+    )
+    pbd.add_argument(
+        "--fail-on-regress", action="store_true",
+        help="exit 1 when any cell regressed (the CI gate)",
+    )
+    pbd.add_argument("--compact", action="store_true",
+                     help="one-line JSON report")
+    pbd.set_defaults(fn=cmd_benchdiff)
 
     pch = sub.add_parser(
         "chaos",

@@ -1180,6 +1180,81 @@ METRICS_NS.option(
     "auto-detect from the device kind)", 0.0,
     Mutability.LOCAL, lambda v: v >= 0,
 )
+# ---- time-series history + SLO/burn-rate engine -------------------------
+METRICS_NS.option(
+    "history-enabled", bool,
+    "retain a bounded in-process ring of periodic registry snapshots "
+    "(counter/timer deltas per window, window percentiles; "
+    "observability/timeseries.py — served at GET /timeseries and "
+    "`janusgraph_tpu timeseries`; the query server owns the sampling "
+    "thread)", True, Mutability.LOCAL,
+)
+METRICS_NS.option(
+    "history-interval-s", float,
+    "seconds between history samples (one ring window per sample)",
+    5.0, Mutability.LOCAL, lambda v: v > 0,
+)
+METRICS_NS.option(
+    "history-retention", int,
+    "history windows retained (retention wall = this x "
+    "history-interval-s; default 360 x 5 s = 30 min)",
+    360, Mutability.LOCAL, lambda v: v > 0,
+)
+METRICS_NS.option(
+    "slo-enabled", bool,
+    "evaluate the declarative SLO specs with multi-window burn-rate "
+    "alerting over the metrics history (observability/slo.py; alerts "
+    "become flight slo_burn events, observability.slo.* gauges, and the "
+    "/healthz slo block — a page-severity burn reports degraded)",
+    True, Mutability.LOCAL,
+)
+METRICS_NS.option(
+    "slo-availability-objective", float,
+    "availability SLO: target non-shed fraction of arriving requests "
+    "(good/bad from the admission counters)", 0.999,
+    Mutability.LOCAL, lambda v: 0 < v < 1,
+)
+METRICS_NS.option(
+    "slo-latency-objective", float,
+    "latency SLO: target fraction of requests under their class "
+    "threshold", 0.99, Mutability.LOCAL, lambda v: 0 < v < 1,
+)
+METRICS_NS.option(
+    "slo-latency-threshold-ms", float,
+    "latency SLO floor threshold; per-digest classes are additionally "
+    "priced at 4x their measured mean cost from the admission price "
+    "book, never below this floor", 250.0,
+    Mutability.LOCAL, lambda v: v > 0,
+)
+METRICS_NS.option(
+    "slo-freshness-max-staleness", float,
+    "OLAP freshness SLO: committed writes the spillover CSR snapshot "
+    "may trail before freshness burns at page rate "
+    "(olap.spillover.staleness gauge)", 10_000.0,
+    Mutability.LOCAL, lambda v: v > 0,
+)
+METRICS_NS.option(
+    "slo-fast-windows", int,
+    "history windows in the fast burn-rate window (reaction time)",
+    3, Mutability.LOCAL, lambda v: v > 0,
+)
+METRICS_NS.option(
+    "slo-slow-windows", int,
+    "history windows in the slow burn-rate window (blip veto); alerts "
+    "require BOTH windows past the threshold", 36,
+    Mutability.LOCAL, lambda v: v > 0,
+)
+METRICS_NS.option(
+    "slo-page-burn", float,
+    "burn rate at which an SLO pages (error budget spent at this "
+    "multiple of the sustainable rate; 14.4 = a 30-day budget in 2 "
+    "days)", 14.4, Mutability.LOCAL, lambda v: v > 0,
+)
+METRICS_NS.option(
+    "slo-ticket-burn", float,
+    "burn rate at which an SLO opens a ticket-severity alert", 6.0,
+    Mutability.LOCAL, lambda v: v > 0,
+)
 METRICS_NS.option(
     "structured-logging", bool,
     "emit one-line JSON log records (with ambient trace_id/span_id) to "

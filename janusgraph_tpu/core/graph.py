@@ -388,6 +388,16 @@ class JanusGraphTPU:
             capacity=cfg.get("metrics.flight-buffer"),
             dump_dir=cfg.get("metrics.flight-dump-dir"),
         )
+        # time-series history sizing (observability/timeseries.py): the
+        # ring is configured here; the SAMPLING thread belongs to the
+        # query server (JanusGraphServer.start), so embedded analytics
+        # use pays nothing unless it starts sampling itself
+        from janusgraph_tpu.observability import history as _history
+
+        _history.configure(
+            capacity=cfg.get("metrics.history-retention"),
+            interval_s=cfg.get("metrics.history-interval-s"),
+        )
         # profiler sizing: digest-table capacity + roofline peak overrides
         # (observability/profiler.py; GET /profile serves the table)
         from janusgraph_tpu.observability import profiler as _profiler

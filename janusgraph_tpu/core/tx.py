@@ -64,6 +64,7 @@ class Transaction:
 
             # bare <group>.<op>: the periodic reporters prepend
             # metrics.prefix to EVERY name, same as store metrics
+            # graphlint: disable=JG110 -- group is the caller-declared tx metrics group, op a fixed verb set (begin/commit/rollback): both bounded
             self._metric = lambda op: _mm.counter(
                 f"{metrics_group}.{op}"
             ).inc()

@@ -57,11 +57,24 @@ from janusgraph_tpu.observability.profiler import (
     flame_lines,
     ledger_scope,
 )
+from janusgraph_tpu.observability.slo import (
+    SLOEngine,
+    SLOSpec,
+    slo_engine,
+)
 from janusgraph_tpu.observability.spans import (
     Span,
     TraceContext,
     Tracer,
     tracer,
+)
+from janusgraph_tpu.observability.timeline import (
+    chrome_trace,
+    render_run,
+)
+from janusgraph_tpu.observability.timeseries import (
+    MetricsHistory,
+    history,
 )
 
 #: process-wide registry (reference: MetricManager.INSTANCE);
@@ -96,7 +109,10 @@ __all__ = [
     "FlightRecorder",
     "Gauge",
     "Histogram",
+    "MetricsHistory",
     "ResourceLedger",
+    "SLOEngine",
+    "SLOSpec",
     "Span",
     "StructuredLogger",
     "TelemetryRegistry",
@@ -105,15 +121,19 @@ __all__ = [
     "Tracer",
     "accrue",
     "accrue_wall",
+    "chrome_trace",
     "current_ledger",
     "digest_table",
     "flame_lines",
     "flight_recorder",
     "get_logger",
+    "history",
     "json_snapshot",
     "ledger_scope",
     "prometheus_text",
     "registry",
+    "render_run",
+    "slo_engine",
     "span",
     "tracer",
 ]

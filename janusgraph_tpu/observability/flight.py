@@ -50,6 +50,12 @@ Event taxonomy (the ``category`` field):
                     overflow, staleness breach, brownout refusal, count
                     overflow, or an internal error — fallback keeps the
                     query correct, the event keeps it visible)
+``slo_burn``        the SLO engine's burn-rate alert ladder transitioned
+                    (observability/slo.py; fields: ``slo``/``kind``/
+                    ``severity`` ok|ticket|page, ``direction`` enter/exit,
+                    ``fast_burn``/``slow_burn``/``objective``) — a
+                    page-severity burn also flips /healthz to degraded,
+                    which dumps this ring via the existing edge trigger
 ==================  =======================================================
 
 Dump triggers: an unhandled server error, the /healthz ok->degraded flip,

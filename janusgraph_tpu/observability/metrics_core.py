@@ -98,13 +98,19 @@ class Histogram:
             if value > self.max:
                 self.max = value
 
-    def percentile(self, q: float) -> float:
-        """Upper bucket bound at quantile ``q`` in [0, 1] (0.0 if empty).
-        Log-bucket resolution: the answer is exact to within 2x."""
+    def state(self) -> Tuple[int, float, float, List[int]]:
+        """ONE-lock consistent read: ``(count, total, max, bucket counts)``
+        with ``sum(counts) == count`` guaranteed. Every reader below (and
+        the history sampler's window deltas) goes through here — a reader
+        taking count and buckets under SEPARATE lock acquisitions can see
+        a torn window when an observe lands in between."""
         with self._lock:
-            total = self.count
-            counts = list(self._counts)
-            hi = self.max
+            return self.count, self.total, self.max, list(self._counts)
+
+    @staticmethod
+    def percentile_of(counts: List[int], q: float, hi: float) -> float:
+        """Quantile over one (possibly windowed) bucket-count vector."""
+        total = sum(counts)
         if total == 0:
             return 0.0
         rank = max(1, int(q * total + 0.5))
@@ -115,12 +121,17 @@ class Histogram:
                 return BUCKET_BOUNDS[i] if i < _NUM_BUCKETS else hi
         return hi
 
+    def percentile(self, q: float) -> float:
+        """Upper bucket bound at quantile ``q`` in [0, 1] (0.0 if empty).
+        Log-bucket resolution: the answer is exact to within 2x."""
+        _count, _total, hi, counts = self.state()
+        return self.percentile_of(counts, q, hi)
+
     def cumulative_buckets(self) -> List[Tuple[float, int]]:
         """``[(le_bound, cumulative_count)]`` for the finite buckets that
         carry data (plus every bound below the max observed bucket that
         contributes to the cumulative shape), for exposition."""
-        with self._lock:
-            counts = list(self._counts)
+        _count, _total, _hi, counts = self.state()
         out: List[Tuple[float, int]] = []
         cum = 0
         for i in range(_NUM_BUCKETS):
@@ -130,13 +141,17 @@ class Histogram:
         return out
 
     def summary(self) -> Dict[str, float]:
+        # one consistent state read feeds every field: count, sum, and the
+        # percentiles all describe the SAME point in time even while other
+        # threads keep observing
+        count, total, hi, counts = self.state()
         return {
-            "count": self.count,
-            "sum": self.total,
-            "max": self.max,
-            "p50": self.percentile(0.50),
-            "p95": self.percentile(0.95),
-            "p99": self.percentile(0.99),
+            "count": count,
+            "sum": total,
+            "max": hi,
+            "p50": self.percentile_of(counts, 0.50, hi),
+            "p95": self.percentile_of(counts, 0.95, hi),
+            "p99": self.percentile_of(counts, 0.99, hi),
         }
 
 
@@ -266,16 +281,18 @@ class TelemetryRegistry:
             if name in counters:
                 out[name] = {"type": "counter", "count": counters[name].count}
             elif name in timers:
-                t = timers[name]
+                # one state() read per timer: count/total/percentiles stay
+                # mutually consistent under concurrent updates
+                count, total, hi, counts = timers[name].state()
                 out[name] = {
                     "type": "timer",
-                    "count": t.count,
-                    "total_ms": t.total / 1e6,
-                    "mean_ms": t.mean_ms,
-                    "max_ms": t.max / 1e6,
-                    "p50_ms": t.percentile_ms(0.50),
-                    "p95_ms": t.percentile_ms(0.95),
-                    "p99_ms": t.percentile_ms(0.99),
+                    "count": count,
+                    "total_ms": total / 1e6,
+                    "mean_ms": (total / count) / 1e6 if count else 0.0,
+                    "max_ms": hi / 1e6,
+                    "p50_ms": Histogram.percentile_of(counts, 0.50, hi) / 1e6,
+                    "p95_ms": Histogram.percentile_of(counts, 0.95, hi) / 1e6,
+                    "p99_ms": Histogram.percentile_of(counts, 0.99, hi) / 1e6,
                 }
             elif name in histograms:
                 h = histograms[name]

@@ -252,6 +252,7 @@ class CPUExecutor:
                 steps_done % checkpoint_every == 0
                 or steps_done == program.max_iterations
             ):
+                _ck0 = _time.perf_counter()
                 if shard_checkpoint_dir:
                     from janusgraph_tpu.olap.sharded_checkpoint import (
                         save_sharded_checkpoint,
@@ -275,6 +276,11 @@ class CPUExecutor:
                         memory.values,
                         steps_done,
                     )
+                # timeline marker (observability/timeline.py): the save's
+                # wall, stamped on the superstep that paid it
+                records[-1]["checkpoint_ms"] = round(
+                    (_time.perf_counter() - _ck0) * 1000.0, 3
+                )
             if program.terminate(memory):
                 break
         self._publish_run(program, records)

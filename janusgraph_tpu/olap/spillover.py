@@ -384,6 +384,7 @@ class SpilloverPlanner:
         with _PROMOTED_LOCK:
             _PROMOTED_GLOBAL.add(digest)
         registry.counter("olap.spillover.promotions").inc()
+        # graphlint: disable=JG110 -- digest is bounded by the top-K-evicted price book (metrics.digest-top-k) that feeds promotion
         registry.set_gauge(f"olap.spillover.promoted.{digest}", 1.0)
         registry.set_gauge(
             "olap.spillover.promoted_digests", float(len(self._promoted))
@@ -415,8 +416,15 @@ class SpilloverPlanner:
             self._csr, self._epoch = load_csr_snapshot(self.graph)
             self._tpu_ex = None
             registry.counter("olap.spillover.packs").inc()
+            registry.set_gauge("olap.spillover.staleness", 0.0)
             return self._csr
         now = backend.mutation_epoch()
+        # the freshness signal the SLO engine samples over time: how many
+        # committed writes the cached snapshot currently trails (0 =
+        # fresh; ROADMAP #4's delta-CSR will track the same number)
+        registry.set_gauge(
+            "olap.spillover.staleness", float(now - self._epoch)
+        )
         if now != self._epoch:
             writes = now - self._epoch
             if writes > self.max_staleness:
@@ -433,6 +441,7 @@ class SpilloverPlanner:
             )
             self._tpu_ex = None
             registry.counter("olap.spillover.refreshes").inc()
+            registry.set_gauge("olap.spillover.staleness", 0.0)
         return self._csr
 
     # ------------------------------------------------------------ execution
@@ -530,6 +539,7 @@ class SpilloverPlanner:
         if stats is not None:
             stats["spilled"] += 1
         registry.counter("olap.spillover.spilled").inc()
+        # graphlint: disable=JG110 -- digest is bounded by the top-K-evicted price book (metrics.digest-top-k) that feeds promotion
         registry.counter(f"olap.spillover.spilled.{plan.digest}").inc()
         block = {
             "digest": plan.digest,
@@ -736,6 +746,7 @@ class SpilloverPlanner:
 
         registry.counter("olap.spillover.fallback").inc()
         head = reason.split(":", 1)[0]
+        # graphlint: disable=JG110 -- head is the fixed refusal-reason vocabulary (unsupported/overlay/stale/brownout/overflow/error)
         registry.counter(f"olap.spillover.fallback.{head}").inc()
         with self._lock:
             stats = self._promoted.get(digest)

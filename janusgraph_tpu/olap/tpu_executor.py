@@ -1092,6 +1092,7 @@ class TPUExecutor:
             from janusgraph_tpu.exceptions import SuperstepPreempted
 
             resumes = 0
+            resume_steps = []
             while True:
                 try:
                     if use_frontier:
@@ -1118,6 +1119,10 @@ class TPUExecutor:
                     # exact saved arrays, so the final state is identical
                     resumes += 1
                     resume = True
+                    resume_steps.append({
+                        "attempt": resumes,
+                        "at_s": round(time.perf_counter() - t0, 4),
+                    })
                     registry.counter("olap.resumes").inc()
                     from janusgraph_tpu.observability import flight_recorder
 
@@ -1127,6 +1132,7 @@ class TPUExecutor:
                     )
             if resumes:
                 self.last_run_info["resumes"] = resumes
+                self.last_run_info["resume_steps"] = resume_steps
                 sp.annotate(resumes=resumes)
             self._finish_run(
                 sp, program, out,
@@ -1569,12 +1575,19 @@ class TPUExecutor:
             if checkpoint_path and checkpoint_every:
                 from janusgraph_tpu.olap.checkpoint import save_checkpoint
 
+                ck0 = time.perf_counter()
                 save_checkpoint(
                     checkpoint_path,
                     {k: np.asarray(v) for k, v in state.items()},
                     {k: np.asarray(v) for k, v in mem.items()},
                     steps_done,
                 )
+                if records:
+                    # timeline marker: the save's wall, stamped on the
+                    # superstep that paid it (observability/timeline.py)
+                    records[-1]["checkpoint_ms"] = round(
+                        (time.perf_counter() - ck0) * 1000.0, 3
+                    )
             if terminated:
                 break
         self.last_run_info = {
@@ -1672,11 +1685,16 @@ class TPUExecutor:
                 ):
                     from janusgraph_tpu.olap.checkpoint import save_checkpoint
 
+                    ck0 = time.perf_counter()
                     save_checkpoint(
                         checkpoint_path,
                         {k: np.asarray(v) for k, v in state.items()},
                         memory.values,
                         steps_done,
+                    )
+                    # timeline marker (observability/timeline.py)
+                    records[-1]["checkpoint_ms"] = round(
+                        (time.perf_counter() - ck0) * 1000.0, 3
                     )
                 if program.terminate(memory):
                     break

@@ -1368,8 +1368,10 @@ class ShardedExecutor:
             self._straggler_events.extend(events)
 
     def _save_ck(
-        self, checkpoint_path, shard_dir, state_host, mem_values, steps
+        self, checkpoint_path, shard_dir, state_host, mem_values, steps,
+        records=None,
     ) -> None:
+        ck0 = time.perf_counter()
         if shard_dir:
             from janusgraph_tpu.olap.sharded_checkpoint import (
                 save_sharded_checkpoint,
@@ -1383,6 +1385,12 @@ class ShardedExecutor:
 
             save_checkpoint(checkpoint_path, state_host, mem_values, steps)
         self._ck_saves += 1
+        if records:
+            # timeline marker (observability/timeline.py): the save's
+            # wall, stamped on the superstep that paid it
+            records[-1]["checkpoint_ms"] = round(
+                (time.perf_counter() - ck0) * 1000.0, 3
+            )
 
     def _load_ck(self, checkpoint_path, shard_dir):
         if shard_dir:
@@ -2026,6 +2034,7 @@ class ShardedExecutor:
                         },
                         memory.values,
                         steps_done,
+                        records=records,
                     )
                 if program.terminate(memory):
                     break
@@ -2144,6 +2153,7 @@ class ShardedExecutor:
                     },
                     {k: float(np.asarray(v)) for k, v in mem.items()},
                     steps_done,
+                    records=records,
                 )
             if terminated:
                 break

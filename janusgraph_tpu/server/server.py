@@ -119,11 +119,22 @@ def healthz_snapshot() -> dict:
     queued requests, brownout rung, and shed/admit/timeout counters. A
     shed user request is a 503 whose body says ``"status": "shed"``; THIS
     endpoint's 503 says ``"status": "degraded"`` — and /healthz (with
-    /metrics, /telemetry, /flight, /profile) BYPASSES admission entirely,
-    because a saturated server you cannot observe is the classic
-    outage-amplifier.
+    /metrics, /telemetry, /flight, /profile, /timeseries) BYPASSES
+    admission entirely, because a saturated server you cannot observe is
+    the classic outage-amplifier.
+
+    The ``slo`` block is the burn-rate engine's verdict
+    (observability/slo.py): per-spec severity and fast/slow burn over
+    the metrics history. A PAGE-severity burn makes this endpoint report
+    degraded — which rides the existing ok->degraded flight-dump edge
+    trigger, so the event ring is on disk the moment an SLO starts
+    burning at page rate.
     """
-    from janusgraph_tpu.observability import flight_recorder, registry
+    from janusgraph_tpu.observability import (
+        flight_recorder,
+        registry,
+        slo_engine,
+    )
     from janusgraph_tpu.server import admission as _admission
 
     snap = registry.snapshot()
@@ -133,7 +144,10 @@ def healthz_snapshot() -> dict:
         if name.startswith("breaker.") and name.endswith(".state")
         and m["type"] == "gauge"
     }
-    degraded = any(v != 0.0 for v in breakers.values())
+    slo_block = slo_engine.snapshot()
+    degraded = any(v != 0.0 for v in breakers.values()) or bool(
+        slo_block["paging"]
+    )
     counters = {
         name: m["count"]
         for name, m in snap.items()
@@ -192,6 +206,7 @@ def healthz_snapshot() -> dict:
         flight_recorder.record(
             "health", transition="ok->degraded",
             breakers={k: v for k, v in breakers.items() if v != 0.0},
+            slo_paging=slo_block["paging"],
         )
         flight_recorder.dump(reason="healthz-degraded")
     # the remote wire-protocol clients' pipelined-framing state: per
@@ -237,6 +252,7 @@ def healthz_snapshot() -> dict:
         "counters": counters,
         "sharded": sharded,
         "admission": admission_block,
+        "slo": slo_block,
         "spillover": spillover_block,
         "pipeline": pipeline_health_block(snap),
         "flight": flight_recorder.health_block(),
@@ -274,6 +290,9 @@ class JanusGraphServer:
         default_deadline_ms: float = 0.0,
         max_deadline_ms: float = 600_000.0,
         ws_workers: int = 4,
+        history_enabled: bool = True,
+        slo_enabled: bool = True,
+        slo_specs=None,
     ):
         self.manager = manager or JanusGraphManager.get_instance()
         self.default_graph = default_graph
@@ -303,6 +322,12 @@ class JanusGraphServer:
 
             admission = AdmissionController()
         self.admission = admission
+        #: metrics.history-enabled — this server owns the sampler thread
+        self.history_enabled = history_enabled
+        #: metrics.slo-* — burn-rate engine evaluated per history window
+        self.slo_enabled = slo_enabled
+        self.slo_specs = slo_specs
+        self._history_started = False
         self._httpd: Optional[ThreadingHTTPServer] = None
         self._thread: Optional[threading.Thread] = None
 
@@ -369,6 +394,28 @@ class JanusGraphServer:
                     self.admission.price_book,
                     _prof.load_price_book(path).get("server"),
                 )
+        # the observability plane's history sampler: one daemon thread on
+        # the server's side of the house (never on a request path), plus
+        # the SLO engine evaluated after each window lands. The engine
+        # prices per-digest latency thresholds from THIS server's
+        # admission price book.
+        from janusgraph_tpu.observability import history, slo_engine
+
+        if self.slo_enabled:
+            from janusgraph_tpu.observability.slo import default_specs
+
+            slo_engine.specs = list(
+                self.slo_specs if self.slo_specs is not None
+                else default_specs()
+            )
+            slo_engine.price_book_fn = (
+                (lambda: self.admission.price_book)
+                if self.admission is not None else None
+            )
+            slo_engine.install()
+        if self.history_enabled and not history.running:
+            history.start()
+            self._history_started = True
         return self
 
     def _price_book_path(self) -> str:
@@ -380,6 +427,13 @@ class JanusGraphServer:
         return getattr(g, "_price_book_path", "") or ""
 
     def stop(self) -> None:
+        from janusgraph_tpu.observability import history, slo_engine
+
+        if self.slo_enabled:
+            slo_engine.uninstall()
+        if self._history_started:
+            history.stop()
+            self._history_started = False
         if self._httpd is not None:
             self._httpd.shutdown()
             self._httpd.server_close()
@@ -645,12 +699,29 @@ class _Handler(BaseHTTPRequestHandler):
                     payload["status"]["ledger"] = resources
             finally:
                 wall_ms = (_time.perf_counter() - t0) * 1000.0
+                from janusgraph_tpu.observability import registry
+
+                # the latency SLO's signal: every request wall lands in
+                # the aggregate timer, and — when the shape is priced —
+                # in its digest-class timer, each class held to a
+                # book-priced threshold (observability/slo.py). Digest
+                # labels are bounded by the top-K-evicted price book.
+                registry.timer("server.request.wall").update(
+                    int(wall_ms * 1e6)
+                )
                 if ctl is not None:
                     if ticket is not None:
                         ctl.release(ticket, wall_ms)
                     # feed the measured cost back into the price book so
                     # the NEXT request of this shape is priced by data
                     ctl.observe_cost(digest, query, wall_ms, cells=cells)
+                    if digest and (
+                        ctl.price_book.mean_cost_ms(digest) is not None
+                    ):
+                        # graphlint: disable=JG110 -- digest is the bounded, top-K-evicted price-book label (metrics.digest-top-k)
+                        registry.timer(
+                            "server.request.digest." + digest
+                        ).update(int(wall_ms * 1e6))
         return payload
 
     def _execute_request(self, req, query, graph, session, sp) -> dict:
@@ -751,6 +822,53 @@ class _Handler(BaseHTTPRequestHandler):
             self.send_header("Content-Length", str(len(body)))
             self.end_headers()
             self.wfile.write(body)
+            return
+        if self.path == "/timeseries" or self.path.startswith("/timeseries?"):
+            # the metrics history ring: per-window counter/timer deltas
+            # with window percentiles (observability/timeseries.py).
+            # ?name= prefix-filters series, ?window=N bounds to the last
+            # N windows. Unauthenticated like /metrics — same content
+            # class, just with a time axis. Bypasses admission (above).
+            from urllib.parse import parse_qs, urlsplit
+
+            from janusgraph_tpu.observability import history
+
+            qs = parse_qs(urlsplit(self.path).query)
+            name = (qs.get("name") or [""])[0]
+            try:
+                window = int((qs.get("window") or ["0"])[0])
+            except ValueError:
+                self._send_json(400, {"status": {
+                    "code": 400, "message": "window must be an integer",
+                }})
+                return
+            self._send_json(200, history.query(name=name, window=window))
+            return
+        if self.path.startswith("/profile/timeline"):
+            # one OLAP run rendered to Chrome-trace (catapult) JSON —
+            # loads unmodified in chrome://tracing / ui.perfetto.dev.
+            # ?run= indexes the retained run records (negative = from
+            # the end; default -1 = the last run).
+            from urllib.parse import parse_qs, urlsplit
+
+            from janusgraph_tpu.observability import registry, render_run
+
+            qs = parse_qs(urlsplit(self.path).query)
+            try:
+                run = int((qs.get("run") or ["-1"])[0])
+            except ValueError:
+                self._send_json(400, {"status": {
+                    "code": 400, "message": "run must be an integer",
+                }})
+                return
+            doc = render_run(registry, run=run)
+            if doc is None:
+                self._send_json(404, {"status": {
+                    "code": 404,
+                    "message": f"no retained OLAP run at index {run}",
+                }})
+                return
+            self._send_json(200, doc)
             return
         if self.path == "/flight" or self.path.startswith("/flight?"):
             # black-box flight recorder: the bounded event ring, counts,

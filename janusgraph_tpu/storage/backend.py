@@ -477,6 +477,7 @@ class BackendTransaction:
                     for store_name, rows in self._mutations.items():
                         # '.rows' suffix: distinct from the per-call 'mutate'
                         # timer namespace of MetricInstrumentedStore
+                        # graphlint: disable=JG110 -- store names are the fixed schema-declared store set (edgestore/indexstore/system)
                         _m.counter(f"storage.{store_name}.mutate.rows").inc(
                             len(rows)
                         )

@@ -333,6 +333,7 @@ class PipelinedConnection:
     def _counter(self, name: str):
         c = self._metric_cache.get(name)
         if c is None:
+            # graphlint: disable=JG110 -- prefix is one of two protocol literals and name a fixed counter vocabulary: bounded
             c = _registry().counter(
                 f"{self.metric_prefix}.pipeline.{name}"
             )
@@ -342,6 +343,7 @@ class PipelinedConnection:
     def _gauge(self, name: str):
         g = self._metric_cache.get(name)
         if g is None:
+            # graphlint: disable=JG110 -- conn index is bounded by storage.remote.connection-pool-size; prefix/name are fixed sets
             g = _registry().gauge(
                 f"{self.metric_prefix}.pipeline.conn{self.index}.{name}"
             )

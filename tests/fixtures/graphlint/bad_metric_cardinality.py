@@ -1,0 +1,59 @@
+"""JG110 fixture: metric names built from non-literal parts.
+
+The registry never evicts a metric name, so a name derived from an
+unbounded value domain (vertex ids, raw query text, user input) grows
+the registry, the /metrics exposition, and every history window without
+bound — the label-cardinality explosion, caught at the construction
+site.
+"""
+
+from janusgraph_tpu.observability import registry
+
+
+def per_query_counter_bad(query_text):
+    # raw query text: unbounded domain -> unbounded metric names
+    registry.counter(f"query.{query_text}.count").inc()  # expect: JG110
+
+
+def per_vertex_gauge_bad(vertex_id, rank):
+    registry.set_gauge(f"rank.{vertex_id}", rank)  # expect: JG110
+
+
+def concat_name_bad(user, ms):
+    registry.timer("request.user." + user).update(ms)  # expect: JG110
+
+
+def concat_chain_bad(prefix, shard):
+    registry.histogram(prefix + ".shard." + shard).observe(1.0)  # expect: JG110
+
+
+def nested_fstring_concat_bad(key):
+    registry.gauge("cache." + f"{key}.hits").set(1.0)  # expect: JG110
+
+
+def literal_name_good():
+    # a literal name is always fine
+    registry.counter("tx.commit").inc()
+
+
+def literal_fstring_good():
+    # an f-string WITHOUT interpolation builds nothing dynamic
+    registry.counter(f"tx.commit").inc()  # noqa: F541
+
+
+def constant_concat_good():
+    # adjacent constants concatenated are still one literal domain
+    registry.counter("server." + "admission.shed").inc()
+
+
+def variable_passthrough_good(name):
+    # a bare variable is not flagged: the rule targets the construction
+    # idiom, and registry plumbing passes names through legitimately
+    registry.counter(name).inc()
+
+
+def bounded_digest_suppressed_good(digest):
+    # the justified case: digests are bounded by the top-K-evicted price
+    # book (metrics.digest-top-k), so the label set is finite
+    # graphlint: disable=JG110 -- digest is the bounded, top-K-evicted price-book label
+    registry.timer(f"server.request.digest.{digest}").update(1000)

@@ -351,7 +351,7 @@ def test_healthz_and_observability_bypass_admission_while_shedding(
         assert shed_payload["status"]["retry_after_s"] > 0
         # ... while every observability endpoint still answers
         for path in ("/healthz", "/metrics", "/telemetry", "/flight",
-                     "/profile"):
+                     "/profile", "/timeseries"):
             try:
                 resp = urllib.request.urlopen(base + path, timeout=5)
                 code = resp.getcode()
@@ -370,6 +370,15 @@ def test_healthz_and_observability_bypass_admission_while_shedding(
         assert hz["admission"]["limit"] == 1
         assert hz["admission"]["shed"] >= 1
         assert hz["admission"]["queue_bound"] == 0
+        # the slo block (ISSUE 13) rides alongside the admission block
+        # even mid-shed: the burn-rate engine's verdict is part of the
+        # same self-describing health surface
+        assert "slo" in hz
+        assert hz["slo"]["specs"] >= 1
+        assert hz["slo"]["worst"] in ("ok", "ticket", "page")
+        for alert in hz["slo"]["alerts"]:
+            assert {"name", "kind", "severity", "fast_burn",
+                    "slow_burn"} <= set(alert)
         t.join(timeout=10)
     finally:
         server.stop()

@@ -176,6 +176,69 @@ def test_concurrent_counters_histograms_spans():
         assert r.end_ns >= r.start_ns
 
 
+def test_history_sampler_never_sees_torn_windows_under_hammer():
+    """ISSUE 13 satellite: the history sampler racing N observer threads
+    must take a CONSISTENT point-in-time view per metric — every sampled
+    window satisfies sum(bucket deltas) == count delta and the deltas
+    reconcile exactly against the final totals. Before the one-lock
+    `Histogram.state()` read, a sampler could catch a histogram between
+    its bucket increment and its count increment (a torn window)."""
+    from janusgraph_tpu.observability.timeseries import MetricsHistory
+
+    m = type(metrics)()
+    h = MetricsHistory(m, capacity=4096, interval_s=0.0005)
+    n_threads, iters = 8, 2000
+    errors = []
+    stop = threading.Event()
+
+    def observe(tid):
+        try:
+            for i in range(iters):
+                m.counter("hammer.count").inc()
+                m.timer("hammer.timer").update(1000 + (i % 7) * 1_000_000)
+                m.histogram("hammer.hist").observe(float(i % 100))
+        except Exception as e:  # surfaced after join
+            errors.append(e)
+
+    def sample():
+        try:
+            while not stop.is_set():
+                h.sample()
+        except Exception as e:
+            errors.append(e)
+
+    threads = [
+        threading.Thread(target=observe, args=(t,))
+        for t in range(n_threads)
+    ] + [threading.Thread(target=sample)]
+    for t in threads:
+        t.start()
+    for t in threads[:-1]:
+        t.join()
+    stop.set()
+    threads[-1].join()
+    h.sample()  # the closing window catches the tail
+    assert errors == []
+    total = n_threads * iters
+    for name in ("hammer.timer", "hammer.hist"):
+        win_count = 0
+        for w in h.windows():
+            s = w["series"].get(name)
+            if s is None:
+                continue
+            # THE torn-window assertion: every window is internally
+            # consistent, however the sampler raced the observers
+            assert sum(s["buckets"]) == s["count"], (name, w["seq"])
+            assert all(b >= 0 for b in s["buckets"]), (name, w["seq"])
+            assert s["sum"] >= 0
+            win_count += s["count"]
+        # and the windows partition the run exactly: no loss, no double
+        assert win_count == total, name
+    assert sum(
+        w["counters"].get("hammer.count", 0) for w in h.windows()
+    ) == total
+
+
 # --------------------------------------------------------------- exposition
 def _populate(m):
     m.counter("tx.commit").inc(4)
@@ -396,6 +459,28 @@ def test_telemetry_endpoint_json(server, olap_graph):
     ]
     assert submit_spans
     assert "slow_ops" in payload
+
+
+def test_timeseries_endpoint_scrape(server, olap_graph):
+    """ISSUE 13 satellite: /timeseries serves the history ring alongside
+    the point-in-time endpoints, and /metrics stays schema-valid with
+    the sampler's own gauges in the registry."""
+    from janusgraph_tpu.observability import history
+
+    metrics.counter("scrape.ts").inc(5)
+    history.sample()
+    base = f"http://127.0.0.1:{server.port}"
+    with urllib.request.urlopen(base + "/timeseries?name=scrape.") as resp:
+        assert resp.status == 200
+        payload = json.loads(resp.read().decode())
+    assert payload["series"]["scrape.ts"][-1]["delta"] == 5
+    assert payload["interval_s"] > 0 and payload["windows"] >= 1
+    # the sampler's self-overhead gauge rides the normal exposition and
+    # the whole /metrics payload still validates
+    with urllib.request.urlopen(base + "/metrics") as resp:
+        text = resp.read().decode()
+    assert validate_prometheus_text(text) is None, text
+    assert "janusgraph_observability_history_overhead_ms" in text
 
 
 # ------------------------------------------------------------------- CLI
