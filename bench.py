@@ -1126,6 +1126,24 @@ def worker() -> None:
                 "error": f"{type(e).__name__}: {e}"[:500],
             })
 
+    # streaming-freshness stage (ISSUE 14, optional: BENCH_STREAM=1):
+    # sustained write bursts vs a rolling PageRank — delta refresh vs
+    # full repack A/B with in-stage bitwise assertions and the staleness
+    # window per round; artifact bench_artifacts/r11_stream_*.jsonl
+    if os.environ.get("BENCH_STREAM", "0") == "1":
+        try:
+            with _stage_span("streaming_freshness"):
+                _streaming_freshness_stage(t0)
+        except Exception as e:
+            _hb(
+                f"streaming_freshness stage FAILED "
+                f"{type(e).__name__}: {e}", t0,
+            )
+            _emit({
+                "stage": "streaming_freshness", "ok": False,
+                "error": f"{type(e).__name__}: {e}"[:500],
+            })
+
     # chaos stage (ISSUE 3, optional: BENCH_CHAOS=1): seeded fault
     # injection over an OLTP workload with a torn commit + recovery,
     # recording recovered-op counts and recovery latency so BENCH_*.json
@@ -2200,6 +2218,217 @@ def _oltp_spillover_stage(t0):
     _hb(
         f"oltp_spillover: 3-hop {three['speedup']}x "
         f"(>=3x: {line['accept_3x']})", t0,
+    )
+
+
+def _streaming_freshness_stage(t0):
+    """Streaming freshness A/B (ISSUE 14 acceptance): sustained write
+    bursts against a store-backed graph while a rolling PageRank keeps
+    running over the snapshot. Per round: commit a bounded burst
+    (<= 1% of edges), refresh the snapshot via the delta capture
+    (zero store reads, olap/delta.materialize) AND via a full
+    scan+repack (load_csr_snapshot), and assert the two are
+    array-for-array identical — which makes every superstep over the
+    refreshed arrays bitwise-identical to the repacked CSR by
+    construction (additionally asserted by running PageRank on both).
+    Round 1 also runs a FUSED cell: the overlay consumed superstep-side
+    (base pack untouched), CC bitwise vs repack per the MIN contract.
+    Reports refresh-vs-repack latency, write throughput, and the
+    staleness window per round; acceptance: refresh >= 10x faster than
+    the repack at <= 1% churn."""
+    import statistics as _stats
+
+    import numpy as np
+
+    from janusgraph_tpu.core.bulk import bulk_add_edges, bulk_add_vertices
+    from janusgraph_tpu.core.graph import open_graph
+    from janusgraph_tpu.olap import delta as _delta
+    from janusgraph_tpu.olap.csr import load_csr_snapshot
+    from janusgraph_tpu.olap.programs import PageRankProgram
+    from janusgraph_tpu.olap.programs.connected_components import (
+        ConnectedComponentsProgram,
+    )
+    from janusgraph_tpu.olap.tpu_executor import TPUExecutor
+    from janusgraph_tpu.observability import registry
+
+    scale = int(os.environ.get("BENCH_STREAM_SCALE", "20"))
+    edge_cap = int(os.environ.get("BENCH_STREAM_EDGES", "2000000"))
+    rounds = int(os.environ.get("BENCH_STREAM_ROUNDS", "4"))
+    burst_frac = float(os.environ.get("BENCH_STREAM_BURST", "0.005"))
+    pr_iters = int(os.environ.get("BENCH_STREAM_PR_ITERS", "5"))
+
+    base_csr = _cached_rmat_csr(scale, 16, t0)
+    n = base_csr.num_vertices
+    src = np.repeat(
+        np.arange(n), np.diff(base_csr.out_indptr)
+    )[:edge_cap]
+    dst = np.asarray(base_csr.out_dst[:edge_cap], dtype=np.int64)
+    g = open_graph({
+        "storage.backend": "inmemory",
+        "computer.delta-capture-limit": 1 << 20,
+    })
+    b0 = time.perf_counter()
+    vids = bulk_add_vertices(g, n)
+    bulk_add_edges(g, "link", vids[src], vids[dst])
+    build_s = time.perf_counter() - b0
+    _hb(
+        f"streaming_freshness: seeded s{scale} store graph "
+        f"({n} v, {len(src)} e) in {build_s:.1f}s", t0,
+    )
+
+    p0 = time.perf_counter()
+    csr, epoch = load_csr_snapshot(g)
+    pack0_s = time.perf_counter() - p0
+    _hb(f"streaming_freshness: initial pack {pack0_s:.2f}s", t0)
+
+    rng = np.random.default_rng(14)
+    burst = max(1, int(burst_frac * len(src)))
+    ts = time.strftime("%Y%m%d-%H%M%S")
+    art_dir = os.path.join(_REPO_DIR, "bench_artifacts")
+    os.makedirs(art_dir, exist_ok=True)
+    art_path = os.path.join(art_dir, f"r11_stream_{ts}.jsonl")
+    cells = []
+    with open(art_path, "a") as art:
+        for rnd in range(rounds):
+            # -- bounded write burst (bulk columnar adds; the capture
+            # decodes each committed batch vectorized)
+            w0 = time.perf_counter()
+            bs = rng.integers(0, n, burst)
+            bd = rng.integers(0, n, burst)
+            bulk_add_edges(g, "link", vids[bs], vids[bd])
+            write_s = time.perf_counter() - w0
+            burst_epoch_t = time.perf_counter()
+
+            # -- A: O(delta) refresh from the capture, zero store reads
+            r0 = time.perf_counter()
+            got = _delta.overlay_since(g, epoch)
+            assert got is not None, "capture overflowed mid-bench"
+            ov, upto = got
+            view = _delta.OverlayView(csr, ov, max_lane_cells=1 << 22)
+            refreshed = _delta.materialize(csr, ov, idm=g.idm)
+            refresh_ms = (time.perf_counter() - r0) * 1e3
+            staleness_ms = (time.perf_counter() - burst_epoch_t) * 1e3
+            depth = ov.size
+            registry.set_gauge("olap.delta.overlay_depth", float(depth))
+
+            # -- B: the full scan + repack the delta path replaces
+            k0 = time.perf_counter()
+            repack, repack_epoch = load_csr_snapshot(g)
+            repack_ms = (time.perf_counter() - k0) * 1e3
+
+            # refreshed arrays must BE the repacked arrays — then every
+            # superstep over them is bitwise-identical by construction
+            arrays_identical = all(
+                np.array_equal(getattr(refreshed, f), getattr(repack, f))
+                for f in (
+                    "vertex_ids", "out_indptr", "out_dst",
+                    "in_indptr", "in_src",
+                )
+            )
+            assert arrays_identical, "delta refresh diverged from repack"
+            # rolling PageRank over the fresh snapshot, asserted bitwise
+            # against the repacked CSR in-stage
+            pr_f = TPUExecutor(refreshed, strategy="ell").run(
+                PageRankProgram(max_iterations=pr_iters)
+            )
+            pr_r = TPUExecutor(repack, strategy="ell").run(
+                PageRankProgram(max_iterations=pr_iters)
+            )
+            pr_bitwise = bool(
+                np.array_equal(pr_f["rank"], pr_r["rank"])
+            )
+            assert pr_bitwise, "refreshed PageRank diverged from repack"
+
+            fused_cell = None
+            if rnd == 0:
+                # fused cell: the overlay consumed superstep-side over
+                # the UNTOUCHED base pack; MIN family bitwise vs repack
+                f0 = time.perf_counter()
+                cc_f = TPUExecutor(csr, strategy="ell", delta=view).run(
+                    ConnectedComponentsProgram(max_iterations=20)
+                )
+                fused_wall_ms = (time.perf_counter() - f0) * 1e3
+                cc_r = TPUExecutor(repack, strategy="ell").run(
+                    ConnectedComponentsProgram(max_iterations=20),
+                    frontier="off",
+                )
+                fused_cell = {
+                    "cc_bitwise": bool(np.array_equal(
+                        np.asarray(cc_f["component"]),
+                        np.asarray(cc_r["component"]),
+                    )),
+                    "wall_ms": round(fused_wall_ms, 1),
+                    "lane_cells": int(sum(
+                        view.lanes(True)["_meta"][k]
+                        for k in ("acap", "tcap", "lcap")
+                    )),
+                }
+                assert fused_cell["cc_bitwise"], (
+                    "fused CC diverged from repack"
+                )
+
+            csr, epoch = refreshed, upto
+            cell = {
+                "round": rnd,
+                "burst_edges": int(burst),
+                "writes_per_s": round(burst / max(write_s, 1e-9), 1),
+                "overlay_depth": int(depth),
+                "refresh_ms": round(refresh_ms, 2),
+                "repack_ms": round(repack_ms, 2),
+                "speedup": round(repack_ms / max(refresh_ms, 1e-9), 2),
+                "staleness_window_ms": round(staleness_ms, 2),
+                "arrays_identical": arrays_identical,
+                "pagerank_bitwise": pr_bitwise,
+                "fused": fused_cell,
+            }
+            cells.append(cell)
+            art.write(json.dumps({
+                "stage": "streaming_freshness", "scale": scale, **cell,
+            }) + "\n")
+            art.flush()
+            _hb(
+                f"streaming_freshness r{rnd}: refresh "
+                f"{refresh_ms:.0f}ms vs repack {repack_ms:.0f}ms "
+                f"({cell['speedup']}x), {depth} records", t0,
+            )
+    med_refresh = _stats.median(c["refresh_ms"] for c in cells)
+    med_repack = _stats.median(c["repack_ms"] for c in cells)
+    speedup = med_repack / max(med_refresh, 1e-9)
+    line = {
+        "stage": "streaming_freshness",
+        "scale": scale,
+        "vertices": n,
+        "edges": len(src),
+        "burst_fraction": burst_frac,
+        "build_s": round(build_s, 1),
+        "initial_pack_s": round(pack0_s, 2),
+        "cells": cells,
+        "refresh_median_ms": round(med_refresh, 2),
+        "repack_median_ms": round(med_repack, 2),
+        "refresh_speedup": round(speedup, 2),
+        "writes_per_s": round(
+            _stats.median(c["writes_per_s"] for c in cells), 1
+        ),
+        "staleness_window_ms": round(
+            _stats.median(c["staleness_window_ms"] for c in cells), 2
+        ),
+        "delta_counters": {
+            name[len("olap.delta."):]: m.get("count", m.get("value"))
+            for name, m in registry.snapshot().items()
+            if name.startswith("olap.delta.")
+        },
+        "artifact": os.path.relpath(art_path, _REPO_DIR),
+        "accept_10x": bool(
+            speedup >= 10.0
+            and all(c["arrays_identical"] for c in cells)
+            and all(c["pagerank_bitwise"] for c in cells)
+        ),
+    }
+    g.close()
+    _emit(line)
+    _hb(
+        f"streaming_freshness: refresh {speedup:.1f}x faster than "
+        f"repack (>=10x: {line['accept_10x']})", t0,
     )
 
 

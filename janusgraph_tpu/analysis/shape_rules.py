@@ -54,6 +54,17 @@ _HALO_NAME_RE = re.compile(
     re.IGNORECASE,
 )
 
+#: delta-CSR overlay tiers (olap/delta.py): the fused lanes and the
+#: extra-vertex domain pad to pow2 capacity tiers so ONE compiled
+#: superstep executable serves every overlay that fits the tier — a
+#: non-pow2 literal breaks the tier-reuse economics and the static-shape
+#: contract silently. 0 = auto-pick (overlay_tier derives the tier from
+#: the lane size), allowed.
+_DELTA_NAME_RE = re.compile(
+    r"^delta_cap$|_delta_cap$|^overlay_tier$|_overlay_tier$|_delta_bin$",
+    re.IGNORECASE,
+)
+
 #: dense-tier padded feature-dim names. The LOGICAL dim (feature_dim,
 #: hidden_dim, ...) may be any value — only the PADDED tier the kernels
 #: consume must be a lane-width pow2 (0 = auto-pick, allowed).
@@ -104,6 +115,21 @@ def _check_capacity_tiers(mod) -> List[Finding]:
     out: List[Finding] = []
 
     def check(name: str, value_node: ast.AST, where: ast.AST):
+        if _DELTA_NAME_RE.search(name):
+            v = _const_int(value_node)
+            # 0 = auto-pick (overlay_tier sizes from the lane); only an
+            # explicit non-pow2 tier is the bug
+            if v is None or v == 0 or _is_pow2(v):
+                return
+            out.append(_finding(
+                "JG301", mod, where,
+                f"delta-overlay capacity tier `{name}` = {v} is not a "
+                f"power of two — overlay lanes and the extra-vertex "
+                f"domain pad to pow2 tiers so one compiled superstep "
+                f"executable serves every overlay that fits (use 0 to "
+                f"auto-pick via overlay_tier)",
+            ))
+            return
         if _FEATURE_TIER_RE.search(name):
             v = _const_int(value_node)
             # 0 = auto-pick (pick_feature_tier walks the FEATURE_TIERS

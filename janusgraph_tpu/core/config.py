@@ -564,6 +564,53 @@ COMPUTER_NS.option(
     Mutability.MASKABLE, lambda v: v >= 0,
 )
 COMPUTER_NS.option(
+    "delta", bool,
+    "incremental delta-CSR (olap/delta.py): commit-side change capture "
+    "feeds a bounded overlay (edge adds, tombstones, vertex add/remove) "
+    "that GraphComputer.submit() and the spillover snapshot consume "
+    "instead of re-scanning the store — warm submits skip the scan "
+    "entirely, small overlays are consumed FUSED with the base CSR "
+    "inside the superstep, larger ones fold into fresh arrays with zero "
+    "store reads. Off = every snapshot is a full scan + pack", True,
+    Mutability.MASKABLE,
+)
+COMPUTER_NS.option(
+    "delta-capture-limit", int,
+    "change-capture ring size (records); past it the oldest batches "
+    "drop and snapshots older than the drop point fall back to a full "
+    "reload (olap/delta.ChangeCapture)", 1 << 16,
+    Mutability.MASKABLE, lambda v: v >= 0,
+)
+COMPUTER_NS.option(
+    "delta-max-overlay", int,
+    "pending records beyond which a warm submit stops consuming the "
+    "overlay fused and folds it into the base arrays instead (still "
+    "zero store reads; olap/delta.DeltaSnapshot)", 4096,
+    Mutability.MASKABLE, lambda v: v >= 0,
+)
+COMPUTER_NS.option(
+    "delta-max-lane-cells", int,
+    "cap on the fused overlay's total lane cells (add + tombstone + "
+    "dirty-row live lanes) — a tombstoned hub destination makes the "
+    "live lane O(degree); past the cap the overlay materializes "
+    "instead (olap/delta.OverlayView)", 1 << 16,
+    Mutability.MASKABLE, lambda v: v >= 0,
+)
+COMPUTER_NS.option(
+    "delta-compact-threshold", int,
+    "overlay depth (records) at which the warm snapshot folds the "
+    "overlay back into the base pack off the superstep path (0 = let "
+    "olap/autotune.decide_delta price delta-vs-repack per device)", 0,
+    Mutability.MASKABLE, lambda v: v >= 0,
+)
+COMPUTER_NS.option(
+    "delta-snapshot-path", str,
+    "file for persisting the compacted base CSR pack (tmp+rename npz, "
+    "same discipline as checkpoints) so a restarted process warm-starts "
+    "from the pack instead of a cold scan; empty = in-memory only "
+    "(olap/delta.save_snapshot)", "",
+)
+COMPUTER_NS.option(
     "price-book-path", str,
     "file for persisting the digest-table price books (tmp+rename JSON, "
     "same discipline as the autotune record) so spillover promotion and "

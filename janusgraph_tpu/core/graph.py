@@ -423,6 +423,20 @@ class JanusGraphTPU:
                 _profiler.digest_table,
                 _profiler.load_price_book(self._price_book_path).get("oltp"),
             )
+        # delta-CSR change capture (computer.delta; olap/delta.py): every
+        # committed edgestore batch streams into a bounded per-graph ring
+        # so snapshots refresh O(delta) from the records alone — no store
+        # re-reads at all (ROADMAP #4)
+        self.change_capture = None
+        if cfg.get("computer.delta"):
+            from janusgraph_tpu.olap.delta import ChangeCapture
+
+            self.change_capture = ChangeCapture(
+                self, limit=cfg.get("computer.delta-capture-limit")
+            )
+            self.backend.register_change_capture(
+                self.change_capture.on_commit
+            )
         # OLTP->OLAP spillover planner (computer.spillover; olap/
         # spillover.py): promoted hot multi-hop traversal shapes run as
         # frontier supersteps over a cached CSR snapshot

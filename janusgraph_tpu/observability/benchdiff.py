@@ -70,6 +70,12 @@ HEADLINES: Dict[str, List[Tuple[str, str]]] = {
         ("spill_3hop_speedup", HIGHER),
         ("spill_4hop_speedup", HIGHER),
     ],
+    "streaming_freshness": [
+        ("refresh_speedup", HIGHER),
+        ("refresh_median_ms", LOWER),
+        ("staleness_window_ms", LOWER),
+        ("writes_per_s", HIGHER),
+    ],
     "dense_gcn": [
         ("superstep_ms", LOWER),
         ("mxu_utilization", HIGHER),

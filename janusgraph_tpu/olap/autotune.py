@@ -529,6 +529,84 @@ def decide_sharded(
     )
 
 
+@dataclass(frozen=True)
+class DeltaDecision:
+    """Deterministic delta-vs-repack compaction decision for the
+    incremental delta-CSR overlay (olap/delta.py): at what overlay depth
+    does folding the overlay back into the base pack beat carrying the
+    fused lanes through every superstep."""
+
+    compact_threshold: int
+    device_kind: str
+    source: str                      # model | config
+    cells: Dict[str, float] = field(default_factory=dict)
+
+    def as_dict(self) -> dict:
+        return {
+            "compact_threshold": self.compact_threshold,
+            "device_kind": self.device_kind,
+            "source": self.source,
+            "cells": {
+                k: round(v, 9) for k, v in sorted(self.cells.items())
+            },
+        }
+
+
+#: per-record per-superstep cost of the fused delta lanes (one gathered
+#: slot + one segment-scatter element per lane entry; the scatter side is
+#: the binding one — same derating family as _SEGMENT_PENALTY)
+_DELTA_LANE_COST_S = {"cpu": 8e-9, "tpu": 5.5e-8}
+
+#: per-edge cost of the zero-scan materialize (numpy multiset merge +
+#: native CSR rebuild) — measured slope of olap/delta.materialize on this
+#: container (~25 ns/edge at s16-s20); the full scan+decode repack is
+#: ~14x that (r05: 5.6 s at s20/16M edges => ~350 ns/edge)
+_DELTA_MATERIALIZE_COST_S = 2.5e-8
+_REPACK_SCAN_COST_S = 3.5e-7
+
+
+def decide_delta(
+    num_edges: int,
+    num_vertices: int,
+    device_kind: str = "cpu",
+    overrides: Optional[dict] = None,
+    expected_runs: int = 8,
+) -> DeltaDecision:
+    """Pure function of (graph size, device kind, overrides) -> the
+    overlay depth at which compaction amortizes: an overlay of depth d
+    costs ~d lane cells per superstep per run, while folding it costs one
+    O(E) zero-scan materialize. The threshold solves
+    ``expected_runs * supersteps * d * lane_cost >= materialize_cost``
+    and is clamped to a pow2 in [1024, 65536] so the fused lanes' tier
+    ladder stays short. ``overrides={"compact_threshold": n}`` wins
+    (config computer.delta-compact-threshold)."""
+    ov = overrides or {}
+    if ov.get("compact_threshold"):
+        return DeltaDecision(
+            compact_threshold=int(ov["compact_threshold"]),
+            device_kind=device_kind, source="config",
+        )
+    kind = "tpu" if "tpu" in str(device_kind).lower() else "cpu"
+    supersteps = 20.0  # a PageRank-shaped run's typical iteration count
+    lane = _DELTA_LANE_COST_S[kind]
+    mat_s = num_edges * _DELTA_MATERIALIZE_COST_S
+    repack_s = num_edges * _REPACK_SCAN_COST_S
+    d_star = mat_s / max(expected_runs * supersteps * lane, 1e-12)
+    threshold = _next_pow2(int(max(1024, min(d_star, 1 << 16))))
+    threshold = min(threshold, 1 << 16)
+    return DeltaDecision(
+        compact_threshold=threshold,
+        device_kind=device_kind,
+        source="model",
+        cells={
+            "materialize_s": mat_s,
+            "repack_s": repack_s,
+            "lane_cost_per_record_per_step_s": lane,
+            "d_star": d_star,
+        },
+    )
+
+
 def decide_tiers(
     stats: GraphStats,
     overrides: Optional[dict] = None,

@@ -229,7 +229,25 @@ class GraphComputer:
                 or self._edge_labels or self._vertex_labels
             )
             backend = cfg.get("storage.backend") if cfg else None
-            if workers > 1 and plain and backend in ("remote", "local"):
+            # warm delta snapshot (computer.delta; olap/delta.py): plain
+            # snapshots reuse the cached base CSR — a warm submit skips
+            # the store scan entirely; pending writes arrive as an
+            # overlay consumed fused (small) or folded into fresh arrays
+            # with zero store reads (large)
+            delta_snap = delta_view = None
+            if plain and cfg is not None and cfg.get("computer.delta") and (
+                workers <= 1
+            ):
+                from janusgraph_tpu.olap import delta as _delta_mod
+
+                delta_snap = _delta_mod.get_snapshot(self.graph)
+            if delta_snap is not None:
+                csr, delta_view, dinfo = delta_snap.acquire()
+                ls.annotate(
+                    delta_path=dinfo["path"],
+                    overlay=dinfo.get("overlay", 0),
+                )
+            elif workers > 1 and plain and backend in ("remote", "local"):
                 from janusgraph_tpu.olap.distributed_load import (
                     distributed_load_csr,
                 )
@@ -389,6 +407,35 @@ class GraphComputer:
                     "computer.resume-attempts"
                 )
         sp.annotate(program=type(self._program).__name__)
+        # ---- pending-overlay consumption: small overlays ride into the
+        # single-device executor FUSED (base pack untouched, delta lanes
+        # merged in the superstep); anything else — sharded runs, typed-
+        # channel programs, oversized lanes — folds into fresh arrays
+        # first (zero store reads either way)
+        if delta_view is not None:
+            from janusgraph_tpu.olap import delta as _delta_mod
+
+            und = bool(getattr(self._program, "undirected", False))
+            fuse = (
+                executor_kind == "tpu"
+                and _delta_mod.program_delta_compatible(self._program)
+                and csr.in_edge_weight is None
+                and delta_view.lanes(und) is not None
+            )
+            if fuse:
+                run_kwargs["delta"] = delta_view
+                sp.annotate(delta="fused", overlay=delta_view.depth)
+            else:
+                csr = _delta_mod.materialize(
+                    csr, delta_view.overlay,
+                    idm=getattr(self.graph, "idm", None),
+                )
+                if delta_snap is not None and (
+                    delta_view.upto_epoch is not None
+                ):
+                    delta_snap.adopt(csr, delta_view.upto_epoch)
+                sp.annotate(delta="materialized", overlay=delta_view.depth)
+                delta_view = None
         from janusgraph_tpu.observability import registry
 
         try:
@@ -417,6 +464,17 @@ class GraphComputer:
                 registry.record_run("olap.routing", routing)
                 return result
             raise
+        if run_kwargs.get("delta") is not None:
+            # fused-run results cover [base ++ new vertices] with removed
+            # slots inert: compact to the surviving set so value()/
+            # by_vertex()/write_back see exactly the live graph
+            from janusgraph_tpu.olap import delta as _delta_mod
+
+            states, csr = _delta_mod.compact_result(delta_view, states)
+        if delta_snap is not None:
+            # compaction is off the superstep path: fold the overlay into
+            # the base pack AFTER the run when it crossed the threshold
+            delta_snap.maybe_compact()
         routing["executor"] = executor_kind
         registry.record_run("olap.routing", routing)
         run_info = dict(registry.last_run("olap") or {})
@@ -474,6 +532,7 @@ def run_on(
     cpu_strategy: str = "scalar",
     shard_checkpoint_dir: str = None,
     checkpoint_shards: int = 0,
+    delta=None,
 ):
     # dense-feature tier program configuration (computer.features-*):
     # applied here so EVERY executor sees the same padded lane tier and
@@ -487,7 +546,7 @@ def run_on(
     if executor == "cpu":
         from janusgraph_tpu.olap.cpu_executor import CPUExecutor
 
-        return CPUExecutor(csr, strategy=cpu_strategy).run(
+        return CPUExecutor(csr, strategy=cpu_strategy, delta=delta).run(
             program,
             checkpoint_path=checkpoint_path,
             checkpoint_every=checkpoint_every,
@@ -497,6 +556,12 @@ def run_on(
             checkpoint_shards=checkpoint_shards,
         )
     if executor == "sharded":
+        if delta is not None:
+            raise ValueError(
+                "the sharded executor consumes MATERIALIZED delta "
+                "snapshots (route_overlay + per-shard rebuild) — fold "
+                "the overlay with olap/delta.materialize first"
+            )
         from janusgraph_tpu.parallel import ShardedExecutor
 
         return ShardedExecutor(
@@ -518,6 +583,7 @@ def run_on(
 
         return TPUExecutor(
             csr,
+            delta=delta,
             strategy=strategy,
             ell_max_capacity=ell_max_capacity,
             frontier=frontier,

@@ -42,12 +42,34 @@ class CPUExecutor:
     vectorized host path when the scalar loop is too slow. Channel-switching
     supersteps always fall back to scalar delivery."""
 
-    def __init__(self, graph: CSRGraph, strategy: str = "scalar"):
+    def __init__(self, graph: CSRGraph, strategy: str = "scalar", delta=None):
         if strategy not in ("scalar", "ell", "hybrid"):
             raise ValueError(f"unknown cpu strategy: {strategy!r}")
         self.strategy = strategy
         self._packs = {}
         self.graph = graph
+        # delta-CSR overlay: consumed fused exactly like the device
+        # executor (olap/delta.py is xp-generic), so cpu-fused vs
+        # cpu-repacked stays inside the bitwise contract. Pack
+        # strategies only — the scalar loop is the oracle for
+        # MATERIALIZED snapshots instead.
+        self._delta = delta if (delta is not None and delta.depth) else None
+        self._fused_view = None
+        if self._delta is not None:
+            if strategy == "scalar":
+                raise ValueError(
+                    "delta-fused cpu runs require a pack strategy "
+                    "('ell'/'hybrid'); the scalar oracle replays "
+                    "materialized snapshots"
+                )
+            if graph.in_edge_weight is not None:
+                raise ValueError(
+                    "delta-fused runs support unfiltered weightless "
+                    "snapshots only"
+                )
+            from janusgraph_tpu.olap.delta import FusedHostView
+
+            self._fused_view = FusedHostView(self._delta)
         #: per-run execution record, same shape as TPUExecutor's — the
         #: CPU oracle reports the same roofline vocabulary (flops, bytes,
         #: operational intensity, utilization) so cost comparisons read
@@ -132,8 +154,19 @@ class CPUExecutor:
                 "sddmm message mode aggregates over the in-CSR only — "
                 "undirected dense programs are not supported"
             )
-        g = self.graph
-        n = g.num_vertices
+        if self._delta is not None:
+            from janusgraph_tpu.olap.delta import (
+                program_delta_compatible,
+            )
+
+            if not program_delta_compatible(program):
+                raise ValueError(
+                    "delta-fused runs support default-edge-view "
+                    "programs only — materialize the overlay for this "
+                    "program"
+                )
+        g = self.graph if self._delta is None else self._fused_view
+        n = getattr(g, "local_num_vertices", g.num_vertices)
         memory = Memory()
         state = None
         start_step = 0
@@ -182,7 +215,33 @@ class CPUExecutor:
                 # documented identity*0 transform noise the validity
                 # mask then repairs)
                 with np.errstate(invalid="ignore"):
-                    aggregated = self._pack_aggregate(program, op, outgoing)
+                    if self._delta is not None:
+                        from janusgraph_tpu.olap.delta import (
+                            fused_delta_aggregate,
+                        )
+
+                        nb = self.graph.num_vertices
+                        base_agg = self._pack_aggregate(
+                            program, op, outgoing[:nb]
+                        )
+                        lanes = self._delta.lanes(
+                            bool(program.undirected)
+                        )
+                        if lanes is None:
+                            raise ValueError(
+                                "delta overlay lanes exceed "
+                                "computer.delta-max-lane-cells"
+                            )
+                        aggregated = fused_delta_aggregate(
+                            np,
+                            {k: v for k, v in lanes.items()
+                             if not k.startswith("_")},
+                            lanes["_meta"], outgoing, base_agg, op,
+                        )
+                    else:
+                        aggregated = self._pack_aggregate(
+                            program, op, outgoing
+                        )
             vec = outgoing.ndim == 2
             if not use_pack:
                 agg_shape = (n, outgoing.shape[1]) if vec else (n,)
@@ -284,6 +343,12 @@ class CPUExecutor:
             if program.terminate(memory):
                 break
         self._publish_run(program, records)
+        if self._delta is not None:
+            # trim the vcap-tier padding (see TPUExecutor.run)
+            return {
+                k: np.asarray(v)[: self._delta.n_real]
+                for k, v in state.items()
+            }
         return {k: np.asarray(v) for k, v in state.items()}
 
     def _pack(self, undirected: bool):

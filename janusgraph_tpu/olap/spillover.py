@@ -404,9 +404,11 @@ class SpilloverPlanner:
     # ------------------------------------------------------------- snapshot
     def _snapshot(self):
         """The current committed-graph CSR: packed on first use, refreshed
-        through the mutation-epoch tracker while committed writes stay
-        within the staleness bound, dropped for repack beyond it. Call
-        under the lock."""
+        O(delta) from the change capture's records (zero store reads;
+        olap/delta.py) while the pending overlay stays within the
+        staleness bound, dropped for repack beyond it. Without a capture
+        the PR 12 whole-row re-derivation (refresh_csr) remains the
+        fallback. Call under the lock."""
         from janusgraph_tpu.observability import registry
 
         backend = self.graph.backend
@@ -419,29 +421,67 @@ class SpilloverPlanner:
             registry.set_gauge("olap.spillover.staleness", 0.0)
             return self._csr
         now = backend.mutation_epoch()
-        # the freshness signal the SLO engine samples over time: how many
-        # committed writes the cached snapshot currently trails (0 =
-        # fresh; ROADMAP #4's delta-CSR will track the same number)
-        registry.set_gauge(
-            "olap.spillover.staleness", float(now - self._epoch)
-        )
-        if now != self._epoch:
-            writes = now - self._epoch
-            if writes > self.max_staleness:
-                # beyond the bound a full repack beats an incremental
-                # refresh; THIS query falls back, the next attempt repacks
-                registry.counter("olap.spillover.stale").inc()
-                self._csr = None
-                self._tpu_ex = None
-                raise _SpillRefused("stale")
+        if now == self._epoch:
+            registry.set_gauge("olap.spillover.staleness", 0.0)
+            return self._csr
+        # the freshness signal the SLO engine samples over time (the
+        # PR 13 spec reads this gauge unchanged): the DELTA-OVERLAY LAG —
+        # pending captured records when the capture can serve, else
+        # distinct touched rows. Both dedupe repeated touches of one row
+        # per (tx, row) (the tracker's per-row epoch map), so a workload
+        # hammering the same rows no longer inflates staleness one epoch
+        # per commit and forces spurious full repacks near the bound.
+        cap = getattr(self.graph, "change_capture", None)
+        lag = cap.depth_since(self._epoch) if cap is not None else None
+        if lag is None:
+            rows = backend.touched_count_since(self._epoch)
+            lag = rows if rows is not None else (now - self._epoch)
+        registry.set_gauge("olap.spillover.staleness", float(lag))
+        if lag > self.max_staleness:
+            # beyond the bound a full repack beats an incremental
+            # refresh; THIS query falls back, the next attempt repacks
+            registry.counter("olap.spillover.stale").inc()
+            self._csr = None
+            self._tpu_ex = None
+            raise _SpillRefused("stale")
+        if lag == 0:
+            # property-only writes bumped the epoch but changed no
+            # structure; the capture append shares the epoch lock, so a
+            # zero depth at `now` proves nothing is pending
+            self._epoch = now
+            registry.set_gauge("olap.spillover.staleness", 0.0)
+            return self._csr
+        refreshed = None
+        if cap is not None:
+            from janusgraph_tpu.olap import delta as _delta_mod
+
+            got = _delta_mod.overlay_since(self.graph, self._epoch)
+            if got is not None:
+                ov, upto = got
+                registry.set_gauge(
+                    "olap.delta.overlay_depth", float(ov.size)
+                )
+                try:
+                    refreshed = (
+                        _delta_mod.materialize(
+                            self._csr, ov, idm=self.graph.idm,
+                        )
+                        if ov.size else self._csr,
+                        upto if ov.size else now,
+                    )
+                    registry.counter(
+                        "olap.spillover.delta_refreshes"
+                    ).inc()
+                except ValueError:
+                    refreshed = None  # filtered/weighted snapshot
+        if refreshed is None:
             from janusgraph_tpu.olap.csr import refresh_csr
 
-            self._csr, self._epoch = refresh_csr(
-                self.graph, self._csr, self._epoch
-            )
-            self._tpu_ex = None
-            registry.counter("olap.spillover.refreshes").inc()
-            registry.set_gauge("olap.spillover.staleness", 0.0)
+            refreshed = refresh_csr(self.graph, self._csr, self._epoch)
+        self._csr, self._epoch = refreshed
+        self._tpu_ex = None
+        registry.counter("olap.spillover.refreshes").inc()
+        registry.set_gauge("olap.spillover.staleness", 0.0)
         return self._csr
 
     # ------------------------------------------------------------ execution

@@ -69,20 +69,38 @@ class _DeviceGraph:
         ),
     }
 
-    def __init__(self, csr: CSRGraph, jnp):
+    #: view fields a delta-fused run sources from the FUSED host view
+    #: (degrees/activity patched by the overlay) instead of the base CSR
+    _FUSED_FIELDS = frozenset(("active", "out_degree", "in_degree"))
+
+    def __init__(self, csr: CSRGraph, jnp, host_view=None):
         self._csr = csr
         self._jnp = jnp
-        self.num_vertices = csr.num_vertices
-        self.local_num_vertices = csr.num_vertices
+        #: delta-fused host view (olap/delta.FusedHostView) or None: the
+        #: program-facing counts/degrees come from base+overlay while the
+        #: base index arrays stay untouched for the base aggregation
+        self._hv = host_view
+        if host_view is not None:
+            self.num_vertices = host_view.num_vertices
+            self.local_num_vertices = host_view.local_num_vertices
+            self.num_edges = host_view.num_edges
+        else:
+            self.num_vertices = csr.num_vertices
+            self.local_num_vertices = csr.num_vertices
+            self.num_edges = csr.num_edges
         self.global_offset = 0
-        self.num_edges = csr.num_edges
 
     def __getattr__(self, name):
         # only reached when `name` is not an instance attribute yet
         fn = self._LAZY.get(name)
         if fn is None:
             raise AttributeError(name)
-        val = fn(self._csr, self._jnp)
+        if self._hv is not None and name in _DeviceGraph._FUSED_FIELDS:
+            val = self._jnp.asarray(
+                getattr(self._hv, name), dtype=self._jnp.float32
+            )
+        else:
+            val = fn(self._csr, self._jnp)
         setattr(self, name, val)  # cache: next access skips __getattr__
         return val
 
@@ -92,10 +110,13 @@ class _DeviceGraph:
         import jax
 
         csr, np_ = self._csr, np
+        # delta-fused views pad the vertex-shaped fields past the base
+        # rows; local_num_vertices == csr.num_vertices otherwise
+        nv = self.local_num_vertices
         shapes = {
-            "active": ((csr.num_vertices,), np_.float32),
-            "out_degree": ((csr.num_vertices,), np_.float32),
-            "in_degree": ((csr.num_vertices,), np_.float32),
+            "active": ((nv,), np_.float32),
+            "out_degree": ((nv,), np_.float32),
+            "in_degree": ((nv,), np_.float32),
             "in_src": ((csr.num_edges,), csr.in_src.dtype),
             "in_dst_seg": ((csr.num_edges,), np_.int32),
             "out_dst": ((csr.num_edges,), csr.out_dst.dtype),
@@ -226,6 +247,7 @@ class TPUExecutor:
         autotune_max_tiers: int = None,
         autotune_persist: bool = None,
         features_dim_tier: int = None,
+        delta=None,
     ):
         import jax
         import jax.numpy as jnp
@@ -234,7 +256,23 @@ class TPUExecutor:
         self.jnp = jnp
         self.csr = csr
         self.ell_max_capacity = ell_max_capacity  # computer.ell-max-capacity
-        self.g = _DeviceGraph(csr, jnp)
+        # delta-CSR overlay (olap/delta.OverlayView): supersteps consume
+        # the pending write overlay FUSED with the base pack — base
+        # aggregation over the untouched device-resident pack, delta
+        # lanes merged through the same segment-combine contract
+        self._delta = delta if (delta is not None and delta.depth) else None
+        host_view = None
+        if self._delta is not None:
+            if csr.in_edge_weight is not None:
+                raise ValueError(
+                    "delta-fused runs support unfiltered weightless "
+                    "snapshots only (the change capture carries no "
+                    "weight column)"
+                )
+            from janusgraph_tpu.olap.delta import FusedHostView
+
+            host_view = FusedHostView(self._delta)
+        self.g = _DeviceGraph(csr, jnp, host_view=host_view)
         if strategy == "auto" and use_pallas:
             strategy = "pallas"
         if strategy not in ("auto", "ell", "hybrid", "segment", "pallas"):
@@ -625,7 +663,10 @@ class TPUExecutor:
         so the fused path needs no second discovery pass."""
         jnp = self.jnp
         ch_val = program.edge_channels[channel] if channel is not None else None
-        key = (program.cache_key(), op, self._strategy_cfg, ch_val)
+        key = (
+            program.cache_key(), op, self._strategy_cfg, ch_val,
+            self._delta_sig(program),
+        )
         used = self._viewkeys.get(key)
         if used is not None:
             return used
@@ -650,6 +691,10 @@ class TPUExecutor:
             "ell", "hybrid"
         ):
             args["sddmm"] = self._sddmm_rows(strategy, program.undirected)
+        if self._delta is not None:
+            args["delta"] = self._delta.device_args(
+                jnp, bool(program.undirected)
+            )
         if state is None:
             # cold discovery (direct _graph_args call before any run):
             # setup just to learn the state/metric pytree shapes
@@ -729,8 +774,28 @@ class TPUExecutor:
             "ell", "hybrid"
         ):
             args["sddmm"] = self._sddmm_rows(strategy, program.undirected)
+        if self._delta is not None:
+            args["delta"] = self._delta.device_args(
+                self.jnp, bool(program.undirected)
+            )
         self._last_arg_bytes = _pytree_nbytes(args)
         return args
+
+    def _delta_sig(self, program):
+        """Static compile signature of the delta overlay for this
+        program's edge view (part of every compiled-executable key), or
+        None without an overlay. Raises when the overlay's lanes exceed
+        the configured cell budget — the caller should have materialized
+        instead of fusing."""
+        if self._delta is None:
+            return None
+        sig = self._delta.sig(bool(program.undirected))
+        if sig is None:
+            raise ValueError(
+                "delta overlay lanes exceed computer.delta-max-lane-cells"
+                " — materialize the overlay instead of consuming it fused"
+            )
+        return sig
 
     def _resolve_pack(self, program: VertexProgram, op: str, channel: str = None):
         """(strategy, ELLPack-or-None) for one combiner monoid + edge view —
@@ -759,6 +824,15 @@ class TPUExecutor:
         n = self.g.local_num_vertices
         tmpl = self.g
         identity = Combiner.IDENTITY[op]
+        # delta overlay: base aggregation runs over the base rows only
+        # (the pack's sentinel is index n_base); the lanes merge after
+        delta = self._delta
+        nb = self.csr.num_vertices if delta is not None else n
+        dmeta = None
+        if delta is not None:
+            dmeta = dict(
+                delta.lanes(bool(program.undirected))["_meta"]
+            )
         strategy, pack_meta = self._resolve_pack(program, op, channel)
         if strategy == "pallas":
             plans = [("in", self._segsum_plan("in"))]
@@ -775,7 +849,7 @@ class TPUExecutor:
                 jnp, outgoing[src_idx], weight,
                 program.edge_transform, program.edge_transform_cols,
             )
-            return _segment_reduce(jnp, op, msgs, dst_seg, n)
+            return _segment_reduce(jnp, op, msgs, dst_seg, nb)
 
         def pallas_aggregate(outgoing, gv):
             from janusgraph_tpu.olap.kernels import pallas_sorted_segment_sum
@@ -803,7 +877,10 @@ class TPUExecutor:
             gv = _TracedView(tmpl, gargs["view"], self._view_record)
             from janusgraph_tpu.olap.kernels import ell_aggregate
 
-            outgoing = program.message(state, superstep_idx, gv, jnp)
+            full_out = program.message(state, superstep_idx, gv, jnp)
+            # base aggregation consumes the base-row slice: the packs'
+            # sentinel (index n_base) must keep reading the identity
+            outgoing = full_out if delta is None else full_out[:nb]
             mode = getattr(program, "message_mode", None)
             if mode == "sddmm":
                 # dense tier: fused SDDMM+SpMM — per-edge dot-attention
@@ -868,6 +945,17 @@ class TPUExecutor:
                         agg = jnp.minimum(agg, rev)
                     else:
                         agg = jnp.maximum(agg, rev)
+            if delta is not None:
+                # fuse the overlay lanes over the base aggregate (SUM:
+                # add - tombstone subtraction; MIN/MAX: dirty rows
+                # re-aggregated from the live lane) — olap/delta.py
+                from janusgraph_tpu.olap.delta import (
+                    fused_delta_aggregate,
+                )
+
+                agg = fused_delta_aggregate(
+                    jnp, gargs["delta"], dmeta, full_out, agg, op
+                )
             # vertices with no in-edges hold the identity, matching the CPU
             # oracle's "no message received" semantics
             new_state, metrics = program.apply(
@@ -883,7 +971,8 @@ class TPUExecutor:
     def _superstep_fn(self, program: VertexProgram, op: str, channel: str = None):
         """Jitted single superstep (host-loop path)."""
         ch_val = program.edge_channels[channel] if channel is not None else None
-        key = ("step", program.cache_key(), op, self._strategy_cfg, ch_val)
+        key = ("step", program.cache_key(), op, self._strategy_cfg, ch_val,
+               self._delta_sig(program))
         if key not in self._compiled:
             self._compiled[key] = self.jax.jit(
                 self._superstep_body(program, op, channel)
@@ -900,7 +989,8 @@ class TPUExecutor:
         from janusgraph_tpu.observability import profiler
 
         ch_val = program.edge_channels[channel] if channel is not None else None
-        key = ("cost", program.cache_key(), op, self._strategy_cfg, ch_val)
+        key = ("cost", program.cache_key(), op, self._strategy_cfg, ch_val,
+               self._delta_sig(program))
         cost = self._kernel_costs.get(key)
         if cost is not None:
             return cost
@@ -936,7 +1026,8 @@ class TPUExecutor:
         essential when the chip sits behind a high-latency PJRT link, and
         idiomatic XLA regardless (compiler-visible control flow instead of
         a host loop)."""
-        key = ("fused", program.cache_key(), op, self._strategy_cfg)
+        key = ("fused", program.cache_key(), op, self._strategy_cfg, None,
+               self._delta_sig(program))
         if key in self._compiled:
             return self._compiled[key]
 
@@ -1042,6 +1133,21 @@ class TPUExecutor:
             if (checkpoint_path and self._autotune_persist)
             else None
         )
+        if self._delta is not None:
+            from janusgraph_tpu.olap.delta import (
+                program_delta_compatible,
+            )
+
+            if not program_delta_compatible(program):
+                raise ValueError(
+                    "delta-fused runs support default-edge-view programs "
+                    "only (typed edge channels aggregate over their own "
+                    "packs and sddmm row-dsts are base-layout) — "
+                    "materialize the overlay for this program"
+                )
+            # the frontier loop walks the BASE adjacency tiers; with a
+            # pending overlay the dense fused path is the correct one
+            frontier = "off"
         if frontier not in (None, "auto", "off", "always"):
             raise ValueError(f"unknown frontier mode: {frontier!r}")
         mode = frontier or self._frontier_cfg
@@ -1130,6 +1236,19 @@ class TPUExecutor:
                         "olap_resume", executor="tpu", attempt=resumes,
                         program=type(program).__name__,
                     )
+            if self._delta is not None:
+                # trim vcap-tier padding: real rows are the base snapshot
+                # plus the overlay's new vertices (removed slots stay,
+                # inert — repack-aligned comparisons index by vertex id)
+                out = {
+                    k: v[: self._delta.n_real] for k, v in out.items()
+                }
+                self.last_run_info["delta"] = {
+                    "overlay_depth": self._delta.depth,
+                    "n_extra": self._delta.n_extra,
+                    "removed": int(len(self._delta.removed_idx)),
+                    "fused": True,
+                }
             if resumes:
                 self.last_run_info["resumes"] = resumes
                 self.last_run_info["resume_steps"] = resume_steps
@@ -1526,7 +1645,8 @@ class TPUExecutor:
             }
             steps_done = 0
 
-        fused_key = ("fused", program.cache_key(), op, self._strategy_cfg)
+        fused_key = ("fused", program.cache_key(), op, self._strategy_cfg,
+                     None, self._delta_sig(program))
         cold = fused_key not in self._compiled
         fn = self._fused_fn(program, op)
         gargs = self._graph_args(program, op)

@@ -182,6 +182,8 @@ class Backend:
         #: (bounds memory on write-heavy workloads that never refresh)
         self._epoch_track_limit = 1_000_000
         self._overflow_epoch = 0
+        #: delta-CSR change-capture sink (register_change_capture)
+        self._change_capture = None
         # consistent-key lockers over dedicated lock stores (reference:
         # Backend.java:184-213 wraps stores in ExpectedValueCheckingStore)
         from janusgraph_tpu.storage.locking import (
@@ -224,7 +226,7 @@ class Backend:
         return BackendTransaction(self, self.manager.begin_transaction(config))
 
     # -- mutation-epoch tracking (incremental CSR refresh) ------------------
-    def note_edge_mutations(self, keys) -> None:
+    def note_edge_mutations(self, keys, mutations=None) -> None:
         with self._epoch_lock:
             self._epoch += 1
             e = self._epoch
@@ -235,6 +237,11 @@ class Backend:
                 # reset fall back to a full reload
                 self._mutation_epochs.clear()
                 self._overflow_epoch = e
+            # delta-CSR change capture (olap/delta.ChangeCapture): the
+            # committed batch streams to the registered capture under the
+            # epoch lock so batches land in epoch order
+            if self._change_capture is not None and mutations is not None:
+                self._change_capture(e, mutations)
 
     def mutation_epoch(self) -> int:
         """Monotonic counter bumped per committed edgestore batch; snapshot
@@ -250,6 +257,24 @@ class Backend:
             if epoch < self._overflow_epoch:
                 return None
             return [k for k, e in self._mutation_epochs.items() if e > epoch]
+
+    def touched_count_since(self, epoch: int) -> Optional[int]:
+        """DISTINCT rows mutated since `epoch` — the refresh-work measure
+        the staleness bound prices. The per-row epoch map already dedupes
+        repeated touches of one row (within a tx via the mutation buffer,
+        across txs via the epoch overwrite), so a workload hammering the
+        same rows no longer inflates staleness one epoch per commit and
+        forces spurious full repacks near the bound. None = overflow."""
+        with self._epoch_lock:
+            if epoch < self._overflow_epoch:
+                return None
+            return sum(1 for e in self._mutation_epochs.values() if e > epoch)
+
+    def register_change_capture(self, callback) -> None:
+        """Register the delta-CSR change-capture sink: called with
+        (epoch, edgestore row mutations) for every committed batch."""
+        with self._epoch_lock:
+            self._change_capture = callback
 
     # -- global config on system_properties (reference: KCVSConfiguration) --
     def set_global_config(self, name: str, value: bytes) -> None:
@@ -487,10 +512,13 @@ class BackendTransaction:
                             self._mutations, self.store_tx
                         )
                     )
-                # mutation-epoch bump for touched edgestore rows
+                # mutation-epoch bump for touched edgestore rows; the
+                # batch itself streams to the delta-CSR change capture
                 edge_rows = self._mutations.get(EDGESTORE_NAME)
                 if edge_rows:
-                    self.backend.note_edge_mutations(edge_rows.keys())
+                    self.backend.note_edge_mutations(
+                        edge_rows.keys(), edge_rows
+                    )
                 # cache invalidation for mutated rows
                 for store_name, rows in self._mutations.items():
                     store = (

@@ -1,0 +1,23 @@
+"""JG301 fixture: delta-CSR overlay capacity tiers (parse-only).
+
+The incremental delta-CSR overlay (olap/delta.py) pads its add/tombstone/
+live lanes and the extra-vertex domain to pow2 capacity tiers so a single
+compiled superstep executable serves every overlay that fits the tier; a
+non-pow2 literal silently breaks the static-shape contract and the
+tier-reuse economics. 0 means auto-pick (overlay_tier sizes the tier from
+the lane) and is allowed.
+"""
+import numpy as np
+
+
+def build_overlay_lanes(num_records):
+    delta_cap = 100  # expect: JG301
+    add_delta_bin = 3 * 16  # expect: JG301
+    good_delta_cap = 256
+    auto_delta_cap = 0  # auto-pick: allowed
+    lanes = np.zeros((num_records, good_delta_cap), dtype=np.int32)
+    return delta_cap, add_delta_bin, auto_delta_cap, lanes
+
+
+def pad_overlay(records, overlay_tier=48):  # expect: JG301
+    return records[:overlay_tier]
