@@ -1211,6 +1211,24 @@ def worker() -> None:
                 "error": f"{type(e).__name__}: {e}"[:500],
             })
 
+    # fleet chaos stage (ISSUE 15, optional: FLEET=1): closed-loop ramp
+    # against a 3-replica fleet over ONE shared backend, seeded
+    # replica-kill + restart mid-traffic (storage/faults.py fleet kinds),
+    # artifact FLEET_r01.json with per-replica goodput/p99/brownout lanes
+    # and a router-failover-latency headline. Acceptance: goodput >= 0.6x
+    # pre-kill during failover, >= 0.9x after re-convergence, zero hung
+    # connections, zero errors surfaced to well-budgeted callers.
+    if os.environ.get("FLEET", "0") == "1":
+        try:
+            with _stage_span("fleet_chaos"):
+                _fleet_chaos_stage(t0)
+        except Exception as e:
+            _hb(f"fleet stage FAILED {type(e).__name__}: {e}", t0)
+            _emit({
+                "stage": "fleet_chaos", "ok": False,
+                "error": f"{type(e).__name__}: {e}"[:500],
+            })
+
     # pallas kernel evidence (VERDICT r2 #5): compiled run at s16 with
     # parity vs the ell result; failure is recorded, not fatal. The stage
     # runs LAST and under a watchdog: a hung Mosaic compile through the
@@ -1822,6 +1840,292 @@ def _saturate_stage(t0):
     os.replace(out_path + ".tmp", out_path)
     report["artifact"] = out_path
     _emit(report)
+
+
+def _fleet_chaos_stage(t0):
+    """Fleet-level chaos certification (ISSUE 15 acceptance): a 3-replica
+    serving fleet over ONE shared storage backend takes closed-loop
+    traffic through the consistent-hash/least-loaded router while the
+    seeded fault plan kills one replica mid-traffic and restarts it
+    (warm-up from the shard-checkpoint snapshot pack). Per-bucket lanes
+    record fleet and per-replica goodput plus each replica's brownout
+    rung; headlines are the router-failover latency and the
+    during-kill / recovered goodput ratios against the pre-kill level."""
+    import tempfile
+    import threading as _threading
+
+    from janusgraph_tpu.core.graph import JanusGraphTPU
+    from janusgraph_tpu.observability import flight_recorder, registry
+    from janusgraph_tpu.server import (
+        FleetRouter,
+        JanusGraphManager,
+        JanusGraphServer,
+        StateGossip,
+    )
+    from janusgraph_tpu.server.fleet import (
+        NoReplicaAvailable,
+        export_snapshot,
+        warm_replica,
+    )
+    from janusgraph_tpu.storage.faults import FaultPlan
+    from janusgraph_tpu.storage.inmemory import InMemoryStoreManager
+
+    n_replicas = int(os.environ.get("FLEET_REPLICAS", "3"))
+    workers = int(os.environ.get("FLEET_WORKERS", "8"))
+    bucket_s = float(os.environ.get("FLEET_BUCKET_S", "0.5"))
+    n_vertices = int(os.environ.get("FLEET_VERTICES", "256"))
+    kill_at = int(os.environ.get("FLEET_KILL_AT", "6"))
+    restart_at = int(os.environ.get("FLEET_RESTART_AT", "14"))
+    n_buckets = int(os.environ.get("FLEET_BUCKETS", "24"))
+    seed = int(os.environ.get("FLEET_SEED", "42"))
+    out_path = os.environ.get(
+        "FLEET_OUT", os.path.join(_REPO_DIR, "FLEET_r01.json")
+    )
+
+    shared = InMemoryStoreManager()
+    base_cfg = {
+        "ids.authority-wait-ms": 0.0,
+        "locks.wait-ms": 0.0,
+        "computer.delta": True,
+    }
+    graphs = [
+        JanusGraphTPU(dict(base_cfg), store_manager=shared)
+        for _ in range(n_replicas)
+    ]
+    graphs[0].management().make_edge_label("knows")
+    tx = graphs[0].new_transaction()
+    ids = [tx.add_vertex().id for _ in range(n_vertices)]
+    for i in range(n_vertices):
+        tx.add_edge(
+            tx.get_vertex(ids[i]), "knows",
+            tx.get_vertex(ids[(i * 7 + 1) % n_vertices]),
+        )
+    tx.commit()
+
+    flight_recorder.reset()
+    flight_recorder.configure(capacity=8192)
+    plan = FaultPlan(
+        seed=seed, replica_kill_at=kill_at, replica_restart_at=restart_at,
+    )
+    router = FleetRouter(
+        retry_budget_capacity=1e9, retry_budget_refill_per_s=1e9,
+    )
+    servers = {}
+    gossips = {}
+
+    def _start_replica(i, graph, warm_dir=None):
+        if warm_dir:
+            warm_replica(graph, warm_dir)
+        manager = JanusGraphManager()
+        manager.put_graph("graph", graph)
+        server = JanusGraphServer(
+            manager=manager, replica_name=f"r{i}",
+            history_enabled=False, slo_enabled=False,
+            request_timeout_s=30.0,
+        ).start()
+        gossip = StateGossip(f"r{i}", server.admission, timeout_s=2.0)
+        server.gossip = gossip
+        servers[f"r{i}"] = server
+        gossips[f"r{i}"] = gossip
+        if f"r{i}" in router.replicas():
+            router.rejoin_replica(f"r{i}", "127.0.0.1", server.port)
+            router.probe(f"r{i}")
+        else:
+            router.add_replica(f"r{i}", "127.0.0.1", server.port)
+        return server
+
+    for i, graph in enumerate(graphs):
+        _start_replica(i, graph)
+    urls = {
+        name: f"http://127.0.0.1:{s.port}" for name, s in servers.items()
+    }
+    for name, gossip in gossips.items():
+        gossip.set_peers([u for n2, u in urls.items() if n2 != name])
+    router.probe()
+
+    stop = _threading.Event()
+    lock = _threading.Lock()
+    counts = {"ok": 0, "errors": 0}
+    bucket_ok = []  # per-bucket fleet completions
+    errors_detail = []
+
+    def _worker(widx):
+        rng = widx * 131 + 7
+        while not stop.is_set():
+            rng = (rng * 1103515245 + 12345) & 0x7FFFFFFF
+            vid = ids[rng % n_vertices]
+            try:
+                router.submit(
+                    f"g.V({vid}).out('knows').count()",
+                    deadline_ms=10_000, key=str(vid),
+                )
+                with lock:
+                    counts["ok"] += 1
+            except NoReplicaAvailable as e:
+                with lock:
+                    counts["errors"] += 1
+                    if len(errors_detail) < 8:
+                        errors_detail.append(str(e)[:200])
+            except Exception as e:  # noqa: BLE001 - surfaced = failed
+                with lock:
+                    counts["errors"] += 1
+                    if len(errors_detail) < 8:
+                        errors_detail.append(
+                            f"{type(e).__name__}: {e}"[:200]
+                        )
+
+    threads = [
+        _threading.Thread(target=_worker, args=(w,))
+        for w in range(workers)
+    ]
+    for th in threads:
+        th.start()
+
+    target_name = f"r{plan.replica_target(n_replicas)}"
+    kill_bucket = restart_bucket = None
+    lanes = []
+    warm_dir = tempfile.mkdtemp(prefix="fleet_warm_")
+    last_ok = 0
+    try:
+        for b in range(n_buckets):
+            t_b = time.monotonic()
+            # the seeded fleet fault plan decides this tick's events; the
+            # driver executes them (kill = hard stop, the crash path)
+            for event in plan.fleet_hook(n_replicas):
+                victim = f"r{event['replica']}"
+                if event["kind"] == "replica_kill":
+                    kill_bucket = b
+                    survivor = next(
+                        g for i2, g in enumerate(graphs)
+                        if f"r{i2}" != victim
+                    )
+                    # export the warm-up pack from a SURVIVOR before the
+                    # kill lands — the restart path hydrates from it
+                    export_snapshot(survivor, warm_dir, num_shards=2)
+                    servers[victim].stop()
+                    gossips[victim].stop()
+                    _hb(f"fleet: killed {victim} @bucket {b}", t0)
+                elif event["kind"] == "replica_restart":
+                    restart_bucket = b
+                    idx = int(event["replica"])
+                    # a FRESH graph handle over the shared backend — the
+                    # rejoining process — warmed from the checkpoint pack
+                    graph = JanusGraphTPU(
+                        dict(base_cfg), store_manager=shared
+                    )
+                    graphs[idx] = graph
+                    _start_replica(idx, graph, warm_dir=warm_dir)
+                    _hb(f"fleet: restarted {victim} @bucket {b}", t0)
+            router.probe()
+            time.sleep(max(0.0, bucket_s - (time.monotonic() - t_b)))
+            with lock:
+                ok_now = counts["ok"]
+            per_replica = {
+                name: dict(h.stats)
+                for name, h in router.replicas().items()
+            }
+            lanes.append({
+                "bucket": b,
+                "ok": ok_now - last_ok,
+                "goodput_per_s": round((ok_now - last_ok) / bucket_s, 1),
+                "replicas": {
+                    name: {
+                        "ok_total": st["ok"],
+                        "shed_total": st["shed"],
+                        "state": router.replicas()[name].state,
+                        "brownout_rung": (
+                            (router.replicas()[name].health.get(
+                                "admission"
+                            ) or {}).get("brownout_rung")
+                        ),
+                    }
+                    for name, st in per_replica.items()
+                },
+            })
+            last_ok = ok_now
+    finally:
+        stop.set()
+        for th in threads:
+            th.join(timeout=10.0)
+        hung = sum(1 for th in threads if th.is_alive())
+        router.stop()
+        for gossip in gossips.values():
+            gossip.stop()
+        for server in servers.values():
+            try:
+                server.stop()
+            except Exception:  # noqa: BLE001 - already stopped
+                pass
+        for graph in graphs:
+            try:
+                graph.close()
+            except Exception:  # noqa: BLE001 - victim graph may be torn
+                pass
+
+    kb = kill_bucket if kill_bucket is not None else n_buckets // 4
+    rb = restart_bucket if restart_bucket is not None else (
+        3 * n_buckets // 4
+    )
+    pre = [r["goodput_per_s"] for r in lanes[1:kb]] or [0.0]
+    during = [
+        r["goodput_per_s"] for r in lanes[kb: min(kb + 4, len(lanes))]
+    ] or [0.0]
+    post = [r["goodput_per_s"] for r in lanes[rb + 1:]] or [0.0]
+    pre_g = sum(pre) / len(pre)
+    during_g = sum(during) / len(during)
+    post_g = sum(post) / len(post)
+    snap = registry.snapshot()
+    failover_t = snap.get("fleet.router.failover", {})
+    report = {
+        "stage": "fleet_chaos",
+        "scenario": {
+            "replicas": n_replicas, "workers": workers,
+            "bucket_s": bucket_s, "buckets": n_buckets,
+            "seed": seed, "target": target_name,
+            "kill_bucket": kill_bucket, "restart_bucket": restart_bucket,
+        },
+        "fault_journal": plan.journal[:32],
+        "lanes": lanes,
+        "pre_kill_goodput_per_s": round(pre_g, 1),
+        "during_kill_goodput_per_s": round(during_g, 1),
+        "recovered_goodput_per_s": round(post_g, 1),
+        "goodput_during_kill_over_prekill": round(
+            during_g / pre_g if pre_g else 0.0, 4
+        ),
+        "goodput_recovered_over_prekill": round(
+            post_g / pre_g if pre_g else 0.0, 4
+        ),
+        "failover_count": int(failover_t.get("count", 0) or 0),
+        "failover_mean_ms": round(
+            float(failover_t.get("mean_ms", 0.0) or 0.0), 2
+        ),
+        "failover_p99_ms": round(
+            float(failover_t.get("p99_ms", 0.0) or 0.0), 2
+        ),
+        "router_retries": snap.get(
+            "fleet.router.retries", {}
+        ).get("count", 0),
+        "replica_deaths": snap.get(
+            "fleet.router.replica_deaths", {}
+        ).get("count", 0),
+        "warmup_hits": snap.get("fleet.warmup.hits", {}).get("count", 0),
+        "errors_surfaced": counts["errors"],
+        "errors_detail": errors_detail,
+        "hung_connections": hung,
+        "ok": bool(
+            during_g >= 0.6 * pre_g
+            and post_g >= 0.9 * pre_g
+            and counts["errors"] == 0
+            and hung == 0
+        ),
+    }
+    with open(out_path + ".tmp", "w") as f:
+        json.dump(report, f, indent=2)
+    os.replace(out_path + ".tmp", out_path)
+    report["artifact"] = out_path
+    # the lanes are bulky in the heartbeat stream; emit a trimmed line
+    emitted = {k: v for k, v in report.items() if k != "lanes"}
+    _emit(emitted)
 
 
 def _oltp_stage(t0):

@@ -96,6 +96,16 @@ RULES: Dict[str, Rule] = {
              "the pipelined mux (storage/pipeline.py) so fixed per-"
              "message cost amortizes; suppress with justification on "
              "cold paths where N is structurally tiny"),
+        Rule("JG208", SEV_ERROR,
+             "outbound socket/HTTP call without an explicit timeout: "
+             "urlopen / socket.create_connection / HTTP(S)Connection / "
+             "requests.<verb> with no finite timeout turns a dead or "
+             "partitioned peer into a hung caller — every remote hop "
+             "(router probes, gossip, drain handoff, driver requests) "
+             "must bound its wait (timeout=None is the explicitly-"
+             "unbounded spelling, not a bound); suppress with "
+             "justification where an outer mechanism provably bounds "
+             "the wait"),
         Rule("JG209", SEV_ERROR,
              "multi-hop adjacency expansion as a Python loop over "
              "per-vertex store reads: an adjacency read (get_edges / "

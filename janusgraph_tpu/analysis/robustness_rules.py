@@ -1,5 +1,6 @@
 """JG204 — swallowed backend errors; JG206 — unbounded queues;
-JG207 — synchronous remote round-trips in loops; JG209 — row-wise
+JG207 — synchronous remote round-trips in loops; JG208 — outbound
+socket/HTTP calls without an explicit timeout; JG209 — row-wise
 multi-hop adjacency expansion.
 
 JG204: the exception taxonomy (janusgraph_tpu/exceptions.py) splits
@@ -39,6 +40,18 @@ justified ``# graphlint: disable=JG207 -- why`` suppression. Calls
 inside a nested function/lambda defined in the loop body are NOT
 flagged — deferred submission is exactly the fix.
 
+JG208: an outbound connection or HTTP request made without a finite
+timeout — ``urllib.request.urlopen``, ``socket.create_connection``,
+``http.client.HTTP(S)Connection``, or a ``requests.<verb>`` call with
+the ``timeout`` argument absent or ``None`` — waits forever on a dead
+or PARTITIONED peer: the exact failure mode the serving fleet's router
+probes, gossip rounds, and drain handoffs (server/fleet.py) must survive
+(a replica that looks alive but cannot answer would otherwise hang the
+router thread that probed it). Pass an explicit finite timeout; where an
+outer mechanism provably bounds the wait (e.g. an alarm/watchdog owns
+the socket), carry a justified ``# graphlint: disable=JG208 -- why``
+suppression.
+
 JG209: a ``for`` loop that iterates an adjacency read (``get_edges`` /
 ``adjacency_edges``) and performs FURTHER per-vertex adjacency reads in
 its body is the row-wise multi-hop expansion shape — one store round per
@@ -53,7 +66,7 @@ justified ``# graphlint: disable=JG209 -- why`` suppression.
 from __future__ import annotations
 
 import ast
-from typing import List, Set
+from typing import List, Set, Tuple
 
 from janusgraph_tpu.analysis.core import Finding, RULES
 from janusgraph_tpu.analysis.tracing import terminal_name
@@ -144,6 +157,56 @@ def _unbounded_queue_call(node: ast.Call):
 #: remote-client method names whose per-iteration use is one RTT each
 _ROUNDTRIP_METHODS = {"_call", "_call_ledger"}
 
+#: JG208 vocabulary: outbound-call spellings and where their timeout may
+#: ride. ``positional`` is the 0-based index a positional timeout may
+#: occupy (None = keyword-only in practice).
+_OUTBOUND_CALLS = {
+    "urlopen": 1,               # urlopen(url, data=None, timeout=...)
+    "create_connection": 1,     # create_connection(addr, timeout=...)
+    "HTTPConnection": None,     # ctor: timeout keyword
+    "HTTPSConnection": None,
+}
+
+#: requests-style verb methods (requests.get/post/... have NO default
+#: timeout — the library's most famous footgun)
+_REQUESTS_VERBS = {"get", "post", "put", "patch", "delete", "head",
+                   "options", "request"}
+
+
+def _timeout_of(node: ast.Call, positional) -> Tuple[bool, object]:
+    """(present, value_node) for the call's timeout argument."""
+    for kw in node.keywords:
+        if kw.arg == "timeout":
+            return True, kw.value
+    if positional is not None and len(node.args) > positional:
+        return True, node.args[positional]
+    return False, None
+
+
+def _untimed_outbound_call(node: ast.Call):
+    """The offending callable name when this call opens an outbound
+    socket/HTTP request without a finite timeout; None otherwise."""
+    name = terminal_name(node.func)
+    if name in _OUTBOUND_CALLS:
+        positional = _OUTBOUND_CALLS[name]
+    elif (
+        name in _REQUESTS_VERBS
+        and isinstance(node.func, ast.Attribute)
+        and terminal_name(node.func.value) == "requests"
+    ):
+        # requests.<verb>(...) — attribute calls off a receiver whose
+        # terminal name is `requests` (module or session variables named
+        # otherwise are out of scope: name-based like the other rules)
+        positional = None
+    else:
+        return None
+    present, value = _timeout_of(node, positional)
+    if not present:
+        return name
+    if isinstance(value, ast.Constant) and value.value is None:
+        return name  # timeout=None: the explicitly-unbounded spelling
+    return None
+
 #: per-vertex adjacency-read vocabulary (JG209): the store reads a
 #: row-by-row multi-hop expansion pays once per neighbor per hop
 _ADJACENCY_METHODS = {"get_edges", "adjacency_edges"}
@@ -220,6 +283,18 @@ def check_module(mod) -> List[Finding]:
                         "justification when N is structurally tiny",
                     ))
         if isinstance(node, ast.Call):
+            offender = _untimed_outbound_call(node)
+            if offender is not None:
+                findings.append(Finding(
+                    "JG208", RULES["JG208"].severity, mod.path,
+                    node.lineno, node.col_offset,
+                    f"{offender}() without a finite timeout: a dead or "
+                    "partitioned peer hangs this caller forever — pass "
+                    "an explicit timeout (router probes, gossip, and "
+                    "drain handoffs all bound theirs), or suppress with "
+                    "justification where an outer mechanism provably "
+                    "bounds the wait",
+                ))
             name = _unbounded_queue_call(node)
             if name is not None:
                 kwarg = _QUEUE_CTORS[name][0]

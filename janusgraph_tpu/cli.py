@@ -29,10 +29,18 @@ def _load_config(path: Optional[str]) -> dict:
 
 def cmd_server(args) -> int:
     from janusgraph_tpu.core.graph import open_graph
+    from janusgraph_tpu.observability import set_replica
     from janusgraph_tpu.server import JanusGraphManager, JanusGraphServer
 
     cfg = _load_config(args.config)
     graph = open_graph(cfg)
+    replica = args.replica_name or graph.config.get(
+        "server.fleet.replica-name"
+    )
+    if replica:
+        # tag this process's flight events / logs / metrics with the
+        # fleet identity (observability/identity.py)
+        set_replica(replica)
     if args.load_gods:
         from janusgraph_tpu.core import gods
 
@@ -87,6 +95,7 @@ def cmd_server(args) -> int:
         history_enabled=graph.config.get("metrics.history-enabled"),
         slo_enabled=graph.config.get("metrics.slo-enabled"),
         slo_specs=_slo_specs_from_config(graph.config),
+        replica_name=replica,
     ).start()
     print(f"JanusGraph-TPU server listening on {args.host}:{server.port}")
     try:
@@ -98,6 +107,120 @@ def cmd_server(args) -> int:
     finally:
         server.stop()
         graph.close()
+    return 0
+
+
+def cmd_fleet(args) -> int:
+    """Run a serving FLEET: N JanusGraphServer replicas over ONE shared
+    storage backend, fronted by the consistent-hash/least-loaded router
+    (server/fleet.py) with health probes, state gossip, and replica
+    warm-up from the shard-checkpoint snapshot pack. The in-process shape
+    of the reference deployment model — for production the same router
+    library fronts replicas on separate hosts speaking to a shared
+    storage-server endpoint (storage.backend=remote)."""
+    from janusgraph_tpu.core.graph import open_graph
+    from janusgraph_tpu.observability import set_replica
+    from janusgraph_tpu.server import (
+        FleetFrontend,
+        FleetRouter,
+        JanusGraphManager,
+        JanusGraphServer,
+        StateGossip,
+    )
+    from janusgraph_tpu.server.fleet import warm_replica
+
+    cfg = _load_config(args.config)
+    set_replica("fleet-frontend")
+    # one shared backing for every replica: inmemory shares the manager
+    # object in-process; remote/local replicas each open their own client
+    # to the SAME endpoint/directory (the config already names it)
+    shared = None
+    if cfg.get("storage.backend", "inmemory") == "inmemory":
+        from janusgraph_tpu.storage.inmemory import InMemoryStoreManager
+
+        shared = InMemoryStoreManager()
+    graphs, servers, gossips = [], [], []
+    first = open_graph(dict(cfg), store_manager=shared)
+    n = args.replicas or first.config.get("server.fleet.replicas")
+    probe_interval = first.config.get("server.fleet.probe-interval-s")
+    probe_timeout = first.config.get("server.fleet.probe-timeout-s")
+    router = FleetRouter(
+        vnodes=first.config.get("server.fleet.vnodes"),
+        candidates=first.config.get("server.fleet.candidates"),
+        probe_timeout_s=probe_timeout,
+    )
+    warmup_dir = first.config.get("server.fleet.warmup-dir")
+    try:
+        for i in range(n):
+            graph = first if i == 0 else open_graph(
+                dict(cfg), store_manager=shared
+            )
+            if i > 0:
+                graphs.append(graph)
+            name = f"r{i}"
+            if i > 0 and warmup_dir:
+                warm_replica(graph, warmup_dir)
+            manager = JanusGraphManager()
+            manager.put_graph(args.graph_name, graph)
+            server = JanusGraphServer(
+                manager=manager,
+                default_graph=args.graph_name,
+                host=args.host,
+                port=0,
+                replica_name=name,
+                # process-global planes (history sampler, SLO engine)
+                # belong to ONE owner in an in-process fleet
+                history_enabled=(i == 0) and graph.config.get(
+                    "metrics.history-enabled"
+                ),
+                slo_enabled=(i == 0) and graph.config.get(
+                    "metrics.slo-enabled"
+                ),
+            ).start()
+            servers.append(server)
+            gossip = StateGossip(
+                name, server.admission,
+                fanout=graph.config.get("server.fleet.gossip-fanout"),
+                timeout_s=probe_timeout,
+            )
+            server.gossip = gossip
+            gossips.append(gossip)
+            router.add_replica(name, args.host, server.port)
+        urls = [f"http://{args.host}:{s.port}" for s in servers]
+        for i, gossip in enumerate(gossips):
+            gossip.set_peers([u for j, u in enumerate(urls) if j != i])
+            gossip.start(
+                interval_s=first.config.get(
+                    "server.fleet.gossip-interval-s"
+                )
+            )
+        router.probe()
+        router.start_probes(interval_s=probe_interval)
+        frontend = FleetFrontend(
+            router, host=args.host, port=args.port
+        ).start()
+        for server in servers:
+            print(f"  replica {server.replica_name}: "
+                  f"{args.host}:{server.port}")
+        print(f"fleet frontend listening on {args.host}:{frontend.port} "
+              f"({n} replicas)")
+        try:
+            import threading
+
+            threading.Event().wait()
+        except KeyboardInterrupt:
+            pass
+        finally:
+            frontend.stop()
+    finally:
+        router.stop()
+        for gossip in gossips:
+            gossip.stop()
+        for server in servers:
+            server.stop()
+        for graph in graphs:
+            graph.close()
+        first.close()
     return 0
 
 
@@ -638,7 +761,24 @@ def main(argv=None) -> int:
     ps.add_argument("--auth-credentials", help="credentials-graph config JSON")
     ps.add_argument("--load-gods", action="store_true",
                     help="preload the Graph of the Gods example")
+    ps.add_argument("--replica-name", default="",
+                    help="fleet identity tag (overrides "
+                         "server.fleet.replica-name)")
     ps.set_defaults(fn=cmd_server)
+
+    pfleet = sub.add_parser(
+        "fleet",
+        help="run N server replicas over one shared backend behind the "
+             "fleet router (probes, gossip, drain, warm-up)",
+    )
+    pfleet.add_argument("--config", help="graph config JSON file")
+    pfleet.add_argument("--graph-name", default="graph")
+    pfleet.add_argument("--host", default="127.0.0.1")
+    pfleet.add_argument("--port", type=int, default=8182,
+                        help="frontend port (replicas pick free ports)")
+    pfleet.add_argument("--replicas", type=int, default=0,
+                        help="replica count (0 = server.fleet.replicas)")
+    pfleet.set_defaults(fn=cmd_fleet)
 
     pc = sub.add_parser("console", help="interactive console")
     pc.add_argument("--config", help="graph config JSON file")

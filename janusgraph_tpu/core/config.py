@@ -953,6 +953,38 @@ STORAGE.option(
     Mutability.LOCAL, lambda v: 0.0 <= v <= 1.0,
 )
 STORAGE.option(
+    "faults.replica-kill-at", int,
+    "fleet tick index at which the seeded-chosen serving replica is "
+    "killed mid-traffic (-1 = off; the fleet chaos driver consults "
+    "FaultPlan.fleet_hook and executes the decision — server/fleet.py)",
+    -1, Mutability.LOCAL, lambda v: v >= -1,
+)
+STORAGE.option(
+    "faults.replica-restart-at", int,
+    "fleet tick index at which the killed replica rejoins the fleet "
+    "(-1 = never; rejoin exercises the shard-checkpoint warm-up path)",
+    -1, Mutability.LOCAL, lambda v: v >= -1,
+)
+STORAGE.option(
+    "faults.replica-partition-at", int,
+    "data-plane op index at which the target replica's storage "
+    "partition window begins (-1 = off): the router still sees the "
+    "replica, the replica cannot reach storage — breaker trips, "
+    "/healthz degrades, the router must route around it",
+    -1, Mutability.LOCAL, lambda v: v >= -1,
+)
+STORAGE.option(
+    "faults.replica-partition-ops", int,
+    "data-plane ops the partition window covers once it begins", 0,
+    Mutability.LOCAL, lambda v: v >= 0,
+)
+STORAGE.option(
+    "faults.replica-target", int,
+    "explicit victim replica index for the replica fault kinds "
+    "(-1 = seed-hashed, the shard-preemption discipline)", -1,
+    Mutability.LOCAL, lambda v: v >= -1,
+)
+STORAGE.option(
     "faults.stores", str,
     "comma-separated store names the injector targets (empty = the "
     "data plane: edgestore,graphindex). System stores stay exempt so "
@@ -1400,6 +1432,69 @@ SERVER_NS.option(
     Mutability.LOCAL, lambda v: v > 0,
 )
 SERVER_NS.option(
+    "fleet.replica-name", str,
+    "this replica's fleet identity: rides /healthz, flight events, "
+    "structured logs, and /metrics (janusgraph_replica_info) so "
+    "cross-replica incident timelines merge by replica "
+    "(observability/identity.py; '' = untagged single process)", "",
+    Mutability.LOCAL,
+)
+SERVER_NS.option(
+    "fleet.replicas", int,
+    "replica count the `janusgraph_tpu fleet` runner starts over ONE "
+    "shared storage backend (server/fleet.py)", 3,
+    Mutability.LOCAL, lambda v: v >= 1,
+)
+SERVER_NS.option(
+    "fleet.vnodes", int,
+    "virtual nodes per replica on the router's consistent-hash ring — "
+    "more vnodes = smoother key spread, slightly larger ring", 16,
+    Mutability.LOCAL, lambda v: v >= 1,
+)
+SERVER_NS.option(
+    "fleet.candidates", int,
+    "ring candidates the router least-loaded-tie-breaks between "
+    "(power-of-two-choices over the consistent hash; 1 = pure hash)",
+    2, Mutability.LOCAL, lambda v: v >= 1,
+)
+SERVER_NS.option(
+    "fleet.probe-interval-s", float,
+    "per-replica /healthz probe cadence of the fleet router", 1.0,
+    Mutability.LOCAL, lambda v: v > 0,
+)
+SERVER_NS.option(
+    "fleet.probe-timeout-s", float,
+    "socket timeout on every router probe / gossip hop (JG208: a dead "
+    "replica costs one bounded wait, never a hung prober)", 2.0,
+    Mutability.LOCAL, lambda v: v > 0,
+)
+SERVER_NS.option(
+    "fleet.gossip-interval-s", float,
+    "push-pull state-gossip cadence (price-book records + brownout "
+    "rung to fanout peers per round; server/fleet.StateGossip)", 2.0,
+    Mutability.LOCAL, lambda v: v > 0,
+)
+SERVER_NS.option(
+    "fleet.gossip-fanout", int,
+    "peers contacted per gossip round — on a full mesh of N a new fact "
+    "reaches everyone within ceil((N-1)/fanout) push rounds", 2,
+    Mutability.LOCAL, lambda v: v >= 1,
+)
+SERVER_NS.option(
+    "fleet.drain-timeout-s", float,
+    "graceful-drain wait for in-flight sessions to finish before the "
+    "replica retires anyway (sessions still open after it are handed "
+    "off as failed-over, not lost silently)", 10.0,
+    Mutability.LOCAL, lambda v: v > 0,
+)
+SERVER_NS.option(
+    "fleet.warmup-dir", str,
+    "shard-checkpoint directory a joining replica hydrates its "
+    "snapshot-CSR cache from (server/fleet.warm_replica; '' = cold "
+    "start, or the computer.delta-snapshot-path pack as fallback)", "",
+    Mutability.LOCAL,
+)
+SERVER_NS.option(
     "deadline.propagation", bool,
     "forward the ambient request deadline's remaining budget on "
     "remote-store/index op frames (gated on the peer's negotiated "
@@ -1432,6 +1527,30 @@ DRIVER_NS.option(
     "retry-budget-refill-per-s", float,
     "token refill rate of the driver retry budget", 0.5,
     Mutability.LOCAL, lambda v: v >= 0,
+)
+DRIVER_NS.option(
+    "failover-retry-budget-capacity", float,
+    "token-bucket capacity of the fleet router's retry-elsewhere budget "
+    "(server/fleet.FleetRouter): each re-route of a shed/draining/dead "
+    "replica spends one token, so a fleet-wide incident cannot multiply "
+    "into a retry stampede against the survivors (0 = never re-route)",
+    16.0, Mutability.LOCAL, lambda v: v >= 0,
+)
+DRIVER_NS.option(
+    "failover-retry-budget-refill-per-s", float,
+    "token refill rate of the fleet failover budget", 2.0,
+    Mutability.LOCAL, lambda v: v >= 0,
+)
+DRIVER_NS.option(
+    "failover-backoff-base-s", float,
+    "base of the jittered backoff slept before retrying a request on "
+    "another replica (decorrelated like the shed Retry-After)", 0.02,
+    Mutability.LOCAL, lambda v: v > 0,
+)
+DRIVER_NS.option(
+    "failover-backoff-max-s", float,
+    "ceiling of the fleet failover backoff", 0.5,
+    Mutability.LOCAL, lambda v: v > 0,
 )
 DRIVER_NS.option(
     "ws-multiplex", bool,

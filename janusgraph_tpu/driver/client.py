@@ -115,6 +115,8 @@ class JanusGraphClient:
         deadline_ms: Optional[float] = None,
         retry_budget_capacity: Optional[float] = None,
         retry_budget_refill_per_s: Optional[float] = None,
+        http_timeout_s: float = 120.0,
+        connect_timeout_s: float = 30.0,
     ):
         from janusgraph_tpu.core.config import REGISTRY
 
@@ -125,6 +127,12 @@ class JanusGraphClient:
         #: default per-submit deadline budget (None = let the server
         #: apply its own default); overridable per call
         self.deadline_ms = deadline_ms
+        #: socket-level timeouts: every outbound hop carries one
+        #: (graphlint JG208) — a dead server must cost a bounded wait,
+        #: never a hung connection. Requests under a deadline use the
+        #: remaining budget (+ slack) instead of the flat ceiling.
+        self.http_timeout_s = float(http_timeout_s)
+        self.connect_timeout_s = float(connect_timeout_s)
         # driver.retry-budget-* defaults come from the config registry so
         # the documented keys and the constructor agree on one value
         if retry_budget_capacity is None:
@@ -162,7 +170,7 @@ class JanusGraphClient:
             self.base + "/token", data=body, method="POST",
             headers={"Content-Type": "application/json"},
         )
-        with _urlreq.urlopen(req) as resp:
+        with _urlreq.urlopen(req, timeout=self.http_timeout_s) as resp:
             self.token = json.loads(resp.read())["token"]
         return self.token
 
@@ -205,8 +213,15 @@ class JanusGraphClient:
                     headers=headers,
                 )
                 retry_after = None
+                # per-request socket timeout: the remaining deadline plus
+                # slack for the response to travel, else the flat ceiling
+                timeout_s = self.http_timeout_s
+                if give_up_at is not None:
+                    timeout_s = max(
+                        0.05, give_up_at - time.monotonic() + 5.0
+                    )
                 try:
-                    with _urlreq.urlopen(req) as resp:
+                    with _urlreq.urlopen(req, timeout=timeout_s) as resp:
                         payload = json.loads(resp.read())
                 except _urlerr.HTTPError as e:
                     # shed (429/503 + Retry-After) and timeout (504)
@@ -267,11 +282,13 @@ class JanusGraphClient:
         req = _urlreq.Request(
             self.base + "/graphs", headers=self._auth_header()
         )
-        with _urlreq.urlopen(req) as resp:
+        with _urlreq.urlopen(req, timeout=self.http_timeout_s) as resp:
             return json.loads(resp.read())["graphs"]
 
     def health(self) -> bool:
-        with _urlreq.urlopen(self.base + "/health") as resp:
+        with _urlreq.urlopen(
+            self.base + "/health", timeout=self.http_timeout_s
+        ) as resp:
             return json.loads(resp.read()).get("status") == "ok"
 
     # ------------------------------------------------------------ WebSocket
@@ -321,7 +338,12 @@ class WebSocketSession:
 
         # graphlint: disable=JG206 -- structurally bounded: one entry per in-flight submit (caller thread), popped on every response
         self._order = collections.deque()
-        self.sock = socket.create_connection((client.host, client.port))
+        # bounded CONNECT (graphlint JG208: a dead host costs one timeout,
+        # not a hang); the established socket returns to blocking mode —
+        # a WS session legitimately idles between submits
+        self.sock = socket.create_connection(
+            (client.host, client.port), timeout=client.connect_timeout_s
+        )
         key = base64.b64encode(os.urandom(16)).decode()
         auth = client._auth_header()
         auth_line = "".join(f"{k}: {v}\r\n" for k, v in auth.items())
@@ -343,6 +365,9 @@ class WebSocketSession:
         status_line = buf.split(b"\r\n", 1)[0].decode()
         if " 101 " not in status_line:
             raise ConnectionError(f"ws upgrade rejected: {status_line}")
+        # handshake done: long-lived blocking socket from here on (the
+        # connect timeout above bounded the only hop that can hang cold)
+        self.sock.settimeout(None)
 
     def submit(
         self,

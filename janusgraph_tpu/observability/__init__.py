@@ -35,6 +35,10 @@ from janusgraph_tpu.observability.exposition import (
 )
 from janusgraph_tpu.observability.flight import FlightRecorder
 from janusgraph_tpu.observability.flight import recorder as flight_recorder
+from janusgraph_tpu.observability.identity import (
+    replica_name,
+    set_replica,
+)
 from janusgraph_tpu.observability.logging import (
     StructuredLogger,
     get_logger,
@@ -133,6 +137,8 @@ __all__ = [
     "prometheus_text",
     "registry",
     "render_run",
+    "replica_name",
+    "set_replica",
     "slo_engine",
     "span",
     "tracer",

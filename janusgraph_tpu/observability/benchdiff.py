@@ -86,6 +86,12 @@ HEADLINES: Dict[str, List[Tuple[str, str]]] = {
         ("peak_goodput_per_s", HIGHER),
         ("goodput_2x_over_peak", HIGHER),
     ],
+    "fleet_chaos": [
+        ("failover_p99_ms", LOWER),
+        ("goodput_during_kill_over_prekill", HIGHER),
+        ("goodput_recovered_over_prekill", HIGHER),
+        ("pre_kill_goodput_per_s", HIGHER),
+    ],
     "multichip_ab": [("superstep_ms", LOWER)],
     "chaos": [("recovery_open_ms", LOWER)],
     "smoke": [],

@@ -45,8 +45,20 @@ def _histogram_lines(lines, name, buckets, total_count, total_sum, scale=1.0):
 
 
 def prometheus_text(registry, prefix: str = "janusgraph") -> str:
+    from janusgraph_tpu.observability.identity import replica_name
+
     counters, timers, histograms, gauges = registry.metric_objects()
     lines = []
+    replica = replica_name()
+    if replica:
+        # the fleet identity rides /metrics as a Prometheus info metric
+        # (the k8s `*_info` convention): scrapes from N replicas stay
+        # distinguishable even behind one relabeling-free scrape target
+        n = _pname(prefix, "replica_info")
+        lines.append(f"# TYPE {n} gauge")
+        lines.append(
+            f'{n}{{replica="{_NAME_RE.sub("_", replica)}"}} 1'
+        )
     for name in sorted(counters):
         n = _pname(prefix, name) + "_total"
         lines.append(f"# TYPE {n} counter")

@@ -50,6 +50,14 @@ Event taxonomy (the ``category`` field):
                     overflow, staleness breach, brownout refusal, count
                     overflow, or an internal error — fallback keeps the
                     query correct, the event keeps it visible)
+``fleet``           serving-fleet lifecycle (server/fleet.py): ``join``,
+                    ``rejoin``, ``dead`` (crash detection: probe/connect
+                    failures), ``drain``/``drain_begin``/``drain_end``
+                    (the graceful path, with handed-off/remaining session
+                    counts), ``warmup`` (snapshot-cache hydration). The
+                    ``fault`` category's kind field includes the fleet
+                    fault kinds ``replica_kill`` / ``replica_restart`` /
+                    ``replica_partition``
 ``slo_burn``        the SLO engine's burn-rate alert ladder transitioned
                     (observability/slo.py; fields: ``slo``/``kind``/
                     ``severity`` ok|ticket|page, ``direction`` enter/exit,
@@ -109,13 +117,19 @@ class FlightRecorder:
     def record(self, category: str, **fields) -> dict:
         """Append one event. Values are coerced to JSON-friendly host
         scalars (same contract as span attributes — never call this from
-        jit-traced code; graphlint JG107)."""
+        jit-traced code; graphlint JG107). When the process carries a
+        replica tag (observability/identity.py) every event is stamped
+        with it, so cross-replica incident timelines merge by `replica`."""
+        from janusgraph_tpu.observability.identity import replica_name
+
+        replica = replica_name()
         with self._lock:
             self._seq += 1
             event = {
                 "seq": self._seq,
                 "ts": time.time(),
                 "category": category,
+                **({"replica": replica} if replica else {}),
                 **{k: _plain(v) for k, v in fields.items()},
             }
             self._ring.append(event)

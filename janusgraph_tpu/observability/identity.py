@@ -1,0 +1,35 @@
+"""Process replica identity: one tag threaded through every telemetry plane.
+
+A serving fleet (server/fleet.py) is N near-identical replicas whose
+telemetry lands in per-process sinks — flight events, structured logs,
+``/metrics`` — and an incident reconstructed across replicas needs every
+record to say WHICH replica produced it. This module is the one place the
+tag lives: ``set_replica()`` once at process start (the ``server`` /
+``fleet`` CLI runners do it), and the flight recorder, structured logger,
+and Prometheus exposition all stamp their output from here.
+
+Deliberately dependency-free (flight.py and logging.py import it, and
+they are imported by everything else). The default is the empty string —
+single-process embedded use stays untagged, byte-identical to the
+pre-fleet output.
+"""
+
+from __future__ import annotations
+
+import threading
+
+_LOCK = threading.Lock()
+_REPLICA = ""
+
+
+def set_replica(name: str) -> None:
+    """Set this process's replica tag ('' clears it)."""
+    global _REPLICA
+    with _LOCK:
+        _REPLICA = str(name or "")
+
+
+def replica_name() -> str:
+    """The process's replica tag ('' when untagged)."""
+    with _LOCK:
+        return _REPLICA

@@ -68,12 +68,19 @@ class StructuredLogger:
         self.name = name
 
     def _emit(self, level: str, event: str, fields: dict) -> dict:
+        from janusgraph_tpu.observability.identity import replica_name
+
         record = {
             "ts": time.time(),
             "level": level,
             "logger": self.name,
             "event": event,
         }
+        replica = replica_name()
+        if replica:
+            # fleet deployments tag every record with the producing
+            # replica so one grep walks an incident across the fleet
+            record["replica"] = replica
         span = tracer.current()
         if span is not None:
             record["trace_id"] = f"{span.trace_id:016x}"

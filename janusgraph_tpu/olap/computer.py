@@ -438,6 +438,13 @@ class GraphComputer:
                 delta_view = None
         from janusgraph_tpu.observability import registry
 
+        # warm-submit executor cache (the PR 14 REMAINING): when this
+        # submit runs over the delta snapshot's CURRENT base pack, the
+        # executor — device-resident packs and compiled executables
+        # included — is cached on the snapshot and reused next submit,
+        # invalidated by any compaction/adopt (generation bump)
+        if delta_snap is not None and csr is delta_snap.csr:
+            run_kwargs["executor_cache"] = delta_snap
         try:
             states = run_on(csr, self._program, executor_kind, **run_kwargs)
         except Exception as e:
@@ -533,6 +540,7 @@ def run_on(
     shard_checkpoint_dir: str = None,
     checkpoint_shards: int = 0,
     delta=None,
+    executor_cache=None,
 ):
     # dense-feature tier program configuration (computer.features-*):
     # applied here so EVERY executor sees the same padded lane tier and
@@ -546,7 +554,17 @@ def run_on(
     if executor == "cpu":
         from janusgraph_tpu.olap.cpu_executor import CPUExecutor
 
-        return CPUExecutor(csr, strategy=cpu_strategy, delta=delta).run(
+        ex = None
+        cache_key = ("cpu", cpu_strategy)
+        if executor_cache is not None:
+            ex = executor_cache.cached_executor(cache_key)
+        if ex is None:
+            ex = CPUExecutor(csr, strategy=cpu_strategy, delta=delta)
+            if executor_cache is not None:
+                executor_cache.store_executor(cache_key, ex, csr)
+        else:
+            ex.set_delta(delta)
+        return ex.run(
             program,
             checkpoint_path=checkpoint_path,
             checkpoint_every=checkpoint_every,
@@ -581,9 +599,7 @@ def run_on(
     if executor == "tpu":
         from janusgraph_tpu.olap.tpu_executor import TPUExecutor
 
-        return TPUExecutor(
-            csr,
-            delta=delta,
+        ctor_kwargs = dict(
             strategy=strategy,
             ell_max_capacity=ell_max_capacity,
             frontier=frontier,
@@ -601,7 +617,21 @@ def run_on(
             autotune_max_tiers=autotune_max_tiers,
             autotune_persist=autotune_persist,
             features_dim_tier=features_dim_tier,
-        ).run(
+        )
+        ex = None
+        # the overlay is NOT part of the key: a cached executor swaps it
+        # per submit (set_delta), and its compiled executables are keyed
+        # by lane signature internally
+        cache_key = ("tpu",) + tuple(sorted(ctor_kwargs.items()))
+        if executor_cache is not None:
+            ex = executor_cache.cached_executor(cache_key)
+        if ex is None:
+            ex = TPUExecutor(csr, delta=delta, **ctor_kwargs)
+            if executor_cache is not None:
+                executor_cache.store_executor(cache_key, ex, csr)
+        else:
+            ex.set_delta(delta)
+        return ex.run(
             program,
             sync_every=sync_every,
             checkpoint_every=checkpoint_every,

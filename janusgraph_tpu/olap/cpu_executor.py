@@ -76,6 +76,37 @@ class CPUExecutor:
         #: uniformly; costs come from the host estimator (no XLA here)
         self.last_run_info: Dict[str, object] = {}
 
+    def set_delta(self, delta) -> None:
+        """Swap the pending-overlay view on a cached executor (the warm-
+        submit executor-cache path, mirroring TPUExecutor.set_delta):
+        the base graph and numpy packs survive across submits."""
+        delta = delta if (delta is not None and delta.depth) else None
+        if delta is None:
+            self._delta = None
+            self._fused_view = None
+            return
+        if self.strategy == "scalar":
+            raise ValueError(
+                "delta-fused cpu runs require a pack strategy "
+                "('ell'/'hybrid'); the scalar oracle replays "
+                "materialized snapshots"
+            )
+        if self.graph.in_edge_weight is not None:
+            raise ValueError(
+                "delta-fused runs support unfiltered weightless "
+                "snapshots only"
+            )
+        if delta.csr is not self.graph:
+            raise ValueError(
+                "overlay view was built over a different base snapshot "
+                "— a cached executor only serves overlays of ITS base "
+                "CSR (the snapshot cache invalidates on compaction)"
+            )
+        from janusgraph_tpu.olap.delta import FusedHostView
+
+        self._delta = delta
+        self._fused_view = FusedHostView(delta)
+
     def run(
         self,
         program: VertexProgram,

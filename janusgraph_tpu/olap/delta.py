@@ -957,6 +957,15 @@ class DeltaSnapshot:
         self.csr = None
         self.epoch = -1
         self._decision = None
+        #: base-pack generation: bumped whenever `csr` is replaced (cold
+        #: load, compaction, adopt, warm-up install) — the executor
+        #: cache's invalidation edge
+        self.generation = 0
+        #: warm-submit executor cache (the PR 14 REMAINING): device-
+        #: resident packs + compiled executables keyed by (executor kind,
+        #: constructor signature), reused across submits over ONE base
+        #: pack; cleared on every generation bump
+        self._executors: Dict[Tuple, object] = {}
 
     # ------------------------------------------------------------- snapshot
     def acquire(self):
@@ -999,17 +1008,52 @@ class DeltaSnapshot:
                     return self.csr, view, info
             from janusgraph_tpu.olap.csr import load_csr_snapshot
 
-            self.csr, self.epoch = load_csr_snapshot(self.graph)
+            csr, epoch = load_csr_snapshot(self.graph)
+            self._install(csr, epoch)
             registry.counter("olap.delta.packs").inc()
             registry.set_gauge("olap.delta.overlay_depth", 0.0)
             return self.csr, None, {"path": "cold"}
 
     def adopt(self, csr, epoch: int) -> None:
         """Install an externally materialized base (submit()'s
-        materialize branch) so the next acquire resumes from it."""
+        materialize branch, or a fleet warm-up pack — server/fleet.py)
+        so the next acquire resumes from it."""
         with self._lock:
-            self.csr = csr
-            self.epoch = epoch
+            self._install(csr, epoch)
+
+    def _install(self, csr, epoch: int) -> None:
+        """Replace the base pack (lock held): generation bump invalidates
+        every cached executor — their device packs cover the OLD base."""
+        self.csr = csr
+        self.epoch = epoch
+        self.generation += 1
+        self._executors.clear()
+
+    # ------------------------------------------------- warm executor cache
+    def cached_executor(self, key: Tuple):
+        """A previously stored executor for this base-pack generation, or
+        None. Keys carry the executor kind + constructor signature; the
+        overlay is NOT part of the key — callers swap it per submit via
+        ``set_delta`` (compiled executables stay sig-keyed inside)."""
+        from janusgraph_tpu.observability import registry
+
+        with self._lock:
+            ex = self._executors.get(key)
+        if ex is not None:
+            registry.counter("olap.executor.cache_hits").inc()
+        return ex
+
+    def store_executor(self, key: Tuple, ex, csr) -> None:
+        """Cache one freshly built executor IF it was built over the
+        CURRENT base pack (a concurrent compaction between acquire and
+        build means the executor's device arrays are already stale —
+        dropping it is the cheap correct answer)."""
+        from janusgraph_tpu.observability import registry
+
+        registry.counter("olap.executor.cache_misses").inc()
+        with self._lock:
+            if csr is self.csr:
+                self._executors[key] = ex
 
     # ----------------------------------------------------------- compaction
     def _threshold(self) -> int:
@@ -1065,12 +1109,15 @@ class DeltaSnapshot:
 
         t0 = _time.perf_counter()
         depth = view.depth
-        self.csr = materialize(
-            self.csr, view.overlay, idm=getattr(self.graph, "idm", None)
-        )
         # anchor at the max epoch actually folded — records committed
         # mid-materialize stay pending instead of being lost
-        self.epoch = getattr(view, "upto_epoch", self.epoch)
+        self._install(
+            materialize(
+                self.csr, view.overlay,
+                idm=getattr(self.graph, "idm", None),
+            ),
+            getattr(view, "upto_epoch", self.epoch),
+        )
         wall_ms = (_time.perf_counter() - t0) * 1000.0
         registry.counter("olap.delta.compactions").inc()
         registry.set_gauge("olap.delta.overlay_depth", 0.0)

@@ -224,6 +224,186 @@ def _assemble(dir_path: str, body: dict, record_fallbacks: bool = True) -> Optio
     return state, dict(body.get("memory", {})), int(body["steps"])
 
 
+# ---------------------------------------------------------------------------
+# CSR snapshot checkpoints (fleet replica warm-up)
+# ---------------------------------------------------------------------------
+#
+# The per-shard-slice + digest-verified-manifest discipline above also
+# carries the snapshot-CSR cache across processes: a serving replica
+# exports its base pack once, and a JOINING replica hydrates from the
+# files instead of re-scanning storage (zero edgestore reads — the
+# warm-up half of server/fleet.py). Unlike the state checkpoints, a CSR
+# pack mixes vertex-axis and edge-axis arrays, so slices are row-range
+# shards whose edge arrays cover exactly the rows' indptr spans — the
+# same contiguous-block convention as ShardedCSR, and reassembly is
+# byte-identical to the exported arrays (the acceptance contract).
+
+_CSR_KIND = "csr-snapshot"
+
+#: arrays present only when the exported pack carries them (the loader
+#: passes absent ones as None, matching a scanned snapshot)
+_CSR_OPTIONAL = ("labels", "out_edge_type", "in_edge_type")
+
+
+def save_csr_checkpoint(
+    dir_path: str, csr, epoch: int, num_shards: int = 1
+) -> None:
+    """Export one CSR snapshot pack as a sharded checkpoint: per-shard
+    row-range slices (vertex arrays by rows, edge arrays by the rows'
+    indptr spans), each digest-embedded and written atomically, committed
+    by the digest-verified manifest."""
+    n = int(len(csr.vertex_ids))
+    ranges = shard_ranges(n, num_shards)
+    shards = []
+    for s, (lo, hi) in enumerate(ranges):
+        olo, ohi = int(csr.out_indptr[lo]), int(csr.out_indptr[hi])
+        ilo, ihi = int(csr.in_indptr[lo]), int(csr.in_indptr[hi])
+        arrays = {
+            "vertex_ids": np.ascontiguousarray(csr.vertex_ids[lo:hi]),
+            "out_degree": np.ascontiguousarray(csr.out_degree[lo:hi]),
+            "out_indptr": np.ascontiguousarray(
+                csr.out_indptr[lo: hi + 1]
+            ),
+            "in_indptr": np.ascontiguousarray(csr.in_indptr[lo: hi + 1]),
+            "out_dst": np.ascontiguousarray(csr.out_dst[olo:ohi]),
+            "in_src": np.ascontiguousarray(csr.in_src[ilo:ihi]),
+        }
+        if csr.labels is not None:
+            arrays["labels"] = np.ascontiguousarray(csr.labels[lo:hi])
+        if csr.out_edge_type is not None:
+            arrays["out_edge_type"] = np.ascontiguousarray(
+                csr.out_edge_type[olo:ohi]
+            )
+            arrays["in_edge_type"] = np.ascontiguousarray(
+                csr.in_edge_type[ilo:ihi]
+            )
+        digest = _content_digest(arrays)
+        arrays["meta__digest"] = digest
+        _atomic_npz(_slice_path(dir_path, s), arrays)
+        shards.append({
+            "file": f"shard-{s}.npz",
+            "rows": [int(lo), int(hi)],
+            "digest": digest.tobytes().hex(),
+        })
+    body = {
+        "version": _MANIFEST_VERSION,
+        "kind": _CSR_KIND,
+        "epoch": int(epoch),
+        "num_shards": int(num_shards),
+        "num_rows": n,
+        "num_edges": int(csr.num_edges),
+        "optional": sorted(
+            k for k in _CSR_OPTIONAL
+            if getattr(csr, k, None) is not None
+        ),
+        "shards": shards,
+    }
+    body["digest"] = _manifest_digest(body)
+    mpath = os.path.join(dir_path, MANIFEST_NAME)
+    fd, tmp = tempfile.mkstemp(dir=dir_path, suffix=".json.tmp")
+    try:
+        with os.fdopen(fd, "w") as f:
+            json.dump(body, f)
+        if os.path.exists(mpath):
+            os.replace(mpath, mpath + ".prev")
+        os.replace(tmp, mpath)
+    except BaseException:
+        if os.path.exists(tmp):
+            os.unlink(tmp)
+        raise
+    from janusgraph_tpu.observability import flight_recorder
+
+    flight_recorder.record(
+        "checkpoint", action="csr_save", rows=n,
+        edges=int(csr.num_edges), shards=int(num_shards),
+    )
+
+
+def _assemble_csr(dir_path: str, body: dict):
+    pieces = []
+    for rec in body["shards"]:
+        path = os.path.join(dir_path, rec["file"])
+        sl = None
+        for candidate in (path, path + ".prev"):
+            if not os.path.exists(candidate):
+                continue
+            try:
+                with np.load(candidate) as z:
+                    arrays = {k: z[k] for k in z.files}
+            except Exception:  # noqa: BLE001 - torn/truncated slice
+                continue
+            arrays.pop("meta__digest", None)
+            if _content_digest(arrays).tobytes().hex() == rec["digest"]:
+                sl = arrays
+                break
+        if sl is None:
+            return None
+        pieces.append(sl)
+    if not pieces:
+        return None
+
+    def _cat(key, indptr=False):
+        if key not in pieces[0]:
+            return None
+        if indptr:
+            # each slice stored indptr[lo:hi+1] with ABSOLUTE values;
+            # drop the duplicated boundary of every later slice
+            parts = [pieces[0][key]] + [p[key][1:] for p in pieces[1:]]
+        else:
+            parts = [p[key] for p in pieces]
+        return np.concatenate(parts) if len(parts) > 1 else parts[0]
+
+    from janusgraph_tpu.olap.csr import CSRGraph
+
+    csr = CSRGraph(
+        vertex_ids=_cat("vertex_ids"),
+        out_indptr=_cat("out_indptr", indptr=True),
+        out_dst=_cat("out_dst"),
+        in_indptr=_cat("in_indptr", indptr=True),
+        in_src=_cat("in_src"),
+        out_degree=_cat("out_degree"),
+        labels=_cat("labels"),
+        out_edge_type=_cat("out_edge_type"),
+        in_edge_type=_cat("in_edge_type"),
+    )
+    if len(csr.vertex_ids) != int(body["num_rows"]) or (
+        len(csr.out_dst) != int(body["num_edges"])
+    ):
+        return None
+    return csr, int(body["epoch"])
+
+
+def load_csr_checkpoint(dir_path: str):
+    """(CSRGraph, epoch) from the newest COMPLETE CSR snapshot checkpoint
+    (current manifest first, ``manifest.json.prev`` fallback — the state
+    checkpoints' torn-write containment), or None. Arrays reassemble
+    byte-identical to the exported pack; the epoch binds to the EXPORTING
+    process's backend — a joining replica re-anchors at its own observed
+    epoch (server/fleet.py warm_replica)."""
+    mpath = os.path.join(dir_path, MANIFEST_NAME)
+    for candidate in (mpath, mpath + ".prev"):
+        body = _read_manifest(candidate)
+        if body is None or body.get("kind") != _CSR_KIND:
+            continue
+        out = _assemble_csr(dir_path, body)
+        if out is not None:
+            if candidate != mpath:
+                from janusgraph_tpu.observability import (
+                    flight_recorder,
+                    registry,
+                )
+
+                registry.counter(
+                    "olap.checkpoint.manifest_fallback"
+                ).inc()
+                flight_recorder.record(
+                    "checkpoint", action="manifest_fallback",
+                    steps=int(out[1]),
+                )
+            return out
+    return None
+
+
 def load_sharded_checkpoint(
     dir_path: str,
 ) -> Optional[Tuple[Dict[str, np.ndarray], Dict[str, float], int]]:

@@ -273,6 +273,10 @@ class TPUExecutor:
 
             host_view = FusedHostView(self._delta)
         self.g = _DeviceGraph(csr, jnp, host_view=host_view)
+        # the overlay-free device view is kept across set_delta swaps so a
+        # cached executor returning to a clean snapshot reuses the already
+        # shipped base arrays instead of re-uploading them
+        self._base_g = self.g if self._delta is None else None
         if strategy == "auto" and use_pallas:
             strategy = "pallas"
         if strategy not in ("auto", "ell", "hybrid", "segment", "pallas"):
@@ -366,6 +370,45 @@ class TPUExecutor:
         self._sddmm_rows_cache: Dict[Tuple, object] = {}
         self._channel_packs: "OrderedDict" = OrderedDict()
         self._segsum_plans: Dict[str, object] = {}
+
+    def set_delta(self, delta) -> None:
+        """Swap the pending-overlay view WITHOUT rebuilding the executor —
+        the warm-submit executor-cache path (olap/computer.py): the base
+        CSR, ELL/hybrid packs, compiled executables, and autotune
+        decisions all survive across submits. A new overlay with the same
+        lane signature reuses the compiled fused executable outright (the
+        lanes ship as jit ARGUMENTS); a different signature compiles its
+        own variant under the sig-keyed executable cache. ``None`` (or an
+        empty view) returns the executor to the overlay-free base view."""
+        delta = delta if (delta is not None and delta.depth) else None
+        if delta is None:
+            if self._delta is None:
+                return
+            self._delta = None
+            if self._base_g is None:
+                self._base_g = _DeviceGraph(self.csr, self.jnp)
+            self.g = self._base_g
+            return
+        if self.csr.in_edge_weight is not None:
+            raise ValueError(
+                "delta-fused runs support unfiltered weightless "
+                "snapshots only (the change capture carries no weight "
+                "column)"
+            )
+        if delta.csr is not self.csr:
+            raise ValueError(
+                "overlay view was built over a different base snapshot "
+                "— a cached executor only serves overlays of ITS base "
+                "CSR (the snapshot cache invalidates on compaction)"
+            )
+        if self._base_g is None and self._delta is None:
+            self._base_g = self.g
+        from janusgraph_tpu.olap.delta import FusedHostView
+
+        self._delta = delta
+        self.g = _DeviceGraph(
+            self.csr, self.jnp, host_view=FusedHostView(delta)
+        )
 
     @staticmethod
     def ell_footprint(

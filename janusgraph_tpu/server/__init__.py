@@ -19,3 +19,10 @@ from janusgraph_tpu.server.server import JanusGraphServer  # noqa: F401
 from janusgraph_tpu.server.admission import (  # noqa: F401
     AdmissionController,
 )
+from janusgraph_tpu.server.fleet import (  # noqa: F401
+    FleetFrontend,
+    FleetRouter,
+    StateGossip,
+    export_snapshot,
+    warm_replica,
+)

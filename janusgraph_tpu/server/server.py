@@ -26,6 +26,7 @@ import json
 import re
 import struct
 import threading
+import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
 
@@ -143,6 +144,11 @@ def healthz_snapshot() -> dict:
         for name, m in snap.items()
         if name.startswith("breaker.") and name.endswith(".state")
         and m["type"] == "gauge"
+        # fleet router breakers describe PEER replicas (server/fleet.py),
+        # not this process's storage/index tier — an in-process router
+        # failing over around a dead peer must not read as THIS replica
+        # degrading
+        and not name.startswith("breaker.fleet.")
     }
     slo_block = slo_engine.snapshot()
     degraded = any(v != 0.0 for v in breakers.values()) or bool(
@@ -293,6 +299,7 @@ class JanusGraphServer:
         history_enabled: bool = True,
         slo_enabled: bool = True,
         slo_specs=None,
+        replica_name: str = "",
     ):
         self.manager = manager or JanusGraphManager.get_instance()
         self.default_graph = default_graph
@@ -330,6 +337,21 @@ class JanusGraphServer:
         self._history_started = False
         self._httpd: Optional[ThreadingHTTPServer] = None
         self._thread: Optional[threading.Thread] = None
+        #: server.fleet.replica-name — this replica's fleet identity
+        #: (rides /healthz; the CLI runners also set the process-wide
+        #: telemetry tag, observability/identity.py)
+        self.replica_name = replica_name
+        #: graceful-drain mode: True stops admitting NEW sessionless
+        #: requests and session opens (shed with status "draining", which
+        #: the fleet router treats as retry-elsewhere) while in-flight
+        #: sessions finish — see drain()
+        self.draining = False
+        self._sessions_lock = threading.Lock()
+        self._open_sessions = 0
+        self._sessions_drained = threading.Condition(self._sessions_lock)
+        #: the replica's gossip agent (server/fleet.StateGossip) when the
+        #: fleet runner wired one; POST /gossip merges into it
+        self.gossip = None
 
     def _deadline_ms(self, requested) -> Optional[float]:
         """Effective deadline budget for one request: the client's
@@ -505,6 +527,44 @@ class JanusGraphServer:
                     else:
                         v.tx.rollback()
 
+    # ---------------------------------------------------------------- drain
+    def drain(self, timeout_s: float = 10.0) -> int:
+        """Graceful retirement, phase one: stop admitting new sessionless
+        requests and session opens (they shed with status ``"draining"``
+        so a fleet router retries them elsewhere), then wait up to
+        ``timeout_s`` for in-flight sessions to close. Returns the number
+        of sessions still open when the wait ends (0 = fully drained —
+        the caller may stop() the server without losing a session). The
+        crash path never runs this — that distinction is the flight
+        record: ``fleet/drain`` vs ``fleet/dead``."""
+        from janusgraph_tpu.observability import flight_recorder
+
+        self.draining = True
+        flight_recorder.record(
+            "fleet", action="drain_begin",
+            server=self.replica_name or f"{self.host}:{self.port}",
+            open_sessions=self.open_sessions,
+        )
+        deadline = time.monotonic() + max(0.0, timeout_s)
+        with self._sessions_drained:
+            while self._open_sessions > 0:
+                wait = deadline - time.monotonic()
+                if wait <= 0:
+                    break
+                self._sessions_drained.wait(wait)
+            remaining = self._open_sessions
+        flight_recorder.record(
+            "fleet", action="drain_end",
+            server=self.replica_name or f"{self.host}:{self.port}",
+            remaining=remaining,
+        )
+        return remaining
+
+    @property
+    def open_sessions(self) -> int:
+        with self._sessions_lock:
+            return self._open_sessions
+
     # ------------------------------------------------------------- sessions
     def open_session(self) -> dict:
         """State for one in-session WS connection (the reference Gremlin
@@ -512,7 +572,16 @@ class JanusGraphServer:
         persist across messages, so ONE transaction spans requests until
         the query itself commits (`g.commit()`) or rolls back — no
         per-request auto-commit. Close with close_session."""
-        return {}
+        if self.draining:
+            # new sessions are the one thing a draining replica must
+            # refuse outright — in-flight sessions keep working
+            raise PermissionError(
+                "replica is draining: no new sessions "
+                "(reconnect to another fleet member)"
+            )
+        with self._sessions_lock:
+            self._open_sessions += 1
+        return {"_counted": True}
 
     def execute_session(
         self, query: str, graph_name: Optional[str], session: dict
@@ -556,7 +625,12 @@ class JanusGraphServer:
                 src.tx.rollback()
             except Exception:  # noqa: BLE001 - already closed
                 pass
+        counted = session.pop("_counted", False)
         session.clear()
+        if counted:
+            with self._sessions_drained:
+                self._open_sessions = max(0, self._open_sessions - 1)
+                self._sessions_drained.notify_all()
 
     def authenticate_request(self, headers) -> Optional[str]:
         """Returns username, or raises. None when auth is disabled."""
@@ -632,6 +706,22 @@ class _Handler(BaseHTTPRequestHandler):
             deadline_header if deadline_header is not None
             else req.get("deadline")
         )
+        # graceful drain: NEW sessionless work is refused with a
+        # structured "draining" shed (the fleet router's retry-elsewhere
+        # signal); requests on an EXISTING session run to completion so
+        # the session can finish and close
+        if server.draining and session is None:
+            from janusgraph_tpu.observability import registry as _reg
+
+            _reg.counter("server.drain.refused").inc()
+            return {
+                "result": {"data": None},
+                "status": {
+                    "code": 503, "status": "draining",
+                    "retry_after_s": 0.05,
+                    "message": "replica is draining; retry elsewhere",
+                },
+            }
         with deadline_scope(budget_ms):
             # admission BEFORE any work: price the query's shape from the
             # measured price book, then admit / queue / shed
@@ -802,6 +892,17 @@ class _Handler(BaseHTTPRequestHandler):
             # (unauthenticated like /health — liveness probes carry no
             # credentials, and nothing here includes data content)
             payload = healthz_snapshot()
+            # fleet identity + drain state ride along so the router's
+            # probe sees admission load, burn rate, AND lifecycle in one
+            # round trip; draining is deliberate, so it does not flip the
+            # ok/degraded verdict
+            server = self.jg_server
+            if server.replica_name:
+                payload["replica"] = server.replica_name
+            payload["draining"] = server.draining
+            payload["open_sessions"] = server.open_sessions
+            if server.gossip is not None:
+                payload["fleet_peers"] = dict(server.gossip.peer_state)
             code = 200 if payload["status"] == "ok" else 503
             self._send_json(code, payload)
             return
@@ -982,6 +1083,27 @@ class _Handler(BaseHTTPRequestHandler):
             except (AuthenticationError, KeyError, AttributeError) as e:
                 self._send_json(401, {"status": {"code": 401, "message": str(e)}})
             return
+        if self.path == "/gossip":
+            # fleet state gossip (server/fleet.StateGossip): merge the
+            # peer's digest (price-book records + brownout rung) and
+            # answer with ours — the PULL half of push-pull anti-entropy.
+            # Operational-plane content only (literal-stripped shapes,
+            # bounded by the price book's top-K eviction), so it rides
+            # unauthenticated like /metrics; 404 when no agent is wired.
+            gossip = getattr(self.jg_server, "gossip", None)
+            if gossip is None:
+                self._send_json(404, {"status": {"code": 404}})
+                return
+            try:
+                body = json.loads(raw)
+            except json.JSONDecodeError:
+                self._send_json(400, {"status": {
+                    "code": 400, "message": "bad json",
+                }})
+                return
+            gossip.merge(body)
+            self._send_json(200, gossip.local_digest())
+            return
         if self.path == "/gremlin" or self.path == "/":
             if not self._auth():
                 return
@@ -995,7 +1117,9 @@ class _Handler(BaseHTTPRequestHandler):
                 deadline_header=self.headers.get("X-Deadline-Ms"),
             )
             status = payload.get("status", {})
-            if status.get("status") == "shed":
+            if status.get("status") == "shed" or (
+                status.get("status") == "draining"
+            ):
                 # a REAL 503 (unlike embedded evaluation errors, which
                 # stay HTTP 200 for driver compat): load balancers and
                 # generic HTTP clients understand it, and EVERY shed
@@ -1073,7 +1197,20 @@ class _Handler(BaseHTTPRequestHandler):
                     )
                     continue
                 if req.get("session") and session is None:
-                    session = self.jg_server.open_session()
+                    try:
+                        session = self.jg_server.open_session()
+                    except PermissionError as e:
+                        # draining replica: refuse the NEW session with a
+                        # structured response the driver/router can act
+                        # on; the connection itself stays usable
+                        payload = {"status": {
+                            "code": 503, "status": "draining",
+                            "message": str(e),
+                        }}
+                        if req.get("id") is not None:
+                            payload["id"] = req.get("id")
+                        _send_locked(payload)
+                        continue
                 if req.get("id") is not None and session is None:
                     from concurrent.futures import ThreadPoolExecutor
 

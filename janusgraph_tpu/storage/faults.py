@@ -53,6 +53,19 @@ Fault kinds (all off by default):
 ``straggler``        per-(shard, superstep) latency skew: the chosen shard
                      "runs late" by ``shard-straggler-ms`` (no exception;
                      feeds straggler detection / the skew gauge)
+``replica_kill``     kill ONE serving replica of a fleet at the scheduled
+                     fleet tick — the router + retry budgets must absorb
+                     it (server/fleet.py; executed by the fleet harness
+                     consulting :meth:`FaultPlan.fleet_hook`)
+``replica_restart``  the killed replica rejoins at the scheduled fleet
+                     tick (warm-up from the shard-checkpoint snapshot
+                     pack exercises the join path)
+``replica_partition`` the chosen replica keeps serving HTTP (the router
+                     still sees it) but its STORAGE reads/writes fail for
+                     a seeded window (``replica-partition-at`` ..
+                     ``+ replica-partition-ops``) — the breaker trips,
+                     /healthz degrades, and the router must route around
+                     a replica that looks alive but cannot reach data
 ===================  =====================================================
 
 The four ``shard-*`` kinds are scheduled/decided exactly like the
@@ -137,6 +150,11 @@ class FaultPlan:
         halo_drop_at: int = -1,
         straggler_ms: float = 0.0,
         straggler_rate: float = 0.0,
+        replica_kill_at: int = -1,
+        replica_restart_at: int = -1,
+        replica_partition_at: int = -1,
+        replica_partition_ops: int = 0,
+        replica_target: int = -1,
         stores: Sequence[str] = DEFAULT_FAULT_STORES,
         journal_limit: int = 4096,
     ):
@@ -159,6 +177,18 @@ class FaultPlan:
         self.halo_drop_at = halo_drop_at
         self.straggler_ms = straggler_ms
         self.straggler_rate = straggler_rate
+        self.replica_kill_at = replica_kill_at
+        self.replica_restart_at = replica_restart_at
+        self.replica_partition_at = replica_partition_at
+        self.replica_partition_ops = replica_partition_ops
+        self._replica_target_cfg = replica_target
+        #: which fleet replica THIS plan instance belongs to (set by the
+        #: fleet harness when wiring each replica's graph; -1 = not part
+        #: of a fleet, so the partition window never applies)
+        self.replica_index = -1
+        self._replica_killed = False
+        self._replica_restarted = False
+        self._partition_recorded = False
         self.stores = tuple(stores)
         self.journal_limit = journal_limit
         #: injected-fault record: [{"kind", "n", ...}] — deterministic
@@ -210,6 +240,17 @@ class FaultPlan:
             halo_drop_at=cfg.get("storage.faults.shard-halo-drop-at"),
             straggler_ms=cfg.get("storage.faults.shard-straggler-ms"),
             straggler_rate=cfg.get("storage.faults.shard-straggler-rate"),
+            replica_kill_at=cfg.get("storage.faults.replica-kill-at"),
+            replica_restart_at=cfg.get(
+                "storage.faults.replica-restart-at"
+            ),
+            replica_partition_at=cfg.get(
+                "storage.faults.replica-partition-at"
+            ),
+            replica_partition_ops=cfg.get(
+                "storage.faults.replica-partition-ops"
+            ),
+            replica_target=cfg.get("storage.faults.replica-target"),
             stores=stores,
         )
 
@@ -245,9 +286,88 @@ class FaultPlan:
         with self._lock:
             return dict(self._counters)
 
+    # ------------------------------------------------------------ fleet hooks
+    def replica_target(self, num_replicas: int) -> int:
+        """The deterministically chosen victim replica for the fleet fault
+        kinds: ``replica-target`` when configured, else seed-hashed — the
+        same pure-function discipline as the shard-preemption choice."""
+        if self._replica_target_cfg >= 0:
+            return self._replica_target_cfg % max(1, num_replicas)
+        return zlib.crc32(f"{self.seed}:replica".encode()) % max(
+            1, num_replicas
+        )
+
+    def arm_replica(self, index: int, num_replicas: int) -> None:
+        """Bind this plan instance to fleet replica ``index`` (each replica
+        opens its own graph, so each carries its own plan). Only the
+        target replica's plan executes the partition window."""
+        self.replica_index = int(index)
+        self._num_replicas = int(num_replicas)
+
+    def _partition_active(self, n: int) -> bool:
+        """Whether data-plane op index ``n`` of THIS replica's plan falls
+        inside the seeded partition window (router sees the replica, the
+        replica cannot reach storage)."""
+        if (
+            self.replica_partition_at < 0
+            or self.replica_partition_ops <= 0
+            or self.replica_index < 0
+        ):
+            return False
+        if self.replica_index != self.replica_target(
+            getattr(self, "_num_replicas", 1)
+        ):
+            return False
+        return (
+            self.replica_partition_at
+            <= n
+            < self.replica_partition_at + self.replica_partition_ops
+        )
+
+    def fleet_hook(self, num_replicas: int) -> List[dict]:
+        """Fleet-tick hook, consulted once per traffic tick by the fleet
+        chaos driver (bench ``_fleet_chaos_stage`` / tests). Returns the
+        scheduled fleet events for this tick — ``replica_kill`` at
+        ``replica-kill-at``, ``replica_restart`` at ``replica-restart-at``
+        — each fired once, journal-recorded, with the victim chosen by
+        :meth:`replica_target`. The DRIVER executes the decision (stops /
+        restarts the server), mirroring how the executors absorb
+        ``sharded_hook`` decisions."""
+        n = self._tick("fleet")
+        events: List[dict] = []
+        target = self.replica_target(num_replicas)
+        if not self._replica_killed and 0 <= self.replica_kill_at <= n:
+            self._replica_killed = True
+            self._record("replica_kill", n, replica=target)
+            events.append({"kind": "replica_kill", "replica": target})
+        if (
+            self._replica_killed
+            and not self._replica_restarted
+            and 0 <= self.replica_restart_at <= n
+        ):
+            self._replica_restarted = True
+            self._record("replica_restart", n, replica=target)
+            events.append({"kind": "replica_restart", "replica": target})
+        return events
+
     # ----------------------------------------------------------- store hooks
     def before_read(self, store: str) -> None:
         n = self._tick("read")
+        if self._partition_active(n):
+            # journaled once at the leading edge (a window of failing ops
+            # would flood the ring), raised for every op inside it
+            if not self._partition_recorded:
+                self._partition_recorded = True
+                self._record(
+                    "replica_partition", n,
+                    replica=self.replica_index,
+                    ops=self.replica_partition_ops,
+                )
+            raise InjectedFaultError(
+                f"injected storage partition: replica "
+                f"{self.replica_index} cannot reach storage (read #{n}, "
+                f"seed {self.seed})"
+            )
         if (
             self.overload_at >= 0
             and self.overload_latency_ms > 0
@@ -274,6 +394,12 @@ class FaultPlan:
 
     def before_write(self, store: str) -> None:
         n = self._tick("write")
+        if self._partition_active(n):
+            raise InjectedFaultError(
+                f"injected storage partition: replica "
+                f"{self.replica_index} cannot reach storage (write #{n}, "
+                f"seed {self.seed})"
+            )
         if self._chance("write", n, self.write_error_rate):
             self._record("write", n, store=store)
             raise InjectedFaultError(

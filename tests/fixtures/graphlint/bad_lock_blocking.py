@@ -23,7 +23,9 @@ class Client:
 
     def _slow_io(self):
         time.sleep(1.0)
-        return socket.create_connection(("localhost", 1))
+        # timeout keeps this fixture JG208-clean: the smell under test is
+        # the blocking call WHILE HOLDING A LOCK (JG203), not the socket
+        return socket.create_connection(("localhost", 1), 1.0)
 
     def fine(self):
         with self._lock:

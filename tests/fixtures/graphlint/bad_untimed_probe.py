@@ -1,0 +1,64 @@
+"""JG208 fixture: outbound socket/HTTP calls without an explicit timeout.
+
+A router probe, gossip round, or drain handoff that waits forever on a
+dead or partitioned peer hangs the fleet thread that made it — every
+remote hop bounds its wait (server/fleet.py does; this file shows the
+shapes that don't).
+"""
+
+import socket
+import urllib.request
+from socket import create_connection
+from urllib.request import urlopen
+
+import requests
+
+
+def probe_replica_bad(url):
+    with urlopen(url) as resp:  # expect: JG208
+        return resp.read()
+
+
+def probe_replica_bad_qualified(url):
+    with urllib.request.urlopen(url) as resp:  # expect: JG208
+        return resp.read()
+
+
+def probe_replica_explicitly_unbounded(url):
+    # timeout=None is the explicitly-unbounded spelling, not a bound
+    with urlopen(url, timeout=None) as resp:  # expect: JG208
+        return resp.read()
+
+
+def gossip_connect_bad(host, port):
+    return create_connection((host, port))  # expect: JG208
+
+
+def gossip_connect_bad_qualified(host, port):
+    return socket.create_connection((host, port))  # expect: JG208
+
+
+def handoff_bad(url, body):
+    return requests.post(url, json=body)  # expect: JG208
+
+
+def probe_replica_good(url):
+    # bounded: a dead peer costs one timeout, never a hung prober
+    with urlopen(url, timeout=2.0) as resp:
+        return resp.read()
+
+
+def gossip_connect_good(host, port):
+    # deadline may ride the positional slot too
+    return create_connection((host, port), 2.0)
+
+
+def handoff_good(url, body):
+    return requests.post(url, json=body, timeout=(2.0, 5.0))
+
+
+def watchdog_owned_socket(host, port):
+    # an outer watchdog provably tears this socket down: the justified-
+    # suppression case
+    # graphlint: disable=JG208 -- the epoch watchdog closes this socket after connect_timeout_s of silence
+    return create_connection((host, port))
