@@ -4,14 +4,25 @@
 #
 # Usage:
 #   bin/graphlint.sh                      # full package scan
-#   bin/graphlint.sh --changed-only       # only git-changed .py files
-#   bin/graphlint.sh --json               # machine-readable report
+#   bin/graphlint.sh --changed-only       # merge-base diff + working tree
+#   bin/graphlint.sh --format json        # machine-readable report (v2 keys)
 #   bin/graphlint.sh --check-imports      # + syntax/import sweep
+#   bin/graphlint.sh --stats              # call-graph + per-rule counts
 #   bin/graphlint.sh janusgraph_tpu/olap  # scoped scan
+#
+# CI mode (suppression ratchet — fails if any rule's suppression count
+# grows past the checked-in budget):
+#   bin/graphlint.sh --baseline .graphlint-baseline.json
+# Re-bank the budget after removing suppressions:
+#   bin/graphlint.sh --write-baseline .graphlint-baseline.json
+# Inspect the budget table:
+#   bin/graphlint.sh --baseline .graphlint-baseline.json --report-suppressions
 #
 # All flags pass through to `python -m janusgraph_tpu.analysis`
 # (see --help / --list-rules). Suppress a finding in code with
 #   # graphlint: disable=JGnnn -- <why>
+# Mark an explicit context handoff to a worker thread with
+#   # graphlint: handoff  (see docs/static_analysis.md, JG402)
 set -euo pipefail
 
 REPO_ROOT="$(cd "$(dirname "${BASH_SOURCE[0]}")/.." && pwd)"

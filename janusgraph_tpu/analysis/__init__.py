@@ -1,6 +1,10 @@
 """graphlint: repo-native static analysis for the TPU graph framework.
 
-Three rule families guard the invariants the runtime cannot check for us:
+v2 is whole-program: a package-wide symbol table and call graph
+(``analysis/callgraph.py``) let trace-taint, blocking-under-lock, and the
+concurrency rules reason across module boundaries.
+
+Four rule families guard the invariants the runtime cannot check for us:
 
 * **Trace safety** (JG1xx) — the OLAP/parallel layers compile supersteps
   with ``jax.jit``/``shard_map``; a Python-side coercion of a traced value,
@@ -14,13 +18,19 @@ Three rule families guard the invariants the runtime cannot check for us:
   capacity tiers and sentinel-padded fixed shapes; a non-power-of-two tier
   or a literal fill that drifts from the documented sentinel silently
   corrupts results or blows up padding.
+* **Concurrency / context-loss** (JG4xx) — the serving fleet mixes
+  request threads, a probe thread, and scan/reindex pools; the call graph
+  computes what runs on a spawned thread so cross-thread attribute races,
+  contextvar state dropped at pool boundaries, cross-module
+  blocking-under-lock, and leaked threads all become findings.
 
 Everything here is stdlib-only (``ast`` + ``tokenize``): importing this
 package never imports jax/numpy, so the analyzer runs fast anywhere.
 
 Usage::
 
-    python -m janusgraph_tpu.analysis [paths ...] [--json] [--check-imports]
+    python -m janusgraph_tpu.analysis [paths ...] [--format json] [--stats]
+    python -m janusgraph_tpu.analysis janusgraph_tpu --baseline .graphlint-baseline.json
     bin/graphlint.sh --changed-only
 
 Suppression: append ``# graphlint: disable=JG101`` to the flagged line (or

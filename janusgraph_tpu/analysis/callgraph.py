@@ -1,0 +1,612 @@
+"""Whole-program symbol table + call graph for graphlint v2.
+
+graphlint v1 analyzed one module at a time, so every transitive rule
+(JG1xx taint, JG2xx lock/blocking closure) stopped at module boundaries.
+This module builds the package-wide layer those families now consume:
+
+* **Symbol table** per module: top-level defs, classes with their
+  methods, and import aliasing (``import a.b as c``, ``from a.b import f
+  as g``, relative imports resolved against the importing module's
+  package path).
+* **Function registry**: every ``def`` at any nesting depth becomes a
+  :class:`FuncNode` with a stable qualified name
+  (``path.py:Class.method`` / ``path.py:outer.<locals>.inner``).
+* **Bounded call resolution** (:meth:`CallGraph.resolve`), in strictly
+  decreasing confidence order:
+
+  1. lexically visible local defs (nested-scope chain),
+  2. same-module top-level defs / classes (a class resolves to its
+     ``__init__``),
+  3. imported symbols and ``module.attr`` calls through the import
+     aliases,
+  4. ``self.m()`` to the enclosing class (following single-inheritance
+     base names resolvable in the analyzed set),
+  5. typed receivers: ``v = ClassName(...)`` in the same function, or
+     ``self.attr`` whose class assigned ``self.attr = ClassName(...)``
+     in any of its own methods,
+  6. the receiver-name fallback: a method name that is **unique across
+     the entire analyzed set** resolves to that one def.
+
+  Anything else resolves to nothing — unresolved calls simply end the
+  transitive walk (documented unsoundness; see docs/static_analysis.md).
+
+* **Decorator unwrapping**: a decorated def registers under its own
+  name, so calls to ``@functools.wraps``-style wrapped functions and
+  ``@contextmanager`` factories resolve to the decorated body.
+
+Interprocedural traced-context propagation (:func:`propagate_traced`)
+rides the same graph: a jit-traced def calling across a module boundary
+marks the callee traced with exactly the tainted argument positions —
+the v1 same-module taint is the depth-1 case of this walk.
+
+Everything here is stdlib-only and deterministic: iteration orders are
+sorted, so the same tree always yields the same graph (and the same
+byte-identical JSON report downstream).
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from janusgraph_tpu.analysis.core import ModuleInfo
+from janusgraph_tpu.analysis.tracing import terminal_name
+
+
+def module_dotted(path: str) -> str:
+    """Display path -> dotted module name (``a/b/c.py`` -> ``a.b.c``;
+    ``a/b/__init__.py`` -> ``a.b``)."""
+    p = path.replace("\\", "/")
+    if p.endswith(".py"):
+        p = p[:-3]
+    parts = [seg for seg in p.split("/") if seg and seg != "."]
+    if parts and parts[-1] == "__init__":
+        parts = parts[:-1]
+    return ".".join(parts)
+
+
+@dataclass
+class ClassInfo:
+    name: str
+    node: ast.ClassDef
+    #: method name -> def node
+    methods: Dict[str, ast.AST] = field(default_factory=dict)
+    #: textual base-class names (``Base``, ``mod.Base``)
+    bases: List[str] = field(default_factory=list)
+    #: self.<attr> -> class-name expression text it was constructed from
+    attr_types: Dict[str, str] = field(default_factory=dict)
+
+
+@dataclass
+class FuncNode:
+    """One function definition anywhere in the analyzed set."""
+
+    qname: str  # "display/path.py:Class.method" (stable, sorted-unique)
+    mod: ModuleInfo
+    node: ast.AST  # FunctionDef | AsyncFunctionDef
+    cls: Optional[str]  # nearest enclosing class name, if any
+
+    @property
+    def name(self) -> str:
+        return getattr(self.node, "name", "<lambda>")
+
+    @property
+    def lineno(self) -> int:
+        return getattr(self.node, "lineno", 1)
+
+
+@dataclass
+class ModuleSymbols:
+    """Import aliases + top-level defs/classes of one module."""
+
+    mod: ModuleInfo
+    dotted: str
+    #: local alias -> dotted target module ("import a.b as c")
+    import_mods: Dict[str, str] = field(default_factory=dict)
+    #: local alias -> (dotted module, symbol) ("from a.b import f as g")
+    import_syms: Dict[str, Tuple[str, str]] = field(default_factory=dict)
+    #: top-level function name -> def node
+    defs: Dict[str, ast.AST] = field(default_factory=dict)
+    #: top-level class name -> ClassInfo
+    classes: Dict[str, ClassInfo] = field(default_factory=dict)
+
+
+def _rel_target(mod_dotted: str, is_pkg: bool, level: int,
+                name: Optional[str]) -> str:
+    """Resolve a relative import to a dotted target module."""
+    parts = mod_dotted.split(".") if mod_dotted else []
+    if not is_pkg:
+        parts = parts[:-1]  # the module's own name is not a package level
+    drop = level - 1
+    if drop:
+        parts = parts[:-drop] if drop <= len(parts) else []
+    if name:
+        parts = parts + name.split(".")
+    return ".".join(parts)
+
+
+def _collect_symbols(mod: ModuleInfo) -> ModuleSymbols:
+    dotted = module_dotted(mod.path)
+    is_pkg = mod.path.replace("\\", "/").endswith("__init__.py")
+    sym = ModuleSymbols(mod=mod, dotted=dotted)
+    # imports anywhere in the module (function-local imports are the
+    # repo's dominant idiom for heavy deps)
+    for node in ast.walk(mod.tree):
+        if isinstance(node, ast.Import):
+            for alias in node.names:
+                sym.import_mods[alias.asname or alias.name.split(".")[0]] = (
+                    alias.name if alias.asname else alias.name.split(".")[0]
+                )
+        elif isinstance(node, ast.ImportFrom):
+            target = (
+                _rel_target(dotted, is_pkg, node.level, node.module)
+                if node.level else (node.module or "")
+            )
+            for alias in node.names:
+                if alias.name == "*":
+                    continue
+                sym.import_syms[alias.asname or alias.name] = (
+                    target, alias.name
+                )
+    for child in ast.iter_child_nodes(mod.tree):
+        if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            sym.defs[child.name] = child
+        elif isinstance(child, ast.ClassDef):
+            info = ClassInfo(name=child.name, node=child)
+            for b in child.bases:
+                t = terminal_name(b)
+                if t:
+                    info.bases.append(t)
+            for sub in ast.iter_child_nodes(child):
+                if isinstance(sub, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    info.methods[sub.name] = sub
+            # receiver typing: self.<attr> = ClassName(...) in any method
+            for meth in info.methods.values():
+                for stmt in ast.walk(meth):
+                    if not (
+                        isinstance(stmt, ast.Assign)
+                        and isinstance(stmt.value, ast.Call)
+                    ):
+                        continue
+                    ctor = terminal_name(stmt.value.func)
+                    if not ctor or not ctor[:1].isupper():
+                        continue  # heuristics: classes are CapWords here
+                    for tgt in stmt.targets:
+                        if (
+                            isinstance(tgt, ast.Attribute)
+                            and isinstance(tgt.value, ast.Name)
+                            and tgt.value.id == "self"
+                        ):
+                            info.attr_types.setdefault(tgt.attr, ctor)
+            sym.classes[child.name] = info
+    return sym
+
+
+class CallGraph:
+    """Whole-program call graph over a set of parsed modules."""
+
+    def __init__(self, modules: Sequence[ModuleInfo]):
+        self.modules = list(modules)
+        self.symbols: Dict[str, ModuleSymbols] = {}
+        #: dotted module name -> ModuleSymbols (exact and unique-suffix)
+        self._by_dotted: Dict[str, ModuleSymbols] = {}
+        #: id(def node) -> FuncNode
+        self.funcs: Dict[int, FuncNode] = {}
+        self.by_qname: Dict[str, FuncNode] = {}
+        #: method/function name -> [FuncNode ...] across the package
+        self._by_name: Dict[str, List[FuncNode]] = {}
+        #: id(node) -> enclosing FuncNode (for any ast node)
+        self._enclosing: Dict[int, FuncNode] = {}
+        #: id(def node) -> parent def node id (lexical scope chain)
+        self._parent_fn: Dict[int, Optional[int]] = {}
+        #: caller qname -> [(callee FuncNode, call node)]
+        self._edges: Dict[str, List[Tuple[FuncNode, ast.Call]]] = {}
+        #: per-function local receiver types: id(fn) -> {var: class name}
+        self._local_types: Dict[int, Dict[str, str]] = {}
+        for mod in self.modules:
+            self.symbols[mod.path] = _collect_symbols(mod)
+        self._index_dotted()
+        for mod in self.modules:
+            self._register_funcs(mod)
+        for fn in self.funcs.values():
+            self._by_name.setdefault(fn.name, []).append(fn)
+        for lst in self._by_name.values():
+            lst.sort(key=lambda f: f.qname)
+        self._build_edges()
+
+    # ------------------------------------------------------------- indexing
+    def _index_dotted(self) -> None:
+        suffix_count: Dict[str, int] = {}
+        suffix_map: Dict[str, ModuleSymbols] = {}
+        for sym in self.symbols.values():
+            parts = sym.dotted.split(".")
+            for i in range(len(parts)):
+                suf = ".".join(parts[i:])
+                suffix_count[suf] = suffix_count.get(suf, 0) + 1
+                suffix_map[suf] = sym
+        self._by_dotted = {
+            suf: sym for suf, sym in suffix_map.items()
+            if suffix_count[suf] == 1
+        }
+
+    def module_named(self, dotted: str) -> Optional[ModuleSymbols]:
+        """Find an analyzed module by dotted name, matching the longest
+        unique suffix (fixture packages under deep display paths resolve
+        the same way the real package does)."""
+        return self._by_dotted.get(dotted)
+
+    def _register_funcs(self, mod: ModuleInfo) -> None:
+        def walk(node, scope: List[str], cls: Optional[str],
+                 parent_fn: Optional[ast.AST]):
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, ast.ClassDef):
+                    walk(child, scope + [child.name], child.name, parent_fn)
+                elif isinstance(
+                    child, (ast.FunctionDef, ast.AsyncFunctionDef)
+                ):
+                    qname = f"{mod.path}:{'.'.join(scope + [child.name])}"
+                    fn = FuncNode(qname=qname, mod=mod, node=child, cls=cls)
+                    self.funcs[id(child)] = fn
+                    self.by_qname[qname] = fn
+                    self._parent_fn[id(child)] = (
+                        id(parent_fn) if parent_fn is not None else None
+                    )
+                    for sub in ast.walk(child):
+                        self._enclosing.setdefault(id(sub), fn)
+                    # nested defs keep the enclosing class for `self`
+                    walk(child, scope + [child.name, "<locals>"], cls, child)
+
+        walk(mod.tree, [], None, None)
+
+    # ------------------------------------------------------ local type maps
+    def _local_types_of(self, fn: FuncNode) -> Dict[str, str]:
+        cached = self._local_types.get(id(fn.node))
+        if cached is not None:
+            return cached
+        types: Dict[str, str] = {}
+        for stmt in ast.walk(fn.node):
+            if not (
+                isinstance(stmt, ast.Assign)
+                and isinstance(stmt.value, ast.Call)
+                and len(stmt.targets) == 1
+                and isinstance(stmt.targets[0], ast.Name)
+            ):
+                continue
+            ctor = terminal_name(stmt.value.func)
+            if ctor and ctor[:1].isupper():
+                types[stmt.targets[0].id] = ctor
+        self._local_types[id(fn.node)] = types
+        return types
+
+    # ------------------------------------------------------------ resolution
+    def enclosing(self, node: ast.AST) -> Optional[FuncNode]:
+        return self._enclosing.get(id(node))
+
+    def _resolve_class(
+        self, name: str, sym: ModuleSymbols
+    ) -> Optional[Tuple[ModuleSymbols, ClassInfo]]:
+        """A class name visible in `sym`'s module: local, or imported."""
+        info = sym.classes.get(name)
+        if info is not None:
+            return sym, info
+        imp = sym.import_syms.get(name)
+        if imp is not None:
+            target = self.module_named(imp[0])
+            if target is not None:
+                info = target.classes.get(imp[1])
+                if info is not None:
+                    return target, info
+        return None
+
+    def _class_method(
+        self, sym: ModuleSymbols, info: ClassInfo, meth: str,
+        _depth: int = 0,
+    ) -> Optional[FuncNode]:
+        """Method lookup following resolvable base classes (bounded)."""
+        node = info.methods.get(meth)
+        if node is not None:
+            return self.funcs.get(id(node))
+        if _depth >= 4:
+            return None
+        for base in info.bases:
+            hit = self._resolve_class(base, sym)
+            if hit is not None:
+                found = self._class_method(hit[0], hit[1], meth, _depth + 1)
+                if found is not None:
+                    return found
+        return None
+
+    def _resolve_symbol(
+        self, sym: ModuleSymbols, name: str
+    ) -> Optional[FuncNode]:
+        """A bare name in module scope: top-level def, class (its
+        __init__), or an imported symbol from an analyzed module."""
+        node = sym.defs.get(name)
+        if node is not None:
+            return self.funcs.get(id(node))
+        hit = self._resolve_class(name, sym)
+        if hit is not None:
+            return self._class_method(hit[0], hit[1], "__init__")
+        imp = sym.import_syms.get(name)
+        if imp is not None:
+            target = self.module_named(imp[0])
+            if target is not None and imp[1] != name:
+                return self._resolve_symbol(target, imp[1])
+            if target is not None:
+                node = target.defs.get(imp[1])
+                if node is not None:
+                    return self.funcs.get(id(node))
+                chit = target.classes.get(imp[1])
+                if chit is not None:
+                    return self._class_method(target, chit, "__init__")
+            # `from a import b` where a.b is itself an analyzed module
+            submod = self.module_named(
+                f"{imp[0]}.{imp[1]}" if imp[0] else imp[1]
+            )
+            if submod is not None:
+                return None  # a module object, not a callable
+        return None
+
+    def resolve(self, call: ast.Call, mod: ModuleInfo,
+                fallback: bool = True) -> List[FuncNode]:
+        """Best-effort callee candidates for one call site (possibly
+        empty). Bounded: at most one candidate except for the documented
+        unique-name fallback (which is also a single candidate).
+        ``fallback=False`` disables that last-resort name match — the
+        traced-taint propagation uses it, because a jnp array method
+        (``msgs.take(idx)``) colliding with a uniquely-named host def
+        would otherwise teleport jit taint into unrelated code."""
+        return self.resolve_ref(call.func, mod, self.enclosing(call),
+                                fallback=fallback)
+
+    def resolve_ref(
+        self, f: ast.AST, mod: ModuleInfo, encl: Optional[FuncNode] = None,
+        fallback: bool = True,
+    ) -> List[FuncNode]:
+        """Resolve a function REFERENCE expression (not necessarily a
+        call) — the form thread targets take: ``Thread(target=self._loop)``
+        / ``pool.submit(worker, ...)``."""
+        sym = self.symbols[mod.path]
+        if encl is None:
+            encl = self.enclosing(f)
+        if isinstance(f, ast.Name):
+            # lexical chain of nested defs first
+            fn_id = id(encl.node) if encl is not None else None
+            seen = set()
+            while fn_id is not None and fn_id not in seen:
+                seen.add(fn_id)
+                holder = self.funcs.get(fn_id)
+                if holder is not None:
+                    for child in ast.iter_child_nodes(holder.node):
+                        if isinstance(
+                            child, (ast.FunctionDef, ast.AsyncFunctionDef)
+                        ) and child.name == f.id:
+                            got = self.funcs.get(id(child))
+                            return [got] if got else []
+                fn_id = self._parent_fn.get(fn_id)
+            hit = self._resolve_symbol(sym, f.id)
+            return [hit] if hit else []
+        if isinstance(f, ast.Attribute):
+            meth = f.attr
+            recv = f.value
+            # self.m()
+            if (
+                isinstance(recv, ast.Name) and recv.id == "self"
+                and encl is not None and encl.cls is not None
+            ):
+                chit = self._resolve_class(encl.cls, sym)
+                if chit is not None:
+                    got = self._class_method(chit[0], chit[1], meth)
+                    if got is not None:
+                        return [got]
+                if not fallback:
+                    return []
+                return self._unique_name(meth, exclude_cls=None)
+            # module alias: mod.f() / pkg.mod.Class(...)
+            root = recv
+            chain = [meth]
+            while isinstance(root, ast.Attribute):
+                chain.append(root.attr)
+                root = root.value
+            if isinstance(root, ast.Name):
+                target = self._module_for_alias(sym, root.id, chain[1:][::-1])
+                if target is not None:
+                    node = target.defs.get(meth)
+                    if node is not None:
+                        got = self.funcs.get(id(node))
+                        return [got] if got else []
+                    chit = target.classes.get(meth)
+                    if chit is not None:
+                        got = self._class_method(target, chit, "__init__")
+                        return [got] if got else []
+                # typed local receiver: v = ClassName(...); v.m()
+                if isinstance(recv, ast.Name) and encl is not None:
+                    tname = self._local_types_of(encl).get(recv.id)
+                    if tname:
+                        chit = self._resolve_class(tname, sym)
+                        if chit is not None:
+                            got = self._class_method(chit[0], chit[1], meth)
+                            if got is not None:
+                                return [got]
+            # typed instance attribute: self.attr.m()
+            if (
+                isinstance(recv, ast.Attribute)
+                and isinstance(recv.value, ast.Name)
+                and recv.value.id == "self"
+                and encl is not None and encl.cls is not None
+            ):
+                chit = self._resolve_class(encl.cls, sym)
+                if chit is not None:
+                    tname = chit[1].attr_types.get(recv.attr)
+                    if tname:
+                        t2 = self._resolve_class(tname, chit[0])
+                        if t2 is not None:
+                            got = self._class_method(t2[0], t2[1], meth)
+                            if got is not None:
+                                return [got]
+            # bounded receiver-name fallback: package-wide unique name
+            if not fallback:
+                return []
+            return self._unique_name(meth, exclude_cls=None)
+        return []
+
+    def _module_for_alias(
+        self, sym: ModuleSymbols, root: str, mids: List[str]
+    ) -> Optional[ModuleSymbols]:
+        """`root(.mid)*` as a module reference through the import table."""
+        base = sym.import_mods.get(root)
+        if base is None:
+            imp = sym.import_syms.get(root)
+            if imp is not None:
+                base = f"{imp[0]}.{imp[1]}" if imp[0] else imp[1]
+        if base is None:
+            return None
+        dotted = ".".join([base] + mids) if mids else base
+        got = self.module_named(dotted)
+        if got is not None:
+            return got
+        return self.module_named(base) if not mids else None
+
+    def _unique_name(
+        self, name: str, exclude_cls: Optional[str]
+    ) -> List[FuncNode]:
+        """The documented fallback: a def name unique across the whole
+        analyzed set resolves by name alone. Dunder and ultra-generic
+        names never resolve this way."""
+        if name.startswith("__") or name in _GENERIC_NAMES:
+            return []
+        cands = self._by_name.get(name, [])
+        return [cands[0]] if len(cands) == 1 else []
+
+    # ---------------------------------------------------------------- edges
+    def _build_edges(self) -> None:
+        for fn in self.funcs.values():
+            out: List[Tuple[FuncNode, ast.Call]] = []
+            for sub in self._own_body_walk(fn.node):
+                if isinstance(sub, ast.Call):
+                    for callee in self.resolve(sub, fn.mod):
+                        if callee.node is not fn.node:
+                            out.append((callee, sub))
+            self._edges[fn.qname] = out
+
+    @staticmethod
+    def _own_body_walk(fn_node: ast.AST):
+        """Walk a def's body without descending into nested defs (those
+        are their own FuncNodes)."""
+        stack = list(ast.iter_child_nodes(fn_node))
+        while stack:
+            node = stack.pop()
+            if isinstance(
+                node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+            ):
+                continue
+            yield node
+            stack.extend(ast.iter_child_nodes(node))
+
+    def callees(self, fn: FuncNode) -> List[Tuple[FuncNode, ast.Call]]:
+        return self._edges.get(fn.qname, [])
+
+    def node_for(self, def_node: ast.AST) -> Optional[FuncNode]:
+        return self.funcs.get(id(def_node))
+
+    def stats(self) -> Dict[str, int]:
+        return {
+            "modules": len(self.modules),
+            "functions": len(self.funcs),
+            "call_edges": sum(len(v) for v in self._edges.values()),
+            "classes": sum(
+                len(s.classes) for s in self.symbols.values()
+            ),
+        }
+
+
+#: method names too generic for the unique-name fallback even when the
+#: analyzed set happens to define them exactly once
+_GENERIC_NAMES = {
+    "get", "put", "set", "add", "run", "close", "open", "read", "write",
+    "send", "recv", "start", "stop", "update", "append", "pop", "clear",
+    "items", "keys", "values", "join", "submit", "result", "wait", "acquire",
+    "release", "copy", "encode", "decode", "next", "reset", "flush", "name",
+}
+
+
+# ---------------------------------------------------------------------------
+# Interprocedural traced-context propagation (JG1xx across modules)
+# ---------------------------------------------------------------------------
+
+def propagate_traced(
+    modules: Sequence[ModuleInfo], cg: CallGraph
+) -> Dict[str, dict]:
+    """Compute each module's traced-def map with cross-module taint.
+
+    Starts from the per-module discovery (``find_traced_defs`` — the
+    depth-1 case), then fixpoints over the call graph: a traced def
+    calling a resolvable function in ANOTHER analyzed module (or a
+    method reached through a typed receiver) marks the callee traced
+    with exactly the argument positions that are tainted at the call
+    site. ``# graphlint: host`` on the callee stops propagation, same as
+    the module-local walk; constructors never become traced.
+
+    Returns {module display path: {id(def node): TracedDef}}.
+    """
+    from janusgraph_tpu.analysis.tracing import TaintWalker, find_traced_defs
+
+    seeds: Dict[str, Dict[int, Optional[Set[int]]]] = {
+        m.path: {} for m in modules
+    }
+    by_path = {m.path: m for m in modules}
+    traced: Dict[str, dict] = {}
+    for _round in range(12):
+        changed = False
+        for mod in modules:
+            traced[mod.path] = find_traced_defs(mod, seeds=seeds[mod.path])
+        for mod in modules:
+            for td in traced[mod.path].values():
+                if isinstance(td.node, ast.Lambda):
+                    continue
+                walker = TaintWalker(td, mod)
+                walker.run()
+                for call, tainted_idx in walker.all_calls:
+                    # no unique-name fallback here: a jnp array method
+                    # (`msgs.take(i)`) must never alias a host def
+                    for callee in cg.resolve(call, mod, fallback=False):
+                        if callee.name == "__init__":
+                            continue
+                        tmod = by_path.get(callee.mod.path)
+                        if tmod is None:
+                            continue
+                        if callee.lineno in tmod.suppressions.host_lines:
+                            continue
+                        if callee.lineno in tmod.suppressions.traced_lines:
+                            # explicitly marked defs pin their own taint
+                            # choice (traced body, static params) — cross-
+                            # module call sites don't widen it
+                            continue
+                        if (
+                            callee.mod.path == mod.path
+                            and isinstance(call.func, ast.Name)
+                        ):
+                            continue  # the module-local fixpoint owns these
+                        cur = seeds[callee.mod.path].get(id(callee.node))
+                        nxt: Optional[Set[int]]
+                        if cur is None and id(callee.node) in seeds[
+                            callee.mod.path
+                        ]:
+                            nxt = None  # already fully tainted
+                        elif cur is None:
+                            nxt = set(tainted_idx)
+                        else:
+                            nxt = cur | set(tainted_idx)
+                        prev_present = id(callee.node) in seeds[
+                            callee.mod.path
+                        ]
+                        if not prev_present or (
+                            cur is not None and nxt is not None
+                            and nxt != cur
+                        ):
+                            seeds[callee.mod.path][id(callee.node)] = nxt
+                            changed = True
+        if not changed:
+            break
+    return traced

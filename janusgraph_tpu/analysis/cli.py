@@ -12,6 +12,12 @@ import subprocess
 import sys
 from typing import List, Optional, Sequence
 
+from janusgraph_tpu.analysis.baseline import (
+    compare,
+    load_baseline,
+    report_table,
+    write_baseline,
+)
 from janusgraph_tpu.analysis.core import Analyzer
 from janusgraph_tpu.analysis.reporting import (
     list_rules_text,
@@ -26,20 +32,48 @@ def _default_target() -> str:
     return os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
-def changed_python_files(repo_root: Optional[str] = None) -> Optional[List[str]]:
-    """Changed (staged + unstaged + untracked) .py files per git, or None
-    when git is unavailable (caller falls back to a full run)."""
+def _git(args: List[str], repo_root: Optional[str]) -> Optional[str]:
     try:
-        out = subprocess.run(
-            # -uall: list files inside untracked directories individually
-            ["git", "status", "--porcelain", "-uall"],
-            cwd=repo_root or os.getcwd(),
-            capture_output=True, text=True, timeout=30, check=True,
-        ).stdout
+        proc = subprocess.run(
+            ["git"] + args, cwd=repo_root or os.getcwd(),
+            capture_output=True, text=True, timeout=30,
+        )
     except (OSError, subprocess.SubprocessError):
         return None
-    files = []
-    for line in out.splitlines():
+    return proc.stdout if proc.returncode == 0 else None
+
+
+def merge_base(
+    repo_root: Optional[str] = None, base_ref: Optional[str] = None
+) -> Optional[str]:
+    """The merge-base commit against the mainline (explicit ``base_ref``,
+    else the first of origin/main, origin/master, main, master that
+    resolves). None when git or the ref is unavailable."""
+    candidates = (
+        [base_ref] if base_ref
+        else ["origin/main", "origin/master", "main", "master"]
+    )
+    for ref in candidates:
+        out = _git(["merge-base", "HEAD", ref], repo_root)
+        if out and out.strip():
+            return out.strip()
+    return None
+
+
+def changed_python_files(
+    repo_root: Optional[str] = None, base_ref: Optional[str] = None
+) -> Optional[List[str]]:
+    """The .py files a review would see as changed: everything different
+    from the merge-base with the mainline (the branch's own commits) PLUS
+    staged/unstaged/untracked work. None when git is unavailable (caller
+    falls back to a full run). Deleted files are excluded — there is
+    nothing left to lint."""
+    # -uall: list files inside untracked directories individually
+    status = _git(["status", "--porcelain", "-uall"], repo_root)
+    if status is None:
+        return None
+    files = set()
+    for line in status.splitlines():
         if len(line) < 4:
             continue
         path = line[3:].strip()
@@ -47,8 +81,18 @@ def changed_python_files(repo_root: Optional[str] = None) -> Optional[List[str]]
             path = path.split(" -> ", 1)[1]
         path = path.strip('"')
         if path.endswith(".py") and line[:2].strip() != "D":
-            files.append(path)
-    return files
+            files.add(path)
+    base = merge_base(repo_root, base_ref)
+    if base is not None:
+        diff = _git(
+            ["diff", "--name-only", "--diff-filter=d", base, "HEAD"],
+            repo_root,
+        )
+        for path in (diff or "").splitlines():
+            path = path.strip().strip('"')
+            if path.endswith(".py"):
+                files.add(path)
+    return sorted(files)
 
 
 def filter_changed(paths: Sequence[str], changed: Sequence[str]) -> List[str]:
@@ -77,7 +121,15 @@ def build_parser() -> argparse.ArgumentParser:
         help="files/directories to analyze (default: the janusgraph_tpu "
         "package)",
     )
-    p.add_argument("--json", action="store_true", help="JSON report on stdout")
+    p.add_argument(
+        "--json", action="store_true",
+        help="JSON report on stdout (alias for --format json)",
+    )
+    p.add_argument(
+        "--format", choices=("text", "json"), default=None,
+        help="report format (default text); json carries the stable "
+        "file/line/rule/severity keys (schema v2)",
+    )
     p.add_argument(
         "--check-imports", action="store_true",
         help="also py_compile every file and import every package module "
@@ -86,8 +138,32 @@ def build_parser() -> argparse.ArgumentParser:
     )
     p.add_argument(
         "--changed-only", action="store_true",
-        help="only lint .py files git reports as changed (incremental "
-        "builder loop)",
+        help="only lint .py files changed vs the mainline merge-base "
+        "plus uncommitted work (incremental builder loop)",
+    )
+    p.add_argument(
+        "--diff-base", default=None, metavar="REF",
+        help="mainline ref for --changed-only's merge-base (default: "
+        "origin/main, falling back to origin/master/main/master)",
+    )
+    p.add_argument(
+        "--baseline", default=None, metavar="PATH",
+        help="suppression-ratchet CI mode: fail if any rule's "
+        "suppression count exceeds the budget recorded in PATH",
+    )
+    p.add_argument(
+        "--write-baseline", default=None, metavar="PATH",
+        help="record current per-rule suppression counts to PATH "
+        "(bank the ratchet)",
+    )
+    p.add_argument(
+        "--report-suppressions", action="store_true",
+        help="print the per-rule suppression budget table",
+    )
+    p.add_argument(
+        "--stats", action="store_true",
+        help="emit a JSON stats report (per-rule finding/suppression "
+        "counts, call-graph size) instead of the findings listing",
     )
     p.add_argument(
         "--select", default=None,
@@ -122,7 +198,7 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
             return 2
 
     if args.changed_only:
-        changed = changed_python_files()
+        changed = changed_python_files(base_ref=args.diff_base)
         if changed is None:
             print(
                 "graphlint: --changed-only needs git; running full scan",
@@ -148,15 +224,62 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         findings.extend(check_imports(paths))
         findings.sort(key=lambda f: f.sort_key())
 
-    print(to_json(findings, files_scanned) if args.json
-          else to_text(findings, files_scanned))
+    stats = analyzer.last_stats or {}
+    suppressions = dict(stats.get("suppressions_by_rule", {}))
 
+    if args.stats:
+        import json as _json
+
+        print(_json.dumps(stats, indent=2, sort_keys=True))
+    elif args.json or args.format == "json":
+        print(to_json(findings, files_scanned))
+    else:
+        print(to_text(findings, files_scanned))
+
+    rc = 0
     counts = summarize(findings)
     if counts["errors"]:
-        return 1
+        rc = 1
     if args.strict and counts["warnings"]:
-        return 1
-    return 0
+        rc = 1
+
+    budget = None
+    if args.baseline:
+        try:
+            budget = load_baseline(args.baseline)
+        except (OSError, ValueError, KeyError) as e:
+            print(f"graphlint: cannot read baseline: {e}", file=sys.stderr)
+            return 2
+        regressions, improvements = compare(suppressions, budget)
+        for rule, used, allowed in regressions:
+            print(
+                f"graphlint: suppression ratchet: {rule} has {used} "
+                f"suppression(s), budget is {allowed} — fix the finding "
+                "or re-bank with --write-baseline",
+                file=sys.stderr,
+            )
+        if improvements and not regressions:
+            freed = sum(a - u for _r, u, a in improvements)
+            print(
+                f"graphlint: suppression budget has {freed} unused "
+                "slot(s); tighten with --write-baseline",
+                file=sys.stderr,
+            )
+        if regressions:
+            rc = max(rc, 1)
+
+    if args.report_suppressions:
+        print(report_table(suppressions, budget))
+
+    if args.write_baseline:
+        write_baseline(args.write_baseline, suppressions)
+        print(
+            f"graphlint: wrote baseline ({sum(suppressions.values())} "
+            f"suppression(s)) to {args.write_baseline}",
+            file=sys.stderr,
+        )
+
+    return rc
 
 
 if __name__ == "__main__":  # pragma: no cover

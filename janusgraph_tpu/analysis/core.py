@@ -117,6 +117,29 @@ RULES: Dict[str, Rule] = {
              "the whole chain as frontier supersteps over the CSR "
              "snapshot; suppress with justification where the fan-out "
              "is structurally tiny"),
+        # -- concurrency (whole-program, graphlint v2) ----------------------
+        Rule("JG401", SEV_ERROR,
+             "shared attribute mutated from both a thread-entry context "
+             "(Thread target / pool submit) and a non-thread context "
+             "with no common lock across the mutation sites — concurrent "
+             "mutation races; guard every site with one lock or confine "
+             "the state to a single thread"),
+        Rule("JG402", SEV_ERROR,
+             "ambient contextvar scope (deadline / tracer span / "
+             "profiler ledger) accessed on a fresh thread without an "
+             "explicit handoff — contextvars don't cross thread "
+             "boundaries, so the read silently yields the empty default; "
+             "capture with contextvars.copy_context()/capture_scope at "
+             "the spawn site, re-enter the scope explicitly, or mark "
+             "`# graphlint: handoff` naming the mechanism"),
+        Rule("JG403", SEV_ERROR,
+             "blocking call while holding a lock, transitively through "
+             "the cross-module call graph (the JG203 hazard where the "
+             "blocking path crosses a module boundary)"),
+        Rule("JG404", SEV_ERROR,
+             "threading.Thread created without daemon= and without a "
+             "join/stop path reachable from a shutdown/close method — "
+             "the thread outlives the process's intent to exit"),
         # -- padding / shape invariants -------------------------------------
         Rule("JG301", SEV_ERROR,
              "capacity tier constant is not a power of two (ELL/frontier "
@@ -159,9 +182,13 @@ class Finding:
         return (self.path, self.line, self.col, self.rule_id)
 
     def to_dict(self) -> dict:
+        # "file"/"line"/"rule"/"severity" are the STABLE keys tooling may
+        # depend on (schema v2); "path" is the v1 spelling, kept so old
+        # consumers keep working
         return {
             "rule": self.rule_id,
             "severity": self.severity,
+            "file": self.path,
             "path": self.path,
             "line": self.line,
             "col": self.col,
@@ -179,6 +206,7 @@ _DISABLE_FILE_RE = re.compile(
 )
 _TRACED_RE = re.compile(r"#\s*graphlint:\s*traced\b")
 _HOST_RE = re.compile(r"#\s*graphlint:\s*host\b")
+_HANDOFF_RE = re.compile(r"#\s*graphlint:\s*handoff\b")
 
 
 def _parse_ids(blob: str) -> set:
@@ -200,6 +228,11 @@ class Suppressions:
         #: defs here compute HOST constants even when called from a traced
         #: body (e.g. lru-cached numpy masks) — propagation skips them
         self.host_lines: set = set()
+        #: lines marked `# graphlint: handoff` — an explicit statement
+        #: that ambient scope (deadline/span/ledger) is re-established
+        #: across a thread boundary here; JG402's walk stops at a marked
+        #: def or spawn site
+        self.handoff_lines: set = set()
         for i, line in enumerate(source.splitlines(), start=1):
             if "graphlint" not in line:
                 continue
@@ -222,6 +255,10 @@ class Suppressions:
                 self.host_lines.add(i)
                 if line.lstrip().startswith("#"):
                     self.host_lines.add(i + 1)
+            if _HANDOFF_RE.search(line):
+                self.handoff_lines.add(i)
+                if line.lstrip().startswith("#"):
+                    self.handoff_lines.add(i + 1)
 
     def is_suppressed(self, rule_id: str, line: int) -> bool:
         if "ALL" in self.file_rules or rule_id in self.file_rules:
@@ -327,6 +364,8 @@ class Analyzer:
     ):
         self.select = [s.upper() for s in select] if select else None
         self.ignore = [s.upper() for s in ignore] if ignore else []
+        #: populated by analyze_paths: per-rule counts + call-graph size
+        self.last_stats: Optional[dict] = None
 
     def _wanted(self, rule_id: str) -> bool:
         if any(rule_id.startswith(p) for p in self.ignore):
@@ -339,9 +378,19 @@ class Analyzer:
         self, paths: Sequence[str], keep_suppressed: bool = False
     ) -> Tuple[List[Finding], int]:
         """Returns (findings, files_scanned). Suppressed findings are kept
-        (marked) only when `keep_suppressed`."""
+        (marked) only when `keep_suppressed`.
+
+        graphlint v2 driver: modules load first, then the whole-program
+        layer (call graph + interprocedural traced map) is computed ONCE,
+        then per-module families run with that context, then the three
+        cross-module passes (lock-closure JG403, acquisition-order JG202,
+        concurrency JG4xx). ``self.last_stats`` captures per-rule counts
+        and the call-graph size for ``--stats``.
+        """
         from janusgraph_tpu.analysis import (
+            callgraph,
             checkpoint_rules,
+            concurrency_rules,
             lock_rules,
             metric_rules,
             robustness_rules,
@@ -359,18 +408,30 @@ class Analyzer:
                 continue
             modules.append(mod)
 
+        cg = callgraph.CallGraph(modules)
+        traced_maps = callgraph.propagate_traced(modules, cg)
+
         lock_graph = lock_rules.LockGraph()
+        scans: List[lock_rules.ModuleScan] = []
         for mod in modules:
-            findings.extend(trace_rules.check_module(mod))
-            findings.extend(shape_rules.check_module(mod))
-            findings.extend(lock_rules.check_module(mod, lock_graph))
+            traced = traced_maps.get(mod.path)
+            findings.extend(trace_rules.check_module(mod, traced))
+            findings.extend(shape_rules.check_module(mod, traced))
+            findings.extend(lock_rules.check_module(mod, lock_graph, scans))
             findings.extend(robustness_rules.check_module(mod))
             findings.extend(checkpoint_rules.check_module(mod))
             findings.extend(metric_rules.check_module(mod))
+        findings.extend(
+            lock_rules.finalize_cross_module(scans, cg, lock_graph)
+        )
+        findings.extend(concurrency_rules.check_program(modules, cg))
         findings.extend(lock_graph.order_findings())
 
         out = []
+        suppressed_counts: Dict[str, int] = {}
+        finding_counts: Dict[str, int] = {}
         seen = set()
+        mods_by_path = {m.path: m for m in modules}
         for f in findings:
             if not self._wanted(f.rule_id):
                 continue
@@ -380,16 +441,27 @@ class Analyzer:
             if key in seen:
                 continue
             seen.add(key)
-            mod = next((m for m in modules if m.path == f.path), None)
+            mod = mods_by_path.get(f.path)
             if mod is not None and mod.suppressions.is_suppressed(
                 f.rule_id, f.line
             ):
+                suppressed_counts[f.rule_id] = (
+                    suppressed_counts.get(f.rule_id, 0) + 1
+                )
                 if keep_suppressed:
                     f.suppressed = True
                     out.append(f)
                 continue
+            finding_counts[f.rule_id] = finding_counts.get(f.rule_id, 0) + 1
             out.append(f)
         out.sort(key=Finding.sort_key)
+        self.last_stats = {
+            "files_scanned": len(pairs),
+            "callgraph": cg.stats(),
+            "findings_by_rule": dict(sorted(finding_counts.items())),
+            "suppressions_by_rule": dict(sorted(suppressed_counts.items())),
+            "traced_defs": sum(len(t) for t in traced_maps.values()),
+        }
         return out, len(pairs)
 
 

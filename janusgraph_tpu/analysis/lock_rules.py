@@ -13,6 +13,15 @@ JG203  blocking call while holding a lock: `time.sleep`, socket I/O,
        transitively through same-module calls (resolved by name:
        `self.m()` to the enclosing class, bare `f()` to module defs,
        `other.m()` only when the method name is unique in the module).
+JG403  graphlint v2: the same hazard when the blocking path crosses a
+       MODULE boundary — a call made while holding a lock resolves
+       through the whole-program call graph (analysis/callgraph.py) to a
+       def in another analyzed module whose transitive closure blocks.
+       JG203 keeps the module-local cases byte-for-byte (no coverage
+       regressions); JG403 is strictly additive on top. The cross-module
+       closure also feeds the callee's transitive lock acquisitions into
+       the global acquisition-order graph, so the JG202 cycle check runs
+       over the real cross-module graph.
 
 Lock identity is lexical: `self._lock` inside class C of module M is the
 lock "M:C.self._lock". That maps each *instance* attribute to one node per
@@ -281,7 +290,24 @@ class _FnScanner(ast.NodeVisitor):
             self.visit(stmt)
 
 
-def check_module(mod, graph: LockGraph) -> List[Finding]:
+@dataclass
+class ModuleScan:
+    """Per-module scan state kept for the cross-module finalize pass."""
+
+    mod: object
+    fns: List[_FnInfo]
+    #: (line, col) of call sites already flagged JG203 by the local pass,
+    #: so the cross-module pass never double-reports them as JG403
+    flagged_sites: Set[Tuple[int, int]] = field(default_factory=set)
+
+
+def check_module(mod, graph: LockGraph, collector=None) -> List[Finding]:
+    """Module-local JG201/JG202-edges/JG203 — behavior identical to v1.
+
+    When `collector` (a list) is given, the per-function scan state is
+    appended as a ModuleScan so finalize_cross_module can run the
+    whole-program closure afterwards.
+    """
     findings: List[Finding] = []
     fns: List[_FnInfo] = []
     by_key: Dict[str, List[_FnInfo]] = {}
@@ -344,6 +370,7 @@ def check_module(mod, graph: LockGraph) -> List[Finding]:
                         info.blocks = True
                         changed = True
 
+    flagged: Set[Tuple[int, int]] = set()
     for info in fns:
         # direct nesting edges
         for held, acquired, node in info.nest:
@@ -368,6 +395,7 @@ def check_module(mod, graph: LockGraph) -> List[Finding]:
                         f"holding `{held[-1].rsplit('.', 1)[-1]}` — a "
                         f"blocked holder stalls every contender",
                     ))
+                    flagged.add((node.lineno, node.col_offset))
         # direct blocking calls under a lock
         for held, node, desc in info.blocked:
             findings.append(_finding(
@@ -376,4 +404,89 @@ def check_module(mod, graph: LockGraph) -> List[Finding]:
                 f"`{held.rsplit('.', 1)[-1]}` — move the wait outside the "
                 f"critical section",
             ))
+            flagged.add((node.lineno, node.col_offset))
+    if collector is not None:
+        collector.append(ModuleScan(mod=mod, fns=fns, flagged_sites=flagged))
+    return findings
+
+
+# ------------------------------------------------- cross-module finalize (v2)
+def finalize_cross_module(scans: List[ModuleScan], cg,
+                          graph: LockGraph) -> List[Finding]:
+    """Whole-program closure over the call graph: JG403 + cross-module
+    lock-order edges.
+
+    Runs a global acquires/blocks fixpoint over callgraph edges (the
+    module-local fixpoint in check_module is its depth-0 restriction),
+    then revisits every call site made while holding a lock. A callee in
+    ANOTHER module contributes its transitive acquisitions as order
+    edges and, if its closure blocks, a JG403 finding; same-module sites
+    the local pass already resolved are skipped, so JG203 output is
+    unchanged and JG403 is purely additive.
+    """
+    findings: List[Finding] = []
+    info_of: Dict[int, _FnInfo] = {}
+    scan_of: Dict[int, ModuleScan] = {}
+    for scan in scans:
+        for info in scan.fns:
+            info_of[id(info.node)] = info
+            scan_of[id(info.node)] = scan
+
+    # global fixpoint: merge callee acquires/blocks through cg edges
+    changed = True
+    passes = 0
+    while changed and passes < 30:
+        changed = False
+        passes += 1
+        for scan in scans:
+            for info in scan.fns:
+                fn = cg.node_for(info.node)
+                if fn is None:
+                    continue
+                for callee, _call in cg.callees(fn):
+                    ci = info_of.get(id(callee.node))
+                    if ci is None or ci is info:
+                        continue
+                    if not ci.acquires <= info.acquires:
+                        info.acquires |= ci.acquires
+                        changed = True
+                    if ci.blocks and not info.blocks:
+                        info.blocks = True
+                        changed = True
+
+    for scan in scans:
+        mod = scan.mod
+        for info in scan.fns:
+            fn = cg.node_for(info.node)
+            if fn is None:
+                continue
+            held_at = {id(node): held for _k, held, node in info.calls}
+            for callee, call in cg.callees(fn):
+                held = held_at.get(id(call))
+                if not held:
+                    continue
+                ci = info_of.get(id(callee.node))
+                if ci is None or ci is info:
+                    continue
+                cross = scan_of[id(callee.node)].mod.path != mod.path
+                if not cross:
+                    continue  # module-local pass owns same-module sites
+                for acq in sorted(ci.acquires):
+                    graph.add_edge(held[-1], acq, mod.path, call.lineno)
+                site = (call.lineno, call.col_offset)
+                if ci.blocks and site not in scan.flagged_sites:
+                    try:
+                        desc = ast.unparse(call.func)
+                    except Exception:  # pragma: no cover
+                        desc = callee.name
+                    findings.append(_finding(
+                        "JG403", mod, call,
+                        f"`{desc}()` can block (transitively, via "
+                        f"{callee.qname}) while holding "
+                        f"`{held[-1].rsplit('.', 1)[-1]}` — the blocking "
+                        f"path crosses a module boundary; a blocked "
+                        f"holder stalls every contender",
+                    ))
+                    scan.flagged_sites.add(site)
+    findings.sort(key=Finding.sort_key)
     return findings

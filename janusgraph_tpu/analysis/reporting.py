@@ -7,7 +7,10 @@ from typing import Dict, List
 
 from janusgraph_tpu.analysis.core import Finding, RULES, SEV_ERROR, SEV_WARNING
 
-SCHEMA_VERSION = 1
+#: v2: finding objects carry the stable ``file``/``line``/``rule``/
+#: ``severity`` keys (plus ``col``/``message``/``suppressed``); ``path``
+#: is kept as the v1 alias of ``file``
+SCHEMA_VERSION = 2
 
 
 def summarize(findings: List[Finding]) -> Dict[str, int]:
@@ -58,7 +61,7 @@ def from_json(blob: str) -> List[Finding]:
         Finding(
             rule_id=d["rule"],
             severity=d["severity"],
-            path=d["path"],
+            path=d.get("file", d.get("path")),
             line=d["line"],
             col=d["col"],
             message=d["message"],

@@ -234,9 +234,9 @@ def _check_sentinel_fills(mod) -> List[Finding]:
     return out
 
 
-def _check_dynamic_shapes(mod) -> List[Finding]:
+def _check_dynamic_shapes(mod, traced) -> List[Finding]:
     out: List[Finding] = []
-    for td in find_traced_defs(mod).values():
+    for td in traced.values():
         name = getattr(td.node, "name", "<lambda>")
         for sub in ast.walk(td.node):
             if not isinstance(sub, ast.Call):
@@ -261,8 +261,10 @@ def _check_dynamic_shapes(mod) -> List[Finding]:
     return out
 
 
-def check_module(mod) -> List[Finding]:
+def check_module(mod, traced=None) -> List[Finding]:
+    if traced is None:
+        traced = find_traced_defs(mod)
     out = _check_capacity_tiers(mod)
     out.extend(_check_sentinel_fills(mod))
-    out.extend(_check_dynamic_shapes(mod))
+    out.extend(_check_dynamic_shapes(mod, traced))
     return out

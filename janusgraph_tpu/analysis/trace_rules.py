@@ -70,9 +70,9 @@ def _is_constant_expr(node: ast.AST) -> bool:
     return False
 
 
-def _check_traced_bodies(mod) -> List[Finding]:
+def _check_traced_bodies(mod, traced) -> List[Finding]:
     out: List[Finding] = []
-    for td in find_traced_defs(mod).values():
+    for td in traced.values():
         if isinstance(td.node, ast.Lambda):
             continue
         walker = TaintWalker(td, mod)
@@ -197,12 +197,12 @@ def _chain_names(node: ast.AST) -> Set[str]:
     return out
 
 
-def _check_telemetry_in_trace(mod) -> List[Finding]:
+def _check_telemetry_in_trace(mod, traced) -> List[Finding]:
     """JG106: metric/span recording calls inside traced bodies. The
     receiver chain must touch a telemetry root name — `.update()` on a
     dict or `x.at[i].set(v)` never match."""
     out: List[Finding] = []
-    for td in find_traced_defs(mod).values():
+    for td in traced.values():
         name = getattr(td.node, "name", "<lambda>")
         for sub in ast.walk(td.node):
             if not isinstance(sub, ast.Call):
@@ -236,12 +236,12 @@ _LOGGER_EMITTERS = {"debug", "info", "warning", "error", "exception",
                     "critical"}
 
 
-def _check_flight_in_trace(mod) -> List[Finding]:
+def _check_flight_in_trace(mod, traced) -> List[Finding]:
     """JG107: flight-recorder records / structured-log emits inside traced
     bodies. Receiver-chain matched like JG106, so `math.log(x)` or a
     dict's `.update()` never hit."""
     out: List[Finding] = []
-    for td in find_traced_defs(mod).values():
+    for td in traced.values():
         name = getattr(td.node, "name", "<lambda>")
         for sub in ast.walk(td.node):
             if not isinstance(sub, ast.Call) or not isinstance(
@@ -281,12 +281,12 @@ _PROFILER_BARE_NAMES = {
 }
 
 
-def _check_profiler_in_trace(mod) -> List[Finding]:
+def _check_profiler_in_trace(mod, traced) -> List[Finding]:
     """JG108: ledger/digest/cost-model calls inside traced bodies.
     Receiver-chain matched like JG106 — a set's `.add()` or a dict's
     `.merge()` never hit unless the chain touches a profiler root."""
     out: List[Finding] = []
-    for td in find_traced_defs(mod).values():
+    for td in traced.values():
         name = getattr(td.node, "name", "<lambda>")
         for sub in ast.walk(td.node):
             if not isinstance(sub, ast.Call):
@@ -378,11 +378,18 @@ def _check_donated_reuse(mod) -> List[Finding]:
     return out
 
 
-def check_module(mod) -> List[Finding]:
-    out = _check_traced_bodies(mod)
+def check_module(mod, traced=None) -> List[Finding]:
+    """`traced` is the precomputed traced-def map for this module — with
+    graphlint v2 the driver computes it ONCE per module via the
+    whole-program call graph (callgraph.propagate_traced), so cross-module
+    jit-taint chains reach here; standalone callers omit it and get the
+    module-local view."""
+    if traced is None:
+        traced = find_traced_defs(mod)
+    out = _check_traced_bodies(mod, traced)
     out.extend(_check_jit_callsites(mod))
     out.extend(_check_donated_reuse(mod))
-    out.extend(_check_telemetry_in_trace(mod))
-    out.extend(_check_flight_in_trace(mod))
-    out.extend(_check_profiler_in_trace(mod))
+    out.extend(_check_telemetry_in_trace(mod, traced))
+    out.extend(_check_flight_in_trace(mod, traced))
+    out.extend(_check_profiler_in_trace(mod, traced))
     return out

@@ -132,8 +132,15 @@ def _candidate_fn_names(arg: ast.AST) -> List[Tuple[ast.AST, str]]:
     return out
 
 
-def find_traced_defs(mod) -> Dict[int, TracedDef]:
-    """All traced contexts of a module: {id(def_node): TracedDef}."""
+def find_traced_defs(mod, seeds=None) -> Dict[int, TracedDef]:
+    """All traced contexts of a module: {id(def_node): TracedDef}.
+
+    ``seeds`` is the cross-module extension point (graphlint v2): a
+    mapping {id(def node): tainted param indices or None} injected by
+    the call-graph fixpoint (callgraph.propagate_traced) for defs that
+    are reached from a traced context in ANOTHER module. The
+    module-local walk below is exactly the depth-1 case of that walk.
+    """
     index = _ScopeIndex()
     index.visit(mod.tree)
     traced: Dict[int, TracedDef] = {}
@@ -161,6 +168,17 @@ def find_traced_defs(mod) -> Dict[int, TracedDef]:
                 cur.tainted_params = None
             else:
                 cur.tainted_params |= tainted
+
+    if seeds:
+        # cross-module seeds first, so the local fixpoint extends them
+        for node in ast.walk(mod.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                if id(node) in seeds:
+                    tp = seeds[id(node)]
+                    mark(
+                        node, None if tp is None else set(tp),
+                        "called-from-traced-xmod",
+                    )
 
     for node in ast.walk(mod.tree):
         if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
@@ -274,6 +292,9 @@ class TaintWalker:
             }
         #: (Name call node, tainted positional indices) for local-def calls
         self.local_calls: List[Tuple[ast.Call, Set[int]]] = []
+        #: every call node with its tainted positional indices — Name AND
+        #: Attribute receivers, for the cross-module call-graph fixpoint
+        self.all_calls: List[Tuple[ast.Call, Set[int]]] = []
         #: events: ("coerce"|"branch"|"hostsync", node, detail)
         self.events: List[Tuple[str, ast.AST, str]] = []
 
@@ -400,11 +421,13 @@ class TaintWalker:
                     self.events.append(("hostsync", sub, t))
             # same-scope local call: record tainted arg positions so the
             # module fixpoint can propagate traced context into helpers
-            if isinstance(sub.func, ast.Name):
+            if isinstance(sub.func, (ast.Name, ast.Attribute)):
                 idx = {
                     i for i, a in enumerate(sub.args) if self.is_tainted(a)
                 }
-                self.local_calls.append((sub, idx))
+                if isinstance(sub.func, ast.Name):
+                    self.local_calls.append((sub, idx))
+                self.all_calls.append((sub, idx))
 
     def _stmt(self, stmt: ast.AST):
         if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):

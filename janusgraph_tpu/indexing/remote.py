@@ -819,6 +819,7 @@ class RemoteIndexProvider(IndexProvider):
             with conn.lock:
                 if conn.sock is None:
                     try:
+                        # graphlint: disable=JG403 -- conn.lock exists to serialize the wire protocol on THIS connection; blocking while holding it is its whole job, and other pool connections proceed
                         conn._connect()
                     except OSError as e:
                         raise TemporaryBackendError(
@@ -828,6 +829,7 @@ class RemoteIndexProvider(IndexProvider):
                     import time as _time
 
                     t0 = _time.monotonic()
+                    # graphlint: disable=JG403 -- per-connection lock serializes request/response framing on one socket by design; contention moves to another pool slot, not behind this one
                     status, payload, _sock = conn.request(op, body)
                     # adaptive-gate latency signal (lock wait excluded)
                     self._op_ewma_s = (

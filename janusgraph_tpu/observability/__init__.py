@@ -70,6 +70,7 @@ from janusgraph_tpu.observability.spans import (
     Span,
     TraceContext,
     Tracer,
+    capture_scope,
     tracer,
 )
 from janusgraph_tpu.observability.timeline import (
@@ -125,6 +126,7 @@ __all__ = [
     "Tracer",
     "accrue",
     "accrue_wall",
+    "capture_scope",
     "chrome_trace",
     "current_ledger",
     "digest_table",

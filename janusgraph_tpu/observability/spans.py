@@ -360,6 +360,37 @@ class Tracer:
             self._slow.clear()
 
 
+def capture_scope(fn):
+    """Bind ``fn`` to the caller's ambient scope for execution on another
+    thread.
+
+    ``contextvars`` do not cross thread boundaries: a pool worker starts
+    from an empty context, so the submitting request's current span,
+    deadline, and profiler ledger silently vanish (graphlint JG402). This
+    is the explicit handoff: it snapshots every contextvar at call time
+    and returns a wrapper that re-enters the snapshot around each
+    invocation::
+
+        with span("store.scan"):
+            pool.map(capture_scope(work), splits)   # workers keep the span
+
+    Each invocation sets/resets the vars on its own thread rather than
+    sharing one ``Context.run`` — a single ``Context`` object refuses
+    concurrent entry, and pool workers run concurrently by design.
+    """
+    snapshot = list(contextvars.copy_context().items())
+
+    def _reentered(*args, **kwargs):
+        tokens = [(var, var.set(value)) for var, value in snapshot]
+        try:
+            return fn(*args, **kwargs)
+        finally:
+            for var, token in reversed(tokens):
+                var.reset(token)
+
+    return _reentered
+
+
 #: process-wide tracer; `janusgraph_tpu.observability.span` is its
 #: `span` method
 tracer = Tracer()

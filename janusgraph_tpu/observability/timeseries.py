@@ -151,39 +151,48 @@ class MetricsHistory:
         t0 = time.perf_counter()
         counters, timers, histograms, gauges = registry.metric_objects()
         counter_deltas: Dict[str, int] = {}
-        for name, c in counters.items():
-            cur = c.count
-            prev = self._prev_counters.get(name)
-            self._prev_counters[name] = cur
-            # first sight of a counter: the whole cumulative value is the
-            # window's delta (a restart-reset registry behaves the same —
-            # deltas never go negative, matching Prometheus rate() resets)
-            delta = cur - prev if prev is not None and cur >= prev else cur
-            if delta:
-                counter_deltas[name] = delta
         hist_windows: Dict[str, dict] = {}
-        for name, h in list(timers.items()) + list(histograms.items()):
-            count, total, hi, counts = h.state()
-            prev = self._prev_hist.get(name)
-            self._prev_hist[name] = (count, total, counts)
-            if prev is not None and count >= prev[0]:
-                dcount = count - prev[0]
-                dtotal = total - prev[1]
-                dcounts = [a - b for a, b in zip(counts, prev[2])]
-            else:
-                dcount, dtotal, dcounts = count, total, counts
-            if dcount <= 0:
-                continue
-            hist_windows[name] = {
-                "kind": "timer" if name in timers else "histogram",
-                "count": dcount,
-                "sum": dtotal,
-                "max": hi,  # cumulative max (windowed max is not derivable)
-                "buckets": dcounts,
-                "p50": Histogram.percentile_of(dcounts, 0.50, hi),
-                "p95": Histogram.percentile_of(dcounts, 0.95, hi),
-                "p99": Histogram.percentile_of(dcounts, 0.99, hi),
-            }
+        # the prev-cumulative maps are shared with reset(), which clears
+        # them under _lock from the caller's thread while this runs on the
+        # sampler thread — diff and update them under the same lock (the
+        # reads here are in-memory registry state, never blocking)
+        with self._lock:
+            for name, c in counters.items():
+                cur = c.count
+                prev = self._prev_counters.get(name)
+                self._prev_counters[name] = cur
+                # first sight of a counter: the whole cumulative value is
+                # the window's delta (a restart-reset registry behaves the
+                # same — deltas never go negative, matching Prometheus
+                # rate() resets)
+                delta = (
+                    cur - prev if prev is not None and cur >= prev else cur
+                )
+                if delta:
+                    counter_deltas[name] = delta
+            for name, h in list(timers.items()) + list(histograms.items()):
+                count, total, hi, counts = h.state()
+                prev = self._prev_hist.get(name)
+                self._prev_hist[name] = (count, total, counts)
+                if prev is not None and count >= prev[0]:
+                    dcount = count - prev[0]
+                    dtotal = total - prev[1]
+                    dcounts = [a - b for a, b in zip(counts, prev[2])]
+                else:
+                    dcount, dtotal, dcounts = count, total, counts
+                if dcount <= 0:
+                    continue
+                hist_windows[name] = {
+                    "kind": "timer" if name in timers else "histogram",
+                    "count": dcount,
+                    "sum": dtotal,
+                    # max is cumulative (windowed max is not derivable)
+                    "max": hi,
+                    "buckets": dcounts,
+                    "p50": Histogram.percentile_of(dcounts, 0.50, hi),
+                    "p95": Histogram.percentile_of(dcounts, 0.95, hi),
+                    "p99": Histogram.percentile_of(dcounts, 0.99, hi),
+                }
         gauge_values = {
             name: g.value for name, g in gauges.items()
         }

@@ -1008,6 +1008,7 @@ class DeltaSnapshot:
                     return self.csr, view, info
             from janusgraph_tpu.olap.csr import load_csr_snapshot
 
+            # graphlint: disable=JG403 -- single-repacker by design: acquire() holds _lock across the cold repack so concurrent submitters share ONE snapshot load instead of racing N repacks
             csr, epoch = load_csr_snapshot(self.graph)
             self._install(csr, epoch)
             registry.counter("olap.delta.packs").inc()
@@ -1027,6 +1028,7 @@ class DeltaSnapshot:
         self.csr = csr
         self.epoch = epoch
         self.generation += 1
+        # graphlint: disable=JG401 -- every caller (acquire, adopt) holds self._lock per this method's contract ("lock held"); the analyzer cannot see caller-held locks
         self._executors.clear()
 
     # ------------------------------------------------- warm executor cache

@@ -207,7 +207,12 @@ class DistributedIndexManagement:
             ]
             return scanner.execute(job, key_ranges=ranges, num_workers=1)
 
+        from janusgraph_tpu.observability import capture_scope
+
+        # pool workers start from an empty contextvars context; without
+        # the capture the per-split scan spans detach from the caller's
+        # trace and ledger/deadline attribution is lost (JG402)
         with ThreadPoolExecutor(max_workers=self.num_workers) as pool:
-            for metrics in pool.map(run_split, splits):
+            for metrics in pool.map(capture_scope(run_split), splits):
                 merged.merge(metrics)
         return merged

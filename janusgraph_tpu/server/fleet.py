@@ -283,29 +283,49 @@ class FleetRouter:
         the crash-detection path, distinct from graceful drain."""
         targets = [name] if name else list(self.replicas())
         for n in targets:
-            handle = self._replicas.get(n)
+            with self._lock:
+                handle = self._replicas.get(n)
+                base_url = handle.base_url if handle is not None else None
             if handle is None:
                 continue
+            # the blocking HTTP probe runs OUTSIDE the lock (a slow peer
+            # must not stall routing); state transitions re-take it below
+            # so the probe thread never races mark_dead/mark_serving/
+            # add_replica, which mutate the same handle under _lock
             try:
                 payload = self._fetch(
-                    handle.base_url + "/healthz", self.probe_timeout_s
+                    base_url + "/healthz", self.probe_timeout_s
                 )
             except Exception:  # noqa: BLE001 - any probe failure counts
-                handle.probe_failures += 1
-                handle.last_probe_ts = self._clock()
-                if handle.probe_failures >= 2 and handle.state != DEAD:
+                with self._lock:
+                    if self._replicas.get(n) is not handle:
+                        continue  # removed/re-added mid-probe: stale handle
+                    handle.probe_failures += 1
+                    handle.last_probe_ts = self._clock()
+                    dead = (
+                        handle.probe_failures >= 2
+                        and handle.state != DEAD
+                    )
+                if dead:
                     self.mark_dead(n, reason="probe")
                 continue
-            handle.probe_failures = 0
-            handle.last_probe_ts = self._clock()
-            handle.health = payload if isinstance(payload, dict) else {}
-            if handle.state == DEAD:
+            rejoined = False
+            with self._lock:
+                if self._replicas.get(n) is not handle:
+                    continue  # removed/re-added mid-probe: stale handle
+                handle.probe_failures = 0
+                handle.last_probe_ts = self._clock()
+                handle.health = payload if isinstance(payload, dict) else {}
+                rejoined = handle.state == DEAD
+                if (
+                    not rejoined
+                    and handle.health.get("draining")
+                    and handle.state == SERVING
+                ):
+                    handle.state = DRAINING
+            if rejoined:
                 # the replica answered: it rejoined (restart path)
                 self.mark_serving(n)
-            elif handle.health.get("draining") and (
-                handle.state == SERVING
-            ):
-                handle.state = DRAINING
 
     def start_probes(self, interval_s: float = 1.0) -> None:
         """Background probe loop (the runner path; tests call probe())."""
@@ -492,10 +512,12 @@ class FleetRouter:
                 if e.status in ("shed", "draining"):
                     handle.stats["shed"] += 1
                     retriable, wait_s, last_err = True, e.retry_after_s, e
-                    if e.status == "draining" and (
-                        handle.state == SERVING
-                    ):
-                        handle.state = DRAINING
+                    if e.status == "draining":
+                        # under _lock: the probe thread writes handle.state
+                        # under the same lock (JG401)
+                        with self._lock:
+                            if handle.state == SERVING:
+                                handle.state = DRAINING
                 else:
                     # evaluation/client errors are the CALLER's problem —
                     # rerouting a bad query just fails it N times
@@ -512,8 +534,13 @@ class FleetRouter:
                 # connect refusal / timeout / open breaker: this replica
                 # is gone or unreachable — crash-detection path
                 if not isinstance(e, CircuitOpenError):
-                    handle.probe_failures += 1
-                    if handle.probe_failures >= 2:
+                    # under _lock: races the probe thread's
+                    # `handle.probe_failures = 0` reset (JG401); mark_dead
+                    # re-takes the lock, so call it after release
+                    with self._lock:
+                        handle.probe_failures += 1
+                        dead = handle.probe_failures >= 2
+                    if dead:
                         self.mark_dead(handle.name, reason="connect")
                 retriable, wait_s, last_err = True, None, e
             if not retriable:

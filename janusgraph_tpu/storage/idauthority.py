@@ -385,5 +385,6 @@ class StandardIDPool:
                     self._prefetch_thread = None
 
         t = threading.Thread(target=run, daemon=True, name="id-prefetch")
+        # graphlint: disable=JG401 -- _start_prefetch is only called from next_id with self._lock already held; the prefetch thread's writes take the same lock
         self._prefetch_thread = t
         t.start()

@@ -120,7 +120,7 @@ class StandardScanner:
         queries = job.get_queries()
         if not queries:
             raise ValueError("ScanJob declared no queries")
-        from janusgraph_tpu.observability import registry, span
+        from janusgraph_tpu.observability import capture_scope, registry, span
 
         with span(
             "store.scan", job=type(job).__name__, store=self.store.name,
@@ -141,10 +141,14 @@ class StandardScanner:
                     for rng in key_ranges:
                         self._scan_range(job, queries, rng, metrics, batch_size)
                 else:
+                    # capture_scope: worker threads re-enter this span's
+                    # context so per-range store reads stay attributed to
+                    # the scan's trace/ledger/deadline (JG402 handoff)
+                    scan_range = capture_scope(self._scan_range)
                     with ThreadPoolExecutor(max_workers=num_workers) as pool:
                         futs = [
                             pool.submit(
-                                self._scan_range, job, queries, rng, metrics,
+                                scan_range, job, queries, rng, metrics,
                                 batch_size,
                             )
                             for rng in key_ranges

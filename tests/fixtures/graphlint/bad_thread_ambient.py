@@ -1,0 +1,33 @@
+"""JG402 fixture: ambient contextvar scope read on a fresh pool thread
+without an explicit handoff (parse-only)."""
+from concurrent.futures import ThreadPoolExecutor
+
+from janusgraph_tpu.core.deadline import remaining_ms
+from janusgraph_tpu.observability import capture_scope, ledger_scope, span
+
+
+def work(item):
+    with span("work", item=item):  # expect: JG402
+        return remaining_ms()  # expect: JG402
+
+
+def work_scoped(item):
+    # re-enters its own ambience: a fresh thread below this is fine
+    with ledger_scope("work"):
+        return remaining_ms()  # must NOT fire
+
+
+def run_all(items):
+    with ThreadPoolExecutor(max_workers=4) as pool:
+        return list(pool.map(work, items))
+
+
+def run_scoped(items):
+    with ThreadPoolExecutor(max_workers=4) as pool:
+        return list(pool.map(work_scoped, items))  # must NOT fire
+
+
+def run_wrapped(items):
+    # wrapped target: the handoff is explicit, no entry at all
+    with ThreadPoolExecutor(max_workers=4) as pool:
+        return list(pool.map(capture_scope(work), items))

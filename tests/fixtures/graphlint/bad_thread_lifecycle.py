@@ -1,0 +1,49 @@
+"""JG404 fixture: non-daemon threads with no join/stop path
+(parse-only)."""
+import threading
+
+
+def fire_and_forget(fn):
+    t = threading.Thread(target=fn)  # expect: JG404
+    t.start()
+    return t
+
+
+def explicit_non_daemon(fn):
+    t = threading.Thread(target=fn, daemon=False)  # expect: JG404
+    t.start()
+    return t
+
+
+def forked_and_joined(fn):
+    # structured fork-join in the same function: must NOT fire
+    t = threading.Thread(target=fn)
+    t.start()
+    t.join()
+
+
+def daemonized(fn):
+    # reaped at interpreter exit: must NOT fire
+    threading.Thread(target=fn, daemon=True).start()
+
+
+class Leaky:
+    def start(self):
+        self._t = threading.Thread(target=self._loop)  # expect: JG404
+        self._t.start()
+
+    def _loop(self):
+        pass
+
+
+class Managed:
+    # the enclosing class joins from a shutdown-family method: must NOT fire
+    def start(self):
+        self._t = threading.Thread(target=self._loop)
+        self._t.start()
+
+    def _loop(self):
+        pass
+
+    def stop(self):
+        self._t.join(timeout=2.0)

@@ -1,0 +1,36 @@
+"""JG401 fixture: attribute mutated from both a background thread and
+the request path with no common lock (parse-only)."""
+import threading
+
+
+class Sampler:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.windows = []
+        self.seq = 0
+        self.total = 0
+
+    def start(self):
+        t = threading.Thread(target=self._loop, daemon=True)
+        t.start()
+
+    def _loop(self):
+        self.seq += 1  # expect: JG401
+        self.windows.append(self.seq)  # expect: JG401
+        with self._lock:
+            self.total += 1  # guarded on BOTH sides: must NOT fire
+
+    def reset(self):
+        # the request-path side guards what the sampler thread does not
+        with self._lock:
+            self.seq = 0
+            self.windows.clear()
+            self.total = 0
+
+    def rebuild(self):
+        # receiver built fresh in this function: never shared, must NOT fire
+        staging = []
+        staging.append(1)
+        scratch = Sampler()
+        scratch.seq = 99
+        return staging, scratch

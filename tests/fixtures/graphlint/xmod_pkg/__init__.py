@@ -1,0 +1,3 @@
+"""Cross-module graphlint fixtures: findings here only exist when the
+whole package is analyzed together (taint chains, lock cycles, and
+thread reachability all cross module boundaries)."""

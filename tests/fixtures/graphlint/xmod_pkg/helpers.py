@@ -1,0 +1,14 @@
+"""Two-module taint chain, module 2: helpers that are only traced
+because kernels.gather_rows (another module) calls them from jit
+(parse-only)."""
+import numpy as np
+
+
+def coerce_rows(rows):
+    dense = np.asarray(rows)  # expect: JG102
+    return dense * 2
+
+
+def host_summary(table):
+    # only ever called from host context: must NOT fire
+    return np.asarray(table).sum()

@@ -1,0 +1,18 @@
+"""Two-module taint chain, module 1: the jit kernel. The helper it
+calls lives in helpers.py — taint must cross the module boundary for
+the numpy coercion there to be flagged (parse-only)."""
+import jax
+import jax.numpy as jnp
+
+from .helpers import coerce_rows, host_summary
+
+
+@jax.jit
+def gather_rows(table, idx):
+    rows = jnp.take(table, idx, axis=0)
+    return coerce_rows(rows)
+
+
+def report(table):
+    # host context: calling the helper here must NOT taint it
+    return host_summary(table)

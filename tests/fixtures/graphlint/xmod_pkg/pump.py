@@ -1,0 +1,18 @@
+"""Cross-module thread-entry mutation, module 2: the spawner. The
+entry def runs on a fresh thread and reaches Buffer.collect in racy.py
+(parse-only)."""
+import threading
+
+from .racy import Buffer
+
+
+def pump_loop(buf, items):
+    for item in items:
+        buf.collect(item)
+
+
+def start_pump(items):
+    buf = Buffer()
+    t = threading.Thread(target=pump_loop, args=(buf, items), daemon=True)
+    t.start()
+    return buf, t

@@ -1,0 +1,19 @@
+"""Cross-module thread-entry mutation, module 1: the shared state. The
+thread that mutates it is spawned in pump.py — reachability must cross
+the module boundary for JG401 to connect the sites (parse-only)."""
+import threading
+
+
+class Buffer:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.pending = []
+
+    def collect(self, item):
+        self.pending.append(item)  # expect: JG401
+
+    def flush(self):
+        with self._lock:
+            drained = list(self.pending)
+            self.pending.clear()
+        return drained

@@ -1,0 +1,31 @@
+"""Cross-module lock discipline, module 1: holds its own lock while
+calling into the wire layer (parse-only)."""
+import threading
+
+from .wire import fetch_remote, wire_lock_section
+
+
+class Registry:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.cache = {}
+
+    def refresh(self, key):
+        with self._lock:
+            value = fetch_remote(key)  # expect: JG403
+            self.cache[key] = value
+        return value
+
+    def locked_section(self):
+        # acquires the wire lock while holding ours: one half of the
+        # cross-module lock-order cycle (the JG202 fires in wire.py)
+        with self._lock:
+            return wire_lock_section()
+
+    def read(self, key):
+        # lock released before the blocking call: must NOT fire
+        with self._lock:
+            cached = self.cache.get(key)
+        if cached is None:
+            cached = fetch_remote(key)
+        return cached

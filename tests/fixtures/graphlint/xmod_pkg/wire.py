@@ -1,0 +1,22 @@
+"""Cross-module lock discipline, module 2: the blocking wire layer,
+plus the other half of the lock-order cycle (parse-only)."""
+import socket
+import threading
+
+_wire_lock = threading.Lock()
+
+
+def fetch_remote(key):
+    conn = socket.create_connection(("localhost", 9), 1.0)
+    conn.sendall(key)
+    return conn.recv(64)
+
+
+def wire_lock_section():
+    with _wire_lock:
+        return 1
+
+
+def locked_callback(reg):
+    with _wire_lock:
+        return reg.refresh("x")  # expect: JG403, JG202

@@ -507,3 +507,44 @@ def test_cli_telemetry_scrape_url(server, capsys):
     out = capsys.readouterr().out
     assert "janusgraph_cli_scrape_total 1" in out
     assert validate_prometheus_text(out) is None, out
+
+
+# ----------------------------------------------------- pool handoff (JG402)
+def test_capture_scope_carries_span_and_ledger_across_pool():
+    """graphlint v2 satellite: span/ledger attribution must survive a
+    thread-pool handoff. A bare pool worker starts from an empty
+    contextvars context (no current span, no ambient ledger); a worker
+    entered through capture_scope() re-enters the submitter's scope, so
+    its reads see the parent span and its accruals land in the parent
+    ledger."""
+    from concurrent.futures import ThreadPoolExecutor
+
+    from janusgraph_tpu.observability import capture_scope, ledger_scope
+    from janusgraph_tpu.observability.profiler import accrue
+
+    def work(_i):
+        accrue(rows=10)
+        cur = tracer.current()
+        return cur.name if cur is not None else None
+
+    with ledger_scope() as led:
+        with span("parent"):
+            with ThreadPoolExecutor(max_workers=2) as pool:
+                bare = list(pool.map(work, range(2)))
+                kept = list(pool.map(capture_scope(work), range(2)))
+    assert bare == [None, None]
+    assert kept == ["parent", "parent"]
+    # only the wrapped workers accrued into the submitting request's ledger
+    assert led.counters.get("rows") == 20
+
+
+def test_capture_scope_restores_vars_after_each_call():
+    """The wrapper sets/resets contextvars per invocation: the worker
+    thread's own ambience is untouched outside the call."""
+    from janusgraph_tpu.observability import capture_scope
+
+    with span("outer"):
+        wrapped = capture_scope(lambda: tracer.current().name)
+    assert tracer.current() is None
+    assert wrapped() == "outer"
+    assert tracer.current() is None
