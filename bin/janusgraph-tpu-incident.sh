@@ -1,0 +1,6 @@
+#!/usr/bin/env bash
+# Merged cross-replica failover forensics: pulls a fleet frontend's
+# GET /fleet/incident (every replica's flight ring, offset-corrected
+# onto one clock) and prints the kill -> mark_dead -> re-pin -> warm-up
+# narrative. Usage: janusgraph-tpu-incident.sh --url host:port [--window 60]
+exec python -m janusgraph_tpu incident "$@"

@@ -70,6 +70,13 @@ RULES: Dict[str, Rule] = {
              "unbounded memory and exposition growth — use literal "
              "names, or carry a justified suppression naming the bound "
              "(e.g. digests from the top-K-evicted price book)"),
+        Rule("JG111", SEV_ERROR,
+             "time.time() subtraction used as a duration: the wall clock "
+             "steps under NTP slew/step and DST, so a wall-clock delta "
+             "can go negative or jump — durations and interval math must "
+             "use time.monotonic() (or perf_counter); wall stamps for "
+             "EVENT STAMPING or cross-process offset math are exempt via "
+             "`# graphlint: wallclock -- why`"),
         # -- lock discipline ------------------------------------------------
         Rule("JG201", SEV_ERROR,
              "lock.acquire() without with/try-finally release on all paths"),
@@ -207,6 +214,7 @@ _DISABLE_FILE_RE = re.compile(
 _TRACED_RE = re.compile(r"#\s*graphlint:\s*traced\b")
 _HOST_RE = re.compile(r"#\s*graphlint:\s*host\b")
 _HANDOFF_RE = re.compile(r"#\s*graphlint:\s*handoff\b")
+_WALLCLOCK_RE = re.compile(r"#\s*graphlint:\s*wallclock\b")
 
 
 def _parse_ids(blob: str) -> set:
@@ -233,6 +241,10 @@ class Suppressions:
         #: across a thread boundary here; JG402's walk stops at a marked
         #: def or spawn site
         self.handoff_lines: set = set()
+        #: lines marked `# graphlint: wallclock` — an explicit statement
+        #: that a time.time() subtraction is event-stamp/offset math over
+        #: wall timestamps, not a duration; JG111 skips these
+        self.wallclock_lines: set = set()
         for i, line in enumerate(source.splitlines(), start=1):
             if "graphlint" not in line:
                 continue
@@ -259,6 +271,10 @@ class Suppressions:
                 self.handoff_lines.add(i)
                 if line.lstrip().startswith("#"):
                     self.handoff_lines.add(i + 1)
+            if _WALLCLOCK_RE.search(line):
+                self.wallclock_lines.add(i)
+                if line.lstrip().startswith("#"):
+                    self.wallclock_lines.add(i + 1)
 
     def is_suppressed(self, rule_id: str, line: int) -> bool:
         if "ALL" in self.file_rules or rule_id in self.file_rules:

@@ -271,21 +271,23 @@ class _FnScanner(ast.NodeVisitor):
         self._stmt_of: Dict[int, ast.stmt] = {}
         self._next_stmt: Dict[int, ast.stmt] = {}
 
-        def index_block(block: List[ast.stmt]):
-            for i, stmt in enumerate(block):
-                if i + 1 < len(block):
-                    self._next_stmt[id(stmt)] = block[i + 1]
-                for sub in ast.walk(stmt):
-                    self._stmt_of.setdefault(id(sub), stmt)
-                for sub in ast.walk(stmt):
-                    for fld in ("body", "orelse", "finalbody"):
-                        blk = getattr(sub, fld, None)
-                        if isinstance(blk, list) and blk and isinstance(
-                            blk[0], ast.stmt
-                        ):
-                            index_block(blk)
-
-        index_block(body)
+        # One linear walk. `_stmt_of` maps every node to its statement in
+        # the OUTERMOST block (setdefault under the top-down walk), and
+        # `_next_stmt` links siblings within every nested block — the same
+        # final maps the old per-block recursion produced, without
+        # re-walking each nested block once per ancestor statement.
+        for i, stmt in enumerate(body):
+            if i + 1 < len(body):
+                self._next_stmt[id(stmt)] = body[i + 1]
+            for sub in ast.walk(stmt):
+                self._stmt_of.setdefault(id(sub), stmt)
+                for fld in ("body", "orelse", "finalbody"):
+                    blk = getattr(sub, fld, None)
+                    if isinstance(blk, list) and blk and isinstance(
+                        blk[0], ast.stmt
+                    ):
+                        for j in range(len(blk) - 1):
+                            self._next_stmt[id(blk[j])] = blk[j + 1]
         for stmt in body:
             self.visit(stmt)
 

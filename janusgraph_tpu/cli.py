@@ -159,7 +159,7 @@ def cmd_fleet(args) -> int:
                 graphs.append(graph)
             name = f"r{i}"
             if i > 0 and warmup_dir:
-                warm_replica(graph, warmup_dir)
+                warm_replica(graph, warmup_dir, replica=name)
             manager = JanusGraphManager()
             manager.put_graph(args.graph_name, graph)
             server = JanusGraphServer(
@@ -196,8 +196,35 @@ def cmd_fleet(args) -> int:
             )
         router.probe()
         router.start_probes(interval_s=probe_interval)
+        federation = None
+        if first.config.get("server.fleet.federation-enabled"):
+            from janusgraph_tpu.observability.federation import (
+                FleetFederation,
+            )
+
+            federation = FleetFederation(
+                router,
+                interval_s=first.config.get(
+                    "server.fleet.federation-interval-s"
+                ),
+                timeout_s=first.config.get(
+                    "server.fleet.federation-timeout-s"
+                ),
+                retention=first.config.get("metrics.fleet-retention"),
+                outlier_metric=first.config.get(
+                    "metrics.fleet-outlier-metric"
+                ),
+                outlier_factor=first.config.get(
+                    "metrics.fleet-outlier-factor"
+                ),
+                outlier_min_count=first.config.get(
+                    "metrics.fleet-outlier-min-count"
+                ),
+            )
+            federation.start()
         frontend = FleetFrontend(
-            router, host=args.host, port=args.port
+            router, host=args.host, port=args.port,
+            federation=federation,
         ).start()
         for server in servers:
             print(f"  replica {server.replica_name}: "
@@ -212,6 +239,8 @@ def cmd_fleet(args) -> int:
             pass
         finally:
             frontend.stop()
+            if federation is not None:
+                federation.stop()
     finally:
         router.stop()
         for gossip in gossips:
@@ -518,6 +547,45 @@ def cmd_timeseries(args) -> int:
             print(f"exported {n} windows -> {args.export}", file=sys.stderr)
         payload = history.query(name=args.name, window=args.window)
     print(json.dumps(payload, indent=2, default=str))
+    return 0
+
+
+def cmd_incident(args) -> int:
+    """Pull a fleet frontend's merged incident report (GET
+    /fleet/incident): every replica's flight ring, offset-corrected onto
+    one clock and causally ordered, with the failover narrative
+    (kill -> mark_dead -> re-pin -> warm-up) and a Chrome-trace document
+    (one lane per replica). --trace-out writes the trace JSON for
+    chrome://tracing / ui.perfetto.dev; --json prints the full payload."""
+    import urllib.request
+
+    base = args.url.rstrip("/")
+    if not base.startswith("http"):
+        base = "http://" + base
+    url = base + f"/fleet/incident?window={args.window}"
+    with urllib.request.urlopen(url, timeout=10) as resp:
+        payload = json.loads(resp.read().decode("utf-8"))
+    if args.trace_out:
+        with open(args.trace_out, "w") as f:
+            json.dump(payload.get("trace", {}), f, indent=2, default=str)
+        print(f"trace -> {args.trace_out}", file=sys.stderr)
+    if args.json:
+        print(json.dumps(payload, indent=2, default=str))
+        return 0
+    events = payload.get("events", [])
+    print(f"incident window: last {payload.get('window_s')}s  "
+          f"replicas: {', '.join(payload.get('replicas', [])) or '-'}  "
+          f"events: {len(events)}"
+          + ("  PARTIAL (missing: "
+             + ", ".join(payload.get("missing", [])) + ")"
+             if payload.get("partial") else ""))
+    for p in payload.get("phases", []):
+        print(f"  {p['phase']:>10}  t={p['ts_corrected']:.6f}  "
+              f"lane={p['lane'] or '-'}  {p.get('detail') or ''}")
+    for e in events[-args.tail:] if args.tail else events:
+        detail = e.get("action") or e.get("kind") or ""
+        print(f"  {e['ts_corrected']:.6f}  [{e['lane'] or '-':>8}]  "
+              f"{e.get('category')}{':' + str(detail) if detail else ''}")
     return 0
 
 
@@ -889,6 +957,30 @@ def main(argv=None) -> int:
                      help="run record index (negative = from the end)")
     ptl.add_argument("--out", help="write the trace JSON to this file")
     ptl.set_defaults(fn=cmd_timeline)
+
+    pin = sub.add_parser(
+        "incident",
+        help="merged cross-replica failover forensics from a fleet "
+             "frontend (/fleet/incident)",
+    )
+    pin.add_argument(
+        "--url", required=True,
+        help="fleet frontend base URL (host:port)",
+    )
+    pin.add_argument(
+        "--window", type=float, default=60.0,
+        help="lookback seconds (0 = whole flight rings)",
+    )
+    pin.add_argument(
+        "--trace-out", help="write the Chrome-trace JSON to this file",
+    )
+    pin.add_argument("--json", action="store_true",
+                     help="print the full report payload")
+    pin.add_argument(
+        "--tail", type=int, default=0,
+        help="print only the last N merged events (0 = all)",
+    )
+    pin.set_defaults(fn=cmd_incident)
 
     pbd = sub.add_parser(
         "benchdiff",

@@ -1335,6 +1335,31 @@ METRICS_NS.option(
     Mutability.LOCAL, lambda v: v > 0,
 )
 METRICS_NS.option(
+    "fleet-retention", int,
+    "merged fleet windows the federation retains (one window per "
+    "federation-interval-s tick; observability/federation.py)", 360,
+    Mutability.LOCAL, lambda v: v >= 1,
+)
+METRICS_NS.option(
+    "fleet-outlier-metric", str,
+    "timer whose per-replica windowed p99 the cross-replica outlier "
+    "detector compares against the fleet median",
+    "server.request.wall", Mutability.LOCAL,
+)
+METRICS_NS.option(
+    "fleet-outlier-factor", float,
+    "outlier threshold: a replica whose windowed p99 exceeds this "
+    "multiple of the fleet median raises a replica_outlier flight "
+    "event and burns the fleet_latency_outlier ticket budget", 3.0,
+    Mutability.LOCAL, lambda v: v > 1.0,
+)
+METRICS_NS.option(
+    "fleet-outlier-min-count", int,
+    "minimum per-replica observations in a window before it joins the "
+    "outlier comparison (small windows make noisy percentiles)", 20,
+    Mutability.LOCAL, lambda v: v >= 1,
+)
+METRICS_NS.option(
     "structured-logging", bool,
     "emit one-line JSON log records (with ambient trace_id/span_id) to "
     "stderr from the server, retry guard, circuit breaker, and chaos "
@@ -1493,6 +1518,28 @@ SERVER_NS.option(
     "snapshot-CSR cache from (server/fleet.warm_replica; '' = cold "
     "start, or the computer.delta-snapshot-path pack as fallback)", "",
     Mutability.LOCAL,
+)
+SERVER_NS.option(
+    "fleet.federation-enabled", bool,
+    "run the fleet observability federation on the frontend: scrape "
+    "every replica's /timeseries?raw=1 each interval, serve merged "
+    "/fleet/timeseries + /fleet/metrics + /fleet/incident, evaluate "
+    "fleet-level SLOs (observability/federation.py)", True,
+    Mutability.LOCAL,
+)
+SERVER_NS.option(
+    "fleet.federation-interval-s", float,
+    "federation scrape cadence — each tick merges one fleet window "
+    "(counters sum, gauges keyed per replica, histogram buckets add) "
+    "and doubles as the clock-offset probe", 2.0,
+    Mutability.LOCAL, lambda v: v > 0,
+)
+SERVER_NS.option(
+    "fleet.federation-timeout-s", float,
+    "socket timeout per federation scrape target (JG208: a dead "
+    "replica costs one bounded wait and a partial:true window, never "
+    "a hung scraper)", 2.0,
+    Mutability.LOCAL, lambda v: v > 0,
 )
 SERVER_NS.option(
     "deadline.propagation", bool,

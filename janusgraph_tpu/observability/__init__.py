@@ -33,6 +33,15 @@ from janusgraph_tpu.observability.exposition import (
     json_snapshot,
     prometheus_text,
 )
+from janusgraph_tpu.observability.federation import (
+    ClockOffsets,
+    FleetFederation,
+    FleetHistory,
+    fleet_default_specs,
+    merge_incident_events,
+    merge_series,
+    merge_windows,
+)
 from janusgraph_tpu.observability.flight import FlightRecorder
 from janusgraph_tpu.observability.flight import recorder as flight_recorder
 from janusgraph_tpu.observability.identity import (
@@ -109,8 +118,11 @@ tracer.on_slow = _slow_span_to_flight
 
 __all__ = [
     "BUCKET_BOUNDS",
+    "ClockOffsets",
     "Counter",
     "DigestTable",
+    "FleetFederation",
+    "FleetHistory",
     "FlightRecorder",
     "Gauge",
     "Histogram",
@@ -131,11 +143,15 @@ __all__ = [
     "current_ledger",
     "digest_table",
     "flame_lines",
+    "fleet_default_specs",
     "flight_recorder",
     "get_logger",
     "history",
     "json_snapshot",
     "ledger_scope",
+    "merge_incident_events",
+    "merge_series",
+    "merge_windows",
     "prometheus_text",
     "registry",
     "render_run",

@@ -204,14 +204,50 @@ def _scan_json_objects(text: str) -> List[dict]:
 
 
 # --------------------------------------------------------------------- cells
+#: cell component names, aligned with cell_key()'s tuple order — the
+#: no_baseline note names which of these a near-miss differs on
+CELL_FIELDS = ("stage", "scale", "platform", "host_fallback", "cpu_count")
+
+
 def cell_key(stage: dict) -> Tuple:
-    """The comparability cell: (stage, scale, platform, host-fallback)."""
+    """The comparability cell: (stage, scale, platform, host-fallback,
+    host cpu_count). cpu_count joined after the SATURATE r01->r03
+    424->360 ops/s mystery turned out to be a 1-core runner: throughput
+    cells from hosts with different core counts are not comparable, so
+    they must not verdict against each other. Artifacts predating the
+    field carry cpu_count=None and keep matching each other."""
     return (
         str(stage.get("stage", "")),
         stage.get("scale"),
         str(stage.get("platform", stage.get("device_kind", "")) or ""),
         bool(stage.get("host_fallback", False)),
+        stage.get("cpu_count"),
     )
+
+
+def nearest_cell_mismatch(
+    stages: List[dict], cell: Tuple
+) -> Optional[str]:
+    """When no prior artifact matches a cell exactly, name the key
+    component(s) the CLOSEST near-miss differs on (same stage name,
+    fewest differing components) — so a no_baseline verdict says
+    "prior cells exist but differ on cpu_count" instead of leaving the
+    operator to diff tuples by hand."""
+    best_diff: Optional[List[str]] = None
+    for s in stages:
+        k = cell_key(s)
+        if k == cell or k[0] != cell[0]:
+            continue
+        diff = [
+            CELL_FIELDS[i]
+            for i in range(1, len(CELL_FIELDS))
+            if k[i] != cell[i]
+        ]
+        if best_diff is None or len(diff) < len(best_diff):
+            best_diff = diff
+    if not best_diff:
+        return None
+    return "nearest prior cell differs on: " + ", ".join(best_diff)
 
 
 def best_prior(
@@ -356,9 +392,13 @@ class BaselineIndex:
             cell = cell_key(stage)
             prior = best_prior(self.stages(), cell)
             if prior is None or prior is stage:
+                note = "no prior artifact matches this cell"
+                mismatch = nearest_cell_mismatch(self.stages(), cell)
+                if mismatch:
+                    note = f"{note} ({mismatch})"
                 stage["regression"] = {
                     "verdict": "no_baseline",
-                    "note": "no prior artifact matches this cell",
+                    "note": note,
                     "cell": list(cell),
                 }
                 return stage

@@ -71,11 +71,16 @@ Dump triggers: an unhandled server error, the /healthz ok->degraded flip,
 Dumps are JSON files under ``metrics.flight-dump-dir`` (default: the
 system temp dir) named ``flight-<pid>-<n>.json``.
 
-Every event carries a monotonic ``seq`` and a wall-clock ``ts``; all
-OTHER fields are producer-supplied and deterministic for seeded chaos
-plans, so two runs with one seed produce comparable event sequences once
-wall-clock fields are masked (the acceptance property test_flight_trace
-asserts).
+Every event carries a monotonic ``seq``, a wall-clock ``ts``, AND a
+monotonic-clock ``mono`` stamp (dual timestamps, ISSUE 17): ``ts`` is
+what humans and cross-replica merges read, ``mono`` is what in-process
+interval math reads — wall clocks step under NTP, monotonic clocks
+don't, and the fleet incident merge (observability/federation.py) uses
+the pair to re-order events from replicas whose wall clocks disagree.
+All OTHER fields are producer-supplied and deterministic for seeded
+chaos plans, so two runs with one seed produce comparable event
+sequences once clock fields are masked (the acceptance property
+test_flight_trace asserts).
 """
 
 from __future__ import annotations
@@ -128,6 +133,7 @@ class FlightRecorder:
             event = {
                 "seq": self._seq,
                 "ts": time.time(),
+                "mono": time.monotonic(),
                 "category": category,
                 **({"replica": replica} if replica else {}),
                 **{k: _plain(v) for k, v in fields.items()},

@@ -275,6 +275,28 @@ class MetricsHistory:
             },
         }
 
+    def scrape(self, last: int = 0) -> dict:
+        """The federation scrape payload (``GET /timeseries?raw=1``):
+        full retained windows WITH their bucket delta vectors (what
+        :meth:`query` strips), plus the sampling clocks' *now* so the
+        scraper can window by sequence and estimate this replica's
+        wall-clock offset from the request round-trip. Consumed by
+        ``observability/federation.py``; per-replica merge semantics
+        (counters sum, buckets add) need the raw vectors."""
+        from janusgraph_tpu.observability.identity import replica_name
+
+        ws = self.windows(last)
+        return {
+            "replica": replica_name(),
+            "now": self._wall(),
+            "mono": self._clock(),
+            "interval_s": self.interval_s,
+            "retention": self._ring.maxlen,
+            "first_seq": ws[0]["seq"] if ws else 0,
+            "last_seq": ws[-1]["seq"] if ws else 0,
+            "windows": ws,
+        }
+
     # -------------------------------------------------------------- export
     def export_jsonl(self, path: str, last: int = 0) -> int:
         """One JSON line per retained window (full bucket vectors

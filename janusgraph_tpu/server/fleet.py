@@ -466,13 +466,23 @@ class FleetRouter:
         deadline_ms: Optional[float] = None,
         key: Optional[str] = None,
         session_key: Optional[str] = None,
+        trace_ctx=None,
     ):
         """Route one request. Sticky ``session_key`` pins to a replica
         (drain/death re-pin transparently); otherwise the consistent-hash
         candidates serve it. Shed/draining/dead replicas are retried
         elsewhere under the fleet retry budget with jittered backoff,
-        never past the caller's deadline."""
-        from janusgraph_tpu.observability import registry
+        never past the caller's deadline.
+
+        The whole routing episode is ONE ``fleet.route`` span joined to
+        the caller's ``trace_ctx`` (the frontend parses X-Trace-Context
+        into it), with one ``fleet.attempt`` child per replica tried
+        (replica id + verdict: ok / shed / draining / dead / unreachable
+        / error, retriable verdicts tagged retry-elsewhere) — and the
+        per-replica client forwards the ambient context on every hop, so
+        one driver query through a failover reads back as one stitched
+        trace instead of N orphans."""
+        from janusgraph_tpu.observability import registry, tracer
 
         give_up_at = (
             self._clock() + deadline_ms / 1000.0 if deadline_ms else None
@@ -482,96 +492,141 @@ class FleetRouter:
         attempt = 0
         tried: List[str] = []
         last_err: Optional[Exception] = None
-        while True:
-            handle = self._pick(route_key, session_key, exclude=tried)
-            if handle is None:
-                registry.counter("fleet.router.no_replica").inc()
-                raise NoReplicaAvailable(
-                    f"no serving replica for key {route_key!r} "
-                    f"(tried {tried}); last error: {last_err}"
-                ) from last_err
-            remaining_ms = (
-                max(0.0, (give_up_at - self._clock()) * 1000.0)
-                if give_up_at is not None else None
-            )
-            try:
-                # graphlint: disable=JG207 -- not a per-element fan-out: the loop IS the retry-elsewhere policy (one logical request, budget-bounded attempts)
-                result = self._call(
-                    handle, query, graph, remaining_ms
-                )
-                handle.stats["ok"] += 1
-                registry.counter("fleet.router.routed").inc()
-                if attempt:
-                    # wall spent re-routing past failed candidates: the
-                    # router-failover-latency headline
-                    registry.timer("fleet.router.failover").update(
-                        int((self._clock() - t0) * 1e9)
+        with tracer.child_span(
+            trace_ctx, "fleet.route",
+            key=route_key, pinned=session_key is not None,
+        ) as route_span:
+            while True:
+                handle = self._pick(route_key, session_key, exclude=tried)
+                if handle is None:
+                    registry.counter("fleet.router.no_replica").inc()
+                    route_span.annotate(
+                        verdict="no-replica", attempts=attempt, tried=tried
                     )
-                return result
-            except RemoteError as e:
-                if e.status in ("shed", "draining"):
-                    handle.stats["shed"] += 1
-                    retriable, wait_s, last_err = True, e.retry_after_s, e
-                    if e.status == "draining":
-                        # under _lock: the probe thread writes handle.state
-                        # under the same lock (JG401)
-                        with self._lock:
-                            if handle.state == SERVING:
-                                handle.state = DRAINING
-                else:
-                    # evaluation/client errors are the CALLER's problem —
-                    # rerouting a bad query just fails it N times
-                    handle.stats["errors"] += 1
-                    raise
-            except _urlerr.HTTPError:
-                # replica answered with a non-shed HTTP error: a caller
-                # problem (auth, bad request), not an availability event
-                handle.stats["errors"] += 1
-                raise
-            # graphlint: disable=JG204 -- the failure is routed: retriable=True re-enters the retry-elsewhere loop (budget-bounded), exhaustion raises NoReplicaAvailable from the original error
-            except (CircuitOpenError, TemporaryBackendError,
-                    ConnectionError, OSError, _urlerr.URLError) as e:
-                # connect refusal / timeout / open breaker: this replica
-                # is gone or unreachable — crash-detection path
-                if not isinstance(e, CircuitOpenError):
-                    # under _lock: races the probe thread's
-                    # `handle.probe_failures = 0` reset (JG401); mark_dead
-                    # re-takes the lock, so call it after release
-                    with self._lock:
-                        handle.probe_failures += 1
-                        dead = handle.probe_failures >= 2
-                    if dead:
-                        self.mark_dead(handle.name, reason="connect")
-                retriable, wait_s, last_err = True, None, e
-            if not retriable:
-                break
-            tried.append(handle.name)
-            handle.stats["retried_away"] += 1
-            if session_key is not None:
-                self._repin(session_key, exclude=tried)
-            if not self.retry_budget.take():
-                registry.counter(
-                    "fleet.router.budget_exhausted"
-                ).inc()
-                raise NoReplicaAvailable(
-                    f"fleet retry budget exhausted after {tried}"
-                ) from last_err
-            registry.counter("fleet.router.retries").inc()
-            wait = wait_s if wait_s else random.uniform(
-                self.backoff_base_s,
-                min(
-                    self.backoff_max_s,
-                    self.backoff_base_s * (3 ** min(attempt, 4)),
-                ),
-            )
-            if give_up_at is not None and (
-                self._clock() + wait >= give_up_at
-            ):
-                raise NoReplicaAvailable(
-                    f"deadline would expire before retry (tried {tried})"
-                ) from last_err
-            time.sleep(min(wait, 1.0))
-            attempt += 1
+                    raise NoReplicaAvailable(
+                        f"no serving replica for key {route_key!r} "
+                        f"(tried {tried}); last error: {last_err}"
+                    ) from last_err
+                remaining_ms = (
+                    max(0.0, (give_up_at - self._clock()) * 1000.0)
+                    if give_up_at is not None else None
+                )
+                with tracer.span(
+                    "fleet.attempt", replica=handle.name, attempt=attempt
+                ) as att:
+                    try:
+                        # graphlint: disable=JG207 -- not a per-element fan-out: the loop IS the retry-elsewhere policy (one logical request, budget-bounded attempts)
+                        result = self._call(
+                            handle, query, graph, remaining_ms
+                        )
+                        att.annotate(verdict="ok")
+                        handle.stats["ok"] += 1
+                        registry.counter("fleet.router.routed").inc()
+                        if attempt:
+                            # wall spent re-routing past failed candidates:
+                            # the router-failover-latency headline
+                            registry.timer("fleet.router.failover").update(
+                                int((self._clock() - t0) * 1e9)
+                            )
+                        route_span.annotate(
+                            verdict="ok", replica=handle.name,
+                            attempts=attempt + 1,
+                        )
+                        return result
+                    except RemoteError as e:
+                        if e.status in ("shed", "draining"):
+                            att.annotate(
+                                verdict=e.status, retry_elsewhere=True
+                            )
+                            handle.stats["shed"] += 1
+                            retriable, wait_s, last_err = (
+                                True, e.retry_after_s, e
+                            )
+                            if e.status == "draining":
+                                # under _lock: the probe thread writes
+                                # handle.state under the same lock (JG401)
+                                with self._lock:
+                                    if handle.state == SERVING:
+                                        handle.state = DRAINING
+                        else:
+                            # evaluation/client errors are the CALLER's
+                            # problem — rerouting a bad query just fails
+                            # it N times
+                            att.annotate(verdict="error")
+                            handle.stats["errors"] += 1
+                            route_span.annotate(
+                                verdict="error", attempts=attempt + 1
+                            )
+                            raise
+                    except _urlerr.HTTPError:
+                        # replica answered with a non-shed HTTP error: a
+                        # caller problem (auth, bad request), not an
+                        # availability event
+                        att.annotate(verdict="error")
+                        handle.stats["errors"] += 1
+                        route_span.annotate(
+                            verdict="error", attempts=attempt + 1
+                        )
+                        raise
+                    # graphlint: disable=JG204 -- the failure is routed: retriable=True re-enters the retry-elsewhere loop (budget-bounded), exhaustion raises NoReplicaAvailable from the original error
+                    except (CircuitOpenError, TemporaryBackendError,
+                            ConnectionError, OSError, _urlerr.URLError) as e:
+                        # connect refusal / timeout / open breaker: this
+                        # replica is gone or unreachable — crash-detection
+                        # path
+                        dead = isinstance(e, CircuitOpenError)
+                        if not dead:
+                            # under _lock: races the probe thread's
+                            # `handle.probe_failures = 0` reset (JG401);
+                            # mark_dead re-takes the lock, so call it
+                            # after release
+                            with self._lock:
+                                handle.probe_failures += 1
+                                dead = handle.probe_failures >= 2
+                            if dead:
+                                self.mark_dead(handle.name, reason="connect")
+                        att.annotate(
+                            verdict="dead" if dead else "unreachable",
+                            retry_elsewhere=True,
+                        )
+                        retriable, wait_s, last_err = True, None, e
+                if not retriable:
+                    break
+                tried.append(handle.name)
+                handle.stats["retried_away"] += 1
+                if session_key is not None:
+                    self._repin(session_key, exclude=tried)
+                if not self.retry_budget.take():
+                    registry.counter(
+                        "fleet.router.budget_exhausted"
+                    ).inc()
+                    route_span.annotate(
+                        verdict="budget-exhausted", attempts=attempt + 1,
+                        tried=tried,
+                    )
+                    raise NoReplicaAvailable(
+                        f"fleet retry budget exhausted after {tried}"
+                    ) from last_err
+                registry.counter("fleet.router.retries").inc()
+                wait = wait_s if wait_s else random.uniform(
+                    self.backoff_base_s,
+                    min(
+                        self.backoff_max_s,
+                        self.backoff_base_s * (3 ** min(attempt, 4)),
+                    ),
+                )
+                if give_up_at is not None and (
+                    self._clock() + wait >= give_up_at
+                ):
+                    route_span.annotate(
+                        verdict="deadline", attempts=attempt + 1,
+                        tried=tried,
+                    )
+                    raise NoReplicaAvailable(
+                        f"deadline would expire before retry (tried {tried})"
+                    ) from last_err
+                time.sleep(min(wait, 1.0))
+                attempt += 1
 
     def _call(self, handle, query, graph, deadline_ms):
         """One attempt against one replica, through its breaker (connect
@@ -943,7 +998,9 @@ def export_snapshot(graph, dir_path: str, num_shards: int = 1) -> dict:
     }
 
 
-def warm_replica(graph, dir_path: Optional[str] = None) -> bool:
+def warm_replica(
+    graph, dir_path: Optional[str] = None, replica: str = ""
+) -> bool:
     """Hydrate a joining replica's snapshot-CSR cache from files instead
     of re-scanning storage: the shard-checkpoint export first, the
     PR 14 delta-snapshot ``.npz`` pack (``computer.delta-snapshot-path``)
@@ -978,8 +1035,12 @@ def warm_replica(graph, dir_path: Optional[str] = None) -> bool:
     # binds to the exporter's backend instance (delta.load_snapshot doc)
     snap.adopt(csr, graph.backend.mutation_epoch())
     registry.counter("fleet.warmup.hits").inc()
+    # the replica identity stamp puts the warm-up on the restarted
+    # replica's lane in the federation incident report (and lets shared
+    # in-process rings dedup the event); "" = unidentified
     flight_recorder.record(
         "fleet", action="warmup", source=source,
+        replica=replica,
         rows=int(csr.num_vertices), edges=int(csr.num_edges),
     )
     return True
@@ -994,14 +1055,24 @@ class FleetFrontend:
     the fleet (the replica's own JSON response shape comes back), GET
     /healthz serves the fleet aggregate. WS/tx clients connect straight
     to a replica — GET /assign?session=<key> answers which one, honoring
-    stickiness and drain state."""
+    stickiness and drain state.
+
+    With a :class:`~janusgraph_tpu.observability.federation.FleetFederation`
+    attached (``janusgraph_tpu fleet`` wires one when
+    ``server.fleet.federation-enabled``), the frontend also serves the
+    merged fleet views: GET ``/fleet/timeseries`` (federated windows,
+    exact merged percentiles), ``/fleet/metrics`` (replica-labeled
+    snapshot merge), and ``/fleet/incident?window=`` (the causally
+    ordered cross-replica forensic timeline)."""
 
     def __init__(self, router: FleetRouter, host: str = "127.0.0.1",
-                 port: int = 0, max_request_bytes: int = 1 << 20):
+                 port: int = 0, max_request_bytes: int = 1 << 20,
+                 federation=None):
         self.router = router
         self.host = host
         self._port = port
         self.max_request_bytes = max_request_bytes
+        self.federation = federation
         self._httpd = None
         self._thread = None
 
@@ -1063,6 +1134,40 @@ class FleetFrontend:
                         "port": handle.port,
                     })
                     return
+                if self.path.startswith("/fleet/"):
+                    fed = frontend.federation
+                    if fed is None:
+                        self._json(404, {"status": {
+                            "code": 404,
+                            "message": "federation not enabled",
+                        }})
+                        return
+                    from urllib.parse import parse_qs, urlsplit
+
+                    parts = urlsplit(self.path)
+                    qs = parse_qs(parts.query)
+                    if parts.path == "/fleet/timeseries":
+                        name = (qs.get("name") or [""])[0]
+                        try:
+                            window = int((qs.get("window") or ["0"])[0])
+                        except ValueError:
+                            window = 0
+                        self._json(
+                            200, fed.timeseries_view(name, window)
+                        )
+                        return
+                    if parts.path == "/fleet/metrics":
+                        self._json(200, fed.metrics_view())
+                        return
+                    if parts.path == "/fleet/incident":
+                        try:
+                            window_s = float(
+                                (qs.get("window") or ["60"])[0]
+                            )
+                        except ValueError:
+                            window_s = 60.0
+                        self._json(200, fed.incident(window_s))
+                        return
                 self._json(404, {"status": {"code": 404}})
 
             def do_POST(self):
@@ -1089,12 +1194,21 @@ class FleetFrontend:
                     deadline_ms = float(deadline) if deadline else None
                 except (TypeError, ValueError):
                     deadline_ms = None
+                from janusgraph_tpu.observability.spans import TraceContext
+
+                # the caller's trace joins the routing episode: the
+                # fleet.route span (and every per-replica hop under it)
+                # lands in the SAME trace as the driver's client span
+                trace_ctx = TraceContext.from_header(
+                    self.headers.get("X-Trace-Context")
+                )
                 try:
                     result = frontend.router.submit(
                         req.get("gremlin", ""),
                         graph=req.get("graph"),
                         deadline_ms=deadline_ms,
                         session_key=req.get("session_key"),
+                        trace_ctx=trace_ctx,
                     )
                 except NoReplicaAvailable as e:
                     self._json(503, {"result": {"data": None}, "status": {

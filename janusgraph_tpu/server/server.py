@@ -943,6 +943,13 @@ class _Handler(BaseHTTPRequestHandler):
                     "code": 400, "message": "window must be an integer",
                 }})
                 return
+            if (qs.get("raw") or ["0"])[0] in ("1", "true"):
+                # the federation scrape shape: full windows WITH bucket
+                # delta vectors + this replica's clocks, so the fleet
+                # frontend can merge exact percentiles and estimate our
+                # wall-clock offset (observability/federation.py)
+                self._send_json(200, history.scrape(last=window))
+                return
             self._send_json(200, history.query(name=name, window=window))
             return
         if self.path.startswith("/profile/timeline"):

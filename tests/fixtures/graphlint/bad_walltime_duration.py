@@ -1,0 +1,65 @@
+"""JG111 fixture: time.time() subtraction used as a duration.
+
+The wall clock steps under NTP slew/step, so a wall-clock delta used as
+a duration can go negative or jump by seconds — a negative "latency"
+fed into a histogram, a backoff, or an SLO window silently corrupts the
+statistic. Interval math must use time.monotonic()/perf_counter; wall
+stamps subtracted for event stamping or cross-process OFFSET math are
+exempt via `# graphlint: wallclock -- why`.
+"""
+
+import time
+
+
+def direct_delta_bad():
+    start = time.time()
+    work()
+    return time.time() - start  # expect: JG111
+
+
+def stored_stamps_bad():
+    t0 = time.time()
+    work()
+    t1 = time.time()
+    elapsed = t1 - t0  # expect: JG111
+    return elapsed
+
+
+def deadline_remaining_bad(deadline_wall):
+    # remaining-budget math against a wall deadline is still interval
+    # math: an NTP step mid-request shrinks or inflates the budget
+    return deadline_wall - time.time()  # expect: JG111
+
+
+def monotonic_delta_good():
+    start = time.monotonic()
+    work()
+    return time.monotonic() - start
+
+
+def perf_counter_good():
+    t0 = time.perf_counter()
+    work()
+    return time.perf_counter() - t0
+
+
+def stamp_only_good():
+    # a wall stamp recorded into an event is fine — only SUBTRACTION
+    # as a duration is the hazard
+    return {"ts": time.time()}
+
+
+def offset_math_exempt_good(peer_wall, rtt_s):
+    # cross-process clock-offset estimation subtracts wall STAMPS by
+    # design (the rtt operand was measured on the monotonic clock)
+    send_wall = time.time()
+    # graphlint: wallclock -- NTP midpoint offset math over wall stamps, not a duration
+    return peer_wall - (send_wall + rtt_s / 2.0)
+
+
+def rebased_stamp_exempt_good(duration_ms):
+    return time.time() - duration_ms / 1e3  # graphlint: wallclock -- reconstructs a wall START STAMP from a monotonic-measured duration
+
+
+def work():
+    pass

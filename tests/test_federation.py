@@ -1,0 +1,517 @@
+"""Fleet observability federation (ISSUE 17): merged telemetry,
+offset-corrected forensics, fleet SLOs.
+
+Covers the acceptance list:
+
+- partial scrapes: a dead/unreachable replica yields ``partial: true``
+  and the EXACT missing-replica list, never a silently complete window,
+- merge semantics: counters sum, gauges stay keyed per replica,
+  histogram bucket vectors add — and the merged percentiles are BITWISE
+  equal to recomputing from the concatenated per-replica vectors,
+- clock-offset estimation + correction: a synthetic two-replica event
+  sequence with injected ±500 ms wall skew comes back in true causal
+  order,
+- the fleet incident report: kill -> mark_dead phases extracted across
+  rings, Chrome-trace document validates, in-process ring sharing dedups,
+- fleet-level SLOs on the merged windows: deterministic on a fake
+  clock, with the cross-replica p99 outlier detector raising a
+  ``replica_outlier`` flight event and burning the ticket rung.
+"""
+
+from __future__ import annotations
+
+import json
+
+import pytest
+
+from janusgraph_tpu.observability import flight_recorder
+from janusgraph_tpu.observability.federation import (
+    ClockOffsets,
+    FleetFederation,
+    fleet_default_specs,
+    merge_incident_events,
+    merge_series,
+)
+from janusgraph_tpu.observability.metrics_core import (
+    Histogram,
+    TelemetryRegistry,
+)
+from janusgraph_tpu.observability.timeline import validate_chrome_trace
+from janusgraph_tpu.observability.timeseries import MetricsHistory
+from janusgraph_tpu.server.fleet import FleetRouter
+
+
+class _Replica:
+    """One synthetic replica: its own registry + history ring, a wall
+    clock that can be skewed, and canned flight events."""
+
+    def __init__(self, name, skew_s=0.0):
+        self.name = name
+        self.skew_s = skew_s
+        self.true_wall = 1_000_000.0
+        self.mono = 50.0
+        self.registry = TelemetryRegistry()
+        self.history = MetricsHistory(
+            registry=self.registry, interval_s=1.0,
+            clock=lambda: self.mono,
+            wall_clock=lambda: self.true_wall + self.skew_s,
+        )
+        self.flight_events = []
+        self.unreachable = False
+
+    def observe(self, name, ms_values):
+        t = self.registry.timer(name)
+        for ms in ms_values:
+            t.update(int(ms * 1e6))
+
+    def advance(self, s=1.0):
+        self.true_wall += s
+        self.mono += s
+
+    def payload(self, path):
+        if self.unreachable:
+            raise ConnectionError(f"{self.name} unreachable")
+        if path.startswith("/timeseries"):
+            payload = json.loads(json.dumps(self.history.scrape()))
+            # a real replica process reports ITS OWN identity; the
+            # shared-process default would collapse all synthetic
+            # replicas onto one producer cursor
+            payload["replica"] = self.name
+            return payload
+        if path.startswith("/flight"):
+            return {"events": [dict(e) for e in self.flight_events]}
+        if path.startswith("/telemetry"):
+            return {"metrics": self.registry.snapshot()}
+        raise AssertionError(f"unexpected path {path}")
+
+
+def _fleet(replicas, dead=(), **fed_kw):
+    """An offline router + federation over synthetic replicas, on fake
+    clocks (zero-RTT scrapes: the offset estimate is exactly the skew)."""
+    by_port = {}
+    router = FleetRouter(fetch=lambda url, timeout: {})
+    for i, rep in enumerate(replicas):
+        port = 9300 + i
+        router.add_replica(rep.name, "127.0.0.1", port)
+        by_port[port] = rep
+    for name in dead:
+        router.mark_dead(name, reason="test")
+
+    calls = []
+
+    def fetch(url, timeout):
+        calls.append(url)
+        rest = url.split("127.0.0.1:", 1)[1]
+        port, path = rest.split("/", 1)
+        return by_port[int(port)].payload("/" + path)
+
+    clock = {"t": 10.0}
+    wall = {"t": 2_000_000.0}
+    fed_kw.setdefault("interval_s", 1.0)
+    fed = FleetFederation(
+        router, fetch=fetch,
+        clock=lambda: clock["t"], wall_clock=lambda: wall["t"],
+        **fed_kw,
+    )
+    fed._test_calls = calls
+    fed._test_clock = clock
+    fed._test_wall = wall
+    return router, fed
+
+
+# ---------------------------------------------------------------------------
+# partial scrapes
+# ---------------------------------------------------------------------------
+
+class TestPartialScrapes:
+    def test_unreachable_replica_marks_window_partial(self):
+        reps = [_Replica("r0"), _Replica("r1"), _Replica("r2")]
+        for rep in reps:
+            rep.observe("server.request.wall", [2.0, 3.0])
+            rep.history.sample()
+        reps[1].unreachable = True
+        _router, fed = _fleet(reps)
+        w = fed.tick()
+        assert w["partial"] is True
+        assert w["missing"] == ["r1"]
+        assert w["replicas"] == ["r0", "r2"]
+        view = fed.timeseries_view()
+        assert view["partial"] is True
+        assert view["missing"] == ["r1"]
+
+    def test_dead_replica_is_missing_without_a_fetch(self):
+        reps = [_Replica("r0"), _Replica("r1")]
+        for rep in reps:
+            rep.history.sample()
+        _router, fed = _fleet(reps, dead=("r1",))
+        w = fed.tick()
+        assert w["partial"] is True and w["missing"] == ["r1"]
+        # a crashed replica must not cost one timeout per tick
+        assert not any("9301" in u for u in fed._test_calls)
+
+    def test_full_scrape_is_not_partial(self):
+        reps = [_Replica("r0"), _Replica("r1")]
+        for rep in reps:
+            rep.history.sample()
+        _router, fed = _fleet(reps)
+        w = fed.tick()
+        assert w["partial"] is False and w["missing"] == []
+
+    def test_shared_producer_ring_counts_once(self):
+        """An in-process fleet serves ONE shared history ring from every
+        port; the producer-keyed cursor must merge each window once."""
+        reps = [_Replica("r0"), _Replica("r1")]
+        reps[0].registry.counter("tx.commit").inc(4)
+        reps[0].history.sample()
+        reps[1].payload = reps[0].payload  # same process, same ring
+        _router, fed = _fleet(reps)
+        w = fed.tick()
+        assert w["counters"]["tx.commit"] == 4
+        assert w["replicas"] == ["r0", "r1"]
+        assert w["partial"] is False
+
+    def test_scrape_cursor_never_remerges_a_window(self):
+        reps = [_Replica("r0")]
+        reps[0].registry.counter("server.admission.admitted").inc(5)
+        reps[0].history.sample()
+        _router, fed = _fleet(reps)
+        w1 = fed.tick()
+        assert w1["counters"].get("server.admission.admitted") == 5
+        # nothing new on the replica: the same retained window must not
+        # be double-counted into the next fleet window
+        w2 = fed.tick()
+        assert "server.admission.admitted" not in w2["counters"]
+
+
+# ---------------------------------------------------------------------------
+# merge semantics
+# ---------------------------------------------------------------------------
+
+class TestMergeSemantics:
+    def test_counters_sum_and_gauges_stay_keyed(self):
+        reps = [_Replica("r0"), _Replica("r1")]
+        reps[0].registry.counter("server.admission.admitted").inc(7)
+        reps[1].registry.counter("server.admission.admitted").inc(5)
+        reps[0].registry.set_gauge("admission.limit", 8)
+        reps[1].registry.set_gauge("admission.limit", 16)
+        for rep in reps:
+            rep.history.sample()
+        _router, fed = _fleet(reps)
+        w = fed.tick()
+        assert w["counters"]["server.admission.admitted"] == 12
+        assert w["gauges"]["admission.limit"] == {"r0": 8, "r1": 16}
+
+    def test_fleet_percentiles_bitwise_equal_concatenated_vectors(self):
+        """Acceptance: fleet-windowed p50/p95/p99 == recomputing from the
+        element-wise concatenation (sum) of the per-replica bucket delta
+        vectors — exact, not approximate."""
+        reps = [_Replica("r0"), _Replica("r1"), _Replica("r2")]
+        reps[0].observe("server.request.wall",
+                        [1.0, 2.0, 4.0, 8.0, 100.0])
+        reps[1].observe("server.request.wall", [0.5, 0.5, 3.0, 250.0])
+        reps[2].observe("server.request.wall", [16.0] * 10)
+        for rep in reps:
+            rep.history.sample()
+        _router, fed = _fleet(reps)
+        w = fed.tick()
+        merged = w["series"]["server.request.wall"]
+        per_replica = [
+            rep.history.windows()[-1]["series"]["server.request.wall"]
+            for rep in reps
+        ]
+        width = max(len(e["buckets"]) for e in per_replica)
+        concat = [0] * width
+        for e in per_replica:
+            for i, v in enumerate(e["buckets"]):
+                concat[i] += v
+        hi = max(e["max"] for e in per_replica)
+        assert merged["buckets"] == concat
+        assert merged["count"] == sum(e["count"] for e in per_replica)
+        assert sum(merged["buckets"]) == merged["count"]
+        for q, key in ((0.50, "p50"), (0.95, "p95"), (0.99, "p99")):
+            assert merged[key] == Histogram.percentile_of(concat, q, hi)
+
+    def test_merge_series_empty_and_sparse(self):
+        assert merge_series([]) is None
+        assert merge_series([{"count": 0, "buckets": []}]) is None
+
+    def test_metrics_view_merges_current_snapshots(self):
+        reps = [_Replica("r0"), _Replica("r1")]
+        reps[0].registry.counter("tx.commit").inc(3)
+        reps[1].registry.counter("tx.commit").inc(4)
+        reps[0].registry.set_gauge("admission.limit", 8)
+        reps[1].observe("server.request.wall", [5.0])
+        _router, fed = _fleet(reps)
+        view = fed.metrics_view()
+        assert view["partial"] is False
+        m = view["metrics"]
+        assert m["tx.commit"]["count"] == 7
+        assert m["admission.limit"]["value"] == {"r0": 8}
+        assert "r1" in m["server.request.wall"]["by_replica"]
+
+
+# ---------------------------------------------------------------------------
+# clock offsets + incident ordering under skew
+# ---------------------------------------------------------------------------
+
+class TestSkewCorrection:
+    def test_offset_estimate_equals_injected_skew_at_zero_rtt(self):
+        reps = [_Replica("r0", skew_s=0.5), _Replica("r1", skew_s=-0.5)]
+        for rep in reps:
+            rep.history.sample()
+        _router, fed = _fleet(reps)
+        # zero-RTT fake clocks: offset = peer_wall - send_wall exactly
+        fed.tick()
+        est = fed.offsets.snapshot()
+        skew0 = est["r0"]["offset_s"] - est["r1"]["offset_s"]
+        assert abs(skew0 - 1.0) < 1e-6, (
+            "±500 ms of injected skew must be recovered exactly"
+        )
+
+    def test_min_rtt_sample_wins(self):
+        co = ClockOffsets()
+        co.observe("r", send_wall=100.0, rtt_s=0.2, peer_wall=100.9)
+        co.observe("r", send_wall=200.0, rtt_s=0.01, peer_wall=200.505)
+        co.observe("r", send_wall=300.0, rtt_s=0.5, peer_wall=301.0)
+        est = co.snapshot()["r"]
+        assert est["rtt_s"] == 0.01 and est["samples"] == 3
+        assert abs(est["offset_s"] - 0.5) < 1e-9
+
+    def test_incident_orders_two_replica_sequence_under_skew(self):
+        """Acceptance: kill (on the +500 ms replica) then mark_dead (on
+        the -500 ms replica) — raw wall stamps invert the order, the
+        offset-corrected merge restores it."""
+        reps = [_Replica("r0", skew_s=0.5), _Replica("r1", skew_s=-0.5)]
+        for rep in reps:
+            rep.history.sample()
+        # true order: kill at t=+100.0 on r0, mark_dead at t=+100.2 on
+        # r1, rejoin at t=+100.4 on r1, warmup at t=+100.6 on r0 — each
+        # event stamped on ITS replica's (skewed) wall clock, so the
+        # raw stamps sort r1's events before r0's kill
+        base = reps[0].true_wall
+        reps[0].flight_events = [
+            {"seq": 1, "ts": base + 100.0 + 0.5, "mono": 1.0,
+             "category": "fault", "kind": "replica_kill",
+             "replica": "r0"},
+            {"seq": 2, "ts": base + 100.6 + 0.5, "mono": 1.6,
+             "category": "fleet", "action": "warmup", "replica": "r0"},
+        ]
+        reps[1].flight_events = [
+            {"seq": 1, "ts": base + 100.2 - 0.5, "mono": 2.2,
+             "category": "fleet", "action": "dead", "replica": "r1"},
+            {"seq": 2, "ts": base + 100.4 - 0.5, "mono": 2.4,
+             "category": "fleet", "action": "rejoin", "replica": "r1"},
+        ]
+        _router, fed = _fleet(reps)
+        fed.tick()  # estimate offsets
+        # drop the frontend's own ring (replica-join events carry real
+        # wall stamps that don't belong on this synthetic timeline)
+        flight_recorder.reset()
+        report = fed.incident(window_s=0)
+        lanes = [e["lane"] for e in report["events"]]
+        assert lanes == ["r0", "r1", "r1", "r0"], (
+            f"raw-stamp order leaked through: {lanes}"
+        )
+        phases = [p["phase"] for p in report["phases"]]
+        assert phases == ["kill", "mark_dead", "re_pin", "warm_up"]
+        ts = [e["ts_corrected"] for e in report["events"]]
+        assert ts == sorted(ts)
+        # corrected onto the FRONTEND's timeline (wall = 2_000_000)
+        assert abs(ts[0] - (fed._test_wall["t"] + 100.0)) < 1e-6
+        validate_chrome_trace(report["trace"])
+        # one lane per replica in the trace metadata
+        assert set(report["trace"]["otherData"]["lanes"]) >= {"r0", "r1"}
+
+    def test_incident_dedups_shared_ring_events(self):
+        """In-process fleets share ONE flight ring: the same event
+        scraped from N replicas' /flight must appear once."""
+        flight_recorder.reset()
+        shared = [
+            {"seq": 7, "ts": 100.0, "mono": 1.0, "category": "fault",
+             "kind": "replica_kill", "replica": "r0"},
+        ]
+        reps = [_Replica("r0"), _Replica("r1")]
+        reps[0].flight_events = shared
+        reps[1].flight_events = shared
+        for rep in reps:
+            rep.history.sample()
+        _router, fed = _fleet(reps)
+        fed.tick()
+        report = fed.incident(window_s=0)
+        kills = [e for e in report["events"]
+                 if e.get("kind") == "replica_kill"]
+        assert len(kills) == 1
+
+    def test_incident_partial_when_a_ring_is_unreachable(self):
+        flight_recorder.reset()
+        reps = [_Replica("r0"), _Replica("r1")]
+        for rep in reps:
+            rep.history.sample()
+        _router, fed = _fleet(reps)
+        fed.tick()
+        reps[1].unreachable = True
+        report = fed.incident(window_s=0)
+        assert report["partial"] is True
+        assert report["missing"] == ["r1"]
+
+    def test_window_bounds_the_lookback(self):
+        co = ClockOffsets()
+        events = [
+            {"seq": 1, "ts": 10.0, "category": "fault", "source": "r0",
+             "replica": "r0"},
+            {"seq": 2, "ts": 95.0, "category": "fault", "source": "r0",
+             "replica": "r0"},
+        ]
+        out = merge_incident_events(events, co, now_wall=100.0,
+                                    window_s=30.0)
+        assert [e["ts"] for e in out] == [95.0]
+
+
+# ---------------------------------------------------------------------------
+# fleet SLOs + outlier detection (fake clock, deterministic)
+# ---------------------------------------------------------------------------
+
+class TestFleetSLOs:
+    def _outlier_fleet(self):
+        reps = [_Replica("r0"), _Replica("r1"), _Replica("r2")]
+        _router, fed = _fleet(
+            reps,
+            outlier_factor=2.0, outlier_min_count=10,
+            slo_specs=fleet_default_specs(
+                fast_windows=1, slow_windows=1,
+            ),
+        )
+        return reps, fed
+
+    def _load(self, reps, sick=None):
+        for rep in reps:
+            ms = 400.0 if rep.name == sick else 2.0
+            rep.observe("server.request.wall", [ms] * 25)
+            rep.registry.counter("server.admission.admitted").inc(25)
+            rep.advance()
+            rep.history.sample()
+
+    def test_outlier_replica_raises_flight_event_and_burn(self):
+        flight_recorder.reset()
+        reps, fed = self._outlier_fleet()
+        self._load(reps, sick="r1")
+        w = fed.tick()
+        assert [o["replica"] for o in w["outliers"]] == ["r1"]
+        events = flight_recorder.events("replica_outlier")
+        assert events and events[-1]["replica"] == "r1"
+        assert events[-1]["threshold_factor"] == 2.0
+        # the outlier budget burned IN this window (not the next one)
+        assert w["counters"].get(
+            "fleet.federation.outlier_windows"
+        ) == 1
+        # 100% bad over a 1% budget at 1-window hysteresis: ticket rung
+        snap = fed.slo.snapshot()
+        outlier_alerts = [a for a in snap["alerts"]
+                         if a["name"] == "fleet_latency_outlier"]
+        assert outlier_alerts and outlier_alerts[0]["severity"] in (
+            "ticket", "page"
+        )
+
+    def test_healthy_fleet_raises_no_outlier(self):
+        flight_recorder.reset()
+        reps, fed = self._outlier_fleet()
+        self._load(reps, sick=None)
+        w = fed.tick()
+        assert w["outliers"] == []
+        assert flight_recorder.events("replica_outlier") == []
+
+    def test_below_min_count_replicas_are_excluded(self):
+        flight_recorder.reset()
+        reps, fed = self._outlier_fleet()
+        # the sick replica has too few observations to judge
+        reps[0].observe("server.request.wall", [2.0] * 25)
+        reps[1].observe("server.request.wall", [400.0] * 3)
+        reps[2].observe("server.request.wall", [2.0] * 25)
+        for rep in reps:
+            rep.history.sample()
+        w = fed.tick()
+        assert w["outliers"] == []
+
+    def test_fleet_availability_spec_reads_summed_admission(self):
+        reps = [_Replica("r0"), _Replica("r1")]
+        _router, fed = _fleet(
+            reps,
+            slo_specs=fleet_default_specs(fast_windows=1, slow_windows=1),
+        )
+        for _ in range(3):
+            for rep in reps:
+                rep.registry.counter("server.admission.admitted").inc(40)
+                rep.registry.counter("server.admission.shed").inc(60)
+                rep.advance()
+                rep.history.sample()
+            fed.tick()
+        snap = fed.slo.snapshot()
+        avail = [a for a in snap["alerts"]
+                 if a["name"] == "fleet_availability"]
+        assert avail and avail[0]["severity"] == "page", (
+            "60% shed across the fleet must page fleet availability"
+        )
+
+    def test_slo_sequence_deterministic_on_fake_clock(self):
+        """Acceptance: same synthetic inputs -> byte-equal slo_burn
+        flight sequence (clock fields masked), twice."""
+
+        def run():
+            flight_recorder.reset()
+            reps, fed = self._outlier_fleet()
+            for round_i in range(4):
+                self._load(reps, sick="r1" if round_i >= 2 else None)
+                fed.tick()
+            return [
+                {k: v for k, v in e.items()
+                 if k not in ("ts", "mono", "seq")}
+                for e in flight_recorder.events("slo_burn")
+            ]
+
+        first, second = run(), run()
+        assert first, "the storm must produce slo_burn transitions"
+        assert json.dumps(first, sort_keys=True) == json.dumps(
+            second, sort_keys=True
+        )
+
+    def test_router_counters_fold_into_fleet_windows(self):
+        from janusgraph_tpu.observability import registry
+
+        reps = [_Replica("r0")]
+        reps[0].history.sample()
+        _router, fed = _fleet(reps)
+        registry.counter("fleet.router.routed").inc(9)
+        w = fed.tick()
+        assert w["counters"].get("fleet.router.routed", 0) >= 9
+        # deltas, not cumulative: a quiet tick re-adds nothing
+        w2 = fed.tick()
+        assert "fleet.router.routed" not in w2["counters"]
+
+
+# ---------------------------------------------------------------------------
+# scrape payload plumbing
+# ---------------------------------------------------------------------------
+
+class TestScrapePayload:
+    def test_history_scrape_keeps_bucket_vectors(self):
+        rep = _Replica("r3")
+        rep.observe("server.request.wall", [4.0, 8.0])
+        rep.history.sample()
+        payload = rep.history.scrape()
+        w = payload["windows"][-1]
+        assert "buckets" in w["series"]["server.request.wall"]
+        assert payload["now"] == pytest.approx(rep.true_wall)
+        assert payload["last_seq"] == w["seq"]
+
+    def test_overhead_gauge_and_timer_account_each_tick(self):
+        from janusgraph_tpu.observability import registry
+
+        reps = [_Replica("r0")]
+        reps[0].history.sample()
+        _router, fed = _fleet(reps)
+        before = registry.get_count("fleet.federation.ticks")
+        fed.tick()
+        assert registry.get_count("fleet.federation.ticks") == before + 1
+        # the overhead gauge is refreshed every tick
+        _c, _t, _h, gauges = registry.metric_objects()
+        assert "fleet.federation.overhead_ms" in gauges

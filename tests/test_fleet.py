@@ -253,6 +253,61 @@ class TestRetryElsewhere:
             "honoring a 5s Retry-After past a 50ms deadline"
         )
 
+    def test_failover_is_one_stitched_trace(self):
+        """ISSUE 17 (d): the retry-elsewhere hop keeps the originating
+        request's trace context — one driver query through a failover is
+        ONE trace: a fleet.route span joined to the caller's context,
+        with one fleet.attempt child per replica tried (replica id +
+        verdict), not N orphan traces."""
+        from janusgraph_tpu.observability import TraceContext, tracer
+
+        shed = RemoteError(503, "shed", status="shed",
+                           retry_after_s=0.001)
+        behaviors = {"r0": lambda: shed, "r1": lambda: shed,
+                     "r2": lambda: shed}
+        r, _clients = self._router(behaviors)
+        # the first candidate sheds, every other replica serves: exactly
+        # one retry-elsewhere hop (clients build lazily, so mutating the
+        # factory-captured dict before submit is enough)
+        first = r.candidates_for("stitch")[0].name
+        for name in behaviors:
+            if name != first:
+                behaviors[name] = lambda: 11
+        caller_ctx = TraceContext(trace_id=0xABCDEF0123456789,
+                                  span_id=0x42)
+        assert r.submit("q", key="stitch", trace_ctx=caller_ctx) == 11
+        roots = tracer.find_trace(caller_ctx.trace_id)
+        routes = [s for s in roots if s.name == "fleet.route"]
+        assert routes, "fleet.route did not join the caller's trace"
+        route = routes[-1]
+        # joined, not copied: the remote parent id is preserved
+        assert route.parent_span_id == caller_ctx.span_id
+        attempts = [c for c in route.children
+                    if c.name == "fleet.attempt"]
+        assert len(attempts) >= 2, (
+            "a failed-over request must carry one attempt child per "
+            "replica tried"
+        )
+        verdicts = [a.attrs.get("verdict") for a in attempts]
+        replicas = [a.attrs.get("replica") for a in attempts]
+        assert verdicts[0] == "shed" and verdicts[-1] == "ok"
+        assert replicas[0] == first
+        assert all(isinstance(x, str) and x for x in replicas)
+        # the retriable hop is tagged as such
+        assert attempts[0].attrs.get("retry_elsewhere") is True
+
+    def test_submit_without_context_still_traces(self):
+        """No caller context: fleet.route is a plain local root — the
+        receive site never branches on propagation."""
+        from janusgraph_tpu.observability import tracer
+
+        behaviors = {"r0": lambda: 5, "r1": lambda: 5}
+        r, _clients = self._router(behaviors)
+        assert r.submit("q", key="k") == 5
+        routes = [s for s in tracer.recent("fleet.route")]
+        assert routes
+        assert routes[-1].attrs.get("verdict") == "ok"
+
 
 # ---------------------------------------------------------------------------
 # sticky sessions + drain

@@ -168,13 +168,13 @@ def test_structured_log_stream_emission():
 
 # ------------------------------------------------ seeded chaos determinism
 def _masked(events):
-    """Event sequence with wall-clock (and id-ish) fields removed — the
+    """Event sequence with clock (and id-ish) fields removed — the
     deterministic projection two same-seed runs must agree on."""
     out = []
     for e in events:
         m = {k: v for k, v in e.items()
-             if k not in ("ts", "seq", "trace_id", "span_id", "tx_id",
-                          "message")}
+             if k not in ("ts", "mono", "seq", "trace_id", "span_id",
+                          "tx_id", "message")}
         out.append(m)
     return out
 

@@ -654,7 +654,7 @@ def _run_latency_storm(seed):
         severities.append(eng.snapshot()["worst"])
     eng.uninstall()
     masked = [
-        {k: v for k, v in e.items() if k not in ("ts", "seq")}
+        {k: v for k, v in e.items() if k not in ("ts", "mono", "seq")}
         for e in flight_recorder.events("slo_burn")
     ]
     return masked, severities
