@@ -15,7 +15,10 @@ silently: the code works until the first crash mid-write, and then the
 loss lands exactly where the recovery machinery expects integrity. This
 rule flags any builtin ``open`` call in a write mode ("w"/"a"/"x"/"+")
 whose path expression mentions a checkpoint-ish name — an identifier or
-string literal containing ``checkpoint``, ``manifest``, or ``.ckpt``.
+string literal containing ``checkpoint``, ``manifest``, or ``.ckpt`` —
+or a CDC log path (PR 18): ``-segment`` / ``.segment`` / ``.cdc``
+names, which carry the same digest-embedded tmp+rename contract
+(storage/cdc.py; a torn sealed segment would silently break replay).
 
 The atomic idiom passes by construction: ``mkstemp`` returns an fd (no
 path-taking ``open``), and intermediate names in the tmp+rename dance are
@@ -32,7 +35,10 @@ from typing import List, Optional
 
 from janusgraph_tpu.analysis.core import Finding, RULES
 
-_CKPT_NAME_RE = re.compile(r"checkpoint|manifest|\.ckpt", re.IGNORECASE)
+_CKPT_NAME_RE = re.compile(
+    r"checkpoint|manifest|\.ckpt|-segment|\.segment|\.cdc",
+    re.IGNORECASE,
+)
 #: the tmp+rename idiom names its intermediate file; a path expression
 #: that is explicitly a temp sibling is the ATOMIC discipline, not a
 #: violation of it
@@ -92,8 +98,9 @@ def check_module(mod) -> List[Finding]:
         findings.append(Finding(
             "JG305", RULES["JG305"].severity, mod.path,
             node.lineno, node.col_offset,
-            f"open(..., {mode!r}) writes directly to a checkpoint/manifest "
-            "path — durability files must commit via tmp + rename "
+            f"open(..., {mode!r}) writes directly to a checkpoint/"
+            "manifest/CDC-segment path — durability files must commit "
+            "via tmp + rename "
             "(tempfile.mkstemp + os.replace with a .prev demotion), or a "
             "crash mid-write leaves a torn file at the committed name",
         ))

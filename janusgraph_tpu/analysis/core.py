@@ -165,8 +165,8 @@ RULES: Dict[str, Rule] = {
              "tree_matmul contractions are complete trees and rows stay "
              "VPU/MXU lane-aligned; 0 means auto-pick)"),
         Rule("JG305", SEV_ERROR,
-             "direct open-for-write on a checkpoint/manifest path: "
-             "durability files must go through atomic tmp + rename "
+             "direct open-for-write on a checkpoint/manifest/CDC-segment "
+             "path: durability files must go through atomic tmp + rename "
              "(tempfile.mkstemp + os.replace, previous file demoted to "
              ".prev) — a crash mid-open(path, 'w') leaves a torn file AT "
              "THE COMMITTED NAME, exactly the loss the checkpoint format "

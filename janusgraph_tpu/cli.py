@@ -148,6 +148,7 @@ def cmd_fleet(args) -> int:
         vnodes=first.config.get("server.fleet.vnodes"),
         candidates=first.config.get("server.fleet.candidates"),
         probe_timeout_s=probe_timeout,
+        trend_windows=first.config.get("server.fleet.trend-windows"),
     )
     warmup_dir = first.config.get("server.fleet.warmup-dir")
     try:

@@ -985,11 +985,51 @@ STORAGE.option(
     Mutability.LOCAL, lambda v: v >= -1,
 )
 STORAGE.option(
+    "faults.cdc-torn-at", int,
+    "CDC tail-append index at which a torn partial frame hits disk and "
+    "the writer 'dies' (CDCTornWrite; -1 = off) — reopening the log "
+    "must drop exactly the torn suffix, never a sealed segment", -1,
+    Mutability.LOCAL, lambda v: v >= -1,
+)
+STORAGE.option(
+    "faults.follower-lag-at", int,
+    "follower pull index at which the lag window begins (-1 = off): "
+    "the follower stops applying for faults.follower-lag-pulls pulls, "
+    "so staleness grows past the priced bound and the router must "
+    "route freshness-hinted traffic back to the leader", -1,
+    Mutability.LOCAL, lambda v: v >= -1,
+)
+STORAGE.option(
+    "faults.follower-lag-pulls", int,
+    "pulls the injected follower lag window covers once it begins", 0,
+    Mutability.LOCAL, lambda v: v >= 0,
+)
+STORAGE.option(
     "faults.stores", str,
     "comma-separated store names the injector targets (empty = the "
     "data plane: edgestore,graphindex). System stores stay exempt so "
     "chaos never corrupts the recovery machinery itself",
     "edgestore,graphindex", Mutability.LOCAL,
+)
+STORAGE.option(
+    "cdc.dir", str,
+    "directory of the durable segmented change-capture log "
+    "(storage/cdc.py CDCLog); empty = no durable CDC — the capture "
+    "stays the PR 14 in-process ring. Requires computer.delta", "",
+    Mutability.LOCAL,
+)
+STORAGE.option(
+    "cdc.segment-records", int,
+    "records per sealed CDC segment (power of two — cursor->segment "
+    "arithmetic stays a shift); the tail seals automatically at this "
+    "boundary", 1024, Mutability.LOCAL,
+    lambda v: v > 0 and v & (v - 1) == 0,
+)
+STORAGE.option(
+    "cdc.retention-segments", int,
+    "sealed CDC segments retained before the oldest is pruned; pruning "
+    "creates an honest cursor gap (followers behind it re-bootstrap "
+    "from a checkpoint)", 64, Mutability.LOCAL, lambda v: v >= 1,
 )
 STORAGE.option(
     "breaker.enabled", bool,
@@ -1518,6 +1558,28 @@ SERVER_NS.option(
     "snapshot-CSR cache from (server/fleet.warm_replica; '' = cold "
     "start, or the computer.delta-snapshot-path pack as fallback)", "",
     Mutability.LOCAL,
+)
+SERVER_NS.option(
+    "fleet.follower-pull-interval-s", float,
+    "cadence at which a follower replica pulls delta records from the "
+    "leader's durable CDC log (server/fleet.CDCFollower); each pull "
+    "folds the netted batches through materialize, O(delta)", 0.5,
+    Mutability.LOCAL, lambda v: v > 0,
+)
+SERVER_NS.option(
+    "fleet.follower-max-staleness-ms", float,
+    "priced staleness bound for follower reads (the PR 13 SLO "
+    "freshness spec's ceiling): past it the follower's /healthz "
+    "reports degraded and the router stops preferring it for "
+    "staleness-hinted requests", 10_000.0,
+    Mutability.LOCAL, lambda v: v > 0,
+)
+SERVER_NS.option(
+    "fleet.trend-windows", int,
+    "per-replica goodput windows the router fetches from /timeseries "
+    "to slope-sharpen its least-loaded tie-break (0 = off: plain "
+    "occupancy ordering, the PR 15 behaviour)", 8,
+    Mutability.LOCAL, lambda v: v >= 0,
 )
 SERVER_NS.option(
     "fleet.federation-enabled", bool,

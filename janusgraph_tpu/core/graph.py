@@ -437,6 +437,22 @@ class JanusGraphTPU:
             self.backend.register_change_capture(
                 self.change_capture.on_commit
             )
+        # durable CDC spine (storage.cdc.dir; storage/cdc.py): every
+        # decoded capture batch also appends to a segmented on-disk log
+        # that survives restarts and feeds follower replicas
+        self.cdc_log = None
+        if self.change_capture is not None and cfg.get("storage.cdc.dir"):
+            from janusgraph_tpu.storage.cdc import CDCLog
+
+            self.cdc_log = CDCLog(
+                cfg.get("storage.cdc.dir"),
+                segment_records=cfg.get("storage.cdc.segment-records"),
+                retention_segments=cfg.get(
+                    "storage.cdc.retention-segments"
+                ),
+                fault_plan=self.fault_plan,
+            )
+            self.change_capture.add_sink(self.cdc_log.append)
         # OLTP->OLAP spillover planner (computer.spillover; olap/
         # spillover.py): promoted hot multi-hop traversal shapes run as
         # frontier supersteps over a cached CSR snapshot
@@ -957,6 +973,8 @@ class JanusGraphTPU:
             if not self.backend.read_only:
                 self.instance_registry.deregister(self.instance_id)
             self.log_manager.close()
+            if getattr(self, "cdc_log", None) is not None:
+                self.cdc_log.close()
             self.backend.close()
             self._open = False
 

@@ -92,6 +92,15 @@ HEADLINES: Dict[str, List[Tuple[str, str]]] = {
         ("goodput_recovered_over_prekill", HIGHER),
         ("pre_kill_goodput_per_s", HIGHER),
     ],
+    # PR 18: leader-kill failover certified from the durable CDC log —
+    # promotion latency first (the availability gap), then the staleness
+    # ceiling followers actually served at, then the read share they
+    # absorbed (the scale-out payoff)
+    "fleet_cdc_failover": [
+        ("promote_ms", LOWER),
+        ("staleness_p99_ms", LOWER),
+        ("follower_read_share", HIGHER),
+    ],
     "multichip_ab": [("superstep_ms", LOWER)],
     "chaos": [("recovery_open_ms", LOWER)],
     "smoke": [],

@@ -70,6 +70,11 @@ _PHASE_EVENTS = (
      and e.get("action") in ("rejoin", "join")),
     ("warm_up", lambda e: e.get("category") == "fleet"
      and e.get("action") == "warmup"),
+    # durable-CDC failover (PR 18): a follower promotes from the log,
+    # then proves itself caught up — kill -> promote -> caught_up
+    ("promote", lambda e: e.get("category") == "follower_promote"),
+    ("caught_up", lambda e: e.get("category") == "cdc_replay"
+     and e.get("action") == "caught_up"),
 )
 
 

@@ -58,6 +58,19 @@ Event taxonomy (the ``category`` field):
                     ``fault`` category's kind field includes the fleet
                     fault kinds ``replica_kill`` / ``replica_restart`` /
                     ``replica_partition``
+``cdc_seal``        the durable CDC log sealed its tail into a segment
+                    (storage/cdc.py; fields: ``seq``/``records``/
+                    ``first_cursor``/``first_epoch``/``last_epoch``)
+``cdc_replay``      a CDC replay was served or refused (``action``:
+                    ``serve``, ``gap`` — cursor outside the retained
+                    range, ``poison`` — an undecodable commit inside the
+                    range, ``corrupt`` — a sealed segment failed its
+                    digest, or ``caught_up`` — a promoting follower
+                    proved itself current, the incident grammar's
+                    closing phase)
+``follower_promote``  a follower replica promoted to leader on leader
+                    death (server/fleet.py CDCFollower.promote; fields:
+                    ``replica``/``promote_ms``/``cursor``/``epoch``)
 ``slo_burn``        the SLO engine's burn-rate alert ladder transitioned
                     (observability/slo.py; fields: ``slo``/``kind``/
                     ``severity`` ok|ticket|page, ``direction`` enter/exit,

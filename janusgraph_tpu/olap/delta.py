@@ -112,6 +112,26 @@ class ChangeCapture:
         self._count = 0
         #: records with epoch <= floor may have been dropped/undecodable
         self._floor = 0
+        #: durable sinks fed every decoded batch (storage/cdc.py CDCLog.
+        #: append); poison forwards as (epoch, None) so the sink can
+        #: record the un-servable range honestly
+        self._sinks: List = []
+
+    def add_sink(self, fn) -> None:
+        """Register a ``fn(epoch, batch_or_None)`` durable sink. Sinks
+        ride the commit path, so failures are swallowed and counted —
+        capture (and the commit) must never fail because a sink did."""
+        with self._lock:
+            self._sinks.append(fn)
+
+    def _feed_sinks(self, epoch: int, batch: Optional[dict]) -> None:
+        for fn in self._sinks:
+            try:
+                fn(epoch, batch)
+            except Exception:  # noqa: BLE001 - never fail a commit
+                from janusgraph_tpu.observability import registry
+
+                registry.counter("olap.delta.sink_errors").inc()
 
     # -- write side ---------------------------------------------------------
     def on_commit(self, epoch: int, edge_rows: Dict[bytes, object]) -> None:
@@ -131,6 +151,7 @@ class ChangeCapture:
                 from janusgraph_tpu.observability import registry
 
                 registry.counter("olap.delta.capture_poisoned").inc()
+                self._feed_sinks(epoch, None)
                 return
             if not batch["n"]:
                 return
@@ -140,6 +161,7 @@ class ChangeCapture:
                 e0, b0 = self._batches.popleft()
                 self._count -= b0["n"]
                 self._floor = e0
+            self._feed_sinks(epoch, batch)
 
     def _decode(self, edge_rows) -> Optional[dict]:
         """One committed batch -> vid-space record arrays. Returns None

@@ -42,6 +42,16 @@ signals the earlier PRs built into a FLEET:
   fallback) — byte-identical to a storage re-scan with ZERO edgestore
   reads, so OLAP/spillover traffic fans out across replicas without N
   scans of one backend.
+- **Follower role** — :class:`CDCFollower` rides the durable CDC log
+  (``storage/cdc.py``): bootstrap from a shard checkpoint, continuously
+  pull and fold the netted delta records through ``materialize``
+  (cursor gap ⇒ honest re-bootstrap), serve reads at a staleness the
+  PR 13 SLO freshness spec prices, and ``promote()`` to leader on
+  leader death. The router learns **staleness-hinted routing**: a
+  request carrying ``max_staleness_ms`` may land on a follower whose
+  reported staleness clears the hint; everything else stays on leaders.
+  The least-loaded tie-break is slope-sharpened with each replica's
+  ``/timeseries`` goodput trend (``server.fleet.trend-windows``).
 
 Every outbound hop here (probes, gossip, drain-era routing) carries an
 explicit timeout — graphlint JG208 enforces that mechanically.
@@ -81,6 +91,10 @@ DEAD = "dead"
 RUNG_WEIGHT = 0.5
 PAGE_WEIGHT = 2.0
 DEGRADED_WEIGHT = 1.0
+#: goodput-trend tie-break weight: a rising admitted-rate slope shaves
+#: at most a quarter point off the load score (and a falling one adds
+#: it) — sharpens ties, never outvotes real occupancy
+TREND_WEIGHT = 0.25
 
 
 class NoReplicaAvailable(Exception):
@@ -104,6 +118,15 @@ class ReplicaHandle:
         #: metrics: replica names are operator input, so per-name metric
         #: series would be unbounded — graphlint JG110's point)
         self.stats = {"ok": 0, "shed": 0, "errors": 0, "retried_away": 0}
+        #: replication role from the last probe's /healthz cdc block:
+        #: "leader" (default — replicas without a cdc block take writes)
+        #: or "follower" (read-only, staleness-hinted traffic only)
+        self.role = "leader"
+        #: follower staleness from the same block (ms; None = unknown)
+        self.staleness_ms: Optional[float] = None
+        #: normalized goodput slope from /timeseries ([-1, 1]; 0 = flat
+        #: or trend probing off)
+        self.goodput_trend = 0.0
         self.breaker = CircuitBreaker(
             f"fleet.{name}", **(breaker_kwargs or {
                 "failure_threshold": 2, "reset_timeout_s": 1.0,
@@ -140,7 +163,13 @@ class ReplicaHandle:
             score += DEGRADED_WEIGHT
         if h.get("draining"):
             score += PAGE_WEIGHT  # drains should win no tie-breaks
+        # trend tie-break: rising goodput prefers, falling defers
+        score -= TREND_WEIGHT * self.goodput_trend
         return score
+
+    @property
+    def is_follower(self) -> bool:
+        return self.role == "follower"
 
     def snapshot(self) -> dict:
         """The fleet-healthz member block."""
@@ -150,6 +179,9 @@ class ReplicaHandle:
             "url": self.base_url,
             "status": h.get("status"),
             "draining": bool(h.get("draining")),
+            "role": self.role,
+            "staleness_ms": self.staleness_ms,
+            "goodput_trend": round(self.goodput_trend, 4),
             "load_score": round(self.load_score(), 4),
             "brownout_rung": (h.get("admission") or {}).get(
                 "brownout_rung"
@@ -172,6 +204,32 @@ def _default_fetch(url: str, timeout_s: float) -> dict:
         return json.loads(e.read())
 
 
+#: the per-replica goodput proxy the trend tie-break slopes over: every
+#: admitted request bumps it, so its window deltas ARE the goodput curve
+TREND_SERIES = "server.admission.admitted"
+
+
+def goodput_slope(payload: dict, name: str = TREND_SERIES) -> float:
+    """Normalized least-squares slope of a /timeseries counter window:
+    the per-window deltas regressed against window index, divided by the
+    mean absolute delta (+1 so an idle replica slopes 0, not NaN), and
+    clipped to [-1, 1] — a dimensionless 'goodput rising/falling' signal
+    comparable across replicas of different traffic levels."""
+    points = ((payload or {}).get("series") or {}).get(name) or []
+    ys = [float(p.get("delta") or 0.0) for p in points]
+    k = len(ys)
+    if k < 2:
+        return 0.0
+    xm = (k - 1) / 2.0
+    ym = sum(ys) / k
+    var = sum((i - xm) ** 2 for i in range(k))
+    if not var:
+        return 0.0
+    slope = sum((i - xm) * (y - ym) for i, y in enumerate(ys)) / var
+    norm = slope / (sum(abs(y) for y in ys) / k + 1.0)
+    return max(-1.0, min(1.0, norm))
+
+
 class FleetRouter:
     """Front-end router: spread traffic across N replicas sharing one
     storage backend. In-process library (the ``janusgraph_tpu fleet``
@@ -191,6 +249,7 @@ class FleetRouter:
         clock: Callable[[], float] = time.monotonic,
         fetch: Callable[[str, float], dict] = _default_fetch,
         client_factory: Optional[Callable[[ReplicaHandle], object]] = None,
+        trend_windows: int = 0,
     ):
         from janusgraph_tpu.core.config import REGISTRY
 
@@ -213,6 +272,9 @@ class FleetRouter:
         )
         self.backoff_base_s = float(backoff_base_s)
         self.backoff_max_s = float(backoff_max_s)
+        #: goodput-trend windows fetched per probe (0 = trend tie-break
+        #: off — the plain PR 15 occupancy ordering)
+        self.trend_windows = max(0, int(trend_windows))
         self._clock = clock
         self._fetch = fetch
         self._client_factory = client_factory or (
@@ -309,6 +371,20 @@ class FleetRouter:
                 if dead:
                     self.mark_dead(n, reason="probe")
                 continue
+            trend = None
+            if self.trend_windows:
+                # trend probe rides the same injectable fetch; failures
+                # leave the last slope standing (a flaky /timeseries
+                # must not zero a healthy replica's tie-break)
+                try:
+                    trend = goodput_slope(self._fetch(
+                        base_url
+                        + f"/timeseries?name={TREND_SERIES}"
+                        + f"&window={self.trend_windows}",
+                        self.probe_timeout_s,
+                    ))
+                except Exception:  # noqa: BLE001 - trend is advisory
+                    trend = None
             rejoined = False
             with self._lock:
                 if self._replicas.get(n) is not handle:
@@ -316,6 +392,15 @@ class FleetRouter:
                 handle.probe_failures = 0
                 handle.last_probe_ts = self._clock()
                 handle.health = payload if isinstance(payload, dict) else {}
+                cdc = handle.health.get("cdc") or {}
+                handle.role = cdc.get("role") or "leader"
+                stale_s = cdc.get("staleness_s")
+                handle.staleness_ms = (
+                    float(stale_s) * 1000.0 if stale_s is not None
+                    else None
+                )
+                if trend is not None:
+                    handle.goodput_trend = trend
                 rejoined = handle.state == DEAD
                 if (
                     not rejoined
@@ -421,12 +506,19 @@ class FleetRouter:
 
         return shape_digest("server>" + query_shape(query))
 
-    def candidates_for(self, key: str) -> List[ReplicaHandle]:
+    def candidates_for(
+        self, key: str, max_staleness_ms: Optional[float] = None
+    ) -> List[ReplicaHandle]:
         """Replicas in routing preference order: the first ``candidates``
         SERVING members clockwise from the key's ring point, least-loaded
         first (consistent hash for affinity, power-of-two-choices for
         balance), then every remaining serving member in ring order as
-        failover tail."""
+        failover tail.
+
+        Staleness-hinted requests (``max_staleness_ms`` set) may land on
+        follower replicas whose last-reported staleness clears the hint
+        — those sort FIRST (least-loaded), leaders behind them as the
+        freshness fallback. Unhinted requests never see a follower."""
         with self._lock:
             ring = self._ring
             if not ring:
@@ -445,11 +537,24 @@ class FleetRouter:
                     ordered.append(handle)
         if not ordered:
             return []
+        followers = [h for h in ordered if h.is_follower]
+        leaders = [h for h in ordered if not h.is_follower]
         head = sorted(
-            ordered[: self.candidates],
+            leaders[: self.candidates],
             key=lambda h: h.load_score(),
         )
-        return head + ordered[self.candidates:]
+        preferred = head + leaders[self.candidates:]
+        if max_staleness_ms is None:
+            return preferred
+        fresh = sorted(
+            (
+                f for f in followers
+                if f.staleness_ms is not None
+                and f.staleness_ms <= float(max_staleness_ms)
+            ),
+            key=lambda h: h.load_score(),
+        )
+        return fresh + preferred
 
     def _client(self, handle: ReplicaHandle):
         with self._lock:
@@ -467,6 +572,7 @@ class FleetRouter:
         key: Optional[str] = None,
         session_key: Optional[str] = None,
         trace_ctx=None,
+        max_staleness_ms: Optional[float] = None,
     ):
         """Route one request. Sticky ``session_key`` pins to a replica
         (drain/death re-pin transparently); otherwise the consistent-hash
@@ -497,7 +603,10 @@ class FleetRouter:
             key=route_key, pinned=session_key is not None,
         ) as route_span:
             while True:
-                handle = self._pick(route_key, session_key, exclude=tried)
+                handle = self._pick(
+                    route_key, session_key, exclude=tried,
+                    max_staleness_ms=max_staleness_ms,
+                )
                 if handle is None:
                     registry.counter("fleet.router.no_replica").inc()
                     route_span.annotate(
@@ -522,6 +631,12 @@ class FleetRouter:
                         att.annotate(verdict="ok")
                         handle.stats["ok"] += 1
                         registry.counter("fleet.router.routed").inc()
+                        if handle.is_follower:
+                            # the read-scale-out share: hinted reads a
+                            # follower absorbed instead of the leader
+                            registry.counter(
+                                "fleet.router.follower_reads"
+                            ).inc()
                         if attempt:
                             # wall spent re-routing past failed candidates:
                             # the router-failover-latency headline
@@ -656,13 +771,18 @@ class FleetRouter:
         route_key: str,
         session_key: Optional[str],
         exclude: List[str],
+        max_staleness_ms: Optional[float] = None,
     ) -> Optional[ReplicaHandle]:
         if session_key is not None:
+            # sticky sessions imply read-write affinity: pins stay on
+            # leaders regardless of any staleness hint
             pinned = self.pin(session_key, exclude=exclude)
             if pinned is not None and pinned.name not in exclude:
                 return pinned
             return None
-        for handle in self.candidates_for(route_key):
+        for handle in self.candidates_for(
+            route_key, max_staleness_ms=max_staleness_ms
+        ):
             if handle.name not in exclude:
                 return handle
         return None
@@ -962,6 +1082,259 @@ class StateGossip:
 
 
 # ---------------------------------------------------------------------------
+# Follower role (durable-CDC read replicas)
+# ---------------------------------------------------------------------------
+
+class CDCFollower:
+    """Follower-side replication loop over a durable CDC log.
+
+    Bootstraps its CSR state from a PR 8/15 shard checkpoint
+    (``olap/sharded_checkpoint.load_csr_checkpoint``), then pulls the
+    leader's netted delta records from ``source`` (a ``storage/cdc.py``
+    :class:`CDCLog` in-process, or a :class:`CDCReader` over the shared
+    log directory — the fleet pull plane) and folds them through
+    ``materialize`` — O(delta) per pull, zero store reads. A cursor gap
+    (retention prune, poison, corrupt segment) is answered honestly:
+    counted, and the follower re-bootstraps from the checkpoint.
+
+    Replay application is idempotent by epoch: records at or below
+    ``last_applied_epoch`` fold to nothing, so pulling the same cursor
+    twice equals pulling it once (tests/test_cdc.py).
+
+    ``promote()`` is the leader-death path: one final forced catch-up
+    from the durable log, then the role flips — the flight recorder sees
+    ``follower_promote`` and a ``cdc_replay``/``caught_up`` event, the
+    two phases the federation incident grammar stitches after a kill.
+
+    Staleness is self-reported and honest: seconds since this follower
+    last PROVED itself caught up to the log head. Past the priced bound
+    (``server.fleet.follower-max-staleness-ms``, the PR 13 freshness
+    ceiling) the /healthz cdc block flags ``degraded`` and the router
+    stops preferring the follower for hinted reads."""
+
+    def __init__(
+        self,
+        source,
+        checkpoint_dir: str,
+        graph=None,
+        idm=None,
+        name: str = "",
+        max_staleness_ms: float = 10_000.0,
+        fault_plan=None,
+        clock: Callable[[], float] = time.monotonic,
+    ):
+        self.source = source
+        self.checkpoint_dir = checkpoint_dir
+        self.graph = graph
+        self.idm = idm if idm is not None else getattr(graph, "idm", None)
+        self.name = name
+        self.max_staleness_ms = float(max_staleness_ms)
+        self.fault_plan = fault_plan
+        self._clock = clock
+        self.role = "follower"
+        self.csr = None
+        self.cursor: Optional[int] = None
+        self.last_applied_epoch = -1
+        self.rebootstraps = 0
+        self._caught_up_at: Optional[float] = None
+        self._lock = threading.RLock()
+
+    # ------------------------------------------------------------ lifecycle
+    def bootstrap(self) -> bool:
+        """Hydrate from the shard checkpoint and anchor the replay
+        cursor at the checkpoint's epoch. False = cannot serve (no
+        checkpoint, or the log cannot cover the epoch gap — the
+        checkpoint is older than the pruned range)."""
+        from janusgraph_tpu.observability import flight_recorder, registry
+        from janusgraph_tpu.olap.sharded_checkpoint import (
+            load_csr_checkpoint,
+        )
+
+        with self._lock:
+            pack = load_csr_checkpoint(self.checkpoint_dir)
+            if pack is None:
+                registry.counter("fleet.follower.bootstrap_misses").inc()
+                return False
+            csr, epoch = pack
+            cursor = self.source.cursor_for_epoch(epoch)
+            if cursor is None:
+                # the log pruned/poisoned records past this checkpoint's
+                # epoch: replay could silently skip them — refuse
+                registry.counter("fleet.follower.bootstrap_misses").inc()
+                return False
+            self.csr = csr
+            self.last_applied_epoch = int(epoch)
+            self.cursor = int(cursor)
+            self._caught_up_at = self._clock()
+            self._adopt()
+        registry.counter("fleet.follower.bootstraps").inc()
+        flight_recorder.record(
+            "fleet", action="follower_bootstrap", replica=self.name,
+            epoch=int(epoch), cursor=int(cursor),
+            rows=int(csr.num_vertices), edges=int(csr.num_edges),
+        )
+        return True
+
+    def _adopt(self) -> None:
+        """Install the follower's CSR into its serving graph's
+        DeltaSnapshot (lock held) so OLAP/spillover reads on this
+        replica serve the replicated state — the warm_replica adoption
+        discipline, re-anchored at the follower's own local epoch."""
+        if self.graph is None:
+            return
+        from janusgraph_tpu.olap import delta as _delta
+
+        snap = _delta.get_snapshot(self.graph)
+        if snap is not None:
+            snap.adopt(self.csr, self.graph.backend.mutation_epoch())
+
+    # ----------------------------------------------------------- replication
+    def pull(self, force: bool = False) -> dict:
+        """One replication pull: replay from the cursor, fold the fresh
+        records, advance. A ``None`` replay (gap) re-bootstraps. The
+        seeded lagging-follower fault skips applying (staleness grows)
+        unless ``force`` — promotion's final catch-up is never skipped."""
+        from janusgraph_tpu.observability import registry
+
+        with self._lock:
+            if self.csr is None and not self.bootstrap():
+                return {"ok": False, "applied": 0, "reason": "no-bootstrap"}
+            plan = self.fault_plan
+            if not force and plan is not None and plan.follower_lag():
+                registry.counter("fleet.follower.lagged_pulls").inc()
+                return {
+                    "ok": True, "applied": 0, "lagging": True,
+                    "cursor": self.cursor,
+                }
+            cursor = self.cursor
+            base = self.csr
+            floor = self.last_applied_epoch
+        # the replay + fold run OUTSIDE the lock (JG403): both are pure
+        # over the captured base, so a blocked holder never stalls
+        # staleness probes; the commit below is optimistic — a
+        # concurrent pull that advanced the cursor first wins
+        replay = self.source.replay_from(cursor)
+        if replay is None:
+            with self._lock:
+                if self.cursor != cursor:
+                    return {
+                        "ok": True, "applied": 0, "raced": True,
+                        "cursor": self.cursor,
+                    }
+                # honest gap: count it and rebuild from the checkpoint
+                registry.counter("fleet.follower.cursor_gaps").inc()
+                self.rebootstraps += 1
+                self.csr = None
+                ok = self.bootstrap()
+                return {
+                    "ok": ok, "applied": 0, "rebootstrap": True,
+                    "cursor": self.cursor,
+                }
+        records, next_cursor = replay
+        fresh = [(e, b) for e, b in records if e > floor]
+        folded = base
+        if fresh:
+            from janusgraph_tpu.olap.delta import (
+                DeltaOverlay,
+                materialize,
+            )
+
+            overlay = DeltaOverlay.from_batches([b for _e, b in fresh])
+            folded = materialize(base, overlay, idm=self.idm)
+        with self._lock:
+            if self.cursor != cursor or self.csr is not base:
+                return {
+                    "ok": True, "applied": 0, "raced": True,
+                    "cursor": self.cursor,
+                }
+            if fresh:
+                self.csr = folded
+                self.last_applied_epoch = max(e for e, _b in fresh)
+                self._adopt()
+            self.cursor = int(next_cursor)
+            self._caught_up_at = self._clock()
+            registry.counter("fleet.follower.pulls").inc()
+            registry.set_gauge(
+                "fleet.follower.applied_epoch",
+                float(self.last_applied_epoch),
+            )
+            return {
+                "ok": True, "applied": len(fresh),
+                "cursor": self.cursor,
+                "epoch": self.last_applied_epoch,
+            }
+
+    def promote(self) -> dict:
+        """Leader-death path: final forced catch-up from the durable
+        log, then flip to leader. Returns the promotion report (the
+        bench's ``promote_ms`` headline)."""
+        from janusgraph_tpu.observability import flight_recorder, registry
+
+        t0 = self._clock()
+        # the forced catch-up manages its own locking (the fold itself
+        # runs lock-free); only the role flip needs the lock
+        report = self.pull(force=True)
+        with self._lock:
+            self.role = "leader"
+        promote_ms = (self._clock() - t0) * 1000.0
+        registry.counter("fleet.follower.promotions").inc()
+        flight_recorder.record(
+            "follower_promote", replica=self.name,
+            promote_ms=round(promote_ms, 3),
+            cursor=self.cursor, epoch=self.last_applied_epoch,
+            applied=report.get("applied", 0), ok=report.get("ok", False),
+        )
+        # the caught-up proof closes the incident grammar's final phase:
+        # kill -> promote -> caught_up
+        flight_recorder.record(
+            "cdc_replay", action="caught_up", replica=self.name,
+            cursor=self.cursor, epoch=self.last_applied_epoch,
+        )
+        return {
+            "promote_ms": promote_ms,
+            "cursor": self.cursor,
+            "epoch": self.last_applied_epoch,
+            "applied": report.get("applied", 0),
+            "ok": report.get("ok", False),
+        }
+
+    # -------------------------------------------------------------- healthz
+    def staleness_s(self) -> float:
+        with self._lock:
+            if self._caught_up_at is None:
+                return float("inf")
+            return max(0.0, self._clock() - self._caught_up_at)
+
+    def lag_records(self) -> int:
+        with self._lock:
+            if self.cursor is None:
+                return 0
+            try:
+                head = self.source.head_cursor()
+            except Exception:  # noqa: BLE001 - lag is advisory
+                return 0
+            return max(0, int(head) - int(self.cursor))
+
+    def healthz_block(self) -> dict:
+        stale = self.staleness_s()
+        with self._lock:
+            return {
+                "role": self.role,
+                "cursor": self.cursor,
+                "lag_records": self.lag_records(),
+                "last_applied_epoch": self.last_applied_epoch,
+                "staleness_s": (
+                    round(stale, 3) if stale != float("inf") else None
+                ),
+                "rebootstraps": self.rebootstraps,
+                "degraded": (
+                    self.role == "follower"
+                    and stale * 1000.0 > self.max_staleness_ms
+                ),
+            }
+
+
+# ---------------------------------------------------------------------------
 # Replica warm-up (snapshot-CSR cache hydration)
 # ---------------------------------------------------------------------------
 
@@ -1194,6 +1567,15 @@ class FleetFrontend:
                     deadline_ms = float(deadline) if deadline else None
                 except (TypeError, ValueError):
                     deadline_ms = None
+                # the freshness hint: a client declaring it tolerates N
+                # ms of staleness may be served by a follower replica
+                stale = self.headers.get(
+                    "X-Max-Staleness-Ms"
+                ) or req.get("max_staleness_ms")
+                try:
+                    max_staleness_ms = float(stale) if stale else None
+                except (TypeError, ValueError):
+                    max_staleness_ms = None
                 from janusgraph_tpu.observability.spans import TraceContext
 
                 # the caller's trace joins the routing episode: the
@@ -1209,6 +1591,7 @@ class FleetFrontend:
                         deadline_ms=deadline_ms,
                         session_key=req.get("session_key"),
                         trace_ctx=trace_ctx,
+                        max_staleness_ms=max_staleness_ms,
                     )
                 except NoReplicaAvailable as e:
                     self._json(503, {"result": {"data": None}, "status": {

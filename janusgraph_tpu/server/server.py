@@ -341,6 +341,10 @@ class JanusGraphServer:
         #: (rides /healthz; the CLI runners also set the process-wide
         #: telemetry tag, observability/identity.py)
         self.replica_name = replica_name
+        #: replication state surfaced as the /healthz ``cdc`` block: a
+        #: server/fleet.CDCFollower (follower role) or a storage/cdc.
+        #: LeaderCDCState (leader with a durable log); None = no CDC
+        self.cdc_state = None
         #: graceful-drain mode: True stops admitting NEW sessionless
         #: requests and session opens (shed with status "draining", which
         #: the fleet router treats as retry-elsewhere) while in-flight
@@ -903,6 +907,14 @@ class _Handler(BaseHTTPRequestHandler):
             payload["open_sessions"] = server.open_sessions
             if server.gossip is not None:
                 payload["fleet_peers"] = dict(server.gossip.peer_state)
+            if server.cdc_state is not None:
+                # replication lane: role + durable cursor + honest
+                # staleness; a follower past the priced staleness bound
+                # IS degraded — the router must stop preferring it
+                cdc = server.cdc_state.healthz_block()
+                payload["cdc"] = cdc
+                if cdc.get("degraded"):
+                    payload["status"] = "degraded"
             code = 200 if payload["status"] == "ok" else 503
             self._send_json(code, payload)
             return

@@ -66,6 +66,15 @@ Fault kinds (all off by default):
                      ``+ replica-partition-ops``) — the breaker trips,
                      /healthz degrades, and the router must route around
                      a replica that looks alive but cannot reach data
+``cdc_torn_segment`` the Nth CDC log append writes HALF a frame and
+                     crashes (:class:`storage.cdc.CDCTornWrite`) — reopen
+                     recovery drops exactly the torn suffix; sealed
+                     segments are never at risk (storage/cdc.py)
+``cdc_lagging_follower`` a follower's next ``follower-lag-pulls`` pulls
+                     skip applying (staleness grows past the bound,
+                     /healthz degrades) — promotion force-pulls through
+                     the window, so leader failover is never blocked by
+                     the lag fault (server/fleet.py ``CDCFollower``)
 ===================  =====================================================
 
 The four ``shard-*`` kinds are scheduled/decided exactly like the
@@ -155,6 +164,9 @@ class FaultPlan:
         replica_partition_at: int = -1,
         replica_partition_ops: int = 0,
         replica_target: int = -1,
+        cdc_torn_at: int = -1,
+        follower_lag_at: int = -1,
+        follower_lag_pulls: int = 0,
         stores: Sequence[str] = DEFAULT_FAULT_STORES,
         journal_limit: int = 4096,
     ):
@@ -182,6 +194,11 @@ class FaultPlan:
         self.replica_partition_at = replica_partition_at
         self.replica_partition_ops = replica_partition_ops
         self._replica_target_cfg = replica_target
+        self.cdc_torn_at = cdc_torn_at
+        self.follower_lag_at = follower_lag_at
+        self.follower_lag_pulls = follower_lag_pulls
+        self._cdc_torn_fired = False
+        self._follower_lag_recorded = False
         #: which fleet replica THIS plan instance belongs to (set by the
         #: fleet harness when wiring each replica's graph; -1 = not part
         #: of a fleet, so the partition window never applies)
@@ -251,6 +268,11 @@ class FaultPlan:
                 "storage.faults.replica-partition-ops"
             ),
             replica_target=cfg.get("storage.faults.replica-target"),
+            cdc_torn_at=cfg.get("storage.faults.cdc-torn-at"),
+            follower_lag_at=cfg.get("storage.faults.follower-lag-at"),
+            follower_lag_pulls=cfg.get(
+                "storage.faults.follower-lag-pulls"
+            ),
             stores=stores,
         )
 
@@ -349,6 +371,40 @@ class FaultPlan:
             self._record("replica_restart", n, replica=target)
             events.append({"kind": "replica_restart", "replica": target})
         return events
+
+    # ------------------------------------------------------------- cdc hooks
+    def cdc_torn_write(self) -> bool:
+        """Tear THIS tail append (a partial frame hits disk and the
+        writer dies)? Fires once at ``cdc-torn-at`` — the torn-tail case
+        CDCLog recovery contains to exactly one frame (storage/cdc.py)."""
+        n = self._tick("cdc-append")
+        if not self._cdc_torn_fired and 0 <= self.cdc_torn_at <= n:
+            self._cdc_torn_fired = True
+            self._record("cdc_torn_segment", n)
+            return True
+        return False
+
+    def follower_lag(self) -> bool:
+        """Stall THIS follower pull (skip applying, so staleness grows)?
+        True across the window [follower-lag-at, +follower-lag-pulls);
+        journaled once at the leading edge. The router must respond by
+        sending freshness-hinted traffic back to the leader."""
+        n = self._tick("follower-pull")
+        if (
+            self.follower_lag_at >= 0
+            and self.follower_lag_pulls > 0
+            and self.follower_lag_at
+            <= n
+            < self.follower_lag_at + self.follower_lag_pulls
+        ):
+            if not self._follower_lag_recorded:
+                self._follower_lag_recorded = True
+                self._record(
+                    "cdc_lagging_follower", n,
+                    pulls=self.follower_lag_pulls,
+                )
+            return True
+        return False
 
     # ----------------------------------------------------------- store hooks
     def before_read(self, store: str) -> None:

@@ -1,0 +1,52 @@
+"""JG305 fixture (PR 18 extension): direct writes to CDC log paths.
+
+Sealed CDC segments and the CDC manifest carry digest-embedded headers
+and commit via tmp + rename (storage/cdc.py); open(path, "w") on a
+``*-segment`` / ``*.cdc*`` name can tear mid-write and silently break
+replay — the loss lands exactly where followers expect integrity.
+"""
+
+import json
+import os
+import tempfile
+
+
+def seal_segment_bad(path, payload):
+    with open(path + ".segment", "wb") as f:  # expect: JG305
+        f.write(payload)
+
+
+def seal_named_segment_bad(log_dir, seq, payload):
+    f = open(log_dir + "/cdc-%06d.segment" % seq, "wb")  # expect: JG305
+    try:
+        f.write(payload)
+    finally:
+        f.close()
+
+
+def write_cdc_manifest_bad(log_dir, body):
+    with open(log_dir + "/manifest.cdc.json", "w") as f:  # expect: JG305
+        json.dump(body, f)
+
+
+def seal_segment_good(segment_path, payload):
+    # the atomic discipline: tmp sibling in the target directory, then
+    # rename onto the committed name — complete-or-absent, never torn
+    d = os.path.dirname(os.path.abspath(segment_path)) or "."
+    fd, tmp = tempfile.mkstemp(dir=d, suffix=".segment.tmp")
+    with os.fdopen(fd, "wb") as f:
+        f.write(payload)
+    os.replace(tmp, segment_path)
+
+
+def append_tail_good(log_dir, frame):
+    # the active tail is the uncommitted intermediate by DESIGN: its
+    # .tmp name marks it torn-tolerant (recovery drops the torn suffix)
+    with open(log_dir + "/cdc-tail.tmp", "ab") as f:
+        f.write(frame)
+
+
+def read_segment_good(segment_path):
+    # reads are harmless — only write modes commit torn bytes
+    with open(segment_path, "rb") as f:
+        return f.read()

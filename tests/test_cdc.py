@@ -1,0 +1,572 @@
+"""Durable CDC log + follower replicas (ISSUE 18).
+
+Covers the acceptance list:
+
+- codec roundtrip: batches encode via the fixed-width bulk edge codec
+  lanes and decode bitwise-identical (edges, vertex add/del, poison);
+- torn-tail recovery: a torn/garbage tail suffix costs exactly the torn
+  frames, never a sealed segment, never the log;
+- seal/manifest discipline: sealed segments + digest-verified manifest
+  survive restart; replay serves across the seal boundary;
+- replay idempotence: replay_from(cursor) twice == once, and a follower
+  applying the same records twice folds to the same CSR;
+- cursor-gap honesty: retention pruning answers None (re-bootstrap),
+  counted; poison in range answers None;
+- follower-read bitwise-equivalence: bootstrap from a shard checkpoint
+  + pulled CDC records == a fresh-scan materialize at the same epoch;
+- seeded cdc-torn-segment / cdc-lagging-follower fault kinds: pure in
+  the seed, journal byte-equal across runs;
+- staleness-hinted routing: unhinted traffic never sees a follower,
+  hinted traffic prefers fresh followers, stale ones fall back to the
+  leader; /timeseries trend slope sharpens the tie-break;
+- /healthz cdc block: leader + follower roles, degraded past the bound.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import urllib.request
+
+import numpy as np
+import pytest
+
+from janusgraph_tpu.core.graph import JanusGraphTPU
+from janusgraph_tpu.olap import delta as D
+from janusgraph_tpu.olap.csr import load_csr, load_csr_snapshot
+from janusgraph_tpu.olap.sharded_checkpoint import save_csr_checkpoint
+from janusgraph_tpu.server import (
+    FleetRouter,
+    JanusGraphManager,
+    JanusGraphServer,
+)
+from janusgraph_tpu.server.fleet import CDCFollower, goodput_slope
+from janusgraph_tpu.storage.cdc import (
+    CDCLog,
+    CDCReader,
+    CDCTornWrite,
+    LeaderCDCState,
+    TAIL_NAME,
+    decode_batch,
+    encode_batch,
+)
+from janusgraph_tpu.storage.faults import FaultPlan
+from janusgraph_tpu.storage.inmemory import InMemoryStoreManager
+from janusgraph_tpu.observability import flight_recorder, registry
+
+BASE_CFG = {
+    "ids.authority-wait-ms": 0.0,
+    "locks.wait-ms": 0.0,
+    "computer.delta": True,
+}
+
+
+def _counter(name):
+    return registry.snapshot().get(name, {}).get("count", 0)
+
+
+def _batch(adds=(), dels=(), v_add=None, v_del=None):
+    def _lanes(rows):
+        if not rows:
+            z = np.empty(0, np.int64)
+            return z, z.copy(), z.copy()
+        a = np.asarray(rows, np.int64).reshape(-1, 3)
+        return a[:, 0].copy(), a[:, 1].copy(), a[:, 2].copy()
+
+    a = _lanes(list(adds))
+    d = _lanes(list(dels))
+    v_add = dict(v_add or {})
+    v_del = list(v_del or [])
+    return {
+        "n": len(a[0]) + len(d[0]) + len(v_add) + len(v_del),
+        "add": a,
+        "del": d,
+        "v_add": v_add,
+        "v_del": v_del,
+    }
+
+
+def _assert_batch_equal(x, y):
+    for lane in ("add", "del"):
+        for i in range(3):
+            np.testing.assert_array_equal(x[lane][i], y[lane][i])
+    assert x["v_add"] == y["v_add"]
+    assert list(x["v_del"]) == list(y["v_del"])
+    assert x["n"] == y["n"]
+
+
+def _graph_chain(tmp_path=None, n=24, extra=None):
+    cfg = dict(BASE_CFG)
+    if tmp_path is not None:
+        cfg["storage.cdc.dir"] = str(tmp_path)
+        cfg["storage.cdc.segment-records"] = 4
+    cfg.update(extra or {})
+    g = JanusGraphTPU(cfg, store_manager=InMemoryStoreManager())
+    g.management().make_edge_label("link")
+    tx = g.new_transaction()
+    ids = [tx.add_vertex().id for _ in range(n)]
+    for i in range(n - 1):
+        tx.add_edge(tx.get_vertex(ids[i]), "link", tx.get_vertex(ids[i + 1]))
+    tx.commit()
+    return g, ids
+
+
+def _burst(g, ids, seed=7, adds=10, dels=2):
+    from janusgraph_tpu.core.codecs import Direction
+
+    rng = np.random.default_rng(seed)
+    tx = g.new_transaction()
+    for _ in range(adds):
+        a, b = rng.integers(0, len(ids), 2)
+        tx.add_edge(
+            tx.get_vertex(ids[int(a)]), "link",
+            tx.get_vertex(ids[int(b)]),
+        )
+    removed = 0
+    for i in rng.permutation(len(ids)):
+        if removed >= dels:
+            break
+        es = tx.get_edges(
+            tx.get_vertex(ids[int(i)]), Direction.OUT, ("link",)
+        )
+        if es:
+            tx.remove_edge(es[0])
+            removed += 1
+    tx.commit()
+
+
+def _assert_csr_equal(a, b):
+    np.testing.assert_array_equal(a.vertex_ids, b.vertex_ids)
+    np.testing.assert_array_equal(a.out_indptr, b.out_indptr)
+    np.testing.assert_array_equal(a.in_indptr, b.in_indptr)
+    np.testing.assert_array_equal(a.out_dst, b.out_dst)
+    np.testing.assert_array_equal(a.in_src, b.in_src)
+
+
+# ---------------------------------------------------------------- codec
+class TestCodec:
+    def test_roundtrip_mixed(self):
+        b = _batch(
+            adds=[(1, 2, 9), (3, 4, 9), (1, 2, 9)],
+            dels=[(5, 6, 11)],
+            v_add={7: 0, 8: 3},
+            v_del=[9, 10],
+        )
+        epoch, back = decode_batch(encode_batch(42, b))
+        assert epoch == 42
+        _assert_batch_equal(b, back)
+
+    def test_roundtrip_empty_lanes(self):
+        b = _batch(v_del=[3])
+        epoch, back = decode_batch(encode_batch(1, b))
+        assert epoch == 1
+        _assert_batch_equal(b, back)
+
+    def test_poison_roundtrip(self):
+        epoch, back = decode_batch(encode_batch(5, None))
+        assert epoch == 5 and back is None
+
+    def test_large_vids_survive(self):
+        big = (1 << 60) + 12345
+        b = _batch(adds=[(big, big - 1, 1 << 40)])
+        _epoch, back = decode_batch(encode_batch(9, b))
+        assert int(back["add"][0][0]) == big
+        assert int(back["add"][1][0]) == big - 1
+        assert int(back["add"][2][0]) == 1 << 40
+
+
+# ---------------------------------------------------------------- the log
+class TestCDCLog:
+    def _fill(self, log, n, start_epoch=1):
+        for i in range(n):
+            log.append(start_epoch + i, _batch(adds=[(i, i + 1, 1)]))
+
+    def test_append_replay_reopen(self, tmp_path):
+        log = CDCLog(str(tmp_path), segment_records=4)
+        self._fill(log, 6)
+        records, nxt = log.replay_from(0)
+        assert len(records) == 6 and nxt == 6
+        assert log.stats()["sealed_segments"] == 1
+        log.close()
+        # restart: sealed segment + tail survive
+        log2 = CDCLog(str(tmp_path), segment_records=4)
+        records2, nxt2 = log2.replay_from(0)
+        assert nxt2 == 6
+        for (e1, b1), (e2, b2) in zip(records, records2):
+            assert e1 == e2
+            _assert_batch_equal(b1, b2)
+        log2.close()
+
+    def test_replay_is_idempotent(self, tmp_path):
+        log = CDCLog(str(tmp_path), segment_records=4)
+        self._fill(log, 5)
+        r1 = log.replay_from(2)
+        r2 = log.replay_from(2)
+        assert r1[1] == r2[1]
+        assert [e for e, _ in r1[0]] == [e for e, _ in r2[0]]
+        log.close()
+
+    def test_torn_tail_costs_only_torn_suffix(self, tmp_path):
+        log = CDCLog(str(tmp_path), segment_records=64)
+        self._fill(log, 3)
+        log.close()
+        # tear: garbage bytes land after the intact frames
+        with open(os.path.join(str(tmp_path), TAIL_NAME), "ab") as f:
+            f.write(b"\x00\x01torn-partial-frame")
+        before = _counter("cdc.torn_frames_dropped")
+        log2 = CDCLog(str(tmp_path), segment_records=64)
+        assert _counter("cdc.torn_frames_dropped") == before + 1
+        records, nxt = log2.replay_from(0)
+        assert len(records) == 3 and nxt == 3
+        # and the log keeps appending cleanly after recovery
+        log2.append(10, _batch(adds=[(9, 9, 9)]))
+        assert log2.replay_from(0)[1] == 4
+        log2.close()
+
+    def test_injected_torn_write_recovers_deterministically(self, tmp_path):
+        plan = FaultPlan(seed=7, cdc_torn_at=2)
+        log = CDCLog(str(tmp_path), segment_records=64, fault_plan=plan)
+        log.append(1, _batch(adds=[(1, 2, 1)]))
+        log.append(2, _batch(adds=[(2, 3, 1)]))
+        with pytest.raises(CDCTornWrite):
+            log.append(3, _batch(adds=[(3, 4, 1)]))
+        log.close()
+        log2 = CDCLog(str(tmp_path), segment_records=64)
+        records, nxt = log2.replay_from(0)
+        assert nxt == 2, "exactly the torn frame is gone"
+        assert [e for e, _ in records] == [1, 2]
+        assert plan.journal == [{"kind": "cdc_torn_segment", "n": 2}]
+        log2.close()
+
+    def test_sealed_segments_survive_tail_loss(self, tmp_path):
+        log = CDCLog(str(tmp_path), segment_records=4)
+        self._fill(log, 9)  # 2 sealed segments + 1 tail record
+        log.close()
+        os.unlink(os.path.join(str(tmp_path), TAIL_NAME))
+        log2 = CDCLog(str(tmp_path), segment_records=4)
+        records, nxt = log2.replay_from(0)
+        assert nxt == 8 and len(records) == 8
+        log2.close()
+
+    def test_retention_prune_makes_honest_gap(self, tmp_path):
+        log = CDCLog(
+            str(tmp_path), segment_records=4, retention_segments=1
+        )
+        self._fill(log, 12, start_epoch=1)  # 3 seals; first two pruned
+        assert log.base_cursor == 8
+        assert log.replay_from(0) is None, "pruned range must not serve"
+        records, nxt = log.replay_from(8)
+        assert nxt == 12 and len(records) == 4
+        # a bootstrap checkpoint older than the pruned range cannot
+        # anchor: records past its epoch are gone
+        assert log.cursor_for_epoch(2) is None
+        assert log.cursor_for_epoch(11) == 11
+        log.close()
+
+    def test_poison_in_range_refuses(self, tmp_path):
+        log = CDCLog(str(tmp_path), segment_records=64)
+        log.append(1, _batch(adds=[(1, 2, 1)]))
+        log.append(2, None)  # poison
+        log.append(3, _batch(adds=[(3, 4, 1)]))
+        assert log.replay_from(0) is None
+        assert log.replay_from(1) is None
+        records, nxt = log.replay_from(2)
+        assert len(records) == 1 and nxt == 3
+        log.close()
+
+    def test_cursor_for_epoch_brackets(self, tmp_path):
+        log = CDCLog(str(tmp_path), segment_records=4)
+        self._fill(log, 6, start_epoch=10)  # epochs 10..15
+        assert log.cursor_for_epoch(9) == 0
+        assert log.cursor_for_epoch(12) == 3
+        assert log.cursor_for_epoch(15) == 6
+        assert log.cursor_for_epoch(99) == 6
+        log.close()
+
+    def test_reader_matches_writer(self, tmp_path):
+        log = CDCLog(str(tmp_path), segment_records=4)
+        self._fill(log, 7, start_epoch=1)
+        reader = CDCReader(str(tmp_path))
+        assert reader.head_cursor() == log.head_cursor() == 7
+        rw, nw = log.replay_from(3)
+        rr, nr = reader.replay_from(3)
+        assert nw == nr
+        assert [e for e, _ in rw] == [e for e, _ in rr]
+        for (_, b1), (_, b2) in zip(rw, rr):
+            _assert_batch_equal(b1, b2)
+        assert reader.cursor_for_epoch(4) == log.cursor_for_epoch(4)
+        log.close()
+
+    def test_pow2_segment_size_enforced(self, tmp_path):
+        with pytest.raises(ValueError):
+            CDCLog(str(tmp_path), segment_records=7)
+
+
+# ------------------------------------------------------- capture -> log
+class TestCaptureFeed:
+    def test_commits_stream_into_the_log(self, tmp_path):
+        g, ids = _graph_chain(tmp_path / "cdc")
+        try:
+            assert g.cdc_log is not None
+            head0 = g.cdc_log.head_cursor()
+            assert head0 > 0, "seed commits must have streamed in"
+            _burst(g, ids, seed=3)
+            assert g.cdc_log.head_cursor() > head0
+            records, _nxt = g.cdc_log.replay_from(0)
+            assert all(b["n"] > 0 for _e, b in records)
+        finally:
+            g.close()
+
+    def test_fresh_scan_equivalence_from_cursor_zero(self, tmp_path):
+        """The tentpole property: an empty-base materialize over ALL
+        durable records == the live graph's fresh scan, bitwise."""
+        g, ids = _graph_chain(tmp_path / "cdc")
+        try:
+            csr0, epoch0 = load_csr_snapshot(g)
+            _burst(g, ids, seed=5)
+            _burst(g, ids, seed=6)
+            cursor = g.cdc_log.cursor_for_epoch(epoch0)
+            records, _ = g.cdc_log.replay_from(cursor)
+            overlay = D.DeltaOverlay.from_batches([b for _e, b in records])
+            folded = D.materialize(csr0, overlay, idm=g.idm)
+            _assert_csr_equal(folded, load_csr(g))
+        finally:
+            g.close()
+
+
+# ------------------------------------------------------------- follower
+class TestFollower:
+    def _leader_with_checkpoint(self, tmp_path):
+        g, ids = _graph_chain(tmp_path / "cdc")
+        csr, epoch = load_csr_snapshot(g)
+        ckpt = str(tmp_path / "ckpt")
+        save_csr_checkpoint(ckpt, csr, epoch, num_shards=2)
+        return g, ids, ckpt
+
+    def test_follower_read_bitwise_equivalence(self, tmp_path):
+        g, ids, ckpt = self._leader_with_checkpoint(tmp_path)
+        try:
+            f = CDCFollower(g.cdc_log, ckpt, idm=g.idm, name="f0")
+            assert f.bootstrap()
+            _burst(g, ids, seed=11)
+            rep = f.pull()
+            assert rep["ok"] and rep["applied"] >= 1
+            # leader materialize at the SAME epoch == follower state
+            _assert_csr_equal(f.csr, load_csr(g))
+            assert f.lag_records() == 0
+        finally:
+            g.close()
+
+    def test_apply_twice_equals_apply_once(self, tmp_path):
+        g, ids, ckpt = self._leader_with_checkpoint(tmp_path)
+        try:
+            _burst(g, ids, seed=13)
+            f1 = CDCFollower(g.cdc_log, ckpt, idm=g.idm)
+            assert f1.bootstrap()
+            f1.pull()
+            once = f1.csr
+            # second follower rewinds its cursor and pulls the SAME
+            # records again: the epoch guard folds them to nothing
+            f2 = CDCFollower(g.cdc_log, ckpt, idm=g.idm)
+            assert f2.bootstrap()
+            f2.pull()
+            f2.cursor = 0
+            rep = f2.pull()
+            assert rep["ok"] and rep["applied"] == 0
+            _assert_csr_equal(once, f2.csr)
+        finally:
+            g.close()
+
+    def test_cursor_gap_rebootstraps_honestly(self, tmp_path):
+        g, ids = _graph_chain(
+            tmp_path / "cdc", extra={"storage.cdc.retention-segments": 1}
+        )
+        try:
+            csr, epoch = load_csr_snapshot(g)
+            ckpt = str(tmp_path / "ckpt")
+            save_csr_checkpoint(ckpt, csr, epoch, num_shards=1)
+            f = CDCFollower(g.cdc_log, ckpt, idm=g.idm)
+            assert f.bootstrap()
+            # churn far past retention (each burst commit is one CDC
+            # record; 12 records == 3 sealed segments, 2 pruned): the
+            # follower's cursor falls inside the pruned range
+            for s in range(12):
+                _burst(g, ids, seed=20 + s, adds=12, dels=0)
+            assert g.cdc_log.base_cursor > 0, "prune must have happened"
+            f.cursor = 0
+            before = _counter("fleet.follower.cursor_gaps")
+            # stale checkpoint cannot re-anchor either -> honest failure
+            rep = f.pull()
+            assert _counter("fleet.follower.cursor_gaps") == before + 1
+            assert rep.get("rebootstrap") and not rep["ok"]
+            assert f.rebootstraps == 1
+            # a FRESH checkpoint (epoch past the pruned range) heals it
+            csr2, epoch2 = load_csr_snapshot(g)
+            save_csr_checkpoint(ckpt, csr2, epoch2, num_shards=1)
+            assert f.bootstrap()
+            _burst(g, ids, seed=30)
+            rep2 = f.pull()
+            assert rep2["ok"]
+            _assert_csr_equal(f.csr, load_csr(g))
+        finally:
+            g.close()
+
+    def test_lagging_follower_fault_then_promote(self, tmp_path):
+        g, ids, ckpt = self._leader_with_checkpoint(tmp_path)
+        try:
+            fake = {"t": 100.0}
+            plan = FaultPlan(seed=3, follower_lag_at=0, follower_lag_pulls=2)
+            f = CDCFollower(
+                g.cdc_log, ckpt, idm=g.idm, name="f1",
+                max_staleness_ms=500.0, fault_plan=plan,
+                clock=lambda: fake["t"],
+            )
+            assert f.bootstrap()
+            _burst(g, ids, seed=41)
+            assert f.pull().get("lagging")
+            fake["t"] += 1.0  # 1s > the 500ms bound
+            block = f.healthz_block()
+            assert block["role"] == "follower" and block["degraded"]
+            assert block["lag_records"] > 0
+            # promotion force-pulls THROUGH the lag window
+            before = len(flight_recorder.events())
+            rep = f.promote()
+            assert rep["ok"] and f.role == "leader"
+            _assert_csr_equal(f.csr, load_csr(g))
+            cats = [
+                e["category"] for e in flight_recorder.events()[before:]
+            ]
+            assert "follower_promote" in cats
+            caught = [
+                e for e in flight_recorder.events()[before:]
+                if e["category"] == "cdc_replay"
+                and e.get("action") == "caught_up"
+            ]
+            assert caught, "promotion must prove itself caught up"
+            assert not f.healthz_block()["degraded"], (
+                "a promoted leader is never stale against itself"
+            )
+            assert plan.journal[0]["kind"] == "cdc_lagging_follower"
+        finally:
+            g.close()
+
+    def test_fault_journal_is_seed_deterministic(self):
+        def _run():
+            plan = FaultPlan(
+                seed=77, cdc_torn_at=1,
+                follower_lag_at=1, follower_lag_pulls=2,
+            )
+            for _ in range(4):
+                plan.cdc_torn_write()
+            for _ in range(5):
+                plan.follower_lag()
+            return json.dumps(plan.journal, sort_keys=True)
+
+        assert _run() == _run()
+
+
+# ------------------------------------------------------ routing + healthz
+class TestStalenessRouting:
+    def _router_with_roles(self):
+        r = FleetRouter(fetch=lambda url, t: {})
+        for i in range(3):
+            r.add_replica(f"r{i}", "127.0.0.1", 9000 + i)
+        reps = r.replicas()
+        reps["r1"].role = "follower"
+        reps["r1"].staleness_ms = 50.0
+        reps["r2"].role = "follower"
+        reps["r2"].staleness_ms = 5000.0
+        return r, reps
+
+    def test_unhinted_requests_never_see_followers(self):
+        r, _ = self._router_with_roles()
+        names = [h.name for h in r.candidates_for("k")]
+        assert names == ["r0"]
+
+    def test_hinted_requests_prefer_fresh_followers(self):
+        r, _ = self._router_with_roles()
+        names = [
+            h.name for h in r.candidates_for("k", max_staleness_ms=100.0)
+        ]
+        assert names[0] == "r1", "the fresh follower absorbs the read"
+        assert "r2" not in names, "a too-stale follower must not serve"
+        assert names[-1] == "r0", "the leader stays as freshness fallback"
+        loose = [
+            h.name for h in r.candidates_for("k", max_staleness_ms=10_000)
+        ]
+        assert set(loose) == {"r0", "r1", "r2"}
+
+    def test_unknown_staleness_is_never_fresh(self):
+        r, reps = self._router_with_roles()
+        reps["r1"].staleness_ms = None
+        names = [
+            h.name for h in r.candidates_for("k", max_staleness_ms=100.0)
+        ]
+        assert "r1" not in names
+
+    def test_trend_slope_signal(self):
+        def payload(deltas):
+            return {"series": {"server.admission.admitted": [
+                {"delta": d} for d in deltas
+            ]}}
+
+        assert goodput_slope(payload([1, 2, 3, 4])) > 0
+        assert goodput_slope(payload([4, 3, 2, 1])) < 0
+        assert goodput_slope(payload([5, 5, 5, 5])) == 0.0
+        assert goodput_slope(payload([])) == 0.0
+        assert goodput_slope({}) == 0.0
+        assert -1.0 <= goodput_slope(payload([0, 1000])) <= 1.0
+
+    def test_probe_trend_sharpens_tie_break(self):
+        def fetch(url, timeout):
+            if "/timeseries" not in url:
+                return {"status": "ok"}
+            rising = "9001" in url
+            d = [1, 2, 3, 4] if rising else [4, 3, 2, 1]
+            return {"series": {"server.admission.admitted": [
+                {"delta": x} for x in d
+            ]}}
+
+        r = FleetRouter(fetch=fetch, trend_windows=4, candidates=2)
+        r.add_replica("up", "127.0.0.1", 9001)
+        r.add_replica("down", "127.0.0.1", 9002)
+        r.probe()
+        reps = r.replicas()
+        assert reps["up"].goodput_trend > 0 > reps["down"].goodput_trend
+        # identical health -> the trend decides the tie
+        assert reps["up"].load_score() < reps["down"].load_score()
+        assert r.candidates_for("k")[0].name == "up"
+
+    def test_healthz_cdc_blocks(self, tmp_path):
+        g, _ids = _graph_chain(tmp_path / "cdc")
+        m = JanusGraphManager()
+        m.put_graph("graph", g)
+        server = JanusGraphServer(
+            manager=m, history_enabled=False, slo_enabled=False,
+            replica_name="leader0",
+        ).start()
+        try:
+            server.cdc_state = LeaderCDCState(g.cdc_log)
+            payload = json.loads(urllib.request.urlopen(
+                f"http://127.0.0.1:{server.port}/healthz", timeout=5
+            ).read())
+            assert payload["cdc"]["role"] == "leader"
+            assert payload["cdc"]["cursor"] == g.cdc_log.head_cursor()
+            assert payload["cdc"]["staleness_s"] == 0.0
+            # an unbootstrapped/stale follower reports degraded -> 503
+            server.cdc_state = CDCFollower(
+                g.cdc_log, str(tmp_path / "none"), idm=g.idm,
+                max_staleness_ms=100.0,
+            )
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                urllib.request.urlopen(
+                    f"http://127.0.0.1:{server.port}/healthz", timeout=5
+                )
+            body = json.loads(ei.value.read())
+            assert ei.value.code == 503
+            assert body["status"] == "degraded"
+            assert body["cdc"]["role"] == "follower"
+            assert body["cdc"]["degraded"] is True
+        finally:
+            server.stop()
+            g.close()
