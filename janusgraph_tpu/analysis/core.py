@@ -77,6 +77,13 @@ RULES: Dict[str, Rule] = {
              "use time.monotonic() (or perf_counter); wall stamps for "
              "EVENT STAMPING or cross-process offset math are exempt via "
              "`# graphlint: wallclock -- why`"),
+        Rule("JG112", SEV_ERROR,
+             "background-thread run loop dies or swallows silently: a "
+             "daemon thread's loop must catch broad exceptions AND "
+             "record them (flight event, log call, counter — anything "
+             "observable) before dying or continuing; a silently-dead "
+             "sampler is a lying profiler, and `except Exception: pass` "
+             "hides the death the stall watchdog exists to catch"),
         # -- lock discipline ------------------------------------------------
         Rule("JG201", SEV_ERROR,
              "lock.acquire() without with/try-finally release on all paths"),
@@ -403,6 +410,24 @@ class Analyzer:
         concurrency JG4xx). ``self.last_stats`` captures per-rule counts
         and the call-graph size for ``--stats``.
         """
+        import gc
+
+        # A batch pass allocates millions of short-lived AST nodes; in a
+        # long-lived host process (a test runner, an IDE daemon) every
+        # generational collection those allocations trigger re-traces the
+        # host's entire live heap, which can triple the pass's wall time.
+        # Freeze the pre-existing heap for the duration: our own garbage
+        # stays collectable, the host's objects stop being traced.
+        gc.collect()
+        gc.freeze()
+        try:
+            return self._analyze(paths, keep_suppressed)
+        finally:
+            gc.unfreeze()
+
+    def _analyze(
+        self, paths: Sequence[str], keep_suppressed: bool
+    ) -> Tuple[List[Finding], int]:
         from janusgraph_tpu.analysis import (
             callgraph,
             checkpoint_rules,
@@ -411,6 +436,7 @@ class Analyzer:
             metric_rules,
             robustness_rules,
             shape_rules,
+            thread_rules,
             trace_rules,
         )
 
@@ -437,6 +463,7 @@ class Analyzer:
             findings.extend(robustness_rules.check_module(mod))
             findings.extend(checkpoint_rules.check_module(mod))
             findings.extend(metric_rules.check_module(mod))
+            findings.extend(thread_rules.check_module(mod))
         findings.extend(
             lock_rules.finalize_cross_module(scans, cg, lock_graph)
         )

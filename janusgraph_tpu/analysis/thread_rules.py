@@ -1,0 +1,195 @@
+"""JG112 — background-thread run loops must record their own death.
+
+A daemon thread running a loop (``while not stop.wait(...)``) is the
+process's most failure-prone shape: an exception anywhere in the loop
+body unwinds the target function and the thread exits — silently. The
+interpreter prints nothing for daemon threads, no metric moves, and
+every consumer of the thread's output (the metrics-history ring, the
+sampling profiler's flame windows, a CDC puller's cursor) simply stops
+advancing while dashboards keep rendering the stale tail. A
+silently-dead sampler is a LYING profiler — the continuous-profiling
+plane (observability/continuous.py) exists to catch exactly this class
+of wedge at runtime, and this rule is its static twin: the run loop
+must catch broad exceptions and RECORD them (a flight event, a log
+call, a counter — anything observable) before dying or continuing.
+
+Flagged, for every function used as a ``threading.Thread(target=...,
+daemon=True)`` target that contains a ``while`` loop (the long-running
+run-loop shape; a ``for`` over a finite work list is a fork-join pump
+whose lifetime is bounded by its input, not a forever-loop):
+
+- the ``def`` line, when the function has NO broad except handler at
+  all (bare ``except:``, ``except Exception``, or ``except
+  BaseException``, including tuples) — the first exception kills the
+  thread with no record;
+- each broad handler whose body does literally nothing (only ``pass`` /
+  ``continue`` / ``break`` / a bare constant) — the failure is
+  swallowed unrecorded, which hides both one-off deaths and a
+  continuously-failing loop burning CPU forever.
+
+A handler that calls ANYTHING (``flight_recorder.record(...)``,
+``logger.warning(...)``, ``counter.inc()``, a sink callable), raises,
+or stores the error for later surfacing (``self._error = e``) passes:
+the rule demands observability, not a particular vocabulary — choosing
+a meaningful record is the author's job, having one is the contract.
+
+Resolution is module-local and name-based: ``target=_loop`` matches any
+``def _loop`` in the module (including the common closure-in-``start()``
+idiom), ``target=self._run`` matches a method ``def _run``. Targets the
+module does not define (``serve_forever`` on an stdlib server) are out
+of scope. Joined worker pools (no ``daemon=True``) are exempt — their
+exceptions are the spawner's problem at ``join()`` time, and flagging
+them would punish fork-join parallelism.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List
+
+from janusgraph_tpu.analysis.core import RULES, Finding
+
+_BROAD = {"Exception", "BaseException"}
+
+
+def _scope_nodes(scope) -> List[ast.AST]:
+    """All nodes in ``scope`` without descending into nested function /
+    class definitions (their loops and handlers are their own story)."""
+    out: List[ast.AST] = []
+    stack = list(ast.iter_child_nodes(scope))
+    while stack:
+        node = stack.pop()
+        out.append(node)
+        if isinstance(
+            node,
+            (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda, ast.ClassDef),
+        ):
+            continue
+        stack.extend(ast.iter_child_nodes(node))
+    return out
+
+
+def _is_broad_handler(handler: ast.ExceptHandler) -> bool:
+    t = handler.type
+    if t is None:
+        return True
+    if isinstance(t, ast.Name) and t.id in _BROAD:
+        return True
+    if isinstance(t, ast.Tuple):
+        return any(
+            isinstance(e, ast.Name) and e.id in _BROAD for e in t.elts
+        )
+    return False
+
+
+def _handler_does_nothing(handler: ast.ExceptHandler) -> bool:
+    """True when the handler body is only pass/continue/break/constant —
+    no call, no raise, no assignment: nothing observable survives."""
+    for stmt in handler.body:
+        if isinstance(stmt, (ast.Pass, ast.Continue, ast.Break)):
+            continue
+        if isinstance(stmt, ast.Expr) and isinstance(
+            stmt.value, ast.Constant
+        ):
+            continue
+        return False
+    return True
+
+
+def _thread_call_target(node: ast.Call):
+    """The ``target=`` expression of a ``Thread(..., daemon=True)``
+    call, or None when this is not a daemon-thread construction."""
+    fn = node.func
+    named_thread = (
+        isinstance(fn, ast.Name) and fn.id == "Thread"
+    ) or (
+        isinstance(fn, ast.Attribute) and fn.attr == "Thread"
+    )
+    if not named_thread:
+        return None
+    target = None
+    daemon = False
+    for kw in node.keywords:
+        if kw.arg == "target":
+            target = kw.value
+        elif kw.arg == "daemon" and isinstance(kw.value, ast.Constant):
+            daemon = bool(kw.value.value)
+    return target if daemon else None
+
+
+def _target_names(expr) -> List[str]:
+    """Local def names a target expression can resolve to."""
+    if isinstance(expr, ast.Name):
+        return [expr.id]
+    if (
+        isinstance(expr, ast.Attribute)
+        and isinstance(expr.value, ast.Name)
+        and expr.value.id == "self"
+    ):
+        return [expr.attr]
+    return []
+
+
+def check_module(mod) -> List[Finding]:
+    findings: List[Finding] = []
+    # text pre-gate: no thread construction, no work
+    if "Thread(" not in mod.source:
+        return findings
+
+    # every def in the module (module-level, methods, closures) by name —
+    # the closure-in-start() idiom means targets are often nested defs
+    defs: Dict[str, List[ast.AST]] = {}
+    for node in ast.walk(mod.tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            defs.setdefault(node.name, []).append(node)
+
+    targeted: List[ast.AST] = []
+    seen = set()
+    for node in ast.walk(mod.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        target = _thread_call_target(node)
+        if target is None:
+            continue
+        for name in _target_names(target):
+            for fn in defs.get(name, []):
+                if id(fn) not in seen:
+                    seen.add(id(fn))
+                    targeted.append(fn)
+
+    for fn in targeted:
+        scope = _scope_nodes(fn)
+        has_loop = any(isinstance(n, ast.While) for n in scope)
+        if not has_loop:
+            continue
+        broad = [
+            n
+            for n in scope
+            if isinstance(n, ast.ExceptHandler) and _is_broad_handler(n)
+        ]
+        if not broad:
+            findings.append(
+                Finding(
+                    "JG112", RULES["JG112"].severity, mod.path,
+                    fn.lineno, fn.col_offset,
+                    f"thread run loop {fn.name!r} has no broad except: "
+                    f"the first exception kills the thread silently — "
+                    f"wrap the loop body and record the failure (flight "
+                    f"event / log / counter) before the thread dies",
+                )
+            )
+            continue
+        for handler in broad:
+            if _handler_does_nothing(handler):
+                findings.append(
+                    Finding(
+                        "JG112", RULES["JG112"].severity, mod.path,
+                        handler.lineno, handler.col_offset,
+                        f"broad except in thread run loop {fn.name!r} "
+                        f"swallows the failure unrecorded (body is only "
+                        f"pass/continue) — record it (flight event / "
+                        f"log / counter) so a dead or flailing loop is "
+                        f"observable",
+                    )
+                )
+    return findings

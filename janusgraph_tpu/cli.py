@@ -96,6 +96,9 @@ def cmd_server(args) -> int:
         slo_enabled=graph.config.get("metrics.slo-enabled"),
         slo_specs=_slo_specs_from_config(graph.config),
         replica_name=replica,
+        profiler_enabled=graph.config.get("metrics.profile-enabled"),
+        watchdog_enabled=graph.config.get("server.watchdog-enabled"),
+        bundle_dir=graph.config.get("metrics.bundle-dir"),
     ).start()
     print(f"JanusGraph-TPU server listening on {args.host}:{server.port}")
     try:
@@ -176,6 +179,18 @@ def cmd_fleet(args) -> int:
                 ),
                 slo_enabled=(i == 0) and graph.config.get(
                     "metrics.slo-enabled"
+                ),
+                # like history/SLO: the sampler, watchdog, and bundle
+                # plane are process-global — replica 0 owns them
+                profiler_enabled=(i == 0) and graph.config.get(
+                    "metrics.profile-enabled"
+                ),
+                watchdog_enabled=(i == 0) and graph.config.get(
+                    "server.watchdog-enabled"
+                ),
+                bundle_dir=(
+                    graph.config.get("metrics.bundle-dir") if i == 0
+                    else ""
                 ),
             ).start()
             servers.append(server)
@@ -488,7 +503,40 @@ def cmd_top(args) -> int:
 def cmd_flame(args) -> int:
     """Render one stitched trace's span trees to collapsed-stack lines
     (pipe into any flamegraph renderer). Local tracer by default, or a
-    running server's GET /profile/flame with --url."""
+    running server's GET /profile/flame with --url. --live renders the
+    continuous sampling profiler's merged flame windows instead — what
+    every thread was actually doing, no instrumentation required."""
+    if args.live:
+        if args.url:
+            import urllib.error
+            import urllib.request
+
+            base = args.url.rstrip("/")
+            if not base.startswith("http"):
+                base = "http://" + base
+            try:
+                with urllib.request.urlopen(
+                    base + f"/debug/profile?window={args.window}",
+                    timeout=10,
+                ) as resp:
+                    sys.stdout.write(resp.read().decode("utf-8"))
+                return 0
+            except urllib.error.HTTPError as e:
+                print(f"server: {e}", file=sys.stderr)
+                return 1
+        from janusgraph_tpu.observability import sampling_profiler
+
+        text = sampling_profiler.flame_text(last=args.window)
+        if not text:
+            print("no samples collected (is the profiler running?)",
+                  file=sys.stderr)
+            return 1
+        print(text)
+        return 0
+    if not args.trace_id:
+        print("trace_id required (or --live for the sampling profiler)",
+              file=sys.stderr)
+        return 2
     try:
         trace_id = f"{int(args.trace_id, 16):016x}"
     except ValueError:
@@ -518,6 +566,46 @@ def cmd_flame(args) -> int:
         print(f"trace {trace_id} not retained", file=sys.stderr)
         return 1
     print(text)
+    return 0
+
+
+def cmd_bundle(args) -> int:
+    """Fetch the newest anomaly forensics bundle — flame windows, the
+    flight ring, the timeseries tail, all-thread stacks, in-flight
+    requests — from a running server's GET /debug/bundle with --url, or
+    this process's bundle directory. --capture forces a fresh capture
+    first (rate limit bypassed)."""
+    if args.url:
+        import urllib.error
+        import urllib.request
+
+        base = args.url.rstrip("/")
+        if not base.startswith("http"):
+            base = "http://" + base
+        path = "/debug/bundle?capture=1" if args.capture else "/debug/bundle"
+        try:
+            with urllib.request.urlopen(base + path, timeout=30) as resp:
+                sys.stdout.write(resp.read().decode("utf-8"))
+                sys.stdout.write("\n")
+            return 0
+        except urllib.error.HTTPError as e:
+            print(f"server: {e}", file=sys.stderr)
+            return 1
+    from janusgraph_tpu.observability import bundle_writer
+
+    if args.capture:
+        path = bundle_writer.capture(reason="cli", force=True)
+        if path is None:
+            print("capture failed (is metrics.bundle-dir set?)",
+                  file=sys.stderr)
+            return 1
+        print(f"captured -> {path}", file=sys.stderr)
+    got = bundle_writer.latest()
+    if got is None:
+        print("no bundle on disk (set metrics.bundle-dir, or --capture)",
+              file=sys.stderr)
+        return 1
+    print(json.dumps(got, indent=2, default=str))
     return 0
 
 
@@ -923,12 +1011,32 @@ def main(argv=None) -> int:
         "flame",
         help="render one trace to collapsed-stack flamegraph lines",
     )
-    pfl.add_argument("trace_id", help="16-hex-char trace id")
+    pfl.add_argument("trace_id", nargs="?", default="",
+                     help="16-hex-char trace id (omit with --live)")
     pfl.add_argument(
-        "--url", help="read a running server's /profile/flame instead of "
-        "this process's tracer",
+        "--url", help="read a running server's /profile/flame (or "
+        "/debug/profile with --live) instead of this process",
     )
+    pfl.add_argument(
+        "--live", action="store_true",
+        help="render the continuous sampling profiler's flame windows "
+        "instead of one trace",
+    )
+    pfl.add_argument("--window", type=int, default=0,
+                     help="with --live: last N flame windows (0 = all)")
     pfl.set_defaults(fn=cmd_flame)
+
+    pbu = sub.add_parser(
+        "bundle",
+        help="fetch the newest anomaly forensics bundle",
+    )
+    pbu.add_argument(
+        "--url", help="read a running server's /debug/bundle instead of "
+        "this process's bundle directory",
+    )
+    pbu.add_argument("--capture", action="store_true",
+                     help="force a fresh capture first")
+    pbu.set_defaults(fn=cmd_bundle)
 
     pts = sub.add_parser(
         "timeseries",

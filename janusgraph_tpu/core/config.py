@@ -1005,6 +1005,26 @@ STORAGE.option(
     Mutability.LOCAL, lambda v: v >= 0,
 )
 STORAGE.option(
+    "faults.stall-lock-at", int,
+    "instrumented-lock acquisition index at which the holder stalls "
+    "for faults.stall-lock-ms (-1 = off) — the stall-watchdog "
+    "certification fault: the watchdog must flight a lock_convoy "
+    "carrying the holder's sampled stack and capture a forensics "
+    "bundle (observability/continuous.py)", -1,
+    Mutability.LOCAL, lambda v: v >= -1,
+)
+STORAGE.option(
+    "faults.stall-lock-ms", float,
+    "how long the chosen holder keeps the instrumented lock", 0.0,
+    Mutability.LOCAL, lambda v: v >= 0,
+)
+STORAGE.option(
+    "faults.wedge-thread-at", int,
+    "worker-op index at which the worker thread wedges (-1 = off); "
+    "the watchdog's progress checker must flight a stall", -1,
+    Mutability.LOCAL, lambda v: v >= -1,
+)
+STORAGE.option(
     "faults.stores", str,
     "comma-separated store names the injector targets (empty = the "
     "data plane: edgestore,graphindex). System stores stay exempt so "
@@ -1406,11 +1426,74 @@ METRICS_NS.option(
     "sites (observability/logging.py; records always land in the "
     "in-process ring regardless)", False, Mutability.LOCAL,
 )
+# ---- continuous profiling plane (sampler, watchdog, bundles) ------------
+METRICS_NS.option(
+    "profile-enabled", bool,
+    "run the always-on sampling profiler (observability/continuous.py "
+    "SamplingProfiler): a daemon thread folds sys._current_frames() "
+    "stacks into collapsed-stack flame windows sealed in lockstep with "
+    "the metrics-history interval; self-measured overhead (wall AND "
+    "CPU) is exported and gated <1% CPU in the saturation bench",
+    True, Mutability.LOCAL,
+)
+METRICS_NS.option(
+    "profile-hz", float,
+    "sampling-profiler rate in passes per second (each pass costs one "
+    "sys._current_frames() walk; 20 Hz keeps the self-measured CPU "
+    "overhead well under the 1% gate)", 20.0,
+    Mutability.LOCAL, lambda v: 0 < v <= 1000,
+)
+METRICS_NS.option(
+    "profile-windows", int,
+    "flame windows retained in the profiler ring (retention wall = "
+    "this x history-interval-s when history drives the sealing)", 60,
+    Mutability.LOCAL, lambda v: v > 0,
+)
+METRICS_NS.option(
+    "bundle-dir", str,
+    "directory for anomaly forensics bundles (flame windows + flight "
+    "ring + timeseries tail + all-thread stacks + active requests), "
+    "written tmp+rename atomic on SLO page / watchdog stall / "
+    "unhandled server error; empty = bundles off", "",
+    Mutability.LOCAL,
+)
+METRICS_NS.option(
+    "bundle-retention", int,
+    "forensics bundles kept on disk (oldest pruned first)", 8,
+    Mutability.LOCAL, lambda v: v > 0,
+)
+METRICS_NS.option(
+    "bundle-min-interval-s", float,
+    "rate limit between bundle captures (an anomaly storm must not "
+    "turn the forensics plane into its own I/O incident)", 30.0,
+    Mutability.LOCAL, lambda v: v >= 0,
+)
 
 
 # ---- overload defense: admission control, deadlines, retry budgets ------
 DRIVER_NS = ConfigNamespace("driver", "remote driver client", ROOT)
 
+SERVER_NS.option(
+    "watchdog-enabled", bool,
+    "run the runtime stall watchdog (observability/continuous.py "
+    "StallWatchdog): scans instrumented-lock wait tables and "
+    "registered progress sources (active requests, supersteps, CDC "
+    "pulls) and flights stall/lock_convoy events carrying the owner's "
+    "sampled stack — the runtime twin of graphlint's static lock "
+    "rules", True, Mutability.LOCAL,
+)
+SERVER_NS.option(
+    "watchdog-interval-s", float,
+    "seconds between watchdog scan passes", 1.0,
+    Mutability.LOCAL, lambda v: v > 0,
+)
+SERVER_NS.option(
+    "watchdog-stall-s", float,
+    "waiting/no-progress threshold past which the watchdog flights a "
+    "stall or lock_convoy event (edge-triggered per episode) and "
+    "captures a forensics bundle", 5.0,
+    Mutability.LOCAL, lambda v: v > 0,
+)
 SERVER_NS.option(
     "admission.enabled", bool,
     "cost-aware admission control in front of every query request "

@@ -410,6 +410,28 @@ class JanusGraphTPU:
             peak_bytes_per_s=cfg.get("metrics.roofline-peak-bytes-per-s"),
             peak_mxu_flops=cfg.get("metrics.roofline-peak-mxu-flops"),
         )
+        # continuous profiling plane sizing (observability/continuous.py):
+        # like the history ring, only CONFIGURED here — the sampler and
+        # watchdog THREADS belong to the query server's lifecycle
+        from janusgraph_tpu.observability import (
+            bundle_writer as _bundles,
+            sampling_profiler as _sampler,
+            watchdog as _watchdog,
+        )
+
+        _sampler.configure(
+            hz=cfg.get("metrics.profile-hz"),
+            max_windows=cfg.get("metrics.profile-windows"),
+        )
+        _watchdog.configure(
+            interval_s=cfg.get("server.watchdog-interval-s"),
+            stall_s=cfg.get("server.watchdog-stall-s"),
+        )
+        _bundles.configure(
+            directory=cfg.get("metrics.bundle-dir"),
+            retention=cfg.get("metrics.bundle-retention"),
+            min_interval_s=cfg.get("metrics.bundle-min-interval-s"),
+        )
         # price-book persistence (computer.price-book-path, defaulting
         # next to the autotune record): warm-start the OLTP shape table
         # so spillover promotion and admission pricing survive restarts

@@ -29,6 +29,17 @@ coercing tracer attribute values forces a device sync). graphlint rule
 JG106 enforces this mechanically.
 """
 
+from janusgraph_tpu.observability.continuous import (
+    BundleWriter,
+    InstrumentedLock,
+    SamplingProfiler,
+    StallWatchdog,
+    bundle_writer,
+    flame_from_artifact,
+    flamediff,
+    sampling_profiler,
+    watchdog,
+)
 from janusgraph_tpu.observability.exposition import (
     json_snapshot,
     prometheus_text,
@@ -118,6 +129,7 @@ tracer.on_slow = _slow_span_to_flight
 
 __all__ = [
     "BUCKET_BOUNDS",
+    "BundleWriter",
     "ClockOffsets",
     "Counter",
     "DigestTable",
@@ -126,11 +138,14 @@ __all__ = [
     "FlightRecorder",
     "Gauge",
     "Histogram",
+    "InstrumentedLock",
     "MetricsHistory",
     "ResourceLedger",
     "SLOEngine",
     "SLOSpec",
+    "SamplingProfiler",
     "Span",
+    "StallWatchdog",
     "StructuredLogger",
     "TelemetryRegistry",
     "Timer",
@@ -138,11 +153,14 @@ __all__ = [
     "Tracer",
     "accrue",
     "accrue_wall",
+    "bundle_writer",
     "capture_scope",
     "chrome_trace",
     "current_ledger",
     "digest_table",
+    "flame_from_artifact",
     "flame_lines",
+    "flamediff",
     "fleet_default_specs",
     "flight_recorder",
     "get_logger",
@@ -156,8 +174,10 @@ __all__ = [
     "registry",
     "render_run",
     "replica_name",
+    "sampling_profiler",
     "set_replica",
     "slo_engine",
     "span",
     "tracer",
+    "watchdog",
 ]

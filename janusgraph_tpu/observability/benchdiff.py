@@ -101,6 +101,10 @@ HEADLINES: Dict[str, List[Tuple[str, str]]] = {
         ("staleness_p99_ms", LOWER),
         ("follower_read_share", HIGHER),
     ],
+    # PR 19: seeded stall forensics — detection latency is the headline
+    # (stall onset -> lock_convoy flight event); everything else in the
+    # stage is boolean acceptance, not a trend
+    "fleet_stall_forensics": [("detect_ms", LOWER)],
     "multichip_ab": [("superstep_ms", LOWER)],
     "chaos": [("recovery_open_ms", LOWER)],
     "smoke": [],
@@ -325,12 +329,30 @@ def compare(
         overall = "noise"
     else:
         overall = "incomparable"
-    return {
+    out = {
         "verdict": overall,
         "threshold_pct": round(threshold * 100.0, 2),
         "cell": list(cell_key(new)),
         "metrics": metrics,
     }
+    if overall == "regress":
+        deltas = _frame_deltas(old, new)
+        if deltas:
+            out["frame_deltas"] = deltas
+    return out
+
+
+def _frame_deltas(old: dict, new: dict, top: int = 3) -> List[dict]:
+    """Top frame-level flame deltas between two stages that both embed
+    profile data (``flame``/``stacks`` blocks from the continuous
+    sampling profiler) — WHERE the regressed time went, not just that it
+    went. Empty when either side carries no profile."""
+    try:
+        from janusgraph_tpu.observability.continuous import flamediff
+
+        return flamediff(old, new, top=top)
+    except Exception:  # noqa: BLE001 - sentinel never fails a bench
+        return []
 
 
 def diff_artifacts(

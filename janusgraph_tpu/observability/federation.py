@@ -597,8 +597,17 @@ class FleetFederation:
             while not self._stop.wait(self.interval_s):
                 try:
                     self.tick()
-                except Exception:  # noqa: BLE001 - the scraper must not die
-                    pass
+                except Exception as e:  # noqa: BLE001 - the scraper must not die
+                    # record before continuing (JG112): an unrecorded
+                    # tick failure looks identical to "no new windows"
+                    from janusgraph_tpu.observability.flight import (
+                        recorder,
+                    )
+
+                    recorder.record(
+                        "thread_error", thread="fleet-federation",
+                        error=repr(e),
+                    )
 
         self._thread = threading.Thread(
             target=_loop, daemon=True, name="fleet-federation"

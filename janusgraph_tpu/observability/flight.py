@@ -77,6 +77,27 @@ Event taxonomy (the ``category`` field):
                     ``fast_burn``/``slow_burn``/``objective``) — a
                     page-severity burn also flips /healthz to degraded,
                     which dumps this ring via the existing edge trigger
+``lock_convoy``     the stall watchdog caught a thread blocked on an
+                    instrumented lock past ``server.watchdog-stall-s``
+                    (observability/continuous.py; fields: ``lock``/
+                    ``waiter``/``wait_s``/``owner``/``owner_stack`` —
+                    the owner's stack snatched from the sampler ring —
+                    and the ``wait_for`` edge [waiter, owner])
+``stall``           a registered progress source (active requests,
+                    supersteps, CDC pulls) reported active work whose
+                    progress value did not change for the stall window
+                    (fields: ``source``/``active``/``stuck_s``/
+                    ``progress``); both watchdog events are
+                    edge-triggered per key and each also captures a
+                    forensics bundle
+``bundle``          an anomaly forensics bundle was written
+                    (observability/continuous.py BundleWriter; fields:
+                    ``reason`` slo-page|stall|lock-convoy|server-error|
+                    manual, ``path``)
+``thread_error``    a background run loop caught an exception it would
+                    previously have swallowed (the JG112 contract:
+                    record before dying/continuing; fields: ``thread``/
+                    ``error``)
 ==================  =======================================================
 
 Dump triggers: an unhandled server error, the /healthz ok->degraded flip,

@@ -119,8 +119,18 @@ class MetricsHistory:
             while not self._stop.wait(self.interval_s):
                 try:
                     self.sample()
-                except Exception:  # noqa: BLE001 - sampling must not die
-                    pass
+                except Exception as e:  # noqa: BLE001 - sampling must not die
+                    # record before continuing (JG112): a silently
+                    # failing sampler leaves a stale ring that reads as
+                    # a healthy-but-frozen process
+                    from janusgraph_tpu.observability.flight import (
+                        recorder,
+                    )
+
+                    recorder.record(
+                        "thread_error", thread="metrics-history",
+                        error=repr(e),
+                    )
 
         self._thread = threading.Thread(
             target=_loop, name="metrics-history", daemon=True

@@ -191,6 +191,23 @@ class ReplicaHandle:
             "probe_failures": self.probe_failures,
             "breaker": self.breaker.state,
             "stats": dict(self.stats),
+            # continuous-profiling summary: enough for the fleet view to
+            # spot a dead sampler or a bundle-writing (anomalous) member
+            # without pulling the full member /healthz
+            "profiler": {
+                k: (h.get("profiler") or {}).get(k)
+                for k in ("enabled", "alive", "overhead_cpu_pct")
+            },
+            "bundles_written": (
+                ((h.get("profiler") or {}).get("bundles") or {}).get(
+                    "written"
+                )
+            ),
+            "watchdog_events": (
+                ((h.get("profiler") or {}).get("watchdog") or {}).get(
+                    "events"
+                )
+            ),
         }
 
 
@@ -422,8 +439,18 @@ class FleetRouter:
             while not self._probe_stop.wait(interval_s):
                 try:
                     self.probe()
-                except Exception:  # noqa: BLE001 - probes must not die
-                    pass
+                except Exception as e:  # noqa: BLE001 - probes must not die
+                    # record before continuing (JG112): a probe loop
+                    # failing every tick means the router is flying
+                    # blind on member health — that must be visible
+                    from janusgraph_tpu.observability import (
+                        flight_recorder,
+                    )
+
+                    flight_recorder.record(
+                        "thread_error", thread="fleet-probe",
+                        error=repr(e),
+                    )
 
         self._probe_thread = threading.Thread(
             target=_loop, daemon=True, name="fleet-probe"
@@ -919,6 +946,23 @@ class FleetRouter:
             "total": total,
             "serving": serving,
             "quorum_bad": bad,
+            # fleet-level profiling rollup: dead samplers (lying
+            # profilers) and total forensics bundles across members
+            "profiler": {
+                "dead_samplers": [
+                    n for n, m in members.items()
+                    if (m.get("profiler") or {}).get("enabled")
+                    and not (m.get("profiler") or {}).get("alive")
+                ],
+                "bundles_written": sum(
+                    m.get("bundles_written") or 0
+                    for m in members.values()
+                ),
+                "watchdog_events": sum(
+                    m.get("watchdog_events") or 0
+                    for m in members.values()
+                ),
+            },
         }
 
 
@@ -1066,8 +1110,17 @@ class StateGossip:
             while not self._stop.wait(interval_s):
                 try:
                     self.tick()
-                except Exception:  # noqa: BLE001 - gossip must not die
-                    pass
+                except Exception as e:  # noqa: BLE001 - gossip must not die
+                    # record before continuing (JG112): silent gossip
+                    # failure strands every peer on stale price books
+                    from janusgraph_tpu.observability import (
+                        flight_recorder,
+                    )
+
+                    flight_recorder.record(
+                        "thread_error", thread=f"gossip-{self.name}",
+                        error=repr(e),
+                    )
 
         self._thread = threading.Thread(
             target=_loop, daemon=True, name=f"gossip-{self.name}"

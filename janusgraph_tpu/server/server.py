@@ -130,11 +130,24 @@ def healthz_snapshot() -> dict:
     degraded — which rides the existing ok->degraded flight-dump edge
     trigger, so the event ring is on disk the moment an SLO starts
     burning at page rate.
+
+    The ``profiler`` block is the continuous profiling plane
+    (observability/continuous.py): sampler liveness, flame windows
+    retained, self-measured overhead (CPU and wall pct), the watchdog's
+    state, and forensics-bundle counts. A sampler thread that DIED
+    while enabled reports degraded on its own — a silently-dead
+    profiler keeps serving stale flame windows, which is worse than no
+    profiler. The ok->degraded flip also captures a forensics bundle
+    (when metrics.bundle-dir is set), so an SLO page ships its own
+    evidence.
     """
     from janusgraph_tpu.observability import (
+        bundle_writer,
         flight_recorder,
         registry,
+        sampling_profiler,
         slo_engine,
+        watchdog,
     )
     from janusgraph_tpu.server import admission as _admission
 
@@ -151,8 +164,19 @@ def healthz_snapshot() -> dict:
         and not name.startswith("breaker.fleet.")
     }
     slo_block = slo_engine.snapshot()
-    degraded = any(v != 0.0 for v in breakers.values()) or bool(
-        slo_block["paging"]
+    # the continuous profiling plane's verdict: a sampler thread that
+    # died while enabled is a LYING profiler — flame windows stop while
+    # dashboards keep rendering the stale ring — so that alone degrades
+    profiler_block = sampling_profiler.status()
+    profiler_block["watchdog"] = watchdog.state()
+    profiler_block["bundles"] = bundle_writer.status()
+    profiler_dead = bool(
+        profiler_block["enabled"] and not profiler_block["alive"]
+    )
+    degraded = (
+        any(v != 0.0 for v in breakers.values())
+        or bool(slo_block["paging"])
+        or profiler_dead
     )
     counters = {
         name: m["count"]
@@ -213,8 +237,13 @@ def healthz_snapshot() -> dict:
             "health", transition="ok->degraded",
             breakers={k: v for k, v in breakers.items() if v != 0.0},
             slo_paging=slo_block["paging"],
+            profiler_dead=profiler_dead,
         )
         flight_recorder.dump(reason="healthz-degraded")
+        # an SLO page (or any other degradation) is a forensics moment:
+        # capture the full bundle on the same edge trigger (no-op unless
+        # metrics.bundle-dir is configured; rate-limited regardless)
+        bundle_writer.capture(reason="healthz-degraded")
     # the remote wire-protocol clients' pipelined-framing state: per
     # protocol (storage.remote / index.remote) in-flight depth,
     # coalescing ratio, stalls, and negotiation fallbacks (absent keys =
@@ -262,6 +291,7 @@ def healthz_snapshot() -> dict:
         "spillover": spillover_block,
         "pipeline": pipeline_health_block(snap),
         "flight": flight_recorder.health_block(),
+        "profiler": profiler_block,
     }
 
 
@@ -300,6 +330,9 @@ class JanusGraphServer:
         slo_enabled: bool = True,
         slo_specs=None,
         replica_name: str = "",
+        profiler_enabled: bool = True,
+        watchdog_enabled: bool = True,
+        bundle_dir: str = "",
     ):
         self.manager = manager or JanusGraphManager.get_instance()
         self.default_graph = default_graph
@@ -334,6 +367,21 @@ class JanusGraphServer:
         #: metrics.slo-* — burn-rate engine evaluated per history window
         self.slo_enabled = slo_enabled
         self.slo_specs = slo_specs
+        #: metrics.profile-enabled — the always-on sampling profiler;
+        #: this server owns the sampler thread (continuous.py)
+        self.profiler_enabled = profiler_enabled
+        #: server.watchdog-* — the runtime stall watchdog
+        self.watchdog_enabled = watchdog_enabled
+        #: metrics.bundle-dir — where anomaly forensics bundles land
+        #: ('' keeps bundle_writer's current directory, e.g. test-set)
+        self.bundle_dir = bundle_dir
+        self._profiler_started = False
+        self._watchdog_started = False
+        #: active-request table for forensics bundles: thread-id ->
+        #: {query, graph, since}; completed count feeds the watchdog's
+        #: progress checker
+        self._active_requests: dict = {}
+        self._completed_requests = 0
         self._history_started = False
         self._httpd: Optional[ThreadingHTTPServer] = None
         self._thread: Optional[threading.Thread] = None
@@ -442,6 +490,25 @@ class JanusGraphServer:
         if self.history_enabled and not history.running:
             history.start()
             self._history_started = True
+        # the continuous profiling plane (observability/continuous.py):
+        # sampler + watchdog threads are the server's, like the history
+        # sampler; bundles get this server's active-request table
+        from janusgraph_tpu.observability import (
+            bundle_writer,
+            sampling_profiler,
+            watchdog,
+        )
+
+        if self.bundle_dir:
+            bundle_writer.configure(directory=self.bundle_dir)
+        bundle_writer.set_request_table(self.active_request_table)
+        if self.profiler_enabled and not sampling_profiler.alive:
+            sampling_profiler.start()
+            self._profiler_started = True
+        if self.watchdog_enabled and not watchdog.alive:
+            watchdog.register_progress("server.requests", self._progress)
+            watchdog.start()
+            self._watchdog_started = True
         return self
 
     def _price_book_path(self) -> str:
@@ -452,9 +519,37 @@ class JanusGraphServer:
             return ""
         return getattr(g, "_price_book_path", "") or ""
 
-    def stop(self) -> None:
-        from janusgraph_tpu.observability import history, slo_engine
+    def active_request_table(self) -> list:
+        """Snapshot of in-flight requests (forensics-bundle content)."""
+        with self._sessions_lock:
+            return [dict(v) for v in self._active_requests.values()]
 
+    def _progress(self) -> dict:
+        """Watchdog progress source: active requests whose completed
+        count stops moving for the stall window is a wedged server."""
+        with self._sessions_lock:
+            return {
+                "active": len(self._active_requests),
+                "progress": self._completed_requests,
+            }
+
+    def stop(self) -> None:
+        from janusgraph_tpu.observability import (
+            bundle_writer,
+            history,
+            sampling_profiler,
+            slo_engine,
+            watchdog,
+        )
+
+        if self._watchdog_started:
+            watchdog.unregister_progress("server.requests")
+            watchdog.stop()
+            self._watchdog_started = False
+        if self._profiler_started:
+            sampling_profiler.stop()
+            self._profiler_started = False
+        bundle_writer.set_request_table(None)
         if self.slo_enabled:
             slo_engine.uninstall()
         if self._history_started:
@@ -822,6 +917,28 @@ class _Handler(BaseHTTPRequestHandler):
         from janusgraph_tpu.core import deadline as _deadline
         from janusgraph_tpu.exceptions import DeadlineExceededError
 
+        server = self.jg_server
+        me = threading.get_ident()
+        # the active-request table: what a forensics bundle shows as
+        # "in flight right now", and the watchdog's progress signal
+        with server._sessions_lock:
+            server._active_requests[me] = {
+                "thread": threading.current_thread().name,
+                "graph": graph or server.default_graph,
+                "query": query[:200],
+                "since": time.time(),
+            }
+        try:
+            return self._execute_request_inner(req, query, graph, session, sp)
+        finally:
+            with server._sessions_lock:
+                server._active_requests.pop(me, None)
+                server._completed_requests += 1
+
+    def _execute_request_inner(self, req, query, graph, session, sp) -> dict:
+        from janusgraph_tpu.core import deadline as _deadline
+        from janusgraph_tpu.exceptions import DeadlineExceededError
+
         try:
             if session is not None:
                 result = self.jg_server.execute_session(
@@ -880,6 +997,12 @@ class _Handler(BaseHTTPRequestHandler):
                 message=str(e)[:200], graph=graph or "",
             )
             flight_recorder.dump(reason="server-error")
+            # full forensics alongside the flight dump: flame windows,
+            # stacks, timeseries tail, active requests (rate-limited and
+            # a no-op unless metrics.bundle-dir is set)
+            from janusgraph_tpu.observability import bundle_writer
+
+            bundle_writer.capture(reason="server-error")
             return {
                 "result": {"data": None},
                 "status": {"code": 500, "message": f"{type(e).__name__}: {e}"},
@@ -1050,6 +1173,61 @@ class _Handler(BaseHTTPRequestHandler):
             self.send_header("Content-Length", str(len(body)))
             self.end_headers()
             self.wfile.write(body)
+            return
+        if self.path.startswith("/debug/profile"):
+            # the continuous profiler's collapsed-stack flamegraph (the
+            # whole process, merged over retained windows; ?window=N
+            # bounds to the last N). Unauthenticated like /metrics —
+            # frames are code locations, never data content. Like every
+            # observability endpoint, bypasses admission.
+            from urllib.parse import parse_qs, urlsplit
+
+            from janusgraph_tpu.observability import sampling_profiler
+
+            qs = parse_qs(urlsplit(self.path).query)
+            try:
+                window = int((qs.get("window") or ["0"])[0])
+            except ValueError:
+                self._send_json(400, {"status": {
+                    "code": 400, "message": "window must be an integer",
+                }})
+                return
+            body = sampling_profiler.flame_text(last=window).encode(
+                "utf-8"
+            )
+            self.send_response(200)
+            self.send_header("Content-Type", "text/plain; charset=utf-8")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+            return
+        if self.path == "/debug/stacks":
+            # all-thread stack dump, the py-spy-dump equivalent over
+            # HTTP: what is every thread doing RIGHT NOW
+            from janusgraph_tpu.observability import bundle_writer
+
+            self._send_json(200, {"stacks": bundle_writer._all_stacks()})
+            return
+        if self.path == "/debug/bundle" or self.path.startswith(
+            "/debug/bundle?"
+        ):
+            # the newest forensics bundle (?capture=1 forces a fresh one
+            # first); a torn bundle on disk — a writer killed mid-write
+            # before the atomic rename — is skipped, not fatal
+            from janusgraph_tpu.observability import bundle_writer
+
+            if "capture=1" in self.path:
+                bundle_writer.capture(reason="manual", force=True)
+            got = bundle_writer.latest()
+            if got is None:
+                self._send_json(404, {"status": {
+                    "code": 404,
+                    "message": "no forensics bundle on disk "
+                               "(set metrics.bundle-dir, or "
+                               "?capture=1 to force one)",
+                }})
+                return
+            self._send_json(200, got)
             return
         if self.path == "/telemetry" or self.path.startswith("/telemetry?"):
             # JSON snapshot: metrics + recent span trees + slow-op log +

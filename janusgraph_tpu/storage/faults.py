@@ -75,6 +75,16 @@ Fault kinds (all off by default):
                      /healthz degrades) — promotion force-pulls through
                      the window, so leader failover is never blocked by
                      the lag fault (server/fleet.py ``CDCFollower``)
+``stalled_lock``     the chosen op holds an instrumented lock for
+                     ``stall-lock-ms`` (the hook returns the hold
+                     duration; the CALLER holds the lock and sleeps, so
+                     the decision stays pure) — the stall watchdog must
+                     flight a ``lock_convoy`` with the holder's stack
+                     and capture a bundle (observability/continuous.py)
+``wedged_thread``    the chosen op wedges its worker thread (the hook
+                     returns True once; the caller blocks until
+                     released) — the watchdog's progress checker must
+                     flight a ``stall``
 ===================  =====================================================
 
 The four ``shard-*`` kinds are scheduled/decided exactly like the
@@ -167,6 +177,9 @@ class FaultPlan:
         cdc_torn_at: int = -1,
         follower_lag_at: int = -1,
         follower_lag_pulls: int = 0,
+        stall_lock_at: int = -1,
+        stall_lock_ms: float = 0.0,
+        wedge_thread_at: int = -1,
         stores: Sequence[str] = DEFAULT_FAULT_STORES,
         journal_limit: int = 4096,
     ):
@@ -199,6 +212,11 @@ class FaultPlan:
         self.follower_lag_pulls = follower_lag_pulls
         self._cdc_torn_fired = False
         self._follower_lag_recorded = False
+        self.stall_lock_at = stall_lock_at
+        self.stall_lock_ms = stall_lock_ms
+        self.wedge_thread_at = wedge_thread_at
+        self._stall_lock_fired = False
+        self._wedge_fired = False
         #: which fleet replica THIS plan instance belongs to (set by the
         #: fleet harness when wiring each replica's graph; -1 = not part
         #: of a fleet, so the partition window never applies)
@@ -273,6 +291,9 @@ class FaultPlan:
             follower_lag_pulls=cfg.get(
                 "storage.faults.follower-lag-pulls"
             ),
+            stall_lock_at=cfg.get("storage.faults.stall-lock-at"),
+            stall_lock_ms=cfg.get("storage.faults.stall-lock-ms"),
+            wedge_thread_at=cfg.get("storage.faults.wedge-thread-at"),
             stores=stores,
         )
 
@@ -403,6 +424,39 @@ class FaultPlan:
                     "cdc_lagging_follower", n,
                     pulls=self.follower_lag_pulls,
                 )
+            return True
+        return False
+
+    # -------------------------------------------------------- watchdog hooks
+    def stalled_lock(self, lock: str = "instrumented") -> float:
+        """Hold duration (ms) for THIS instrumented-lock acquisition: the
+        scheduled op index returns ``stall-lock-ms`` once, every other op
+        returns 0. The CALLER holds the lock for that long (the decision
+        is pure; the side effect — a convoy the watchdog must catch —
+        happens at the call site), so two runs with one seed journal
+        byte-equal."""
+        n = self._tick("stall_lock")
+        if (
+            not self._stall_lock_fired
+            and self.stall_lock_ms > 0
+            and 0 <= self.stall_lock_at <= n
+        ):
+            self._stall_lock_fired = True
+            self._record(
+                "stalled_lock", n, lock=lock, ms=self.stall_lock_ms
+            )
+            return self.stall_lock_ms
+        return 0.0
+
+    def wedge_thread(self) -> bool:
+        """Wedge THIS worker op? Fires once at ``wedge-thread-at``; the
+        caller parks the thread (on an event the harness releases), so
+        the watchdog's progress checker sees active work that stops
+        moving."""
+        n = self._tick("wedge_thread")
+        if not self._wedge_fired and 0 <= self.wedge_thread_at <= n:
+            self._wedge_fired = True
+            self._record("wedged_thread", n)
             return True
         return False
 

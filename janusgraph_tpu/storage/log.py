@@ -189,14 +189,29 @@ class KCVSLog:
                 ]
             raise
 
+    def _record_loop_error(self, loop: str, e: Exception) -> None:
+        """Bounded observability for the background send/pull loops
+        (JG112): the loop keeps running, the failure is on record."""
+        from janusgraph_tpu.observability import flight_recorder, registry
+
+        registry.counter("storage.log.loop_errors").inc()
+        flight_recorder.record(
+            "thread_error", thread=f"log-{self.name}-{loop}",
+            error=repr(e),
+        )
+
     def _send_loop(self) -> None:
         while not self._closed.is_set():
             self._flush_wakeup.wait(self.send_interval_ms / 1000.0)
             self._flush_wakeup.clear()
             try:
                 self.flush()
-            except Exception:
-                pass  # re-queued by flush(); retried next tick
+            except Exception as e:  # noqa: BLE001 - sender must not die
+                # the batch is re-queued by flush() and retried next
+                # tick, but the failure itself must be recorded (JG112):
+                # a permanently failing sender is an outbox growing
+                # toward the journal bound, invisibly
+                self._record_loop_error("send", e)
 
     # ------------------------------------------------------------------- read
     def register_reader(
@@ -277,10 +292,12 @@ class KCVSLog:
                             continue
                         try:
                             reader(LogMessage(val, ts, col[8:16]))
-                        except Exception:
-                            pass  # a bad consumer must not kill the puller
-            except Exception:
-                pass
+                        except Exception as e:  # noqa: BLE001 - a bad consumer must not kill the puller
+                            self._record_loop_error("reader", e)
+            except Exception as e:  # noqa: BLE001 - puller must not die
+                # recorded, not raised (JG112): a puller failing every
+                # poll means consumers silently stop seeing messages
+                self._record_loop_error("pull", e)
             self._closed.wait(poll_ms / 1000.0)
 
     def close(self) -> None:

@@ -1,0 +1,143 @@
+"""JG112 fixture: background-thread run loops that die or swallow
+silently.
+
+A daemon thread's run loop that either has no broad except (the first
+exception kills the thread with no record) or swallows broad exceptions
+with a do-nothing handler (`except Exception: pass`) leaves every
+consumer of the thread's output reading a stale ring that looks
+healthy. The loop must RECORD the failure — flight event, log call,
+counter, stored error — before dying or continuing.
+"""
+
+import threading
+
+
+class NakedLoopBad:
+    """No broad except at all: the first sample() exception kills the
+    sampler silently."""
+
+    def __init__(self):
+        self._stop = threading.Event()
+
+    def _loop(self):  # expect: JG112
+        while not self._stop.wait(1.0):
+            self.sample()
+
+    def start(self):
+        t = threading.Thread(target=self._loop, daemon=True)
+        t.start()
+
+    def sample(self):
+        pass
+
+
+class SwallowingLoopBad:
+    """Broad except whose body is only pass: failures vanish, and a
+    continuously-failing loop burns CPU invisibly forever."""
+
+    def __init__(self):
+        self._stop = threading.Event()
+
+    def start(self):
+        def _loop():
+            while not self._stop.wait(1.0):
+                try:
+                    self.tick()
+                except Exception:  # expect: JG112
+                    pass
+
+        threading.Thread(target=_loop, daemon=True).start()
+
+    def tick(self):
+        pass
+
+
+class RecordingLoopGood:
+    """Broad except that records before continuing: compliant."""
+
+    def __init__(self, sink):
+        self._stop = threading.Event()
+        self._sink = sink
+
+    def _loop(self):
+        while not self._stop.wait(1.0):
+            try:
+                self.tick()
+            except Exception as e:  # records: compliant
+                self._sink(f"loop error: {e}")
+
+    def start(self):
+        threading.Thread(target=self._loop, daemon=True).start()
+
+    def tick(self):
+        pass
+
+
+class StoringLoopGood:
+    """Broad except that stores the error for later surfacing (the
+    prefetch idiom): an assignment is a record, not a swallow."""
+
+    def __init__(self):
+        self._stop = threading.Event()
+        self._error = None
+
+    def _loop(self):
+        while not self._stop.wait(1.0):
+            try:
+                self.tick()
+            except Exception as e:  # surfaced on next read
+                self._error = e
+
+    def start(self):
+        threading.Thread(target=self._loop, daemon=True).start()
+
+    def tick(self):
+        pass
+
+
+class JoinedWorkerGood:
+    """A joined (non-daemon) fork-join worker is exempt: its exceptions
+    are the spawner's problem at join() time."""
+
+    def run_partitions(self, parts):
+        def worker(part):
+            for item in part:
+                self.process(item)
+
+        threads = [
+            threading.Thread(target=worker, args=(p,)) for p in parts
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+
+    def process(self, item):
+        pass
+
+
+class BoundedPumpGood:
+    """A daemon pump over a finite work list is fork-join shaped — its
+    lifetime is bounded by its input, not a forever-loop — so it is
+    exempt like a joined worker."""
+
+    def start_pump(self, items, sink):
+        def _pump():
+            for item in items:
+                sink(item)
+
+        threading.Thread(target=_pump, daemon=True).start()
+
+
+class NoLoopGood:
+    """A one-shot daemon target without a loop is exempt — nothing runs
+    long enough to be a lying sampler."""
+
+    def fire_and_forget(self):
+        threading.Thread(target=self.once, daemon=True).start()
+
+    def once(self):
+        self.process()
+
+    def process(self):
+        pass

@@ -1,0 +1,626 @@
+"""Continuous profiling plane (PR 19): sampling profiler, stall
+watchdog, and anomaly forensics bundles.
+
+Covers the acceptance surface of the plane end to end:
+
+- flame windows seal in lockstep with MetricsHistory windows (same
+  ``seq``), and the sampler's self-cost is accounted wall AND cpu;
+- ``flamediff`` produces a deterministic ranking (byte-identical
+  across runs) and benchdiff attaches top frame deltas on regress;
+- a seeded ``stalled-lock`` fault drives waiter -> watchdog
+  ``lock_convoy`` flight event naming the owner's holding frame -> a
+  complete forensics bundle, all under fake clocks (no wall sleeps in
+  the detection path), with a byte-reproducible fault journal;
+- bundles are tmp+rename atomic, retention-bounded, rate-limited, and
+  a torn bundle on disk is skipped rather than fatal;
+- the /debug/profile, /debug/stacks, /debug/bundle endpoints and the
+  ``flame --live`` / ``bundle`` CLI verbs serve the same data;
+- /healthz carries the profiler block and flips to degraded when the
+  sampler thread dies while enabled (a lying profiler);
+- JG112 (silent thread death) is registered and fires on the fixture.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import io
+import json
+import os
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from janusgraph_tpu.observability import (
+    bundle_writer,
+    flight_recorder,
+    history,
+    registry,
+    sampling_profiler,
+    slo_engine,
+    watchdog,
+)
+from janusgraph_tpu.observability.continuous import (
+    BundleWriter,
+    InstrumentedLock,
+    SamplingProfiler,
+    StallWatchdog,
+    flame_from_artifact,
+    flamediff,
+)
+from janusgraph_tpu.storage.faults import FaultPlan
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+class _Clock:
+    """Manually-advanced monotonic clock for deterministic stall tests."""
+
+    def __init__(self, t: float = 0.0):
+        self.t = t
+
+    def __call__(self) -> float:
+        return self.t
+
+    def advance(self, dt: float) -> None:
+        self.t += dt
+
+
+@pytest.fixture(autouse=True)
+def _reset_plane():
+    """Every test starts and leaves with pristine plane singletons."""
+    for step in (
+        sampling_profiler.stop, sampling_profiler.reset,
+        watchdog.stop, watchdog.reset,
+        bundle_writer.reset, flight_recorder.reset, registry.reset,
+    ):
+        step()
+    bundle_writer.configure(directory="", min_interval_s=30.0)
+    bundle_writer.directory = ""
+    yield
+    for step in (
+        sampling_profiler.stop, sampling_profiler.reset,
+        watchdog.stop, watchdog.reset,
+        bundle_writer.reset, flight_recorder.reset, registry.reset,
+    ):
+        step()
+    bundle_writer.directory = ""
+
+
+@contextlib.contextmanager
+def _parked_thread(name: str = "parked"):
+    """A background thread blocked in a recognisable frame."""
+    release = threading.Event()
+
+    def _park_here():
+        release.wait(30.0)
+
+    t = threading.Thread(target=_park_here, name=name, daemon=True)
+    t.start()
+    try:
+        yield t
+    finally:
+        release.set()
+        t.join(timeout=5.0)
+
+
+# ------------------------------------------------------------- sampler
+def test_sample_once_folds_other_threads_not_self():
+    p = SamplingProfiler()
+    with _parked_thread():
+        folded = p.sample_once()
+        assert folded >= 1
+        merged = p.merged_stacks()
+    assert merged, "pending stacks should be visible before sealing"
+    assert any("_park_here" in stack for stack in merged)
+    # the sampler never profiles the thread doing the sampling
+    assert not any("sample_once" in stack for stack in merged)
+
+
+def test_flame_windows_align_with_history_window_seq():
+    history.reset()
+    p = SamplingProfiler()
+    p.configure(hz=1.0)
+    p.start()
+    try:
+        with _parked_thread():
+            p.sample_once()
+            w1 = history.sample()
+            p.sample_once()
+            w2 = history.sample()
+        seqs = [w["seq"] for w in p.windows()]
+        # every history window sealed a flame window with the SAME seq
+        assert seqs[-2:] == [w1["seq"], w2["seq"]]
+    finally:
+        p.stop()
+        history.reset()
+
+
+def test_sampler_overhead_accounted_wall_and_cpu():
+    clk = _Clock(50.0)
+    p = SamplingProfiler(clock=clk)
+    p.configure(hz=100.0)
+    p.start()
+    try:
+        with _parked_thread():
+            deadline = time.monotonic() + 5.0
+            while p.status()["samples"] < 3:
+                assert time.monotonic() < deadline, "sampler never sampled"
+                time.sleep(0.01)
+    finally:
+        p.stop()
+    clk.advance(10.0)  # 10 fake seconds elapsed -> tiny honest pct
+    st = p.status()
+    assert st["samples"] >= 3
+    assert st["died"] is None
+    assert st["overhead_wall_pct"] > 0.0
+    assert 0.0 <= st["overhead_cpu_pct"] < 5.0
+    # wall cost includes cpu cost plus time descheduled
+    assert st["overhead_wall_pct"] >= st["overhead_cpu_pct"]
+
+
+def test_seal_window_tags_seq_and_resets_pending():
+    p = SamplingProfiler()
+    with _parked_thread():
+        p.sample_once()
+    w = p.seal_window(seq=7)
+    assert w["seq"] == 7
+    assert w["samples"] == 1
+    assert w["stacks"]
+    assert p.status()["windows_sealed"] == 1
+    # pending was folded into the window, not duplicated
+    w2 = p.seal_window(seq=8)
+    assert w2["samples"] == 0 and w2["stacks"] == {}
+
+
+def test_window_ring_is_bounded():
+    p = SamplingProfiler(max_windows=3)
+    for seq in range(6):
+        p.seal_window(seq=seq)
+    assert [w["seq"] for w in p.windows()] == [3, 4, 5]
+
+
+# ----------------------------------------------------------- flamediff
+def test_flamediff_ranking_is_deterministic():
+    old = {"stacks": {"a;b": 100, "a;c": 50, "d": 10}}
+    new = {"stacks": {"a;b": 70, "a;c": 90, "d": 10, "e": 25}}
+    r1 = flamediff(old, new)
+    r2 = flamediff(old, new)
+    assert json.dumps(r1, sort_keys=True) == json.dumps(r2, sort_keys=True)
+    # frame weights: a 150->160, b 100->70, c 50->90, d flat, e 0->25
+    assert [r["frame"] for r in r1] == ["c", "b", "e", "a"]
+    assert r1[0] == {
+        "frame": "c", "old_us": 50.0, "new_us": 90.0,
+        "delta_us": 40.0, "delta_pct": 80.0,
+    }
+    assert [r["frame"] for r in flamediff(old, new, top=2)] == ["c", "b"]
+
+
+def test_flamediff_tie_breaks_on_frame_name():
+    rows = flamediff({"x": 10, "y": 30}, {"x": 20, "y": 20})
+    assert [r["frame"] for r in rows] == ["x", "y"]
+
+
+def test_flamediff_recursion_charges_frame_once():
+    # a recursive stack must not double-charge the repeated frame
+    rows = flamediff({"f;g;f": 100}, {"f;g;f": 300})
+    by_frame = {r["frame"]: r for r in rows}
+    assert by_frame["f"]["delta_us"] == 200.0
+    assert by_frame["g"]["delta_us"] == 200.0
+
+
+def test_flame_from_artifact_shapes():
+    assert flame_from_artifact({"stacks": {"a": 1}}) == {"a": 1.0}
+    assert flame_from_artifact({"flame": {"a;b": 2}}) == {"a;b": 2.0}
+    assert flame_from_artifact(
+        {"flame": {"stacks": {"c": 3}}}
+    ) == {"c": 3.0}
+    assert flame_from_artifact({"a": 1, "b": 2.5}) == {"a": 1.0, "b": 2.5}
+    assert flame_from_artifact({"a": "text"}) is None
+    assert flame_from_artifact(None) is None
+
+
+def test_benchdiff_attaches_frame_deltas_on_regress():
+    from janusgraph_tpu.observability.benchdiff import compare
+
+    old = {
+        "stage": "saturate",
+        "peak_goodput_per_s": 400.0,
+        "goodput_2x_over_peak": 0.95,
+        "flame": {"a;b": 100, "a;c": 50},
+    }
+    new = dict(old)
+    new["peak_goodput_per_s"] = 200.0
+    new["flame"] = {"a;b": 300, "a;c": 50}
+    got = compare(old, new)
+    assert got["verdict"] == "regress"
+    deltas = got["frame_deltas"]
+    assert 0 < len(deltas) <= 3
+    assert deltas[0]["frame"] == "a"  # |delta| tie with b -> name order
+    # identical artifacts: no regression, no frame_deltas key
+    assert "frame_deltas" not in compare(old, dict(old))
+
+
+# ------------------------------------------ watchdog: seeded stall path
+def test_seeded_stalled_lock_fires_convoy_with_owner_frame(tmp_path):
+    """The acceptance path: seeded stalled-lock fault -> blocked waiter
+    -> watchdog flights a lock_convoy naming the owner's holding frame
+    -> a complete forensics bundle lands atomically.  Fake clocks
+    everywhere; the only real waiting is thread synchronisation."""
+    clk = _Clock(100.0)
+    wd = StallWatchdog(clock=clk)
+    wd.configure(stall_s=5.0)
+    bundle_writer.configure(directory=str(tmp_path), min_interval_s=0.0)
+    plan = FaultPlan(seed=1234, stall_lock_at=0, stall_lock_ms=250.0)
+    lk = InstrumentedLock("stall-test", watchdog=wd, clock=clk)
+    held = threading.Event()
+    release = threading.Event()
+
+    def _holding_frame():
+        release.wait(30.0)
+
+    def _holder():
+        assert plan.stalled_lock(lock=lk.name) == 250.0
+        with lk:
+            held.set()
+            _holding_frame()
+
+    th = threading.Thread(target=_holder, name="holder", daemon=True)
+    th.start()
+    assert held.wait(5.0)
+    tw = threading.Thread(
+        target=lambda: (lk.acquire(), lk.release()),
+        name="waiter", daemon=True,
+    )
+    tw.start()
+    deadline = time.monotonic() + 5.0
+    while lk.state()["waiters"] < 1:
+        assert time.monotonic() < deadline, "waiter never registered"
+        time.sleep(0.005)
+    sampling_profiler.sample_once()  # snapshot the owner's stack
+
+    # below stall_s: nothing fires yet
+    clk.advance(2.0)
+    assert wd.check() == []
+    # past stall_s: exactly one edge-triggered convoy event
+    clk.advance(4.0)
+    fired = wd.check()
+    assert len(fired) == 1
+    ev = fired[0]
+    assert ev["category"] == "lock_convoy"
+    assert ev["lock"] == "stall-test"
+    assert ev["waiter"] == "waiter"
+    assert ev["owner"] == "holder"
+    assert ev["wait_s"] >= 5.0
+    assert "_holding_frame" in ev["owner_stack"]
+    # the wait-for edge names both parties (flighted as a string field)
+    assert "waiter" in ev["wait_for"] and "holder" in ev["wait_for"]
+    # edge-triggered: the same episode never re-fires
+    clk.advance(10.0)
+    assert wd.check() == []
+    assert wd.state()["events"] == 1
+
+    # the convoy shipped a complete atomic bundle
+    bundle = bundle_writer.latest()
+    assert bundle is not None
+    assert bundle["reason"] == "lock-convoy"
+    for key in (
+        "ts", "pid", "flame_windows", "profiler", "flight",
+        "timeseries", "stacks", "requests", "watchdog",
+    ):
+        assert key in bundle
+    convoy_evs = [
+        e for e in bundle["flight"]["events"]
+        if e["category"] == "lock_convoy"
+    ]
+    assert len(convoy_evs) == 1
+    assert not [
+        n for n in os.listdir(tmp_path) if n.endswith(".tmp")
+    ], "no torn temp files after capture"
+
+    release.set()
+    th.join(5.0)
+    tw.join(5.0)
+    # the waiter was granted -> the key re-arms for the next episode
+    assert lk.state()["owner"] is None and lk.state()["waiters"] == 0
+    wd.check()
+    assert ("lock", "stall-test") not in {
+        k[:2] for k in wd._flagged
+    }
+
+
+def test_seeded_fault_journal_is_byte_reproducible():
+    def drive(seed: int):
+        plan = FaultPlan(
+            seed=seed, stall_lock_at=1, stall_lock_ms=75.0,
+            wedge_thread_at=2,
+        )
+        out = []
+        for _ in range(4):
+            out.append(plan.stalled_lock(lock="l"))
+            out.append(plan.wedge_thread())
+        return out, json.dumps(plan.journal, sort_keys=True)
+
+    out1, j1 = drive(9)
+    out2, j2 = drive(9)
+    assert out1 == out2
+    assert j1 == j2, "journal must be byte-equal for the same seed"
+    # one-shot semantics: each fault fires exactly once at its index
+    assert [v for v in out1 if isinstance(v, float) and v > 0] == [75.0]
+    assert out1.count(True) == 1
+    kinds = [e["kind"] for e in json.loads(j1)]
+    assert kinds == ["stalled_lock", "wedged_thread"]
+
+
+def test_seeded_wedged_thread_progress_stall(tmp_path):
+    """wedged-thread fault: the worker stops advancing its progress
+    counter while still 'active' -> the watchdog flights a stall."""
+    clk = _Clock(0.0)
+    wd = StallWatchdog(clock=clk)
+    wd.configure(stall_s=5.0)
+    bundle_writer.configure(directory=str(tmp_path), min_interval_s=0.0)
+    plan = FaultPlan(seed=3, wedge_thread_at=1)
+    state = {"done": 0, "wedged": False}
+
+    def _step():
+        if plan.wedge_thread():
+            state["wedged"] = True
+        if not state["wedged"]:
+            state["done"] += 1
+
+    wd.register_progress(
+        "worker", lambda: {"active": 1, "progress": state["done"]}
+    )
+    _step()  # advances (n=0 < at)
+    wd.check()  # baseline: progress=1 recorded
+    clk.advance(3.0)
+    _step()  # wedges at n=1: progress frozen from here on
+    wd.check()  # value unchanged? no — 1 -> 1: starts the stuck timer
+    clk.advance(6.0)
+    fired = wd.check()
+    assert [e["category"] for e in fired] == ["stall"]
+    assert fired[0]["source"] == "worker"
+    assert fired[0]["stuck_s"] >= 5.0
+    assert bundle_writer.latest()["reason"] == "stall"
+    # edge-triggered until progress resumes
+    clk.advance(10.0)
+    assert wd.check() == []
+    state["wedged"] = False
+    _step()
+    wd.check()  # progress moved: re-arms
+    _step()  # freeze again at a new value? no — keeps advancing
+    assert wd.state()["events"] == 1
+    assert plan.journal == [{"kind": "wedged_thread", "n": 1}]
+
+
+def test_progress_source_exception_does_not_kill_scan():
+    wd = StallWatchdog(clock=_Clock(0.0))
+
+    def _bad():
+        raise RuntimeError("boom")
+
+    wd.register_progress("bad", _bad)
+    assert wd.check() == []  # no raise, no stall
+    errs = flight_recorder.events("thread_error")
+    assert any("bad" in e["error"] for e in errs)
+
+
+def test_instrumented_lock_tracks_owner_and_context_manager():
+    wd = StallWatchdog(clock=_Clock(0.0))
+    lk = InstrumentedLock("ctx", watchdog=wd)
+    assert lk.state()["owner"] is None
+    with lk:
+        st = lk.state()
+        assert st["owner"] == threading.current_thread().name
+        assert st["waiters"] == 0
+    assert lk.state()["owner"] is None
+    # timeout on a contended acquire returns False and deregisters
+    with lk:
+        got = {}
+
+        def _try():
+            got["ok"] = lk.acquire(timeout=0.05)
+
+        t = threading.Thread(target=_try, daemon=True)
+        t.start()
+        t.join(5.0)
+    assert got["ok"] is False
+    assert lk.state()["waiters"] == 0
+
+
+# ------------------------------------------------------------- bundles
+def test_bundle_retention_rate_limit_and_atomicity(tmp_path):
+    clk = _Clock(0.0)
+    bw = BundleWriter(
+        directory=str(tmp_path), retention=3, min_interval_s=30.0,
+        clock=clk,
+    )
+    paths = []
+    for _ in range(5):
+        clk.advance(60.0)
+        paths.append(bw.capture(reason="test"))
+    assert all(paths)
+    names = sorted(os.listdir(tmp_path))
+    assert len(names) == 3, "retention prunes oldest bundles"
+    assert names == [os.path.basename(p) for p in paths[-3:]]
+    assert not [n for n in names if n.endswith(".tmp")]
+    # rate limit: a capture inside min_interval_s is suppressed...
+    assert bw.capture(reason="test") is None
+    assert bw.status()["suppressed"] == 1
+    # ...unless forced (the CLI / ?capture=1 path)
+    assert bw.capture(reason="manual", force=True) is not None
+    assert bw.written == 6
+
+
+def test_bundle_capture_disabled_without_directory():
+    bw = BundleWriter(directory="", min_interval_s=0.0)
+    assert bw.capture(reason="noop") is None
+    assert bw.status()["dir"] is None
+
+
+def test_latest_skips_torn_bundle(tmp_path):
+    bw = BundleWriter(directory=str(tmp_path), min_interval_s=0.0)
+    good = bw.capture(reason="good", force=True)
+    assert good is not None
+    # a writer killed mid-write leaves a torn newest file (sorts after
+    # every pid-numbered bundle): latest() must skip it
+    torn = os.path.join(str(tmp_path), "bundle-zzz-torn.json")
+    with open(torn, "w") as fh:
+        fh.write('{"reason": "torn"')
+    got = bw.latest()
+    assert got is not None
+    assert got["reason"] == "good"
+    assert got["path"] == good
+    os.remove(good)
+    assert bw.latest() is None
+
+
+def test_bundle_is_complete_and_json_clean(tmp_path):
+    with _parked_thread():
+        bw = BundleWriter(directory=str(tmp_path), min_interval_s=0.0)
+        path = bw.capture(reason="unit", force=True)
+    assert path is not None
+    with open(path) as fh:
+        bundle = json.load(fh)
+    assert set(bundle) >= {
+        "reason", "ts", "pid", "flame_windows", "profiler", "flight",
+        "timeseries", "stacks", "requests", "watchdog",
+    }
+    assert bundle["pid"] == os.getpid()
+    assert any("_park_here" in "\n".join(v) for v in bundle["stacks"].values())
+
+
+# --------------------------------------------------- endpoints and CLI
+@pytest.fixture
+def debug_server(tmp_path):
+    from janusgraph_tpu.core.graph import open_graph
+    from janusgraph_tpu.server import JanusGraphManager, JanusGraphServer
+
+    g = open_graph({"ids.authority-wait-ms": 0.0})
+    m = JanusGraphManager()
+    m.put_graph("graph", g)
+    s = JanusGraphServer(manager=m, bundle_dir=str(tmp_path)).start()
+    yield s
+    s.stop()
+    g.close()
+    history.reset()
+    slo_engine.reset()
+    import janusgraph_tpu.server.server as server_mod
+
+    with server_mod._HEALTH_LOCK:
+        server_mod._HEALTH_STATE["status"] = None
+
+
+def _get(base: str, path: str) -> bytes:
+    return urllib.request.urlopen(base + path, timeout=5).read()
+
+
+def test_debug_endpoints_serve_profile_stacks_and_bundle(debug_server):
+    base = "http://127.0.0.1:%d" % debug_server.port
+    h = json.loads(_get(base, "/healthz"))
+    prof = h["profiler"]
+    assert prof["enabled"] is True and prof["alive"] is True
+    assert prof["died"] is None
+    assert "watchdog" in prof and "bundles" in prof
+    # let the 20 Hz sampler collect a few stacks
+    deadline = time.monotonic() + 5.0
+    while sampling_profiler.status()["samples"] < 3:
+        assert time.monotonic() < deadline, "server sampler never sampled"
+        time.sleep(0.02)
+    text = _get(base, "/debug/profile").decode()
+    assert text.strip(), "live flame text should not be empty"
+    assert " " in text.splitlines()[0]  # "stack weight_us" lines
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        _get(base, "/debug/profile?window=x")
+    assert ei.value.code == 400
+    stacks = json.loads(_get(base, "/debug/stacks"))
+    assert stacks["stacks"], "every live thread appears in the dump"
+    # no bundle on disk yet -> 404 with a hint
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        _get(base, "/debug/bundle")
+    assert ei.value.code == 404
+    bundle = json.loads(_get(base, "/debug/bundle?capture=1"))
+    assert bundle["reason"] == "manual"
+    for key in ("flame_windows", "flight", "stacks", "watchdog", "requests"):
+        assert key in bundle
+    # subsequent plain GET serves the bundle just captured
+    again = json.loads(_get(base, "/debug/bundle"))
+    assert again["path"] == bundle["path"]
+
+
+def test_healthz_degrades_when_sampler_dies(debug_server):
+    base = "http://127.0.0.1:%d" % debug_server.port
+    assert json.loads(_get(base, "/healthz"))["profiler"]["alive"] is True
+    # simulate a wedged/killed sampler thread: still enabled, not alive
+    sampling_profiler._stop.set()
+    sampling_profiler._thread.join(timeout=5.0)
+    # a degraded /healthz is a 503 whose body carries the diagnosis
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        _get(base, "/healthz")
+    assert ei.value.code == 503
+    h = json.loads(ei.value.read())
+    assert h["profiler"]["enabled"] is True
+    assert h["profiler"]["alive"] is False
+    assert h["status"] == "degraded", "a dead sampler is a lying profiler"
+
+
+def test_cli_flame_live_and_bundle(tmp_path, capsys):
+    from janusgraph_tpu.cli import main
+
+    with _parked_thread():
+        sampling_profiler.sample_once()
+        assert main(["flame", "--live"]) == 0
+    out = capsys.readouterr().out
+    assert "_park_here" in out
+    # no trace id and no --live is a usage error
+    assert main(["flame"]) == 2
+    capsys.readouterr()
+    # bundle --capture writes then prints the bundle
+    bundle_writer.configure(directory=str(tmp_path), min_interval_s=0.0)
+    assert main(["bundle", "--capture"]) == 0
+    got = json.loads(capsys.readouterr().out)
+    assert got["reason"] == "cli"
+
+
+def test_cli_flame_live_empty_profiler_fails(capsys):
+    from janusgraph_tpu.cli import main
+
+    assert main(["flame", "--live"]) == 1
+    assert "no samples" in capsys.readouterr().err
+
+
+# ---------------------------------------------------------------- JG112
+def test_jg112_registered_and_fires_on_fixture():
+    from janusgraph_tpu.analysis import RULES, analyze_paths
+
+    assert "JG112" in RULES
+    path = os.path.join(
+        REPO, "tests", "fixtures", "graphlint",
+        "bad_silent_thread_death.py",
+    )
+    findings = [
+        f for f in analyze_paths([path]) if f.rule_id == "JG112"
+    ]
+    assert sorted(f.line for f in findings) == [22, 46]
+
+
+def test_plane_daemons_record_rather_than_die_silently():
+    """The plane's own daemons obey JG112: a poisoned sample loop
+    flights a thread_error and marks died instead of vanishing."""
+    p = SamplingProfiler()
+    p.configure(hz=200.0)
+    # poison the sample counter so sample_once raises in the run loop
+    p._samples = None
+    p.start()
+    try:
+        deadline = time.monotonic() + 5.0
+        while p._died is None:
+            assert time.monotonic() < deadline, "sampler never recorded death"
+            time.sleep(0.01)
+    finally:
+        p._samples = 0
+        p.stop()
+    errs = flight_recorder.events("thread_error")
+    assert any(e["thread"] == "profiler-sampler" for e in errs)
