@@ -1277,6 +1277,28 @@ def worker() -> None:
                 "error": f"{type(e).__name__}: {e}"[:500],
             })
 
+    # streaming-telemetry push-vs-poll A/B (ISSUE 20, rides the FLEET
+    # gate or runs alone via FLEET_PUSH=1): a live replica streams
+    # flight events over /watch to a push-mode federation while the
+    # poll baseline only refreshes at tick boundaries; a seeded
+    # replica kill mid-stream must lose ZERO events (cursor resume on
+    # renegotiation) and the killed replica's forensics bundle must be
+    # retrievable off-host after the death. Artifact FLEET_r05.json.
+    # Acceptance: push event p99 <= 0.1x the poll interval, bus
+    # self-cost < 1% on both the wall and CPU clocks.
+    if os.environ.get("FLEET", "0") == "1" or (
+        os.environ.get("FLEET_PUSH", "0") == "1"
+    ):
+        try:
+            with _stage_span("fleet_push_poll"):
+                _fleet_push_stage(t0)
+        except Exception as e:
+            _hb(f"fleet push stage FAILED {type(e).__name__}: {e}", t0)
+            _emit({
+                "stage": "fleet_push_poll", "ok": False,
+                "error": f"{type(e).__name__}: {e}"[:500],
+            })
+
     # pallas kernel evidence (VERDICT r2 #5): compiled run at s16 with
     # parity vs the ell result; failure is recorded, not fatal. The stage
     # runs LAST and under a watchdog: a hung Mosaic compile through the
@@ -2067,6 +2089,377 @@ def _stall_forensics_stage(t0):
     _hb(
         f"stall-forensics: detect {report['detect_ms']}ms "
         f"journal-equal {byte_equal} ok {report['ok']}", t0,
+    )
+    with open(out_path + ".tmp", "w") as f:
+        json.dump(report, f, indent=2)
+    os.replace(out_path + ".tmp", out_path)
+    report["artifact"] = out_path
+    _emit(report)
+
+
+def _fleet_push_stage(t0):
+    """Streaming-telemetry push-vs-poll A/B (ISSUE 20 acceptance): one
+    live replica pumps flight events at a fixed rate while (a) a
+    poll-mode federation sees them only at tick boundaries — the PR 17
+    freshness baseline — and (b) a push-mode federation receives them
+    over a real ``/watch`` WebSocket the moment they flight. The seeded
+    fault plan kills the replica mid-stream (after its forensics bundle
+    is announced on the bus and shipped off-host) and restarts it; the
+    renegotiated channel must resume from its flight cursor so ZERO
+    pumped events are lost and none duplicate. Gates: push event p99
+    <= 0.1x the poll interval, bus self-cost < 1% on both the wall and
+    the CPU clock, and the dead replica's bundle still retrievable from
+    ``GET /fleet/bundles``. Artifact FLEET_r05.json."""
+    import shutil
+    import tempfile
+    import threading as _threading
+    import urllib.request
+
+    from janusgraph_tpu.core.graph import JanusGraphTPU
+    from janusgraph_tpu.observability import (
+        FleetFederation,
+        bundle_writer,
+        flight_recorder,
+        telemetry_bus,
+    )
+    from janusgraph_tpu.observability.identity import (
+        replica_name,
+        set_replica,
+    )
+    from janusgraph_tpu.observability.timeseries import history
+    from janusgraph_tpu.server import (
+        FleetRouter,
+        JanusGraphManager,
+        JanusGraphServer,
+    )
+    from janusgraph_tpu.server.fleet import FleetFrontend
+    from janusgraph_tpu.storage.faults import FaultPlan
+
+    out_path = os.environ.get(
+        "FLEET_PUSH_OUT", os.path.join(_REPO_DIR, "FLEET_r05.json")
+    )
+    poll_interval_s = float(os.environ.get("PUSH_POLL_INTERVAL_S", "0.5"))
+    event_hz = float(os.environ.get("PUSH_EVENT_HZ", "25"))
+    phase_s = float(os.environ.get("PUSH_PHASE_S", "6"))
+    seed = int(os.environ.get("PUSH_SEED", "7"))
+    kill_at = int(os.environ.get("PUSH_KILL_AT", "4"))
+    restart_at = int(os.environ.get("PUSH_RESTART_AT", "8"))
+
+    plan = FaultPlan(
+        seed=seed, replica_kill_at=kill_at,
+        replica_restart_at=restart_at,
+    )
+    flight_recorder.reset()
+    flight_recorder.configure(capacity=8192)
+    history.reset()
+    telemetry_bus.reset()
+    prev_identity = replica_name()
+    set_replica("r0")
+    bdir = tempfile.mkdtemp(prefix="jg-push-bundle-")
+
+    graph = JanusGraphTPU({"ids.authority-wait-ms": 0.0})
+    manager = JanusGraphManager()
+    manager.put_graph("graph", graph)
+    router = FleetRouter()
+    servers = []
+
+    def _start_server():
+        server = JanusGraphServer(
+            manager=manager, replica_name="r0", bundle_dir=bdir,
+            request_timeout_s=30.0,
+        ).start()
+        servers.append(server)
+        if "r0" in router.replicas():
+            router.rejoin_replica("r0", "127.0.0.1", server.port)
+            router.probe("r0")
+        else:
+            router.add_replica("r0", "127.0.0.1", server.port)
+        return server
+
+    # pump: one thread flighting `bench_push` events at event_hz; every
+    # recorded (seq, wall-ts) pair is banked for the lag/loss accounting
+    ev_lock = _threading.Lock()
+    recorded = []  # (seq, wall ts)
+    stop_pump = _threading.Event()
+
+    def _pump():
+        period = 1.0 / max(1e-6, event_hz)
+        nxt = time.monotonic()
+        while not stop_pump.is_set():
+            try:
+                e = flight_recorder.record("bench_push", bench=1)
+                with ev_lock:
+                    recorded.append((e["seq"], e["ts"]))
+            except Exception:  # noqa: BLE001 - survive teardown races
+                pass
+            nxt += period
+            time.sleep(max(0.0, nxt - time.monotonic()))
+
+    def _pump_phase():
+        stop_pump.clear()
+        th = _threading.Thread(target=_pump, daemon=True)
+        th.start()
+        return th
+
+    fed_poll = fed_push = frontend = None
+    poll_lags = []
+    push_seen = []  # (seq, lag_ms)
+    push_lock = _threading.Lock()
+    report = {"stage": "fleet_push_poll", "seed": seed}
+    try:
+        server = _start_server()
+        router.probe()
+        bundle_writer.reset()
+        bundle_writer.configure(directory=bdir, min_interval_s=0.0)
+
+        # ------------- phase A: poll baseline (tick-boundary freshness)
+        # the poll transport cannot see an event before the tick that
+        # scrapes past it completes — its freshness is the tick cadence
+        fed_poll = FleetFederation(
+            router, interval_s=poll_interval_s, push_enabled=False,
+        )
+        th = _pump_phase()
+        accounted = 0
+        t_end = time.monotonic() + phase_s
+        while time.monotonic() < t_end:
+            time.sleep(poll_interval_s)
+            fed_poll.tick()
+            tc = time.time()
+            with ev_lock:
+                fresh = [ts for _, ts in recorded[accounted:] if ts <= tc]
+                accounted += len(fresh)
+            poll_lags.extend(
+                (tc - ts) * 1000.0 for ts in fresh  # graphlint: wallclock -- tick-boundary freshness lag over event stamps
+            )
+        stop_pump.set()
+        th.join(timeout=10.0)
+        poll_events = len(recorded)
+        _hb(
+            f"push-poll: poll baseline {len(poll_lags)} lag samples over "
+            f"{poll_events} events", t0,
+        )
+
+        # ------------------- phase B: push transport with seeded chaos
+        fed_push = FleetFederation(
+            router, interval_s=poll_interval_s, push_enabled=True,
+            bundle_min_interval_s=0.0,
+        )
+        frontend = FleetFrontend(router, federation=fed_push).start()
+        orig_on_event = fed_push._on_push_event
+
+        def _spy(channel, event):
+            if str(event.get("category", "")) == "bench_push":
+                ts = event.get("ts")
+                lag_ms = (
+                    (time.time() - float(ts)) * 1000.0  # graphlint: wallclock -- push freshness lag over event stamps (in-process: zero offset)
+                    if isinstance(ts, (int, float)) else None
+                )
+                with push_lock:
+                    push_seen.append((int(event.get("seq", 0)), lag_ms))
+            orig_on_event(channel, event)
+
+        fed_push._on_push_event = _spy
+        fed_push.tick()  # negotiates the /watch channel; live from here
+        if "r0" not in fed_push.push_status()["channels"]:
+            raise RuntimeError("push channel failed to negotiate")
+
+        bus0 = telemetry_bus.status()
+        wall0 = time.monotonic()
+        push_start = len(recorded)
+        th = _pump_phase()
+        outage = [None, None]  # [kill wall ts, reconnect wall ts]
+        bundle_after_kill = None
+        t_end = time.monotonic() + phase_s
+        bucket = 0
+        # the loop overruns phase_s only to let the restarted replica
+        # renegotiate; the bucket cap bounds a reconnection that never
+        # lands (gated as a failure below, not a hang)
+        while (
+            time.monotonic() < t_end or (outage[0] and not outage[1])
+        ) and bucket < 64:
+            time.sleep(poll_interval_s)
+            for event in plan.fleet_hook(1):
+                if event["kind"] == "replica_kill":
+                    # the dying replica's pager announces its bundle on
+                    # the bus on the way down; the push channel ships it
+                    # off-host before the process is gone
+                    bundle_writer.capture(reason="bench-kill", force=True)
+                    ship_deadline = time.monotonic() + 10.0
+                    while (
+                        fed_push.bundles.get("r0") is None
+                        and time.monotonic() < ship_deadline
+                    ):
+                        time.sleep(0.05)
+                    outage[0] = time.time()
+                    server.stop()
+                    # crash detection: two consecutive probe misses
+                    router.probe("r0")
+                    router.probe("r0")
+                    _hb(f"push-poll: killed r0 @bucket {bucket}", t0)
+                elif event["kind"] == "replica_restart":
+                    server = _start_server()
+                    _hb(f"push-poll: restarted r0 @bucket {bucket}", t0)
+            fed_push.tick()
+            if outage[0] and not outage[1]:
+                chan = fed_push.push_status()["channels"].get("r0")
+                if chan and chan.get("connected"):
+                    outage[1] = time.time()
+            if outage[0] and bundle_after_kill is None:
+                # off-host forensics endpoint, queried AFTER the death:
+                # the shipped bundle must outlive its replica
+                try:
+                    with urllib.request.urlopen(
+                        "http://127.0.0.1:%d/fleet/bundles?replica=r0"
+                        % frontend.port, timeout=10,
+                    ) as resp:
+                        bundle_after_kill = json.loads(
+                            resp.read().decode("utf-8")
+                        )
+                except Exception as e:  # noqa: BLE001 - a miss gates `ok`
+                    bundle_after_kill = {
+                        "status": f"{type(e).__name__}: {e}"[:200],
+                    }
+            bucket += 1
+        stop_pump.set()
+        th.join(timeout=10.0)
+        with ev_lock:
+            pushed = recorded[push_start:]
+        # settle: tick until the resumed channel has replayed everything
+        # the outage hid (or 10 s — a loss, gated below)
+        settle_deadline = time.monotonic() + 10.0
+        while time.monotonic() < settle_deadline:
+            with push_lock:
+                seen_set = {s for s, _ in push_seen}
+            if all(s in seen_set for s, _ in pushed):
+                break
+            fed_push.tick()
+            time.sleep(0.2)
+        wall_ms = (time.monotonic() - wall0) * 1000.0
+        bus1 = telemetry_bus.status()
+    finally:
+        stop_pump.set()
+        if frontend is not None:
+            frontend.stop()
+        if fed_push is not None:
+            fed_push.stop()
+        router.stop()
+        for s in servers:
+            try:
+                s.stop()
+            except Exception:  # noqa: BLE001 - already stopped
+                pass
+        try:
+            graph.close()
+        except Exception:  # noqa: BLE001 - torn by the seeded kill
+            pass
+        bundle_writer.reset()
+        telemetry_bus.reset()
+        history.reset()
+        flight_recorder.reset()
+        set_replica(prev_identity)
+        shutil.rmtree(bdir, ignore_errors=True)
+
+    # ------------------------------------------------------- accounting
+    with push_lock:
+        seen = list(push_seen)
+    pushed_seqs = [s for s, _ in pushed]
+    seen_seqs = [s for s, _ in seen]
+    seen_set = set(seen_seqs)
+    lost = [s for s in pushed_seqs if s not in seen_set]
+    duplicated = len(seen_seqs) - len(seen_set)
+    # steady-state freshness excludes the outage window: events flighted
+    # while no channel existed are REPLAYED on resume (recovery, counted
+    # for loss, not for live latency)
+    ts_by_seq = dict(pushed)
+    outage_lo = (outage[0] - 0.1) if outage[0] else None
+    outage_hi = outage[1] if outage[1] else float("inf")
+    steady = [
+        lag for s, lag in seen
+        if lag is not None and s in ts_by_seq
+        and not (
+            outage_lo is not None
+            and outage_lo <= ts_by_seq[s] <= outage_hi
+        )
+    ]
+    replayed = sum(
+        1 for s in pushed_seqs
+        if outage_lo is not None
+        and outage_lo <= ts_by_seq[s] <= outage_hi
+    )
+
+    def _p99(samples):
+        if not samples:
+            return float("inf")
+        ss = sorted(samples)
+        return round(ss[min(len(ss) - 1, int(0.99 * (len(ss) - 1)))], 3)
+
+    poll_p99 = _p99(poll_lags)
+    push_p99 = _p99(steady)
+    poll_interval_ms = poll_interval_s * 1000.0
+    # both self-cost clocks against elapsed wall on ONE core — the
+    # sampling profiler's honest denominator (a mostly-idle process
+    # makes a process-CPU denominator punish the bus for the idleness
+    # around it, not for its own bill)
+    bus_wall_ms = bus1["overhead_wall_ms"] - bus0["overhead_wall_ms"]
+    bus_cpu_ms = bus1["overhead_cpu_ms"] - bus0["overhead_cpu_ms"]
+    bus_wall_pct = bus_wall_ms / max(1e-9, wall_ms) * 100.0
+    bus_cpu_pct = bus_cpu_ms / max(1e-9, wall_ms) * 100.0
+    bundle_retrieved = bool(
+        isinstance(bundle_after_kill, dict)
+        and "status" not in bundle_after_kill
+        and bundle_after_kill.get("bundle")
+    )
+    report.update({
+        "poll_interval_ms": poll_interval_ms,
+        "event_hz": event_hz,
+        "phase_s": phase_s,
+        "journal": plan.journal,
+        "poll": {
+            "events": poll_events,
+            "lag_samples": len(poll_lags),
+            "poll_event_p99_ms": poll_p99,
+        },
+        "push": {
+            "events": len(pushed_seqs),
+            "steady_lag_samples": len(steady),
+            "replayed_through_outage": replayed,
+            "events_lost": len(lost),
+            "events_duplicated": duplicated,
+            "outage_s": (
+                round(outage[1] - outage[0], 3)  # graphlint: wallclock -- outage span over the two wall stamps bracketing it
+                if outage[0] and outage[1] else None
+            ),
+            "push_event_p99_ms": push_p99,
+        },
+        "poll_event_p99_ms": poll_p99,
+        "push_event_p99_ms": push_p99,
+        "push_vs_poll_speedup": (
+            round(poll_p99 / push_p99, 1) if push_p99 > 0 else None
+        ),
+        "events_lost": len(lost),
+        "events_duplicated": duplicated,
+        "bus_wall_overhead_ms": round(bus_wall_ms, 3),
+        "bus_cpu_overhead_ms": round(bus_cpu_ms, 3),
+        "bus_wall_overhead_pct": round(bus_wall_pct, 4),
+        "bus_cpu_overhead_pct": round(bus_cpu_pct, 4),
+        "bus_dropped": bus1["dropped"],
+        "bundle_retrievable_after_kill": bundle_retrieved,
+        "ok": bool(
+            push_p99 <= 0.1 * poll_interval_ms
+            and not lost
+            and duplicated == 0
+            and bus_wall_pct < 1.0
+            and bus_cpu_pct < 1.0
+            and bundle_retrieved
+            and outage[0] is not None
+            and outage[1] is not None
+        ),
+    })
+    _hb(
+        f"push-poll: push p99 {push_p99}ms vs poll p99 {poll_p99}ms "
+        f"lost {len(lost)} dup {duplicated} "
+        f"bus {report['bus_cpu_overhead_pct']}% cpu "
+        f"ok {report['ok']}", t0,
     )
     with open(out_path + ".tmp", "w") as f:
         json.dump(report, f, indent=2)

@@ -84,6 +84,14 @@ RULES: Dict[str, Rule] = {
              "observable) before dying or continuing; a silently-dead "
              "sampler is a lying profiler, and `except Exception: pass` "
              "hides the death the stall watchdog exists to catch"),
+        Rule("JG113", SEV_ERROR,
+             "fan-out publish into subscriber queues without a "
+             "drop/accounting path: a blocking put() inside a fan-out "
+             "loop convoys EVERY subscriber behind the slowest one "
+             "(one wedged consumer stalls the producer and so the "
+             "whole bus); use put_nowait()/put(block=False) with a "
+             "caught queue.Full that RECORDS the drop — a slow "
+             "consumer must cost itself data, never stall producers"),
         # -- lock discipline ------------------------------------------------
         Rule("JG201", SEV_ERROR,
              "lock.acquire() without with/try-finally release on all paths"),

@@ -1,4 +1,6 @@
-"""JG112 — background-thread run loops must record their own death.
+"""JG112/JG113 — background-thread and fan-out queue discipline.
+
+JG112 — background-thread run loops must record their own death.
 
 A daemon thread running a loop (``while not stop.wait(...)``) is the
 process's most failure-prone shape: an exception anywhere in the loop
@@ -40,6 +42,38 @@ module does not define (``serve_forever`` on an stdlib server) are out
 of scope. Joined worker pools (no ``daemon=True``) are exempt — their
 exceptions are the spawner's problem at ``join()`` time, and flagging
 them would punish fork-join parallelism.
+
+JG113 — fan-out publish must have a drop/accounting path (ISSUE 20).
+
+The telemetry bus's publish shape — ``for sub in subscribers:
+sub.queue.put(event)`` — is a convoy waiting to happen: ``Queue.put()``
+blocks when the queue is full, so ONE wedged consumer stalls the
+publish loop, which stalls every OTHER subscriber's delivery, which
+stalls the PRODUCER that called publish (a flight-recorder ``record()``
+or a history sampler tick). The runtime symptom is the lock-convoy
+wedge the stall watchdog hunts; this rule is the static twin for the
+queue-fan-out variant.
+
+Flagged, for every ``.put(...)`` / ``.put_nowait(...)`` call lexically
+inside a ``for`` loop (the fan-out shape — one producer iterating
+consumers):
+
+- blocking ``.put(...)`` (no ``block=False`` and no ``timeout=``) —
+  unconditionally: an unbounded wait inside a fan-out loop convoys the
+  remaining subscribers behind the slowest one;
+- ``.put_nowait(...)`` / ``.put(..., block=False)`` NOT guarded by a
+  ``try`` whose handler catches ``Full`` (or ``queue.Full``, or a
+  broad except) with an observable body (the JG112 vocabulary: a call,
+  a raise, an assignment — a ``dropped`` counter is the canonical
+  choice): an uncaught ``Full`` unwinds the publish loop mid-fan-out
+  (later subscribers silently miss the event), and a swallowed one
+  hides the drop the accounting contract exists to surface.
+
+A bounded ``.put(..., timeout=...)`` passes the convoy check (the wait
+is priced) but still needs the ``Full`` handler — the timeout's whole
+point is that it CAN expire. Drop-oldest designs (popleft-then-append
+under the consumer lock, observability/stream.py) never block and
+never raise, so they are invisible to this rule by construction.
 """
 
 from __future__ import annotations
@@ -130,9 +164,136 @@ def _target_names(expr) -> List[str]:
     return []
 
 
+def _catches_full(handler: ast.ExceptHandler) -> bool:
+    """True when the handler would catch ``queue.Full`` — an explicit
+    ``Full`` / ``queue.Full`` (possibly in a tuple) or a broad except."""
+    if _is_broad_handler(handler):
+        return True
+    t = handler.type
+
+    def _is_full(e) -> bool:
+        if isinstance(e, ast.Name) and e.id == "Full":
+            return True
+        return isinstance(e, ast.Attribute) and e.attr == "Full"
+
+    if t is None:
+        return True
+    if _is_full(t):
+        return True
+    if isinstance(t, ast.Tuple):
+        return any(_is_full(e) for e in t.elts)
+    return False
+
+
+def _put_is_blocking(call: ast.Call) -> bool:
+    """True when a ``.put(...)`` call can block indefinitely: no
+    ``block=False`` (keyword or second positional) and no ``timeout=``."""
+    if len(call.args) >= 2:
+        blk = call.args[1]
+        if isinstance(blk, ast.Constant) and blk.value is False:
+            return False
+    if len(call.args) >= 3:
+        # put(item, block, timeout) — a timeout bounds the wait
+        return False
+    for kw in call.keywords:
+        if (
+            kw.arg == "block"
+            and isinstance(kw.value, ast.Constant)
+            and kw.value.value is False
+        ):
+            return False
+        if kw.arg == "timeout":
+            return False
+    return True
+
+
+def _fanout_puts(loop: ast.For):
+    """Yield ``(call, guarded)`` for every ``.put`` / ``.put_nowait``
+    call lexically inside ``loop``, where ``guarded`` means an enclosing
+    ``try`` catches ``Full`` with an observable handler body. Does not
+    descend into nested defs/lambdas/classes (their fan-outs are their
+    own story, found via their own enclosing loops)."""
+
+    def visit(node: ast.AST, guarded: bool):
+        if isinstance(
+            node,
+            (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda, ast.ClassDef),
+        ):
+            return
+        if isinstance(node, ast.Try):
+            inner = guarded or any(
+                _catches_full(h) and not _handler_does_nothing(h)
+                for h in node.handlers
+            )
+            for stmt in node.body:
+                yield from visit(stmt, inner)
+            # handler/else/finally bodies sit OUTSIDE the try's guard
+            for h in node.handlers:
+                for stmt in h.body:
+                    yield from visit(stmt, guarded)
+            for stmt in node.orelse + node.finalbody:
+                yield from visit(stmt, guarded)
+            return
+        if (
+            isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Attribute)
+            and node.func.attr in ("put", "put_nowait")
+        ):
+            yield node, guarded
+        for child in ast.iter_child_nodes(node):
+            yield from visit(child, guarded)
+
+    for child in ast.iter_child_nodes(loop):
+        yield from visit(child, False)
+
+
+def _check_fanout_queues(mod) -> List[Finding]:
+    """JG113: blocking or unaccounted queue puts inside fan-out loops."""
+    findings: List[Finding] = []
+    if ".put" not in mod.source:
+        return findings
+    reported = set()
+    for node in ast.walk(mod.tree):
+        if not isinstance(node, ast.For):
+            continue
+        for call, guarded in _fanout_puts(node):
+            if id(call) in reported:
+                # nested loops walk the same call twice; one finding
+                reported.add(id(call))
+                continue
+            reported.add(id(call))
+            method = call.func.attr
+            if method == "put" and _put_is_blocking(call):
+                findings.append(
+                    Finding(
+                        "JG113", RULES["JG113"].severity, mod.path,
+                        call.lineno, call.col_offset,
+                        "blocking put() inside a fan-out loop: one full "
+                        "subscriber queue convoys every later subscriber "
+                        "AND the producer — use put_nowait() (or "
+                        "block=False) and account the Full as a drop",
+                    )
+                )
+            elif not guarded:
+                findings.append(
+                    Finding(
+                        "JG113", RULES["JG113"].severity, mod.path,
+                        call.lineno, call.col_offset,
+                        f"{method}() inside a fan-out loop without an "
+                        f"accounted Full path: an uncaught queue.Full "
+                        f"unwinds the loop mid-fan-out and later "
+                        f"subscribers silently miss the event — catch "
+                        f"Full and record the drop (a dropped counter / "
+                        f"flight event)",
+                    )
+                )
+    return findings
+
+
 def check_module(mod) -> List[Finding]:
     findings: List[Finding] = []
-    # text pre-gate: no thread construction, no work
+    findings.extend(_check_fanout_queues(mod))
+    # text pre-gate: no thread construction, no JG112 work
     if "Thread(" not in mod.source:
         return findings
 

@@ -236,6 +236,16 @@ def cmd_fleet(args) -> int:
                 outlier_min_count=first.config.get(
                     "metrics.fleet-outlier-min-count"
                 ),
+                push_enabled=first.config.get("server.fleet.push-enabled"),
+                ship_bundles=first.config.get(
+                    "server.fleet.push-ship-bundles"
+                ),
+                bundle_retention=first.config.get(
+                    "server.fleet.push-bundle-retention"
+                ),
+                bundle_min_interval_s=first.config.get(
+                    "server.fleet.push-bundle-min-interval-s"
+                ),
             )
             federation.start()
         frontend = FleetFrontend(
@@ -678,6 +688,125 @@ def cmd_incident(args) -> int:
     return 0
 
 
+def cmd_watch(args) -> int:
+    """Live-tail a server's telemetry bus over the /watch WebSocket
+    (observability/stream.py): flight events, sealed metrics windows,
+    SLO transitions, flame-window seals, and bundle announcements as
+    they happen — no polling. --cursor resumes a stream past an
+    already-seen seq (the federation's cursor vocabulary), --names
+    prefix-filters, and heartbeats keep quiet streams distinguishable
+    from dead servers."""
+    from janusgraph_tpu.driver.client import WatchSession
+
+    subscribe = {"name": "cli-watch"}
+    if args.streams:
+        subscribe["streams"] = [
+            s.strip() for s in args.streams.split(",") if s.strip()
+        ]
+    if args.names:
+        subscribe["names"] = [
+            s.strip() for s in args.names.split(",") if s.strip()
+        ]
+    if args.cursor:
+        cursors = {}
+        for pair in args.cursor:
+            stream, _, seq = pair.partition("=")
+            try:
+                cursors[stream] = int(seq)
+            except ValueError:
+                print(f"bad --cursor {pair!r} (want stream=seq)",
+                      file=sys.stderr)
+                return 2
+        subscribe["cursors"] = cursors
+    if args.heartbeat:
+        subscribe["heartbeat_s"] = args.heartbeat
+    try:
+        session = WatchSession(
+            args.url, subscribe=subscribe, connect_timeout_s=5.0
+        )
+    except (OSError, ConnectionError) as e:
+        print(f"connect failed: {e}", file=sys.stderr)
+        return 1
+    seen = 0
+    try:
+        while True:
+            try:
+                frame = session.recv(timeout=2.0)
+            except ConnectionError as e:
+                print(f"stream closed: {e}", file=sys.stderr)
+                return 1
+            if frame is None:
+                continue
+            if args.json:
+                print(json.dumps(frame, default=str))
+                sys.stdout.flush()
+            else:
+                kind = frame.get("type")
+                if kind == "hello":
+                    print(f"# watching {frame.get('replica') or '-'}  "
+                          f"streams={','.join(frame.get('streams', []))}  "
+                          f"cursors={frame.get('cursors')}",
+                          file=sys.stderr)
+                elif kind == "heartbeat":
+                    if args.heartbeats:
+                        print(f"# heartbeat dropped={frame.get('dropped')}",
+                              file=sys.stderr)
+                elif kind == "event":
+                    data = frame.get("data") or {}
+                    detail = (
+                        data.get("category")
+                        or f"window counters={len(data.get('counters') or {})}"
+                        f" series={len(data.get('series') or {})}"
+                    )
+                    extra = data.get("action") or data.get("kind") or ""
+                    print(f"[{frame.get('stream'):>7} "
+                          f"#{frame.get('seq')}] {detail}"
+                          + (f":{extra}" if extra else ""))
+                    sys.stdout.flush()
+                else:
+                    print(json.dumps(frame, default=str), file=sys.stderr)
+            if frame.get("type") == "event":
+                seen += 1
+                if args.count and seen >= args.count:
+                    return 0
+    except KeyboardInterrupt:
+        return 0
+    finally:
+        session.close()
+
+
+def cmd_fleet_bundles(args) -> int:
+    """List or fetch forensics bundles a fleet frontend shipped
+    off-host (GET /fleet/bundles): bundles announced on each replica's
+    telemetry bus are retained at the frontend, so a dead replica's
+    evidence is still retrievable here."""
+    import urllib.request
+
+    base = args.url.rstrip("/")
+    if not base.startswith("http"):
+        base = "http://" + base
+    url = base + "/fleet/bundles"
+    if args.replica:
+        url += f"?replica={args.replica}&i={args.index}"
+    with urllib.request.urlopen(url, timeout=10) as resp:
+        payload = json.loads(resp.read().decode("utf-8"))
+    if args.replica or args.json:
+        print(json.dumps(payload, indent=2, default=str))
+        return 0
+    rows = payload.get("bundles", [])
+    push = payload.get("push", {})
+    print(f"shipped bundles: {len(rows)}  "
+          f"(fetched={payload.get('fetched')} "
+          f"rate-limited-skips={payload.get('rate_skipped')}  "
+          f"push channels={len(push.get('channels') or {})})")
+    for b in rows:
+        print(f"  {b.get('replica'):>10}  "
+              f"reason={b.get('reason') or '-'}  "
+              f"path={b.get('path') or '-'}  "
+              f"fetched_at={b.get('fetched_at')}")
+    return 0
+
+
 def cmd_timeline(args) -> int:
     """Render one retained OLAP run to Chrome-trace (catapult) JSON —
     load the output in chrome://tracing or ui.perfetto.dev to see
@@ -1090,6 +1219,58 @@ def main(argv=None) -> int:
         help="print only the last N merged events (0 = all)",
     )
     pin.set_defaults(fn=cmd_incident)
+
+    pw = sub.add_parser(
+        "watch",
+        help="live-tail a server's telemetry bus (/watch WebSocket)",
+    )
+    pw.add_argument(
+        "--url", required=True, help="server base URL (host:port)",
+    )
+    pw.add_argument(
+        "--streams",
+        help="comma-separated streams (flight,window,slo,flame,bundle; "
+             "default all)",
+    )
+    pw.add_argument(
+        "--names",
+        help="comma-separated name/category prefixes to filter on",
+    )
+    pw.add_argument(
+        "--cursor", action="append", default=[],
+        metavar="STREAM=SEQ",
+        help="resume a stream past an already-seen seq (repeatable)",
+    )
+    pw.add_argument(
+        "--heartbeat", type=float, default=0.0,
+        help="requested heartbeat cadence in seconds (0 = server default)",
+    )
+    pw.add_argument("--count", type=int, default=0,
+                    help="exit after N events (0 = run until interrupted)")
+    pw.add_argument("--json", action="store_true",
+                    help="print raw protocol frames as JSON lines")
+    pw.add_argument("--heartbeats", action="store_true",
+                    help="also print heartbeat frames (compact mode)")
+    pw.set_defaults(fn=cmd_watch)
+
+    pfb = sub.add_parser(
+        "fleet-bundles",
+        help="forensics bundles shipped off-host to a fleet frontend "
+             "(/fleet/bundles)",
+    )
+    pfb.add_argument(
+        "--url", required=True,
+        help="fleet frontend base URL (host:port)",
+    )
+    pfb.add_argument("--replica",
+                     help="fetch one replica's full bundle body")
+    pfb.add_argument(
+        "--index", type=int, default=-1,
+        help="which of the replica's retained bundles (-1 = newest)",
+    )
+    pfb.add_argument("--json", action="store_true",
+                     help="print the raw listing payload")
+    pfb.set_defaults(fn=cmd_fleet_bundles)
 
     pbd = sub.add_parser(
         "benchdiff",

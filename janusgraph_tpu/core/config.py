@@ -1468,6 +1468,23 @@ METRICS_NS.option(
     "turn the forensics plane into its own I/O incident)", 30.0,
     Mutability.LOCAL, lambda v: v >= 0,
 )
+# ---- streaming telemetry bus (push transport) ---------------------------
+METRICS_NS.option(
+    "stream-depth", int,
+    "per-subscriber queue depth on the telemetry bus "
+    "(observability/stream.py): events past it DROP-OLDEST into the "
+    "subscriber's dropped counter — a slow /watch client or push peer "
+    "costs itself data, never stalls a producer (graphlint JG113)",
+    256, Mutability.LOCAL, lambda v: v >= 1,
+)
+METRICS_NS.option(
+    "stream-heartbeat-s", float,
+    "default idle-gap heartbeat cadence on /watch sessions (the client "
+    "may request its own, clamped to [0.2, 30]); heartbeats carry the "
+    "subscriber's drop counter so a quiet stream and a dead peer are "
+    "distinguishable", 5.0,
+    Mutability.LOCAL, lambda v: 0.2 <= v <= 30.0,
+)
 
 
 # ---- overload defense: admission control, deadlines, retry budgets ------
@@ -1685,6 +1702,34 @@ SERVER_NS.option(
     "replica costs one bounded wait and a partial:true window, never "
     "a hung scraper)", 2.0,
     Mutability.LOCAL, lambda v: v > 0,
+)
+SERVER_NS.option(
+    "fleet.push-enabled", bool,
+    "negotiate the push-mode federation transport: replicas whose "
+    "/watch/info advertises the capability stream sealed windows and "
+    "flight events over a /watch subscription instead of being "
+    "scraped each tick; peers without it keep the exact poll-mode "
+    "scrape path byte-compatibly (observability/federation.py)",
+    True, Mutability.LOCAL,
+)
+SERVER_NS.option(
+    "fleet.push-ship-bundles", bool,
+    "fetch forensics bundles announced on a pushed replica's bundle "
+    "stream into the frontend's fleet store, so a replica's evidence "
+    "survives its death (served at /fleet/bundles)", True,
+    Mutability.LOCAL,
+)
+SERVER_NS.option(
+    "fleet.push-bundle-retention", int,
+    "shipped bundles the frontend's fleet store retains fleet-wide "
+    "(oldest dropped first)", 16,
+    Mutability.LOCAL, lambda v: v >= 1,
+)
+SERVER_NS.option(
+    "fleet.push-bundle-min-interval-s", float,
+    "per-replica rate bound between off-host bundle fetches (a bundle "
+    "storm on one replica must not monopolize the frontend)", 5.0,
+    Mutability.LOCAL, lambda v: v >= 0,
 )
 SERVER_NS.option(
     "deadline.propagation", bool,

@@ -432,6 +432,12 @@ class JanusGraphTPU:
             retention=cfg.get("metrics.bundle-retention"),
             min_interval_s=cfg.get("metrics.bundle-min-interval-s"),
         )
+        # streaming telemetry bus sizing (observability/stream.py): the
+        # bus itself is passive — it taps sources lazily on the first
+        # subscribe, so configuring costs nothing without subscribers
+        from janusgraph_tpu.observability import telemetry_bus as _bus
+
+        _bus.configure(depth=cfg.get("metrics.stream-depth"))
         # price-book persistence (computer.price-book-path, defaulting
         # next to the autotune record): warm-start the OLTP shape table
         # so spillover promotion and admission pricing survive restarts

@@ -522,3 +522,126 @@ class WebSocketSession:
                 raise ConnectionError("connection closed")
             buf += chunk
         return buf
+
+
+class WatchSession:
+    """Client half of the server's ``/watch`` live-telemetry stream.
+
+    Speaks the telemetry bus's wire protocol (server/server.py
+    ``_watch_stream``): connect, upgrade, send ONE masked subscribe
+    frame, then :meth:`recv` parsed ``hello`` / ``event`` /
+    ``heartbeat`` frames until the peer closes.  Used by
+    ``janusgraph_tpu watch`` (live tail) and the fleet federation's
+    push-mode transport (observability/federation.py), which is why the
+    constructor takes a URL rather than a JanusGraphClient — the
+    federation addresses replicas by their registered base URLs.
+
+    ``recv(timeout)`` returns the next frame dict, or None when the
+    timeout elapses with nothing queued (callers poll their stop flags
+    on that cadence — the JG208 discipline: no unbounded blocking
+    reads), and raises ``ConnectionError`` when the peer is gone.
+    """
+
+    def __init__(
+        self,
+        url: str,
+        subscribe: Optional[dict] = None,
+        connect_timeout_s: float = 5.0,
+    ):
+        from urllib.parse import urlsplit
+
+        parts = urlsplit(url if "//" in url else "//" + url)
+        host = parts.hostname or "localhost"
+        port = parts.port or 80
+        self.url = url
+        # bounded CONNECT (JG208), like WebSocketSession
+        self.sock = socket.create_connection(
+            (host, port), timeout=connect_timeout_s
+        )
+        key = base64.b64encode(os.urandom(16)).decode()
+        handshake = (
+            f"GET /watch HTTP/1.1\r\n"
+            f"Host: {host}:{port}\r\n"
+            f"Upgrade: websocket\r\nConnection: Upgrade\r\n"
+            f"Sec-WebSocket-Key: {key}\r\n"
+            f"Sec-WebSocket-Version: 13\r\n\r\n"
+        )
+        self.sock.sendall(handshake.encode())
+        buf = b""
+        while b"\r\n\r\n" not in buf:
+            chunk = self.sock.recv(4096)
+            if not chunk:
+                raise ConnectionError("watch handshake failed")
+            buf += chunk
+        status_line = buf.split(b"\r\n", 1)[0].decode()
+        if " 101 " not in status_line:
+            raise ConnectionError(f"watch upgrade rejected: {status_line}")
+        self._send(json.dumps(subscribe or {}))
+
+    def recv(self, timeout: Optional[float] = None) -> Optional[dict]:
+        """Next protocol frame as a dict; None on timeout, raises
+        ``ConnectionError`` on close/EOF.  The poll timeout applies to
+        the frame HEADER only — once a header lands, the body is read
+        under a fixed generous bound, and a mid-frame stall is a dead
+        peer (abandoning mid-frame would desync the stream)."""
+        self.sock.settimeout(timeout)
+        try:
+            hdr = self._read_exact(2)
+        except (socket.timeout, TimeoutError):
+            return None
+        self.sock.settimeout(max(10.0, timeout or 0.0))
+        try:
+            text = self._recv_body(hdr)
+        except (socket.timeout, TimeoutError):
+            raise ConnectionError("peer stalled mid-frame") from None
+        try:
+            return json.loads(text)
+        except ValueError as e:
+            raise ConnectionError(f"undecodable watch frame: {e}") from None
+
+    def close(self) -> None:
+        try:
+            self.sock.sendall(b"\x88\x80" + os.urandom(4))  # masked close
+        except OSError:
+            pass
+        self.sock.close()
+
+    # client frames MUST be masked per RFC6455 (same codec shape as
+    # WebSocketSession; duplicated rather than shared because the two
+    # sessions have different timeout disciplines on the same calls)
+    def _send(self, text: str) -> None:
+        payload = text.encode()
+        mask = os.urandom(4)
+        masked = bytes(c ^ mask[i % 4] for i, c in enumerate(payload))
+        n = len(payload)
+        hdr = bytearray([0x81])
+        if n < 126:
+            hdr.append(0x80 | n)
+        elif n < (1 << 16):
+            hdr.append(0x80 | 126)
+            hdr += struct.pack(">H", n)
+        else:
+            hdr.append(0x80 | 127)
+            hdr += struct.pack(">Q", n)
+        self.sock.sendall(bytes(hdr) + mask + masked)
+
+    def _recv_body(self, hdr: bytes) -> str:
+        b1, b2 = hdr
+        length = b2 & 0x7F
+        if length == 126:
+            (length,) = struct.unpack(">H", self._read_exact(2))
+        elif length == 127:
+            (length,) = struct.unpack(">Q", self._read_exact(8))
+        payload = self._read_exact(length)
+        if (b1 & 0x0F) == 0x8:
+            raise ConnectionError("server closed")
+        return payload.decode()
+
+    def _read_exact(self, n: int) -> bytes:
+        buf = b""
+        while len(buf) < n:
+            chunk = self.sock.recv(n - len(buf))
+            if not chunk:
+                raise ConnectionError("connection closed")
+            buf += chunk
+        return buf

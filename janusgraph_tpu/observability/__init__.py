@@ -46,6 +46,7 @@ from janusgraph_tpu.observability.exposition import (
 )
 from janusgraph_tpu.observability.federation import (
     ClockOffsets,
+    FleetBundleStore,
     FleetFederation,
     FleetHistory,
     fleet_default_specs,
@@ -93,6 +94,12 @@ from janusgraph_tpu.observability.spans import (
     capture_scope,
     tracer,
 )
+from janusgraph_tpu.observability.stream import (
+    STREAMS,
+    Subscription,
+    TelemetryBus,
+    telemetry_bus,
+)
 from janusgraph_tpu.observability.timeline import (
     chrome_trace,
     render_run,
@@ -133,6 +140,7 @@ __all__ = [
     "ClockOffsets",
     "Counter",
     "DigestTable",
+    "FleetBundleStore",
     "FleetFederation",
     "FleetHistory",
     "FlightRecorder",
@@ -143,10 +151,13 @@ __all__ = [
     "ResourceLedger",
     "SLOEngine",
     "SLOSpec",
+    "STREAMS",
     "SamplingProfiler",
     "Span",
     "StallWatchdog",
     "StructuredLogger",
+    "Subscription",
+    "TelemetryBus",
     "TelemetryRegistry",
     "Timer",
     "TraceContext",
@@ -178,6 +189,7 @@ __all__ = [
     "set_replica",
     "slo_engine",
     "span",
+    "telemetry_bus",
     "tracer",
     "watchdog",
 ]

@@ -105,6 +105,14 @@ HEADLINES: Dict[str, List[Tuple[str, str]]] = {
     # (stall onset -> lock_convoy flight event); everything else in the
     # stage is boolean acceptance, not a trend
     "fleet_stall_forensics": [("detect_ms", LOWER)],
+    # PR 20: streaming telemetry — push-mode event freshness first (the
+    # latency collapse push exists for), then the bus's own CPU bill;
+    # loss/duplication in the stage are boolean acceptance, not trends
+    "fleet_push_poll": [
+        ("push_event_p99_ms", LOWER),
+        ("bus_cpu_overhead_pct", LOWER),
+        ("push_vs_poll_speedup", HIGHER),
+    ],
     "multichip_ab": [("superstep_ms", LOWER)],
     "chaos": [("recovery_open_ms", LOWER)],
     "smoke": [],

@@ -175,7 +175,12 @@ class SamplingProfiler:
         self._stop = threading.Event()
         self._started_at = 0.0
         self._last_seal = 0.0
+        self._last_seal_seq = 0
         self._died: Optional[str] = None
+        #: per-seal hooks (the telemetry bus); called AFTER the ring
+        #: lock is released, exceptions swallowed — the same contract
+        #: as MetricsHistory listeners
+        self._seal_listeners: List[Callable[[dict], None]] = []
         # lifetime self-cost (the PR 17 discipline: wall AND cpu,
         # 1-core honest — cpu_pct is against elapsed wall on one core)
         self._overhead_wall_s = 0.0
@@ -284,6 +289,23 @@ class SamplingProfiler:
             folded = len(frames) - (1 if own in frames else 0)
         return folded
 
+    def add_seal_listener(self, fn: Callable[[dict], None]) -> None:
+        """Register a per-seal hook (the streaming telemetry bus);
+        runs on the sealing thread after the flame window lands."""
+        if fn not in self._seal_listeners:
+            self._seal_listeners.append(fn)
+
+    def remove_seal_listener(self, fn) -> None:
+        if fn in self._seal_listeners:
+            self._seal_listeners.remove(fn)
+
+    def last_seal_seq(self) -> int:
+        """Seq of the newest HISTORY-ALIGNED flame seal — the ``flame``
+        stream's cursor position (fallback seals carry seq=-1 and have
+        no stable cursor, so they never advance this)."""
+        with self._lock:
+            return self._last_seal_seq
+
     def _on_history_window(self, window: dict) -> None:
         self.seal_window(seq=int(window.get("seq", -1)))
 
@@ -318,12 +340,20 @@ class SamplingProfiler:
             self._pending_samples = 0
             self._windows_sealed += 1
             self._last_seal = self._clock()
+            if seq > 0:
+                self._last_seal_seq = seq
+            listeners = list(self._seal_listeners)
         from janusgraph_tpu.observability import registry
 
         registry.set_gauge(
             "observability.profiler.overhead_cpu_pct",
             round(self.overhead_cpu_pct(), 4),
         )
+        for fn in listeners:
+            try:
+                fn(window)
+            except Exception:  # noqa: BLE001 - a listener must not kill sealing
+                pass
         return window
 
     # ----------------------------------------------------------- querying
@@ -404,8 +434,10 @@ class SamplingProfiler:
             self._overhead_cpu_s = 0.0
             self._samples = 0
             self._windows_sealed = 0
+            self._last_seal_seq = 0
             self._died = None
             self._started_at = 0.0
+            self._seal_listeners.clear()
 
 
 class InstrumentedLock:

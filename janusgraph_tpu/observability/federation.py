@@ -44,6 +44,20 @@ module is the federation layer that runs IN the fleet frontend process
   ``outlier_factor x`` the fleet median raises a ``replica_outlier``
   flight event and burns the ticket-rung outlier budget.
 
+- **Push-mode transport** (PR 20) — behind a negotiated capability
+  (``GET /watch/info``; a 404 marks the peer POLL-ONLY and keeps the
+  exact scrape path above, byte-compatibly), the frontend opens a
+  ``/watch`` stream per replica and receives sealed windows and flight
+  events at EVENT latency instead of poll latency.  Pushed windows run
+  through the same producer-keyed cursor and the same merge semantics;
+  a dropped stream reconnects with its cursors (resume, no duplicates)
+  and a cursor gap heals with one full ``raw=1`` re-fetch — the same
+  heal the bounded poll tail uses.  Bundle announcements on the stream
+  drive **off-host forensics shipping**: the frontend fetches the
+  episode (rate-bounded, torn-skip) into a :class:`FleetBundleStore`
+  served at ``GET /fleet/bundles``, so a dying replica's forensics
+  survive the replica.
+
 Everything remote is bounded (JG208) and runs outside locks (JG203);
 every wall-clock subtraction here is offset math over event *stamps*,
 marked ``# graphlint: wallclock`` — durations use the monotonic clock
@@ -287,6 +301,214 @@ def fleet_default_specs(
     ]
 
 
+def _default_watch_factory(url: str, subscribe: dict, timeout_s: float):
+    """Open a real ``/watch`` WebSocket against a replica base URL
+    (tests inject a fake factory, the same seam as ``fetch``)."""
+    from janusgraph_tpu.driver.client import WatchSession
+
+    return WatchSession(
+        url, subscribe=subscribe, connect_timeout_s=timeout_s
+    )
+
+
+# ------------------------------------------------------- bundle shipping
+class FleetBundleStore:
+    """Fleet-wide retention of per-replica forensics bundles.
+
+    When a replica's BundleWriter announces an episode on its telemetry
+    bus (a ``bundle`` flight event on the push stream), the frontend
+    fetches the bundle off-host into this bounded ring — so a replica
+    that dies seconds later still has its dying forensics readable at
+    ``GET /fleet/bundles``.  Rate-bounded per replica
+    (``min_interval_s``) and bounded in count (``retention``); an
+    unreadable/torn fetch is skipped and counted, never fatal."""
+
+    def __init__(
+        self,
+        retention: int = 16,
+        min_interval_s: float = 5.0,
+        clock: Callable[[], float] = time.monotonic,
+        wall_clock: Callable[[], float] = time.time,
+    ):
+        self.retention = max(1, int(retention))
+        self.min_interval_s = float(min_interval_s)
+        self._clock = clock
+        self._wall = wall_clock
+        self._lock = threading.Lock()
+        self._ring: deque = deque(maxlen=self.retention)
+        self._last_fetch: Dict[str, float] = {}
+        self.fetched = 0
+        self.skipped = 0
+
+    def should_fetch(self, replica: str) -> bool:
+        """Rate bound: at most one fetch per replica per
+        ``min_interval_s`` (a flapping pager must not turn the frontend
+        into a bundle vacuum)."""
+        with self._lock:
+            now = self._clock()
+            last = self._last_fetch.get(replica)
+            if last is not None and now - last < self.min_interval_s:
+                self.skipped += 1
+                return False
+            self._last_fetch[replica] = now
+            return True
+
+    def add(
+        self, replica: str, reason: str, path: str, bundle: dict
+    ) -> None:
+        with self._lock:
+            self.fetched += 1
+            self._ring.append({
+                "replica": replica,
+                "reason": reason,
+                "path": path,
+                "ts": bundle.get("ts"),
+                "fetched_at": self._wall(),
+                "bundle": bundle,
+            })
+
+    def summaries(self) -> List[dict]:
+        """The ``GET /fleet/bundles`` listing (newest last), bundles
+        themselves elided."""
+        with self._lock:
+            return [
+                {k: v for k, v in b.items() if k != "bundle"}
+                for b in self._ring
+            ]
+
+    def get(self, replica: str = "", index: int = -1) -> Optional[dict]:
+        """One retained bundle (full body): the newest, or ``index``
+        into the (optionally replica-filtered) retained list."""
+        with self._lock:
+            items = [
+                b for b in self._ring
+                if not replica or b["replica"] == replica
+            ]
+        if not items:
+            return None
+        try:
+            return items[index]
+        except IndexError:
+            return None
+
+    def status(self) -> dict:
+        with self._lock:
+            return {
+                "retention": self.retention,
+                "min_interval_s": self.min_interval_s,
+                "retained": len(self._ring),
+                "fetched": self.fetched,
+                "rate_skipped": self.skipped,
+            }
+
+
+# ------------------------------------------------------------ push channel
+class _PushChannel:
+    """One replica's live ``/watch`` subscription on the frontend.
+
+    A reader thread drains the stream: windows buffer for the next
+    :meth:`FleetFederation.tick` merge (same cursor pipeline as poll),
+    flight events feed the freshness timer and bundle shipping the
+    moment they arrive — the latency collapse push mode exists for.
+    The session object only needs ``recv(timeout) -> frame|None`` and
+    ``close()`` (injectable via ``watch_factory`` for tests)."""
+
+    def __init__(self, federation, name: str, url: str, producer: str, session):
+        self.federation = federation
+        self.name = name
+        self.url = url
+        self.producer = producer
+        self.session = session
+        self._lock = threading.Lock()
+        self._connected = True
+        self._windows: List[dict] = []
+        self.events_seen = 0
+        self.windows_seen = 0
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> None:
+        if self._thread is not None:
+            return
+        self._thread = threading.Thread(
+            target=self._run, daemon=True,
+            name="fleet-push-%s" % self.name,
+        )
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        try:
+            self.session.close()
+        except Exception:  # noqa: BLE001 - teardown must not raise
+            pass
+        t = self._thread
+        if t is not None and t is not threading.current_thread():
+            t.join(timeout=2.0)
+        self._thread = None
+
+    @property
+    def connected(self) -> bool:
+        with self._lock:
+            return self._connected
+
+    def _run(self) -> None:
+        while not self._stop.is_set():
+            try:
+                frame = self.session.recv(timeout=1.0)
+                if frame is None:
+                    continue
+                self._handle(frame)
+            except Exception as e:  # noqa: BLE001 - record before dying (JG112)
+                if not self._stop.is_set():
+                    from janusgraph_tpu.observability.flight import (
+                        recorder,
+                    )
+
+                    recorder.record(
+                        "thread_error",
+                        thread="fleet-push-%s" % self.name,
+                        error=repr(e),
+                    )
+                with self._lock:
+                    self._connected = False
+                return
+
+    def _handle(self, frame: dict) -> None:
+        if not isinstance(frame, dict) or frame.get("type") != "event":
+            return  # hello / heartbeat
+        stream = frame.get("stream")
+        data = frame.get("data")
+        if not isinstance(data, dict):
+            return
+        if stream == "window":
+            with self._lock:
+                self._windows.append(data)
+                self.windows_seen += 1
+        elif stream == "flight":
+            with self._lock:
+                self.events_seen += 1
+            self.federation._on_push_event(self, data)
+
+    def take_windows(self) -> List[dict]:
+        """Drain the buffered windows for this tick's merge."""
+        with self._lock:
+            ws = self._windows
+            self._windows = []
+            return ws
+
+    def state(self) -> dict:
+        with self._lock:
+            return {
+                "replica": self.name,
+                "producer": self.producer,
+                "connected": self._connected,
+                "windows_seen": self.windows_seen,
+                "events_seen": self.events_seen,
+                "buffered": len(self._windows),
+            }
+
+
 # ------------------------------------------------------------- the federator
 class FleetFederation:
     """The fleet frontend's scrape-merge-evaluate loop over a
@@ -311,6 +533,12 @@ class FleetFederation:
         outlier_min_count: int = 20,
         scrape_window: int = 8,
         slo_specs: Optional[List[SLOSpec]] = None,
+        push_enabled: bool = False,
+        watch_factory=None,
+        ship_bundles: bool = True,
+        bundle_retention: int = 16,
+        bundle_min_interval_s: float = 5.0,
+        watchdog=None,
     ):
         from janusgraph_tpu.server.fleet import _default_fetch
 
@@ -320,6 +548,23 @@ class FleetFederation:
         self._fetch = fetch or _default_fetch
         self._clock = clock
         self._wall = wall_clock
+        #: negotiated streaming transport (PR 20): when on, replicas
+        #: that answer /watch/info get a push channel; refusals are
+        #: poll-only peers on the exact PR 17 scrape path
+        self.push_enabled = bool(push_enabled)
+        self._watch_factory = watch_factory or _default_watch_factory
+        self.ship_bundles = bool(ship_bundles)
+        self.bundles = FleetBundleStore(
+            retention=bundle_retention,
+            min_interval_s=bundle_min_interval_s,
+            clock=clock, wall_clock=wall_clock,
+        )
+        self._watchdog = watchdog
+        self._push: Dict[str, _PushChannel] = {}
+        self._push_refused: set = set()
+        #: per-producer last pushed flight seq (reconnect resume cursor)
+        self._flight_seq: Dict[str, int] = {}
+        self._tick_count = 0
         self.outlier_metric = outlier_metric
         self.outlier_factor = float(outlier_factor)
         self.outlier_min_count = int(outlier_min_count)
@@ -387,6 +632,14 @@ class FleetFederation:
                 missing.append(name)
             else:
                 live.append((name, target["url"]))
+        # push transport first: replicas with a live channel are served
+        # from their pushed-window buffer and SKIP the HTTP scrape
+        # entirely; everyone else (poll-only peers, refused capability,
+        # dropped channels this tick) takes the PR 17 poll path below
+        push_served: Dict[str, _PushChannel] = {}
+        if self.push_enabled:
+            push_served = self._push_tick(live)
+            live = [(n, u) for n, u in live if n not in push_served]
         # fetches run in parallel — the tick's wall cost is the slowest
         # replica, not the sum. Each fetch measures its own RTT (offset
         # estimation) on the monotonic clock.
@@ -427,7 +680,7 @@ class FleetFederation:
             for th in threads:
                 th.join(timeout=self.timeout_s * 2 + 1.0)
         fetch_cpu_s = 0.0
-        for name, _url in live:
+        for name, url in live:
             got, cpu_s = results.get(name) or (None, 0.0)
             fetch_cpu_s += cpu_s
             payload = got[2] if got else None
@@ -459,10 +712,39 @@ class FleetFederation:
             if fresh:
                 if cursor > 0 and int(fresh[0].get("seq", 0)) > cursor + 1:
                     # the bounded tail didn't reach back to the cursor:
-                    # producer windows were lost between scrapes
+                    # heal with ONE full-backlog re-fetch instead of
+                    # letting the gap count grow tick after tick
                     registry.counter(
                         "fleet.federation.cursor_gaps"
                     ).inc()
+                    healed = self._heal_cursor(url, cursor)
+                    if healed:
+                        fresh = healed
+                with self._lock:
+                    self._last_seq[producer] = int(fresh[-1]["seq"])
+            contributed[name] = fresh
+        # push-served replicas: merge their buffered pushed windows
+        # through the SAME producer-keyed cursor (shared producers
+        # still count once) and the same gap heal
+        for name, channel in sorted(push_served.items()):
+            producer = channel.producer
+            self._bootstrapped.add(name)
+            with self._lock:
+                cursor = self._last_seq.get(producer, 0)
+            fresh = [
+                w for w in channel.take_windows()
+                if isinstance(w, dict) and int(w.get("seq", 0)) > cursor
+            ]
+            if fresh:
+                if cursor > 0 and int(fresh[0].get("seq", 0)) > cursor + 1:
+                    # the bus dropped oldest under backpressure: same
+                    # gap, same heal — the poll path never went away
+                    registry.counter(
+                        "fleet.federation.cursor_gaps"
+                    ).inc()
+                    healed = self._heal_cursor(channel.url, cursor)
+                    if healed:
+                        fresh = healed
                 with self._lock:
                     self._last_seq[producer] = int(fresh[-1]["seq"])
             contributed[name] = fresh
@@ -516,7 +798,207 @@ class FleetFederation:
         # append last: listeners (the fleet SLO engine) see a window
         # whose overhead accounting is already on the books
         self.history.append(window)
+        with self._lock:
+            # watchdog progress advances only when a tick COMPLETES —
+            # a tick wedged mid-scrape freezes this and fires a stall
+            self._tick_count += 1
         return window
+
+    def _heal_cursor(self, url: str, cursor: int) -> Optional[List[dict]]:
+        """One full-backlog ``raw=1`` re-fetch after a cursor gap (the
+        bounded tail or a drop-oldest push stream didn't reach back to
+        the cursor).  Returns the fresh windows past the cursor, or
+        None when the heal itself failed (the gap stands, counted
+        once — not per tick)."""
+        from janusgraph_tpu.observability import registry
+
+        try:
+            payload = self._fetch(url + "/timeseries?raw=1", self.timeout_s)
+        except Exception:  # noqa: BLE001 - a failed heal is a counted no-op
+            registry.counter("fleet.federation.cursor_heal_failures").inc()
+            return None
+        if not isinstance(payload, dict) or "windows" not in payload:
+            registry.counter("fleet.federation.cursor_heal_failures").inc()
+            return None
+        registry.counter("fleet.federation.cursor_heals").inc()
+        return [
+            w for w in payload["windows"]
+            if isinstance(w, dict) and int(w.get("seq", 0)) > cursor
+        ]
+
+    # ------------------------------------------------------ push transport
+    def _push_tick(self, live: List[tuple]) -> Dict[str, _PushChannel]:
+        """Maintain push channels for this tick: drop dead streams
+        (flighted, and renegotiated with resume cursors in the same
+        pass), negotiate with replicas not yet refused, and return the
+        channels serving this tick."""
+        from janusgraph_tpu.observability import flight_recorder, registry
+
+        live_names = {n for n, _ in live}
+        for name in list(self._push):
+            channel = self._push[name]
+            if name not in live_names or not channel.connected:
+                channel.stop()
+                del self._push[name]
+                flight_recorder.record(
+                    "fleet", action="push_lost", replica=name
+                )
+                registry.counter("fleet.federation.push_lost").inc()
+        served: Dict[str, _PushChannel] = {}
+        for name, url in live:
+            channel = self._push.get(name)
+            if channel is None and name not in self._push_refused:
+                channel = self._open_push(name, url)
+            if channel is not None:
+                served[name] = channel
+        registry.set_gauge(
+            "fleet.federation.push_channels", float(len(served))
+        )
+        return served
+
+    def _open_push(self, name: str, url: str) -> Optional[_PushChannel]:
+        """Negotiate the streaming capability with one replica and open
+        its push channel.  A capability miss (a REPLY without the
+        ``watch`` bit — a PR 17 peer's 404 body) marks the peer
+        POLL-ONLY, terminally: the feature-bit discipline keeps it on
+        the exact PR 17 scrape path from here on.  A transport failure
+        (connection refused, timeout — the probe never got an answer)
+        is NOT a refusal: a replica mid-restart must renegotiate when
+        it comes back, so it retries next tick."""
+        from janusgraph_tpu.observability import flight_recorder, registry
+
+        send_wall = self._wall()
+        m0 = self._clock()
+        try:
+            info = self._fetch(url + "/watch/info", self.timeout_s)
+        except Exception:  # noqa: BLE001 - unanswered probe: retry next tick
+            registry.counter(
+                "fleet.federation.push_connect_failures"
+            ).inc()
+            return None
+        if not isinstance(info, dict) or not info.get("watch"):
+            self._push_refused.add(name)
+            registry.counter("fleet.federation.push_refused").inc()
+            return None
+        rtt_s = self._clock() - m0
+        peer_wall = info.get("now")
+        if isinstance(peer_wall, (int, float)):
+            # the negotiation round-trip doubles as the NTP-style
+            # offset probe the poll path gets from every scrape
+            self.offsets.observe(name, send_wall, rtt_s, float(peer_wall))
+        producer = str(info.get("replica") or "") or name
+        with self._lock:
+            cursors = {"window": int(self._last_seq.get(producer, 0))}
+            flight_cursor = self._flight_seq.get(producer)
+        if flight_cursor is not None:
+            # reconnect: resume the flight stream past what we saw
+            cursors["flight"] = int(flight_cursor)
+        subscribe = {
+            "streams": ["window", "flight"],
+            "cursors": cursors,
+            "heartbeat_s": max(0.5, min(self.interval_s, 2.0)),
+            "name": "fleet-federation",
+        }
+        try:
+            session = self._watch_factory(url, subscribe, self.timeout_s)
+        except Exception:  # noqa: BLE001 - transport failure: retry next tick
+            registry.counter(
+                "fleet.federation.push_connect_failures"
+            ).inc()
+            return None
+        channel = _PushChannel(self, name, url, producer, session)
+        channel.start()
+        self._push[name] = channel
+        registry.counter("fleet.federation.push_negotiated").inc()
+        flight_recorder.record(
+            "fleet", action="push_on", replica=name, producer=producer
+        )
+        return channel
+
+    def _on_push_event(self, channel: _PushChannel, event: dict) -> None:
+        """A flight event arrived on a push stream (reader thread):
+        advance the resume cursor, account the event→frontend freshness
+        lag, and ship announced bundles off-host."""
+        from janusgraph_tpu.observability import registry
+
+        producer = str(event.get("replica") or "") or channel.producer
+        seq = int(event.get("seq", 0))
+        with self._lock:
+            if seq > self._flight_seq.get(producer, 0):
+                self._flight_seq[producer] = seq
+        ts = event.get("ts")
+        if isinstance(ts, (int, float)):
+            lag_s = self._wall() - self.offsets.correct(producer, float(ts))  # graphlint: wallclock -- freshness lag over offset-corrected stamps; the quantity push mode exists to shrink
+            registry.timer("fleet.federation.push_event_lag").update(
+                int(max(0.0, lag_s) * 1e9)
+            )
+        if str(event.get("category", "")) == "bundle":
+            self._ship_bundle(channel, event)
+
+    def _ship_bundle(self, channel: _PushChannel, event: dict) -> None:
+        """Fetch an announced forensics bundle off-host (rate-bounded
+        per replica, torn/unparseable fetches skipped and counted)."""
+        from janusgraph_tpu.observability import registry
+
+        if not self.ship_bundles:
+            return
+        replica = str(event.get("replica") or "") or channel.producer
+        if not self.bundles.should_fetch(replica):
+            registry.counter("fleet.federation.bundle_rate_limited").inc()
+            return
+        try:
+            payload = self._fetch(
+                channel.url + "/debug/bundle", self.timeout_s
+            )
+        except Exception:  # noqa: BLE001 - a lost bundle is counted, not fatal
+            payload = None
+        # GET /debug/bundle returns the bundle dict DIRECTLY (with its
+        # on-disk "path" folded in) — a 404/error body carries "status"
+        # instead, and a torn reply is not a dict at all
+        bundle = (
+            payload
+            if isinstance(payload, dict) and "status" not in payload
+            else None
+        )
+        if not isinstance(bundle, dict) or not bundle:
+            # torn-skip: the replica had no readable bundle (or died
+            # mid-reply) — skip it, never poison the store
+            registry.counter(
+                "fleet.federation.bundle_fetch_failures"
+            ).inc()
+            return
+        self.bundles.add(
+            replica,
+            reason=str(event.get("reason") or ""),
+            path=str(event.get("path") or ""),
+            bundle=bundle,
+        )
+        registry.counter("fleet.federation.bundles_shipped").inc()
+
+    def push_status(self) -> dict:
+        """The push-transport block of ``GET /fleet/timeseries`` and
+        the CLI's fleet view."""
+        with self._lock:
+            refused = sorted(self._push_refused)
+            channels = {n: c.state() for n, c in self._push.items()}
+        return {
+            "enabled": self.push_enabled,
+            "channels": channels,
+            "poll_only": refused,
+            "bundles": self.bundles.status(),
+        }
+
+    def _tick_progress(self) -> dict:
+        """Stall-watchdog progress source (auto-registered by
+        :meth:`start`): the loop is active while the thread runs, and
+        progress is completed ticks — a tick wedged in a scrape stops
+        advancing it and fires a ``stall`` flight event."""
+        with self._lock:
+            count = self._tick_count
+        return {
+            "active": 1 if self._thread is not None else 0,
+            "progress": count,
+        }
 
     def _local_deltas(self) -> Dict[str, int]:
         """Window deltas of the frontend process's OWN ``fleet.*``
@@ -613,12 +1095,27 @@ class FleetFederation:
             target=_loop, daemon=True, name="fleet-federation"
         )
         self._thread.start()
+        # the tick loop auto-registers as a watchdog progress source
+        # (no manual wiring): a wedged tick fires a stall event
+        if self._watchdog is None:
+            from janusgraph_tpu.observability.continuous import (
+                watchdog_singleton,
+            )
+
+            self._watchdog = watchdog_singleton()
+        self._watchdog.register_progress(
+            "fleet.federation.tick", self._tick_progress
+        )
 
     def stop(self) -> None:
         self._stop.set()
         if self._thread is not None:
             self._thread.join(timeout=2.0)
             self._thread = None
+        if self._watchdog is not None:
+            self._watchdog.unregister_progress("fleet.federation.tick")
+        for name in list(self._push):
+            self._push.pop(name).stop()
 
     # --------------------------------------------------------- merged views
     def timeseries_view(self, name: str = "", window: int = 0) -> dict:

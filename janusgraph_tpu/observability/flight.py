@@ -54,7 +54,10 @@ Event taxonomy (the ``category`` field):
                     ``rejoin``, ``dead`` (crash detection: probe/connect
                     failures), ``drain``/``drain_begin``/``drain_end``
                     (the graceful path, with handed-off/remaining session
-                    counts), ``warmup`` (snapshot-cache hydration). The
+                    counts), ``warmup`` (snapshot-cache hydration),
+                    ``push_on`` / ``push_lost`` (the federation's
+                    streaming transport negotiated with / lost to a
+                    replica — observability/federation.py push mode). The
                     ``fault`` category's kind field includes the fleet
                     fault kinds ``replica_kill`` / ``replica_restart`` /
                     ``replica_partition``
@@ -140,6 +143,20 @@ class FlightRecorder:
         self.last_dump_path: Optional[str] = None
         self.last_dump_ts: Optional[float] = None
         self._lock = threading.Lock()
+        #: per-event hooks (the telemetry bus); called AFTER the ring
+        #: lock is released, exceptions swallowed — same contract as
+        #: MetricsHistory listeners
+        self._listeners: List = []
+
+    def add_listener(self, fn) -> None:
+        """Register a per-event hook (the streaming telemetry bus);
+        runs on the recording thread after the event lands."""
+        if fn not in self._listeners:
+            self._listeners.append(fn)
+
+    def remove_listener(self, fn) -> None:
+        if fn in self._listeners:
+            self._listeners.remove(fn)
 
     def configure(
         self,
@@ -174,7 +191,20 @@ class FlightRecorder:
             }
             self._ring.append(event)
             self._counts[category] = self._counts.get(category, 0) + 1
+            listeners = list(self._listeners)
+        for fn in listeners:
+            try:
+                fn(event)
+            except Exception:  # noqa: BLE001 - a listener must not kill recording
+                pass
         return event
+
+    @property
+    def last_seq(self) -> int:
+        """Sequence of the newest recorded event — the ``flight``
+        stream's cursor position (``/watch/info``)."""
+        with self._lock:
+            return self._seq
 
     # -------------------------------------------------------------- querying
     def events(self, category: Optional[str] = None) -> List[dict]:
@@ -264,6 +294,7 @@ class FlightRecorder:
             self._dumps = 0
             self.last_dump_path = None
             self.last_dump_ts = None
+            self._listeners.clear()
 
 
 #: process-wide recorder; every producer site appends here and

@@ -232,6 +232,13 @@ class MetricsHistory:
         return window
 
     # ------------------------------------------------------------- querying
+    def last_seq(self) -> int:
+        """Sequence of the newest sealed window — the ``window``
+        stream's cursor position (telemetry bus / ``/watch/info``),
+        the same cursor vocabulary the federation scrape uses."""
+        with self._lock:
+            return self._seq
+
     def windows(self, last: int = 0) -> List[dict]:
         """The most recent ``last`` windows (0 = all retained), oldest
         first."""

@@ -1189,8 +1189,10 @@ class CDCFollower:
         self.cursor: Optional[int] = None
         self.last_applied_epoch = -1
         self.rebootstraps = 0
+        self.pulls = 0
         self._caught_up_at: Optional[float] = None
         self._lock = threading.RLock()
+        self._watchdog_key: Optional[str] = None
 
     # ------------------------------------------------------------ lifecycle
     def bootstrap(self) -> bool:
@@ -1221,6 +1223,11 @@ class CDCFollower:
             self._caught_up_at = self._clock()
             self._adopt()
         registry.counter("fleet.follower.bootstraps").inc()
+        # the stall-watchdog contract (ISSUE 20): every background pull
+        # source auto-registers as a progress source — a serving
+        # follower whose pull counter freezes is a wedged replication
+        # loop, caught without any manual wiring
+        self._register_watchdog()
         flight_recorder.record(
             "fleet", action="follower_bootstrap", replica=self.name,
             epoch=int(epoch), cursor=int(cursor),
@@ -1246,7 +1253,18 @@ class CDCFollower:
         """One replication pull: replay from the cursor, fold the fresh
         records, advance. A ``None`` replay (gap) re-bootstraps. The
         seeded lagging-follower fault skips applying (staleness grows)
-        unless ``force`` — promotion's final catch-up is never skipped."""
+        unless ``force`` — promotion's final catch-up is never skipped.
+
+        The watchdog progress counter advances when the pull COMPLETES
+        (any outcome): a pull wedged inside replay/fold keeps it frozen,
+        which is exactly the stall signal."""
+        try:
+            return self._pull_once(force)
+        finally:
+            with self._lock:
+                self.pulls += 1
+
+    def _pull_once(self, force: bool = False) -> dict:
         from janusgraph_tpu.observability import registry
 
         with self._lock:
@@ -1350,6 +1368,44 @@ class CDCFollower:
             "applied": report.get("applied", 0),
             "ok": report.get("ok", False),
         }
+
+    # -------------------------------------------------------------- watchdog
+    def _register_watchdog(self) -> None:
+        """Idempotent: one progress source per follower identity."""
+        from janusgraph_tpu.observability.continuous import (
+            watchdog_singleton,
+        )
+
+        with self._lock:
+            if self._watchdog_key is not None:
+                return
+            self._watchdog_key = "fleet.cdc.%s" % (self.name or "follower")
+        watchdog_singleton().register_progress(
+            self._watchdog_key, self._progress
+        )
+
+    def unregister_watchdog(self) -> None:
+        from janusgraph_tpu.observability.continuous import (
+            watchdog_singleton,
+        )
+
+        with self._lock:
+            key, self._watchdog_key = self._watchdog_key, None
+        if key is not None:
+            watchdog_singleton().unregister_progress(key)
+
+    def _progress(self) -> dict:
+        """A bootstrapped follower is active replication work; the pull
+        counter advances at the END of every pull (success, gap, or
+        lagging alike), so a pull wedged mid-replay freezes it."""
+        with self._lock:
+            return {
+                "active": (
+                    1 if self.role == "follower" and self.csr is not None
+                    else 0
+                ),
+                "progress": self.pulls,
+            }
 
     # -------------------------------------------------------------- healthz
     def staleness_s(self) -> float:
@@ -1593,6 +1649,33 @@ class FleetFrontend:
                         except ValueError:
                             window_s = 60.0
                         self._json(200, fed.incident(window_s))
+                        return
+                    if parts.path == "/fleet/bundles":
+                        # off-host forensics: bundles announced on the
+                        # telemetry bus and shipped here survive their
+                        # replica's death — ?replica=&i= pulls one full
+                        # bundle, bare path lists the retained summaries
+                        replica = (qs.get("replica") or [""])[0]
+                        if replica:
+                            try:
+                                index = int((qs.get("i") or ["-1"])[0])
+                            except ValueError:
+                                index = -1
+                            got = fed.bundles.get(replica, index)
+                            if got is None:
+                                self._json(404, {"status": {
+                                    "code": 404,
+                                    "message": "no shipped bundle for "
+                                               f"replica {replica!r}",
+                                }})
+                                return
+                            self._json(200, got)
+                            return
+                        self._json(200, {
+                            **fed.bundles.status(),
+                            "push": fed.push_status(),
+                            "bundles": fed.bundles.summaries(),
+                        })
                         return
                 self._json(404, {"status": {"code": 404}})
 

@@ -24,6 +24,7 @@ import base64
 import hashlib
 import json
 import re
+import select
 import struct
 import threading
 import time
@@ -1174,6 +1175,56 @@ class _Handler(BaseHTTPRequestHandler):
             self.end_headers()
             self.wfile.write(body)
             return
+        if self.path.startswith("/debug/profile/diff"):
+            # differential flamegraph between two sealed flame windows:
+            # ?a=&b= are window seqs (negative = index from the newest,
+            # -1 = last), ?top=N bounds the frame list. Output is the
+            # flamediff structure (observability/continuous.py) — per
+            # frame self-sample delta, a-count, b-count — the "what got
+            # slower between these two minutes" question answered
+            # without shipping raw stacks.
+            from urllib.parse import parse_qs, urlsplit
+
+            from janusgraph_tpu.observability import sampling_profiler
+            from janusgraph_tpu.observability.continuous import flamediff
+
+            qs = parse_qs(urlsplit(self.path).query)
+            try:
+                a = int((qs.get("a") or ["-2"])[0])
+                b = int((qs.get("b") or ["-1"])[0])
+                top = int((qs.get("top") or ["50"])[0])
+            except ValueError:
+                self._send_json(400, {"status": {
+                    "code": 400, "message": "a, b, top must be integers",
+                }})
+                return
+            retained = sampling_profiler.windows()
+            by_seq = {w.get("seq"): w for w in retained}
+
+            def _pick(key):
+                if key in by_seq:
+                    return by_seq[key]
+                if key < 0 and -key <= len(retained):
+                    return retained[key]
+                return None
+
+            wa, wb = _pick(a), _pick(b)
+            if wa is None or wb is None:
+                self._send_json(404, {"status": {
+                    "code": 404,
+                    "message": "flame window not retained "
+                               f"(a={a} b={b}; retained "
+                               f"{sorted(k for k in by_seq if k)})",
+                }})
+                return
+            self._send_json(200, {
+                "a": {"seq": wa.get("seq"), "ts": wa.get("ts"),
+                      "samples": wa.get("samples")},
+                "b": {"seq": wb.get("seq"), "ts": wb.get("ts"),
+                      "samples": wb.get("samples")},
+                "frames": flamediff(wa, wb, top=top),
+            })
+            return
         if self.path.startswith("/debug/profile"):
             # the continuous profiler's collapsed-stack flamegraph (the
             # whole process, merged over retained windows; ?window=N
@@ -1243,12 +1294,38 @@ class _Handler(BaseHTTPRequestHandler):
             ).encode("utf-8")
             self._send_json(200, body)
             return
+        if self.path == "/watch/info":
+            # the streaming-transport capability handshake: advertises
+            # the telemetry bus's streams and their CURRENT cursors (the
+            # same producer-keyed vocabulary the federation scrape uses)
+            # so a push-mode peer can negotiate before upgrading, and a
+            # reconnecting subscriber can see what it missed. A peer that
+            # 404s here is poll-only — the federation keeps the exact
+            # scrape path for it. Unauthenticated like /metrics.
+            from janusgraph_tpu.observability import telemetry_bus
+            from janusgraph_tpu.observability.identity import replica_name
+            from janusgraph_tpu.observability.stream import STREAMS
+
+            self._send_json(200, {
+                "watch": True,
+                "streams": list(STREAMS),
+                "cursors": telemetry_bus.cursors(),
+                "replica": self.jg_server.replica_name or replica_name(),
+                "now": time.time(),
+                "subscribers": telemetry_bus.subscriber_count(),
+            })
+            return
         if self.path == "/graphs":
             if not self._auth():
                 return
             self._send_json(
                 200, {"graphs": self.jg_server.manager.graph_names()}
             )
+            return
+        if self.path.startswith("/watch") and (
+            self.headers.get("Upgrade", "").lower() == "websocket"
+        ):
+            self._watch_stream()
             return
         if self.path.startswith("/gremlin") and (
             self.headers.get("Upgrade", "").lower() == "websocket"
@@ -1336,6 +1413,108 @@ class _Handler(BaseHTTPRequestHandler):
         self._send_json(404, {"status": {"code": 404}})
 
     # ------------------------------------------------------------ WebSocket
+    def _watch_stream(self) -> None:
+        """The ``/watch`` live-telemetry WebSocket: the telemetry bus's
+        wire transport (observability/stream.py).
+
+        Protocol: the client's FIRST text frame is the subscribe request
+        ``{"streams": [...], "names": [...], "cursors": {...},
+        "heartbeat_s": N, "name": "..."}`` (all optional; ``categories``
+        is accepted as an alias for ``names``).  The server answers with
+        a ``hello`` frame carrying the replica identity and the bus's
+        CURRENT cursors, then streams ``{"type": "event", "stream",
+        "seq", "data"}`` envelopes; an idle gap longer than
+        ``heartbeat_s`` produces ``{"type": "heartbeat", "ts",
+        "dropped"}`` so the peer can distinguish quiet from dead and
+        watch its drop counter.  Cursors in the subscribe request resume
+        past-tail replay exactly like a federation scrape cursor.
+        Unauthenticated like /metrics — events are operational, never
+        query/data content — and bypasses admission like every
+        observability endpoint."""
+        from janusgraph_tpu.observability import telemetry_bus
+        from janusgraph_tpu.observability.identity import replica_name
+
+        key = self.headers.get("Sec-WebSocket-Key", "")
+        accept = base64.b64encode(
+            hashlib.sha1((key + _WS_MAGIC).encode()).digest()
+        ).decode()
+        self.send_response(101, "Switching Protocols")
+        self.send_header("Upgrade", "websocket")
+        self.send_header("Connection", "Upgrade")
+        self.send_header("Sec-WebSocket-Accept", accept)
+        self.end_headers()
+        # the socket is a WS stream from here on — never hand it back
+        # to the HTTP request parser (covers every exit path below)
+        self.close_connection = True
+        sock = self.connection
+        raw = _ws_recv(sock)
+        if raw is None:
+            return
+        try:
+            req = json.loads(raw)
+            if not isinstance(req, dict):
+                raise ValueError("subscribe frame must be an object")
+        except ValueError as e:
+            _ws_send(sock, json.dumps({
+                "type": "error", "message": f"bad subscribe frame: {e}",
+            }))
+            return
+        heartbeat_s = req.get("heartbeat_s", 5.0)
+        try:
+            heartbeat_s = min(30.0, max(0.2, float(heartbeat_s)))
+        except (TypeError, ValueError):
+            heartbeat_s = 5.0
+        label = str(
+            req.get("name") or "watch-%s" % (self.client_address[0],)
+        )
+        try:
+            sub = telemetry_bus.subscribe(
+                streams=req.get("streams") or None,
+                names=tuple(
+                    req.get("names") or req.get("categories") or ()
+                ),
+                cursors=req.get("cursors") or None,
+                name=label,
+            )
+        except (TypeError, ValueError) as e:
+            _ws_send(sock, json.dumps({
+                "type": "error", "message": str(e),
+            }))
+            return
+        server = self.jg_server
+        try:
+            _ws_send(sock, json.dumps({
+                "type": "hello",
+                "replica": server.replica_name or replica_name(),
+                "streams": sorted(sub.streams),
+                "cursors": telemetry_bus.cursors(),
+                "heartbeat_s": heartbeat_s,
+            }, default=str))
+            while True:
+                envelope = sub.pop(timeout=heartbeat_s)
+                if envelope is None:
+                    if sub.closed:
+                        break
+                    _ws_send(sock, json.dumps({
+                        "type": "heartbeat",
+                        "ts": time.time(),
+                        "dropped": sub.dropped,
+                    }))
+                else:
+                    _ws_send(sock, json.dumps({
+                        "type": "event", **envelope,
+                    }, default=str))
+                # a readable socket mid-stream is the client talking —
+                # a close frame (or EOF) ends the session; pings are
+                # answered inside _ws_recv
+                readable, _, _ = select.select([sock], [], [], 0)
+                if readable and _ws_recv(sock) is None:
+                    break
+        except OSError:
+            pass  # client went away mid-send; unsubscribe below
+        finally:
+            telemetry_bus.unsubscribe(sub)
+
     def _websocket(self) -> None:
         if not self._auth():
             return

@@ -1,0 +1,80 @@
+"""JG113 fixture: blocking / unaccounted queue puts in fan-out loops.
+
+One producer iterating subscriber queues must never block on a slow
+consumer (convoy) and must account every drop: an uncaught queue.Full
+unwinds the loop mid-fan-out and later subscribers silently miss the
+event; a swallowed one hides the drop.
+"""
+
+import queue
+from queue import Full, Queue
+
+
+def publish_blocking_bad(subscribers, event):
+    for sub in subscribers:
+        sub.q.put(event)  # expect: JG113
+
+
+def publish_blocking_kw_true_bad(subscribers, event):
+    for sub in subscribers:
+        sub.q.put(event, block=True)  # expect: JG113
+
+
+def publish_nowait_unguarded_bad(subscribers, event):
+    for sub in subscribers:
+        sub.q.put_nowait(event)  # expect: JG113
+
+
+def publish_nowait_swallowed_bad(subscribers, event):
+    for sub in subscribers:
+        try:
+            sub.q.put_nowait(event)  # expect: JG113
+        except Full:
+            pass  # drop hidden: nothing observable survives
+
+
+def publish_nonblocking_unguarded_bad(subscribers, event):
+    for sub in subscribers:
+        sub.q.put(event, block=False)  # expect: JG113
+
+
+def publish_wrong_guard_bad(subscribers, event):
+    for sub in subscribers:
+        try:
+            sub.q.put_nowait(event)  # expect: JG113
+        except ValueError:
+            # catches the wrong thing: queue.Full still unwinds the loop
+            subscribers.remove(sub)
+
+
+def publish_accounted_good(subscribers, event, dropped):
+    # the contract: never block, and a slow consumer costs itself data
+    for sub in subscribers:
+        try:
+            sub.q.put_nowait(event)
+        except Full:
+            dropped[sub.name] = dropped.get(sub.name, 0) + 1
+
+
+def publish_accounted_qualified_good(subscribers, event, recorder):
+    for sub in subscribers:
+        try:
+            sub.q.put(event, block=False)
+        except queue.Full:
+            recorder.record("stream", "drop", subscriber=sub.name)
+
+
+def publish_bounded_timeout_good(subscribers, event, log):
+    # timeout bounds the wait (convoy priced), Full still accounted
+    for sub in subscribers:
+        try:
+            sub.q.put(event, timeout=0.05)
+        except Full:
+            log.warning("dropped event for %s", sub.name)
+
+
+def single_put_outside_loop_good(q, event):
+    # not a fan-out: one queue, one put — backpressure is the point
+    q = Queue(maxsize=8)
+    q.put(event)
+    return q

@@ -21,6 +21,8 @@ Covers the acceptance list:
 from __future__ import annotations
 
 import json
+import threading
+import time
 
 import pytest
 
@@ -58,6 +60,12 @@ class _Replica:
         )
         self.flight_events = []
         self.unreachable = False
+        #: streaming capability bit served at /watch/info (PR 20)
+        self.watch = False
+        #: canned /debug/bundle body (the bundle dict itself)
+        self.bundle = None
+        #: fail ONLY the full-backlog re-fetch (the heal path)
+        self.fail_full = False
 
     def observe(self, name, ms_values):
         t = self.registry.timer(name)
@@ -72,7 +80,12 @@ class _Replica:
         if self.unreachable:
             raise ConnectionError(f"{self.name} unreachable")
         if path.startswith("/timeseries"):
-            payload = json.loads(json.dumps(self.history.scrape()))
+            if self.fail_full and "window=" not in path:
+                raise ConnectionError(f"{self.name} full scrape torn")
+            last = 0
+            if "window=" in path:
+                last = int(path.split("window=")[1].split("&")[0])
+            payload = json.loads(json.dumps(self.history.scrape(last=last)))
             # a real replica process reports ITS OWN identity; the
             # shared-process default would collapse all synthetic
             # replicas onto one producer cursor
@@ -82,6 +95,16 @@ class _Replica:
             return {"events": [dict(e) for e in self.flight_events]}
         if path.startswith("/telemetry"):
             return {"metrics": self.registry.snapshot()}
+        if path.startswith("/watch/info"):
+            return {
+                "watch": self.watch,
+                "replica": self.name,
+                "now": self.true_wall + self.skew_s,
+                "streams": ["flight", "window", "slo", "flame", "bundle"],
+                "cursors": {},
+            }
+        if path.startswith("/debug/bundle"):
+            return self.bundle
         raise AssertionError(f"unexpected path {path}")
 
 
@@ -515,3 +538,453 @@ class TestScrapePayload:
         # the overhead gauge is refreshed every tick
         _c, _t, _h, gauges = registry.metric_objects()
         assert "fleet.federation.overhead_ms" in gauges
+
+
+# --------------------------------------------- cursor-gap heal (ISSUE 20)
+class TestCursorGapHeal:
+    def _burst(self, rep, n, counter="app.burst"):
+        for _ in range(n):
+            rep.registry.counter(counter).inc()
+            rep.advance()
+            rep.history.sample()
+
+    def test_burst_past_bounded_tail_heals_with_one_full_refetch(self):
+        """A window burst longer than the bounded scrape tail opens a
+        cursor gap: counted once, healed by ONE full-backlog re-fetch,
+        and zero windows are lost from the fleet merge."""
+        from janusgraph_tpu.observability import registry
+
+        rep = _Replica("r0")
+        rep.history.sample()
+        _router, fed = _fleet([rep], scrape_window=2)
+        fed.tick()  # bootstrap: full backlog, cursor lands at seq 1
+        gaps0 = registry.get_count("fleet.federation.cursor_gaps")
+        heals0 = registry.get_count("fleet.federation.cursor_heals")
+        calls0 = len(fed._test_calls)
+        self._burst(rep, 6)  # seqs 2..7 — tail of 2 reaches back to 6
+        fed.tick()
+        assert registry.get_count("fleet.federation.cursor_gaps") == gaps0 + 1
+        assert (
+            registry.get_count("fleet.federation.cursor_heals") == heals0 + 1
+        )
+        # exactly two fetches this tick: the bounded scrape + the heal
+        tick_calls = fed._test_calls[calls0:]
+        assert len(tick_calls) == 2
+        assert "window=2" in tick_calls[0]
+        assert tick_calls[1].endswith("/timeseries?raw=1")
+        # zero lost: every burst increment survived into fleet windows
+        merged = sum(
+            w["counters"].get("app.burst", 0)
+            for w in fed.history.windows()
+        )
+        assert merged == 6
+        # and the cursor is fully caught up — the next tick re-merges
+        # nothing and opens no new gap
+        fed.tick()
+        assert registry.get_count("fleet.federation.cursor_gaps") == gaps0 + 1
+        assert sum(
+            w["counters"].get("app.burst", 0)
+            for w in fed.history.windows()
+        ) == 6
+
+    def test_failed_heal_is_counted_and_the_tail_still_merges(self):
+        """When the heal re-fetch itself fails the gap stands (counted,
+        not retried in-tick) and the bounded tail merges as-is."""
+        from janusgraph_tpu.observability import registry
+
+        rep = _Replica("r0")
+        rep.history.sample()
+        _router, fed = _fleet([rep], scrape_window=2)
+        fed.tick()
+        rep.fail_full = True  # tears ONLY the full-backlog heal fetch
+        fails0 = registry.get_count("fleet.federation.cursor_heal_failures")
+        self._burst(rep, 6)
+        fed.tick()
+        assert (
+            registry.get_count("fleet.federation.cursor_heal_failures")
+            == fails0 + 1
+        )
+        # the tail (2 windows) merged; the 4 gap windows are lost and
+        # that loss is exactly what the gap counter priced
+        merged = sum(
+            w["counters"].get("app.burst", 0)
+            for w in fed.history.windows()
+        )
+        assert merged == 2
+        assert fed._last_seq[rep.name] == rep.history.last_seq()
+
+
+# ------------------------------------------ push transport (ISSUE 20)
+class _FakeWatchSession:
+    """Injectable push channel peer: the test feeds frames, the
+    federation's reader thread drains them.  ``fail=True`` simulates a
+    killed replica (recv raises, the channel records the death)."""
+
+    def __init__(self, url, subscribe):
+        self.url = url
+        self.subscribe = subscribe
+        self.frames = []
+        self._lock = threading.Lock()
+        self.closed = False
+        self.fail = False
+
+    def feed(self, *frames):
+        with self._lock:
+            self.frames.extend(frames)
+
+    def recv(self, timeout=1.0):
+        if self.fail:
+            raise ConnectionError("replica killed mid-stream")
+        with self._lock:
+            if self.frames:
+                return self.frames.pop(0)
+        time.sleep(0.002)
+        return None
+
+    def close(self):
+        self.closed = True
+
+
+def _push_fleet(replicas, **fed_kw):
+    """A push-enabled fleet whose watch sessions are test-fed."""
+    sessions = []
+
+    def factory(url, subscribe, timeout_s):
+        s = _FakeWatchSession(url, subscribe)
+        sessions.append(s)
+        return s
+
+    router, fed = _fleet(
+        replicas, push_enabled=True, watch_factory=factory, **fed_kw
+    )
+    return router, fed, sessions
+
+
+def _wait(pred, timeout=5.0):
+    deadline = time.monotonic() + timeout
+    while not pred():
+        assert time.monotonic() < deadline, "condition never held"
+        time.sleep(0.005)
+
+
+def _app_series(window):
+    """The merged series minus the plane's own self-cost metrics —
+    those time REAL work (sample duration in ns) and differ between
+    otherwise-identical twin replicas."""
+    return {
+        k: v for k, v in window["series"].items()
+        if not k.startswith("observability.")
+    }
+
+
+def _app_by_replica(window):
+    return {
+        k: v for k, v in window["by_replica"].items()
+        if not k.startswith("observability.")
+    }
+
+
+def _window_frames(rep, since=0):
+    return [
+        {"type": "event", "stream": "window", "seq": w["seq"], "data": w}
+        for w in json.loads(json.dumps(rep.history.windows()))
+        if w["seq"] > since
+    ]
+
+
+class TestPushTransport:
+    def test_capable_replica_negotiates_and_windows_merge_identically(self):
+        """Cell 1 of the interop matrix: push frontend x push replica.
+        The replica is served from pushed frames — never scraped — and
+        the merged series are byte-identical to what the PR 17 poll
+        path produces over a twin replica."""
+        from janusgraph_tpu.observability import registry
+
+        def twin(name):
+            rep = _Replica(name)
+            rep.observe("server.request.wall", [4.0, 8.0, 16.0])
+            rep.registry.counter("app.ops").inc(7)
+            rep.history.sample()
+            return rep
+
+        push_rep, poll_rep = twin("r0"), twin("r0")
+        push_rep.watch = True
+        _r1, fed_push, sessions = _push_fleet([push_rep])
+        _r2, fed_poll = _fleet([poll_rep])
+
+        w_poll = fed_poll.tick()
+        fed_push.tick()  # negotiates; nothing buffered yet
+        assert len(sessions) == 1
+        assert sessions[0].subscribe["cursors"] == {"window": 0}
+        assert fed_push.push_status()["channels"]["r0"]["connected"]
+        # the replica was NEVER scraped: only the capability probe ran
+        assert [c for c in fed_push._test_calls if "/timeseries" in c] == []
+        assert registry.get_count("fleet.federation.push_negotiated") >= 1
+
+        sessions[0].feed(*_window_frames(push_rep))
+        channel = fed_push._push["r0"]
+        _wait(lambda: channel.state()["windows_seen"] == 1)
+        w_push = fed_push.tick()
+        # byte-compatible merge: same source windows -> same series
+        assert _app_series(w_push) == _app_series(w_poll)
+        assert _app_by_replica(w_push) == _app_by_replica(w_poll)
+        assert (
+            w_push["counters"]["app.ops"] == w_poll["counters"]["app.ops"]
+        )
+        assert [c for c in fed_push._test_calls if "/timeseries" in c] == []
+
+    def test_poll_only_peer_keeps_the_exact_scrape_path(self):
+        """Cells 2-4: a peer that refuses the capability — and any
+        frontend with push disabled — runs the byte-exact PR 17 poll
+        path: same fetch URLs, same merged windows."""
+        from janusgraph_tpu.observability import registry
+
+        def twin(name):
+            rep = _Replica(name)
+            rep.observe("server.request.wall", [3.0, 9.0])
+            rep.history.sample()
+            return rep
+
+        old_rep, plain_rep = twin("r0"), twin("r0")  # watch=False: poll-only
+        refused0 = registry.get_count("fleet.federation.push_refused")
+        _r1, fed_push, sessions = _push_fleet([old_rep])
+        _r2, fed_poll = _fleet([plain_rep])
+        w1_push, w1_poll = fed_push.tick(), fed_poll.tick()
+        for rep in (old_rep, plain_rep):
+            rep.observe("server.request.wall", [5.0])
+            rep.advance()
+            rep.history.sample()
+        w2_push, w2_poll = fed_push.tick(), fed_poll.tick()
+
+        assert sessions == []  # no channel was ever opened
+        assert registry.get_count(
+            "fleet.federation.push_refused"
+        ) == refused0 + 1
+        assert fed_push.push_status()["poll_only"] == ["r0"]
+        # byte-exact scrape path: identical URLs once the one-shot
+        # capability probe is set aside (and it is never re-probed)
+        push_urls = [
+            c for c in fed_push._test_calls if "/watch/info" not in c
+        ]
+        assert push_urls == fed_poll._test_calls
+        assert sum("/watch/info" in c for c in fed_push._test_calls) == 1
+        for wp, wq in ((w1_push, w1_poll), (w2_push, w2_poll)):
+            assert _app_series(wp) == _app_series(wq)
+            assert _app_by_replica(wp) == _app_by_replica(wq)
+
+    def test_unanswered_probe_is_retried_not_refused(self):
+        """A probe the replica never ANSWERS (mid-restart, network) is
+        a transport failure, not a capability refusal — the peer must
+        renegotiate when it comes back, not be poll-only forever."""
+        from janusgraph_tpu.observability import registry
+
+        rep = _Replica("r0")
+        rep.watch = True
+        rep.history.sample()
+        _router, fed, sessions = _push_fleet([rep])
+        rep.unreachable = True
+        fails0 = registry.get_count(
+            "fleet.federation.push_connect_failures"
+        )
+        fed.tick()
+        assert fed.push_status()["poll_only"] == []
+        assert registry.get_count(
+            "fleet.federation.push_connect_failures"
+        ) == fails0 + 1
+        assert sessions == []
+        rep.unreachable = False
+        fed.tick()  # came back: the capability negotiates NOW
+        assert len(sessions) == 1
+        assert fed.push_status()["channels"]["r0"]["connected"]
+
+    def test_reconnect_resumes_from_cursors_zero_dup_zero_lost(self):
+        """Kill the stream mid-flight: the dropped channel is flighted,
+        renegotiated the SAME tick with resume cursors (window AND
+        flight), and across the kill every window merges exactly once."""
+        from janusgraph_tpu.observability import registry
+
+        rep = _Replica("r0")
+        rep.watch = True
+        rep.registry.counter("app.ops").inc()
+        rep.history.sample()
+        _router, fed, sessions = _push_fleet([rep])
+        fed.tick()
+        sessions[0].feed(*_window_frames(rep))
+        sessions[0].feed({
+            "type": "event", "stream": "flight", "seq": 41,
+            "data": {"seq": 41, "replica": "r0", "ts": rep.true_wall,
+                     "category": "compaction", "action": "start"},
+        })
+        channel = fed._push["r0"]
+        _wait(lambda: channel.state()["windows_seen"] == 1)
+        _wait(lambda: channel.state()["events_seen"] == 1)
+        fed.tick()
+
+        lost0 = registry.get_count("fleet.federation.push_lost")
+        sessions[0].fail = True  # kill: reader thread records the death
+        _wait(lambda: not channel.connected)
+        for _ in range(3):
+            rep.registry.counter("app.ops").inc()
+            rep.advance()
+            rep.history.sample()
+        fed.tick()  # drops the dead channel AND renegotiates, same tick
+        assert registry.get_count("fleet.federation.push_lost") == lost0 + 1
+        assert len(sessions) == 2
+        # resume cursors: past the last merged window and pushed event
+        assert sessions[1].subscribe["cursors"] == {
+            "window": 1, "flight": 41,
+        }
+        sessions[1].feed(*_window_frames(rep, since=1))
+        channel2 = fed._push["r0"]
+        _wait(lambda: channel2.state()["windows_seen"] == 3)
+        fed.tick()
+        # zero dup / zero lost across the kill: 4 increments in, 4 out
+        merged = sum(
+            w["counters"].get("app.ops", 0)
+            for w in fed.history.windows()
+        )
+        assert merged == 4
+        events = [
+            e for e in flight_recorder.snapshot()["events"]
+            if e["category"] == "fleet"
+            and e.get("action") in ("push_on", "push_lost")
+            and e.get("replica") == "r0"
+        ]
+        assert [e["action"] for e in events[-3:]] == [
+            "push_on", "push_lost", "push_on",
+        ]
+
+    def test_bundle_announcement_ships_off_host(self):
+        """A pushed ``bundle`` flight event triggers one rate-bounded
+        off-host fetch; the bundle outlives its replica in the
+        frontend's store and torn replies are skipped, not stored."""
+        from janusgraph_tpu.observability import registry
+
+        rep = _Replica("r0")
+        rep.watch = True
+        rep.bundle = {
+            "reason": "stall", "ts": 1.0, "path": "/tmp/b1.json",
+            "flight": [], "timeseries": [],
+        }
+        rep.history.sample()
+        _router, fed, sessions = _push_fleet([rep])
+        fed.tick()
+
+        def announce(seq):
+            sessions[-1].feed({
+                "type": "event", "stream": "flight", "seq": seq,
+                "data": {"seq": seq, "replica": "r0", "ts": rep.true_wall,
+                         "category": "bundle", "reason": "stall",
+                         "path": "/tmp/b1.json"},
+            })
+
+        shipped0 = registry.get_count("fleet.federation.bundles_shipped")
+        announce(1)
+        _wait(lambda: fed.bundles.get("r0") is not None)
+        got = fed.bundles.get("r0")
+        assert got["bundle"]["reason"] == "stall"
+        assert got["path"] == "/tmp/b1.json"
+        assert registry.get_count(
+            "fleet.federation.bundles_shipped"
+        ) == shipped0 + 1
+        # inside the rate bound: announced again, NOT fetched again
+        announce(2)
+        _wait(lambda: registry.get_count(
+            "fleet.federation.bundle_rate_limited"
+        ) >= 1)
+        assert fed.bundles.status()["fetched"] == 1
+        # past the bound, a torn reply (error body) is skipped-counted
+        fed._test_clock["t"] += 60.0
+        rep.bundle = {"status": 404, "error": "no bundle"}
+        fails0 = registry.get_count("fleet.federation.bundle_fetch_failures")
+        announce(3)
+        _wait(lambda: registry.get_count(
+            "fleet.federation.bundle_fetch_failures"
+        ) == fails0 + 1)
+        assert fed.bundles.status()["fetched"] == 1
+        # the good bundle is still the one retrievable off-host
+        assert fed.bundles.get("r0")["bundle"]["reason"] == "stall"
+
+
+# --------------------------------------- watchdog progress (ISSUE 20)
+class TestWatchdogSources:
+    def test_wedged_federation_tick_fires_stall(self, tmp_path):
+        """start() auto-registers the tick loop as a watchdog progress
+        source; a tick that stops completing (wedged scrape) freezes
+        the counter and fires exactly one edge-triggered stall."""
+        from janusgraph_tpu.observability.continuous import (
+            StallWatchdog, bundle_writer,
+        )
+
+        clk = {"t": 0.0}
+        wd = StallWatchdog(clock=lambda: clk["t"])
+        wd.configure(stall_s=5.0)
+        bundle_writer.configure(directory=str(tmp_path), min_interval_s=0.0)
+        rep = _Replica("r0")
+        rep.history.sample()
+        _router, fed = _fleet([rep], watchdog=wd)
+        fed.start(interval_s=3600.0)  # the loop thread sleeps; we tick
+        try:
+            fed.tick()
+            assert wd.check() == []  # baseline
+            clk["t"] += 3.0
+            fed.tick()
+            assert wd.check() == []  # progress advanced: re-arms
+            clk["t"] += 2.0
+            assert wd.check() == []  # frozen, but under stall_s
+            clk["t"] += 4.0  # 6 s since the last completed tick
+            fired = wd.check()
+            assert [e["category"] for e in fired] == ["stall"]
+            assert fired[0]["source"] == "fleet.federation.tick"
+            assert fired[0]["stuck_s"] >= 5.0
+            # edge-triggered: the same wedge never re-fires
+            clk["t"] += 10.0
+            assert wd.check() == []
+        finally:
+            fed.stop()
+        # stop() unregisters — a stopped fleet is not a stall
+        assert "fleet.federation.tick" not in wd._progress
+
+    def test_cdc_follower_auto_registers_pull_progress(
+        self, tmp_path, monkeypatch
+    ):
+        """bootstrap() self-registers the pull loop with the watchdog
+        singleton (no manual wiring); the progress value advances only
+        when a pull COMPLETES, so a wedged replay freezes it."""
+        from janusgraph_tpu.observability.continuous import (
+            watchdog_singleton,
+        )
+        from janusgraph_tpu.olap import sharded_checkpoint
+        from janusgraph_tpu.server.fleet import CDCFollower
+
+        class _CSR:
+            num_vertices = 3
+            num_edges = 2
+
+        class _Src:
+            def cursor_for_epoch(self, epoch):
+                return 0
+
+            def replay_from(self, cursor):
+                return [], cursor
+
+        monkeypatch.setattr(
+            sharded_checkpoint, "load_csr_checkpoint",
+            lambda d: (_CSR(), 0),
+        )
+        wd = watchdog_singleton()
+        f = CDCFollower(_Src(), str(tmp_path), name="wd-probe")
+        try:
+            assert f.bootstrap()
+            assert "fleet.cdc.wd-probe" in wd._progress
+            p = f._progress()
+            assert p["active"] == 1  # serving follower: active work
+            f.pull()
+            assert f._progress()["progress"] == p["progress"] + 1
+            # promotion flips the role: the source reports inactive
+            # (a leader that stops pulling is not a stall)
+            f.role = "leader"
+            assert f._progress()["active"] == 0
+        finally:
+            f.unregister_watchdog()
+        assert "fleet.cdc.wd-probe" not in wd._progress

@@ -1,0 +1,504 @@
+"""Streaming telemetry bus (ISSUE 20): typed streams, bounded
+drop-oldest subscriber queues, producer-keyed cursor resume, and the
+``/watch`` + ``/watch/info`` + ``/debug/profile/diff`` transport.
+
+Unit tests drive a :class:`TelemetryBus` over injected fake sources
+(deterministic seqs, no threads); endpoint tests reuse the live debug
+server from the continuous-profiling plane and certify the tentpole's
+resume contract end-to-end: reconnect with cursors delivers every
+missed event exactly once — no duplicates, no full re-bootstrap.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from janusgraph_tpu.observability import (
+    flight_recorder,
+    history,
+    registry,
+    sampling_profiler,
+    slo_engine,
+    telemetry_bus,
+    watchdog,
+)
+from janusgraph_tpu.observability.continuous import watchdog_singleton
+from janusgraph_tpu.observability.stream import STREAMS, TelemetryBus
+
+
+# ------------------------------------------------------------ fake sources
+class _FakeRecorder:
+    def __init__(self):
+        self._listeners = []
+        self._events = []
+        self.last_seq = 0
+
+    def add_listener(self, fn):
+        if fn not in self._listeners:
+            self._listeners.append(fn)
+
+    def remove_listener(self, fn):
+        if fn in self._listeners:
+            self._listeners.remove(fn)
+
+    def events(self):
+        return [dict(e) for e in self._events]
+
+    def record(self, category, **fields):
+        self.last_seq += 1
+        ev = {
+            "seq": self.last_seq, "ts": float(self.last_seq),
+            "category": category, **fields,
+        }
+        self._events.append(ev)
+        for fn in list(self._listeners):
+            fn(ev)
+        return ev
+
+
+class _FakeHistory:
+    def __init__(self):
+        self._listeners = []
+        self._windows = []
+
+    def add_listener(self, fn):
+        if fn not in self._listeners:
+            self._listeners.append(fn)
+
+    def remove_listener(self, fn):
+        if fn in self._listeners:
+            self._listeners.remove(fn)
+
+    def last_seq(self):
+        return self._windows[-1]["seq"] if self._windows else 0
+
+    def windows(self, last=0):
+        return [dict(w) for w in self._windows]
+
+    def seal(self, counters=None, series=None, gauges=None):
+        w = {
+            "seq": len(self._windows) + 1, "ts": 0.0,
+            "counters": counters or {}, "series": series or {},
+            "gauges": gauges or {},
+        }
+        self._windows.append(w)
+        for fn in list(self._listeners):
+            fn(w)
+        return w
+
+
+class _FakeProfiler:
+    def __init__(self):
+        self._listeners = []
+        self._windows = []
+        self._seal_seq = 0
+
+    def add_seal_listener(self, fn):
+        if fn not in self._listeners:
+            self._listeners.append(fn)
+
+    def remove_seal_listener(self, fn):
+        if fn in self._listeners:
+            self._listeners.remove(fn)
+
+    def last_seal_seq(self):
+        return self._seal_seq
+
+    def windows(self, last=0):
+        return [dict(w) for w in self._windows]
+
+    def seal(self, seq):
+        w = {"seq": seq, "ts": 0.0, "samples": 1, "stacks": {}}
+        if seq > 0:
+            self._seal_seq = seq
+            self._windows.append(w)
+        for fn in list(self._listeners):
+            fn(w)
+        return w
+
+
+def _bus(depth=256):
+    rec, hist, prof = _FakeRecorder(), _FakeHistory(), _FakeProfiler()
+    bus = TelemetryBus(
+        depth=depth, history=hist, recorder=rec, profiler=prof
+    )
+    return bus, rec, hist, prof
+
+
+# --------------------------------------------------------------- unit: bus
+class TestBus:
+    def test_taxonomy_and_unknown_stream_rejected(self):
+        assert STREAMS == ("flight", "window", "slo", "flame", "bundle")
+        bus, _rec, _hist, _prof = _bus()
+        with pytest.raises(ValueError, match="unknown streams"):
+            bus.subscribe(streams=["flight", "metrics"])
+
+    def test_publish_fans_out_typed_envelopes(self):
+        bus, rec, hist, _prof = _bus()
+        flights = bus.subscribe(streams=["flight"], name="f")
+        windows = bus.subscribe(streams=["window"], name="w")
+        rec.record("compaction", action="start")
+        hist.seal(counters={"app.ops": 3})
+        ev = flights.pop()
+        assert ev == {
+            "stream": "flight", "seq": 1,
+            "data": {"seq": 1, "ts": 1.0, "category": "compaction",
+                     "action": "start"},
+        }
+        assert flights.pop(timeout=0) is None  # no window leakage
+        w = windows.pop()
+        assert w["stream"] == "window" and w["seq"] == 1
+        assert w["data"]["counters"] == {"app.ops": 3}
+        assert bus.subscriber_count() == 2
+        for sub in (flights, windows):
+            bus.unsubscribe(sub)
+
+    def test_derived_streams_share_the_flight_seq(self):
+        """slo/bundle are flight-derived: same ring, same seqs — one
+        cursor vocabulary across the whole flight family."""
+        bus, rec, _hist, _prof = _bus()
+        sub = bus.subscribe(streams=["flight", "slo", "bundle"], name="d")
+        rec.record("slo_burn", slo="availability")
+        rec.record("bundle", reason="stall")
+        got = [(e["stream"], e["seq"]) for e in sub.drain()]
+        assert got == [
+            ("flight", 1), ("slo", 1), ("flight", 2), ("bundle", 2),
+        ]
+        bus.unsubscribe(sub)
+
+    def test_flame_fallback_seal_is_not_streamed(self):
+        """A seal with no aligned history window (seq <= 0) never hits
+        the flame stream — its seq is meaningless as a cursor."""
+        bus, _rec, _hist, prof = _bus()
+        sub = bus.subscribe(streams=["flame"], name="fl")
+        prof.seal(-1)
+        assert sub.pop(timeout=0) is None
+        prof.seal(7)
+        assert sub.pop()["seq"] == 7
+        bus.unsubscribe(sub)
+
+    def test_drop_oldest_accounting(self):
+        """A slow consumer costs ITSELF data — never the producer: the
+        oldest event drops, the counter records it (JG113 contract)."""
+        bus, rec, _hist, _prof = _bus()
+        dropped0 = registry.get_count("observability.stream.dropped")
+        sub = bus.subscribe(streams=["flight"], depth=4, name="slow")
+        for i in range(10):
+            rec.record("tick", n=i)
+        assert sub.dropped == 6
+        assert bus.dropped == 6
+        assert [e["seq"] for e in sub.drain()] == [7, 8, 9, 10]
+        assert registry.get_count(
+            "observability.stream.dropped"
+        ) == dropped0 + 6
+        stats = sub.stats()
+        assert stats["enqueued"] == 10 and stats["dropped"] == 6
+        bus.unsubscribe(sub)
+
+    def test_cursor_resume_replays_retained_tail_exactly_once(self):
+        """THE tentpole contract: a cursor is a replay floor — the
+        retained tail past it replays, live events append, and the
+        seam between them never duplicates or loses a seq."""
+        bus, rec, _hist, _prof = _bus()
+        for i in range(5):
+            rec.record("tick", n=i)
+        sub = bus.subscribe(
+            streams=["flight"], cursors={"flight": 2}, name="resume"
+        )
+        rec.record("tick", n=5)  # live, behind the replay
+        assert [e["seq"] for e in sub.drain()] == [3, 4, 5, 6]
+        # replay+live race: a re-publish of a replayed seq is a no-op
+        assert bus.publish("flight", 4, {"seq": 4}) == 0
+        assert sub.drain() == []
+        bus.unsubscribe(sub)
+
+    def test_no_cursor_means_live_only(self):
+        bus, rec, _hist, _prof = _bus()
+        rec.record("old")
+        sub = bus.subscribe(streams=["flight"], name="live")
+        assert sub.pop(timeout=0) is None  # history NOT re-bootstrapped
+        rec.record("new")
+        assert sub.pop()["data"]["category"] == "new"
+        bus.unsubscribe(sub)
+
+    def test_bus_cursors_read_the_sources(self):
+        bus, rec, hist, prof = _bus()
+        rec.record("a")
+        rec.record("b")
+        hist.seal()
+        prof.seal(1)
+        assert bus.cursors() == {
+            "flight": 2, "window": 1, "slo": 2, "flame": 1, "bundle": 2,
+        }
+
+    def test_name_filters_trim_windows_and_gate_flight(self):
+        """Category-prefix filtering: flight-family events gate on
+        category, windows are trimmed to matching metric names.  The
+        cursor still advances past filtered events — a filtered stream
+        is NOT gap-free, by design."""
+        bus, rec, hist, _prof = _bus()
+        sub = bus.subscribe(
+            streams=["flight", "window"], names=("compaction",),
+            name="filt",
+        )
+        rec.record("gc", pause_ms=3)
+        rec.record("compaction", level=1)
+        hist.seal(counters={"compaction.bytes": 9, "gc.pauses": 1})
+        hist.seal(counters={"gc.pauses": 2})
+        got = sub.drain()
+        assert [(e["stream"], e["seq"]) for e in got] == [
+            ("flight", 2), ("window", 1),
+        ]
+        assert got[1]["data"]["counters"] == {"compaction.bytes": 9}
+        # filtered events still advanced the cursor (gap by design)
+        assert sub.stats()["cursors"] == {"flight": 2, "window": 2}
+        bus.unsubscribe(sub)
+
+    def test_subscriber_drain_is_a_watchdog_progress_source(self):
+        """Satellite 1: every subscriber auto-registers its drain with
+        the watchdog singleton — a queue holding events whose delivered
+        count froze is a wedged consumer, caught with no wiring."""
+        bus, rec, _hist, _prof = _bus()
+        sub = bus.subscribe(streams=["flight"], name="drainee")
+        wd = watchdog_singleton()
+        assert "stream.drainee" in wd._progress
+        assert sub._progress() == {"active": 0, "progress": 0}
+        rec.record("tick")
+        assert sub._progress()["active"] == 1  # queued, undelivered
+        sub.pop()
+        assert sub._progress() == {"active": 0, "progress": 1}
+        bus.unsubscribe(sub)
+        assert "stream.drainee" not in wd._progress
+
+    def test_publish_self_cost_on_both_clocks(self):
+        bus, rec, _hist, _prof = _bus()
+        sub = bus.subscribe(streams=["flight"], name="clk")
+        rec.record("tick")
+        status = bus.status()
+        assert status["published"] == 1
+        assert status["overhead_wall_ms"] >= 0.0
+        assert status["overhead_cpu_ms"] >= 0.0
+        _c, _t, _h, gauges = registry.metric_objects()
+        assert "observability.stream.overhead_wall_ms" in gauges
+        assert "observability.stream.overhead_cpu_ms" in gauges
+        bus.unsubscribe(sub)
+
+    def test_configure_depth_and_reset(self):
+        bus, rec, _hist, _prof = _bus()
+        bus.configure(depth=8)
+        sub = bus.subscribe(streams=["flight"], name="cfg")
+        assert sub.depth == 8
+        rec.record("tick")
+        bus.reset()
+        assert sub.closed
+        assert bus.subscriber_count() == 0
+        assert bus.status()["published"] == 0
+        assert "stream.cfg" not in watchdog_singleton()._progress
+
+
+# --------------------------------------------------- endpoints: /watch
+@pytest.fixture
+def watch_server(tmp_path):
+    from janusgraph_tpu.core.graph import open_graph
+    from janusgraph_tpu.server import JanusGraphManager, JanusGraphServer
+
+    for step in (
+        sampling_profiler.stop, sampling_profiler.reset,
+        watchdog.stop, watchdog.reset,
+        flight_recorder.reset, registry.reset,
+    ):
+        step()
+    telemetry_bus.reset()
+    g = open_graph({"ids.authority-wait-ms": 0.0})
+    m = JanusGraphManager()
+    m.put_graph("graph", g)
+    s = JanusGraphServer(manager=m, bundle_dir=str(tmp_path)).start()
+    yield s
+    s.stop()
+    g.close()
+    telemetry_bus.reset()
+    history.reset()
+    slo_engine.reset()
+    for step in (
+        sampling_profiler.stop, sampling_profiler.reset,
+        watchdog.stop, watchdog.reset,
+        flight_recorder.reset, registry.reset,
+    ):
+        step()
+    import janusgraph_tpu.server.server as server_mod
+
+    with server_mod._HEALTH_LOCK:
+        server_mod._HEALTH_STATE["status"] = None
+
+
+def _get(base, path):
+    return urllib.request.urlopen(base + path, timeout=5).read()
+
+
+def _session(port, subscribe):
+    from janusgraph_tpu.driver.client import WatchSession
+
+    return WatchSession("127.0.0.1:%d" % port, subscribe=subscribe)
+
+
+def _recv_events(session, n, timeout=5.0):
+    """Collect the next n event frames, skipping heartbeats."""
+    out = []
+    deadline = time.monotonic() + timeout
+    while len(out) < n:
+        assert time.monotonic() < deadline, f"got {out}, wanted {n}"
+        frame = session.recv(timeout=0.25)
+        if frame and frame.get("type") == "event":
+            out.append(frame)
+    return out
+
+
+class TestWatchEndpoint:
+    def test_watch_info_advertises_capability_and_cursors(
+        self, watch_server
+    ):
+        base = "http://127.0.0.1:%d" % watch_server.port
+        info = json.loads(_get(base, "/watch/info"))
+        assert info["watch"] is True
+        assert info["streams"] == list(STREAMS)
+        assert set(info["cursors"]) == set(STREAMS)
+        assert info["subscribers"] == 0
+        assert isinstance(info["now"], float)
+
+    def test_live_events_then_cursor_resume_exactly_once(
+        self, watch_server
+    ):
+        """The acceptance path over a real socket: subscribe, see live
+        flight events, disconnect mid-stream, reconnect with the last
+        seen cursor — every missed event arrives exactly once."""
+        base = "http://127.0.0.1:%d" % watch_server.port
+        s1 = _session(
+            watch_server.port,
+            {"streams": ["flight"], "name": "t-live"},
+        )
+        try:
+            hello = s1.recv(timeout=5.0)
+            assert hello["type"] == "hello"
+            assert set(hello["cursors"]) == set(STREAMS)
+            flight_recorder.record("compaction", action="start", n=1)
+            (ev,) = _recv_events(s1, 1)
+            assert ev["stream"] == "flight"
+            assert ev["data"]["category"] == "compaction"
+            last = ev["seq"]
+        finally:
+            s1.close()
+        # events missed while disconnected...
+        flight_recorder.record("compaction", action="mid", n=2)
+        flight_recorder.record("compaction", action="end", n=3)
+        info = json.loads(_get(base, "/watch/info"))
+        assert info["cursors"]["flight"] == last + 2
+        s2 = _session(
+            watch_server.port,
+            {"streams": ["flight"], "cursors": {"flight": last},
+             "name": "t-resume"},
+        )
+        try:
+            evs = _recv_events(s2, 2)
+            assert [e["seq"] for e in evs] == [last + 1, last + 2]
+            assert [e["data"]["action"] for e in evs] == ["mid", "end"]
+            # exactly once: no event frame remains queued
+            tail = s2.recv(timeout=0.3)
+            assert tail is None or tail.get("type") != "event"
+        finally:
+            s2.close()
+
+    def test_heartbeats_carry_drop_count_and_bad_subscribe_errors(
+        self, watch_server
+    ):
+        s = _session(
+            watch_server.port,
+            {"streams": ["flight"], "heartbeat_s": 0.01, "name": "t-hb"},
+        )
+        try:
+            # the cadence clamps to >= 0.2 s; an idle stream heartbeats
+            hello = s.recv(timeout=5.0)
+            assert hello["type"] == "hello"
+            assert hello["heartbeat_s"] == 0.2
+            deadline = time.monotonic() + 5.0
+            frame = None
+            while frame is None or frame.get("type") != "heartbeat":
+                assert time.monotonic() < deadline
+                frame = s.recv(timeout=0.5)
+            assert frame["dropped"] == 0
+            assert isinstance(frame["ts"], float)
+        finally:
+            s.close()
+        bad = _session(watch_server.port, {"streams": ["bogus"]})
+        try:
+            frame = bad.recv(timeout=5.0)
+            assert frame["type"] == "error"
+            assert "unknown streams" in frame["message"]
+        finally:
+            bad.close()
+
+
+class TestProfileDiffEndpoint:
+    def test_diff_serves_frame_deltas_between_sealed_windows(
+        self, watch_server
+    ):
+        base = "http://127.0.0.1:%d" % watch_server.port
+        deadline = time.monotonic() + 5.0
+        while sampling_profiler.status()["samples"] < 3:
+            assert time.monotonic() < deadline
+            time.sleep(0.02)
+        sampling_profiler.seal_window()
+        sampling_profiler.sample_once()
+        sampling_profiler.seal_window()
+        body = json.loads(_get(base, "/debug/profile/diff"))
+        # defaults: a=-2, b=-1 — the last two retained windows
+        assert set(body) == {"a", "b", "frames"}
+        for side in ("a", "b"):
+            assert set(body[side]) == {"seq", "ts", "samples"}
+        assert isinstance(body["frames"], list)
+        if body["frames"]:
+            row = body["frames"][0]
+            assert {"frame", "old_us", "new_us", "delta_us",
+                    "delta_pct"} <= set(row)
+        top = json.loads(_get(base, "/debug/profile/diff?top=1"))
+        assert len(top["frames"]) <= 1
+
+    def test_diff_404_names_the_retained_windows(self, watch_server):
+        base = "http://127.0.0.1:%d" % watch_server.port
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            _get(base, "/debug/profile/diff?a=99999")
+        assert ei.value.code == 404
+        body = json.loads(ei.value.read())
+        assert "retained" in body["status"]["message"]
+
+
+class TestWatchCLI:
+    def test_watch_cli_tails_n_events_and_exits(
+        self, watch_server, capsys
+    ):
+        from janusgraph_tpu.cli import main
+
+        def _pump():
+            # feed events until the tail below has consumed one
+            for i in range(50):
+                flight_recorder.record("cli-probe", n=i)
+                time.sleep(0.05)
+
+        t = threading.Thread(target=_pump, daemon=True)
+        t.start()
+        rc = main([
+            "watch", "--url", "127.0.0.1:%d" % watch_server.port,
+            "--streams", "flight", "--names", "cli-probe",
+            "--count", "2",
+        ])
+        t.join(timeout=10.0)
+        assert rc == 0
+        out = capsys.readouterr().out
+        lines = [l for l in out.splitlines() if "cli-probe" in l]
+        assert len(lines) == 2
+        assert "flight" in lines[0]
